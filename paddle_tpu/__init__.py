@@ -1,0 +1,97 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capabilities, built from scratch on jax/XLA/Pallas (NOT a port).
+
+The public surface mirrors ``import paddle`` (SURVEY.md §1 L10): tensors +
+~2000 ops, ``nn.Layer``, optimizers, DataLoader, autograd, AMP, ``jit``
+trace-and-compile (the to_static role, with XLA playing CINN), and a full
+distributed stack over a named TPU mesh (DP / ZeRO sharding 1-3 / TP / PP /
+SP / ring+Ulysses context parallel / MoE expert parallel).
+"""
+
+from __future__ import annotations
+
+import jax as _jax
+
+# Paddle dtype semantics need real 64-bit types (int64 indices, optional
+# float64 math). Creation APIs still default python floats to float32
+# (paddle behavior) via framework.core coercion, so the compute path stays
+# fp32/bf16 — x64 only stops jax from silently truncating explicit 64-bit
+# requests.
+_jax.config.update("jax_enable_x64", True)
+
+import jax.numpy as _jnp
+
+# ---- dtypes (paddle.float32 etc.) ----------------------------------------
+float16 = _jnp.float16
+float32 = _jnp.float32
+float64 = _jnp.float64
+bfloat16 = _jnp.bfloat16
+int8 = _jnp.int8
+int16 = _jnp.int16
+int32 = _jnp.int32
+int64 = _jnp.int64
+uint8 = _jnp.uint8
+bool = _jnp.bool_
+complex64 = _jnp.complex64
+complex128 = _jnp.complex128
+float8_e4m3fn = _jnp.float8_e4m3fn
+float8_e5m2 = _jnp.float8_e5m2
+
+from .framework.core import (Tensor, no_grad, enable_grad, is_grad_enabled,
+                             set_grad_enabled)
+from .framework import random as _random
+from .framework.random import seed, get_rng_state, set_rng_state
+from .framework.device import (CPUPlace, TPUPlace, CUDAPlace, XPUPlace,
+                               CustomPlace, set_device, get_device,
+                               device_count, is_compiled_with_cuda,
+                               is_compiled_with_rocm, is_compiled_with_xpu,
+                               is_compiled_with_tpu)
+from .framework.flags import get_flags, set_flags
+from .framework.io import save, load
+
+from .ops import *  # noqa: F401,F403  (creation/math/manip/linalg/... ops)
+from .ops import creation as _creation
+from .autograd import grad, backward  # noqa: F401
+from .framework.core import Parameter  # noqa: F401
+
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import io  # noqa: F401
+from . import autograd  # noqa: F401
+from . import amp  # noqa: F401
+from . import jit  # noqa: F401
+from . import static  # noqa: F401
+from . import distributed  # noqa: F401
+from . import distribution  # noqa: F401
+from . import metric  # noqa: F401
+from . import incubate  # noqa: F401
+from . import profiler  # noqa: F401
+from . import device  # noqa: F401
+from . import vision  # noqa: F401
+from . import sparse  # noqa: F401
+from . import version  # noqa: F401
+
+from .hapi.model import Model  # noqa: F401
+from .nn.layer.layers import Layer  # noqa: F401  (paddle.nn.Layer shortcut)
+from .jit import to_static  # noqa: F401
+
+# paddle.disable_static/enable_static: dygraph is the default; static mode
+# switches the ``paddle.static`` program-building API on.
+from .static.mode import (enable_static, disable_static,  # noqa: F401
+                          in_dynamic_mode)
+
+
+def DataParallel(layers, **kwargs):
+    """paddle.DataParallel — on TPU, data parallelism is mesh-sharded
+    (GSPMD inserts the gradient psum); the wrapper exists for source parity
+    and marks the layer for the 'data' mesh axis."""
+    from .distributed.parallel import DataParallel as _DP
+    return _DP(layers, **kwargs)
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    from .hapi.summary import summary as _summary
+    return _summary(net, input_size, dtypes, input)
+
+
+__version__ = "0.1.0"

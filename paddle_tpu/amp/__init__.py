@@ -1,0 +1,6 @@
+from .auto_cast import auto_cast, amp_guard, is_auto_cast_enabled, \
+    amp_state, white_list, black_list, decorate
+from .grad_scaler import GradScaler, AmpScaler
+
+__all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate",
+           "is_auto_cast_enabled", "white_list", "black_list"]

@@ -1,0 +1,114 @@
+"""Automatic mixed precision — the role of ``paddle.amp.auto_cast`` plus the
+C++ per-op cast insertion (``paddle/fluid/eager/amp_*``, UNVERIFIED).
+
+TPU-first: bf16 is the native mixed-precision dtype (no loss scaling needed);
+fp16 ('O1'/'O2' with GradScaler) is supported for source parity. The cast
+policy is applied inside the hot ops (matmul/conv/attention) rather than by
+rewriting every op — the XLA fusion pass makes the surrounding elementwise
+dtype churn free.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, to_jax_dtype, is_floating
+
+__all__ = ["auto_cast", "amp_guard", "is_auto_cast_enabled", "amp_state",
+           "maybe_cast_matmul", "white_list", "black_list", "decorate"]
+
+# ops always cast to low precision under AMP (mirrors paddle's white list)
+white_list = {"matmul", "conv2d", "conv1d", "conv3d", "einsum", "mm", "bmm",
+              "attention", "linear"}
+# ops kept in fp32 (reductions that need range)
+black_list = {"softmax", "log_softmax", "layer_norm", "cross_entropy",
+              "exp", "log", "mean", "sum", "norm"}
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white = set()
+        self.custom_black = set()
+
+
+_state = _AmpState()
+
+
+def amp_state() -> _AmpState:
+    return _state
+
+
+def is_auto_cast_enabled() -> bool:
+    return _state.enabled
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    prev = (_state.enabled, _state.dtype, _state.level,
+            _state.custom_white, _state.custom_black)
+    _state.enabled = bool(enable)
+    _state.dtype = to_jax_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def maybe_cast_matmul(x: Tensor, y: Tensor):
+    """Cast matmul operands to the AMP dtype when auto_cast is active."""
+    if not _state.enabled:
+        return x, y
+    lo = _state.dtype
+
+    def cast(t):
+        if isinstance(t, Tensor) and is_floating(t.dtype) and t.dtype != lo:
+            from ..ops.manipulation import cast as cast_op
+            return cast_op(t, lo)
+        return t
+    return cast(x), cast(y)
+
+
+def maybe_cast(t, op_name: str):
+    """Generic AMP cast hook for a named op."""
+    if not _state.enabled:
+        return t
+    wl = (white_list | _state.custom_white) - _state.custom_black
+    if op_name not in wl:
+        return t
+    if isinstance(t, Tensor) and is_floating(t.dtype) \
+            and t.dtype != _state.dtype:
+        from ..ops.manipulation import cast as cast_op
+        return cast_op(t, _state.dtype)
+    return t
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """``paddle.amp.decorate`` — for O2, cast model params to the AMP dtype.
+    Optimizer master weights are handled by the optimizer (it keeps fp32
+    copies when params are low-precision)."""
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        lo = to_jax_dtype(dtype)
+        for m in model_list:
+            for p in m.parameters():
+                if is_floating(p.dtype):
+                    p.set_data(p._data.astype(lo))
+    if optimizers is None:
+        return models
+    return models, optimizers

@@ -1,0 +1,114 @@
+"""Dynamic loss scaling — ``paddle.amp.GradScaler`` parity (UNVERIFIED path
+python/paddle/amp/grad_scaler.py; kernels ``check_finite_and_unscale`` /
+``update_loss_scaling`` in phi).
+
+On TPU bf16 training doesn't need loss scaling; this exists for fp16 parity
+and follows the same dynamic-scale algorithm (grow after N good steps, shrink
+on inf/nan, skip the step)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, no_grad
+
+__all__ = ["GradScaler", "AmpScaler"]
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=2000,
+                 decr_every_n_nan_or_inf=1, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n_steps = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+        # guards the unscale_-then-step pattern against double unscaling
+        self._unscaled_since_step = False
+
+    def is_enable(self) -> bool:
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self) -> bool:
+        return self._dynamic
+
+    def get_loss_scaling(self) -> float:
+        return self._scale
+
+    def set_init_loss_scaling(self, v) -> None:
+        self._scale = float(v)
+
+    def scale(self, var: Tensor) -> Tensor:
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer) -> None:
+        if not self._enable:
+            return
+        if self._unscaled_since_step:
+            raise RuntimeError(
+                "GradScaler.unscale_() already called since the last "
+                "step()/update(); calling it twice would double-unscale "
+                "the gradients")
+        self._unscaled_since_step = True
+        inv = 1.0 / self._scale
+        found = jnp.asarray(False)
+        with no_grad():
+            for p in optimizer._parameter_list:
+                if p.grad is None:
+                    continue
+                g = p.grad._data * inv
+                found = jnp.logical_or(found, ~jnp.all(jnp.isfinite(g)))
+                p.grad.set_data(g)
+        self._found_inf = bool(found)
+
+    def step(self, optimizer) -> None:
+        if not self._enable:
+            optimizer.step()
+            return
+        if not self._unscaled_since_step:
+            self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss) -> None:
+        self.step(optimizer)
+
+    def update(self) -> None:
+        self._unscaled_since_step = False
+        if not (self._enable and self._dynamic):
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n_steps:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def state_dict(self) -> dict:
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_count": self._good_steps, "decr_count": self._bad_steps}
+
+    def load_state_dict(self, state: dict) -> None:
+        self._scale = state.get("scale", self._scale)
+        self._good_steps = state.get("incr_count", 0)
+        self._bad_steps = state.get("decr_count", 0)
+
+
+AmpScaler = GradScaler

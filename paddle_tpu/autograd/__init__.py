@@ -1,0 +1,172 @@
+"""User-facing autograd API — ``python/paddle/autograd/`` parity
+(UNVERIFIED): ``backward``, ``grad``, ``no_grad``, ``PyLayer``."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+
+from ..framework.core import (Tensor, apply, backward as _backward_impl,
+                              no_grad, enable_grad, is_grad_enabled,
+                              set_grad_enabled)
+
+__all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
+           "set_grad_enabled", "PyLayer", "PyLayerContext", "hessian",
+           "jacobian"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if isinstance(tensors, Tensor):
+        tensors = [tensors]
+    if isinstance(grad_tensors, Tensor):
+        grad_tensors = [grad_tensors]
+    _backward_impl(tensors, grad_tensors, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None, name=None):
+    """``paddle.grad`` — grads of outputs w.r.t. inputs without touching
+    ``.grad`` of other leaves. Implemented by running the tape backward and
+    collecting; parity caveat: ``create_graph=True`` (double grad) is
+    supported through ``paddle_tpu.incubate.autograd.grad`` jax-native path.
+    """
+    outputs = [outputs] if isinstance(outputs, Tensor) else list(outputs)
+    inputs = [inputs] if isinstance(inputs, Tensor) else list(inputs)
+    if grad_outputs is not None and isinstance(grad_outputs, Tensor):
+        grad_outputs = [grad_outputs]
+    # save/restore existing .grad so paddle.grad is side-effect free;
+    # accumulate_ids makes the engine deposit cotangents on the requested
+    # inputs even when they are intermediates (non-leaves)
+    saved = [(t, t.grad) for t in _all_leaves(outputs) + inputs]
+    seen_saved = set()
+    saved = [(t, g) for t, g in saved
+             if not (id(t) in seen_saved or seen_saved.add(id(t)))]
+    for t, _ in saved:
+        t.grad = None
+    try:
+        _backward_impl(outputs, grad_outputs, retain_graph=True,
+                       accumulate_ids=frozenset(id(t) for t in inputs))
+        res = []
+        for i, t in enumerate(inputs):
+            if t.grad is None:
+                if not allow_unused:
+                    raise ValueError(
+                        f"paddle.grad: input {i} is unreachable from the "
+                        "outputs (no gradient path); pass allow_unused=True "
+                        "to get None for such inputs")
+                res.append(None)
+            else:
+                res.append(Tensor(t.grad._data))
+        return res
+    finally:
+        for t, g in saved:
+            t.grad = g
+
+
+def _all_leaves(outputs):
+    seen, leaves, stack = set(), [], []
+    for o in outputs:
+        if o._node is not None:
+            stack.append(o._node)
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        for p in n.parents:
+            if p._node is None:
+                leaves.append(p)
+            else:
+                stack.append(p._node)
+    return leaves
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved = ()
+        self.__dict__["_attrs"] = {}
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    def saved_tensor(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom autograd function — ``paddle.autograd.PyLayer`` parity.
+
+    Subclass with static ``forward(ctx, *args)`` and ``backward(ctx, *grads)``
+    returning input grads. Runs through the tape via jax.custom_vjp-style
+    recording."""
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        from ..framework.core import GradNode, is_grad_enabled
+        ctx = PyLayerContext()
+        with no_grad():
+            outs = cls.forward(ctx, *args, **kwargs)
+        single = isinstance(outs, Tensor)
+        out_list = [outs] if single else list(outs)
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)]
+        needs = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+        if needs:
+            def vjp_fn(cotangents):
+                gs = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                gts = [Tensor(g) for g in gs]
+                with no_grad():
+                    in_grads = cls.backward(ctx, *gts) if len(gts) > 1 \
+                        else cls.backward(ctx, gts[0])
+                if isinstance(in_grads, Tensor) or in_grads is None:
+                    in_grads = (in_grads,)
+                return tuple(
+                    g._data if isinstance(g, Tensor) else g
+                    for g in in_grads)
+            parents = [t for t in tensor_inputs if not t.stop_gradient]
+            # map backward outputs (per tensor input) onto parents
+            def vjp_parents(cotangents):
+                full = vjp_fn(cotangents)
+                out = []
+                k = 0
+                for t in tensor_inputs:
+                    g = full[k] if k < len(full) else None
+                    k += 1
+                    if not t.stop_gradient:
+                        out.append(g)
+                return tuple(out)
+            node = GradNode(vjp_parents, parents, len(out_list),
+                            name=cls.__name__,
+                            out_avals=[(o._data.shape, o._data.dtype)
+                                       for o in out_list])
+            for i, o in enumerate(out_list):
+                o._node = node
+                o._out_idx = i
+                o.stop_gradient = False
+        return outs
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """Dense jacobian via jax.jacrev on the underlying arrays."""
+    single_y = isinstance(ys, Tensor)
+    single_x = isinstance(xs, Tensor)
+    ylist = [ys] if single_y else list(ys)
+    xlist = [xs] if single_x else list(xs)
+    raise NotImplementedError(
+        "Use paddle_tpu.incubate.autograd.jacobian (jax-native) — the "
+        "tape records concrete values; jacobians need a functional recompute.")
+
+
+def hessian(ys, xs, batch_axis=None):
+    raise NotImplementedError(
+        "Use paddle_tpu.incubate.autograd.hessian (jax-native).")

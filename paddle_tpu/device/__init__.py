@@ -1,0 +1,121 @@
+"""``paddle.device`` namespace — device queries + memory stats.
+
+The reference's allocator stats (StatAllocator, SURVEY.md §2.1) map to PJRT
+memory stats here."""
+
+from __future__ import annotations
+
+import jax
+
+from ..framework.device import (set_device, get_device, device_count,  # noqa
+                                CPUPlace, TPUPlace, CUDAPlace, XPUPlace,
+                                CustomPlace, is_compiled_with_cuda,
+                                is_compiled_with_xpu, is_compiled_with_tpu)
+
+__all__ = ["set_device", "get_device", "device_count", "CPUPlace",
+           "TPUPlace", "CUDAPlace", "XPUPlace", "CustomPlace",
+           "is_compiled_with_cuda", "is_compiled_with_xpu",
+           "is_compiled_with_tpu", "memory_stats", "max_memory_allocated",
+           "max_memory_reserved", "memory_allocated", "memory_reserved",
+           "synchronize", "get_available_device", "cuda"]
+
+
+def _mem_stats(device_id=0):
+    devs = jax.devices()
+    d = devs[min(device_id, len(devs) - 1)]
+    try:
+        return d.memory_stats() or {}
+    except Exception:
+        return {}
+
+
+def memory_stats(device=None):
+    return _mem_stats(_dev_id(device))
+
+
+def _dev_id(device):
+    if device is None:
+        return 0
+    if isinstance(device, int):
+        return device
+    if isinstance(device, str) and ":" in device:
+        return int(device.split(":")[1])
+    return getattr(device, "device_id", 0)
+
+
+def max_memory_allocated(device=None):
+    return _mem_stats(_dev_id(device)).get("peak_bytes_in_use", 0)
+
+
+def memory_allocated(device=None):
+    return _mem_stats(_dev_id(device)).get("bytes_in_use", 0)
+
+
+def max_memory_reserved(device=None):
+    s = _mem_stats(_dev_id(device))
+    return s.get("peak_bytes_in_use", s.get("bytes_limit", 0))
+
+
+def memory_reserved(device=None):
+    s = _mem_stats(_dev_id(device))
+    return s.get("bytes_in_use", 0)
+
+
+def synchronize(device=None):
+    """Block until queued work is observable (paddle.device.synchronize).
+    XLA serializes per-device execution, so readiness of a fresh transfer
+    implies prior work completed."""
+    jax.block_until_ready(jax.device_put(0))
+
+
+def get_available_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+class cuda:
+    """paddle.device.cuda namespace shim (maps to the accelerator)."""
+
+    @staticmethod
+    def device_count():
+        return device_count("tpu")
+
+    @staticmethod
+    def max_memory_allocated(device=None):
+        return max_memory_allocated(device)
+
+    @staticmethod
+    def memory_allocated(device=None):
+        return memory_allocated(device)
+
+    @staticmethod
+    def max_memory_reserved(device=None):
+        return max_memory_reserved(device)
+
+    @staticmethod
+    def memory_reserved(device=None):
+        return memory_reserved(device)
+
+    @staticmethod
+    def synchronize(device=None):
+        return synchronize(device)
+
+    @staticmethod
+    def empty_cache():
+        pass
+
+    class Event:
+        def __init__(self, *a, **k):
+            pass
+
+        def record(self, *a):
+            pass
+
+        def synchronize(self):
+            synchronize()
+
+    class Stream:
+        def __init__(self, *a, **k):
+            pass
+
+        def synchronize(self):
+            synchronize()

@@ -1,0 +1,42 @@
+"""``paddle.distributed`` — the distributed stack over a named TPU mesh
+(SURVEY.md §2.3: DP / sharding 1-3 / TP / PP / SP / CP(ring+Ulysses) / EP,
+hybrid-composed).
+
+Data plane = XLA collectives over ICI/DCN inside compiled programs (GSPMD or
+shard_map); control plane = jax.distributed. The fleet/communication APIs
+keep Paddle's shape for source familiarity."""
+
+from .env import (init_parallel_env, get_rank, get_world_size,
+                  is_initialized, ParallelEnv)
+from .mesh import (ProcessMesh, Shard, Replicate, Partial, shard_tensor,
+                   reshard, dtensor_from_fn, shard_layer, get_mesh,
+                   set_mesh, auto_mesh)
+from .communication import (all_reduce, all_gather, all_gather_object,
+                            reduce_scatter, alltoall, alltoall_single,
+                            broadcast, broadcast_object_list, reduce, scatter,
+                            send, recv, isend, irecv, barrier, new_group,
+                            get_group, wait, ReduceOp, P2POp,
+                            batch_isend_irecv, stream)
+from .parallel import DataParallel
+from . import fleet
+from . import checkpoint
+from .checkpoint.save_load import (save_state_dict, load_state_dict)
+from .parallel_layers import (ColumnParallelLinear, RowParallelLinear,
+                              VocabParallelEmbedding, ParallelCrossEntropy)
+from .auto_parallel_api import (to_static as dist_to_static, Strategy,
+                                DistAttr, DistModel, unshard_dtensor)
+from . import launch  # noqa: F401
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
+    "ParallelEnv", "ProcessMesh", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "dtensor_from_fn", "shard_layer", "get_mesh",
+    "set_mesh", "auto_mesh", "all_reduce", "all_gather", "all_gather_object",
+    "reduce_scatter", "alltoall", "alltoall_single", "broadcast",
+    "broadcast_object_list", "reduce", "scatter", "send", "recv", "isend",
+    "irecv", "barrier", "new_group", "get_group", "wait", "ReduceOp",
+    "P2POp", "batch_isend_irecv", "DataParallel", "fleet", "checkpoint",
+    "save_state_dict", "load_state_dict", "ColumnParallelLinear",
+    "RowParallelLinear", "VocabParallelEmbedding", "ParallelCrossEntropy",
+    "Strategy", "DistAttr", "DistModel", "unshard_dtensor", "stream",
+]

@@ -1,0 +1,110 @@
+"""Auto-parallel mid-layer — ``dist.to_static`` / ``DistModel`` / Strategy
+parity (UNVERIFIED paths python/paddle/distributed/auto_parallel/).
+
+The reference's static SPMD planner (completion pass over spmd_rules +
+reshard) is GSPMD's job here: ``dist.to_static`` functionalizes the train
+step exactly like ``paddle_tpu.jit.to_static`` — parameters already carry
+NamedSharding placements, so XLA propagates shardings op-by-op and inserts
+collectives/reshards."""
+
+from __future__ import annotations
+
+from ..framework.core import Tensor
+
+__all__ = ["Strategy", "DistAttr", "DistModel", "to_static",
+           "unshard_dtensor"]
+
+
+class Strategy:
+    def __init__(self, config=None):
+        config = config or {}
+        self.sharding = _Cfg(config.get("sharding", {}))
+        self.fused_passes = _Cfg(config.get("fused_passes", {}))
+        self.gradient_merge = _Cfg(config.get("gradient_merge", {}))
+        self.pipeline = _Cfg(config.get("pipeline", {}))
+        self.amp = _Cfg(config.get("amp", {}))
+
+
+class _Cfg:
+    def __init__(self, d):
+        self.enable = d.get("enable", False)
+        self.__dict__.update(d)
+
+
+class DistAttr:
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = sharding_specs
+
+
+class DistModel:
+    """Wraps (layer, loader, loss, optimizer) into compiled train/eval
+    steps — ``dist.to_static`` return object parity."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None):
+        self.network = layer
+        self._loader = loader
+        self._loss = loss
+        self._optimizer = optimizer
+        self._mode = "train"
+        from ..jit.to_static_api import StaticFunction
+        self._train_step = StaticFunction(self._train_impl)
+        self._eval_step = StaticFunction(self._eval_impl)
+
+    def _train_impl(self, *inputs):
+        *xs, label = inputs
+        out = self.network(*xs)
+        loss = self._loss(out, label)
+        loss.backward()
+        self._optimizer.step()
+        self._optimizer.clear_grad()
+        return loss
+
+    def _eval_impl(self, *inputs):
+        *xs, label = inputs
+        out = self.network(*xs)
+        return self._loss(out, label)
+
+    def train(self):
+        self._mode = "train"
+        self.network.train()
+
+    def eval(self):
+        self._mode = "eval"
+        self.network.eval()
+
+    def __call__(self, *inputs):
+        if self._mode == "train":
+            return self._train_step(*inputs)
+        return self._eval_step(*inputs)
+
+    def state_dict(self, mode="all"):
+        sd = dict(self.network.state_dict())
+        if mode in ("all", "opt") and self._optimizer is not None:
+            sd.update(self._optimizer.state_dict())
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self.network.set_state_dict(state_dict)
+        if self._optimizer is not None:
+            self._optimizer.set_state_dict(state_dict)
+
+    def dist_main_program(self, mode=None):
+        return None
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None, strategy=None):
+    """``dist.to_static`` — returns a DistModel with compiled steps."""
+    return DistModel(layer, loader, loss, optimizer, strategy)
+
+
+def unshard_dtensor(dist_tensor: Tensor) -> Tensor:
+    """Gather a sharded tensor to a replicated dense tensor."""
+    import jax
+    import numpy as np
+    data = dist_tensor._data
+    if isinstance(data, jax.Array):
+        out = jax.device_get(data)
+        return Tensor(out)
+    return Tensor(np.asarray(data))

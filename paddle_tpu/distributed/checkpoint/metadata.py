@@ -1,0 +1,23 @@
+"""Checkpoint metadata — ``paddle.distributed.checkpoint.metadata`` parity
+(UNVERIFIED). Records global shape + per-shard offsets so load can reshard
+across a different mesh/parallelism."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class LocalTensorMetadata:
+    global_shape: tuple
+    local_shape: tuple
+    global_offset: tuple
+    dtype: str
+    file_name: str = ""
+
+
+@dataclass
+class Metadata:
+    state_dict_metadata: dict = field(default_factory=dict)
+    # name -> list[LocalTensorMetadata]
+    flat_mapping: dict = field(default_factory=dict)

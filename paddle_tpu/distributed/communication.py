@@ -1,0 +1,391 @@
+"""``paddle.distributed.communication`` — collective API
+(python/paddle/distributed/communication/ parity, UNVERIFIED).
+
+Reference mechanism: eager NCCL collectives through ProcessGroup (SURVEY.md
+§2.1). TPU-native mechanism: collectives are *compiled* XLA ops over mesh
+axes. This module therefore has two modes:
+
+- **Traced mode** (inside ``shard_map``/``pjit`` over a mesh axis): calls
+  lower to ``lax.psum/all_gather/ppermute/all_to_all`` on the group's axis
+  name — this is the hot path used by the parallel layers and pipeline
+  schedules.
+- **Eager mode** (plain dygraph): with one participant they are identity
+  ops (matching single-process paddle); true multi-process *eager*
+  collectives are intentionally not the TPU way (data-plane comm belongs
+  inside the compiled program) and raise with guidance.
+
+Groups carry a mesh-axis name instead of an NCCL communicator."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.core import Tensor, apply
+from .env import get_rank, get_world_size
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
+           "all_gather", "all_gather_object", "reduce_scatter", "alltoall",
+           "alltoall_single", "broadcast", "broadcast_object_list", "reduce",
+           "scatter", "send", "recv", "isend", "irecv", "barrier", "wait",
+           "P2POp", "batch_isend_irecv", "stream", "in_traced_collective"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+@dataclass
+class Group:
+    id: int = 0
+    ranks: list = field(default_factory=list)
+    axis_name: str | None = None  # mesh axis this group maps onto
+
+    @property
+    def nranks(self):
+        if self.axis_name is not None and _axis_bound(self.axis_name):
+            return lax.axis_size(self.axis_name)
+        return len(self.ranks) if self.ranks else max(get_world_size(), 1)
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if self.ranks else rank
+
+
+_groups: dict[int, Group] = {}
+_next_gid = [1]
+_default_group = Group(0, [], None)
+_groups[0] = _default_group
+
+
+def _axis_bound(name: str) -> bool:
+    """True when `name` is a mapped axis in the current trace context."""
+    if name is None:
+        return False
+    try:
+        lax.axis_size(name)
+        return True
+    except (NameError, KeyError, Exception):
+        return False
+
+
+def in_traced_collective(group=None) -> bool:
+    g = group or _default_group
+    return g.axis_name is not None and _axis_bound(g.axis_name)
+
+
+def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
+    gid = _next_gid[0]
+    _next_gid[0] += 1
+    g = Group(gid, list(ranks) if ranks else [], axis_name)
+    _groups[gid] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    return _groups.get(gid, _default_group)
+
+
+def _axis(group) -> str | None:
+    g = group or _default_group
+    return g.axis_name
+
+
+def _single(group) -> bool:
+    g = group or _default_group
+    return not in_traced_collective(g) and g.nranks <= 1
+
+
+def _raise_eager(op: str):
+    raise RuntimeError(
+        f"{op}: eager multi-process collectives are not the TPU data "
+        "plane. Run this op inside a compiled region over a mesh axis "
+        "(shard_map / fleet.distributed_model / to_static), or use "
+        "*_object collectives for host-side control data.")
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
+    if in_traced_collective(group):
+        name = _axis(group)
+        fns = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
+               ReduceOp.MIN: lax.pmin,
+               ReduceOp.AVG: lambda x, n: lax.pmean(x, n)}
+        if op == ReduceOp.PROD:
+            out = apply(lambda a: jnp.exp(lax.psum(jnp.log(a), name)),
+                        tensor, name="all_reduce_prod")
+        else:
+            out = apply(lambda a: fns[op](a, name), tensor,
+                        name="all_reduce")
+        tensor.set_data(out._data, _clear_tape=False)
+        tensor._node, tensor._out_idx = out._node, out._out_idx
+        return tensor
+    if _single(group):
+        return tensor
+    _raise_eager("all_reduce")
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
+    if in_traced_collective(group):
+        name = _axis(group)
+        out = apply(lambda a: lax.all_gather(a, name), tensor,
+                    name="all_gather")
+        n = (group or _default_group).nranks
+        from ..ops.manipulation import unbind
+        parts = unbind(out, 0)
+        if isinstance(tensor_list, list):
+            tensor_list.extend(parts)
+            return tensor_list
+        return parts
+    if _single(group):
+        if isinstance(tensor_list, list):
+            tensor_list.append(tensor)
+            return tensor_list
+        return [tensor]
+    _raise_eager("all_gather")
+
+
+def all_gather_object(object_list, obj, group=None):
+    """Host-side control-plane gather (checkpoint coordination etc.)."""
+    if get_world_size() <= 1:
+        object_list.append(obj)
+        return object_list
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(jnp.asarray(0))  # barrier
+    # object gather via broadcast of pickled payloads is host-count sized;
+    # single-host path above covers tests. Multi-host: use jax broadcast.
+    import pickle
+    import numpy as np
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    sizes = multihost_utils.process_allgather(
+        jnp.asarray([payload.size], jnp.int32))
+    maxlen = int(np.max(np.asarray(sizes)))
+    padded = np.zeros(maxlen, np.uint8)
+    padded[: payload.size] = payload
+    all_payloads = multihost_utils.process_allgather(jnp.asarray(padded))
+    arr = np.asarray(all_payloads)
+    for i in range(arr.shape[0]):
+        object_list.append(
+            pickle.loads(arr[i, : int(np.asarray(sizes)[i, 0])].tobytes()))
+    return object_list
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    if in_traced_collective(group):
+        name = _axis(group)
+        src = tensor_list
+        if isinstance(src, (list, tuple)):
+            from ..ops.manipulation import concat
+            src = concat(list(src), axis=0)
+        out = apply(lambda a: lax.psum_scatter(a, name, tiled=True), src,
+                    name="reduce_scatter")
+        tensor.set_data(out._data, _clear_tape=False)
+        tensor._node, tensor._out_idx = out._node, out._out_idx
+        return tensor
+    if _single(group):
+        src = tensor_list[0] if isinstance(tensor_list, (list, tuple)) \
+            else tensor_list
+        tensor.set_data(src._data, _clear_tape=False)
+        tensor._node, tensor._out_idx = src._node, src._out_idx
+        return tensor
+    _raise_eager("reduce_scatter")
+
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    if in_traced_collective(group):
+        name = _axis(group)
+        from ..ops.manipulation import stack, unbind
+        stacked = stack(list(in_tensor_list), axis=0)
+        out = apply(lambda a: lax.all_to_all(a, name, split_axis=0,
+                                             concat_axis=0, tiled=False),
+                    stacked, name="alltoall")
+        parts = unbind(out, 0)
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.extend(parts)
+            return out_tensor_list
+        return parts
+    if _single(group):
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.extend(in_tensor_list)
+            return out_tensor_list
+        return list(in_tensor_list)
+    _raise_eager("alltoall")
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    if in_traced_collective(group):
+        name = _axis(group)
+        out = apply(lambda a: lax.all_to_all(
+            a, name, split_axis=0, concat_axis=0, tiled=True),
+            in_tensor, name="alltoall_single")
+        out_tensor.set_data(out._data, _clear_tape=False)
+        out_tensor._node = out._node
+        out_tensor._out_idx = out._out_idx
+        return out_tensor
+    if _single(group):
+        out_tensor.set_data(in_tensor._data, _clear_tape=False)
+        out_tensor._node = in_tensor._node
+        out_tensor._out_idx = in_tensor._out_idx
+        return out_tensor
+    _raise_eager("alltoall_single")
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    if in_traced_collective(group):
+        name = _axis(group)
+        g = group or _default_group
+        src_local = g.get_group_rank(src) if g.ranks else src
+
+        def fn(a):
+            # select src's value on every member: gather then index
+            return lax.all_gather(a, name)[src_local]
+        out = apply(fn, tensor, name="broadcast")
+        tensor.set_data(out._data, _clear_tape=False)
+        tensor._node, tensor._out_idx = out._node, out._out_idx
+        return tensor
+    if _single(group):
+        return tensor
+    _raise_eager("broadcast")
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    if get_world_size() <= 1:
+        return object_list
+    import pickle
+    import numpy as np
+    from jax.experimental import multihost_utils
+    if get_rank() == src:
+        payload = np.frombuffer(pickle.dumps(object_list), np.uint8)
+    else:
+        payload = np.zeros(0, np.uint8)
+    out = multihost_utils.broadcast_one_to_all(
+        jnp.asarray(payload), is_source=get_rank() == src)
+    if get_rank() != src:
+        object_list[:] = pickle.loads(np.asarray(out).tobytes())
+    return object_list
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # on TPU a reduce-to-root inside SPMD is just an all_reduce (cheap over
+    # ICI; avoids divergent programs)
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    if in_traced_collective(group):
+        name = _axis(group)
+        from ..ops.manipulation import stack
+        stacked = stack(list(tensor_list), axis=0)
+
+        def fn(a):
+            # every rank holds the full list (SPMD); pick own slice
+            idx = lax.axis_index(name)
+            return lax.dynamic_index_in_dim(a, idx, 0, keepdims=False)
+        out = apply(fn, stacked, name="scatter")
+        tensor.set_data(out._data, _clear_tape=False)
+        tensor._node, tensor._out_idx = out._node, out._out_idx
+        return tensor
+    if _single(group):
+        src_t = tensor_list[0]
+        tensor.set_data(src_t._data, _clear_tape=False)
+        tensor._node, tensor._out_idx = src_t._node, src_t._out_idx
+        return tensor
+    _raise_eager("scatter")
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    if in_traced_collective(group):
+        raise RuntimeError(
+            "point-to-point send/recv inside traced code should use "
+            "lax.ppermute via paddle_tpu.distributed.fleet p2p helpers")
+    if _single(group):
+        _p2p_buf.append(tensor)
+        return
+    _raise_eager("send")
+
+
+_p2p_buf: list = []
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    if _single(group):
+        if _p2p_buf:
+            src_t = _p2p_buf.pop(0)
+            tensor.set_data(src_t._data, _clear_tape=False)
+        return tensor
+    _raise_eager("recv")
+
+
+class _Work:
+    def wait(self):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def isend(tensor, dst=0, group=None):
+    send(tensor, dst, group)
+    return _Work()
+
+
+def irecv(tensor, src=0, group=None):
+    recv(tensor, src, group)
+    return _Work()
+
+
+class P2POp:
+    def __init__(self, op, tensor, peer, group=None):
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    works = []
+    for op in p2p_op_list:
+        works.append(op.op(op.tensor, op.peer, op.group))
+    return works
+
+
+def barrier(group=None):
+    if get_world_size() <= 1:
+        return
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices("paddle_tpu_barrier")
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    jax.block_until_ready(tensor._data)
+    return tensor
+
+
+class stream:
+    """``paddle.distributed.stream`` namespace: stream-targeted variants.
+    XLA owns scheduling on TPU; these alias the defaults."""
+
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    reduce_scatter = staticmethod(reduce_scatter)
+    alltoall = staticmethod(alltoall)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    scatter = staticmethod(scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)

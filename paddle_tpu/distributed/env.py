@@ -1,0 +1,98 @@
+"""Distributed environment / rendezvous.
+
+Reference role: ``init_parallel_env`` + TCPStore + ``PADDLE_TRAINER_*`` env
+bootstrap (SURVEY.md §3.3, UNVERIFIED paths). TPU-native: the control plane
+is ``jax.distributed`` (gRPC coordination service); the data plane is XLA
+collectives over ICI/DCN — there is no ProcessGroup object to create per
+communicator, only the global mesh. Rank/world-size here mean *process*
+(host) coordinates; device-level parallelism lives in the Mesh."""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size",
+           "is_initialized", "ParallelEnv", "parallel_device_count"]
+
+_initialized = False
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def init_parallel_env():
+    """Multi-host init: connect to the coordination service when the
+    launcher provided endpoints (PADDLE_TRAINER_* / PADDLE_TPU_* env)."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    coord = os.environ.get("PADDLE_MASTER",
+                           os.environ.get("MASTER_ADDR"))
+    nranks = _env_int("PADDLE_TRAINERS_NUM",
+                      _env_int("PADDLE_NNODES", 1))
+    rank = _env_int("PADDLE_TRAINER_ID", _env_int("PADDLE_RANK", 0))
+    if coord and nranks > 1 and jax.process_count() == 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        addr = coord if ":" in coord else f"{coord}:{port}"
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nranks,
+                                   process_id=rank)
+    _initialized = True
+    return ParallelEnv()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def get_rank(group=None) -> int:
+    if group is not None:
+        return group.rank
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None:
+        return group.nranks
+    return jax.process_count()
+
+
+def parallel_device_count() -> int:
+    return jax.device_count()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return jax.process_count()
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        return os.environ.get("PADDLE_CURRENT_ENDPOINT", "127.0.0.1:0")
+
+    @property
+    def trainer_endpoints(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        return eps.split(",") if eps else []
+
+    @property
+    def nranks(self):
+        return self.world_size
+
+    @property
+    def local_rank(self):
+        return self.rank

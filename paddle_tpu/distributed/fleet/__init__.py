@@ -1,0 +1,32 @@
+"""``paddle.distributed.fleet`` — the hybrid-parallel engine
+(python/paddle/distributed/fleet/ parity, UNVERIFIED).
+
+The reference builds a 4D/5D process topology (dp × sharding × pp × mp ×
+sep) and per-axis NCCL groups. TPU-native: ONE global
+``jax.sharding.Mesh`` with named axes ('dp','sharding','pp','mp','sep',
+'ep'); HybridCommunicateGroup reports the same coordinates/world-size API,
+but "groups" are mesh axis names consumed by GSPMD/shard_map instead of
+communicators (SURVEY.md §2.3 hybrid row)."""
+
+from .base import (fleet, init, DistributedStrategy, Fleet, worker_num,
+                   worker_index, is_first_worker, PaddleCloudRoleMaker,
+                   UserDefinedRoleMaker)
+from .topology import CommunicateTopology, HybridCommunicateGroup
+from . import meta_parallel
+from ..parallel_layers import (ColumnParallelLinear, RowParallelLinear,
+                               VocabParallelEmbedding, ParallelCrossEntropy)
+from ...framework.random import get_rng_state_tracker
+from .sharding import (DygraphShardingOptimizer, group_sharded_parallel,
+                       GroupShardedStage3)
+
+__all__ = ["fleet", "init", "DistributedStrategy", "Fleet",
+           "CommunicateTopology", "HybridCommunicateGroup", "meta_parallel",
+           "ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy",
+           "get_rng_state_tracker", "DygraphShardingOptimizer",
+           "group_sharded_parallel", "GroupShardedStage3", "worker_num",
+           "worker_index", "is_first_worker"]
+
+distributed_model = fleet.distributed_model
+distributed_optimizer = fleet.distributed_optimizer
+get_hybrid_communicate_group = fleet.get_hybrid_communicate_group

@@ -1,0 +1,47 @@
+"""HybridParallelOptimizer — fleet ``HybridParallelOptimizer`` parity
+(UNVERIFIED).
+
+Reference behavior (SURVEY.md §3.4 step 4): global-norm clip with norms
+allreduced across mp/pp/sharding groups, then apply. TPU-native: when the
+step runs compiled over the mesh, parameter shards are NamedSharding-ed and
+grad norms computed on sharded arrays are already global (GSPMD inserts the
+psum); eager single-process path is the plain clip."""
+
+from __future__ import annotations
+
+from ...optimizer.optimizer import Optimizer
+
+__all__ = ["HybridParallelOptimizer"]
+
+
+class HybridParallelOptimizer:
+    def __init__(self, optimizer: Optimizer, hcg, strategy=None):
+        self._inner = optimizer
+        self._hcg = hcg
+        self._strategy = strategy
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    @property
+    def _parameter_list(self):
+        return self._inner._parameter_list
+
+    def step(self):
+        self._inner.step()
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        return self._inner.minimize(loss, startup_program, parameters,
+                                    no_grad_set)
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, state):
+        self._inner.set_state_dict(state)

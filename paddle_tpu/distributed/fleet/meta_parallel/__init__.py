@@ -1,0 +1,18 @@
+"""fleet.meta_parallel — parallel layer wrappers + pipeline engine
+(fleet/meta_parallel/ parity, UNVERIFIED)."""
+
+from ...parallel_layers import (ColumnParallelLinear, RowParallelLinear,
+                                VocabParallelEmbedding, ParallelCrossEntropy)
+from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
+from .pipeline_parallel import PipelineParallel
+from ....framework.random import get_rng_state_tracker
+
+__all__ = ["ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy", "LayerDesc",
+           "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
+           "get_rng_state_tracker", "TensorParallel"]
+
+
+def TensorParallel(model, hcg=None, **kwargs):
+    """Wrapper parity: TP layers already carry shardings; returns model."""
+    return model

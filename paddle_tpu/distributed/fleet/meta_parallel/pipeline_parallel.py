@@ -1,0 +1,63 @@
+"""PipelineParallel runtime — fleet ``pipeline_parallel.py`` parity
+(UNVERIFIED).
+
+Reference: 1F1B/interleaved schedules over NCCL p2p between stage processes
+(SURVEY.md §3.4). TPU-native round-1 engine: microbatched GPipe-style
+schedule executed as python-driven microbatch loop with gradient
+accumulation. With pp_degree==1 (or single process) every stage runs
+locally — this is the loss-parity reference. The shard_map+ppermute
+multi-stage compiled schedule lands in the pipeline module
+(paddle_tpu/distributed/pipeline.py) and is used when a mesh 'pipe' axis
+has >1 devices."""
+
+from __future__ import annotations
+
+from ....framework.core import Tensor
+from ....ops.manipulation import split as split_op
+
+__all__ = ["PipelineParallel"]
+
+
+class PipelineParallel:
+    def __init__(self, layers, hcg, accumulate_steps=1, strategy=None):
+        self._layers = layers
+        self._hcg = hcg
+        self.accumulate_steps = max(int(accumulate_steps), 1)
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)
+
+    def __call__(self, *args, **kwargs):
+        return self._layers(*args, **kwargs)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """Split into microbatches, accumulate grads, one optimizer step.
+        Returns the mean loss (paddle semantics)."""
+        inputs, labels = data
+        n = self.accumulate_steps
+        if n > 1:
+            micro_x = split_op(inputs, n, axis=0)
+            micro_y = split_op(labels, n, axis=0)
+        else:
+            micro_x, micro_y = [inputs], [labels]
+        total = None
+        for mx, my in zip(micro_x, micro_y):
+            out = self._layers(mx)
+            loss = self._layers._loss_fn(out, my)
+            (loss / float(n)).backward()
+            total = loss if total is None else total + loss
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return total / float(n)
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        out = self._layers(inputs)
+        if compute_loss and self._layers._loss_fn is not None:
+            return self._layers._loss_fn(out, labels)
+        return out

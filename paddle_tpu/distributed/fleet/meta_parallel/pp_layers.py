@@ -1,0 +1,135 @@
+"""PipelineLayer — fleet ``parallel_layers/pp_layers.py`` parity
+(UNVERIFIED).
+
+Describes a model as an ordered list of layer descs, partitioned into
+pipeline stages. TPU-native execution: PipelineParallel runs the stages
+inside one compiled program (lax.scan over microbatches + ppermute between
+stage shards over the 'pipe' mesh axis) rather than NCCL p2p between
+processes; with pp_degree==1 it runs the layers sequentially."""
+
+from __future__ import annotations
+
+from ....nn.layer.layers import Layer
+from ....nn.layer.container import LayerList
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    def __init__(self, key, layer_cls, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages=None, topology=None,
+                 loss_fn=None, seg_method="uniform", recompute_interval=0,
+                 recompute_ctx=None, num_virtual_pipeline_stages=None):
+        super().__init__()
+        self._descs = list(layers)
+        self._loss_fn = loss_fn
+        self._topo = topology
+        self._seg_method = seg_method
+        self._recompute_interval = recompute_interval
+        self._num_virtual = num_virtual_pipeline_stages or 1
+        if num_stages is None:
+            from ..base import fleet
+            hcg = fleet._hcg
+            num_stages = hcg.get_pipe_parallel_world_size() if hcg else 1
+        self._num_stages = max(int(num_stages), 1)
+        # build ALL layers (SPMD: every process holds the full program;
+        # per-stage weights live on their pipe-mesh shard)
+        self._shared: dict[str, Layer] = {}
+        built = []
+        for d in self._descs:
+            if isinstance(d, SharedLayerDesc):
+                if d.layer_name in self._shared:
+                    built.append(_SharedLayerRef(
+                        self._shared[d.layer_name], d.forward_func))
+                    continue
+                layer = d.build_layer()
+                self._shared[d.layer_name] = layer
+                built.append(layer)
+            elif isinstance(d, LayerDesc):
+                built.append(d.build_layer())
+            elif isinstance(d, Layer):
+                built.append(d)
+            else:  # callable (e.g. lambda reshape)
+                built.append(_FnLayer(d))
+        self.run_function = LayerList(built)
+        self._segments = self._partition(len(built), self._num_stages)
+
+    def _partition(self, n, stages):
+        """Uniform / by-param segmentation → list of (start, end)."""
+        if self._seg_method.startswith("layer:"):
+            cls_name = self._seg_method.split(":", 1)[1]
+            marks = [i for i, l in enumerate(self.run_function)
+                     if type(l).__name__ == cls_name]
+            if len(marks) >= stages:
+                # distribute marked layers evenly
+                per = len(marks) // stages
+                bounds = [0]
+                for s in range(1, stages):
+                    bounds.append(marks[s * per])
+                bounds.append(n)
+                return [(bounds[i], bounds[i + 1]) for i in range(stages)]
+        base = n // stages
+        rem = n % stages
+        segs, start = [], 0
+        for s in range(stages):
+            size = base + (1 if s < rem else 0)
+            segs.append((start, start + size))
+            start += size
+        return segs
+
+    def get_stage_layers(self, stage_id):
+        s, e = self._segments[stage_id]
+        return list(self.run_function)[s:e]
+
+    @property
+    def parameters_by_stage(self):
+        return [[p for l in self.get_stage_layers(s)
+                 for p in l.parameters()] for s in range(self._num_stages)]
+
+    def forward(self, x):
+        for layer in self.run_function:
+            x = layer(x)
+        return x
+
+
+class _FnLayer(Layer):
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def forward(self, *args):
+        return self._fn(*args)
+
+
+class _SharedLayerRef(Layer):
+    """Second occurrence of a SharedLayerDesc: reuses the first layer's
+    weights (e.g. tied embedding/lm-head)."""
+
+    def __init__(self, target: Layer, forward_func=None):
+        super().__init__()
+        self._target = [target]  # list to avoid sublayer registration
+        self._forward_func = forward_func
+
+    def forward(self, x):
+        target = self._target[0]
+        if self._forward_func is not None:
+            return self._forward_func(target, x)
+        return target(x)

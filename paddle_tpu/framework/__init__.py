@@ -1,0 +1,10 @@
+from .core import (Tensor, Parameter, apply, backward, no_grad, enable_grad,
+                   is_grad_enabled, set_grad_enabled, to_jax_dtype,
+                   dtype_name)
+from . import device, flags, random
+from .io import save, load
+
+__all__ = ["Tensor", "Parameter", "apply", "backward", "no_grad",
+           "enable_grad", "is_grad_enabled", "set_grad_enabled",
+           "to_jax_dtype", "dtype_name", "device", "flags", "random",
+           "save", "load"]

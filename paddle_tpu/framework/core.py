@@ -1,0 +1,633 @@
+"""Core of the TPU-native framework: Tensor façade over ``jax.Array`` plus a
+tape-based eager autograd engine.
+
+Reference parity (see SURVEY.md §2.1/§3; reference mount was empty, paths
+unverified): plays the role of Paddle's PHI core (``DenseTensor``,
+``paddle/phi/core/``) + the eager autograd engine (``paddle/fluid/eager/``,
+``GradNodeBase``/``RunBackward``).  Design is TPU-first instead of a port:
+
+- A ``Tensor`` wraps an immutable ``jax.Array``; "in-place" ops rebind the
+  wrapped array, preserving Python identity (Paddle semantics) while staying
+  functional underneath (XLA semantics).
+- Autograd does not need per-op grad kernels: every differentiable op is a
+  pure jax function, and the tape records the ``jax.vjp`` residual closure.
+  ``backward()`` walks the tape.  Under ``paddle_tpu.jit.to_static`` the same
+  tape runs on tracers and lowers into one XLA program, so eager and compiled
+  mode share one autograd implementation (Paddle needs two: eager GradNodes
+  and static-graph grad ops).
+- State (parameters, buffers, optimizer accumulators, RNG key) is observable
+  via a read/write tracking hook so the trace-and-compile path can
+  functionalize user code that mutates state imperatively.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Tensor",
+    "Parameter",
+    "apply",
+    "backward",
+    "no_grad",
+    "enable_grad",
+    "is_grad_enabled",
+    "set_grad_enabled",
+    "to_jax_dtype",
+    "dtype_name",
+    "track_state",
+    "current_tracking",
+]
+
+# --------------------------------------------------------------------------
+# dtype handling
+# --------------------------------------------------------------------------
+
+_DTYPE_ALIASES = {
+    "float32": jnp.float32, "fp32": jnp.float32,
+    "float64": jnp.float64, "fp64": jnp.float64, "double": jnp.float64,
+    "float16": jnp.float16, "fp16": jnp.float16, "half": jnp.float16,
+    "bfloat16": jnp.bfloat16, "bf16": jnp.bfloat16,
+    "int8": jnp.int8, "uint8": jnp.uint8,
+    "int16": jnp.int16, "int32": jnp.int32, "int64": jnp.int64,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64, "complex128": jnp.complex128,
+    "float8_e4m3fn": jnp.float8_e4m3fn, "float8_e5m2": jnp.float8_e5m2,
+}
+
+
+def to_jax_dtype(dtype) -> jnp.dtype:
+    """Normalize a user-facing dtype (string / numpy / jax) to a jnp dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return jnp.dtype(_DTYPE_ALIASES[dtype])
+        except KeyError:
+            raise ValueError(f"Unknown dtype name: {dtype!r}")
+    if isinstance(dtype, Tensor):
+        return dtype.dtype
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return jnp.dtype(dtype).name
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def is_complex(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def _coerce_host_data(data, dtype):
+    """Paddle creation-dtype semantics for host data: python floats (and
+    lists of them) default to float32; python ints to int64; numpy arrays
+    keep their own dtype (so an explicit np.float64 array stays float64)."""
+    if dtype is not None or isinstance(data, np.ndarray):
+        return data
+    arr = np.asarray(data)
+    if arr.dtype == np.float64:
+        return arr.astype(np.float32)
+    return arr
+
+
+# --------------------------------------------------------------------------
+# grad mode
+# --------------------------------------------------------------------------
+
+class _GradState(threading.local):
+    def __init__(self):
+        self.enabled = True
+
+
+_grad_state = _GradState()
+
+
+def is_grad_enabled() -> bool:
+    return _grad_state.enabled
+
+
+def set_grad_enabled(mode: bool) -> None:
+    _grad_state.enabled = bool(mode)
+
+
+class _NoGrad(contextlib.ContextDecorator):
+    """``paddle.no_grad`` equivalent — usable as context manager or decorator."""
+
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+
+class _EnableGrad(contextlib.ContextDecorator):
+    def __enter__(self):
+        self._prev = _grad_state.enabled
+        _grad_state.enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _grad_state.enabled = self._prev
+        return False
+
+
+no_grad = _NoGrad
+enable_grad = _EnableGrad
+
+
+# --------------------------------------------------------------------------
+# state read/write tracking (used by jit.to_static functionalization)
+# --------------------------------------------------------------------------
+
+class StateTracking:
+    """Records which persistable tensors are read / written during a call."""
+
+    def __init__(self):
+        self.read: dict[int, "Tensor"] = {}
+        self.written: dict[int, "Tensor"] = {}
+
+    def record_read(self, t: "Tensor") -> None:
+        self.read.setdefault(id(t), t)
+
+    def record_write(self, t: "Tensor") -> None:
+        self.written.setdefault(id(t), t)
+
+
+class _TrackState(threading.local):
+    def __init__(self):
+        self.current: StateTracking | None = None
+
+
+_track_state = _TrackState()
+
+
+def current_tracking() -> StateTracking | None:
+    return _track_state.current
+
+
+@contextlib.contextmanager
+def track_state(tracking: StateTracking):
+    prev = _track_state.current
+    _track_state.current = tracking
+    try:
+        yield tracking
+    finally:
+        _track_state.current = prev
+
+
+# --------------------------------------------------------------------------
+# autograd tape
+# --------------------------------------------------------------------------
+
+class GradNode:
+    """One tape entry.  Mirrors the role of Paddle's ``GradNodeBase``
+    (paddle/fluid/eager/grad_node_info.h, UNVERIFIED) but holds a ``jax.vjp``
+    residual closure instead of pointing at a hand-written grad kernel."""
+
+    __slots__ = ("vjp_fn", "parents", "n_outputs", "out_grads", "name",
+                 "pending", "out_avals", "_hooks")
+
+    def __init__(self, vjp_fn, parents, n_outputs, name="", out_avals=None):
+        self.vjp_fn = vjp_fn
+        # parents: list of Tensors that required grad (inputs of the op)
+        self.parents: list[Tensor] = parents
+        self.n_outputs = n_outputs
+        self.out_grads: list[Any] = [None] * n_outputs
+        self.name = name
+        self.pending = 0
+        # (shape, dtype) per output so unseeded outputs can be zero-filled
+        self.out_avals = out_avals
+        self._hooks: list[Callable] | None = None
+
+    def add_out_grad(self, idx: int, g):
+        cur = self.out_grads[idx]
+        self.out_grads[idx] = g if cur is None else cur + g
+
+
+class Tensor:
+    """Paddle-shaped tensor.  Wraps a ``jax.Array`` (or jax tracer).
+
+    ``stop_gradient`` defaults to True, matching ``paddle.Tensor``; set to
+    False (or use ``Parameter``) to take part in autograd.
+    """
+
+    # let Tensor win in e.g. np_array * tensor
+    __array_priority__ = 100
+
+    __slots__ = ("_data", "_stop_gradient", "grad", "_node", "_out_idx",
+                 "name", "persistable", "_grad_hooks", "trainable",
+                 "__weakref__")
+
+    def __init__(self, data, dtype=None, stop_gradient: bool = True,
+                 name: str = ""):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, jax.Array) and not isinstance(data, jax.core.Tracer):
+            data = jnp.asarray(_coerce_host_data(data, dtype),
+                               dtype=to_jax_dtype(dtype))
+        elif dtype is not None and data.dtype != to_jax_dtype(dtype):
+            data = data.astype(to_jax_dtype(dtype))
+        self._data = data
+        self._stop_gradient = stop_gradient
+        self.grad: Tensor | None = None
+        self._node: GradNode | None = None
+        self._out_idx: int = 0
+        self.name = name
+        self.persistable = False
+        self.trainable = not stop_gradient
+        self._grad_hooks: list[Callable] | None = None
+
+    # -- data access -------------------------------------------------------
+
+    @property
+    def data(self) -> "Tensor":
+        return self
+
+    @data.setter
+    def data(self, value):
+        self.set_data(value._data if isinstance(value, Tensor) else jnp.asarray(value))
+
+    def jax(self):
+        """The underlying jax.Array (TPU-native escape hatch)."""
+        tr = _track_state.current
+        if tr is not None and self.persistable:
+            tr.record_read(self)
+        return self._data
+
+    def set_data(self, new_data, *, _clear_tape: bool = True) -> None:
+        """Rebind the wrapped array. This is the single mutation point, so the
+        to_static functionalizer can observe writes."""
+        tr = _track_state.current
+        if tr is not None and self.persistable:
+            tr.record_write(self)
+        self._data = new_data
+        if _clear_tape:
+            self._node = None
+            self._out_idx = 0
+
+    @property
+    def stop_gradient(self) -> bool:
+        return self._stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, value: bool) -> None:
+        self._stop_gradient = bool(value)
+
+    # -- metadata ----------------------------------------------------------
+
+    @property
+    def shape(self):
+        return list(self._data.shape)
+
+    @property
+    def dtype(self):
+        return self._data.dtype
+
+    @property
+    def ndim(self):
+        return self._data.ndim
+
+    @property
+    def size(self):
+        return int(np.prod(self._data.shape)) if self._data.ndim else 1
+
+    @property
+    def place(self):
+        from . import device
+        return device.place_of(self._data)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self._node is None
+
+    def numel(self):
+        from ..ops import creation
+        return creation.to_tensor(self.size, dtype="int64")
+
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+    def element_size(self) -> int:
+        return self._data.dtype.itemsize
+
+    # -- conversion --------------------------------------------------------
+
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        try:
+            body = repr(np.asarray(self._data))
+        except Exception:  # tracers
+            body = repr(self._data)
+        return (f"Tensor(shape={self.shape}, dtype={dtype_name(self.dtype)}, "
+                f"stop_gradient={self._stop_gradient},\n       {body})")
+
+    def __hash__(self):
+        return id(self)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    # -- autograd ----------------------------------------------------------
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True)
+        return t
+
+    def detach_(self) -> "Tensor":
+        self._node = None
+        self._stop_gradient = True
+        return self
+
+    def clone(self) -> "Tensor":
+        from ..ops import manipulation
+        return manipulation.clone(self)
+
+    def backward(self, grad_tensor=None, retain_graph: bool = False) -> None:
+        backward([self], [grad_tensor] if grad_tensor is not None else None,
+                 retain_graph=retain_graph)
+
+    def clear_grad(self) -> None:
+        self.grad = None
+
+    def clear_gradient(self, set_to_zero: bool = False) -> None:
+        if set_to_zero and self.grad is not None:
+            self.grad.set_data(jnp.zeros_like(self.grad._data))
+        else:
+            self.grad = None
+
+    def register_hook(self, hook: Callable) -> Callable:
+        """Register a grad hook fired when this tensor's grad is computed.
+        Returns a remover callable."""
+        if self._grad_hooks is None:
+            self._grad_hooks = []
+        self._grad_hooks.append(hook)
+
+        def remove():
+            try:
+                self._grad_hooks.remove(hook)
+            except ValueError:
+                pass
+        return remove
+
+    @property
+    def requires_grad(self) -> bool:  # torch-style alias used in tests
+        return not self._stop_gradient
+
+    # in-place helpers used by optimizers (no autograd)
+    def _inplace_update(self, new_data):
+        self.set_data(new_data)
+        return self
+
+
+class Parameter(Tensor):
+    """Trainable, persistable tensor — ``paddle.nn.Parameter`` equivalent."""
+
+    def __init__(self, data, dtype=None, name: str = "", trainable: bool = True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.persistable = True
+        self.trainable = trainable
+
+
+# --------------------------------------------------------------------------
+# op dispatch: eager execution + tape recording
+# --------------------------------------------------------------------------
+
+def _wrap_out(data, node=None, idx=0, stop_gradient=True):
+    t = Tensor(data, stop_gradient=stop_gradient)
+    if node is not None:
+        t._node = node
+        t._out_idx = idx
+    return t
+
+
+def apply(fn: Callable, *tensors, n_outputs: int = 1, name: str = "",
+          differentiable: bool = True, **static_kwargs):
+    """Execute op ``fn(*arrays, **static_kwargs)`` over Tensor inputs.
+
+    The single entry point every op goes through (the analogue of Paddle's
+    generated ``*_ad_func`` + PHI API dispatch, SURVEY.md §3.1). Handles:
+      - unwrapping Tensors (and passing through python scalars),
+      - state-read tracking for the to_static functionalizer,
+      - recording a GradNode via ``jax.vjp`` when grad is required.
+
+    ``fn`` must be a pure jax function. Tensor-valued kwargs are not allowed;
+    pass tensors positionally.
+    """
+    tr = _track_state.current
+    datas = []
+    for t in tensors:
+        if isinstance(t, Tensor):
+            if tr is not None and t.persistable:
+                tr.record_read(t)
+            datas.append(t._data)
+        else:
+            datas.append(t)
+
+    needs_grad = (
+        differentiable
+        and _grad_state.enabled
+        and any(isinstance(t, Tensor) and not t._stop_gradient for t in tensors)
+    )
+
+    if not needs_grad:
+        out = fn(*datas, **static_kwargs)
+        if n_outputs == 1:
+            return _wrap_out(out)
+        return tuple(_wrap_out(o) for o in out)
+
+    # Differentiate only w.r.t. inputs that require grad; close over the rest.
+    diff_idx = [i for i, t in enumerate(tensors)
+                if isinstance(t, Tensor) and not t._stop_gradient]
+    diff_parents = [tensors[i] for i in diff_idx]
+
+    def pure(*diff_args):
+        full = list(datas)
+        for i, a in zip(diff_idx, diff_args):
+            full[i] = a
+        return fn(*full, **static_kwargs)
+
+    out, vjp_fn = jax.vjp(pure, *(datas[i] for i in diff_idx))
+    if n_outputs == 1:
+        node = GradNode(vjp_fn, diff_parents, 1, name=name or fn.__name__,
+                        out_avals=[(out.shape, out.dtype)])
+        return _wrap_out(out, node, 0, stop_gradient=False)
+    node = GradNode(vjp_fn, diff_parents, n_outputs, name=name or fn.__name__,
+                    out_avals=[(o.shape, o.dtype) for o in out])
+    outs = tuple(
+        _wrap_out(o, node, i, stop_gradient=False) for i, o in enumerate(out)
+    )
+    return outs
+
+
+# --------------------------------------------------------------------------
+# backward engine
+# --------------------------------------------------------------------------
+
+def _ones_like(data):
+    return jnp.ones_like(data)
+
+
+def backward(tensors: Sequence[Tensor], grad_tensors=None,
+             retain_graph: bool = False, accumulate_ids=None) -> None:
+    """Run reverse-mode over the recorded tape — the analogue of
+    ``egr::Backward`` (paddle/fluid/eager/backward.cc, UNVERIFIED).
+
+    Topologically orders reachable GradNodes by dependency counting, then
+    pulls vjp closures in reverse order, accumulating into ``.grad`` of leaf
+    tensors with ``stop_gradient=False``. ``accumulate_ids`` (used by
+    ``paddle.grad``) additionally accumulates into the named *non-leaf*
+    tensors as their cotangents stream past."""
+    roots = [t for t in tensors if isinstance(t, Tensor)]
+    accumulate_ids = accumulate_ids or frozenset()
+    if grad_tensors is None:
+        grad_tensors = [None] * len(roots)
+    # 1) seed grads
+    for t, g in zip(roots, grad_tensors):
+        if t._stop_gradient:
+            continue
+        seed = g._data if isinstance(g, Tensor) else (
+            jnp.asarray(g, dtype=t.dtype) if g is not None else _ones_like(t._data))
+        if id(t) in accumulate_ids:
+            _accumulate_leaf(t, seed)
+        if t._node is None:
+            if id(t) not in accumulate_ids:
+                _accumulate_leaf(t, seed)
+        else:
+            t._node.add_out_grad(t._out_idx, seed)
+
+    # 2) collect reachable node graph & in-degrees (number of child nodes
+    #    that will feed grads into each node)
+    nodes: dict[int, GradNode] = {}
+    indeg: dict[int, int] = {}
+    stack = [t._node for t in roots if t._node is not None and not t._stop_gradient]
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        nodes[id(node)] = node
+        for p in node.parents:
+            pn = p._node
+            if pn is not None:
+                indeg[id(pn)] = indeg.get(id(pn), 0) + 1
+                if id(pn) not in seen:
+                    stack.append(pn)
+
+    # 3) ready queue: nodes all of whose consumers have fired
+    ready = [n for nid, n in nodes.items() if indeg.get(nid, 0) == 0]
+    fired = set()
+    while ready:
+        node = ready.pop()
+        fired.add(id(node))
+        grads_out = tuple(
+            g if g is not None else jnp.zeros(av[0], av[1])
+            for g, av in zip(node.out_grads, node.out_avals)
+        )
+        in_grads = node.vjp_fn(grads_out[0] if node.n_outputs == 1 else grads_out)
+        if not retain_graph:
+            node.vjp_fn = None
+        for parent, g in zip(node.parents, in_grads):
+            pn = parent._node
+            if g is None:
+                # still release the dependency edge so upstream nodes fire
+                if pn is not None:
+                    indeg[id(pn)] -= 1
+                    if indeg[id(pn)] == 0:
+                        ready.append(pn)
+                continue
+            if parent._grad_hooks:
+                gt = Tensor(g, stop_gradient=True)
+                for hook in parent._grad_hooks:
+                    res = hook(gt)
+                    if res is not None:
+                        gt = res if isinstance(res, Tensor) else Tensor(res)
+                g = gt._data
+            if id(parent) in accumulate_ids:
+                _accumulate_leaf(parent, g)
+            if pn is None:
+                if not parent._stop_gradient and \
+                        id(parent) not in accumulate_ids:
+                    _accumulate_leaf(parent, g)
+            else:
+                pn.add_out_grad(parent._out_idx, g)
+                indeg[id(pn)] -= 1
+                if indeg[id(pn)] == 0:
+                    ready.append(pn)
+        node.out_grads = [None] * node.n_outputs
+    # Nodes never fired (unreached due to missing seeds) are fine — their
+    # vjp closures get collected with the tape.
+
+
+def tape_alias(t: Tensor) -> Tensor:
+    """A fresh Tensor sharing t's data AND tape position. In-place ops must
+    run the functional op on an alias — recording the op with the mutated
+    tensor itself as parent would create a self-referential node."""
+    a = Tensor(t._data, stop_gradient=t._stop_gradient)
+    a._node, a._out_idx = t._node, t._out_idx
+    return a
+
+
+def tape_rebind(t: Tensor, out: Tensor) -> Tensor:
+    """Point t at out's data and tape node (the in-place op epilogue)."""
+    t.set_data(out._data, _clear_tape=False)
+    t._node, t._out_idx = out._node, out._out_idx
+    t._stop_gradient = out._stop_gradient
+    return t
+
+
+def _accumulate_leaf(t: Tensor, g) -> None:
+    if g.dtype != t.dtype and is_floating(t.dtype):
+        g = g.astype(t.dtype)
+    if t.grad is None:
+        t.grad = Tensor(g, stop_gradient=True)
+    else:
+        t.grad.set_data(t.grad._data + g)

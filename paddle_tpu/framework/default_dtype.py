@@ -1,0 +1,22 @@
+"""Global default dtype (``paddle.get/set_default_dtype``)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .core import to_jax_dtype
+
+_default_dtype = jnp.float32
+
+
+def set_default_dtype(d) -> None:
+    global _default_dtype
+    _default_dtype = to_jax_dtype(d)
+
+
+def get_default_dtype():
+    return _default_dtype
+
+
+def get_default_dtype_name() -> str:
+    return jnp.dtype(_default_dtype).name

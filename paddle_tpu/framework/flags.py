@@ -1,0 +1,98 @@
+"""Runtime flag registry — the role of Paddle's gflags-workalike
+(``paddle/phi/core/flags.h`` / ``PHI_DEFINE_EXPORTED_*``, UNVERIFIED).
+
+Flags are defined in Python, ingested from ``FLAGS_*`` environment variables
+at import, readable/mutable at runtime via ``get_flags``/``set_flags``
+(mirroring ``paddle.get_flags``/``paddle.set_flags``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable
+
+__all__ = ["define_flag", "get_flags", "set_flags", "flag"]
+
+_lock = threading.Lock()
+_registry: dict[str, dict] = {}
+
+
+def _parse_env(value: str, typ):
+    if typ is bool:
+        return value.lower() in ("1", "true", "yes", "on")
+    return typ(value)
+
+
+def define_flag(name: str, default: Any, help: str = "",
+                typ: type | None = None,
+                on_change: Callable[[Any], None] | None = None) -> None:
+    """Define ``FLAGS_<name>``. Reads initial value from env if present."""
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    typ = typ if typ is not None else type(default)
+    value = default
+    env = os.environ.get(name)
+    if env is not None:
+        try:
+            value = _parse_env(env, typ)
+        except (TypeError, ValueError):
+            pass
+    with _lock:
+        _registry[name] = {"value": value, "default": default, "help": help,
+                           "type": typ, "on_change": on_change}
+
+
+def flag(name: str) -> Any:
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    return _registry[name]["value"]
+
+
+def get_flags(flags: str | list[str] | None = None) -> dict[str, Any]:
+    if flags is None:
+        return {k: v["value"] for k, v in _registry.items()}
+    if isinstance(flags, str):
+        flags = [flags]
+    out = {}
+    for f in flags:
+        key = f if f.startswith("FLAGS_") else "FLAGS_" + f
+        out[key] = _registry[key]["value"]
+    return out
+
+
+def set_flags(flags: dict[str, Any]) -> None:
+    for k, v in flags.items():
+        key = k if k.startswith("FLAGS_") else "FLAGS_" + k
+        with _lock:
+            if key not in _registry:
+                # Paddle tolerates unknown flags with a warning; we register.
+                _registry[key] = {"value": v, "default": v, "help": "",
+                                  "type": type(v), "on_change": None}
+                continue
+            ent = _registry[key]
+            ent["value"] = ent["type"](v) if not isinstance(v, ent["type"]) else v
+            cb = ent["on_change"]
+        if cb is not None:
+            cb(v)
+
+
+# -- core flags (mirroring commonly-used FLAGS_* names where sensible) ------
+define_flag("FLAGS_check_nan_inf", False,
+            "Check outputs for NaN/Inf after each op (debug).")
+define_flag("FLAGS_cudnn_deterministic", False,
+            "Determinism knob (XLA is deterministic by default; accepted for "
+            "compatibility).")
+define_flag("FLAGS_use_stride_kernel", False, "Accepted for compatibility.")
+define_flag("FLAGS_embedding_deterministic", 0, "Accepted for compatibility.")
+define_flag("FLAGS_allocator_strategy", "auto_growth",
+            "Allocator strategy (PJRT owns allocation on TPU).")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92,
+            "Accepted for compatibility; PJRT flag controls TPU memory.")
+define_flag("FLAGS_log_level", 1, "Framework log verbosity.")
+define_flag("FLAGS_tpu_matmul_precision", "default",
+            "Matmul precision: default|high|highest (maps to jax precision).")
+define_flag("FLAGS_enable_pallas_kernels", True,
+            "Use Pallas kernels (flash-attn, rms_norm, rope) when on TPU.")
+define_flag("FLAGS_flash_attn_block_q", 128, "Pallas flash-attn q block.")
+define_flag("FLAGS_flash_attn_block_kv", 128, "Pallas flash-attn kv block.")

@@ -1,0 +1,65 @@
+"""``paddle.save`` / ``paddle.load`` — single-process checkpoint tier
+(python/paddle/framework/io.py parity, UNVERIFIED; pickle ``.pdparams`` /
+``.pdopt`` format in spirit). Tensors serialize as numpy arrays."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+
+from .core import Tensor
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+class _TensorPlaceholder:
+    def __init__(self, array: np.ndarray, stop_gradient: bool, name: str):
+        self.array = array
+        self.stop_gradient = stop_gradient
+        self.name = name
+
+
+def _pack(obj):
+    if isinstance(obj, Tensor):
+        return _TensorPlaceholder(np.asarray(obj._data), obj.stop_gradient,
+                                  obj.name)
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj, return_numpy=False):
+    if isinstance(obj, _TensorPlaceholder):
+        if return_numpy:
+            return obj.array
+        t = Tensor(jnp.asarray(obj.array), stop_gradient=obj.stop_gradient,
+                   name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=_PROTOCOL, **configs) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path, return_numpy=False, **configs):
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,119 @@
+"""Global RNG state.
+
+Paddle exposes a global seeded generator (``paddle.seed``) plus per-parallel-
+axis generators (``get_rng_state_tracker`` in fleet, for TP-correct dropout).
+jax wants explicit keys. Resolution: a named registry of ``Generator`` objects
+each holding a persistable key tensor; every draw splits the key and writes
+back, so the to_static functionalizer captures RNG state like any other state
+(SURVEY.md §7 "hard parts": RNG under trace).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .core import Tensor
+
+__all__ = ["seed", "Generator", "default_generator", "get_rng_state",
+           "set_rng_state", "next_key", "RNGStatesTracker",
+           "get_rng_state_tracker"]
+
+
+class Generator:
+    def __init__(self, seed_: int = 0, name: str = "default"):
+        self.name = name
+        self._state = Tensor(jax.random.PRNGKey(seed_), stop_gradient=True)
+        self._state.persistable = True
+        self._state.name = f"rng_{name}"
+
+    def manual_seed(self, seed_: int) -> "Generator":
+        self._state.set_data(jax.random.PRNGKey(seed_))
+        return self
+
+    def next_key(self):
+        """Split: return a fresh subkey, store the new state."""
+        key = self._state.jax()  # records a state read under tracking
+        new_state, sub = jax.random.split(key)
+        self._state.set_data(new_state)
+        return sub
+
+    def get_state(self) -> Tensor:
+        return Tensor(self._state.jax())
+
+    def set_state(self, state) -> None:
+        data = state.jax() if isinstance(state, Tensor) else jnp.asarray(state)
+        self._state.set_data(data)
+
+
+default_generator = Generator(0, "default")
+
+
+def seed(value: int) -> Generator:
+    """``paddle.seed`` — reseed the default generator (and axis trackers)."""
+    default_generator.manual_seed(value)
+    _tracker.reseed_all(value)
+    return default_generator
+
+
+def next_key():
+    return default_generator.next_key()
+
+
+def get_rng_state():
+    return [default_generator.get_state()]
+
+
+def set_rng_state(states) -> None:
+    if isinstance(states, (list, tuple)):
+        states = states[0]
+    default_generator.set_state(states)
+
+
+class RNGStatesTracker:
+    """Named RNG states for parallelism — mirrors fleet's
+    ``get_rng_state_tracker`` (meta_parallel/parallel_layers/random.py,
+    UNVERIFIED): e.g. dropout inside a TP region must differ per model-rank
+    ('local_seed') but match across ('global_seed')."""
+
+    def __init__(self):
+        self.states: dict[str, Generator] = {}
+
+    def add(self, name: str, seed_: int) -> None:
+        if name in self.states:
+            raise ValueError(f"RNG state {name!r} already exists")
+        self.states[name] = Generator(seed_, name)
+
+    def reseed_all(self, base_seed: int) -> None:
+        for i, (name, gen) in enumerate(sorted(self.states.items())):
+            gen.manual_seed(base_seed + 1000 + i)
+
+    def rng_state(self, name: str = "global_seed"):
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            gen = self.states.get(name)
+            if gen is None:
+                # lazily create deterministically from the name; crc32 is
+                # stable across processes (str hash is salted per process,
+                # which would desync TP ranks)
+                import zlib
+                gen = Generator(zlib.crc32(name.encode()) % (2**31), name)
+                self.states[name] = gen
+            global default_generator
+            from . import random as _self
+            prev = _self.default_generator
+            _self.default_generator = gen
+            try:
+                yield
+            finally:
+                _self.default_generator = prev
+        return ctx()
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker

@@ -1,0 +1,4 @@
+from .model import Model
+from . import callbacks
+
+__all__ = ["Model", "callbacks"]

@@ -1,0 +1,125 @@
+"""High-level ``paddle.Model`` API (python/paddle/hapi/model.py parity,
+UNVERIFIED): prepare/fit/evaluate/predict/save/load."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor, no_grad
+from ..framework.io import save as save_obj, load as load_obj
+from ..io import DataLoader
+
+__all__ = ["Model"]
+
+
+class Model:
+    def __init__(self, network, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is not None:
+            self._metrics = metrics if isinstance(metrics, (list, tuple)) \
+                else [metrics]
+
+    def _compute_loss(self, outputs, labels):
+        if callable(self._loss):
+            return self._loss(outputs, labels)
+        raise RuntimeError("prepare(loss=...) first")
+
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        outputs = self.network(*inputs)
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        return [float(loss.item())]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
+        return [float(loss.item())]
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        with no_grad():
+            out = self.network(*inputs)
+        return out
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None):
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        for epoch in range(epochs):
+            losses = []
+            for step, batch in enumerate(loader):
+                *xs, y = batch if isinstance(batch, (list, tuple)) \
+                    else (batch,)
+                loss = self.train_batch(xs, y)
+                losses.append(loss[0])
+                if verbose and step % log_freq == 0:
+                    print(f"epoch {epoch} step {step}: "
+                          f"loss {loss[0]:.5f}")
+            if save_dir is not None and epoch % save_freq == 0:
+                self.save(f"{save_dir}/epoch_{epoch}")
+            if eval_data is not None and epoch % eval_freq == 0:
+                self.evaluate(eval_data, batch_size=batch_size,
+                              verbose=verbose)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size)
+        losses = []
+        for batch in loader:
+            *xs, y = batch if isinstance(batch, (list, tuple)) else (batch,)
+            losses.append(self.eval_batch(xs, y)[0])
+        result = {"loss": [float(np.mean(losses))]}
+        if verbose:
+            print(f"Eval loss: {result['loss'][0]:.5f}")
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size)
+        outs = []
+        for batch in loader:
+            xs = batch if isinstance(batch, (list, tuple)) else (batch,)
+            outs.append(self.predict_batch(list(xs)))
+        return outs
+
+    def save(self, path, training=True):
+        save_obj(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            save_obj(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        self.network.set_state_dict(load_obj(path + ".pdparams"))
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(load_obj(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(p.size for p in self.network.parameters())
+        print(f"Total params: {n_params}")
+        return {"total_params": n_params}

@@ -1,0 +1,28 @@
+"""``paddle.summary`` (hapi summary parity, UNVERIFIED)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["summary"]
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    rows = []
+    total = 0
+    trainable = 0
+    for name, p in net.named_parameters():
+        n = p.size
+        total += n
+        if p.trainable:
+            trainable += n
+        rows.append((name, tuple(p.shape), n))
+    width = max((len(r[0]) for r in rows), default=20) + 2
+    print(f"{'Layer (param)':<{width}}{'Shape':<24}{'Param #':>12}")
+    print("-" * (width + 36))
+    for name, shape, n in rows:
+        print(f"{name:<{width}}{str(shape):<24}{n:>12,}")
+    print("-" * (width + 36))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    return {"total_params": total, "trainable_params": trainable}

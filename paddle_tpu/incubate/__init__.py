@@ -1,0 +1,79 @@
+"""``paddle.incubate`` — experimental APIs (python/paddle/incubate/ parity,
+UNVERIFIED): fused-op functional APIs, jax-native higher-order autograd,
+MoE layers."""
+
+from . import nn
+from . import autograd
+from .nn import functional
+
+__all__ = ["nn", "autograd", "functional", "softmax_mask_fuse",
+           "graph_send_recv", "segment_sum", "segment_mean", "segment_max",
+           "segment_min"]
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    from .nn.functional import fused_softmax_mask
+    return fused_softmax_mask(x, mask)
+
+
+def _segment(op):
+    import jax
+    import jax.numpy as jnp
+    from ..framework.core import Tensor, apply
+    from ..ops.common import as_tensor
+
+    def seg(data, segment_ids, name=None):
+        data, segment_ids = as_tensor(data), as_tensor(segment_ids)
+        num = int(jnp.max(segment_ids._data)) + 1 if \
+            segment_ids._data.size else 0
+
+        def fn(d, ids):
+            if op == "sum":
+                return jax.ops.segment_sum(d, ids, num) if hasattr(
+                    jax.ops, "segment_sum") else \
+                    jax.ops.segment_sum(d, ids, num)
+            if op == "mean":
+                s = jax.ops.segment_sum(d, ids, num)
+                c = jax.ops.segment_sum(jnp.ones_like(ids,
+                                                      dtype=d.dtype),
+                                        ids, num)
+                shape = (num,) + (1,) * (d.ndim - 1)
+                return s / jnp.maximum(c.reshape(shape), 1)
+            if op == "max":
+                return jax.ops.segment_max(d, ids, num)
+            return jax.ops.segment_min(d, ids, num)
+        return apply(fn, data, segment_ids, name=f"segment_{op}")
+    return seg
+
+
+segment_sum = _segment("sum")
+segment_mean = _segment("mean")
+segment_max = _segment("max")
+segment_min = _segment("min")
+
+
+def graph_send_recv(x, src_index, dst_index, reduce_op="sum",
+                    out_size=None, name=None):
+    import jax
+    import jax.numpy as jnp
+    from ..framework.core import apply
+    from ..ops.common import as_tensor
+    x = as_tensor(x)
+    src = as_tensor(src_index)
+    dst = as_tensor(dst_index)
+    n = out_size or x.shape[0]
+
+    def fn(xx, s, d):
+        gathered = jnp.take(xx, s, axis=0)
+        if reduce_op in ("sum", "mean"):
+            out = jax.ops.segment_sum(gathered, d, n)
+            if reduce_op == "mean":
+                cnt = jax.ops.segment_sum(
+                    jnp.ones_like(d, dtype=xx.dtype), d, n)
+                shape = (n,) + (1,) * (xx.ndim - 1)
+                out = out / jnp.maximum(cnt.reshape(shape), 1)
+            return out
+        if reduce_op == "max":
+            return jax.ops.segment_max(gathered, d, n)
+        return jax.ops.segment_min(gathered, d, n)
+    return apply(fn, x, src, dst, name="graph_send_recv")

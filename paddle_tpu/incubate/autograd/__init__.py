@@ -1,0 +1,88 @@
+"""jax-native higher-order autograd (the role of paddle.incubate.autograd's
+prim mechanism, UNVERIFIED): jacobian/hessian/vjp/jvp over functions of
+Tensors, computed with jax transforms (exact, any order)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+
+__all__ = ["jacobian", "hessian", "vjp", "jvp", "forward_grad", "grad"]
+
+
+def _wrap_fn(func):
+    """Lift a Tensor->Tensor function to arrays->arrays."""
+    def fn(*arrays):
+        ins = [Tensor(a, stop_gradient=False) for a in arrays]
+        out = func(*ins)
+        if isinstance(out, (list, tuple)):
+            return tuple(o._data for o in out)
+        return out._data
+    return fn
+
+
+def _datas(xs):
+    if isinstance(xs, Tensor):
+        return (xs._data,), True
+    return tuple(x._data for x in xs), False
+
+
+def jacobian(func, xs, is_batched=False):
+    arrays, single = _datas(xs)
+    jac = jax.jacrev(_wrap_fn(func), argnums=tuple(range(len(arrays))))(
+        *arrays)
+    if single:
+        return Tensor(jac[0])
+    return [Tensor(j) for j in jac]
+
+
+def hessian(func, xs, is_batched=False):
+    arrays, single = _datas(xs)
+    hes = jax.hessian(_wrap_fn(func), argnums=tuple(range(len(arrays))))(
+        *arrays)
+    if single:
+        return Tensor(hes[0][0])
+    return [[Tensor(h) for h in row] for row in hes]
+
+
+def vjp(func, xs, v=None):
+    arrays, single = _datas(xs)
+    out, vjp_fn = jax.vjp(_wrap_fn(func), *arrays)
+    if v is None:
+        cot = jnp.ones_like(out)
+    else:
+        cot = v._data if isinstance(v, Tensor) else v
+    grads = vjp_fn(cot)
+    gout = Tensor(grads[0]) if single else [Tensor(g) for g in grads]
+    return Tensor(out), gout
+
+
+def jvp(func, xs, v=None):
+    arrays, single = _datas(xs)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    elif isinstance(v, Tensor):
+        tangents = (v._data,)
+    else:
+        tangents = tuple(t._data for t in v)
+    out, tang = jax.jvp(_wrap_fn(func), arrays, tangents)
+    return Tensor(out), Tensor(tang)
+
+
+def forward_grad(func, xs, v=None):
+    return jvp(func, xs, v)[1]
+
+
+def grad(func, xs, v=None):
+    """Differentiable grad (create_graph=True semantics via jax.grad)."""
+    arrays, single = _datas(xs)
+
+    def scalar_fn(*ars):
+        out = _wrap_fn(func)(*ars)
+        return jnp.sum(out)
+    g = jax.grad(scalar_fn, argnums=tuple(range(len(arrays))))(*arrays)
+    if single:
+        return Tensor(g[0])
+    return [Tensor(x) for x in g]

@@ -1,0 +1,6 @@
+from . import functional
+from .layers import (FusedMultiHeadAttention, FusedFeedForward,
+                     FusedTransformerEncoderLayer)
+
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]

@@ -1,0 +1,173 @@
+"""Fused-op functional APIs (paddle.incubate.nn.functional parity,
+UNVERIFIED: fused_multi_head_attention etc.).
+
+On TPU "fused" means: written so XLA/Pallas emit one kernel. These
+compositions hit the Pallas flash-attention / rms_norm kernels where
+available and otherwise rely on XLA fusion — same API, TPU-native fusion
+story (SURVEY.md §2.1 PHI fusion kernels row)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply
+from ...ops.common import as_tensor
+from ...nn import functional as F
+
+__all__ = ["fused_multi_head_attention", "fused_feedforward",
+           "fused_linear", "fused_linear_activation", "fused_rms_norm",
+           "fused_layer_norm", "fused_dropout_add", "fused_rotary_position_embedding",
+           "fused_softmax_mask", "fused_softmax_mask_upper_triangle",
+           "swiglu"]
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    if transpose_weight:
+        from ...ops.linalg import matmul
+        out = matmul(x, weight, transpose_y=True)
+        return out + bias if bias is not None else out
+    return F.linear(x, weight, bias)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    from ...ops.linalg import matmul
+    out = matmul(x, y, transpose_x=trans_x, transpose_y=trans_y)
+    if bias is not None:
+        out = out + bias
+    return getattr(F, activation)(out)
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    out = F.rms_norm(x, norm_weight, epsilon)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=1, **kw):
+    x = as_tensor(x)
+    shape = x.shape[begin_norm_axis:] if begin_norm_axis >= 0 else \
+        x.shape[begin_norm_axis:]
+    return F.layer_norm(x, shape, norm_weight, norm_bias, epsilon)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      name=None):
+    return F.dropout(x, p, training=training, mode=mode) + y
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    time_major=False, rotary_emb_base=10000.0):
+    """RoPE applied to q/k (Pallas kernel on TPU when enabled)."""
+    from ...ops.pallas import rope as rope_mod
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        t = as_tensor(t)
+        if sin is None or cos is None:
+            s, c = rope_mod.build_sin_cos(t.shape[1], t.shape[-1],
+                                          rotary_emb_base, t.dtype)
+        else:
+            s = sin._data if isinstance(sin, Tensor) else jnp.asarray(sin)
+            c = cos._data if isinstance(cos, Tensor) else jnp.asarray(cos)
+        pid = position_ids._data if isinstance(position_ids, Tensor) \
+            else position_ids
+        outs.append(apply(
+            lambda a: rope_mod.apply_rope(a, s, c, pid,
+                                          neox=use_neox_rotary_style),
+            t, name="fused_rope"))
+    return tuple(outs)
+
+
+def fused_softmax_mask(x, mask, name=None):
+    def fn(a, m):
+        return jax.nn.softmax(a + m.astype(a.dtype), -1)
+    return apply(fn, as_tensor(x), as_tensor(mask),
+                 name="fused_softmax_mask")
+
+
+def fused_softmax_mask_upper_triangle(x, name=None):
+    def fn(a):
+        s = a.shape[-1]
+        mask = jnp.tril(jnp.ones((a.shape[-2], s), bool))
+        return jax.nn.softmax(jnp.where(mask, a, -1e30), -1)
+    return apply(fn, as_tensor(x), name="fused_softmax_mask_upper_triangle")
+
+
+def swiglu(x, y=None, name=None):
+    return F.swiglu(x, y)
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, mode="upscale_in_train",
+                               ring_id=-1, add_residual=True, name=None):
+    """Fused MHA epilogue/prologue around the flash-attention core."""
+    x = as_tensor(x)
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [x.shape[-1]], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    qkvw = as_tensor(qkv_weight)  # [3, H, D, E] paddle layout
+    nh, hd = qkvw.shape[1], qkvw.shape[2]
+
+    def qkv_fn(a, w, *b):
+        out = jnp.einsum("bse,thde->bsthd", a, w)
+        if b:
+            out = out + b[0][None, None]
+        return out
+    if qkv_bias is not None:
+        qkv = apply(qkv_fn, x, qkvw, as_tensor(qkv_bias), name="fused_qkv")
+    else:
+        qkv = apply(qkv_fn, x, qkvw, name="fused_qkv")
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    ctx = F.scaled_dot_product_attention(q, k, v, attn_mask,
+                                         attn_dropout_rate, False, training)
+    b, s = ctx.shape[0], ctx.shape[1]
+    from ...ops.manipulation import reshape
+    ctx = reshape(ctx, [b, s, nh * hd])
+    out = F.linear(ctx, linear_weight, linear_bias)
+    out = F.dropout(out, dropout_rate, training=training, mode=mode)
+    if add_residual:
+        out = out + residual
+    if not pre_layer_norm:
+        out = F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias,
+                           ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, mode="upscale_in_train",
+                      ring_id=-1, add_residual=True, name=None):
+    x = as_tensor(x)
+    residual = x
+    if pre_layer_norm:
+        x = F.layer_norm(x, [x.shape[-1]], ln1_scale, ln1_bias, ln1_epsilon)
+    h = F.linear(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, dropout1_rate, training=training, mode=mode)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, dropout2_rate, training=training, mode=mode)
+    if add_residual:
+        h = h + residual
+    if not pre_layer_norm:
+        h = F.layer_norm(h, [h.shape[-1]], ln2_scale, ln2_bias, ln2_epsilon)
+    return h

@@ -1,0 +1,94 @@
+"""Fused transformer layers (paddle.incubate.nn parity, UNVERIFIED)."""
+
+from __future__ import annotations
+
+from ...nn.layer.layers import Layer
+from ...nn.layer.common import Linear, Dropout
+from ...nn.layer.norm import LayerNorm
+from ...nn.layer.transformer import MultiHeadAttention
+from ...nn import functional as F
+
+__all__ = ["FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]
+
+
+class FusedMultiHeadAttention(Layer):
+    """API-parity fused MHA; execution uses flash-attention + XLA fusion."""
+
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.attn = MultiHeadAttention(embed_dim, num_heads,
+                                       attn_dropout_rate)
+        self.dropout = Dropout(dropout_rate)
+        self.norm = LayerNorm(embed_dim, epsilon)
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        residual = query
+        x = self.norm(query) if self.normalize_before else query
+        out = self.attn(x, key, value, attn_mask, cache)
+        if isinstance(out, tuple):
+            out, cache = out
+        out = residual + self.dropout(out)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-05, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.linear1 = Linear(d_model, dim_feedforward,
+                              linear1_weight_attr, linear1_bias_attr)
+        self.linear2 = Linear(dim_feedforward, d_model,
+                              linear2_weight_attr, linear2_bias_attr)
+        self.dropout1 = Dropout(act_dropout_rate if act_dropout_rate
+                                is not None else dropout_rate)
+        self.dropout2 = Dropout(dropout_rate)
+        self.norm = LayerNorm(d_model, epsilon)
+        self.activation = activation
+
+    def forward(self, src, cache=None):
+        residual = src
+        x = self.norm(src) if self.normalize_before else src
+        h = getattr(F, self.activation)(self.linear1(x))
+        h = self.linear2(self.dropout1(h))
+        out = residual + self.dropout2(h)
+        if not self.normalize_before:
+            out = self.norm(out)
+        return out
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        self.fused_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate,
+            attn_dropout_rate if attn_dropout_rate is not None
+            else dropout_rate, normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
+        return self.ffn(out)

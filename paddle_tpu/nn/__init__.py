@@ -1,0 +1,46 @@
+"""``paddle.nn`` namespace (SURVEY.md §2.2: Layer system + ~150 layers)."""
+
+from .layer.layers import Layer
+from .layer.common import *  # noqa: F401,F403
+from .layer.container import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .param_attr import ParamAttr  # noqa: F401
+from ..framework.core import Parameter  # noqa: F401
+
+from ..framework.core import Tensor as _Tensor
+
+
+class ClipGradByGlobalNorm:
+    """Re-exported from optimizer (paddle exposes paddle.nn.ClipGradBy*)."""
+    def __new__(cls, clip_norm=1.0, group_name="default_group",
+                auto_skip_clip=False):
+        from ..optimizer.clip import ClipGradByGlobalNorm as C
+        return C(clip_norm, group_name, auto_skip_clip)
+
+
+class ClipGradByNorm:
+    def __new__(cls, clip_norm=1.0):
+        from ..optimizer.clip import ClipGradByNorm as C
+        return C(clip_norm)
+
+
+class ClipGradByValue:
+    def __new__(cls, max=1.0, min=None):
+        from ..optimizer.clip import ClipGradByValue as C
+        return C(max, min)
+
+
+def utils_clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                          error_if_nonfinite=False):
+    from ..optimizer.clip import clip_grad_norm_
+    return clip_grad_norm_(parameters, max_norm, norm_type,
+                           error_if_nonfinite)

@@ -1,0 +1,12 @@
+"""``paddle.nn.functional`` namespace."""
+
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+
+from ...ops.creation import one_hot  # noqa: F401
+from ...ops.search import where  # noqa: F401

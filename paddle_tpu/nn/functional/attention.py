@@ -1,0 +1,99 @@
+"""Attention functionals.
+
+``scaled_dot_product_attention`` mirrors paddle's API
+(python/paddle/nn/functional/flash_attention.py, UNVERIFIED) and routes to
+the Pallas flash-attention kernel on TPU (SURVEY.md §2.1: fused_attention /
+flash-attn integration → Pallas), with a jnp reference path everywhere else.
+Layout convention is paddle's: [batch, seq, num_heads, head_dim]."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply
+from ...framework import flags
+from ...ops.common import as_tensor
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "sdpa_reference"]
+
+
+def _use_pallas() -> bool:
+    return (flags.flag("FLAGS_enable_pallas_kernels")
+            and jax.default_backend() == "tpu")
+
+
+def sdpa_reference(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
+                   scale=None, dropout_key=None):
+    """Pure-jnp reference attention on [B, S, H, D] arrays."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    # [B, H, S, D]
+    qh = jnp.swapaxes(q, 1, 2)
+    kh = jnp.swapaxes(k, 1, 2)
+    vh = jnp.swapaxes(v, 1, 2)
+    logits = jnp.einsum("bhqd,bhkd->bhqk", qh, kh) * s
+    if is_causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, jnp.asarray(-1e30, logits.dtype))
+    if attn_mask is not None:
+        if attn_mask.dtype == jnp.bool_:
+            logits = jnp.where(attn_mask, logits,
+                               jnp.asarray(-1e30, logits.dtype))
+        else:
+            logits = logits + attn_mask.astype(logits.dtype)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    probs = probs.astype(v.dtype)
+    if dropout_p > 0.0 and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1 - dropout_p, probs.shape)
+        probs = probs * keep / (1 - dropout_p)
+    out = jnp.einsum("bhqk,bhkd->bhqd", probs, vh)
+    return jnp.swapaxes(out, 1, 2)
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    """Inputs [batch, seq, num_heads, head_dim] (paddle convention)."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    from ...amp.auto_cast import maybe_cast_matmul
+    q, k = maybe_cast_matmul(q, k)
+    _, v = maybe_cast_matmul(q, v)
+    args = [q, k, v]
+    if attn_mask is not None:
+        args.append(as_tensor(attn_mask))
+
+    use_pallas = (_use_pallas() and attn_mask is None and dropout_p == 0.0
+                  and q.shape[1] == k.shape[1])
+    if use_pallas:
+        from ...ops.pallas import flash_attention as fa
+
+        def fn(qq, kk, vv):
+            return fa.flash_attention(qq, kk, vv, causal=is_causal)
+        return apply(fn, q, k, v, name="flash_attention")
+
+    key_rng = None
+    if dropout_p > 0.0 and training:
+        from ...framework import random as fr
+        key_rng = fr.default_generator.next_key()
+
+    def fn(qq, kk, vv, *m):
+        return sdpa_reference(qq, kk, vv, m[0] if m else None,
+                              dropout_p if key_rng is not None else 0.0,
+                              is_causal, dropout_key=key_rng)
+    return apply(fn, *args, name="sdpa")
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None,
+                    rng_name="", training=True, name=None):
+    """paddle.nn.functional.flash_attention.flash_attention parity."""
+    out = scaled_dot_product_attention(query, key, value, None, dropout,
+                                       causal, training)
+    if return_softmax:
+        return out, None
+    return out, None

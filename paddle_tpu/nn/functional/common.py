@@ -1,0 +1,317 @@
+"""Common functionals: linear, dropout, embedding, normalize, pad,
+interpolate, unfold … (python/paddle/nn/functional/common.py parity,
+UNVERIFIED)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply
+from ...framework import random as framework_random
+from ...ops.common import as_tensor
+
+__all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+           "embedding", "normalize", "cosine_similarity", "pad",
+           "interpolate", "upsample", "unfold", "fold", "pixel_shuffle",
+           "pixel_unshuffle", "channel_shuffle", "label_smooth",
+           "pairwise_distance", "bilinear", "pdist"]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Paddle stores Linear weight as [in, out]."""
+    from ...amp.auto_cast import maybe_cast_matmul
+    x, weight = maybe_cast_matmul(as_tensor(x), as_tensor(weight))
+    if bias is not None:
+        def fn(a, w, b):
+            y = a @ w
+            return y + b.astype(y.dtype)
+        return apply(fn, x, weight, as_tensor(bias), name="linear")
+    return apply(lambda a, w: a @ w, x, weight, name="linear")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply(lambda a: a * (1.0 - p), x, name="dropout")
+        return x
+    key = framework_random.default_generator.next_key()
+    shape = tuple(x.shape)
+    if axis is not None:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        shape = tuple(s if i in axes else 1 for i, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+
+    def fn(a):
+        m = keep.astype(a.dtype)
+        if mode == "upscale_in_train":
+            return a * m / (1.0 - p)
+        return a * m
+    return apply(fn, x, name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ch_axis = 1 if data_format == "NCHW" else 3
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ch_axis = 1 if data_format == "NCDHW" else 4
+    return dropout(x, p, axis=[0, ch_axis], training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = framework_random.default_generator.next_key()
+    keep = jax.random.bernoulli(key, 1.0 - p, tuple(x.shape))
+    a_coef = (1.0 - p + p * alpha_p ** 2 * (1.0 - p)) ** -0.5
+    b_coef = -a_coef * p * alpha_p
+
+    def fn(a):
+        m = keep.astype(a.dtype)
+        return a_coef * (a * m + alpha_p * (1 - m)) + b_coef
+    return apply(fn, x, name="alpha_dropout")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    x, weight = as_tensor(x), as_tensor(weight)
+
+    def fn(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids != padding_idx)[..., None].astype(out.dtype)
+            out = out * mask
+        return out
+    return apply(fn, x, weight, name="embedding")
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    x = as_tensor(x)
+
+    def fn(a):
+        if p == 2:
+            n = jnp.sqrt(jnp.sum(jnp.square(a), axis=axis, keepdims=True))
+        else:
+            n = jnp.sum(jnp.abs(a) ** p, axis=axis, keepdims=True) ** (1.0 / p)
+        return a / jnp.maximum(n, epsilon)
+    return apply(fn, x, name="normalize")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def fn(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply(fn, as_tensor(x1), as_tensor(x2), name="cosine_similarity")
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    def fn(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1, keepdims=keepdim) ** (1.0 / p)
+    return apply(fn, as_tensor(x), as_tensor(y), name="pairwise_distance")
+
+
+def pdist(x, p=2.0, name=None):
+    def fn(a):
+        d = a[:, None, :] - a[None, :, :]
+        dist = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+        iu = jnp.triu_indices(a.shape[0], k=1)
+        return dist[iu]
+    return apply(fn, as_tensor(x), name="pdist")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as _pad
+    return _pad(x, pad, mode, value, data_format)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    x1, x2, weight = as_tensor(x1), as_tensor(x2), as_tensor(weight)
+
+    def fn(a, b, w, *bb):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bb:
+            out = out + bb[0]
+        return out
+    if bias is not None:
+        return apply(fn, x1, x2, weight, as_tensor(bias), name="bilinear")
+    return apply(fn, x1, x2, weight, name="bilinear")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    x = as_tensor(x)
+    nd = x.ndim
+    spatial = nd - 2
+    channel_last = data_format.endswith("C") or data_format in ("NHWC", "NWC",
+                                                                "NDHWC")
+    if channel_last:
+        sp_shape = x.shape[1:-1]
+    else:
+        sp_shape = x.shape[2:]
+    if size is None:
+        if isinstance(scale_factor, (int, float)):
+            scale_factor = [scale_factor] * spatial
+        size = [int(s * f) for s, f in zip(sp_shape, scale_factor)]
+    else:
+        if isinstance(size, Tensor):
+            size = [int(v) for v in size.tolist()]
+        size = [int(s.item()) if isinstance(s, Tensor) else int(s)
+                for s in size]
+
+    jmode = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+
+    def fn(a):
+        if channel_last:
+            out_shape = (a.shape[0],) + tuple(size) + (a.shape[-1],)
+        else:
+            out_shape = a.shape[:2] + tuple(size)
+        if mode == "nearest":
+            return jax.image.resize(a, out_shape, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate via manual
+            # coordinate map using scale_and_translate
+            in_sp = sp_shape
+            scales = [(o - 1) / (i - 1) if i > 1 else 1.0
+                      for i, o in zip(in_sp, size)]
+            sp_dims = list(range(1, nd - 1)) if channel_last else \
+                list(range(2, nd))
+            return jax.image.scale_and_translate(
+                a, out_shape, sp_dims,
+                jnp.asarray(scales, jnp.float32),
+                jnp.zeros((spatial,), jnp.float32),
+                method={"linear": "linear", "cubic": "cubic"}[jmode],
+                antialias=False)
+        return jax.image.resize(a, out_shape, method=jmode, antialias=False)
+    return apply(fn, x, name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    x = as_tensor(x)
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    d = _pair(dilations)
+    if isinstance(paddings, int):
+        p = ((paddings, paddings), (paddings, paddings))
+    elif len(paddings) == 2:
+        p = ((paddings[0], paddings[0]), (paddings[1], paddings[1]))
+    else:
+        p = ((paddings[0], paddings[2]), (paddings[1], paddings[3]))
+
+    def fn(a):
+        n, c, h, w = a.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            a, filter_shape=k, window_strides=s, padding=p,
+            rhs_dilation=d, dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        # patches: [N, C*kh*kw, oh, ow]
+        return patches.reshape(n, patches.shape[1], -1)
+    return apply(fn, x, name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    x = as_tensor(x)
+
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    out_sz = _pair(output_sizes)
+    k = _pair(kernel_sizes)
+    s = _pair(strides)
+    d = _pair(dilations)
+    pd = _pair(paddings) if not isinstance(paddings, int) else (paddings,
+                                                                paddings)
+
+    def fn(a):
+        n, ckk, L = a.shape
+        c = ckk // (k[0] * k[1])
+        oh = (out_sz[0] + 2 * pd[0] - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (out_sz[1] + 2 * pd[1] - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = a.reshape(n, c, k[0], k[1], oh, ow)
+        out = jnp.zeros((n, c, out_sz[0] + 2 * pd[0], out_sz[1] + 2 * pd[1]),
+                        a.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                hi = i * d[0]
+                wj = j * d[1]
+                out = out.at[:, :, hi:hi + oh * s[0]:s[0],
+                             wj:wj + ow * s[1]:s[1]].add(cols[:, :, i, j])
+        return out[:, :, pd[0]:pd[0] + out_sz[0], pd[1]:pd[1] + out_sz[1]]
+    return apply(fn, x, name="fold")
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c // (r * r), r, r, h, w)
+            a = a.transpose(0, 1, 4, 2, 5, 3)
+            return a.reshape(n, c // (r * r), h * r, w * r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, r, r, c // (r * r))
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h * r, w * r, c // (r * r))
+    return apply(fn, as_tensor(x), name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, c, h // r, r, w // r, r)
+            a = a.transpose(0, 1, 3, 5, 2, 4)
+            return a.reshape(n, c * r * r, h // r, w // r)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h // r, r, w // r, r, c)
+        a = a.transpose(0, 1, 3, 2, 4, 5)
+        return a.reshape(n, h // r, w // r, c * r * r)
+    return apply(fn, as_tensor(x), name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def fn(a):
+        if data_format == "NCHW":
+            n, c, h, w = a.shape
+            a = a.reshape(n, groups, c // groups, h, w)
+            return a.transpose(0, 2, 1, 3, 4).reshape(n, c, h, w)
+        n, h, w, c = a.shape
+        a = a.reshape(n, h, w, groups, c // groups)
+        return a.transpose(0, 1, 2, 4, 3).reshape(n, h, w, c)
+    return apply(fn, as_tensor(x), name="channel_shuffle")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    label = as_tensor(label)
+
+    def fn(l):
+        k = l.shape[-1]
+        if prior_dist is not None:
+            pd = prior_dist._data if isinstance(prior_dist, Tensor) \
+                else jnp.asarray(prior_dist)
+            return (1 - epsilon) * l + epsilon * pd
+        return (1 - epsilon) * l + epsilon / k
+    return apply(fn, label, name="label_smooth")

@@ -1,0 +1,169 @@
+"""Convolution functionals over ``jax.lax.conv_general_dilated`` — the MXU
+path for convs (python/paddle/nn/functional/conv.py parity, UNVERIFIED)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply
+from ...ops.common import as_tensor
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuplize(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    if len(v) == 1:
+        return v * n
+    return v
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()  # SAME / VALID
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    # paddle also accepts [[0,0],[0,0],[p,p],...] including batch/channel
+    return [tuple(p) for p in padding[-n:]]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format, name):
+    x, weight = as_tensor(x), as_tensor(weight)
+    from ...amp.auto_cast import maybe_cast_matmul
+    x, weight = maybe_cast_matmul(x, weight)
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    pad = _padding(padding, n)
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    sp = "DHW"[3 - n:]
+    if channel_last:
+        dn = ("N" + sp + "C", "OI" + sp, "N" + sp + "C")
+    else:
+        dn = ("NC" + sp, "OI" + sp, "NC" + sp)
+
+    def fn(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=stride, padding=pad,
+            rhs_dilation=dilation, dimension_numbers=dn,
+            feature_group_count=groups)
+        if b:
+            bia = b[0].astype(out.dtype)
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = bia.shape[0]
+            out = out + bia.reshape(shape)
+        return out
+    if bias is not None:
+        return apply(fn, x, weight, as_tensor(bias), name=name)
+    return apply(fn, x, weight, name=name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NWC" if data_format == "NLC" else "NCW"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 fmt, name="conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, name="conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, name="conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, data_format, output_size, name):
+    x, weight = as_tensor(x), as_tensor(weight)
+    stride = _tuplize(stride, n)
+    dilation = _tuplize(dilation, n)
+    opad = _tuplize(output_padding, n)
+    channel_last = data_format in ("NHWC", "NWC", "NDHWC", "NLC")
+    sp = "DHW"[3 - n:]
+    if channel_last:
+        dn = ("N" + sp + "C", "IO" + sp, "N" + sp + "C")
+    else:
+        dn = ("NC" + sp, "IO" + sp, "NC" + sp)
+    if isinstance(padding, str):
+        pads = padding.upper()
+    else:
+        pads = _padding(padding, n)
+
+    def fn(a, w, *b):
+        # paddle conv_transpose weight: [in, out/groups, *k]; lax wants
+        # gradient-style transposed conv: use conv_transpose with IO spec.
+        if isinstance(pads, str):
+            jpad = pads
+        else:
+            # transposed conv padding: effective pad = dilation*(k-1) - pad
+            k = w.shape[2:]
+            jpad = [(dilation[i] * (k[i] - 1) - pads[i][0],
+                     dilation[i] * (k[i] - 1) - pads[i][1] + opad[i])
+                    for i in range(n)]
+        if groups == 1:
+            out = jax.lax.conv_general_dilated(
+                a, w, window_strides=(1,) * n, padding=jpad,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=dn)
+        else:
+            ch_ax = a.ndim - 1 if channel_last else 1
+            xs = jnp.split(a, groups, axis=ch_ax)
+            ws = jnp.split(w, groups, axis=0)
+            outs = [jax.lax.conv_general_dilated(
+                xg, wg, window_strides=(1,) * n, padding=jpad,
+                lhs_dilation=stride, rhs_dilation=dilation,
+                dimension_numbers=dn) for xg, wg in zip(xs, ws)]
+            out = jnp.concatenate(outs, axis=ch_ax)
+        if b:
+            bia = b[0].astype(out.dtype)
+            shape = [1] * out.ndim
+            shape[-1 if channel_last else 1] = bia.shape[0]
+            out = out + bia.reshape(shape)
+        return out
+    # weight layout: paddle is [in, out/groups, *k]; lax IO spec means
+    # dim0=I, dim1=O which matches directly.
+    def fn_flip(a, w, *b):
+        w = jnp.flip(w, axis=tuple(range(2, 2 + n)))
+        return fn(a, w, *b)
+    if bias is not None:
+        return apply(fn_flip, x, weight, as_tensor(bias), name=name)
+    return apply(fn_flip, x, weight, name=name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    fmt = "NWC" if data_format == "NLC" else "NCW"
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, fmt, output_size,
+                           "conv1d_transpose")
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format, output_size,
+                           "conv2d_transpose")
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format, output_size,
+                           "conv3d_transpose")

@@ -1,0 +1,398 @@
+"""Loss functionals (python/paddle/nn/functional/loss.py parity,
+UNVERIFIED)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply
+from ...ops.common import as_tensor
+
+__all__ = ["cross_entropy", "softmax_with_cross_entropy", "nll_loss",
+           "mse_loss", "l1_loss", "smooth_l1_loss", "binary_cross_entropy",
+           "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
+           "hinge_embedding_loss", "cosine_embedding_loss", "ctc_loss",
+           "triplet_margin_loss", "multi_label_soft_margin_loss",
+           "square_error_cost", "log_loss", "sigmoid_focal_loss",
+           "poisson_nll_loss", "gaussian_nll_loss", "dice_loss"]
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    args = [input, label]
+    if weight is not None:
+        args.append(as_tensor(weight))
+
+    def fn(logits, lab, *w):
+        lf = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) \
+            if use_softmax else jnp.log(jnp.maximum(
+                logits.astype(jnp.float32), 1e-38))
+        n_classes = logits.shape[axis]
+        if soft_label or (lab.ndim == logits.ndim and
+                          lab.shape[axis] == n_classes and
+                          jnp.issubdtype(lab.dtype, jnp.floating)):
+            soft = lab.astype(jnp.float32)
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + \
+                    label_smoothing / n_classes
+            loss = -jnp.sum(soft * lf, axis=axis)
+            mask = None
+        else:
+            idx = lab
+            if idx.ndim == logits.ndim:  # [..., 1] hard labels
+                idx = jnp.squeeze(idx, axis=axis)
+            idx = idx.astype(jnp.int32)
+            mask = (idx != ignore_index)
+            safe = jnp.where(mask, idx, 0)
+            if label_smoothing > 0:
+                oh = jax.nn.one_hot(safe, n_classes, axis=axis,
+                                    dtype=jnp.float32)
+                soft = oh * (1 - label_smoothing) + \
+                    label_smoothing / n_classes
+                loss = -jnp.sum(soft * lf, axis=axis)
+            else:
+                loss = -jnp.take_along_axis(
+                    lf, jnp.expand_dims(safe, axis), axis=axis)
+                loss = jnp.squeeze(loss, axis=axis)
+            if w:
+                cw = jnp.take(w[0].astype(jnp.float32), safe)
+                loss = loss * cw
+            loss = jnp.where(mask, loss, 0.0)
+        if reduction == "mean":
+            if mask is not None:
+                if w:
+                    cw = jnp.take(w[0].astype(jnp.float32),
+                                  jnp.where(mask, idx, 0)) * mask
+                    return jnp.sum(loss) / jnp.maximum(jnp.sum(cw), 1e-12)
+                return jnp.sum(loss) / jnp.maximum(
+                    jnp.sum(mask.astype(jnp.float32)), 1.0)
+            return jnp.mean(loss)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply(fn, *args, name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    from .activation import softmax as softmax_fn
+    from ...ops.manipulation import unsqueeze
+    loss = unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    input, label = as_tensor(input), as_tensor(label)
+    args = [input, label]
+    if weight is not None:
+        args.append(as_tensor(weight))
+
+    def fn(lp, lab, *w):
+        idx = lab.astype(jnp.int32)
+        mask = (idx != ignore_index)
+        safe = jnp.where(mask, idx, 0)
+        loss = -jnp.take_along_axis(lp, safe[:, None] if lp.ndim == 2
+                                    else jnp.expand_dims(safe, 1), axis=1)
+        loss = jnp.squeeze(loss, axis=1)
+        cw = None
+        if w:
+            cw = jnp.take(w[0], safe)
+            loss = loss * cw
+        loss = jnp.where(mask, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(cw * mask) if cw is not None else \
+                jnp.sum(mask.astype(loss.dtype))
+            return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply(fn, *args, name="nll_loss")
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                 as_tensor(input), as_tensor(label), name="mse_loss")
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), as_tensor(input),
+                 as_tensor(label), name="square_error_cost")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                 as_tensor(input), as_tensor(label), name="l1_loss")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def fn(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        val = jnp.where(ad < delta, 0.5 * d * d / delta, ad - 0.5 * delta)
+        return _reduce(val, reduction)
+    return apply(fn, as_tensor(input), as_tensor(label),
+                 name="smooth_l1_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    args = [as_tensor(input), as_tensor(label)]
+    if weight is not None:
+        args.append(as_tensor(weight))
+
+    def fn(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        val = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            val = val * w[0]
+        return _reduce(val, reduction)
+    return apply(fn, *args, name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    args = [as_tensor(logit), as_tensor(label)]
+    if weight is not None:
+        args.append(as_tensor(weight))
+    if pos_weight is not None:
+        args.append(as_tensor(pos_weight))
+
+    def fn(x, y, *rest):
+        i = 0
+        w = pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        # stable: max(x,0) - x*y + log(1+exp(-|x|)); with pos_weight:
+        log_sig_x = jax.nn.log_sigmoid(x)
+        log_sig_nx = jax.nn.log_sigmoid(-x)
+        if pw is not None:
+            val = -(pw * y * log_sig_x + (1 - y) * log_sig_nx)
+        else:
+            val = -(y * log_sig_x + (1 - y) * log_sig_nx)
+        if w is not None:
+            val = val * w
+        return _reduce(val, reduction)
+    return apply(fn, *args, name="bce_with_logits")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def fn(lp, y):
+        if log_target:
+            val = jnp.exp(y) * (y - lp)
+        else:
+            val = y * (jnp.log(jnp.maximum(y, 1e-38)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(val) / lp.shape[0]
+        return _reduce(val, reduction)
+    return apply(fn, as_tensor(input), as_tensor(label), name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def fn(a, b, y):
+        val = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(val, reduction)
+    return apply(fn, as_tensor(input), as_tensor(other), as_tensor(label),
+                 name="margin_ranking_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def fn(a, y):
+        val = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(val, reduction)
+    return apply(fn, as_tensor(input), as_tensor(label),
+                 name="hinge_embedding_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def fn(a, b, y):
+        cos = jnp.sum(a * b, -1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        val = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(val, reduction)
+    return apply(fn, as_tensor(input1), as_tensor(input2), as_tensor(label),
+                 name="cosine_embedding_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-06, swap=False, reduction="mean",
+                        name=None):
+    def fn(a, pos, neg):
+        def dist(u, v):
+            return jnp.sum(jnp.abs(u - v + epsilon) ** p, -1) ** (1 / p)
+        d_ap = dist(a, pos)
+        d_an = dist(a, neg)
+        if swap:
+            d_pn = dist(pos, neg)
+            d_an = jnp.minimum(d_an, d_pn)
+        val = jnp.maximum(0.0, d_ap - d_an + margin)
+        return _reduce(val, reduction)
+    return apply(fn, as_tensor(input), as_tensor(positive),
+                 as_tensor(negative), name="triplet_margin_loss")
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    args = [as_tensor(input), as_tensor(label)]
+    if weight is not None:
+        args.append(as_tensor(weight))
+
+    def fn(x, y, *w):
+        val = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        val = jnp.mean(val, -1)
+        if w:
+            val = val * w[0]
+        return _reduce(val, reduction)
+    return apply(fn, *args, name="multi_label_soft_margin_loss")
+
+
+def log_loss(input, label, epsilon=0.0001, name=None):
+    def fn(p, y):
+        return -(y * jnp.log(p + epsilon) +
+                 (1 - y) * jnp.log(1 - p + epsilon))
+    return apply(fn, as_tensor(input), as_tensor(label), name="log_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    args = [as_tensor(logit), as_tensor(label)]
+    if normalizer is not None:
+        args.append(as_tensor(normalizer))
+
+    def fn(x, y, *nm):
+        p = jax.nn.sigmoid(x)
+        ce = -(y * jax.nn.log_sigmoid(x) + (1 - y) * jax.nn.log_sigmoid(-x))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        val = a_t * ((1 - p_t) ** gamma) * ce
+        if nm:
+            val = val / nm[0]
+        return _reduce(val, reduction)
+    return apply(fn, *args, name="sigmoid_focal_loss")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def fn(x, y):
+        if log_input:
+            val = jnp.exp(x) - y * x
+        else:
+            val = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + (y == 0)) - y + \
+                0.5 * jnp.log(2 * jnp.pi * jnp.maximum(y, 1.0))
+            val = val + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(val, reduction)
+    return apply(fn, as_tensor(input), as_tensor(label),
+                 name="poisson_nll_loss")
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def fn(mu, y, var):
+        var = jnp.maximum(var, epsilon)
+        val = 0.5 * (jnp.log(var) + jnp.square(y - mu) / var)
+        if full:
+            val = val + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, var.dtype))
+        return _reduce(val, reduction)
+    return apply(fn, as_tensor(input), as_tensor(label), as_tensor(variance),
+                 name="gaussian_nll_loss")
+
+
+def dice_loss(input, label, epsilon=1e-05, name=None):
+    def fn(p, y):
+        yf = jax.nn.one_hot(jnp.squeeze(y, -1), p.shape[-1], dtype=p.dtype)
+        inter = jnp.sum(p * yf, axis=tuple(range(1, p.ndim)))
+        union = jnp.sum(p, axis=tuple(range(1, p.ndim))) + \
+            jnp.sum(yf, axis=tuple(range(1, p.ndim)))
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply(fn, as_tensor(input), as_tensor(label), name="dice_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    # log_probs: [T, N, C] (paddle layout)
+    lp = as_tensor(log_probs)
+    lab = as_tensor(labels)
+    il = as_tensor(input_lengths)
+    ll = as_tensor(label_lengths)
+
+    def fn(logp, ys, in_len, lab_len):
+        logp = jnp.transpose(logp, (1, 0, 2))  # [N, T, C]
+        logp = jax.nn.log_softmax(logp, -1)
+        N, T, C = logp.shape
+        S = ys.shape[1]
+        # classic alpha recursion over extended label seq with blanks
+        ext = jnp.full((N, 2 * S + 1), blank, dtype=ys.dtype)
+        ext = ext.at[:, 1::2].set(ys)
+        L = 2 * lab_len + 1
+
+        def get(logp_t, idx):
+            return jnp.take_along_axis(logp_t, idx, axis=-1)
+
+        neg_inf = -1e30
+        alpha0 = jnp.full((N, 2 * S + 1), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(logp[:, 0, blank])
+        first_lab = get(logp[:, 0], ext[:, 1:2])[:, 0]
+        alpha0 = alpha0.at[:, 1].set(jnp.where(S > 0, first_lab, neg_inf))
+
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((N, 2), dtype=bool),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, logp_t):
+            a_prev = alpha
+            a_shift1 = jnp.concatenate(
+                [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            m = jnp.maximum(jnp.maximum(a_prev, a_shift1), a_shift2)
+            summed = m + jnp.log(
+                jnp.exp(a_prev - m) + jnp.exp(a_shift1 - m) +
+                jnp.exp(a_shift2 - m) + 1e-38)
+            emit = get(logp_t, ext)
+            return summed + emit, None
+
+        def scan_fn(alpha, t):
+            new_alpha, _ = step(alpha, logp[:, t])
+            keep = (t < in_len)[:, None]
+            return jnp.where(keep, new_alpha, alpha), None
+
+        alpha, _ = jax.lax.scan(scan_fn, alpha0, jnp.arange(1, T))
+        idx_last = (L - 1)[:, None]
+        idx_prev = jnp.maximum(L - 2, 0)[:, None]
+        a_last = jnp.take_along_axis(alpha, idx_last, axis=1)[:, 0]
+        a_prev = jnp.take_along_axis(alpha, idx_prev, axis=1)[:, 0]
+        m = jnp.maximum(a_last, a_prev)
+        ll_prob = m + jnp.log(jnp.exp(a_last - m) + jnp.exp(a_prev - m))
+        loss = -ll_prob
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lab_len, 1))
+        if reduction == "sum":
+            return jnp.sum(loss)
+        return loss
+    return apply(fn, lp, lab, il, ll, name="ctc_loss")

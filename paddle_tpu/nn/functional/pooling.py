@@ -1,0 +1,228 @@
+"""Pooling functionals via ``jax.lax.reduce_window``
+(python/paddle/nn/functional/pooling.py parity, UNVERIFIED)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply
+from ...ops.common import as_tensor
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+           "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+           "adaptive_max_pool1d", "adaptive_max_pool2d",
+           "adaptive_max_pool3d"]
+
+
+def _tuplize(v, n):
+    if v is None:
+        return None
+    if isinstance(v, int):
+        return (v,) * n
+    v = tuple(int(x) for x in v)
+    return v * n if len(v) == 1 else v
+
+
+def _pool(x, kernel, stride, padding, n, op, channel_last, ceil_mode=False,
+          exclusive=True, count_include_pad=False, name=""):
+    x = as_tensor(x)
+    kernel = _tuplize(kernel, n)
+    stride = _tuplize(stride, n) or kernel
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        pads = None
+    else:
+        pad_mode = None
+        p = _tuplize(padding, n) if not (isinstance(padding, (list, tuple))
+                                         and len(padding) == 2 * n) else None
+        if p is not None:
+            pads = [(pi, pi) for pi in p]
+        else:
+            pads = [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+
+    def fn(a):
+        nd = a.ndim
+        if channel_last:
+            sp_dims = list(range(1, nd - 1))
+        else:
+            sp_dims = list(range(2, nd))
+        window = [1] * nd
+        strides = [1] * nd
+        padding_full = [(0, 0)] * nd
+        for i, d in enumerate(sp_dims):
+            window[d] = kernel[i]
+            strides[d] = stride[i]
+            if pads is not None:
+                padding_full[d] = pads[i]
+        if pad_mode == "SAME":
+            padding_cfg = "SAME"
+        elif pad_mode == "VALID":
+            padding_cfg = "VALID"
+        else:
+            padding_cfg = padding_full
+        if op == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+                else jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, window,
+                                         strides, padding_cfg)
+        # avg
+        s = jax.lax.reduce_window(a, 0.0 if jnp.issubdtype(
+            a.dtype, jnp.floating) else 0, jax.lax.add, window, strides,
+            padding_cfg)
+        if exclusive and not count_include_pad and padding_cfg not in \
+                ("VALID",):
+            ones = jnp.ones_like(a)
+            cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                        strides, padding_cfg)
+            return s / cnt
+        return s / float(np.prod(kernel))
+    return apply(fn, x, name=name)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "avg", False,
+                 ceil_mode, exclusive, name="avg_pool1d")
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, "avg",
+                 data_format == "NHWC", ceil_mode, exclusive,
+                 name="avg_pool2d")
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "avg",
+                 data_format == "NDHWC", ceil_mode, exclusive,
+                 name="avg_pool3d")
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, name=None):
+    return _pool(x, kernel_size, stride, padding, 1, "max", False,
+                 ceil_mode, name="max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, "max",
+                data_format == "NHWC", ceil_mode, name="max_pool2d")
+    if return_mask:
+        idx = _max_pool_indices(as_tensor(x), kernel_size, stride, padding,
+                                2, data_format == "NHWC")
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, "max",
+                 data_format == "NDHWC", ceil_mode, name="max_pool3d")
+
+
+def _max_pool_indices(x, kernel, stride, padding, n, channel_last):
+    # host-side index computation (eager debugging aid, like paddle's mask)
+    kernel = _tuplize(kernel, n)
+    stride = _tuplize(stride, n) or kernel
+    p = _tuplize(padding, n)
+    a = np.asarray(x._data)
+    if channel_last:
+        a = np.moveaxis(a, -1, 1)
+    N, C, H, W = a.shape
+    oh = (H + 2 * p[0] - kernel[0]) // stride[0] + 1
+    ow = (W + 2 * p[1] - kernel[1]) // stride[1] + 1
+    idx = np.zeros((N, C, oh, ow), np.int64)
+    padded = np.pad(a, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
+                    constant_values=-np.inf)
+    for i in range(oh):
+        for j in range(ow):
+            win = padded[:, :, i * stride[0]:i * stride[0] + kernel[0],
+                         j * stride[1]:j * stride[1] + kernel[1]]
+            flat = win.reshape(N, C, -1)
+            am = flat.argmax(-1)
+            wi, wj = np.unravel_index(am, kernel)
+            src_i = np.clip(i * stride[0] + wi - p[0], 0, H - 1)
+            src_j = np.clip(j * stride[1] + wj - p[1], 0, W - 1)
+            idx[:, :, i, j] = src_i * W + src_j
+    return Tensor(jnp.asarray(idx))
+
+
+def _adaptive_pool(x, output_size, n, op, channel_last, name):
+    x = as_tensor(x)
+    out_sz = _tuplize(output_size, n)
+    out_sz = tuple(o if o is not None else -1 for o in out_sz)
+
+    def fn(a):
+        nd = a.ndim
+        sp_dims = list(range(1, nd - 1)) if channel_last else \
+            list(range(2, nd))
+        out = a
+        for i, d in enumerate(sp_dims):
+            o = out.shape[d] if out_sz[i] == -1 else out_sz[i]
+            in_sz = out.shape[d]
+            if in_sz % o == 0:
+                k = in_sz // o
+                window = [1] * out.ndim
+                strides = [1] * out.ndim
+                window[d] = k
+                strides[d] = k
+                if op == "max":
+                    init = -jnp.inf
+                    out = jax.lax.reduce_window(out, init, jax.lax.max,
+                                                window, strides, "VALID")
+                else:
+                    out = jax.lax.reduce_window(out, 0.0, jax.lax.add,
+                                                window, strides,
+                                                "VALID") / k
+            else:
+                # general adaptive: per-output-bin mean/max via segment ends
+                starts = (np.arange(o) * in_sz) // o
+                ends = ((np.arange(o) + 1) * in_sz + o - 1) // o
+                pieces = []
+                for s, e in zip(starts, ends):
+                    sl = [slice(None)] * out.ndim
+                    sl[d] = slice(int(s), int(e))
+                    seg = out[tuple(sl)]
+                    red = jnp.max(seg, axis=d, keepdims=True) if op == "max" \
+                        else jnp.mean(seg, axis=d, keepdims=True)
+                    pieces.append(red)
+                out = jnp.concatenate(pieces, axis=d)
+        return out
+    return apply(fn, x, name=name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive_pool(x, output_size, 1, "avg", False,
+                          "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive_pool(x, output_size, 2, "avg", data_format == "NHWC",
+                          "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive_pool(x, output_size, 3, "avg", data_format == "NDHWC",
+                          "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 1, "max", False,
+                          "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 2, "max", False,
+                          "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive_pool(x, output_size, 3, "max", False,
+                          "adaptive_max_pool3d")

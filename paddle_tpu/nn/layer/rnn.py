@@ -1,0 +1,313 @@
+"""Recurrent layers (python/paddle/nn/layer/rnn.py parity, UNVERIFIED).
+
+TPU-first: the time loop is a single ``jax.lax.scan`` inside one traced op,
+so the whole sequence compiles to one XLA while-loop (no per-step dispatch),
+and the MXU sees batched [B, 4H] gate matmuls."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply
+from ...ops.common import as_tensor
+from .. import initializer as I
+from .layers import Layer
+
+__all__ = ["SimpleRNN", "LSTM", "GRU", "SimpleRNNCell", "LSTMCell",
+           "GRUCell", "RNN", "BiRNN"]
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gates, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        u = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [gates * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=u)
+        self.weight_hh = self.create_parameter(
+            [gates * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=u)
+        if bias_ih_attr is not False:
+            self.bias_ih = self.create_parameter(
+                [gates * hidden_size], attr=bias_ih_attr, is_bias=True,
+                default_initializer=u)
+        else:
+            self.bias_ih = None
+        if bias_hh_attr is not False:
+            self.bias_hh = self.create_parameter(
+                [gates * hidden_size], attr=bias_hh_attr, is_bias=True,
+                default_initializer=u)
+        else:
+            self.bias_hh = None
+
+    def _params(self):
+        ps = [self.weight_ih, self.weight_hh]
+        if self.bias_ih is not None:
+            ps.append(self.bias_ih)
+        if self.bias_hh is not None:
+            ps.append(self.bias_hh)
+        return ps
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        b = batch_ref.shape[batch_dim_idx]
+        from ...ops.creation import full
+        return full([b, self.hidden_size], init_value, dtype or "float32")
+
+
+def _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh):
+    gates = x_t @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        gates = gates + b_ih
+    if b_hh is not None:
+        gates = gates + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i = jax.nn.sigmoid(i)
+    f = jax.nn.sigmoid(f)
+    g = jnp.tanh(g)
+    o = jax.nn.sigmoid(o)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh):
+    gi = x_t @ w_ih.T + (b_ih if b_ih is not None else 0)
+    gh = h @ w_hh.T + (b_hh if b_hh is not None else 0)
+    ir, iz, ic = jnp.split(gi, 3, axis=-1)
+    hr, hz, hc = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ir + hr)
+    z = jax.nn.sigmoid(iz + hz)
+    n = jnp.tanh(ic + r * hc)
+    return n + z * (h - n)
+
+
+def _rnn_step(x_t, h, w_ih, w_hh, b_ih, b_hh, act):
+    out = x_t @ w_ih.T + h @ w_hh.T
+    if b_ih is not None:
+        out = out + b_ih
+    if b_hh is not None:
+        out = out + b_hh
+    return jnp.tanh(out) if act == "tanh" else jax.nn.relu(out)
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh", **kw):
+        super().__init__(input_size, hidden_size, 1, **kw)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        args = [as_tensor(inputs), as_tensor(states)] + self._params()
+        act = self.activation
+        has_bi, has_bh = self.bias_ih is not None, self.bias_hh is not None
+
+        def fn(x, h, w_ih, w_hh, *bs):
+            b_ih = bs[0] if has_bi else None
+            b_hh = bs[1 if has_bi else 0] if has_bh else None
+            return _rnn_step(x, h, w_ih, w_hh, b_ih, b_hh, act)
+        out = apply(fn, *args, name="simple_rnn_cell")
+        return out, out
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 4, **kw)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            b = inputs.shape[0]
+            from ...ops.creation import zeros
+            states = (zeros([b, self.hidden_size]),
+                      zeros([b, self.hidden_size]))
+        h0, c0 = states
+        args = [as_tensor(inputs), as_tensor(h0), as_tensor(c0)] + \
+            self._params()
+        has_bi, has_bh = self.bias_ih is not None, self.bias_hh is not None
+
+        def fn(x, h, c, w_ih, w_hh, *bs):
+            b_ih = bs[0] if has_bi else None
+            b_hh = bs[1 if has_bi else 0] if has_bh else None
+            return _lstm_step(x, h, c, w_ih, w_hh, b_ih, b_hh)
+        h_new, c_new = apply(fn, *args, n_outputs=2, name="lstm_cell")
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, **kw):
+        super().__init__(input_size, hidden_size, 3, **kw)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        args = [as_tensor(inputs), as_tensor(states)] + self._params()
+        has_bi, has_bh = self.bias_ih is not None, self.bias_hh is not None
+
+        def fn(x, h, w_ih, w_hh, *bs):
+            b_ih = bs[0] if has_bi else None
+            b_hh = bs[1 if has_bi else 0] if has_bh else None
+            return _gru_step(x, h, w_ih, w_hh, b_ih, b_hh)
+        out = apply(fn, *args, name="gru_cell")
+        return out, out
+
+
+class RNN(Layer):
+    """Wraps a cell; runs it over time with lax.scan."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        # delegate to the layer-mode runner in _RNNLayerBase style
+        raise NotImplementedError(
+            "Use SimpleRNN/LSTM/GRU layers; RNN cell wrapper supports "
+            "step-by-step use via self.cell")
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.cell_fw = cell_fw
+        self.cell_bw = cell_bw
+        self.time_major = time_major
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.activation = activation
+        self.bidirect = direction in ("bidirect", "bidirectional")
+        ndir = 2 if self.bidirect else 1
+        gates = {"lstm": 4, "gru": 3, "rnn": 1}[mode]
+        cell_cls = {"lstm": LSTMCell, "gru": GRUCell,
+                    "rnn": SimpleRNNCell}[mode]
+        self.cells = []
+        for layer_i in range(num_layers):
+            for d in range(ndir):
+                in_sz = input_size if layer_i == 0 else hidden_size * ndir
+                kw = dict(weight_ih_attr=weight_ih_attr,
+                          weight_hh_attr=weight_hh_attr,
+                          bias_ih_attr=bias_ih_attr,
+                          bias_hh_attr=bias_hh_attr)
+                if mode == "rnn":
+                    cell = cell_cls(in_sz, hidden_size, activation, **kw)
+                else:
+                    cell = cell_cls(in_sz, hidden_size, **kw)
+                self.add_sublayer(f"cell_{layer_i}_{d}", cell)
+                self.cells.append(cell)
+
+    def _scan_layer(self, cell, x, reverse):
+        """x: Tensor [B, T, I] (batch-first internally). One traced op."""
+        is_lstm = self.mode == "lstm"
+        mode, act = self.mode, self.activation
+        has_bi = cell.bias_ih is not None
+        has_bh = cell.bias_hh is not None
+
+        def fn(xx, w_ih, w_hh, *bs):
+            b_ih = bs[0] if has_bi else None
+            b_hh = bs[1 if has_bi else 0] if has_bh else None
+            xt = jnp.swapaxes(xx, 0, 1)  # [T, B, I]
+            if reverse:
+                xt = jnp.flip(xt, 0)
+            B = xt.shape[1]
+            h0 = jnp.zeros((B, cell.hidden_size), xx.dtype)
+
+            if is_lstm:
+                def step(carry, x_t):
+                    h, c = carry
+                    h2, c2 = _lstm_step(x_t, h, c, w_ih, w_hh, b_ih, b_hh)
+                    return (h2, c2), h2
+                (hT, cT), ys = jax.lax.scan(step, (h0, h0), xt)
+                final = jnp.stack([hT, cT])
+            else:
+                def step(h, x_t):
+                    if mode == "gru":
+                        h2 = _gru_step(x_t, h, w_ih, w_hh, b_ih, b_hh)
+                    else:
+                        h2 = _rnn_step(x_t, h, w_ih, w_hh, b_ih, b_hh, act)
+                    return h2, h2
+                hT, ys = jax.lax.scan(step, h0, xt)
+                final = hT[None]
+            if reverse:
+                ys = jnp.flip(ys, 0)
+            return jnp.swapaxes(ys, 0, 1), final
+        args = [x] + cell._params()
+        ys, final = apply(fn, *args, n_outputs=2,
+                          name=f"{mode}_layer")
+        return ys, final
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops import manipulation as M
+        x = as_tensor(inputs)
+        if self.time_major:
+            x = M.transpose(x, [1, 0, 2])
+        finals = []
+        out = x
+        ndir = 2 if self.bidirect else 1
+        for layer_i in range(self.num_layers):
+            if self.bidirect:
+                fw = self.cells[layer_i * 2]
+                bw = self.cells[layer_i * 2 + 1]
+                y_f, s_f = self._scan_layer(fw, out, False)
+                y_b, s_b = self._scan_layer(bw, out, True)
+                out = M.concat([y_f, y_b], axis=-1)
+                finals.extend([s_f, s_b])
+            else:
+                cell = self.cells[layer_i]
+                out, s = self._scan_layer(cell, out, False)
+                finals.append(s)
+            if self.dropout > 0 and layer_i < self.num_layers - 1:
+                from .. import functional as F
+                out = F.dropout(out, self.dropout, training=self.training)
+        if self.time_major:
+            out = M.transpose(out, [1, 0, 2])
+        # final states: [num_layers*ndir, B, H] (+ cell for lstm)
+        if self.mode == "lstm":
+            h = M.stack([f[0] for f in finals], axis=0)
+            c = M.stack([f[1] for f in finals], axis=0)
+            return out, (h, c)
+        h = M.concat(finals, axis=0)
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__("rnn", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("lstm", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("gru", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)

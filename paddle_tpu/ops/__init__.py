@@ -1,0 +1,20 @@
+"""Op library — the role of Paddle's PHI op set + ``python/paddle/tensor/``
+(~2000 APIs; SURVEY.md §2.2).  Each op is a pure jax function dispatched
+through ``framework.core.apply`` so eager autograd, state tracking, and
+to_static tracing all share one path.  XLA plays the role of PHI's per-backend
+kernels (SURVEY.md §2.1: "XLA:CPU via jax (free)").
+"""
+
+from . import creation, math, manipulation, linalg, logic, search, stat, random_ops  # noqa
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .search import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+from .random_ops import *  # noqa: F401,F403
+from .einsum_op import einsum  # noqa: F401
+
+from .tensor_methods import install_tensor_methods
+install_tensor_methods()

@@ -1,0 +1,43 @@
+"""Shared helpers for the op library."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply, to_jax_dtype
+
+__all__ = ["Tensor", "apply", "to_jax_dtype", "as_tensor", "unary", "binary"]
+
+
+def as_tensor(x, dtype=None) -> Tensor:
+    if isinstance(x, Tensor):
+        return x
+    return Tensor(jnp.asarray(x, dtype=to_jax_dtype(dtype)))
+
+
+def unary(fn, name):
+    """Build a paddle-style unary op ``op(x, name=None)``."""
+    def op(x, name=None):
+        return apply(fn, as_tensor(x), name=name or fn.__name__)
+    op.__name__ = name
+    return op
+
+
+def binary(fn, name):
+    """Build a paddle-style broadcasting binary op ``op(x, y, name=None)``."""
+    def op(x, y, name=None):
+        xt = x if isinstance(x, Tensor) else x
+        yt = y if isinstance(y, Tensor) else y
+        # keep python scalars as scalars (weak-typed in jax, matches paddle
+        # scalar-op behavior); coerce lists/ndarrays to tensors
+        if not isinstance(xt, Tensor) and not _is_scalar(xt):
+            xt = as_tensor(xt)
+        if not isinstance(yt, Tensor) and not _is_scalar(yt):
+            yt = as_tensor(yt)
+        return apply(fn, xt, yt, name=name or fn.__name__)
+    op.__name__ = name
+    return op
+
+
+def _is_scalar(x) -> bool:
+    return isinstance(x, (int, float, bool, complex))

@@ -1,0 +1,178 @@
+"""Tensor creation ops (paddle/tensor/creation.py parity, UNVERIFIED paths)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply, to_jax_dtype
+from .common import as_tensor
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
+    "tril_indices", "triu_indices", "complex", "polar", "one_hot",
+]
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    return Tensor(jnp.asarray(data, dtype=to_jax_dtype(dtype)),
+                  stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype="float32", name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), to_jax_dtype(dtype or "float32")))
+
+
+def ones(shape, dtype="float32", name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), to_jax_dtype(dtype or "float32")))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value.item()
+    if dtype is None:
+        dtype = "float32"
+    return Tensor(jnp.full(_shape(shape), fill_value, to_jax_dtype(dtype)))
+
+
+def empty(shape, dtype="float32", name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jnp.zeros_like(x._data, dtype=to_jax_dtype(dtype)))
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jnp.ones_like(x._data, dtype=to_jax_dtype(dtype)))
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jnp.full_like(x._data, fill_value, dtype=to_jax_dtype(dtype)))
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    return Tensor(jnp.arange(start, end, step, dtype=to_jax_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=to_jax_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    def _v(v):
+        return v.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)),
+                               base=_v(base), dtype=to_jax_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype="float32", name=None) -> Tensor:
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=to_jax_dtype(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    x = as_tensor(x)
+
+    def fn(a):
+        if a.ndim == 1:
+            out = jnp.diag(a, k=offset)
+            if padding_value != 0:
+                mask = jnp.diag(jnp.ones_like(a, dtype=bool), k=offset)
+                out = jnp.where(mask, out, jnp.asarray(padding_value, a.dtype))
+            return out
+        return jnp.diagonal(a, offset=offset)
+    return apply(fn, x, name="diag")
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    x = as_tensor(x)
+    return apply(lambda a: jnp.diagflat(a, k=offset), x, name="diagflat")
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    x = as_tensor(x)
+    return apply(lambda a: jnp.tril(a, k=diagonal), x, name="tril")
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    x = as_tensor(x)
+    return apply(lambda a: jnp.triu(a, k=diagonal), x, name="triu")
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64") -> Tensor:
+    col = row if col is None else col
+    r, c = np.tril_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=to_jax_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64") -> Tensor:
+    col = row if col is None else col
+    r, c = np.triu_indices(row, offset, col)
+    return Tensor(jnp.asarray(np.stack([r, c]), dtype=to_jax_dtype(dtype)))
+
+
+def meshgrid(*args, name=None):
+    args = [as_tensor(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = apply(lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")),
+                 *args, n_outputs=len(args), name="meshgrid")
+    return list(outs)
+
+
+def assign(x, output=None) -> Tensor:
+    x = as_tensor(x)
+    out = apply(lambda a: a + 0, x, name="assign")
+    if output is not None:
+        output.set_data(out._data)
+        output._node = out._node
+        output._out_idx = out._out_idx
+        output.stop_gradient = out.stop_gradient
+        return output
+    return out
+
+
+def clone(x, name=None) -> Tensor:
+    return assign(x)
+
+
+def complex(real, imag, name=None) -> Tensor:
+    return apply(lambda r, i: jax.lax.complex(r, i), as_tensor(real),
+                 as_tensor(imag), name="complex")
+
+
+def polar(abs, angle, name=None) -> Tensor:
+    return apply(lambda a, t: a * jnp.exp(1j * t.astype(jnp.complex64)),
+                 as_tensor(abs), as_tensor(angle), name="polar")
+
+
+def one_hot(x, num_classes, name=None) -> Tensor:
+    x = as_tensor(x)
+    return Tensor(jax.nn.one_hot(x._data, num_classes, dtype=jnp.float32))

@@ -1,0 +1,15 @@
+"""einsum (paddle/tensor/einsum.py parity, UNVERIFIED)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import apply
+from .common import as_tensor
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands, name=None):
+    ts = [as_tensor(o) for o in operands]
+    return apply(lambda *xs: jnp.einsum(equation, *xs), *ts, name="einsum")

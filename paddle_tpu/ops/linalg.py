@@ -1,0 +1,287 @@
+"""Linear algebra ops (paddle/tensor/linalg.py parity, UNVERIFIED).
+
+Matmuls are the MXU path: ``matmul`` honors the global matmul precision flag
+and the AMP auto-cast policy (bf16-first on TPU).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply
+from ..framework import flags
+from .common import as_tensor
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "t", "transpose_matmul", "dist", "norm",
+    "cond", "cross", "cholesky", "cholesky_solve", "eig", "eigh", "eigvals",
+    "eigvalsh", "det", "slogdet", "inv", "pinv", "matrix_power", "matrix_rank",
+    "mv", "multi_dot", "qr", "lu", "svd", "solve", "triangular_solve",
+    "lstsq", "corrcoef", "cov", "histogram", "bincount", "householder_product",
+]
+
+
+def _precision():
+    p = flags.flag("FLAGS_tpu_matmul_precision")
+    return {"default": None, "high": jax.lax.Precision.HIGH,
+            "highest": jax.lax.Precision.HIGHEST}.get(p, None)
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    from ..amp.auto_cast import maybe_cast_matmul
+    x, y = maybe_cast_matmul(x, y)
+
+    def fn(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b, precision=_precision())
+    return apply(fn, x, y, name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: (a * b).sum(-1), as_tensor(x), as_tensor(y),
+                 name="dot")
+
+
+def mv(x, vec, name=None):
+    return apply(lambda a, b: a @ b, as_tensor(x), as_tensor(vec), name="mv")
+
+
+def t(input, name=None):
+    input = as_tensor(input)
+    if input.ndim < 2:
+        return apply(lambda a: a, input, name="t")
+    return apply(lambda a: a.T, input, name="t")
+
+
+def transpose_matmul(x, y, name=None):
+    return matmul(x, y, transpose_x=True)
+
+
+def dist(x, y, p=2, name=None):
+    def fn(a, b):
+        d = jnp.abs(a - b).reshape(-1)
+        if p == float("inf"):
+            return jnp.max(d)
+        if p == float("-inf"):
+            return jnp.min(d)
+        if p == 0:
+            return jnp.sum(d != 0).astype(a.dtype)
+        return jnp.sum(d ** p) ** (1.0 / p)
+    return apply(fn, as_tensor(x), as_tensor(y), name="dist")
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+
+    def fn(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=_ax(axis),
+                                   keepdims=keepdim)
+        if p == float("inf") or p == float("-inf"):
+            if axis is None:
+                d = jnp.abs(a).reshape(-1)
+                return jnp.max(d) if p > 0 else jnp.min(d)
+            return jnp.linalg.norm(a, ord=p, axis=_ax(axis), keepdims=keepdim)
+        if axis is None:
+            d = jnp.abs(a).reshape(-1)
+            return jnp.sum(d ** p) ** (1.0 / p)
+        return jnp.linalg.norm(a, ord=p, axis=_ax(axis), keepdims=keepdim)
+    return apply(fn, x, name="norm")
+
+
+def _ax(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis) if axis is not None else None
+
+
+def cond(x, p=None, name=None):
+    return apply(lambda a: jnp.linalg.cond(a, p=p), as_tensor(x), name="cond")
+
+
+def cross(x, y, axis=9, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+    ax = axis
+    if ax == 9:  # paddle default: first axis with dim 3
+        ax = next(i for i, s in enumerate(x.shape) if s == 3)
+    return apply(lambda a, b: jnp.cross(a, b, axis=int(ax)), x, y,
+                 name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    return apply(lambda a: jnp.linalg.cholesky(
+        jnp.swapaxes(a, -1, -2) if upper else a).swapaxes(-1, -2)
+        if upper else jnp.linalg.cholesky(a), as_tensor(x), name="cholesky")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def fn(b, l):
+        ll = jnp.swapaxes(l, -1, -2) if upper else l
+        z = jax.scipy.linalg.solve_triangular(ll, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(ll, -1, -2), z, lower=False)
+    return apply(fn, as_tensor(x), as_tensor(y), name="cholesky_solve")
+
+
+def eig(x, name=None):
+    x = as_tensor(x)
+    import numpy as np
+    w, v = np.linalg.eig(np.asarray(x._data))
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigh(x, UPLO="L", name=None):
+    outs = apply(lambda a: jnp.linalg.eigh(a, UPLO=UPLO), as_tensor(x),
+                 n_outputs=2, name="eigh")
+    return outs[0], outs[1]
+
+
+def eigvals(x, name=None):
+    import numpy as np
+    x = as_tensor(x)
+    return Tensor(jnp.asarray(np.linalg.eigvals(np.asarray(x._data))))
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply(lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), as_tensor(x),
+                 name="eigvalsh")
+
+
+def det(x, name=None):
+    return apply(jnp.linalg.det, as_tensor(x), name="det")
+
+
+def slogdet(x, name=None):
+    outs = apply(lambda a: tuple(jnp.linalg.slogdet(a)), as_tensor(x),
+                 n_outputs=2, name="slogdet")
+    from .manipulation import stack
+    return stack([outs[0], outs[1]], axis=0)
+
+
+def inv(x, name=None):
+    return apply(jnp.linalg.inv, as_tensor(x), name="inv")
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply(lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                           hermitian=hermitian),
+                 as_tensor(x), name="pinv")
+
+
+def matrix_power(x, n, name=None):
+    return apply(lambda a: jnp.linalg.matrix_power(a, n), as_tensor(x),
+                 name="matrix_power")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return Tensor(jnp.linalg.matrix_rank(as_tensor(x)._data, rtol=tol))
+
+
+def multi_dot(x, name=None):
+    ts = [as_tensor(t_) for t_ in x]
+    return apply(lambda *xs: jnp.linalg.multi_dot(xs), *ts, name="multi_dot")
+
+
+def qr(x, mode="reduced", name=None):
+    outs = apply(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), as_tensor(x),
+                 n_outputs=2, name="qr")
+    return outs[0], outs[1]
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    x = as_tensor(x)
+    lu_, piv = apply(lambda a: tuple(jax.scipy.linalg.lu_factor(a)), x,
+                     n_outputs=2, name="lu")
+    piv = Tensor((piv._data + 1).astype(jnp.int32))
+    if get_infos:
+        info = Tensor(jnp.zeros((), jnp.int32))
+        return lu_, piv, info
+    return lu_, piv
+
+
+def svd(x, full_matrices=False, name=None):
+    outs = apply(lambda a: tuple(jnp.linalg.svd(
+        a, full_matrices=full_matrices)), as_tensor(x), n_outputs=3,
+        name="svd")
+    return outs[0], outs[1], outs[2]
+
+
+def solve(x, y, name=None):
+    return apply(jnp.linalg.solve, as_tensor(x), as_tensor(y), name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def fn(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply(fn, as_tensor(x), as_tensor(y), name="triangular_solve")
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    outs = apply(lambda a, b: tuple(jnp.linalg.lstsq(a, b, rcond=rcond)),
+                 as_tensor(x), as_tensor(y), n_outputs=4, name="lstsq")
+    return outs
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply(lambda a: jnp.corrcoef(a, rowvar=rowvar), as_tensor(x),
+                 name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply(lambda a: jnp.cov(a, rowvar=rowvar,
+                                   ddof=1 if ddof else 0),
+                 as_tensor(x), name="cov")
+
+
+def histogram(input, bins=100, min=0, max=0, name=None):
+    input = as_tensor(input)
+    lo, hi = min, max
+    if lo == 0 and hi == 0:
+        lo = float(jnp.min(input._data))
+        hi = float(jnp.max(input._data))
+    hist, _ = jnp.histogram(input._data, bins=bins, range=(lo, hi))
+    return Tensor(hist.astype(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    x = as_tensor(x)
+    w = as_tensor(weights)._data if weights is not None else None
+    import numpy as np
+    out = np.bincount(np.asarray(x._data), weights=np.asarray(w) if w is not None else None,
+                      minlength=minlength)
+    return Tensor(jnp.asarray(out))
+
+
+def householder_product(x, tau, name=None):
+    def fn(a, t_):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+        q = jnp.broadcast_to(eye, a.shape[:-2] + (m, m)).copy() \
+            if a.ndim > 2 else eye
+        for i in range(n):
+            v = jnp.concatenate([
+                jnp.zeros(a.shape[:-2] + (i,), a.dtype),
+                jnp.ones(a.shape[:-2] + (1,), a.dtype),
+                a[..., i + 1:, i]], axis=-1)
+            vv = v[..., :, None] * v[..., None, :]
+            q = q @ (jnp.eye(m, dtype=a.dtype) - t_[..., i, None, None] * vv)
+        return q[..., :, :n] if m >= n else q
+    return apply(fn, as_tensor(x), as_tensor(tau), name="householder_product")

@@ -1,0 +1,384 @@
+"""Elementwise math + reductions (paddle/tensor/math.py parity, UNVERIFIED)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import (Tensor, apply, to_jax_dtype, tape_alias, tape_rebind)
+from .common import as_tensor, unary, binary
+
+__all__ = [
+    # binary
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "pow", "maximum", "minimum", "fmax", "fmin", "atan2",
+    "logaddexp", "heaviside", "copysign", "nextafter", "ldexp", "hypot",
+    "gcd", "lcm", "inner", "outer", "kron",
+    # unary
+    "abs", "acos", "acosh", "asin", "asinh", "atan", "atanh", "ceil", "cos",
+    "cosh", "deg2rad", "digamma", "erf", "erfinv", "exp", "expm1", "floor",
+    "frac", "lgamma", "log", "log10", "log1p", "log2", "logit", "neg",
+    "rad2deg", "reciprocal", "round", "rsqrt", "sigmoid", "sign", "sgn",
+    "sin", "sinh", "sqrt", "square", "tan", "tanh", "trunc", "angle",
+    "conj", "real", "imag", "i0", "i0e", "i1", "i1e", "polygamma",
+    "isfinite", "isinf", "isnan", "nan_to_num",
+    # reductions
+    "sum", "mean", "max", "min", "prod", "amax", "amin", "all", "any",
+    "logsumexp", "nansum", "nanmean", "count_nonzero",
+    # scans
+    "cumsum", "cumprod", "cummax", "cummin", "logcumsumexp",
+    # other
+    "clip", "lerp", "addmm", "trace", "diagonal", "multiplex",
+    "scale", "stanh", "softplus", "increment", "isclose", "allclose",
+    "floor_mod", "divide_no_nan",
+]
+
+# ---- binary ---------------------------------------------------------------
+
+add = binary(jnp.add, "add")
+subtract = binary(jnp.subtract, "subtract")
+multiply = binary(jnp.multiply, "multiply")
+divide = binary(jnp.divide, "divide")
+floor_divide = binary(jnp.floor_divide, "floor_divide")
+mod = binary(jnp.mod, "mod")
+remainder = mod
+floor_mod = mod
+maximum = binary(jnp.maximum, "maximum")
+minimum = binary(jnp.minimum, "minimum")
+fmax = binary(jnp.fmax, "fmax")
+fmin = binary(jnp.fmin, "fmin")
+atan2 = binary(jnp.arctan2, "atan2")
+logaddexp = binary(jnp.logaddexp, "logaddexp")
+heaviside = binary(jnp.heaviside, "heaviside")
+copysign = binary(jnp.copysign, "copysign")
+nextafter = binary(jnp.nextafter, "nextafter")
+hypot = binary(jnp.hypot, "hypot")
+gcd = binary(jnp.gcd, "gcd")
+lcm = binary(jnp.lcm, "lcm")
+
+
+def pow(x, y, name=None):
+    return binary(jnp.power, "pow")(x, y)
+
+
+def ldexp(x, y, name=None):
+    return apply(lambda a, b: a * (2.0 ** b.astype(jnp.float32)),
+                 as_tensor(x), as_tensor(y), name="ldexp")
+
+
+def divide_no_nan(x, y, name=None):
+    return apply(lambda a, b: jnp.where(b == 0, jnp.zeros_like(a + b), a / b),
+                 as_tensor(x), as_tensor(y), name="divide_no_nan")
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, as_tensor(x), as_tensor(y), name="inner")
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), as_tensor(x), as_tensor(y),
+                 name="outer")
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, as_tensor(x), as_tensor(y), name="kron")
+
+
+# ---- unary ----------------------------------------------------------------
+
+abs = unary(jnp.abs, "abs")
+acos = unary(jnp.arccos, "acos")
+acosh = unary(jnp.arccosh, "acosh")
+asin = unary(jnp.arcsin, "asin")
+asinh = unary(jnp.arcsinh, "asinh")
+atan = unary(jnp.arctan, "atan")
+atanh = unary(jnp.arctanh, "atanh")
+ceil = unary(jnp.ceil, "ceil")
+cos = unary(jnp.cos, "cos")
+cosh = unary(jnp.cosh, "cosh")
+deg2rad = unary(jnp.deg2rad, "deg2rad")
+digamma = unary(jax.scipy.special.digamma, "digamma")
+erf = unary(jax.scipy.special.erf, "erf")
+erfinv = unary(jax.scipy.special.erfinv, "erfinv")
+exp = unary(jnp.exp, "exp")
+expm1 = unary(jnp.expm1, "expm1")
+floor = unary(jnp.floor, "floor")
+frac = unary(lambda a: a - jnp.trunc(a), "frac")
+lgamma = unary(jax.scipy.special.gammaln, "lgamma")
+log = unary(jnp.log, "log")
+log10 = unary(jnp.log10, "log10")
+log1p = unary(jnp.log1p, "log1p")
+log2 = unary(jnp.log2, "log2")
+neg = unary(jnp.negative, "neg")
+rad2deg = unary(jnp.rad2deg, "rad2deg")
+reciprocal = unary(jnp.reciprocal, "reciprocal")
+round = unary(jnp.round, "round")
+rsqrt = unary(jax.lax.rsqrt, "rsqrt")
+sigmoid = unary(jax.nn.sigmoid, "sigmoid")
+sign = unary(jnp.sign, "sign")
+sgn = sign
+sin = unary(jnp.sin, "sin")
+sinh = unary(jnp.sinh, "sinh")
+sqrt = unary(jnp.sqrt, "sqrt")
+square = unary(jnp.square, "square")
+tan = unary(jnp.tan, "tan")
+tanh = unary(jnp.tanh, "tanh")
+trunc = unary(jnp.trunc, "trunc")
+angle = unary(jnp.angle, "angle")
+conj = unary(jnp.conj, "conj")
+real = unary(jnp.real, "real")
+imag = unary(jnp.imag, "imag")
+i0 = unary(jax.scipy.special.i0, "i0")
+i0e = unary(jax.scipy.special.i0e, "i0e")
+i1 = unary(jax.scipy.special.i1, "i1")
+i1e = unary(jax.scipy.special.i1e, "i1e")
+isfinite = unary(jnp.isfinite, "isfinite")
+isinf = unary(jnp.isinf, "isinf")
+isnan = unary(jnp.isnan, "isnan")
+
+
+def logit(x, eps=None, name=None):
+    def fn(a):
+        b = a if eps is None else jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(b / (1.0 - b))
+    return apply(fn, as_tensor(x), name="logit")
+
+
+def polygamma(x, n, name=None):
+    return apply(lambda a: jax.scipy.special.polygamma(n, a), as_tensor(x),
+                 name="polygamma")
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                          neginf=neginf),
+                 as_tensor(x), name="nan_to_num")
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply(lambda a: scale_b * jnp.tanh(scale_a * a), as_tensor(x),
+                 name="stanh")
+
+
+def softplus(x, beta=1, threshold=20, name=None):
+    def fn(a):
+        bx = beta * a
+        return jnp.where(bx > threshold, a, jnp.logaddexp(bx, 0.0) / beta)
+    return apply(fn, as_tensor(x), name="softplus")
+
+
+# ---- reductions -----------------------------------------------------------
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(jfn, name):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        x = as_tensor(x)
+
+        def fn(a):
+            out = jfn(a, axis=_axes(axis), keepdims=keepdim)
+            if dtype is not None:
+                out = out.astype(to_jax_dtype(dtype))
+            return out
+        return apply(fn, x, name=name)
+    op.__name__ = name
+    return op
+
+
+sum = _reduce(jnp.sum, "sum")
+mean = _reduce(jnp.mean, "mean")
+prod = _reduce(jnp.prod, "prod")
+amax = _reduce(jnp.max, "amax")
+amin = _reduce(jnp.min, "amin")
+nansum = _reduce(jnp.nansum, "nansum")
+nanmean = _reduce(jnp.nanmean, "nanmean")
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.max, "max")(x, axis, keepdim)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return _reduce(jnp.min, "min")(x, axis, keepdim)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.all(x._data, axis=_axes(axis), keepdims=keepdim))
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.any(x._data, axis=_axes(axis), keepdims=keepdim))
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    return apply(lambda a: jax.scipy.special.logsumexp(
+        a, axis=_axes(axis), keepdims=keepdim), x, name="logsumexp")
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    x = as_tensor(x)
+    return Tensor(jnp.count_nonzero(x._data, axis=_axes(axis),
+                                    keepdims=keepdim))
+
+
+# ---- scans ----------------------------------------------------------------
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    x = as_tensor(x)
+
+    def fn(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=to_jax_dtype(dtype))
+        return jnp.cumsum(a, axis=int(axis), dtype=to_jax_dtype(dtype))
+    return apply(fn, x, name="cumsum")
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    x = as_tensor(x)
+
+    def fn(a):
+        if dim is None:
+            a = a.reshape(-1)
+            return jnp.cumprod(a, dtype=to_jax_dtype(dtype))
+        return jnp.cumprod(a, axis=int(dim), dtype=to_jax_dtype(dtype))
+    return apply(fn, x, name="cumprod")
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    ax = 0 if axis is None else int(axis)
+    data = x._data.reshape(-1) if axis is None else x._data
+    vals = jax.lax.associative_scan(jnp.maximum, data, axis=ax)
+    idx_src = jnp.arange(data.shape[ax]).reshape(
+        [-1 if i == ax % data.ndim else 1 for i in range(data.ndim)])
+    idx_src = jnp.broadcast_to(idx_src, data.shape)
+
+    def take_pair(a, b):
+        av, ai = a
+        bv, bi = b
+        keep = av >= bv
+        return jnp.where(keep, av, bv), jnp.where(keep, ai, bi)
+    _, idx = jax.lax.associative_scan(take_pair, (data, idx_src), axis=ax)
+    return Tensor(vals), Tensor(idx.astype(to_jax_dtype(dtype)))
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    x = as_tensor(x)
+    neg_vals, idx = cummax(Tensor(-x._data), axis=axis, dtype=dtype)
+    return Tensor(-neg_vals._data), idx
+
+
+def logcumsumexp(x, axis=None, name=None):
+    x = as_tensor(x)
+
+    def fn(a):
+        if axis is None:
+            b, ax = a.reshape(-1), 0
+        else:
+            b, ax = a, int(axis)
+        mx = jnp.max(b, axis=ax, keepdims=True)
+        return jnp.log(jnp.cumsum(jnp.exp(b - mx), axis=ax)) + mx
+    return apply(fn, x, name="logcumsumexp")
+
+
+# ---- other ----------------------------------------------------------------
+
+def clip(x, min=None, max=None, name=None):
+    x = as_tensor(x)
+    lo = min.item() if isinstance(min, Tensor) and min.ndim == 0 else min
+    hi = max.item() if isinstance(max, Tensor) and max.ndim == 0 else max
+    if isinstance(lo, Tensor) or isinstance(hi, Tensor):
+        args = [x]
+        def fn(a, *mm):
+            i = 0
+            l, h = lo, hi
+            if isinstance(lo, Tensor):
+                l = mm[i]; i += 1
+            if isinstance(hi, Tensor):
+                h = mm[i]
+            return jnp.clip(a, l, h)
+        extra = [t for t in (lo, hi) if isinstance(t, Tensor)]
+        return apply(fn, x, *extra, name="clip")
+    return apply(lambda a: jnp.clip(a, lo, hi), x, name="clip")
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return apply(lambda a, b, w: a + w * (b - a), as_tensor(x),
+                     as_tensor(y), weight, name="lerp")
+    return apply(lambda a, b: a + weight * (b - a), as_tensor(x),
+                 as_tensor(y), name="lerp")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b),
+                 as_tensor(input), as_tensor(x), as_tensor(y), name="addmm")
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.trace(a, offset=offset, axis1=axis1,
+                                     axis2=axis2), as_tensor(x), name="trace")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                        axis2=axis2),
+                 as_tensor(x), name="diagonal")
+
+
+def multiplex(inputs, index, name=None):
+    ins = [as_tensor(i) for i in inputs]
+    idx = as_tensor(index)
+
+    def fn(ix, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        sel = ix.reshape(-1).astype(jnp.int32)
+        return jnp.take_along_axis(
+            stacked, sel[None, :].reshape((1, -1) + (1,) * (stacked.ndim - 2)),
+            axis=0)[0]
+    return apply(fn, idx, *ins, name="multiplex")
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    x = as_tensor(x)
+    if isinstance(scale, Tensor):
+        def fn(a, s):
+            return a * s + bias if bias_after_scale else (a + bias) * s
+        out = apply(fn, x, scale, name="scale")
+    else:
+        def fn(a):
+            return a * scale + bias if bias_after_scale else (a + bias) * scale
+        out = apply(fn, x, name="scale")
+    if act is not None:
+        from ..nn import functional as F
+        out = getattr(F, act)(out)
+    return out
+
+
+def increment(x, value=1.0, name=None):
+    if isinstance(x, Tensor):
+        out = apply(lambda a: a + value, tape_alias(x), name="increment")
+        return tape_rebind(x, out)
+    return apply(lambda a: a + value, as_tensor(x), name="increment")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.isclose(as_tensor(x)._data, as_tensor(y)._data,
+                              rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return Tensor(jnp.allclose(as_tensor(x)._data, as_tensor(y)._data,
+                               rtol=rtol, atol=atol, equal_nan=equal_nan))

@@ -1,0 +1,210 @@
+"""Flash attention Pallas kernel for TPU.
+
+Role of the reference's flash-attn CUDA integration
+(phi fused attention kernels, UNVERIFIED). Layout: [B, S, H, D] in/out
+(paddle convention); internally blocks over (batch*heads, q_blocks) with an
+online-softmax accumulation loop over kv blocks — the classic TPU flash
+forward. Backward is a blockwise lax.scan recompute using the saved
+log-sum-exp: memory stays O(S·D) (no S×S materialization) while XLA fuses
+the per-block matmuls onto the MXU; a fully hand-scheduled Pallas backward
+is a later optimization (PAPERS.md Liger-style).
+
+GQA/MQA (fewer kv heads than q heads) is handled by repeating kv heads."""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["flash_attention", "flash_attention_reference"]
+
+_NEG_INF = -1e30
+
+
+def flash_attention_reference(q, k, v, causal=False, scale=None):
+    """[B, S, H, D] reference (fp32 softmax)."""
+    d = q.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * s
+    if causal:
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_q, block_k, seq_len_q, seq_len_k):
+    qi = pl.program_id(1)
+    q = q_ref[:].astype(jnp.float32) * scale  # [block_q, d]
+    # bottom-right-aligned causal offset (standard flash/decode semantics):
+    # query i may see keys k_pos <= i + (seq_len_k - seq_len_q)
+    causal_offset = seq_len_k - seq_len_q
+
+    def body(start_k, carry):
+        acc, m_prev, l_prev = carry
+        k = pl.load(k_ref, (pl.dslice(start_k * block_k, block_k),
+                            slice(None))).astype(jnp.float32)
+        v = pl.load(v_ref, (pl.dslice(start_k * block_k, block_k),
+                            slice(None))).astype(jnp.float32)
+        s = q @ k.T  # [block_q, block_k]
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0)
+            k_pos = start_k * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + p @ v
+        return acc, m_new, l_new
+
+    d = q_ref.shape[-1]
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    if causal:
+        # only kv blocks up to this q block's last visible key participate
+        last_visible = (qi + 1) * block_q + causal_offset
+        num_k = jnp.clip(
+            jax.lax.div(last_visible + block_k - 1, block_k),
+            0, seq_len_k // block_k)
+    else:
+        num_k = seq_len_k // block_k
+    acc, m, l = jax.lax.fori_loop(0, num_k, body, (acc0, m0, l0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[:] = m + jnp.log(l)
+
+
+def _pick_block(seq_len, preferred):
+    b = min(preferred, seq_len)
+    while seq_len % b:
+        b //= 2
+    return max(b, 1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_attention(q, k, v, causal=False, scale=None):
+    out, _ = _flash_fwd(q, k, v, causal, scale)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    from ...framework import flags
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    hk = k.shape[2]
+    if hk != h:  # GQA: repeat kv heads
+        rep = h // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    block_q = _pick_block(sq, int(flags.flag("FLAGS_flash_attn_block_q")))
+    block_k = _pick_block(sk, int(flags.flag("FLAGS_flash_attn_block_kv")))
+    # [B, S, H, D] -> [B*H, S, D]
+    qh = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
+    kh = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    vh = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
+    grid = (b * h, sq // block_q)
+    out, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, scale=s, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len_q=sq,
+                          seq_len_k=sk),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
+            pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+    )(qh, kh, vh)
+    out4 = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out4, lse
+
+
+def _fwd_rule(q, k, v, causal, scale):
+    out, lse = _flash_fwd(q, k, v, causal, scale)
+    return out, (q, k, v, out, lse)
+
+
+def _bwd_rule(causal, scale, res, g):
+    """Blockwise recompute backward (fp32 accumulation, O(S·D) memory)."""
+    q, k, v, out, lse = res
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    hk = k.shape[2]
+    rep = h // hk
+    if rep != 1:
+        k_full = jnp.repeat(k, rep, axis=2)
+        v_full = jnp.repeat(v, rep, axis=2)
+    else:
+        k_full, v_full = k, v
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    # [B,H,S,D] fp32
+    qh = q.transpose(0, 2, 1, 3).astype(jnp.float32)
+    kh = k_full.transpose(0, 2, 1, 3).astype(jnp.float32)
+    vh = v_full.transpose(0, 2, 1, 3).astype(jnp.float32)
+    gh = g.transpose(0, 2, 1, 3).astype(jnp.float32)
+    oh = out.transpose(0, 2, 1, 3).astype(jnp.float32)
+    lse_h = lse.reshape(b, h, sq)
+    delta = jnp.sum(gh * oh, axis=-1)  # [B,H,Sq]
+
+    block = 512
+    while sk % block and block > 1:
+        block //= 2
+    n_blocks = sk // block
+
+    def kv_block(carry, i):
+        dq_acc = carry
+        ks = jax.lax.dynamic_slice_in_dim(kh, i * block, block, 2)
+        vs = jax.lax.dynamic_slice_in_dim(vh, i * block, block, 2)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qh, ks) * s
+        if causal:
+            q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, block), 0)
+            k_pos = i * block + jax.lax.broadcasted_iota(
+                jnp.int32, (sq, block), 1)
+            # bottom-right aligned, matching the forward kernel
+            logits = jnp.where(
+                (q_pos + (sk - sq))[None, None] >= k_pos[None, None],
+                logits, _NEG_INF)
+        p = jnp.exp(logits - lse_h[..., None])  # [B,H,Sq,block]
+        dv_i = jnp.einsum("bhqk,bhqd->bhkd", p, gh)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", gh, vs)
+        ds = p * (dp - delta[..., None]) * s
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, ks)
+        dk_i = jnp.einsum("bhqk,bhqd->bhkd", ds, qh)
+        return dq_acc, (dk_i, dv_i)
+
+    dq0 = jnp.zeros_like(qh)
+    dq, (dk_blocks, dv_blocks) = jax.lax.scan(
+        kv_block, dq0, jnp.arange(n_blocks))
+    # [n_blocks, B, H, block, D] -> [B, H, Sk, D]
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, sk, d)
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, sk, d)
+    if rep != 1:  # sum over repeated query-head groups
+        dk = dk.reshape(b, hk, rep, sk, d).sum(2)
+        dv = dv.reshape(b, hk, rep, sk, d).sum(2)
+    dq4 = dq.transpose(0, 2, 1, 3).astype(q.dtype)
+    dk4 = dk.transpose(0, 2, 1, 3).astype(k.dtype)
+    dv4 = dv.transpose(0, 2, 1, 3).astype(v.dtype)
+    return dq4, dk4, dv4
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)

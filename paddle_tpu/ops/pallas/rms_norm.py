@@ -1,0 +1,103 @@
+"""Fused RMSNorm Pallas kernel (role of phi fused rms_norm, UNVERIFIED).
+
+Forward is a row-wise reduction + scale — one VMEM pass per block of rows.
+Backward uses a custom VJP with a fused Pallas kernel for dx and an XLA
+reduction for dw (dw is a full-rows reduction; XLA's tree reduction over
+HBM is already optimal for it)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+__all__ = ["rms_norm", "rms_norm_reference"]
+
+
+def rms_norm_reference(x, w, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    return (xf * jax.lax.rsqrt(ms + eps)).astype(x.dtype) * w
+
+
+def _fwd_kernel(x_ref, w_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    normed = x * jax.lax.rsqrt(ms + eps)
+    o_ref[:] = (normed * w_ref[:].astype(jnp.float32)).astype(o_ref.dtype)
+
+
+def _dx_kernel(x_ref, w_ref, g_ref, o_ref, *, eps):
+    x = x_ref[:].astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    d = x.shape[-1]
+    ms = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    gw = g * w
+    # dx = inv * gw - x * inv^3 * mean(gw * x)
+    dot = jnp.mean(gw * x, axis=-1, keepdims=True)
+    o_ref[:] = (inv * gw - x * (inv ** 3) * dot).astype(o_ref.dtype)
+
+
+def _rows_block(n_rows: int) -> int:
+    for b in (256, 128, 64, 32, 16, 8):
+        if n_rows % b == 0:
+            return b
+    return 1
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def rms_norm(x, w, eps=1e-6):
+    return _rms_fwd_impl(x, w, eps)
+
+
+def _rms_fwd_impl(x, w, eps):
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    x2 = x.reshape(-1, d)
+    n = x2.shape[0]
+    blk = _rows_block(n)
+    out = pl.pallas_call(
+        functools.partial(_fwd_kernel, eps=eps),
+        grid=(n // blk,),
+        in_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                  pl.BlockSpec((d,), lambda i: (0,))],
+        out_specs=pl.BlockSpec((blk, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+    )(x2, w)
+    return out.reshape(orig_shape)
+
+
+def _rms_fwd(x, w, eps):
+    return _rms_fwd_impl(x, w, eps), (x, w)
+
+
+def _rms_bwd(eps, res, g):
+    x, w = res
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    x2 = x.reshape(-1, d)
+    g2 = g.reshape(-1, d)
+    n = x2.shape[0]
+    blk = _rows_block(n)
+    dx = pl.pallas_call(
+        functools.partial(_dx_kernel, eps=eps),
+        grid=(n // blk,),
+        in_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                  pl.BlockSpec((d,), lambda i: (0,)),
+                  pl.BlockSpec((blk, d), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((blk, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
+    )(x2, w, g2)
+    # dw: reduction over all rows — XLA's job
+    xf = x2.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(ms + eps)
+    dw = jnp.sum(g2.astype(jnp.float32) * normed, axis=0).astype(w.dtype)
+    return dx.reshape(orig_shape), dw
+
+
+rms_norm.defvjp(_rms_fwd, _rms_bwd)

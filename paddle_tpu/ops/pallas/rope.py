@@ -1,0 +1,59 @@
+"""Rotary position embedding (RoPE).
+
+Reference: phi fused_rope kernel (UNVERIFIED). On TPU the rotate+multiply
+is bandwidth-bound elementwise work that XLA fuses into the surrounding
+matmuls, so the jnp formulation IS the fused kernel; a bespoke Pallas kernel
+buys nothing here (measured wisdom from the pallas guide: don't hand-write
+what XLA already fuses)."""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+
+__all__ = ["build_sin_cos", "apply_rope", "rope_reference"]
+
+
+@functools.lru_cache(maxsize=32)
+def _sin_cos_np(seq_len: int, dim: int, base: float):
+    import numpy as np
+    inv = 1.0 / (base ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    t = np.arange(seq_len, dtype=np.float64)
+    freqs = np.outer(t, inv)  # [S, D/2]
+    return np.sin(freqs), np.cos(freqs)
+
+
+def build_sin_cos(seq_len, dim, base=10000.0, dtype=jnp.float32):
+    s, c = _sin_cos_np(int(seq_len), int(dim), float(base))
+    return jnp.asarray(s, jnp.float32), jnp.asarray(c, jnp.float32)
+
+
+def apply_rope(x, sin, cos, position_ids=None, neox=True):
+    """x: [B, S, H, D]; sin/cos: [S, D/2] (fp32). Returns same dtype as x."""
+    orig_dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    if position_ids is not None:
+        sin = jnp.take(sin, position_ids, axis=0)  # [B, S, D/2]
+        cos = jnp.take(cos, position_ids, axis=0)
+        sin = sin[:, :, None, :]
+        cos = cos[:, :, None, :]
+    else:
+        sin = sin[None, :, None, :]
+        cos = cos[None, :, None, :]
+    d2 = xf.shape[-1] // 2
+    if neox:
+        x1 = xf[..., :d2]
+        x2 = xf[..., d2:]
+        out = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    else:
+        x1 = xf[..., 0::2]
+        x2 = xf[..., 1::2]
+        r1 = x1 * cos - x2 * sin
+        r2 = x2 * cos + x1 * sin
+        out = jnp.stack([r1, r2], axis=-1).reshape(xf.shape)
+    return out.astype(orig_dtype)
+
+
+rope_reference = apply_rope

@@ -1,0 +1,146 @@
+"""Random sampling ops (paddle/tensor/random.py parity, UNVERIFIED).
+
+All draws go through the global ``Generator`` (framework.random), which
+splits a jax PRNG key per call — so randomness is reproducible under
+``paddle_tpu.seed`` and functionalizes cleanly under to_static.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply, to_jax_dtype
+from ..framework import random as framework_random
+from .common import as_tensor
+from .creation import _shape
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "uniform", "normal",
+    "standard_normal", "gaussian", "multinomial", "randperm", "bernoulli",
+    "poisson", "exponential_", "uniform_", "normal_", "binomial",
+    "standard_gamma", "log_normal",
+]
+
+
+def _key():
+    return framework_random.default_generator.next_key()
+
+
+def uniform(shape, dtype="float32", min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else _key()
+    return Tensor(jax.random.uniform(key, _shape(shape),
+                                     to_jax_dtype(dtype or "float32"),
+                                     minval=min, maxval=max))
+
+
+def rand(shape, dtype=None, name=None):
+    return uniform(shape, dtype or "float32", 0.0, 1.0)
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(_key(), _shape(shape),
+                                    to_jax_dtype(dtype or "float32")))
+
+
+standard_normal = randn
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = as_tensor(mean) if not isinstance(mean, Tensor) else mean
+        s = as_tensor(std) if not isinstance(std, Tensor) else std
+        out_shape = tuple(m.shape) if isinstance(mean, Tensor) else tuple(s.shape)
+        noise = jax.random.normal(_key(), out_shape, jnp.float32)
+        return apply(lambda mm, ss: mm + ss * noise, m, s, name="normal")
+    shape = _shape(shape if shape is not None else [1])
+    return Tensor(mean + std * jax.random.normal(_key(), shape, jnp.float32))
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype="float32", name=None):
+    key = jax.random.PRNGKey(seed) if seed else _key()
+    return Tensor(mean + std * jax.random.normal(
+        key, _shape(shape), to_jax_dtype(dtype or "float32")))
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, name=None):
+    shape = _shape(shape if shape is not None else [1])
+    return Tensor(jnp.exp(mean + std * jax.random.normal(_key(), shape,
+                                                         jnp.float32)))
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    return Tensor(jax.random.randint(_key(), _shape(shape), low, high,
+                                     to_jax_dtype(dtype or "int64")))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    x = as_tensor(x)
+    return randint(low, high, x.shape, dtype or x.dtype)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    x = as_tensor(x)
+    probs = x._data / jnp.sum(x._data, axis=-1, keepdims=True)
+    logits = jnp.log(jnp.maximum(probs, 1e-38))
+    if replacement:
+        out = jax.random.categorical(_key(), logits,
+                                     shape=(num_samples,) + logits.shape[:-1]
+                                     if logits.ndim > 1 else (num_samples,))
+        out = jnp.moveaxis(out, 0, -1) if logits.ndim > 1 else out
+    else:
+        g = jax.random.gumbel(_key(), logits.shape)
+        _, out = jax.lax.top_k(logits + g, num_samples)
+    return Tensor(out.astype(jnp.int64))
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(_key(), n).astype(
+        to_jax_dtype(dtype or "int64")))
+
+
+def bernoulli(x, name=None):
+    x = as_tensor(x)
+    u = jax.random.uniform(_key(), tuple(x.shape))
+    return Tensor((u < x._data).astype(x.dtype))
+
+
+def binomial(count, prob, name=None):
+    count, prob = as_tensor(count), as_tensor(prob)
+    out = jax.random.binomial(_key(), count._data.astype(jnp.float32),
+                              prob._data)
+    return Tensor(out.astype(jnp.int64))
+
+
+def poisson(x, name=None):
+    x = as_tensor(x)
+    return Tensor(jax.random.poisson(_key(), x._data).astype(x.dtype))
+
+
+def standard_gamma(x, name=None):
+    x = as_tensor(x)
+    return Tensor(jax.random.gamma(_key(), x._data).astype(x.dtype))
+
+
+# ---- in-place samplers (tensor methods) -----------------------------------
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else _key()
+    x.set_data(jax.random.uniform(key, tuple(x.shape), x.dtype
+                                  if jnp.issubdtype(x.dtype, jnp.floating)
+                                  else jnp.float32, minval=min, maxval=max))
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x.set_data(mean + std * jax.random.normal(_key(), tuple(x.shape),
+                                              x.dtype))
+    return x
+
+
+def exponential_(x, lam=1.0, name=None):
+    u = jax.random.uniform(_key(), tuple(x.shape), x.dtype)
+    x.set_data(-jnp.log(1.0 - u) / lam)
+    return x

@@ -1,0 +1,162 @@
+"""Search/sort ops (paddle/tensor/search.py parity, UNVERIFIED)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply, to_jax_dtype
+from .common import as_tensor
+
+__all__ = [
+    "argmax", "argmin", "argsort", "sort", "searchsorted", "topk", "where",
+    "nonzero", "kthvalue", "mode", "index_sample", "masked_select", "bucketize",
+]
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = as_tensor(x)
+    d = jnp.argmax(x._data if axis is not None else x._data.reshape(-1),
+                   axis=axis)
+    if keepdim and axis is not None:
+        d = jnp.expand_dims(d, axis)
+    return Tensor(d.astype(to_jax_dtype(dtype)))
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    x = as_tensor(x)
+    d = jnp.argmin(x._data if axis is not None else x._data.reshape(-1),
+                   axis=axis)
+    if keepdim and axis is not None:
+        d = jnp.expand_dims(d, axis)
+    return Tensor(d.astype(to_jax_dtype(dtype)))
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    x = as_tensor(x)
+    d = jnp.argsort(x._data, axis=axis, stable=True,
+                    descending=descending)
+    return Tensor(d.astype(jnp.int64))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    x = as_tensor(x)
+
+    def fn(a):
+        s = jnp.sort(a, axis=axis, stable=True, descending=descending)
+        return s
+    return apply(fn, x, name="sort")
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    ss, v = as_tensor(sorted_sequence), as_tensor(values)
+    side = "right" if right else "left"
+
+    def fn(s, x):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, x, side=side)
+        flat_fn = lambda srow, xrow: jnp.searchsorted(srow, xrow, side=side)
+        for _ in range(s.ndim - 1):
+            flat_fn = jax.vmap(flat_fn)
+        return flat_fn(s, x)
+    out = fn(ss._data, v._data)
+    return Tensor(out.astype(jnp.int32 if out_int32 else jnp.int64))
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def topk(x, k, axis=None, largest=True, sorted=True, name=None):
+    x = as_tensor(x)
+    if isinstance(k, Tensor):
+        k = int(k.item())
+    ax = -1 if axis is None else int(axis)
+
+    def fn(a):
+        b = jnp.moveaxis(a, ax, -1)
+        vals, idx = jax.lax.top_k(b if largest else -b, k)
+        if not largest:
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx, -1, ax)
+
+    vals, idx = apply(fn, x, n_outputs=2, name="topk")
+    return vals, Tensor(idx._data.astype(jnp.int64))
+
+
+def where(condition, x=None, y=None, name=None):
+    condition = as_tensor(condition)
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    xt = x if isinstance(x, Tensor) else x
+    yt = y if isinstance(y, Tensor) else y
+    args = [t for t in (xt, yt) if isinstance(t, Tensor)]
+
+    def fn(c, *ts):
+        i = 0
+        xx, yy = xt, yt
+        if isinstance(xt, Tensor):
+            xx = ts[i]; i += 1
+        if isinstance(yt, Tensor):
+            yy = ts[i]
+        return jnp.where(c, xx, yy)
+    return apply(fn, condition, *args, name="where")
+
+
+def nonzero(x, as_tuple=False, name=None):
+    x = as_tensor(x)
+    idx = np.nonzero(np.asarray(x._data))
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i[:, None].astype(np.int64)))
+                     for i in idx)
+    return Tensor(jnp.asarray(np.stack(idx, axis=1).astype(np.int64)))
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    x = as_tensor(x)
+
+    def fn(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis, stable=True)
+        vals = jnp.take(s, k - 1, axis=axis)
+        idxs = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idxs = jnp.expand_dims(idxs, axis)
+        return vals, idxs
+    vals, idx = apply(fn, x, n_outputs=2, name="kthvalue")
+    return vals, Tensor(idx._data.astype(jnp.int64))
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    x = as_tensor(x)
+    data = np.asarray(x._data)
+    mv = np.moveaxis(data, axis, -1)
+    flat = mv.reshape(-1, mv.shape[-1])
+    vals = np.empty(flat.shape[0], dtype=data.dtype)
+    idxs = np.empty(flat.shape[0], dtype=np.int64)
+    for i, row in enumerate(flat):
+        uniq, counts = np.unique(row, return_counts=True)
+        # ties resolve to the largest value (np.unique sorts ascending)
+        best = uniq[counts == counts.max()][-1]
+        vals[i] = best
+        idxs[i] = np.where(row == best)[0][-1]
+    out_shape = mv.shape[:-1]
+    vals = vals.reshape(out_shape)
+    idxs = idxs.reshape(out_shape)
+    if keepdim:
+        vals = np.expand_dims(vals, axis)
+        idxs = np.expand_dims(idxs, axis)
+    return Tensor(jnp.asarray(vals)), Tensor(jnp.asarray(idxs))
+
+
+def index_sample(x, index):
+    from .manipulation import index_sample as _is
+    return _is(x, index)
+
+
+def masked_select(x, mask, name=None):
+    from .manipulation import masked_select as _ms
+    return _ms(x, mask)

@@ -1,0 +1,93 @@
+"""Statistics ops (paddle/tensor/stat.py parity, UNVERIFIED)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply
+from .common import as_tensor
+
+__all__ = ["std", "var", "median", "nanmedian", "quantile", "nanquantile",
+           "numel", "histogramdd"]
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = as_tensor(x)
+    return apply(lambda a: jnp.var(a, axis=_axes(axis),
+                                   ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, name="var")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    x = as_tensor(x)
+    return apply(lambda a: jnp.std(a, axis=_axes(axis),
+                                   ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x, name="std")
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = as_tensor(x)
+
+    def fn(a):
+        if mode == "avg":
+            return jnp.median(a, axis=_axes(axis), keepdims=keepdim)
+        # 'min' mode: lower of the two middles
+        ax = _axes(axis)
+        if ax is None:
+            s = jnp.sort(a.reshape(-1))
+            return s[(s.shape[0] - 1) // 2]
+        s = jnp.sort(a, axis=ax)
+        idx = (s.shape[ax] - 1) // 2
+        out = jnp.take(s, idx, axis=ax)
+        return jnp.expand_dims(out, ax) if keepdim else out
+    return apply(fn, x, name="median")
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    x = as_tensor(x)
+    return apply(lambda a: jnp.nanmedian(a, axis=_axes(axis),
+                                         keepdims=keepdim), x,
+                 name="nanmedian")
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    x = as_tensor(x)
+    qv = q.jax() if isinstance(q, Tensor) else jnp.asarray(q)
+
+    def fn(a):
+        return jnp.quantile(a, qv, axis=_axes(axis), keepdims=keepdim,
+                            method=interpolation)
+    return apply(fn, x, name="quantile")
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    x = as_tensor(x)
+    qv = q.jax() if isinstance(q, Tensor) else jnp.asarray(q)
+    return apply(lambda a: jnp.nanquantile(a, qv, axis=_axes(axis),
+                                           keepdims=keepdim,
+                                           method=interpolation),
+                 x, name="nanquantile")
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(as_tensor(x).size, dtype=jnp.int64))
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    x = as_tensor(x)
+    w = np.asarray(as_tensor(weights)._data) if weights is not None else None
+    hist, edges = np.histogramdd(np.asarray(x._data), bins=bins,
+                                 range=ranges, density=density, weights=w)
+    return Tensor(jnp.asarray(hist)), [Tensor(jnp.asarray(e)) for e in edges]

@@ -1,0 +1,196 @@
+"""Install paddle-style methods and operators on ``Tensor``.
+
+Paddle monkey-patches ``paddle.Tensor`` with the tensor-module functions
+(python/paddle/tensor/__init__.py `tensor_method_func`, UNVERIFIED); we do
+the same so ``x.sum(axis=1)``, ``x @ y``, ``x[...]`` behave identically.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import (Tensor, apply, to_jax_dtype, tape_alias,
+                              tape_rebind)
+from . import creation, linalg, logic, manipulation, math, search, stat, \
+    random_ops
+from .common import as_tensor
+
+
+def _binary_op(fn, reverse=False):
+    def op(self, other):
+        if reverse:
+            return fn(other, self)
+        return fn(self, other)
+    return op
+
+
+def _index_fn(item):
+    """Normalize a paddle-style index (may contain Tensors) to jax index."""
+    if isinstance(item, tuple):
+        return tuple(_index_fn(i) for i in item)
+    if isinstance(item, Tensor):
+        return item._data
+    if isinstance(item, list):
+        return jnp.asarray(item)
+    return item
+
+
+def _getitem(self, item):
+    idx = _index_fn(item)
+    if isinstance(idx, Tensor):
+        idx = idx._data
+    # boolean-mask indexing produces dynamic shapes → host fallback like
+    # paddle's masked_select
+    def has_bool(ix):
+        if isinstance(ix, tuple):
+            return any(has_bool(i) for i in ix)
+        return hasattr(ix, "dtype") and ix.dtype == jnp.bool_
+    if has_bool(idx):
+        import numpy as np
+        data = np.asarray(self._data)[
+            tuple(np.asarray(i) if hasattr(i, "dtype") else i for i in idx)
+            if isinstance(idx, tuple) else np.asarray(idx)]
+        return Tensor(jnp.asarray(data))
+    return apply(lambda a: a[idx], self, name="getitem")
+
+
+def _setitem(self, item, value):
+    idx = _index_fn(item)
+    alias = tape_alias(self)
+    if isinstance(value, Tensor):
+        out = apply(lambda a, v: a.at[idx].set(v.astype(a.dtype)), alias,
+                    value, name="setitem")
+    else:
+        out = apply(lambda a: a.at[idx].set(value), alias, name="setitem")
+    tape_rebind(self, out)
+
+
+def install_tensor_methods() -> None:
+    T = Tensor
+
+    # ---- operators --------------------------------------------------------
+    T.__add__ = _binary_op(math.add)
+    T.__radd__ = _binary_op(math.add, reverse=True)
+    T.__sub__ = _binary_op(math.subtract)
+    T.__rsub__ = _binary_op(math.subtract, reverse=True)
+    T.__mul__ = _binary_op(math.multiply)
+    T.__rmul__ = _binary_op(math.multiply, reverse=True)
+    T.__truediv__ = _binary_op(math.divide)
+    T.__rtruediv__ = _binary_op(math.divide, reverse=True)
+    T.__floordiv__ = _binary_op(math.floor_divide)
+    T.__rfloordiv__ = _binary_op(math.floor_divide, reverse=True)
+    T.__mod__ = _binary_op(math.mod)
+    T.__rmod__ = _binary_op(math.mod, reverse=True)
+    T.__pow__ = _binary_op(math.pow)
+    T.__rpow__ = _binary_op(math.pow, reverse=True)
+    T.__matmul__ = _binary_op(linalg.matmul)
+    T.__rmatmul__ = _binary_op(linalg.matmul, reverse=True)
+    T.__neg__ = lambda self: math.neg(self)
+    T.__abs__ = lambda self: math.abs(self)
+    T.__invert__ = lambda self: logic.logical_not(self) \
+        if self.dtype == jnp.bool_ else logic.bitwise_not(self)
+    T.__eq__ = _binary_op(logic.equal)
+    T.__ne__ = _binary_op(logic.not_equal)
+    T.__lt__ = _binary_op(logic.less_than)
+    T.__le__ = _binary_op(logic.less_equal)
+    T.__gt__ = _binary_op(logic.greater_than)
+    T.__ge__ = _binary_op(logic.greater_equal)
+    T.__and__ = _binary_op(logic.bitwise_and)
+    T.__or__ = _binary_op(logic.bitwise_or)
+    T.__xor__ = _binary_op(logic.bitwise_xor)
+    T.__lshift__ = _binary_op(logic.bitwise_left_shift)
+    T.__rshift__ = _binary_op(logic.bitwise_right_shift)
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+    # ---- methods from op modules -----------------------------------------
+    modules = [math, manipulation, linalg, logic, search, stat, creation,
+               random_ops]
+    skip = {"to_tensor", "zeros", "ones", "full", "empty", "arange",
+            "linspace", "logspace", "eye", "meshgrid", "tril_indices",
+            "triu_indices", "rand", "randn", "randint", "uniform", "normal",
+            "gaussian", "randperm", "standard_normal", "is_tensor",
+            "one_hot"}
+    for mod in modules:
+        for name in getattr(mod, "__all__", []):
+            if name in skip:
+                continue
+            fn = getattr(mod, name)
+            if callable(fn) and not hasattr(T, name):
+                setattr(T, name, fn)
+
+    # ---- explicit methods with tensor-first semantics --------------------
+    T.astype = lambda self, dtype: manipulation.cast(self, dtype)
+    T.cast = lambda self, dtype: manipulation.cast(self, dtype)
+    T.item = Tensor.item
+    T.matmul = lambda self, y, transpose_x=False, transpose_y=False, name=None: \
+        linalg.matmul(self, y, transpose_x, transpose_y)
+    T.mm = lambda self, y, name=None: linalg.matmul(self, y)
+    T.dot = lambda self, y, name=None: linalg.dot(self, y)
+    T.one_hot = lambda self, num_classes: creation.one_hot(self, num_classes)
+
+    def _cuda(self, device_id=None, blocking=True):
+        return self
+    T.cuda = _cuda
+    T.cpu = lambda self: self
+    T.pin_memory = lambda self: self
+    T.to = _to
+
+    # in-place aliases used by optimizers / user code; the functional op
+    # runs on a tape_alias so the rebound tensor isn't its own parent
+    T.add_ = lambda self, y: tape_rebind(self, math.add(tape_alias(self), y))
+    T.subtract_ = lambda self, y: tape_rebind(
+        self, math.subtract(tape_alias(self), y))
+    T.multiply_ = lambda self, y: tape_rebind(
+        self, math.multiply(tape_alias(self), y))
+    T.divide_ = lambda self, y: tape_rebind(
+        self, math.divide(tape_alias(self), y))
+    T.scale_ = lambda self, scale=1.0, bias=0.0, bias_after_scale=True, act=None: \
+        tape_rebind(self, math.scale(tape_alias(self), scale, bias,
+                                     bias_after_scale))
+    T.clip_ = lambda self, min=None, max=None: tape_rebind(
+        self, math.clip(tape_alias(self), min, max))
+    T.zero_ = lambda self: _inplace_nograd(self, jnp.zeros_like(self._data))
+    T.fill_ = lambda self, value: _inplace_nograd(
+        self, jnp.full_like(self._data, value))
+    T.exp_ = lambda self: tape_rebind(self, math.exp(tape_alias(self)))
+    T.sqrt_ = lambda self: tape_rebind(self, math.sqrt(tape_alias(self)))
+    T.rsqrt_ = lambda self: tape_rebind(self, math.rsqrt(tape_alias(self)))
+    T.copy_ = _copy_
+    T.set_value = _set_value
+    T.get_tensor = lambda self: self
+    T.value = lambda self: self
+    T.uniform_ = random_ops.uniform_
+    T.normal_ = random_ops.normal_
+    T.exponential_ = random_ops.exponential_
+
+
+def _inplace_nograd(t: Tensor, data) -> Tensor:
+    t.set_data(data)
+    return t
+
+
+def _copy_(self, other, blocking=True):
+    src = other._data if isinstance(other, Tensor) else jnp.asarray(other)
+    self.set_data(src.astype(self.dtype))
+    return self
+
+
+def _set_value(self, value):
+    src = value._data if isinstance(value, Tensor) else jnp.asarray(value)
+    self.set_data(jnp.asarray(src, dtype=self.dtype).reshape(self._data.shape)
+                  if src.size == self.size else src.astype(self.dtype))
+    return self
+
+
+def _to(self, *args, **kwargs):
+    dtype = kwargs.get("dtype")
+    for a in args:
+        if isinstance(a, str) and (a in ("cpu",) or ":" in a or a in
+                                   ("gpu", "tpu", "xpu", "cuda")):
+            continue  # single-device program; placement handled by jax
+        elif a is not None and not isinstance(a, bool):
+            dtype = a
+    if dtype is not None:
+        return manipulation.cast(self, dtype)
+    return self

@@ -1,0 +1,9 @@
+from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
+                        Adagrad, Adadelta, RMSProp, Lamb, LBFGS)
+from . import lr
+from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
+                   clip_grad_norm_)
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "LBFGS", "lr",
+           "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"]

@@ -1,0 +1,108 @@
+"""Gradient clipping (python/paddle/nn/clip.py parity, UNVERIFIED).
+
+``ClipGradByGlobalNorm`` is distributed-aware in the reference (norms
+allreduced across mp/pp/sharding groups); on TPU the same computation inside
+a compiled region gets its psum inserted by GSPMD automatically, and the
+hybrid-parallel optimizer wrapper adds explicit psums where running under
+shard_map."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, no_grad
+
+__all__ = ["ClipGradBase", "ClipGradByGlobalNorm", "ClipGradByNorm",
+           "ClipGradByValue", "clip_grad_norm_", "clip_grad_value_"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+        self.auto_skip_clip = auto_skip_clip
+
+    def __call__(self, params_grads):
+        grads = [g for _, g in params_grads if g is not None]
+        if not grads:
+            return params_grads
+        sq = sum(jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+                 for g in grads)
+        global_norm = jnp.sqrt(sq)
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._data.astype(jnp.float32)
+                                       * scale).astype(g.dtype))))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm=1.0):
+        self.clip_norm = float(clip_norm)
+
+    def __call__(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None:
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(
+                g._data.astype(jnp.float32))))
+            scale = self.clip_norm / jnp.maximum(norm, self.clip_norm)
+            out.append((p, Tensor((g._data.astype(jnp.float32)
+                                   * scale).astype(g.dtype))))
+        return out
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max=1.0, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def __call__(self, params_grads):
+        return [(p, Tensor(jnp.clip(g._data, self.min, self.max))
+                 if g is not None else g)
+                for p, g in params_grads]
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0,
+                    error_if_nonfinite=False):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    grads = [p.grad for p in parameters if p.grad is not None]
+    if not grads:
+        return Tensor(jnp.zeros(()))
+    if norm_type == float("inf"):
+        total = jnp.max(jnp.stack([jnp.max(jnp.abs(g._data))
+                                   for g in grads]))
+    else:
+        total = jnp.sum(jnp.stack(
+            [jnp.sum(jnp.abs(g._data.astype(jnp.float32)) ** norm_type)
+             for g in grads])) ** (1.0 / norm_type)
+    clip_coef = jnp.minimum(max_norm / (total + 1e-6), 1.0)
+    with no_grad():
+        for p in parameters:
+            if p.grad is not None:
+                p.grad.set_data((p.grad._data.astype(jnp.float32)
+                                 * clip_coef).astype(p.grad.dtype))
+    return Tensor(total)
+
+
+def clip_grad_value_(parameters, clip_value):
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    with no_grad():
+        for p in parameters:
+            if p.grad is not None:
+                p.grad.set_data(jnp.clip(p.grad._data, -clip_value,
+                                         clip_value))

@@ -1,0 +1,470 @@
+"""Optimizer base + the standard family
+(python/paddle/optimizer/ parity, UNVERIFIED).
+
+Update math runs as jax ops on the wrapped arrays; under
+``paddle_tpu.jit.to_static`` the whole step (grads → clip → update) traces
+into the compiled program, which is where XLA fuses it into the fused
+multi-tensor-apply the reference implements by hand (SURVEY.md §3.2 step 4).
+Accumulators are persistable Tensors so the functionalizer captures them.
+Master weights: when a parameter is low-precision (bf16/fp16), Adam-family
+optimizers keep an fp32 master copy (paddle `multi_precision`)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, Parameter, no_grad, is_floating
+from .lr import LRScheduler
+from .clip import ClipGradBase
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "LBFGS"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=True):
+        if parameters is None:
+            raise ValueError(
+                "parameters must be given in dygraph mode "
+                "(pass model.parameters())")
+        self._parameter_list = list(parameters)
+        self._param_groups = None
+        if self._parameter_list and isinstance(self._parameter_list[0], dict):
+            self._param_groups = self._parameter_list
+            flat = []
+            for g in self._param_groups:
+                flat.extend(g["params"])
+            self._parameter_list = flat
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+        self._multi_precision = multi_precision
+        self._accumulators: dict[str, dict[int, Tensor]] = {}
+        self._master_weights: dict[int, Tensor] = {}
+        self._step_count = 0
+        # checkpoint loaded before the first step(): accumulators are lazy,
+        # so stash the state and apply it as they get created
+        self._pending_state: dict | None = None
+
+    # -- lr ---------------------------------------------------------------
+
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float) -> None:
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when a LRScheduler is in use")
+        self._learning_rate = value
+
+    # -- accumulators ------------------------------------------------------
+
+    def _param_key(self, p: Tensor) -> str:
+        if not hasattr(self, "_id2name"):
+            self._id2name = {id(q): (q.name or f"param_{i}")
+                             for i, q in enumerate(self._parameter_list)}
+        return self._id2name.get(id(p), str(id(p)))
+
+    def _acc(self, name: str, p: Tensor, init=None, dtype=None):
+        store = self._accumulators.setdefault(name, {})
+        key = id(p)
+        if key not in store:
+            data = jnp.zeros(p._data.shape, dtype or jnp.float32) \
+                if init is None else init
+            pending = (self._pending_state or {}).get(
+                f"{self._param_key(p)}_{name}")
+            if pending is not None:
+                data = pending._data if isinstance(pending, Tensor) \
+                    else jnp.asarray(pending)
+            t = Tensor(data)
+            t.persistable = True
+            t.name = f"{self._param_key(p)}_{name}"
+            store[key] = t
+        return store[key]
+
+    def _master(self, p: Tensor):
+        """fp32 master weight for low-precision params."""
+        if not self._multi_precision or p.dtype == jnp.float32 \
+                or not is_floating(p.dtype):
+            return None
+        key = id(p)
+        if key not in self._master_weights:
+            data = p._data.astype(jnp.float32)
+            pending = (self._pending_state or {}).get(
+                f"{self._param_key(p)}_master")
+            if pending is not None:
+                data = pending._data if isinstance(pending, Tensor) \
+                    else jnp.asarray(pending)
+            t = Tensor(data)
+            t.persistable = True
+            self._master_weights[key] = t
+        return self._master_weights[key]
+
+    # -- step --------------------------------------------------------------
+
+    def _collect_params_grads(self):
+        pgs = []
+        for p in self._parameter_list:
+            if not getattr(p, "trainable", True):
+                continue
+            pgs.append((p, p.grad))
+        return pgs
+
+    def _apply_decay(self, p, g, lr):
+        """L2 regularization folded into grad (paddle weight_decay on
+        non-AdamW optimizers)."""
+        wd = self._weight_decay
+        if wd is None or wd == 0.0:
+            return g
+        coeff = float(wd) if not isinstance(wd, (list, tuple)) else wd[0]
+        return g + coeff * p.astype(g.dtype)
+
+    def step(self) -> None:
+        with no_grad():
+            pgs = [(p, g) for p, g in self._collect_params_grads()
+                   if g is not None]
+            if self._grad_clip is not None:
+                pgs = self._grad_clip(pgs)
+            lr = self.get_lr()
+            for p, g in pgs:
+                self._update_param(p, g, lr)
+        self._step_count += 1
+
+    def _update_param(self, p: Tensor, g: Tensor, lr: float) -> None:
+        raise NotImplementedError
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+    def clear_grad(self, set_to_zero: bool = False) -> None:
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    # -- state dict --------------------------------------------------------
+
+    def state_dict(self) -> dict:
+        sd = {}
+        for store in self._accumulators.values():
+            for t in store.values():
+                sd[t.name] = t
+        for pid, t in self._master_weights.items():
+            # master weights are keyed by param
+            name = next((f"{self._param_key(p)}_master"
+                         for p in self._parameter_list if id(p) == pid),
+                        f"{pid}_master")
+            sd[name] = t
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        sd["@step"] = self._step_count
+        return sd
+
+    def set_state_dict(self, state: dict) -> None:
+        """Restore optimizer state. Accumulators are created lazily at the
+        first step, so state for not-yet-created slots is stashed and
+        applied on creation (resume-before-first-step works)."""
+        self._pending_state = dict(state)
+        for store in self._accumulators.values():
+            for t in store.values():
+                if t.name in state:
+                    src = state[t.name]
+                    t.set_data(src._data if isinstance(src, Tensor)
+                               else jnp.asarray(src))
+        for pid, t in self._master_weights.items():
+            name = next((f"{self._param_key(p)}_master"
+                         for p in self._parameter_list if id(p) == pid),
+                        None)
+            if name and name in state:
+                src = state[name]
+                t.set_data(src._data if isinstance(src, Tensor)
+                           else jnp.asarray(src))
+        if "LR_Scheduler" in state and isinstance(self._learning_rate,
+                                                  LRScheduler):
+            self._learning_rate.set_state_dict(state["LR_Scheduler"])
+        self._step_count = state.get("@step", self._step_count)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+    def _update_param(self, p, g, lr):
+        gd = self._apply_decay(Tensor(p._data), Tensor(g._data), lr)._data \
+            if self._weight_decay else g._data
+        m = self._master(p)
+        if m is not None:
+            new = m._data - lr * gd.astype(jnp.float32)
+            m.set_data(new)
+            p.set_data(new.astype(p.dtype))
+        else:
+            p.set_data(p._data - (lr * gd).astype(p.dtype))
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _update_param(self, p, g, lr):
+        gd = g._data.astype(jnp.float32)
+        if self._weight_decay:
+            gd = gd + float(self._weight_decay) * \
+                p._data.astype(jnp.float32)
+        vel = self._acc("velocity", p)
+        v = self._momentum * vel._data + gd
+        vel.set_data(v)
+        if self._nesterov:
+            upd = gd + self._momentum * v
+        else:
+            upd = v
+        m = self._master(p)
+        if m is not None:
+            new = m._data - lr * upd
+            m.set_data(new)
+            p.set_data(new.astype(p.dtype))
+        else:
+            p.set_data((p._data.astype(jnp.float32) - lr *
+                        upd).astype(p.dtype))
+
+
+class _AdamBase(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _adam_update(self, p, g, lr, decoupled_wd=0.0, apply_l2=True):
+        gd = g._data.astype(jnp.float32)
+        if apply_l2 and self._weight_decay and not decoupled_wd:
+            gd = gd + float(self._weight_decay) * \
+                p._data.astype(jnp.float32)
+        m_t = self._acc("moment1", p)
+        v_t = self._acc("moment2", p)
+        b1p = self._acc("beta1_pow", p,
+                        init=jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p,
+                        init=jnp.asarray(1.0, jnp.float32))
+        b1 = self._beta1() if callable(self._beta1) else self._beta1
+        b2 = self._beta2() if callable(self._beta2) else self._beta2
+        m = b1 * m_t._data + (1 - b1) * gd
+        v = b2 * v_t._data + (1 - b2) * jnp.square(gd)
+        b1_pow = b1p._data * b1
+        b2_pow = b2p._data * b2
+        m_t.set_data(m)
+        v_t.set_data(v)
+        b1p.set_data(b1_pow)
+        b2p.set_data(b2_pow)
+        m_hat = m / (1 - b1_pow)
+        v_hat = v / (1 - b2_pow)
+        master = self._master(p)
+        base = master._data if master is not None else \
+            p._data.astype(jnp.float32)
+        if decoupled_wd:
+            base = base * (1.0 - lr * decoupled_wd)
+        new = base - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        if master is not None:
+            master.set_data(new)
+        p.set_data(new.astype(p.dtype))
+
+
+class Adam(_AdamBase):
+    def _update_param(self, p, g, lr):
+        self._adam_update(p, g, lr)
+
+
+class AdamW(_AdamBase):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision, name)
+        self._coeff = weight_decay
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update_param(self, p, g, lr):
+        decay = self._coeff
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            decay = 0.0
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        self._adam_update(p, g, lr, decoupled_wd=decay, apply_l2=False)
+
+
+class Adamax(_AdamBase):
+    def _update_param(self, p, g, lr):
+        gd = g._data.astype(jnp.float32)
+        if self._weight_decay:
+            gd = gd + float(self._weight_decay) * \
+                p._data.astype(jnp.float32)
+        m_t = self._acc("moment", p)
+        u_t = self._acc("inf_norm", p)
+        b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        m = self._beta1 * m_t._data + (1 - self._beta1) * gd
+        u = jnp.maximum(self._beta2 * u_t._data, jnp.abs(gd))
+        b1_pow = b1p._data * self._beta1
+        m_t.set_data(m)
+        u_t.set_data(u)
+        b1p.set_data(b1_pow)
+        master = self._master(p)
+        base = master._data if master is not None else \
+            p._data.astype(jnp.float32)
+        new = base - lr / (1 - b1_pow) * m / (u + self._epsilon)
+        if master is not None:
+            master.set_data(new)
+        p.set_data(new.astype(p.dtype))
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, g, lr):
+        gd = g._data.astype(jnp.float32)
+        if self._weight_decay:
+            gd = gd + float(self._weight_decay) * \
+                p._data.astype(jnp.float32)
+        acc = self._acc("moment", p,
+                        init=jnp.full(p._data.shape, self._init_acc,
+                                      jnp.float32))
+        a = acc._data + jnp.square(gd)
+        acc.set_data(a)
+        p.set_data((p._data.astype(jnp.float32) -
+                    lr * gd / (jnp.sqrt(a) + self._epsilon)).astype(p.dtype))
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._rho = rho
+
+    def _update_param(self, p, g, lr):
+        gd = g._data.astype(jnp.float32)
+        if self._weight_decay:
+            gd = gd + float(self._weight_decay) * \
+                p._data.astype(jnp.float32)
+        avg_sq = self._acc("avg_squared_grad", p)
+        avg_up = self._acc("avg_squared_update", p)
+        asg = self._rho * avg_sq._data + (1 - self._rho) * jnp.square(gd)
+        upd = jnp.sqrt(avg_up._data + self._epsilon) / \
+            jnp.sqrt(asg + self._epsilon) * gd
+        asu = self._rho * avg_up._data + (1 - self._rho) * jnp.square(upd)
+        avg_sq.set_data(asg)
+        avg_up.set_data(asu)
+        p.set_data((p._data.astype(jnp.float32) - lr * upd).astype(p.dtype))
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho = rho
+        self._epsilon = epsilon
+        self._momentum = momentum
+        self._centered = centered
+
+    def _update_param(self, p, g, lr):
+        gd = g._data.astype(jnp.float32)
+        if self._weight_decay:
+            gd = gd + float(self._weight_decay) * \
+                p._data.astype(jnp.float32)
+        ms = self._acc("mean_square", p)
+        mom = self._acc("momentum", p)
+        new_ms = self._rho * ms._data + (1 - self._rho) * jnp.square(gd)
+        ms.set_data(new_ms)
+        if self._centered:
+            mg = self._acc("mean_grad", p)
+            new_mg = self._rho * mg._data + (1 - self._rho) * gd
+            mg.set_data(new_mg)
+            denom = jnp.sqrt(new_ms - jnp.square(new_mg) + self._epsilon)
+        else:
+            denom = jnp.sqrt(new_ms + self._epsilon)
+        v = self._momentum * mom._data + lr * gd / denom
+        mom.set_data(v)
+        p.set_data((p._data.astype(jnp.float32) - v).astype(p.dtype))
+
+
+class Lamb(_AdamBase):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, name=name)
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _update_param(self, p, g, lr):
+        gd = g._data.astype(jnp.float32)
+        m_t = self._acc("moment1", p)
+        v_t = self._acc("moment2", p)
+        b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        m = self._beta1 * m_t._data + (1 - self._beta1) * gd
+        v = self._beta2 * v_t._data + (1 - self._beta2) * jnp.square(gd)
+        b1_pow, b2_pow = b1p._data * self._beta1, b2p._data * self._beta2
+        m_t.set_data(m); v_t.set_data(v)
+        b1p.set_data(b1_pow); b2p.set_data(b2_pow)
+        m_hat = m / (1 - b1_pow)
+        v_hat = v / (1 - b2_pow)
+        wd = self._lamb_wd
+        if self._exclude_fn is not None and self._exclude_fn(p):
+            wd = 0.0
+        pf = p._data.astype(jnp.float32)
+        r = m_hat / (jnp.sqrt(v_hat) + self._epsilon) + wd * pf
+        w_norm = jnp.linalg.norm(pf)
+        r_norm = jnp.linalg.norm(r)
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        p.set_data((pf - lr * trust * r).astype(p.dtype))
+
+
+class LBFGS(Optimizer):
+    """Accepted for API parity; performs plain gradient descent with line
+    search omitted (full L-BFGS is a later-phase item, rarely used in the
+    baseline workloads)."""
+
+    def __init__(self, learning_rate=1.0, max_iter=20, parameters=None,
+                 **kw):
+        super().__init__(learning_rate, parameters, None, None, None)
+
+    def step(self, closure=None):
+        loss = None
+        if closure is not None:
+            loss = closure()
+        with no_grad():
+            for p, g in self._collect_params_grads():
+                if g is not None:
+                    p.set_data(p._data - self.get_lr() * g._data)
+        return loss

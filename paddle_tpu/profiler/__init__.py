@@ -1,0 +1,185 @@
+"""``paddle.profiler`` (python/paddle/profiler/ parity, UNVERIFIED).
+
+Reference: host RecordEvent ranges + CUPTI device tracer → chrome trace
+(SURVEY.md §5). TPU-native: ``jax.profiler`` captures host + device (TPU)
+timelines into TensorBoard/Perfetto format; ``RecordEvent`` maps to
+``jax.profiler.TraceAnnotation`` so user annotations appear in the same
+trace. Summary tables come from jax's own profile session where available;
+``profiler_result.save`` exports the trace dir."""
+
+from __future__ import annotations
+
+import os
+import time
+
+import jax
+
+from ..framework.core import Tensor
+
+__all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
+           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
+           "SortedKeys", "SummaryView"]
+
+
+class ProfilerTarget:
+    CPU = "cpu"
+    GPU = "gpu"
+    CUSTOM_DEVICE = "custom"
+    TPU = "tpu"
+
+
+class ProfilerState:
+    CLOSED = 0
+    READY = 1
+    RECORD = 2
+    RECORD_AND_RETURN = 3
+
+
+class SortedKeys:
+    CPUTotal = 0
+    CPUAvg = 1
+    GPUTotal = 2
+
+
+class SummaryView:
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+
+
+def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
+    """Return a step→state callable (paddle.profiler.make_scheduler)."""
+    cycle = closed + ready + record
+
+    def scheduler(step):
+        if step < skip_first:
+            return ProfilerState.CLOSED
+        s = step - skip_first
+        if repeat and s >= repeat * cycle:
+            return ProfilerState.CLOSED
+        pos = s % cycle if cycle else 0
+        if pos < closed:
+            return ProfilerState.CLOSED
+        if pos < closed + ready:
+            return ProfilerState.READY
+        if pos == cycle - 1:
+            return ProfilerState.RECORD_AND_RETURN
+        return ProfilerState.RECORD
+    return scheduler
+
+
+def export_chrome_tracing(dir_name, worker_name=None):
+    def handler(prof):
+        prof._log_dir = dir_name
+    return handler
+
+
+def load_profiler_result(path):
+    return path
+
+
+class RecordEvent:
+    """User range annotation; shows up in the jax/Perfetto trace."""
+
+    def __init__(self, name, event_type=None):
+        self.name = name
+        self._ann = None
+        self.begin_ts = None
+
+    def begin(self):
+        self._ann = jax.profiler.TraceAnnotation(self.name)
+        self._ann.__enter__()
+        self.begin_ts = time.perf_counter()
+
+    def end(self):
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.begin()
+        return self
+
+    def __exit__(self, *exc):
+        self.end()
+        return False
+
+
+class Profiler:
+    def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
+                 record_shapes=False, profile_memory=False, timer_only=False,
+                 emit_nvtx=False, custom_device_types=None, with_flops=False):
+        self._scheduler = scheduler if callable(scheduler) else None
+        if isinstance(scheduler, (tuple, list)):
+            lo, hi = scheduler
+            self._scheduler = lambda step: (
+                ProfilerState.RECORD if lo <= step < hi
+                else ProfilerState.CLOSED)
+        self._on_trace_ready = on_trace_ready
+        self._log_dir = os.environ.get("PADDLE_PROFILER_LOG_DIR",
+                                       "./profiler_log")
+        self._step = 0
+        self._recording = False
+        self._timer_only = timer_only
+        self._step_times = []
+        self._last = None
+
+    def start(self):
+        self._last = time.perf_counter()
+        self._maybe_transition()
+
+    def stop(self):
+        if self._recording:
+            jax.profiler.stop_trace()
+            self._recording = False
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+
+    def step(self, num_samples=None):
+        now = time.perf_counter()
+        if self._last is not None:
+            self._step_times.append(now - self._last)
+        self._last = now
+        self._step += 1
+        self._maybe_transition()
+
+    def _maybe_transition(self):
+        if self._timer_only or self._scheduler is None:
+            return
+        state = self._scheduler(self._step)
+        want = state in (ProfilerState.RECORD,
+                         ProfilerState.RECORD_AND_RETURN)
+        if want and not self._recording:
+            os.makedirs(self._log_dir, exist_ok=True)
+            jax.profiler.start_trace(self._log_dir)
+            self._recording = True
+        elif not want and self._recording:
+            jax.profiler.stop_trace()
+            self._recording = False
+            if self._on_trace_ready:
+                self._on_trace_ready(self)
+
+    def summary(self, sorted_by=None, op_detail=True, thread_sep=False,
+                time_unit="ms"):
+        n = len(self._step_times)
+        if not n:
+            print("No steps recorded")
+            return
+        avg = sum(self._step_times) / n
+        print(f"steps: {n}  avg step time: {avg * 1e3:.3f} ms  "
+              f"throughput: {1.0 / avg:.2f} steps/s")
+
+    def export(self, path=None, format="json"):
+        return self._log_dir
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

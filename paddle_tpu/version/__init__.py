@@ -1,0 +1,23 @@
+"""Version info (paddle.version parity)."""
+
+full_version = "0.1.0"
+major = "0"
+minor = "1"
+patch = "0"
+rc = "0"
+commit = "unknown"
+cuda_version = "False"
+cudnn_version = "False"
+tpu = True
+
+
+def show():
+    print(f"paddle_tpu {full_version} (TPU-native, jax-based)")
+
+
+def cuda():
+    return False
+
+
+def cudnn():
+    return False

@@ -1,0 +1,10 @@
+"""``paddle.vision`` — models/transforms/datasets scaffold
+(python/paddle/vision/ parity, UNVERIFIED). Round-1 scope: ResNet family +
+basic transforms + ops used by OpTest-style suites."""
+
+from . import transforms
+from . import models
+from .models import ResNet, resnet18, resnet34, resnet50, resnet101, LeNet
+
+__all__ = ["transforms", "models", "ResNet", "resnet18", "resnet34",
+           "resnet50", "resnet101", "LeNet"]

@@ -1,0 +1,135 @@
+"""Basic vision transforms (python/paddle/vision/transforms parity,
+UNVERIFIED) operating on numpy HWC arrays / Tensors."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "Transpose", "to_tensor",
+           "normalize"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = np.asarray(pic, dtype=np.float32)
+    if arr.max() > 1.0:
+        arr = arr / 255.0
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, pic):
+        return to_tensor(pic, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    if isinstance(img, Tensor):
+        arr = np.asarray(img._data)
+    else:
+        arr = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean[:, None, None]) / std[:, None, None]
+    else:
+        arr = (arr - mean) / std
+    return Tensor(arr)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        self.mean = mean if isinstance(mean, (list, tuple)) else [mean] * 3
+        self.std = std if isinstance(std, (list, tuple)) else [std] * 3
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        import jax
+        import jax.numpy as jnp
+        arr = img._data if isinstance(img, Tensor) else jnp.asarray(img)
+        hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+        if hwc:
+            out_shape = self.size + (arr.shape[-1],)
+        else:
+            out_shape = arr.shape[:-2] + self.size
+        return Tensor(jax.image.resize(arr, out_shape, "linear"))
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        h, w = arr.shape[:2] if arr.shape[-1] in (1, 3, 4) else \
+            arr.shape[-2:]
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        if arr.ndim == 3 and arr.shape[-1] in (1, 3, 4):
+            return Tensor(arr[i:i + th, j:j + tw])
+        return Tensor(arr[..., i:i + th, j:j + tw])
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        if self.padding:
+            p = self.padding
+            pad = [(p, p), (p, p)] + [(0, 0)] * (arr.ndim - 2)
+            arr = np.pad(arr, pad)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return Tensor(arr[i:i + th, j:j + tw])
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        if np.random.rand() < self.prob:
+            arr = arr[:, ::-1].copy()
+        return Tensor(arr)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        return Tensor(arr.transpose(self.order))

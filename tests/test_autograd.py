@@ -1,0 +1,154 @@
+"""Autograd engine tests (reference pattern: eager backward tests +
+double-grad tests, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2, 4, 6])
+
+
+def test_backward_chain():
+    x = paddle.to_tensor(2.0, stop_gradient=False)
+    y = paddle.exp(paddle.sin(x) * 3)
+    y.backward()
+    expected = np.exp(np.sin(2.0) * 3) * 3 * np.cos(2.0)
+    np.testing.assert_allclose(x.grad.numpy(), expected, rtol=1e-5)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0, 1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5, 5])
+
+
+def test_shared_subexpression():
+    x = paddle.to_tensor(3.0, stop_gradient=False)
+    y = x * x      # y used twice
+    z = y + y
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), 12.0)  # d(2x^2)/dx = 4x
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0], stop_gradient=True)
+    (x * y).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x * 2).detach()
+    assert y.stop_gradient
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0])
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 2
+    assert y.stop_gradient
+    assert y._node is None
+
+
+def test_paddle_grad():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = (x ** 3).sum()
+    (gx,) = paddle.grad(y, x)
+    np.testing.assert_allclose(gx.numpy(), 3 * np.array([1.0, 4.0]))
+    assert x.grad is None  # side-effect free
+
+
+def test_grad_non_scalar_with_grad_outputs():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    seed = paddle.to_tensor([1.0, 0.5])
+    (gx,) = paddle.grad(y, x, grad_outputs=seed)
+    np.testing.assert_allclose(gx.numpy(), [2.0, 1.0])
+
+
+def test_backward_multi_output_op():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    parts = paddle.split(x, 3, axis=1)
+    loss = (parts[0] * 1 + parts[2] * 3).sum()  # parts[1] unused
+    loss.backward()
+    np.testing.assert_allclose(
+        x.grad.numpy(), [[1, 0, 3], [1, 0, 3]])
+
+
+def test_register_hook():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    seen = []
+
+    def hook(g):
+        seen.append(g.numpy().copy())
+        return g * 2
+
+    x.register_hook(hook)
+    (x * 3).sum().backward()
+    assert len(seen) == 1
+    np.testing.assert_allclose(seen[0], [3.0])
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])
+
+
+def test_pylayer():
+    class Double(paddle.autograd.PyLayer):
+        @staticmethod
+        def forward(ctx, x):
+            ctx.save_for_backward(x)
+            return x * 2
+
+        @staticmethod
+        def backward(ctx, grad):
+            (x,) = ctx.saved_tensor()
+            return grad * 2
+
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = Double.apply(x)
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_inplace_add_():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    y.add_(paddle.to_tensor([1.0, 1.0]))
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+    np.testing.assert_allclose(y.numpy(), [3.0, 5.0])
+
+
+def test_setitem_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = x * 2
+    y[0] = 10.0
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 2.0, 2.0])
+
+
+def test_higher_order_incubate():
+    def f(x):
+        return (x ** 3).sum()
+
+    x = paddle.to_tensor([1.0, 2.0])
+    h = paddle.incubate.autograd.hessian(f, x)
+    np.testing.assert_allclose(h.numpy(), np.diag([6.0, 12.0]))
+
+
+def test_clear_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    x.clear_grad()
+    assert x.grad is None

@@ -1,0 +1,165 @@
+"""Op tests: math/reduction ops vs NumPy oracle + grad checks
+(reference pattern: test/legacy_test/test_*_op.py, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from op_test import check_forward, check_grad
+
+rng = np.random.RandomState(0)
+
+
+UNARY_CASES = [
+    ("exp", paddle.exp, np.exp, (3, 4), (-2, 2)),
+    ("log", paddle.log, np.log, (3, 4), (0.1, 3)),
+    ("sqrt", paddle.sqrt, np.sqrt, (3, 4), (0.1, 3)),
+    ("abs", paddle.abs, np.abs, (3, 4), (-2, 2)),
+    ("sin", paddle.sin, np.sin, (3, 4), (-3, 3)),
+    ("cos", paddle.cos, np.cos, (3, 4), (-3, 3)),
+    ("tanh", paddle.tanh, np.tanh, (3, 4), (-2, 2)),
+    ("sigmoid", paddle.sigmoid, lambda x: 1 / (1 + np.exp(-x)), (3, 4),
+     (-2, 2)),
+    ("square", paddle.square, np.square, (3, 4), (-2, 2)),
+    ("rsqrt", paddle.rsqrt, lambda x: 1 / np.sqrt(x), (3, 4), (0.5, 2)),
+    ("log1p", paddle.log1p, np.log1p, (3, 4), (-0.5, 2)),
+    ("expm1", paddle.expm1, np.expm1, (3, 4), (-1, 1)),
+    ("floor", paddle.floor, np.floor, (3, 4), (-2, 2)),
+    ("ceil", paddle.ceil, np.ceil, (3, 4), (-2, 2)),
+    ("reciprocal", paddle.reciprocal, lambda x: 1.0 / x, (3, 4), (0.5, 2)),
+    ("erf", paddle.erf, None, (3, 4), (-2, 2)),
+]
+
+
+@pytest.mark.parametrize("name,op,ref,shape,rng_range", UNARY_CASES,
+                         ids=[c[0] for c in UNARY_CASES])
+def test_unary_forward(name, op, ref, shape, rng_range):
+    lo, hi = rng_range
+    x = rng.uniform(lo, hi, shape).astype(np.float32)
+    if ref is None:
+        import scipy.special
+        ref = getattr(scipy.special, name, None)
+        if ref is None:
+            pytest.skip("no oracle")
+    # fp32 transcendentals: XLA:CPU's vectorized approximations differ from
+    # libm in the last few ulps
+    check_forward(lambda x: op(x), lambda x: ref(x), {"x": x}, rtol=5e-4,
+                  atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["exp", "log", "sqrt", "sin", "tanh",
+                                  "sigmoid", "square"])
+def test_unary_grad(name):
+    op = getattr(paddle, name)
+    lo, hi = (0.5, 2) if name in ("log", "sqrt") else (-1.5, 1.5)
+    x = rng.uniform(lo, hi, (3, 4)).astype(np.float32)
+    check_grad(lambda x: op(x), {"x": x})
+
+
+BINARY_CASES = [
+    ("add", paddle.add, np.add),
+    ("subtract", paddle.subtract, np.subtract),
+    ("multiply", paddle.multiply, np.multiply),
+    ("divide", paddle.divide, np.divide),
+    ("maximum", paddle.maximum, np.maximum),
+    ("minimum", paddle.minimum, np.minimum),
+    ("pow", paddle.pow, np.power),
+    ("atan2", paddle.atan2, np.arctan2),
+]
+
+
+@pytest.mark.parametrize("name,op,ref", BINARY_CASES,
+                         ids=[c[0] for c in BINARY_CASES])
+def test_binary_forward(name, op, ref):
+    x = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    y = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    check_forward(lambda x, y: op(x, y), lambda x, y: ref(x, y),
+                  {"x": x, "y": y})
+
+
+def test_binary_broadcast():
+    x = rng.rand(3, 1, 4).astype(np.float32)
+    y = rng.rand(2, 4).astype(np.float32)
+    check_forward(lambda x, y: paddle.add(x, y), lambda x, y: x + y,
+                  {"x": x, "y": y})
+
+
+def test_binary_grad():
+    x = rng.uniform(0.5, 2, (3, 4)).astype(np.float32)
+    y = rng.uniform(0.5, 2, (4,)).astype(np.float32)  # broadcast grad
+    check_grad(lambda x, y: paddle.multiply(x, y), {"x": x, "y": y})
+    check_grad(lambda x, y: paddle.divide(x, y), {"x": x, "y": y})
+
+
+REDUCE_CASES = [
+    ("sum", paddle.sum, np.sum),
+    ("mean", paddle.mean, np.mean),
+    ("max", paddle.max, np.max),
+    ("min", paddle.min, np.min),
+    ("prod", paddle.prod, np.prod),
+]
+
+
+@pytest.mark.parametrize("name,op,ref", REDUCE_CASES,
+                         ids=[c[0] for c in REDUCE_CASES])
+@pytest.mark.parametrize("axis,keepdim", [(None, False), (0, False),
+                                          (1, True), ([0, 1], False)])
+def test_reduce_forward(name, op, ref, axis, keepdim):
+    x = rng.rand(3, 4, 5).astype(np.float32)
+    np_axis = tuple(axis) if isinstance(axis, list) else axis
+    check_forward(
+        lambda x: op(x, axis=axis, keepdim=keepdim),
+        lambda x: ref(x, axis=np_axis, keepdims=keepdim),
+        {"x": x})
+
+
+def test_reduce_grad():
+    x = rng.rand(3, 4).astype(np.float32)
+    check_grad(lambda x: paddle.sum(x, axis=1), {"x": x})
+    check_grad(lambda x: paddle.mean(x), {"x": x})
+    check_grad(lambda x: paddle.max(x, axis=0), {"x": x})
+
+
+def test_cumsum():
+    x = rng.rand(3, 4).astype(np.float32)
+    check_forward(lambda x: paddle.cumsum(x, axis=1),
+                  lambda x: np.cumsum(x, axis=1), {"x": x})
+    check_grad(lambda x: paddle.cumsum(x, axis=0), {"x": x})
+
+
+def test_logsumexp():
+    import scipy.special
+    x = rng.rand(3, 4).astype(np.float32)
+    check_forward(lambda x: paddle.logsumexp(x, axis=1),
+                  lambda x: scipy.special.logsumexp(x, axis=1), {"x": x},
+                  rtol=1e-5, atol=1e-5)
+
+
+def test_clip():
+    x = rng.uniform(-2, 2, (3, 4)).astype(np.float32)
+    check_forward(lambda x: paddle.clip(x, -1.0, 1.0),
+                  lambda x: np.clip(x, -1.0, 1.0), {"x": x})
+
+
+def test_scale():
+    x = rng.rand(3, 4).astype(np.float32)
+    check_forward(lambda x: paddle.scale(x, scale=2.0, bias=1.0),
+                  lambda x: 2.0 * x + 1.0, {"x": x})
+
+
+def test_operators_and_scalars():
+    x = paddle.to_tensor([1.0, 2.0, 3.0])
+    np.testing.assert_allclose((x + 1).numpy(), [2, 3, 4])
+    np.testing.assert_allclose((2 * x).numpy(), [2, 4, 6])
+    np.testing.assert_allclose((1 - x).numpy(), [0, -1, -2])
+    np.testing.assert_allclose((x / 2).numpy(), [0.5, 1, 1.5])
+    np.testing.assert_allclose((x ** 2).numpy(), [1, 4, 9])
+    np.testing.assert_allclose((-x).numpy(), [-1, -2, -3])
+    assert bool((x > 1.5).numpy()[1])
+
+
+def test_dtype_of_int_ops():
+    x = paddle.to_tensor([1, 2, 3], dtype="int64")
+    assert paddle.sum(x).dtype == paddle.int64
+    y = paddle.to_tensor([True, False, True])
+    assert int(paddle.sum(y.astype("int32")).item()) == 2
