@@ -1,0 +1,118 @@
+"""Driver benchmark: Llama fwd/bwd bf16 on one chip (BASELINE config 2
+shape; the 8B config does not fit a 16GB v5e, so the chip-appropriate Llama
+variant is picked by HBM size and MFU is reported against the chip's peak).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+vs_baseline = achieved MFU / 0.40 (the north-star MFU target).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "").lower()
+    if "v5p" in kind or "v5 p" in kind:
+        return 459e12
+    if "v5" in kind or "v5e" in kind or "lite" in kind:
+        return 197e12  # v5e bf16 peak
+    if "v4" in kind:
+        return 275e12
+    if "v6" in kind:
+        return 918e12
+    return 50e12  # unknown / CPU fallback so the line still prints
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform.lower() in ("tpu", "axon")
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        try:
+            hbm = dev.memory_stats().get("bytes_limit", 16e9)
+        except Exception:
+            hbm = 16e9
+        if hbm > 64e9:
+            cfg = LlamaConfig.llama3_8b()
+            batch, seq = 4, 2048
+        else:
+            cfg = LlamaConfig.llama_1b()
+            batch, seq = 8, 2048
+        cfg.use_recompute = True
+        steps, warmup = 10, 3
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq = 2, 128
+        steps, warmup = 5, 2
+    cfg.tensor_parallel = False
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+
+    import numpy as np
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                         (batch, seq + 1)).astype(np.int64))
+
+    @paddle.jit.to_static
+    def fwd_bwd(ids):
+        _, loss = model(ids, labels=ids)
+        loss.backward()
+        # keep backward alive in the compiled program: fold grads into the
+        # returned scalar, then drop them
+        gsum = None
+        for p in model.parameters():
+            if p.grad is not None:
+                s = p.grad.astype("float32").sum()
+                gsum = s if gsum is None else gsum + s
+        for p in model.parameters():
+            p.clear_grad()
+        return loss, gsum
+
+    # warmup / compile
+    for _ in range(warmup):
+        loss, gsum = fwd_bwd(ids)
+    jax.block_until_ready(loss.jax())
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss, gsum = fwd_bwd(ids)
+    jax.block_until_ready(loss.jax())
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens = batch * seq
+    n_params = sum(p.size for p in model.parameters())
+    L, d = cfg.num_hidden_layers, cfg.hidden_size
+    flops_per_step = 6.0 * n_params * tokens + 12.0 * L * batch * seq * seq * d
+    if cfg.use_recompute:
+        # recompute re-runs the forward during backward: +~2*N*tokens
+        flops_per_step += 2.0 * n_params * tokens
+    mfu = flops_per_step / dt / _peak_flops(dev)
+    tok_per_s = tokens / dt
+
+    print(json.dumps({
+        "metric": f"llama_{n_params/1e9:.2f}B_fwd_bwd_bf16_tokens_per_sec"
+                  + ("" if on_tpu else "_cpu_smoke"),
+        "value": round(tok_per_s, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }))
+    print(f"# step {dt*1000:.1f} ms, params {n_params/1e9:.3f}B, "
+          f"MFU {mfu*100:.1f}% of {_peak_flops(dev)/1e12:.0f} TFLOP/s "
+          f"({getattr(dev, 'device_kind', dev.platform)}), "
+          f"loss {float(loss.item()):.3f}", file=sys.stderr)
+
+
+if __name__ == "__main__":
+    main()

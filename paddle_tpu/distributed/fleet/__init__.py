@@ -18,6 +18,7 @@ from ..parallel_layers import (ColumnParallelLinear, RowParallelLinear,
 from ...framework.random import get_rng_state_tracker
 from .sharding import (DygraphShardingOptimizer, group_sharded_parallel,
                        GroupShardedStage3)
+from . import utils
 
 __all__ = ["fleet", "init", "DistributedStrategy", "Fleet",
            "CommunicateTopology", "HybridCommunicateGroup", "meta_parallel",

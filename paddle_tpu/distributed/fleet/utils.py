@@ -1,0 +1,6 @@
+"""fleet.utils — recompute + sequence-parallel helpers
+(fleet/utils/ parity, UNVERIFIED)."""
+
+from ...incubate.recompute import recompute
+
+__all__ = ["recompute"]

@@ -1,0 +1,52 @@
+"""Activation recompute — ``paddle.distributed.fleet.utils.recompute``
+parity (UNVERIFIED).
+
+TPU-native: ``jax.checkpoint`` (remat) IS the mechanism — we functionalize
+the layer call (parameters become explicit inputs), wrap it in
+``jax.checkpoint``, and record it as ONE tape node, so backward recomputes
+the block's activations instead of storing them (the HBM-for-FLOPs trade
+SURVEY.md's design notes call out)."""
+
+from __future__ import annotations
+
+from ..framework.core import Tensor, apply
+from ..ops.common import as_tensor
+
+__all__ = ["recompute"]
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function(*args)`` under rematerialization. ``function`` may be
+    a Layer (its parameters/buffers are captured as differentiable inputs)
+    or a pure function of tensors."""
+    import jax
+
+    from ..nn.layer.layers import Layer
+    params: list[Tensor] = []
+    if isinstance(function, Layer):
+        params = [p for p in function.parameters()] + \
+            [b for b in function.buffers()]
+    tensor_args = [as_tensor(a) if not isinstance(a, Tensor) else a
+                   for a in args]
+    n_args = len(tensor_args)
+
+    def pure(*flat):
+        arg_datas = flat[:n_args]
+        p_datas = flat[n_args:]
+        originals = [(p, p._data) for p in params]
+        try:
+            for p, d in zip(params, p_datas):
+                p._data = d
+            from ..framework.core import no_grad
+            with no_grad():
+                # inner ops must not re-record on the tape: the outer
+                # checkpointed node owns the whole block's vjp
+                out = function(*[Tensor(a) for a in arg_datas], **kwargs)
+            return out._data if isinstance(out, Tensor) else \
+                tuple(o._data for o in out)
+        finally:
+            for p, d in originals:
+                p._data = d
+
+    ckpt = jax.checkpoint(pure)
+    return apply(ckpt, *tensor_args, *params, name="recompute")

@@ -1,0 +1,10 @@
+"""Model zoo — the role PaddleNLP's ``llm/`` + ``paddlenlp/transformers``
+plays for the reference (SURVEY.md §0: the baseline workloads are PaddleNLP
+scripts driving the framework). TPU-first implementations built on
+paddle_tpu's nn + parallel layers + Pallas kernels."""
+
+from .gpt2 import GPT2Config, GPT2Model, GPT2ForCausalLM
+from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM
+
+__all__ = ["GPT2Config", "GPT2Model", "GPT2ForCausalLM", "LlamaConfig",
+           "LlamaModel", "LlamaForCausalLM"]

@@ -1,0 +1,203 @@
+"""Llama family (BASELINE configs 2/4: Llama-3-8B single chip, 70B 4D
+hybrid) — the flagship model.
+
+TPU-first: RMSNorm + RoPE + flash attention are the Pallas kernel pack
+(SURVEY.md §7 step 5); GQA repeats kv heads inside the kernel; weights use
+tensor-parallel layers that carry 'model'-axis NamedSharding when fleet is
+initialized with mp_degree > 1, and the whole forward is
+sharding-constraint-annotated so GSPMD lays out activations."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..framework.core import Tensor
+from .. import nn
+from ..nn import functional as F
+from ..ops import creation, manipulation as M
+from ..ops.linalg import matmul
+from ..distributed.parallel_layers import (ColumnParallelLinear,
+                                           RowParallelLinear,
+                                           VocabParallelEmbedding)
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 128256
+    hidden_size: int = 4096
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 8
+    intermediate_size: int = 14336
+    max_position_embeddings: int = 8192
+    rope_theta: float = 500000.0
+    rms_norm_eps: float = 1e-5
+    initializer_range: float = 0.02
+    tie_word_embeddings: bool = False
+    use_recompute: bool = False
+    tensor_parallel: bool = True  # use TP layers (degenerate w/o mesh)
+
+    @classmethod
+    def llama3_8b(cls):
+        return cls()
+
+    @classmethod
+    def llama3_70b(cls):
+        return cls(hidden_size=8192, num_hidden_layers=80,
+                   num_attention_heads=64, num_key_value_heads=8,
+                   intermediate_size=28672)
+
+    @classmethod
+    def llama_1b(cls):
+        """Single-v5e-chip bench config (8B does not fit 16GB HBM for
+        training)."""
+        return cls(vocab_size=32000, hidden_size=2048,
+                   num_hidden_layers=16, num_attention_heads=16,
+                   num_key_value_heads=8, intermediate_size=5632,
+                   max_position_embeddings=4096, rope_theta=10000.0)
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                   num_attention_heads=4, num_key_value_heads=2,
+                   intermediate_size=128, max_position_embeddings=128,
+                   rope_theta=10000.0)
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+
+def _lin(cfg, in_f, out_f, *, column, gather_output=False,
+         input_is_parallel=True):
+    init = nn.initializer.Normal(0.0, cfg.initializer_range)
+    attr = nn.ParamAttr(initializer=init)
+    if cfg.tensor_parallel:
+        if column:
+            return ColumnParallelLinear(in_f, out_f, weight_attr=attr,
+                                        has_bias=False,
+                                        gather_output=gather_output)
+        return RowParallelLinear(in_f, out_f, weight_attr=attr,
+                                 has_bias=False,
+                                 input_is_parallel=input_is_parallel)
+    return nn.Linear(in_f, out_f, weight_attr=attr, bias_attr=False)
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = cfg.num_key_value_heads
+        self.head_dim = cfg.head_dim
+        self.q_proj = _lin(cfg, cfg.hidden_size,
+                           self.num_heads * self.head_dim, column=True)
+        self.k_proj = _lin(cfg, cfg.hidden_size,
+                           self.num_kv_heads * self.head_dim, column=True)
+        self.v_proj = _lin(cfg, cfg.hidden_size,
+                           self.num_kv_heads * self.head_dim, column=True)
+        self.o_proj = _lin(cfg, self.num_heads * self.head_dim,
+                           cfg.hidden_size, column=False)
+
+    def forward(self, x, sin_cos=None):
+        b, s, _ = x.shape
+        q = M.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
+        k = M.reshape(self.k_proj(x),
+                      [b, s, self.num_kv_heads, self.head_dim])
+        v = M.reshape(self.v_proj(x),
+                      [b, s, self.num_kv_heads, self.head_dim])
+        from ..incubate.nn.functional import \
+            fused_rotary_position_embedding
+        q, k, _ = fused_rotary_position_embedding(
+            q, k, None, rotary_emb_base=self.cfg.rope_theta)
+        ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        ctx = M.reshape(ctx, [b, s, self.num_heads * self.head_dim])
+        return self.o_proj(ctx)
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.gate_proj = _lin(cfg, cfg.hidden_size, cfg.intermediate_size,
+                              column=True)
+        self.up_proj = _lin(cfg, cfg.hidden_size, cfg.intermediate_size,
+                            column=True)
+        self.down_proj = _lin(cfg, cfg.intermediate_size, cfg.hidden_size,
+                              column=False)
+
+    def forward(self, x):
+        return self.down_proj(F.swiglu(self.gate_proj(x), self.up_proj(x)))
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.self_attn(self.input_layernorm(x))
+        x = x + self.mlp(self.post_attention_layernorm(x))
+        return x
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.config = config
+        init = nn.initializer.Normal(0.0, config.initializer_range)
+        if config.tensor_parallel:
+            self.embed_tokens = VocabParallelEmbedding(
+                config.vocab_size, config.hidden_size,
+                weight_attr=nn.ParamAttr(initializer=init))
+        else:
+            self.embed_tokens = nn.Embedding(
+                config.vocab_size, config.hidden_size,
+                weight_attr=nn.ParamAttr(initializer=init))
+        self.layers = nn.LayerList([LlamaDecoderLayer(config)
+                                    for _ in range(config.num_hidden_layers)])
+        self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
+
+    def forward(self, input_ids):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            if self.config.use_recompute and self.training:
+                from ..incubate.recompute import recompute
+                x = recompute(layer, x)
+            else:
+                x = layer(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, config: LlamaConfig):
+        super().__init__()
+        self.llama = LlamaModel(config)
+        self.config = config
+        if not config.tie_word_embeddings:
+            self.lm_head = _lin(config, config.hidden_size,
+                                config.vocab_size, column=True,
+                                gather_output=True)
+        else:
+            self.lm_head = None
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.llama(input_ids)
+        if self.lm_head is not None:
+            logits = self.lm_head(hidden)
+        else:
+            logits = matmul(hidden, self.llama.embed_tokens.weight,
+                            transpose_y=True)
+        if labels is None:
+            return logits
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        loss = F.cross_entropy(
+            M.reshape(shift_logits, [-1, self.config.vocab_size]),
+            M.reshape(shift_labels, [-1]))
+        return logits, loss

@@ -31,6 +31,11 @@ def sdpa_reference(q, k, v, attn_mask=None, dropout_p=0.0, is_causal=False,
     """Pure-jnp reference attention on [B, S, H, D] arrays."""
     d = q.shape[-1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
+    # GQA/MQA: repeat kv heads up to the query head count
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     # [B, H, S, D]
     qh = jnp.swapaxes(q, 1, 2)
     kh = jnp.swapaxes(k, 1, 2)

@@ -25,12 +25,28 @@ class LRScheduler:
     def __call__(self):
         return self.last_lr
 
+    def _bind(self, optimizer) -> None:
+        """Called by Optimizer: lr changes push into the optimizer's
+        persistable lr state, so compiled (to_static) train steps see the
+        CURRENT lr as a state input rather than a trace-time constant."""
+        import weakref
+        if not hasattr(self, "_bound_opts"):
+            self._bound_opts = []
+        self._bound_opts.append(weakref.ref(optimizer))
+
+    def _push_lr(self) -> None:
+        for ref in getattr(self, "_bound_opts", []):
+            opt = ref()
+            if opt is not None:
+                opt._sync_lr_state(self.last_lr)
+
     def step(self, epoch=None):
         if epoch is None:
             self.last_epoch += 1
         else:
             self.last_epoch = epoch
         self.last_lr = self.get_lr()
+        self._push_lr()
         if self.verbose:
             print(f"Epoch {self.last_epoch}: lr set to {self.last_lr}")
 
@@ -39,7 +55,8 @@ class LRScheduler:
 
     def state_dict(self):
         return {k: v for k, v in self.__dict__.items()
-                if isinstance(v, (int, float, bool, str, list))}
+                if not k.startswith("_")
+                and isinstance(v, (int, float, bool, str, list))}
 
     def set_state_dict(self, state):
         self.__dict__.update(state)
@@ -293,6 +310,7 @@ class ReduceOnPlateau(LRScheduler):
                 self.last_lr = new_lr
             self.cooldown_counter = self.cooldown
             self.num_bad = 0
+        self._push_lr()
 
 
 class OneCycleLR(LRScheduler):

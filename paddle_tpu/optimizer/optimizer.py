@@ -11,6 +11,7 @@ optimizers keep an fp32 master copy (paddle `multi_precision`)."""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor, Parameter, no_grad, is_floating
@@ -46,6 +47,15 @@ class Optimizer:
         # checkpoint loaded before the first step(): accumulators are lazy,
         # so stash the state and apply it as they get created
         self._pending_state: dict | None = None
+        # lr lives in a persistable scalar so a to_static-compiled train
+        # step reads the CURRENT lr as state input instead of baking the
+        # trace-time value; scheduler.step() outside the compiled region
+        # refreshes it (the jax-idiomatic "lr is part of opt state")
+        self._lr_state = Tensor(jnp.asarray(self.get_lr(), jnp.float32))
+        self._lr_state.persistable = True
+        self._lr_state.name = "learning_rate"
+        if isinstance(self._learning_rate, LRScheduler):
+            self._learning_rate._bind(self)
 
     # -- lr ---------------------------------------------------------------
 
@@ -59,6 +69,12 @@ class Optimizer:
             raise RuntimeError(
                 "cannot set_lr when a LRScheduler is in use")
         self._learning_rate = value
+        self._sync_lr_state(value)
+
+    def _sync_lr_state(self, value: float) -> None:
+        from jax._src.core import trace_state_clean
+        if trace_state_clean():
+            self._lr_state.set_data(jnp.asarray(value, jnp.float32))
 
     # -- accumulators ------------------------------------------------------
 
@@ -122,13 +138,22 @@ class Optimizer:
         coeff = float(wd) if not isinstance(wd, (list, tuple)) else wd[0]
         return g + coeff * p.astype(g.dtype)
 
+    def _lr_array(self):
+        """Scalar lr used by update math. Outside a trace it is refreshed
+        from the scheduler; inside a trace it is read as state, so compiled
+        steps see per-call lr."""
+        from jax._src.core import trace_state_clean
+        if trace_state_clean():
+            self._lr_state.set_data(jnp.asarray(self.get_lr(), jnp.float32))
+        return self._lr_state.jax()
+
     def step(self) -> None:
         with no_grad():
             pgs = [(p, g) for p, g in self._collect_params_grads()
                    if g is not None]
             if self._grad_clip is not None:
                 pgs = self._grad_clip(pgs)
-            lr = self.get_lr()
+            lr = self._lr_array()
             for p, g in pgs:
                 self._update_param(p, g, lr)
         self._step_count += 1
@@ -170,8 +195,12 @@ class Optimizer:
     def set_state_dict(self, state: dict) -> None:
         """Restore optimizer state. Accumulators are created lazily at the
         first step, so state for not-yet-created slots is stashed and
-        applied on creation (resume-before-first-step works)."""
-        self._pending_state = dict(state)
+        applied on creation (resume-before-first-step works). Values are
+        snapshotted now — state_dict() hands out live tensors, and the
+        source optimizer may keep stepping before our slots materialize."""
+        self._pending_state = {
+            k: (Tensor(v._data) if isinstance(v, Tensor) else v)
+            for k, v in state.items()}
         for store in self._accumulators.values():
             for t in store.values():
                 if t.name in state:

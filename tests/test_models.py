@@ -1,0 +1,160 @@
+"""Model-level tests: tiny GPT-2 / Llama train a few steps and the loss
+drops (the reference's loss-parity-style oracle, SURVEY.md §4); attention
+numerics vs reference."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (GPT2Config, GPT2ForCausalLM, LlamaConfig,
+                               LlamaForCausalLM)
+
+
+def _lm_train(model, vocab, steps=12, seq=32, batch=4, lr=3e-3):
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    data = rng.randint(0, vocab, (batch, seq + 1)).astype(np.int64)
+    ids = paddle.to_tensor(data)
+    opt = paddle.optimizer.AdamW(lr, parameters=model.parameters())
+    losses = []
+    for _ in range(steps):
+        _, loss = model(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    return losses
+
+
+def test_gpt2_tiny_trains():
+    cfg = GPT2Config.tiny()
+    model = GPT2ForCausalLM(cfg)
+    losses = _lm_train(model, cfg.vocab_size)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_llama_tiny_trains():
+    cfg = LlamaConfig.tiny()
+    cfg.tensor_parallel = False
+    model = LlamaForCausalLM(cfg)
+    losses = _lm_train(model, cfg.vocab_size)
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_llama_tiny_trains_compiled():
+    cfg = LlamaConfig.tiny()
+    cfg.tensor_parallel = False
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(3e-3, parameters=model.parameters())
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (4, 33)).astype(np.int64))
+
+    @paddle.jit.to_static
+    def step(ids):
+        _, loss = model(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = [float(step(ids).item()) for _ in range(10)]
+    assert losses[-1] < losses[0] * 0.7, losses
+
+
+def test_llama_recompute_matches():
+    cfg = LlamaConfig.tiny()
+    cfg.tensor_parallel = False
+    paddle.seed(0)
+    m1 = LlamaForCausalLM(cfg)
+    cfg2 = LlamaConfig.tiny()
+    cfg2.tensor_parallel = False
+    cfg2.use_recompute = True
+    paddle.seed(0)
+    m2 = LlamaForCausalLM(cfg2)
+    m2.set_state_dict(m1.state_dict())
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (2, 17)).astype(np.int64))
+    _, l1 = m1(ids, labels=ids)
+    _, l2 = m2(ids, labels=ids)
+    np.testing.assert_allclose(l1.item(), l2.item(), rtol=1e-5)
+    l1.backward()
+    l2.backward()
+    p1 = dict(m1.named_parameters())
+    p2 = dict(m2.named_parameters())
+    for name in p1:
+        if p1[name].grad is not None:
+            assert p2[name].grad is not None, f"no grad through remat: {name}"
+            np.testing.assert_allclose(p1[name].grad.numpy(),
+                                       p2[name].grad.numpy(),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=name)
+
+
+def test_flash_reference_matches_sdpa():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas.flash_attention import \
+        flash_attention_reference
+    from paddle_tpu.nn.functional.attention import sdpa_reference
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, 16, 4, 8).astype(np.float32))
+    k = jnp.asarray(rng.randn(2, 16, 4, 8).astype(np.float32))
+    v = jnp.asarray(rng.randn(2, 16, 4, 8).astype(np.float32))
+    for causal in (False, True):
+        a = flash_attention_reference(q, k, v, causal=causal)
+        b = sdpa_reference(q, k, v, is_causal=causal)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_flash_bwd_rule_matches_autodiff():
+    """The custom flash bwd (blockwise recompute) vs jax autodiff of the
+    reference — causal and cross-length (decode) shapes."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.pallas import flash_attention as fa
+    rng = np.random.RandomState(1)
+    for sq, sk in [(16, 16), (8, 16)]:
+        q = jnp.asarray(rng.randn(1, sq, 2, 8).astype(np.float32))
+        k = jnp.asarray(rng.randn(1, sk, 2, 8).astype(np.float32))
+        v = jnp.asarray(rng.randn(1, sk, 2, 8).astype(np.float32))
+        g = jnp.asarray(rng.randn(1, sq, 2, 8).astype(np.float32))
+
+        def ref(q, k, v):
+            return fa.flash_attention_reference(q, k, v, causal=True)
+        out_ref, vjp = jax.vjp(ref, q, k, v)
+        dq_ref, dk_ref, dv_ref = vjp(g)
+        lse = _lse(q, k, True)
+        dq, dk, dv = fa._bwd_rule(True, None, (q, k, v, out_ref, lse), g)
+        np.testing.assert_allclose(np.asarray(dq), np.asarray(dq_ref),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dk), np.asarray(dk_ref),
+                                   rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(np.asarray(dv), np.asarray(dv_ref),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def _lse(q, k, causal):
+    import math
+    import jax.numpy as jnp
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    s = 1.0 / math.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * s
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), bool), k=sk - sq)
+        logits = jnp.where(mask, logits, -1e30)
+    lse = jnp.log(jnp.sum(jnp.exp(logits - logits.max(-1, keepdims=True)),
+                          -1)) + logits.max(-1)
+    return lse.reshape(b * h, sq)
+
+
+def test_gpt2_generate_shape():
+    cfg = GPT2Config.tiny()
+    model = GPT2ForCausalLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(np.ones((2, 10), np.int64))
+    logits = model(ids)
+    assert logits.shape == [2, 10, cfg.vocab_size]

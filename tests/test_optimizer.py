@@ -1,0 +1,162 @@
+"""Optimizer/scheduler tests — incl. regressions for lr-as-state under
+compiled train steps and lazy checkpoint restore."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _quadratic(opt_ctor, steps=60, **kw):
+    paddle.seed(0)
+    w = paddle.nn.Parameter(paddle.to_tensor([5.0, -3.0]).jax())
+    opt = opt_ctor(parameters=[w], **kw)
+    for _ in range(steps):
+        loss = (w * w).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return np.abs(w.numpy()).max()
+
+
+@pytest.mark.parametrize("ctor,kw", [
+    (paddle.optimizer.SGD, {"learning_rate": 0.1}),
+    (paddle.optimizer.Momentum, {"learning_rate": 0.05, "momentum": 0.9}),
+    (paddle.optimizer.Adam, {"learning_rate": 0.2}),
+    (paddle.optimizer.AdamW, {"learning_rate": 0.2}),
+    (paddle.optimizer.Adamax, {"learning_rate": 0.2}),
+    (paddle.optimizer.Adagrad, {"learning_rate": 0.5}),
+    (paddle.optimizer.Adadelta, {"learning_rate": 5.0, "steps": 400}),
+    (paddle.optimizer.RMSProp, {"learning_rate": 0.05, "steps": 300}),
+    (paddle.optimizer.Lamb, {"learning_rate": 0.05}),
+], ids=lambda v: getattr(v, "__name__", ""))
+def test_optimizers_converge(ctor, kw):
+    final = _quadratic(ctor, **kw)
+    assert final < 0.5, final
+
+
+def test_adam_matches_reference_impl():
+    """One Adam step vs hand-computed numpy reference."""
+    w0 = np.array([1.0, 2.0], np.float32)
+    g = np.array([0.5, -1.0], np.float32)
+    w = paddle.nn.Parameter(w0.copy())
+    w.grad = paddle.to_tensor(g)
+    opt = paddle.optimizer.Adam(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                                epsilon=1e-8, parameters=[w])
+    opt.step()
+    m = 0.1 * g
+    v = 0.001 * g * g
+    m_hat = m / (1 - 0.9)
+    v_hat = v / (1 - 0.999)
+    expected = w0 - 0.1 * m_hat / (np.sqrt(v_hat) + 1e-8)
+    np.testing.assert_allclose(w.numpy(), expected, rtol=1e-6)
+
+
+def test_adamw_decoupled_decay():
+    w = paddle.nn.Parameter(np.array([1.0], np.float32))
+    w.grad = paddle.to_tensor(np.array([0.0], np.float32))
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, weight_decay=0.5,
+                                 parameters=[w])
+    opt.step()
+    # zero grad → pure decay: w *= (1 - lr*wd)
+    np.testing.assert_allclose(w.numpy(), [1.0 * (1 - 0.1 * 0.5)],
+                               rtol=1e-6)
+
+
+def test_scheduler_updates_compiled_step():
+    """Regression: lr must flow into a to_static-compiled step as state,
+    not be baked at trace time."""
+    paddle.seed(0)
+    lin = nn.Linear(2, 1)
+    sched = paddle.optimizer.lr.StepDecay(1.0, step_size=1, gamma=0.1)
+    opt = paddle.optimizer.SGD(learning_rate=sched,
+                               parameters=lin.parameters())
+    x = paddle.ones([1, 2])
+
+    @paddle.jit.to_static
+    def step(x):
+        loss = lin(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    w_before = lin.weight.numpy().copy()
+    step(x)                       # discovery at lr=1.0
+    delta1 = np.abs(lin.weight.numpy() - w_before).max()
+    sched.step()                  # lr -> 0.1
+    w_mid = lin.weight.numpy().copy()
+    step(x)                       # compiled; must use the NEW lr
+    delta2 = np.abs(lin.weight.numpy() - w_mid).max()
+    assert 0.05 < delta2 / delta1 < 0.2, (delta1, delta2)
+
+
+def test_optimizer_resume_before_first_step():
+    """Regression: loading opt state into a fresh optimizer (lazy
+    accumulators) must not be a silent no-op."""
+    paddle.seed(0)
+    lin = nn.Linear(2, 2)
+    opt = paddle.optimizer.Adam(0.1, parameters=lin.parameters())
+    x = paddle.ones([1, 2])
+    for _ in range(3):
+        loss = (lin(x) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    sd = opt.state_dict()
+    w_ref = lin.weight.numpy().copy()
+
+    # fresh pair, restore BEFORE any step
+    paddle.seed(0)
+    lin2 = nn.Linear(2, 2)
+    lin2.set_state_dict(lin.state_dict())
+    opt2 = paddle.optimizer.Adam(0.1, parameters=lin2.parameters())
+    opt2.set_state_dict(sd)
+    # one more step on both; trajectories must match
+    for o, l in ((opt, lin), (opt2, lin2)):
+        loss = (l(x) ** 2).sum()
+        loss.backward()
+        o.step()
+        o.clear_grad()
+    np.testing.assert_allclose(lin.weight.numpy(), lin2.weight.numpy(),
+                               rtol=1e-6)
+
+
+def test_clip_by_global_norm():
+    w1 = paddle.nn.Parameter(np.ones(4, np.float32))
+    w2 = paddle.nn.Parameter(np.ones(4, np.float32))
+    w1.grad = paddle.to_tensor(np.full(4, 3.0, np.float32))
+    w2.grad = paddle.to_tensor(np.full(4, 4.0, np.float32))
+    clip = paddle.nn.ClipGradByGlobalNorm(1.0)
+    pgs = clip([(w1, w1.grad), (w2, w2.grad)])
+    total = np.sqrt(sum(np.sum(g.numpy() ** 2) for _, g in pgs))
+    np.testing.assert_allclose(total, 1.0, rtol=1e-5)
+
+
+def test_lr_schedulers_shapes():
+    lr = paddle.optimizer.lr.CosineAnnealingDecay(1.0, T_max=10)
+    vals = []
+    for _ in range(10):
+        vals.append(lr())
+        lr.step()
+    assert vals[0] == pytest.approx(1.0)
+    assert vals[-1] < 0.1
+    warm = paddle.optimizer.lr.LinearWarmup(0.5, 4, 0.0, 0.5)
+    seq = []
+    for _ in range(6):
+        seq.append(warm())
+        warm.step()
+    np.testing.assert_allclose(seq[:4], [0.0, 0.125, 0.25, 0.375])
+
+
+def test_grad_scaler_skips_nonfinite():
+    w = paddle.nn.Parameter(np.ones(2, np.float32))
+    opt = paddle.optimizer.SGD(0.1, parameters=[w])
+    sc = paddle.amp.GradScaler(init_loss_scaling=8.0,
+                               decr_every_n_nan_or_inf=1)
+    w.grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+    before = w.numpy().copy()
+    sc.step(opt)
+    np.testing.assert_allclose(w.numpy(), before)  # step skipped
+    assert sc.get_loss_scaling() == 4.0  # halved
