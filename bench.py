@@ -16,14 +16,16 @@ import time
 
 def _peak_flops(device) -> float:
     kind = getattr(device, "device_kind", "").lower()
+    # order matters: 'v6 lite' (v6e) must match before the generic
+    # 'lite'/'v5' clauses
+    if "v6" in kind:
+        return 918e12  # v6e (Trillium) bf16 peak
     if "v5p" in kind or "v5 p" in kind:
         return 459e12
     if "v5" in kind or "v5e" in kind or "lite" in kind:
         return 197e12  # v5e bf16 peak
     if "v4" in kind:
         return 275e12
-    if "v6" in kind:
-        return 918e12
     return 50e12  # unknown / CPU fallback so the line still prints
 
 
@@ -94,10 +96,10 @@ def main():
     tokens = batch * seq
     n_params = sum(p.size for p in model.parameters())
     L, d = cfg.num_hidden_layers, cfg.hidden_size
+    # MFU counts model FLOPs only (6*N*tokens + attention); recompute's
+    # re-forward work is real hardware time but not model FLOPs, so it is
+    # deliberately NOT added (that would report HFU and inflate the metric)
     flops_per_step = 6.0 * n_params * tokens + 12.0 * L * batch * seq * seq * d
-    if cfg.use_recompute:
-        # recompute re-runs the forward during backward: +~2*N*tokens
-        flops_per_step += 2.0 * n_params * tokens
     mfu = flops_per_step / dt / _peak_flops(dev)
     tok_per_s = tokens / dt
 

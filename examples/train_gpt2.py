@@ -34,7 +34,8 @@ def main():
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=128)
-    ap.add_argument("--compile", action="store_true", default=True)
+    ap.add_argument("--compile", action=argparse.BooleanOptionalAction,
+                    default=True)
     args = ap.parse_args()
 
     cfg = GPT2Config.tiny() if args.tiny else GPT2Config.small()

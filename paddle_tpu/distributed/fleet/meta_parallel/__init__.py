@@ -5,12 +5,18 @@ from ...parallel_layers import (ColumnParallelLinear, RowParallelLinear,
                                 VocabParallelEmbedding, ParallelCrossEntropy)
 from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
 from .pipeline_parallel import PipelineParallel
+from .context_parallel import (RingFlashAttention, ring_flash_attention,
+                               ulysses_attention,
+                               split_inputs_sequence_dim,
+                               gather_outputs_sequence_dim, sep_positions)
 from ....framework.random import get_rng_state_tracker
 
 __all__ = ["ColumnParallelLinear", "RowParallelLinear",
            "VocabParallelEmbedding", "ParallelCrossEntropy", "LayerDesc",
            "SharedLayerDesc", "PipelineLayer", "PipelineParallel",
-           "get_rng_state_tracker", "TensorParallel"]
+           "RingFlashAttention", "ring_flash_attention", "ulysses_attention",
+           "split_inputs_sequence_dim", "gather_outputs_sequence_dim",
+           "sep_positions", "get_rng_state_tracker", "TensorParallel"]
 
 
 def TensorParallel(model, hcg=None, **kwargs):

@@ -1,0 +1,168 @@
+"""SEP / context-parallel fleet surface — parity with the reference 'sep'
+hybrid-topology axis + PaddleNLP ``ring_flash_attention.py`` (SURVEY.md
+§2.3: CP/ring attention + Ulysses rows; reference mount empty, paths
+unverified).
+
+Tensor-level wrappers over the pure-jax core in
+``paddle_tpu.ops.ring_attention``: inside a compiled region whose mesh
+binds the 'sep' axis these lower to the ppermute K/V ring (or all-to-all
+for Ulysses); with sep degree 1 they fall back to ordinary full attention
+so the same model code runs everywhere (loss-parity oracle)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ....framework.core import Tensor, apply
+from ....ops import ring_attention as ra
+from ....nn.functional.attention import sdpa_reference
+from ...communication import in_traced_collective
+
+__all__ = ["RingFlashAttention", "ring_flash_attention", "ulysses_attention",
+           "sep_attention", "split_inputs_sequence_dim",
+           "gather_outputs_sequence_dim", "sep_positions"]
+
+
+def _hcg():
+    from ..base import fleet as fleet_singleton
+    return fleet_singleton._hcg
+
+
+def _sep_axis():
+    hcg = _hcg()
+    if hcg is None:
+        return None, 1
+    return hcg.sep_axis_name, hcg.get_sep_parallel_world_size()
+
+
+def ring_flash_attention(q, k, v, causal=True, scale=None,
+                         placement="contiguous"):
+    """Exact attention over a 'sep'-sharded sequence via the K/V ring.
+    q/k/v: Tensors [B, S_local, H, D]. Falls back to full attention when
+    the sep axis is unbound (sep degree 1)."""
+    axis, degree = _sep_axis()
+    group = _hcg().get_sep_parallel_group() if _hcg() is not None else None
+    if axis is not None and in_traced_collective(group):
+        def fn(qq, kk, vv):
+            return ra.ring_attention(qq, kk, vv, axis, causal=causal,
+                                     scale=scale, placement=placement)
+        return apply(fn, q, k, v, name="ring_flash_attention")
+
+    def fn(qq, kk, vv):
+        return sdpa_reference(qq, kk, vv, None, 0.0, causal, scale)
+    return apply(fn, q, k, v, name="ring_flash_attention_local")
+
+
+# PaddleNLP class-style alias
+RingFlashAttention = ring_flash_attention
+
+
+def ulysses_attention(q, k, v, causal=True, scale=None):
+    """Ulysses SEP attention (all-to-all head<->seq reshuffle)."""
+    axis, degree = _sep_axis()
+    group = _hcg().get_sep_parallel_group() if _hcg() is not None else None
+    if axis is not None and in_traced_collective(group):
+        def fn(qq, kk, vv):
+            return ra.ulysses_attention(qq, kk, vv, axis, causal=causal,
+                                        scale=scale)
+        return apply(fn, q, k, v, name="ulysses_attention")
+
+    def fn(qq, kk, vv):
+        return sdpa_reference(qq, kk, vv, None, 0.0, causal, scale)
+    return apply(fn, q, k, v, name="ulysses_attention_local")
+
+
+def sep_attention(q, k, v, causal=True, scale=None, impl="ring",
+                  placement="contiguous"):
+    """Context-parallel attention for *global-view* (GSPMD) programs.
+
+    Takes full-sequence Tensors [B, S, H, D] inside a jitted train step and
+    runs the K/V ring (or Ulysses all-to-all) manually over the mesh's
+    'sep' axis only — every other axis (data, model, sharding) stays under
+    automatic GSPMD partitioning (``jax.shard_map(axis_names={'sep'})``).
+    This is how the sep engine composes with TP/DP in one compiled program.
+    Falls back to full attention at sep degree 1."""
+    import jax as _jax
+    from jax.sharding import PartitionSpec as P
+
+    hcg = _hcg()
+    axis, degree = _sep_axis()
+    if hcg is None or degree <= 1 or hcg.global_mesh is None:
+        def fb(qq, kk, vv):
+            return sdpa_reference(qq, kk, vv, None, 0.0, causal, scale)
+        return apply(fb, q, k, v, name="sep_attention_local")
+
+    mesh = hcg.global_mesh
+    spec = P(None, axis, None, None)
+    if impl == "ring":
+        core = lambda a, b, c: ra.ring_attention(
+            a, b, c, axis, causal=causal, scale=scale, placement=placement)
+    elif impl == "ulysses":
+        core = lambda a, b, c: ra.ulysses_attention(
+            a, b, c, axis, causal=causal, scale=scale)
+    else:
+        raise ValueError(f"unknown sep impl {impl!r}")
+
+    def fn(qq, kk, vv):
+        f = _jax.shard_map(core, mesh=mesh, in_specs=(spec, spec, spec),
+                           out_specs=spec, axis_names={axis})
+        return f(qq, kk, vv)
+
+    return apply(fn, q, k, v, name=f"sep_attention_{impl}")
+
+
+def split_inputs_sequence_dim(inputs, rank=None, degree=None, axis=1,
+                              zigzag=False):
+    """Fleet's ``split_inputs_sequence_dim``: pre-shard host batches along
+    the sequence dim for the sep group. In single-process SPMD the whole
+    (optionally zigzag-reordered) sequence is returned and the mesh
+    sharding does the split; with an explicit rank the local slice is cut
+    out (multi-process layout)."""
+    if degree is None:
+        _, degree = _sep_axis()
+    if degree <= 1:
+        return inputs
+
+    def one(t):
+        arr = t.jax() if isinstance(t, Tensor) else jnp.asarray(t)
+        if arr.shape[axis] % degree:
+            raise ValueError(
+                f"sequence length {arr.shape[axis]} not divisible by sep "
+                f"degree {degree}")
+        if zigzag:
+            arr = ra.zigzag_reorder(arr, degree, axis=axis)
+        if rank is not None:
+            per = arr.shape[axis] // degree
+            sl = [slice(None)] * arr.ndim
+            sl[axis] = slice(rank * per, (rank + 1) * per)
+            arr = arr[tuple(sl)]
+        return Tensor(arr) if isinstance(t, Tensor) else arr
+
+    if isinstance(inputs, (list, tuple)):
+        return type(inputs)(one(t) for t in inputs)
+    if isinstance(inputs, dict):
+        return {k2: one(v2) for k2, v2 in inputs.items()}
+    return one(inputs)
+
+
+def gather_outputs_sequence_dim(outputs, degree=None, axis=1, zigzag=False):
+    """Undo zigzag reordering on a full (gathered) sequence tensor."""
+    if degree is None:
+        _, degree = _sep_axis()
+    if degree <= 1 or not zigzag:
+        return outputs
+    arr = outputs.jax() if isinstance(outputs, Tensor) else \
+        jnp.asarray(outputs)
+    arr = ra.zigzag_restore(arr, degree, axis=axis)
+    return Tensor(arr) if isinstance(outputs, Tensor) else arr
+
+
+def sep_positions(seq_len, degree=None, zigzag=False):
+    """Global RoPE position ids for a sep-sharded (optionally zigzag)
+    sequence, as a host numpy array of shape [seq_len]."""
+    import numpy as np
+    if degree is None:
+        _, degree = _sep_axis()
+    if zigzag and degree > 1:
+        return ra.zigzag_positions(seq_len, degree)
+    return np.arange(seq_len, dtype=np.int32)

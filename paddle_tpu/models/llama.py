@@ -38,6 +38,8 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     use_recompute: bool = False
     tensor_parallel: bool = True  # use TP layers (degenerate w/o mesh)
+    # context parallelism over the 'sep' mesh axis: None | "ring" | "ulysses"
+    sep_parallel: str | None = None
 
     @classmethod
     def llama3_8b(cls):
@@ -112,7 +114,13 @@ class LlamaAttention(nn.Layer):
             fused_rotary_position_embedding
         q, k, _ = fused_rotary_position_embedding(
             q, k, None, rotary_emb_base=self.cfg.rope_theta)
-        ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        if self.cfg.sep_parallel is not None:
+            from ..distributed.fleet.meta_parallel.context_parallel import \
+                sep_attention
+            ctx = sep_attention(q, k, v, causal=True,
+                                impl=self.cfg.sep_parallel)
+        else:
+            ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         ctx = M.reshape(ctx, [b, s, self.num_heads * self.head_dim])
         return self.o_proj(ctx)
 

@@ -60,6 +60,8 @@ class LRScheduler:
 
     def set_state_dict(self, state):
         self.__dict__.update(state)
+        # sync the restored lr into bound optimizers' compiled-state arrays
+        self._push_lr()
 
     set_dict = set_state_dict
     state_keys = state_dict

@@ -72,8 +72,8 @@ class Optimizer:
         self._sync_lr_state(value)
 
     def _sync_lr_state(self, value: float) -> None:
-        from jax._src.core import trace_state_clean
-        if trace_state_clean():
+        from ..framework.core import trace_clean
+        if trace_clean():
             self._lr_state.set_data(jnp.asarray(value, jnp.float32))
 
     # -- accumulators ------------------------------------------------------
@@ -142,8 +142,8 @@ class Optimizer:
         """Scalar lr used by update math. Outside a trace it is refreshed
         from the scheduler; inside a trace it is read as state, so compiled
         steps see per-call lr."""
-        from jax._src.core import trace_state_clean
-        if trace_state_clean():
+        from ..framework.core import trace_clean
+        if trace_clean():
             self._lr_state.set_data(jnp.asarray(self.get_lr(), jnp.float32))
         return self._lr_state.jax()
 
