@@ -1,21 +1,41 @@
 """PipelineParallel runtime — fleet ``pipeline_parallel.py`` parity
-(UNVERIFIED).
+(UNVERIFIED; reference mount empty).
 
-Reference: 1F1B/interleaved schedules over NCCL p2p between stage processes
-(SURVEY.md §3.4). TPU-native round-1 engine: microbatched GPipe-style
-schedule executed as python-driven microbatch loop with gradient
-accumulation. With pp_degree==1 (or single process) every stage runs
-locally — this is the loss-parity reference. The shard_map+ppermute
-multi-stage compiled schedule lands in the pipeline module
-(paddle_tpu/distributed/pipeline.py) and is used when a mesh 'pipe' axis
-has >1 devices."""
+Reference: FThenB/1F1B/interleaved schedules over NCCL p2p between stage
+processes (SURVEY.md §3.4). TPU-native engine:
+
+- pp_degree == 1: python-driven microbatch loop with gradient
+  accumulation (the loss-parity oracle, and the eager-debug path — the
+  role dygraph plays vs to_static in the reference).
+- pp_degree > 1: ONE compiled program over the mesh's 'pipe' axis
+  (``paddle_tpu.distributed.pipeline``): the PipelineLayer's layer list
+  is decomposed into [prologue | uniform body | epilogue]; the body —
+  the run of structurally-identical layers (transformer decoder stack) —
+  is split into S stage groups whose weights are stacked [S, ...] and
+  sharded over 'pipe'; prologue (embedding) and epilogue (norm/head/loss)
+  run under plain GSPMD. Activations hop stages via ppermute inside a
+  lax.scan (see pipeline.py for the schedule/bubble analysis). The
+  backward pipeline is jax reverse-mode through that scan.
+"""
 
 from __future__ import annotations
 
-from ....framework.core import Tensor
+import jax
+import jax.numpy as jnp
+
+from ....framework.core import Tensor, apply, no_grad
 from ....ops.manipulation import split as split_op
 
 __all__ = ["PipelineParallel"]
+
+
+def _param_sig(layer):
+    """Structural identity of a layer: class + param shapes/dtypes. The
+    class matters — two layers with identical parameters but different
+    forward() must not land in the same uniform body run."""
+    return (type(layer).__name__,
+            tuple((tuple(p.shape), str(p.dtype))
+                  for p in layer.parameters()))
 
 
 class PipelineParallel:
@@ -23,6 +43,11 @@ class PipelineParallel:
         self._layers = layers
         self._hcg = hcg
         self.accumulate_steps = max(int(accumulate_steps), 1)
+        self._pp_degree = (hcg.get_pipe_parallel_world_size()
+                           if hcg is not None else 1)
+        self._compiled_plan = None
+        if self._pp_degree > 1:
+            self._compiled_plan = self._build_plan()
 
     def __getattr__(self, name):
         return getattr(self._layers, name)
@@ -30,10 +55,131 @@ class PipelineParallel:
     def __call__(self, *args, **kwargs):
         return self._layers(*args, **kwargs)
 
+    # ---- compiled-plan construction -------------------------------------
+
+    def _build_plan(self):
+        """Split run_function into prologue / uniform body / epilogue and
+        group the body into S stages of equal layer count."""
+        S = self._pp_degree
+        layer_list = list(self._layers.run_function)
+        sigs = [_param_sig(l) for l in layer_list]
+        # longest contiguous run of identical non-empty signatures
+        best = (0, 0)  # (start, length)
+        i = 0
+        while i < len(layer_list):
+            if not sigs[i][1]:  # param-less layers can't anchor the body
+                i += 1
+                continue
+            j = i
+            while j < len(layer_list) and sigs[j] == sigs[i]:
+                j += 1
+            if j - i > best[1]:
+                best = (i, j - i)
+            i = j
+        start, length = best
+        usable = (length // S) * S
+        if usable < S:
+            raise ValueError(
+                f"pipeline compile: need a run of >= {S} structurally "
+                f"identical layers to partition over {S} stages; found "
+                f"{length}. Adjust the PipelineLayer or pp_degree.")
+        # keep trailing non-uniform layers in the epilogue; any uniform
+        # surplus (length - usable) also joins the epilogue
+        body = layer_list[start:start + usable]
+        prologue = layer_list[:start]
+        epilogue = layer_list[start + usable:]
+        per_stage = usable // S
+        groups = [body[g * per_stage:(g + 1) * per_stage]
+                  for g in range(S)]
+        group_params = [[p for l in grp for p in l.parameters()]
+                        for grp in groups]
+        n_leaves = len(group_params[0])
+        for gp in group_params[1:]:
+            assert len(gp) == n_leaves
+        return {
+            "prologue": prologue,
+            "groups": groups,
+            "epilogue": epilogue,
+            "group_params": group_params,
+            "n_leaves": n_leaves,
+            "per_stage": per_stage,
+        }
+
+    def _body_apply(self, h_micro):
+        """Run the stacked body pipeline as ONE differentiable op:
+        apply(fn, h_micro, *all_group_params)."""
+        from ...pipeline import run_pipeline
+        plan = self._compiled_plan
+        S = self._pp_degree
+        n_leaves = plan["n_leaves"]
+        template = plan["groups"][0]
+        template_params = [p for l in template for p in l.parameters()]
+        mesh = self._hcg.global_mesh
+        remat = "stage" if getattr(self._layers, "_recompute_interval", 0) \
+            else None
+        flat = [p for gp in plan["group_params"] for p in gp]
+
+        def fn(hm, *leaves):
+            stacked = tuple(
+                jnp.stack([leaves[g * n_leaves + i] for g in range(S)])
+                for i in range(n_leaves))
+
+            def stage_fn(params_one, x):
+                originals = [(p, p._data) for p in template_params]
+                try:
+                    for p, a in zip(template_params, params_one):
+                        p._data = a
+                    t = Tensor(x)
+                    with no_grad():
+                        for l in template:
+                            t = l(t)
+                    return t.jax() if isinstance(t, Tensor) else t
+                finally:
+                    for p, a in originals:
+                        p._data = a
+
+            return run_pipeline(stage_fn, stacked, hm, mesh,
+                                axis_name=self._hcg.pp_axis_name,
+                                remat=remat)
+
+        return apply(fn, h_micro, *flat, name="pipeline_body")
+
+    def _forward_compiled(self, inputs):
+        plan = self._compiled_plan
+        M = self.accumulate_steps
+        h = inputs
+        for l in plan["prologue"]:
+            h = l(h)
+        b = h.shape[0]
+        if b % M:
+            raise ValueError(f"batch {b} not divisible by "
+                             f"accumulate_steps {M}")
+        from ....ops.manipulation import reshape
+        h_micro = reshape(h, [M, b // M] + list(h.shape[1:]))
+        out_micro = self._body_apply(h_micro)
+        out = reshape(out_micro, [b] + list(out_micro.shape[2:]))
+        for l in plan["epilogue"]:
+            out = l(out)
+        return out
+
+    # ---- train / eval ----------------------------------------------------
+
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
-        """Split into microbatches, accumulate grads, one optimizer step.
-        Returns the mean loss (paddle semantics)."""
+        """Microbatch-accumulated step; one optimizer step. Returns the
+        mean loss (paddle semantics)."""
         inputs, labels = data
+        if self._compiled_plan is not None:
+            out = self._forward_compiled(inputs)
+            loss = self._layers._loss_fn(out, labels)
+            loss.backward()
+            if scaler is not None:
+                scaler.step(optimizer)
+            else:
+                optimizer.step()
+            optimizer.clear_grad()
+            if lr_scheduler is not None:
+                lr_scheduler.step()
+            return loss
         n = self.accumulate_steps
         if n > 1:
             micro_x = split_op(inputs, n, axis=0)
@@ -57,6 +203,12 @@ class PipelineParallel:
 
     def eval_batch(self, data, compute_loss=True):
         inputs, labels = data
+        if self._compiled_plan is not None:
+            with no_grad():
+                out = self._forward_compiled(inputs)
+                if compute_loss and self._layers._loss_fn is not None:
+                    return self._layers._loss_fn(out, labels)
+                return out
         out = self._layers(inputs)
         if compute_loss and self._layers._loss_fn is not None:
             return self._layers._loss_fn(out, labels)
