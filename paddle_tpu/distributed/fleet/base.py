@@ -29,6 +29,7 @@ class DistributedStrategy:
             "pp_degree": 1,
             "sharding_degree": 1,
             "sep_degree": 1,
+            "ep_degree": 1,
         }
         self.amp = False
         self.amp_configs = {}
@@ -82,16 +83,17 @@ class Fleet:
         pp = max(int(hc.get("pp_degree", 1)), 1)
         sh = max(int(hc.get("sharding_degree", 1)), 1)
         sep = max(int(hc.get("sep_degree", 1)), 1)
+        ep = max(int(hc.get("ep_degree", 1)), 1)
         dp = int(hc.get("dp_degree", -1))
         if dp in (-1, 0):
-            dp = max(n // (mp * pp * sh * sep), 1)
-        total = dp * sh * pp * sep * mp
+            dp = max(n // (mp * pp * sh * sep * ep), 1)
+        total = dp * sh * pp * sep * mp * ep
         if total > n:
             raise ValueError(
-                f"hybrid degrees {dp}x{sh}x{pp}x{sep}x{mp}={total} exceed "
-                f"device count {n}")
-        names = ("data", "sharding", "pipe", "sep", "model")
-        dims = (dp, sh, pp, sep, mp)
+                f"hybrid degrees {dp}x{sh}x{pp}x{sep}x{mp}x{ep}={total} "
+                f"exceed device count {n}")
+        names = ("data", "sharding", "pipe", "sep", "model", "expert")
+        dims = (dp, sh, pp, sep, mp, ep)
         self._topology = CommunicateTopology(names, dims)
         devices = np.asarray(jax.devices()[:total]).reshape(dims)
         mesh = Mesh(devices, names)

@@ -21,8 +21,8 @@ __all__ = ["CommunicateTopology", "HybridCommunicateGroup"]
 
 class CommunicateTopology:
     def __init__(self, hybrid_group_names=("data", "pipe", "sharding",
-                                           "sep", "model"),
-                 dims=(1, 1, 1, 1, 1)):
+                                           "sep", "model", "expert"),
+                 dims=(1, 1, 1, 1, 1, 1)):
         self._names = list(hybrid_group_names)
         self._dims = list(dims)
         self._world = int(np.prod(self._dims))
@@ -79,12 +79,14 @@ class HybridCommunicateGroup:
         self._pp_rank = coord.get("pipe", 0)
         self._mp_rank = coord.get("model", 0)
         self._sep_rank = coord.get("sep", 0)
+        self._ep_rank = coord.get("expert", 0)
         # axis names for collectives
         self.dp_axis_name = "data"
         self.sharding_axis_name = "sharding"
         self.pp_axis_name = "pipe"
         self.mp_axis_name = "model"
         self.sep_axis_name = "sep"
+        self.ep_axis_name = "expert"
         self._groups = {
             name: new_group(
                 ranks=topology.get_axis_list(
@@ -179,6 +181,19 @@ class HybridCommunicateGroup:
 
     def get_sep_parallel_group(self) -> Group:
         return self._groups["sep"]
+
+    # expert parallel
+    def get_expert_parallel_rank(self):
+        return self._ep_rank
+
+    def get_expert_parallel_world_size(self):
+        try:
+            return self._topo.get_dim("expert")
+        except ValueError:
+            return 1
+
+    def get_expert_parallel_group(self) -> Group:
+        return self._groups.get("expert")
 
     # checks
     def get_check_parallel_group(self, *a):

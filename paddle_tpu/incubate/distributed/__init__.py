@@ -1,0 +1,5 @@
+"""``paddle.incubate.distributed`` namespace (parity; UNVERIFIED)."""
+
+from . import models
+
+__all__ = ["models"]

@@ -1,0 +1,5 @@
+"""``paddle.incubate.distributed.models`` (parity; UNVERIFIED)."""
+
+from . import moe
+
+__all__ = ["moe"]

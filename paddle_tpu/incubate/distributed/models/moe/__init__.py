@@ -1,0 +1,6 @@
+"""``paddle.incubate.distributed.models.moe`` — MoE layers
+(paddle/incubate/distributed/models/moe parity, UNVERIFIED)."""
+
+from .moe_layer import MoELayer, GShardGate, SwitchGate
+
+__all__ = ["MoELayer", "GShardGate", "SwitchGate"]

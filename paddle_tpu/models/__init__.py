@@ -5,6 +5,9 @@ paddle_tpu's nn + parallel layers + Pallas kernels."""
 
 from .gpt2 import GPT2Config, GPT2Model, GPT2ForCausalLM
 from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM
+from .qwen2 import (Qwen2Config, Qwen2MoeConfig, Qwen2ForCausalLM,
+                    Qwen2MoeForCausalLM)
 
 __all__ = ["GPT2Config", "GPT2Model", "GPT2ForCausalLM", "LlamaConfig",
-           "LlamaModel", "LlamaForCausalLM"]
+           "LlamaModel", "LlamaForCausalLM", "Qwen2Config",
+           "Qwen2MoeConfig", "Qwen2ForCausalLM", "Qwen2MoeForCausalLM"]

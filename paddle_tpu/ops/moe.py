@@ -1,0 +1,136 @@
+"""Mixture-of-Experts core — pure jax, shape-static, TPU-first.
+
+Reference parity: ``paddle/incubate/distributed/models/moe`` (MoELayer,
+top-k gate, all-to-all dispatch/combine, aux load-balance loss) and the
+phi ``moe_*`` GPU dispatch kernels (SURVEY.md §2.1 EP row, §2.3 EP).
+Reference mount was empty; no file:line citations available.
+
+TPU-native design — NOT a port of the token-index scatter kernels:
+
+- Gating/dispatch is the GShard/Switch *capacity* formulation: one-hot
+  dispatch masks built with cumsum position counters, so every shape is
+  static under jit (no ragged scatter; dropped tokens are handled by the
+  capacity factor exactly as in the reference's capacity mode).
+- Expert compute is a *grouped matmul* over a stacked expert weight bank
+  ([E, d, h] einsum) — big, batched MXU work instead of per-expert loops.
+- Expert parallelism is an ``lax.all_to_all`` pair over the 'expert' mesh
+  axis inside shard_map: tokens travel to their expert's device and back,
+  exactly the reference's NCCL all-to-all but compiled into the program
+  so XLA overlaps it with the gate/combine math.
+- The auxiliary load-balance loss (mean fraction × mean prob, ×E) and the
+  router z-loss follow the standard formulations.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["top_k_gating", "moe_dispatch_combine", "moe_ffn_grouped",
+           "moe_forward", "moe_forward_ep"]
+
+
+def top_k_gating(logits, k, capacity, norm_topk_prob=True):
+    """Top-k softmax gating with capacity-bounded dispatch tensors.
+
+    logits: [T, E] router outputs (fp32 recommended).
+    Returns (dispatch [T, E, C] bool, combine [T, E, C] float,
+    aux_loss scalar, z_loss scalar).
+    """
+    T, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = lax.top_k(probs, k)          # [T, k]
+    if norm_topk_prob:
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+
+    # one-hot per assignment: [T, k, E]
+    assign = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)
+    # position of each assignment within its expert queue, counting down
+    # the token dim then the k dim (priority: token order, then rank)
+    flat = assign.reshape(T * k, E)                     # row-major (t, k)
+    pos = jnp.cumsum(flat, axis=0) - flat               # positions 0-based
+    pos = pos.reshape(T, k, E)
+    within_cap = pos < capacity
+    keep = assign * within_cap                          # [T, k, E]
+
+    # aux load-balance loss (Switch): E * sum_e(frac_assign_e * mean_prob_e)
+    me = jnp.mean(probs, axis=0)                        # [E]
+    ce = jnp.sum(jax.nn.one_hot(gate_idx, E), axis=(0, 1)) / (T * k)
+    aux_loss = E * jnp.sum(me * ce)
+    z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+
+    # dispatch/combine: [T, E, C]
+    C = capacity
+    pos_cap = jnp.clip(pos, 0, C - 1).astype(jnp.int32)
+    pos_onehot = jax.nn.one_hot(pos_cap, C, dtype=jnp.float32)  # [T,k,E,C]
+    disp_k = keep[..., None] * pos_onehot               # [T, k, E, C]
+    dispatch = jnp.sum(disp_k, axis=1)                  # [T, E, C]
+    combine = jnp.sum(disp_k * gate_vals[:, :, None, None], axis=1)
+    return dispatch, combine, aux_loss, z_loss
+
+
+def moe_dispatch_combine(x, dispatch, combine, expert_fn):
+    """Dense (single-device) capacity dispatch: x [T, d] -> [T, d]."""
+    xd = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
+    out = expert_fn(xd)                                 # [E, C, d]
+    return jnp.einsum("tec,ecd->td", combine.astype(out.dtype), out)
+
+
+def moe_ffn_grouped(xd, w_gate, w_up, w_down, act=jax.nn.silu):
+    """Grouped SwiGLU FFN over the expert dim: xd [E, C, d],
+    w_gate/w_up [E, d, h], w_down [E, h, d]."""
+    g = jnp.einsum("ecd,edh->ech", xd, w_gate)
+    u = jnp.einsum("ecd,edh->ech", xd, w_up)
+    h = act(g) * u
+    return jnp.einsum("ech,ehd->ecd", h, w_down)
+
+
+def moe_forward(x, router_w, expert_fn, k=2, capacity_factor=1.25,
+                norm_topk_prob=True):
+    """Single-device MoE block: x [T, d], router_w [d, E].
+    Returns (out [T, d], aux_loss, z_loss)."""
+    T = x.shape[0]
+    E = router_w.shape[1]
+    capacity = max(int(capacity_factor * k * T / E), 1)
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    dispatch, combine, aux, z = top_k_gating(logits, k, capacity,
+                                             norm_topk_prob)
+    out = moe_dispatch_combine(x, dispatch, combine, expert_fn)
+    return out.astype(x.dtype), aux, z
+
+
+def moe_forward_ep(x, router_w, expert_fn_local, axis_name, k=2,
+                   capacity_factor=1.25, norm_topk_prob=True):
+    """Expert-parallel MoE inside shard_map over ``axis_name``.
+
+    x: [T_local, d] this device's tokens. router_w [d, E] replicated.
+    expert_fn_local([E_local, C_total, d]) -> same shape — computes this
+    device's experts on all devices' tokens (weights already local).
+    Two all-to-alls move token slots expert-ward and back (the NCCL
+    alltoall pair of the reference, compiled over ICI).
+    """
+    ep = lax.psum(1, axis_name)
+    T = x.shape[0]
+    E = router_w.shape[1]
+    if E % ep:
+        raise ValueError(f"num_experts {E} not divisible by ep degree {ep}")
+    capacity = max(int(capacity_factor * k * T / E), 1)
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    dispatch, combine, aux, z = top_k_gating(logits, k, capacity,
+                                             norm_topk_prob)
+    xd = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)  # [E,C,d]
+    # send each expert-slice to its owner; receive every device's slots
+    # for the local experts: [E, C, d] -> [E/ep, ep*C, d]
+    xd = lax.all_to_all(xd, axis_name, split_axis=0, concat_axis=1,
+                        tiled=True)
+    out = expert_fn_local(xd)                           # [E/ep, ep*C, d]
+    out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
+                         tiled=True)                    # [E, C, d]
+    y = jnp.einsum("tec,ecd->td", combine.astype(out.dtype), out)
+    # aux losses are per-device estimates; average over the ep group
+    aux = lax.pmean(aux, axis_name)
+    z = lax.pmean(z, axis_name)
+    return y.astype(x.dtype), aux, z

@@ -1,0 +1,109 @@
+"""Qwen2 / Qwen2-MoE model tests: eager training sanity, compiled-step
+parity, and expert-parallel execution under a fleet 'expert' mesh axis."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import (Qwen2Config, Qwen2MoeConfig,
+                               Qwen2ForCausalLM, Qwen2MoeForCausalLM)
+
+
+def _ids(cfg, batch=2, seq=17, seed=0):
+    return paddle.to_tensor(np.random.RandomState(seed).randint(
+        0, cfg.vocab_size, (batch, seq)).astype(np.int64))
+
+
+def test_qwen2_dense_trains():
+    cfg = Qwen2Config.tiny()
+    paddle.seed(0)
+    model = Qwen2ForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    ids = _ids(cfg)
+
+    @paddle.jit.to_static
+    def step(t):
+        _, loss = model(t, labels=t)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = [float(step(ids).item()) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_qwen2_moe_trains_and_uses_aux_loss():
+    cfg = Qwen2MoeConfig.tiny()
+    paddle.seed(0)
+    model = Qwen2MoeForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    ids = _ids(cfg, seq=16)
+
+    _, loss = model(ids, labels=ids)
+    # router weights participate in the graph (aux loss)
+    loss.backward()
+    router_grads = [l.mlp.moe.router_weight.grad for l in model.layers]
+    assert all(g is not None for g in router_grads)
+    opt.step()
+    opt.clear_grad()
+
+    @paddle.jit.to_static
+    def step(t):
+        _, loss = model(t, labels=t)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    losses = [float(step(ids).item()) for _ in range(4)]
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]
+
+
+def test_qwen2_moe_expert_parallel():
+    """ep_degree=4: loss parity (same seed) vs the dense-device run, and a
+    compiled EP train step executes."""
+    from paddle_tpu.distributed import fleet
+
+    cfg = Qwen2MoeConfig.tiny()
+    paddle.seed(0)
+    ref_model = Qwen2MoeForCausalLM(cfg)
+    ids = _ids(cfg, batch=4, seq=16)
+    with paddle.no_grad():
+        _, ref_loss = ref_model(ids, labels=ids)
+    ref = float(ref_loss.item())
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 1, "ep_degree": 4}
+    fleet.init(strategy=strategy)
+    try:
+        paddle.seed(0)
+        model = Qwen2MoeForCausalLM(cfg)
+        with paddle.no_grad():
+            _, loss = model(ids, labels=ids)
+        # EP applies the capacity quota per device rather than globally,
+        # so token-drop patterns (and the loss) may differ slightly —
+        # the reference's per-rank capacity semantics behave the same way
+        assert abs(float(loss.item()) - ref) < 5e-3
+
+        opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+
+        @paddle.jit.to_static
+        def step(t):
+            _, l = model(t, labels=t)
+            l.backward()
+            opt.step()
+            opt.clear_grad()
+            return l
+
+        losses = [float(step(ids).item()) for _ in range(3)]
+        assert all(np.isfinite(l) for l in losses)
+        assert losses[-1] < losses[0]
+    finally:
+        fleet.fleet._hcg = None
+        fleet.fleet._topology = None
+        fleet.fleet._is_initialized = False
