@@ -1,0 +1,202 @@
+"""Megatron-style sequence parallelism — parity with fleet
+``utils/sequence_parallel_utils.py`` (ScatterOp/GatherOp/AllGatherOp/
+ReduceScatterOp autograd-aware comm ops + Column/RowSequenceParallelLinear
++ mark_as_sequence_parallel_parameter; SURVEY.md §2.3 SP row. Reference
+mount empty, no cites).
+
+TPU-native mechanism: in the reference, SP hand-writes the comm pattern —
+activations around LayerNorm/dropout are *scattered* along the sequence
+dim within the TP group (memory win), and the Column/Row linears trade the
+TP identity/allreduce pair for allgather/reduce-scatter. Under GSPMD all
+four ops are *sharding constraints* on the seq dim over the 'model' mesh
+axis: XLA inserts exactly those allgathers/reduce-scatters, placed and
+overlapped by the scheduler. Inside an explicit shard_map region the ops
+lower to the literal collectives, matching the reference semantics.
+
+The parameter-marking / hook-registration APIs exist for source parity:
+with GSPMD the LayerNorm params are replicated and their grads are
+correctly summed by the partitioner, so the hooks are no-ops.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ....framework.core import Tensor, apply
+from ....nn.layer.layers import Layer
+from ....nn import functional as F
+from ....nn import initializer as I
+from ...communication import in_traced_collective
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+
+def _mp():
+    from ..base import fleet as fleet_singleton
+    hcg = fleet_singleton._hcg
+    if hcg is None:
+        return None, None, 1
+    return (hcg.mp_axis_name, hcg.global_mesh,
+            hcg.get_model_parallel_world_size())
+
+
+def _constrain(t: Tensor, spec) -> Tensor:
+    axis, mesh, world = _mp()
+    if mesh is None or world <= 1:
+        return t
+    ns = NamedSharding(mesh, spec)
+
+    def fn(a):
+        if isinstance(a, jax.core.Tracer):
+            return lax.with_sharding_constraint(a, ns)
+        return jax.device_put(a, ns)
+    return apply(fn, t, name="sp_constraint")
+
+
+def ScatterOp(x, axis=1):
+    """Split activations along the sequence dim across the TP group.
+    GSPMD: a seq-dim sharding constraint. shard_map: reduce_scatter-free
+    local slice (inputs are replicated in the mp group there)."""
+    axis_name, mesh, world = _mp()
+    if world <= 1:
+        return x
+    if in_traced_collective():
+        def fn(a):
+            r = lax.axis_index(axis_name)
+            per = a.shape[axis] // lax.axis_size(axis_name)
+            return lax.dynamic_slice_in_dim(a, r * per, per, axis)
+        return apply(fn, x, name="sp_scatter")
+    spec = [None] * x.ndim
+    spec[axis] = axis_name
+    return _constrain(x, PartitionSpec(*spec))
+
+
+def GatherOp(x, axis=1):
+    """Re-assemble the full sequence (inverse of ScatterOp)."""
+    axis_name, mesh, world = _mp()
+    if world <= 1:
+        return x
+    if in_traced_collective():
+        return apply(lambda a: lax.all_gather(a, axis_name, axis=axis,
+                                              tiled=True), x,
+                     name="sp_gather")
+    return _constrain(x, PartitionSpec(*([None] * x.ndim)))
+
+
+# reference aliases: AllGather on the seq dim / ReduceScatter of partials
+AllGatherOp = GatherOp
+
+
+def ReduceScatterOp(x, axis=1):
+    """Sum partial activations over the TP group and shard the result
+    along the seq dim (row-parallel epilogue under SP)."""
+    axis_name, mesh, world = _mp()
+    if world <= 1:
+        return x
+    if in_traced_collective():
+        return apply(lambda a: lax.psum_scatter(a, axis_name,
+                                                scatter_dimension=axis,
+                                                tiled=True), x,
+                     name="sp_reduce_scatter")
+    # GSPMD: a psum has already been folded by the partitioner; constrain
+    # the result onto the seq dim
+    spec = [None] * x.ndim
+    spec[axis] = axis_name
+    return _constrain(x, PartitionSpec(*spec))
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Column-parallel linear whose INPUT is sequence-sharded: the seq dim
+    is gathered (by GSPMD/collective) and the output is feature-sharded."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        self.gather_output = gather_output
+        axis, mesh, world = _mp()
+        self._axis, self._mesh, self.world_size = axis, mesh, world
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.is_distributed = world > 1
+        if mesh is not None and world > 1:
+            self.weight.set_data(jax.device_put(
+                self.weight._data,
+                NamedSharding(mesh, PartitionSpec(None, axis))))
+        self.bias = self.create_parameter(
+            [out_features], is_bias=True,
+            default_initializer=I.Constant(0.0)) if has_bias else None
+
+    def forward(self, x):
+        axis, world = self._axis, self.world_size
+        if in_traced_collective() and axis is not None and world > 1:
+            x = GatherOp(x, axis=1)
+        out = F.linear(x, self.weight, self.bias)
+        if not self.gather_output and self._mesh is not None and world > 1 \
+                and not in_traced_collective():
+            spec = [None] * out.ndim
+            spec[-1] = axis
+            out = _constrain(out, PartitionSpec(*spec))
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """Row-parallel linear whose OUTPUT is sequence-sharded: partial sums
+    are reduce-scattered along the seq dim instead of allreduced."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, mp_group=None,
+                 name=None):
+        super().__init__()
+        self.in_features = in_features
+        self.out_features = out_features
+        axis, mesh, world = _mp()
+        self._axis, self._mesh, self.world_size = axis, mesh, world
+        self.weight = self.create_parameter(
+            [in_features, out_features], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.weight.is_distributed = world > 1
+        if mesh is not None and world > 1:
+            self.weight.set_data(jax.device_put(
+                self.weight._data,
+                NamedSharding(mesh, PartitionSpec(axis, None))))
+        self.bias = self.create_parameter(
+            [out_features], is_bias=True,
+            default_initializer=I.Constant(0.0)) if has_bias else None
+
+    def forward(self, x):
+        axis, world = self._axis, self.world_size
+        if in_traced_collective() and axis is not None and world > 1:
+            out = F.linear(x, self.weight, None)
+            out = ReduceScatterOp(out, axis=1)
+            if self.bias is not None:
+                out = out + self.bias
+            return out
+        out = F.linear(x, self.weight, None)
+        if self._mesh is not None and world > 1:
+            out = ReduceScatterOp(out, axis=1)
+        if self.bias is not None:
+            out = out + self.bias
+        return out
+
+
+def mark_as_sequence_parallel_parameter(param):
+    """Reference: tags LayerNorm params in the SP region so their grads
+    get allreduced over the TP group. GSPMD sums replicated-param grads
+    automatically; we keep the tag for introspection/source parity."""
+    param.sequence_parallel = True
+    return param
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_sequence_parallel_allreduce=False):
+    """No-op under GSPMD (see module docstring); kept for source parity."""
+    return model

@@ -40,6 +40,9 @@ class LlamaConfig:
     tensor_parallel: bool = True  # use TP layers (degenerate w/o mesh)
     # context parallelism over the 'sep' mesh axis: None | "ring" | "ulysses"
     sep_parallel: str | None = None
+    # Megatron-style SP: keep LN/residual activations sequence-sharded over
+    # the 'model' axis (memory win; XLA inserts the gathers)
+    sequence_parallel: bool = False
 
     @classmethod
     def llama3_8b(cls):
@@ -142,15 +145,29 @@ class LlamaMLP(nn.Layer):
 class LlamaDecoderLayer(nn.Layer):
     def __init__(self, cfg: LlamaConfig):
         super().__init__()
+        self.cfg = cfg
         self.input_layernorm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
         self.self_attn = LlamaAttention(cfg)
         self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
                                                    cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg)
+        if cfg.sequence_parallel:
+            from ..distributed.fleet.utils import \
+                mark_as_sequence_parallel_parameter
+            for p in self.input_layernorm.parameters():
+                mark_as_sequence_parallel_parameter(p)
+            for p in self.post_attention_layernorm.parameters():
+                mark_as_sequence_parallel_parameter(p)
+
+    def _sp(self, t):
+        if not self.cfg.sequence_parallel:
+            return t
+        from ..distributed.fleet.utils import ScatterOp
+        return ScatterOp(t, axis=1)
 
     def forward(self, x):
-        x = x + self.self_attn(self.input_layernorm(x))
-        x = x + self.mlp(self.post_attention_layernorm(x))
+        x = x + self.self_attn(self._sp(self.input_layernorm(x)))
+        x = x + self.mlp(self._sp(self.post_attention_layernorm(x)))
         return x
 
 
