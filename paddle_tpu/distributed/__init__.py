@@ -26,6 +26,7 @@ from .parallel_layers import (ColumnParallelLinear, RowParallelLinear,
 from .auto_parallel_api import (to_static as dist_to_static, Strategy,
                                 DistAttr, DistModel, unshard_dtensor)
 from . import launch  # noqa: F401
+from ..native import TCPStore  # noqa: F401 — rendezvous control plane
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "is_initialized",

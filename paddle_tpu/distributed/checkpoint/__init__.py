@@ -1,5 +1,5 @@
-from .save_load import save_state_dict, load_state_dict
+from .save_load import save_state_dict, load_state_dict, wait_async_save
 from .metadata import Metadata, LocalTensorMetadata
 
-__all__ = ["save_state_dict", "load_state_dict", "Metadata",
-           "LocalTensorMetadata"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_async_save",
+           "Metadata", "LocalTensorMetadata"]

@@ -34,8 +34,66 @@ def _flat(state_dict, prefix=""):
     return out
 
 
+
+def _save_np(path, arr):
+    """np.save with non-native dtypes (bfloat16, fp8) stored as byte-width
+    integer views — numpy's npy format cannot round-trip ml_dtypes."""
+    arr = np.asarray(arr)
+    if arr.dtype.kind == "V" or str(arr.dtype) in (
+            "bfloat16", "float8_e4m3fn", "float8_e5m2"):
+        view = arr.view(np.uint16 if arr.dtype.itemsize == 2 else np.uint8)
+        np.save(path, view)
+    else:
+        np.save(path, arr)
+
+
+def _load_np(path, dtype_str):
+    data = np.load(path)
+    if dtype_str in ("bfloat16", "float8_e4m3fn", "float8_e5m2"):
+        import ml_dtypes
+        data = data.view(np.dtype(getattr(ml_dtypes, dtype_str)))
+    return data
+
+
+_async_threads = []
+
+
+def wait_async_save():
+    """Join all outstanding async checkpoint writers (called by tests and
+    before teardown; paddle's async save exposes the same barrier)."""
+    while _async_threads:
+        _async_threads.pop().join()
+
+
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False):
+    """Each rank writes the shards it owns + a metadata json (global shape
+    and per-shard offsets). async_save=True snapshots arrays to host, then
+    writes in a background thread (the PaddleNLP unified-checkpoint async
+    pattern)."""
+    if async_save:
+        flat = _flat(state_dict)
+        host = {}
+        for name, t in flat.items():
+            if isinstance(t, Tensor):
+                arr = t._data
+                if isinstance(arr, jax.Array) and \
+                        len(arr.sharding.device_set) > 1:
+                    shards = [(s.index, np.asarray(s.data))
+                              for s in arr.addressable_shards]
+                    host[name] = ("sharded", tuple(arr.shape),
+                                  str(arr.dtype), shards)
+                else:
+                    host[name] = ("full", tuple(arr.shape),
+                                  str(arr.dtype), np.asarray(arr))
+            else:
+                host[name] = ("value", None, None, t)
+        import threading
+        th = threading.Thread(
+            target=_write_snapshot, args=(host, path), daemon=False)
+        th.start()
+        _async_threads.append(th)
+        return
     os.makedirs(path, exist_ok=True)
     rank = jax.process_index()
     meta = {}
@@ -57,14 +115,14 @@ def save_state_dict(state_dict, path, process_group=None,
                     continue  # replicated copy
                 written.add(offset)
                 fname = f"{safe}.r{rank}.s{i}.npy"
-                np.save(os.path.join(path, fname),
-                        np.asarray(shard.data))
+                _save_np(os.path.join(path, fname),
+                         np.asarray(shard.data))
                 shards.append({"offset": offset,
                                "local_shape": list(shard.data.shape),
                                "file": fname})
         else:
             fname = f"{safe}.r{rank}.s0.npy"
-            np.save(os.path.join(path, fname), np.asarray(arr))
+            _save_np(os.path.join(path, fname), np.asarray(arr))
             shards.append({"offset": [0] * arr.ndim,
                            "local_shape": list(arr.shape),
                            "file": fname})
@@ -72,30 +130,56 @@ def save_state_dict(state_dict, path, process_group=None,
                       "global_shape": list(arr.shape),
                       "dtype": str(arr.dtype),
                       "shards": shards}
-    if rank == coordinator_rank:
-        with open(os.path.join(path, f"meta.{rank}.json"), "w") as f:
-            json.dump(meta, f)
-    else:
-        with open(os.path.join(path, f"meta.{rank}.json"), "w") as f:
-            json.dump(meta, f)
+    with open(os.path.join(path, f"meta.{rank}.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def _write_snapshot(host, path):
+    """Background writer for async_save: host holds already-snapshotted
+    numpy data, so device arrays are not touched off-thread."""
+    os.makedirs(path, exist_ok=True)
+    rank = jax.process_index()
+    meta = {}
+    for name, (kind, shape, dtype, payload) in host.items():
+        safe = name.replace("/", "_")
+        if kind == "value":
+            meta[name] = {"kind": "value", "value": payload}
+            continue
+        shards = []
+        if kind == "sharded":
+            written = set()
+            for i, (idx, data) in enumerate(payload):
+                offset = tuple(
+                    (0 if s.start is None else s.start) for s in idx)
+                if offset in written:
+                    continue
+                written.add(offset)
+                fname = f"{safe}.r{rank}.s{i}.npy"
+                _save_np(os.path.join(path, fname), data)
+                shards.append({"offset": offset,
+                               "local_shape": list(data.shape),
+                               "file": fname})
+        else:
+            fname = f"{safe}.r{rank}.s0.npy"
+            _save_np(os.path.join(path, fname), payload)
+            shards.append({"offset": [0] * len(shape),
+                           "local_shape": list(shape), "file": fname})
+        meta[name] = {"kind": "tensor", "global_shape": list(shape),
+                      "dtype": dtype, "shards": shards}
+    with open(os.path.join(path, f"meta.{rank}.json"), "w") as f:
+        json.dump(meta, f)
 
 
 def _assemble(entry, path):
     shape = tuple(entry["global_shape"])
     dtype = entry["dtype"]
-    out = np.zeros(shape, dtype=np.dtype(dtype) if dtype != "bfloat16"
-                   else np.float32)
+    out = np.zeros(shape, dtype=np.dtype(dtype))
     for sh in entry["shards"]:
-        data = np.load(os.path.join(path, sh["file"]))
-        if dtype == "bfloat16":
-            data = data.astype(np.float32)
+        data = _load_np(os.path.join(path, sh["file"]), dtype)
         idx = tuple(slice(o, o + l) for o, l in
                     zip(sh["offset"], sh["local_shape"]))
         out[idx] = data
-    arr = jnp.asarray(out)
-    if dtype == "bfloat16":
-        arr = arr.astype(jnp.bfloat16)
-    return arr
+    return jnp.asarray(out)
 
 
 def load_state_dict(state_dict, path, process_group=None,
@@ -116,8 +200,14 @@ def load_state_dict(state_dict, path, process_group=None,
             continue
         arr = _assemble(entry, path)
         if isinstance(t, Tensor):
-            if isinstance(t._data, jax.Array) and hasattr(t._data,
-                                                          "sharding"):
+            if isinstance(t._data, jax.Array) and \
+                    len(t._data.sharding.device_set) > 1:
+                # sharded target: reshard the assembled global array onto
+                # the target's (possibly different-mesh) sharding
                 arr = jax.device_put(arr.astype(t.dtype), t._data.sharding)
+            else:
+                # single-device target: keep the array uncommitted so it
+                # composes with mesh-sharded arrays in eager ops
+                arr = arr.astype(t.dtype)
             t.set_data(arr)
     return state_dict

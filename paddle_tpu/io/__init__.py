@@ -263,9 +263,11 @@ class DistributedBatchSampler(BatchSampler):
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, Tensor):
-        return Tensor(np.stack([np.asarray(s._data) for s in batch]))
+        from ..native import parallel_stack
+        return Tensor(parallel_stack([np.asarray(s._data) for s in batch]))
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        from ..native import parallel_stack
+        return Tensor(parallel_stack(batch))
     if isinstance(sample, (int, float)):
         return Tensor(np.asarray(batch))
     if isinstance(sample, (str, bytes)):

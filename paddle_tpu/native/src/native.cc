@@ -1,0 +1,425 @@
+// paddle_tpu native runtime core.
+//
+// Reference parity: the C++ runtime pieces that remain host-side work on
+// TPU (SURVEY.md §2.1): TCPStore rendezvous (paddle/fluid/distributed/
+// store/tcp_store.*, UNVERIFIED — reference mount empty) and the
+// data-loader's native batch assembly (paddle/fluid/operators/reader +
+// DataLoader C++ workers). The TPU compute path is XLA; these are the
+// honest native components: sockets, threads, memcpy.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image).
+//
+// Components:
+//   1. TCPStore — key/value rendezvous with blocking wait: a master
+//      process serves set/get/add/wait over TCP; workers connect by
+//      host:port. Used by paddle_tpu.distributed.launch for multi-host
+//      bootstrap, barriers and elastic membership.
+//   2. pts_parallel_stack — multi-threaded sample->batch memcpy (the hot
+//      loop of collate) .
+//   3. pts_shuffle — Fisher-Yates index shuffle with splitmix64.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- utils
+
+bool send_all(int fd, const void* buf, size_t len) {
+  const char* p = static_cast<const char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::send(fd, p, len, MSG_NOSIGNAL);
+    if (n <= 0) return false;
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t len) {
+  char* p = static_cast<char*>(buf);
+  while (len > 0) {
+    ssize_t n = ::recv(fd, p, len, 0);
+    if (n <= 0) return false;
+    p += n;
+    len -= static_cast<size_t>(n);
+  }
+  return true;
+}
+
+bool send_u32(int fd, uint32_t v) {
+  uint32_t be = htonl(v);
+  return send_all(fd, &be, 4);
+}
+
+bool recv_u32(int fd, uint32_t* v) {
+  uint32_t be;
+  if (!recv_all(fd, &be, 4)) return false;
+  *v = ntohl(be);
+  return true;
+}
+
+bool send_i64(int fd, int64_t v) {
+  uint64_t u = static_cast<uint64_t>(v);
+  uint32_t hi = htonl(static_cast<uint32_t>(u >> 32));
+  uint32_t lo = htonl(static_cast<uint32_t>(u & 0xffffffffu));
+  return send_all(fd, &hi, 4) && send_all(fd, &lo, 4);
+}
+
+bool recv_i64(int fd, int64_t* v) {
+  uint32_t hi, lo;
+  if (!recv_u32(fd, &hi) || !recv_u32(fd, &lo)) return false;
+  *v = static_cast<int64_t>((static_cast<uint64_t>(hi) << 32) |
+                            static_cast<uint64_t>(lo));
+  return true;
+}
+
+bool send_str(int fd, const std::string& s) {
+  return send_u32(fd, static_cast<uint32_t>(s.size())) &&
+         (s.empty() || send_all(fd, s.data(), s.size()));
+}
+
+bool recv_str(int fd, std::string* s) {
+  uint32_t n;
+  if (!recv_u32(fd, &n)) return false;
+  s->resize(n);
+  return n == 0 || recv_all(fd, &(*s)[0], n);
+}
+
+// ---------------------------------------------------------------- server
+
+// wire ops
+enum Op : uint8_t { OP_SET = 1, OP_GET = 2, OP_ADD = 3, OP_WAIT = 4,
+                    OP_DEL = 5, OP_PING = 6 };
+
+struct StoreServer {
+  int listen_fd = -1;
+  std::atomic<bool> running{false};
+  std::thread accept_thread;
+  std::vector<std::thread> handlers;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<std::string, std::string> kv;
+
+  ~StoreServer() { stop(); }
+
+  void handle(int fd) {
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    for (;;) {
+      uint8_t op;
+      if (!recv_all(fd, &op, 1)) break;
+      std::string key;
+      if (op != OP_PING && !recv_str(fd, &key)) break;
+      if (op == OP_SET) {
+        std::string val;
+        if (!recv_str(fd, &val)) break;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          kv[key] = std::move(val);
+        }
+        cv.notify_all();
+        uint8_t ok = 1;
+        if (!send_all(fd, &ok, 1)) break;
+      } else if (op == OP_GET) {
+        std::string val;
+        bool found;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          auto it = kv.find(key);
+          found = it != kv.end();
+          if (found) val = it->second;
+        }
+        uint8_t ok = found ? 1 : 0;
+        if (!send_all(fd, &ok, 1)) break;
+        if (found && !send_str(fd, val)) break;
+        if (!found) { /* key absent signalled by ok=0 */ }
+      } else if (op == OP_ADD) {
+        int64_t delta, result;
+        if (!recv_i64(fd, &delta)) break;
+        {
+          std::lock_guard<std::mutex> g(mu);
+          int64_t cur = 0;
+          auto it = kv.find(key);
+          if (it != kv.end() && it->second.size() == 8)
+            memcpy(&cur, it->second.data(), 8);
+          cur += delta;
+          std::string v(8, '\0');
+          memcpy(&v[0], &cur, 8);
+          kv[key] = v;
+          result = cur;
+        }
+        cv.notify_all();
+        if (!send_i64(fd, result)) break;
+      } else if (op == OP_WAIT) {
+        int64_t timeout_ms;
+        if (!recv_i64(fd, &timeout_ms)) break;
+        bool ok;
+        {
+          std::unique_lock<std::mutex> g(mu);
+          auto pred = [&] { return kv.count(key) > 0; };
+          if (timeout_ms < 0) {
+            cv.wait(g, pred);
+            ok = true;
+          } else {
+            ok = cv.wait_for(g, std::chrono::milliseconds(timeout_ms),
+                             pred);
+          }
+        }
+        uint8_t r = ok ? 1 : 0;
+        if (!send_all(fd, &r, 1)) break;
+      } else if (op == OP_DEL) {
+        {
+          std::lock_guard<std::mutex> g(mu);
+          kv.erase(key);
+        }
+        uint8_t ok = 1;
+        if (!send_all(fd, &ok, 1)) break;
+      } else if (op == OP_PING) {
+        uint8_t ok = 1;
+        if (!send_all(fd, &ok, 1)) break;
+      } else {
+        break;
+      }
+    }
+    ::close(fd);
+  }
+
+  bool start(int port) {
+    listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (listen_fd < 0) return false;
+    int one = 1;
+    setsockopt(listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_addr.s_addr = htonl(INADDR_ANY);
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::bind(listen_fd, reinterpret_cast<sockaddr*>(&addr),
+               sizeof(addr)) != 0)
+      return false;
+    if (::listen(listen_fd, 128) != 0) return false;
+    running = true;
+    accept_thread = std::thread([this] {
+      while (running) {
+        int fd = ::accept(listen_fd, nullptr, nullptr);
+        if (fd < 0) break;
+        handlers.emplace_back([this, fd] { handle(fd); });
+      }
+    });
+    return true;
+  }
+
+  void stop() {
+    if (!running.exchange(false)) return;
+    ::shutdown(listen_fd, SHUT_RDWR);
+    ::close(listen_fd);
+    if (accept_thread.joinable()) accept_thread.join();
+    for (auto& t : handlers)
+      if (t.joinable()) t.join();
+    handlers.clear();
+  }
+};
+
+// ---------------------------------------------------------------- client
+
+struct StoreClient {
+  int fd = -1;
+  std::mutex mu;  // one request/response in flight per client
+
+  ~StoreClient() {
+    if (fd >= 0) ::close(fd);
+  }
+
+  bool connect_to(const char* host, int port, int timeout_ms) {
+    auto deadline = std::chrono::steady_clock::now() +
+                    std::chrono::milliseconds(timeout_ms);
+    for (;;) {
+      fd = ::socket(AF_INET, SOCK_STREAM, 0);
+      if (fd < 0) return false;
+      sockaddr_in addr{};
+      addr.sin_family = AF_INET;
+      addr.sin_port = htons(static_cast<uint16_t>(port));
+      if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+        ::close(fd);
+        fd = -1;
+        return false;
+      }
+      if (::connect(fd, reinterpret_cast<sockaddr*>(&addr),
+                    sizeof(addr)) == 0) {
+        int one = 1;
+        setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        return true;
+      }
+      ::close(fd);
+      fd = -1;
+      if (std::chrono::steady_clock::now() >= deadline) return false;
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- TCPStore C ABI ----
+
+void* pts_store_server_start(int port) {
+  auto* s = new StoreServer();
+  if (!s->start(port)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void pts_store_server_stop(void* h) {
+  delete static_cast<StoreServer*>(h);
+}
+
+void* pts_store_client_new(const char* host, int port, int timeout_ms) {
+  auto* c = new StoreClient();
+  if (!c->connect_to(host, port, timeout_ms)) {
+    delete c;
+    return nullptr;
+  }
+  return c;
+}
+
+void pts_store_client_free(void* h) {
+  delete static_cast<StoreClient*>(h);
+}
+
+int pts_store_set(void* h, const char* key, const uint8_t* val, int len) {
+  auto* c = static_cast<StoreClient*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t op = OP_SET;
+  if (!send_all(c->fd, &op, 1) || !send_str(c->fd, key) ||
+      !send_str(c->fd, std::string(reinterpret_cast<const char*>(val),
+                                   static_cast<size_t>(len))))
+    return -1;
+  uint8_t ok;
+  return recv_all(c->fd, &ok, 1) && ok == 1 ? 0 : -1;
+}
+
+// returns length (>=0) and fills buf (up to buflen); -1 missing; -2 error
+int pts_store_get(void* h, const char* key, uint8_t* buf, int buflen) {
+  auto* c = static_cast<StoreClient*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t op = OP_GET;
+  if (!send_all(c->fd, &op, 1) || !send_str(c->fd, key)) return -2;
+  uint8_t ok;
+  if (!recv_all(c->fd, &ok, 1)) return -2;
+  if (!ok) return -1;
+  std::string val;
+  if (!recv_str(c->fd, &val)) return -2;
+  int n = static_cast<int>(val.size());
+  if (n > buflen) n = buflen;
+  memcpy(buf, val.data(), static_cast<size_t>(n));
+  return static_cast<int>(val.size());
+}
+
+long long pts_store_add(void* h, const char* key, long long delta) {
+  auto* c = static_cast<StoreClient*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t op = OP_ADD;
+  if (!send_all(c->fd, &op, 1) || !send_str(c->fd, key) ||
+      !send_i64(c->fd, delta))
+    return -(1LL << 62);
+  int64_t result;
+  if (!recv_i64(c->fd, &result)) return -(1LL << 62);
+  return result;
+}
+
+// 1 = key present, 0 = timeout, -1 = error
+int pts_store_wait(void* h, const char* key, long long timeout_ms) {
+  auto* c = static_cast<StoreClient*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t op = OP_WAIT;
+  if (!send_all(c->fd, &op, 1) || !send_str(c->fd, key) ||
+      !send_i64(c->fd, timeout_ms))
+    return -1;
+  uint8_t ok;
+  if (!recv_all(c->fd, &ok, 1)) return -1;
+  return ok ? 1 : 0;
+}
+
+int pts_store_delete(void* h, const char* key) {
+  auto* c = static_cast<StoreClient*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t op = OP_DEL;
+  if (!send_all(c->fd, &op, 1) || !send_str(c->fd, key)) return -1;
+  uint8_t ok;
+  return recv_all(c->fd, &ok, 1) && ok == 1 ? 0 : -1;
+}
+
+int pts_store_ping(void* h) {
+  auto* c = static_cast<StoreClient*>(h);
+  std::lock_guard<std::mutex> g(c->mu);
+  uint8_t op = OP_PING;
+  if (!send_all(c->fd, &op, 1)) return -1;
+  uint8_t ok;
+  return recv_all(c->fd, &ok, 1) && ok == 1 ? 0 : -1;
+}
+
+// ---- data loader core ----
+
+// stack n equally-sized samples into dst (contiguous batch) with threads
+void pts_parallel_stack(uint8_t* dst, const uint8_t** srcs, long long n,
+                        long long bytes_per_sample, int nthreads) {
+  if (nthreads <= 1 || n < 4) {
+    for (long long i = 0; i < n; ++i)
+      memcpy(dst + i * bytes_per_sample, srcs[i],
+             static_cast<size_t>(bytes_per_sample));
+    return;
+  }
+  if (nthreads > n) nthreads = static_cast<int>(n);
+  std::vector<std::thread> ts;
+  ts.reserve(static_cast<size_t>(nthreads));
+  long long per = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    long long lo = t * per;
+    long long hi = lo + per > n ? n : lo + per;
+    if (lo >= hi) break;
+    ts.emplace_back([=] {
+      for (long long i = lo; i < hi; ++i)
+        memcpy(dst + i * bytes_per_sample, srcs[i],
+               static_cast<size_t>(bytes_per_sample));
+    });
+  }
+  for (auto& t : ts) t.join();
+}
+
+static inline uint64_t splitmix64(uint64_t* s) {
+  uint64_t z = (*s += 0x9e3779b97f4a7c15ULL);
+  z = (z ^ (z >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  z = (z ^ (z >> 27)) * 0x94d049bb133111ebULL;
+  return z ^ (z >> 31);
+}
+
+// in-place Fisher-Yates over idx[0..n)
+void pts_shuffle(long long* idx, long long n, unsigned long long seed) {
+  uint64_t s = seed ? seed : 0x853c49e6748fea9bULL;
+  for (long long i = n - 1; i > 0; --i) {
+    uint64_t j = splitmix64(&s) % static_cast<uint64_t>(i + 1);
+    long long tmp = idx[i];
+    idx[i] = idx[static_cast<long long>(j)];
+    idx[static_cast<long long>(j)] = tmp;
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,118 @@
+"""Native (C++) runtime core: build, parallel collate, TCPStore."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from paddle_tpu import native
+
+
+def test_native_builds():
+    assert native.available(), \
+        "native lib should compile in this image (g++ is baked in)"
+
+
+def test_parallel_stack_matches_np():
+    rng = np.random.RandomState(0)
+    arrays = [rng.randn(64, 32).astype(np.float32) for _ in range(16)]
+    out = native.parallel_stack(arrays)
+    np.testing.assert_array_equal(out, np.stack(arrays))
+    # dtype variety
+    ints = [rng.randint(0, 100, (128,)).astype(np.int64)
+            for _ in range(8)]
+    np.testing.assert_array_equal(native.parallel_stack(ints),
+                                  np.stack(ints))
+
+
+def test_shuffle_indices_is_permutation_and_deterministic():
+    a = native.shuffle_indices(1000, seed=123)
+    b = native.shuffle_indices(1000, seed=123)
+    c = native.shuffle_indices(1000, seed=124)
+    np.testing.assert_array_equal(np.sort(a), np.arange(1000))
+    np.testing.assert_array_equal(a, b)
+    assert not np.array_equal(a, c)
+
+
+def test_tcp_store_roundtrip():
+    port = 29712
+    master = native.TCPStore("127.0.0.1", port, is_master=True)
+    try:
+        worker = native.TCPStore("127.0.0.1", port, is_master=False)
+        master.set("k1", b"hello")
+        assert worker.get("k1") == b"hello"
+        assert worker.get("missing") is None
+        assert worker.add("ctr", 2) == 2
+        assert master.add("ctr", 3) == 5
+        # blocking wait released by another client's set
+        done = []
+
+        def waiter():
+            done.append(worker.wait("late", timeout=5.0))
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        import time
+        time.sleep(0.1)
+        master.set("late", b"x")
+        t.join(timeout=5)
+        assert done == [True]
+        assert worker.wait("never", timeout=0.2) is False
+        worker.delete_key("k1")
+        assert master.get("k1") is None
+        worker.close()
+    finally:
+        master.close()
+
+
+def test_tcp_store_barrier_pattern():
+    """The launch-time barrier idiom: every rank add()s then wait()s."""
+    port = 29713
+    master = native.TCPStore("127.0.0.1", port, is_master=True)
+    try:
+        world = 4
+        clients = [native.TCPStore("127.0.0.1", port) for _ in range(world)]
+        results = []
+
+        def rank(i):
+            c = clients[i]
+            n = c.add("barrier0", 1)
+            if n == world:
+                c.set("barrier0_done", b"1")
+            ok = c.wait("barrier0_done", timeout=10.0)
+            results.append(ok)
+
+        ts = [threading.Thread(target=rank, args=(i,))
+              for i in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=10)
+        assert results == [True] * world
+        for c in clients:
+            c.close()
+    finally:
+        master.close()
+
+
+def test_dataloader_uses_native_collate():
+    import paddle_tpu as paddle
+    from paddle_tpu.io import DataLoader, Dataset
+
+    class DS(Dataset):
+        def __init__(self):
+            self.x = np.random.RandomState(0).randn(32, 8).astype(
+                np.float32)
+
+        def __getitem__(self, i):
+            return self.x[i]
+
+        def __len__(self):
+            return 32
+
+    dl = DataLoader(DS(), batch_size=8, shuffle=False)
+    batches = list(dl)
+    assert len(batches) == 4
+    assert batches[0].shape == [8, 8]
+    np.testing.assert_allclose(np.asarray(batches[0].jax()),
+                               DS().x[:8])
