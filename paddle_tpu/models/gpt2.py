@@ -14,6 +14,7 @@ from ..framework.core import Tensor
 from .. import nn
 from ..nn import functional as F
 from ..ops import creation, manipulation as M
+from ..generation import GenerationMixin
 
 __all__ = ["GPT2Config", "GPT2Model", "GPT2ForCausalLM"]
 
@@ -56,13 +57,18 @@ class GPT2Attention(nn.Layer):
                                 weight_attr=attr)
         self.attn_dropout = cfg.attention_dropout_prob
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
         b, s, e = x.shape
         qkv = self.c_attn(x)
         qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q = qkv[:, :, 0]
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
+        if cache is not None:
+            ctx, k_cache, v_cache = F.sdpa_with_cache(
+                q, k, v, cache[0], cache[1], pos)
+            ctx = M.reshape(ctx, [b, s, e])
+            return self.c_proj(ctx), (k_cache, v_cache)
         ctx = F.scaled_dot_product_attention(
             q, k, v, is_causal=True, dropout_p=self.attn_dropout,
             training=self.training)
@@ -93,7 +99,12 @@ class GPT2Block(nn.Layer):
         self.mlp = GPT2MLP(cfg)
         self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        if cache is not None:
+            attn, new_cache = self.attn(self.ln_1(x), cache=cache, pos=pos)
+            x = x + attn
+            x = x + self.mlp(self.ln_2(x))
+            return x, new_cache
         x = x + self.dropout(self.attn(self.ln_1(x)))
         x = x + self.dropout(self.mlp(self.ln_2(x)))
         return x
@@ -115,17 +126,26 @@ class GPT2Model(nn.Layer):
         self.ln_f = nn.LayerNorm(config.hidden_size,
                                  config.layer_norm_epsilon)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos=None):
         s = input_ids.shape[1]
-        pos = creation.arange(0, s, dtype="int64")
-        x = self.wte(input_ids) + self.wpe(pos)
+        positions = creation.arange(0, s, dtype="int64")
+        if pos is not None:
+            positions = positions + pos.astype("int64")
+        x = self.wte(input_ids) + self.wpe(positions)
+        if caches is not None:
+            new_caches = []
+            for i, block in enumerate(self.h):
+                x, (kc, vc) = block(x, cache=(caches[2 * i],
+                                              caches[2 * i + 1]), pos=pos)
+                new_caches.extend((kc, vc))
+            return self.ln_f(x), new_caches
         x = self.drop(x)
         for block in self.h:
             x = block(x)
         return self.ln_f(x)
 
 
-class GPT2ForCausalLM(nn.Layer):
+class GPT2ForCausalLM(nn.Layer, GenerationMixin):
     """LM head ties the embedding matrix (GPT-2 convention)."""
 
     def __init__(self, config: GPT2Config):
@@ -133,9 +153,23 @@ class GPT2ForCausalLM(nn.Layer):
         self.gpt2 = GPT2Model(config)
         self.config = config
 
-    def forward(self, input_ids, labels=None):
-        hidden = self.gpt2(input_ids)
+    def init_kv_cache(self, batch_size, max_length, dtype=None):
+        cfg = self.config
+        if dtype is None:
+            dtype = next(iter(self.parameters())).dtype
+        head_dim = cfg.hidden_size // cfg.num_attention_heads
+        return [creation.zeros([batch_size, max_length,
+                                cfg.num_attention_heads, head_dim],
+                               dtype=dtype)
+                for _ in range(2 * cfg.num_hidden_layers)]
+
+    def forward(self, input_ids, labels=None, caches=None, pos=None):
         from ..ops.linalg import matmul
+        if caches is not None:
+            hidden, caches = self.gpt2(input_ids, caches=caches, pos=pos)
+            logits = matmul(hidden, self.gpt2.wte.weight, transpose_y=True)
+            return logits, caches
+        hidden = self.gpt2(input_ids)
         logits = matmul(hidden, self.gpt2.wte.weight, transpose_y=True)
         if labels is None:
             return logits

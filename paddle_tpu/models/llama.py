@@ -11,7 +11,9 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-from ..framework.core import Tensor
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply
 from .. import nn
 from ..nn import functional as F
 from ..ops import creation, manipulation as M
@@ -19,6 +21,7 @@ from ..ops.linalg import matmul
 from ..distributed.parallel_layers import (ColumnParallelLinear,
                                            RowParallelLinear,
                                            VocabParallelEmbedding)
+from ..generation import GenerationMixin
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM"]
 
@@ -75,6 +78,33 @@ class LlamaConfig:
         return self.hidden_size // self.num_attention_heads
 
 
+def rope_with_offset(t, pos, max_pos, theta):
+    """RoPE at absolute positions ``pos + [0..S)`` (decode-with-cache path);
+    table length is the static ``max_pos`` so the traced offset only picks
+    rows."""
+    from ..ops.pallas import rope as rope_mod
+
+    def fn(a, p):
+        s_tab, c_tab = rope_mod.build_sin_cos(max_pos, a.shape[-1], theta)
+        pid = (p.astype(jnp.int32)
+               + jnp.arange(a.shape[1], dtype=jnp.int32)[None, :])
+        pid = jnp.broadcast_to(pid, (a.shape[0], a.shape[1]))
+        return rope_mod.apply_rope(a, s_tab, c_tab, pid)
+
+    return apply(fn, t, pos, name="rope_cached")
+
+
+def _alloc_kv_caches(cfg, batch_size, max_length, dtype):
+    """Zero KV caches: per layer (k, v) of [B, max_len, KV, D]."""
+    caches = []
+    for _ in range(cfg.num_hidden_layers):
+        for _kv in range(2):
+            caches.append(creation.zeros(
+                [batch_size, max_length, cfg.num_key_value_heads,
+                 cfg.head_dim], dtype=dtype))
+    return caches
+
+
 def _lin(cfg, in_f, out_f, *, column, gather_output=False,
          input_is_parallel=True):
     init = nn.initializer.Normal(0.0, cfg.initializer_range)
@@ -106,13 +136,22 @@ class LlamaAttention(nn.Layer):
         self.o_proj = _lin(cfg, self.num_heads * self.head_dim,
                            cfg.hidden_size, column=False)
 
-    def forward(self, x, sin_cos=None):
+    def forward(self, x, sin_cos=None, cache=None, pos=None):
         b, s, _ = x.shape
         q = M.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
         k = M.reshape(self.k_proj(x),
                       [b, s, self.num_kv_heads, self.head_dim])
         v = M.reshape(self.v_proj(x),
                       [b, s, self.num_kv_heads, self.head_dim])
+        if cache is not None:
+            q = rope_with_offset(q, pos, self.cfg.max_position_embeddings,
+                                 self.cfg.rope_theta)
+            k = rope_with_offset(k, pos, self.cfg.max_position_embeddings,
+                                 self.cfg.rope_theta)
+            ctx, k_cache, v_cache = F.sdpa_with_cache(
+                q, k, v, cache[0], cache[1], pos)
+            ctx = M.reshape(ctx, [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(ctx), (k_cache, v_cache)
         from ..incubate.nn.functional import \
             fused_rotary_position_embedding
         q, k, _ = fused_rotary_position_embedding(
@@ -165,7 +204,13 @@ class LlamaDecoderLayer(nn.Layer):
         from ..distributed.fleet.utils import ScatterOp
         return ScatterOp(t, axis=1)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        if cache is not None:
+            attn, new_cache = self.self_attn(self.input_layernorm(x),
+                                             cache=cache, pos=pos)
+            x = x + attn
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
         x = x + self.self_attn(self._sp(self.input_layernorm(x)))
         x = x + self.mlp(self._sp(self.post_attention_layernorm(x)))
         return x
@@ -188,8 +233,15 @@ class LlamaModel(nn.Layer):
                                     for _ in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos=None):
         x = self.embed_tokens(input_ids)
+        if caches is not None:
+            new_caches = []
+            for i, layer in enumerate(self.layers):
+                x, (kc, vc) = layer(x, cache=(caches[2 * i],
+                                              caches[2 * i + 1]), pos=pos)
+                new_caches.extend((kc, vc))
+            return self.norm(x), new_caches
         for layer in self.layers:
             if self.config.use_recompute and self.training:
                 from ..incubate.recompute import recompute
@@ -199,7 +251,7 @@ class LlamaModel(nn.Layer):
         return self.norm(x)
 
 
-class LlamaForCausalLM(nn.Layer):
+class LlamaForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, config: LlamaConfig):
         super().__init__()
         self.llama = LlamaModel(config)
@@ -211,13 +263,23 @@ class LlamaForCausalLM(nn.Layer):
         else:
             self.lm_head = None
 
-    def forward(self, input_ids, labels=None):
-        hidden = self.llama(input_ids)
+    def init_kv_cache(self, batch_size, max_length, dtype=None):
+        if dtype is None:
+            dtype = next(iter(self.parameters())).dtype
+        return _alloc_kv_caches(self.config, batch_size, max_length, dtype)
+
+    def forward(self, input_ids, labels=None, caches=None, pos=None):
+        if caches is not None:
+            hidden, caches = self.llama(input_ids, caches=caches, pos=pos)
+        else:
+            hidden = self.llama(input_ids)
         if self.lm_head is not None:
             logits = self.lm_head(hidden)
         else:
             logits = matmul(hidden, self.llama.embed_tokens.weight,
                             transpose_y=True)
+        if caches is not None:
+            return logits, caches
         if labels is None:
             return logits
         shift_logits = logits[:, :-1, :]

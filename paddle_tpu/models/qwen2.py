@@ -20,6 +20,8 @@ from ..distributed.parallel_layers import (ColumnParallelLinear,
                                            RowParallelLinear,
                                            VocabParallelEmbedding)
 from ..incubate.distributed.models.moe import MoELayer
+from ..generation import GenerationMixin
+from .llama import rope_with_offset, _alloc_kv_caches
 
 __all__ = ["Qwen2Config", "Qwen2MoeConfig", "Qwen2ForCausalLM",
            "Qwen2MoeForCausalLM"]
@@ -118,13 +120,22 @@ class Qwen2Attention(nn.Layer):
         self.o_proj = _lin(cfg, self.num_heads * self.head_dim,
                            cfg.hidden_size, column=False)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
         b, s, _ = x.shape
         q = M.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
         k = M.reshape(self.k_proj(x),
                       [b, s, self.num_kv_heads, self.head_dim])
         v = M.reshape(self.v_proj(x),
                       [b, s, self.num_kv_heads, self.head_dim])
+        if cache is not None:
+            q = rope_with_offset(q, pos, self.cfg.max_position_embeddings,
+                                 self.cfg.rope_theta)
+            k = rope_with_offset(k, pos, self.cfg.max_position_embeddings,
+                                 self.cfg.rope_theta)
+            ctx, k_cache, v_cache = F.sdpa_with_cache(
+                q, k, v, cache[0], cache[1], pos)
+            ctx = M.reshape(ctx, [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(ctx), (k_cache, v_cache)
         from ..incubate.nn.functional import \
             fused_rotary_position_embedding
         q, k, _ = fused_rotary_position_embedding(
@@ -187,13 +198,19 @@ class Qwen2DecoderLayer(nn.Layer):
                                                    cfg.rms_norm_eps)
         self.mlp = Qwen2MoeBlock(cfg) if moe else Qwen2MLP(cfg)
 
-    def forward(self, x):
+    def forward(self, x, cache=None, pos=None):
+        if cache is not None:
+            attn, new_cache = self.self_attn(self.input_layernorm(x),
+                                             cache=cache, pos=pos)
+            x = x + attn
+            x = x + self.mlp(self.post_attention_layernorm(x))
+            return x, new_cache
         x = x + self.self_attn(self.input_layernorm(x))
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 
 
-class _Qwen2Base(nn.Layer):
+class _Qwen2Base(nn.Layer, GenerationMixin):
     def __init__(self, cfg, moe: bool):
         super().__init__()
         self.config = cfg
@@ -214,8 +231,23 @@ class _Qwen2Base(nn.Layer):
                             column=True, gather_output=True) \
             if not cfg.tie_word_embeddings else None
 
-    def forward(self, input_ids, labels=None):
+    def init_kv_cache(self, batch_size, max_length, dtype=None):
+        if dtype is None:
+            dtype = next(iter(self.parameters())).dtype
+        return _alloc_kv_caches(self.config, batch_size, max_length, dtype)
+
+    def forward(self, input_ids, labels=None, caches=None, pos=None):
         x = self.embed_tokens(input_ids)
+        if caches is not None:
+            new_caches = []
+            for i, layer in enumerate(self.layers):
+                x, (kc, vc) = layer(x, cache=(caches[2 * i],
+                                              caches[2 * i + 1]), pos=pos)
+                new_caches.extend((kc, vc))
+            hidden = self.norm(x)
+            logits = self.lm_head(hidden) if self.lm_head is not None else \
+                matmul(hidden, self.embed_tokens.weight, transpose_y=True)
+            return logits, new_caches
         for layer in self.layers:
             if self.config.use_recompute and self.training:
                 from ..incubate.recompute import recompute

@@ -18,7 +18,7 @@ from ...framework import flags
 from ...ops.common import as_tensor
 
 __all__ = ["scaled_dot_product_attention", "flash_attention",
-           "sdpa_reference"]
+           "sdpa_reference", "sdpa_with_cache"]
 
 
 def _use_pallas() -> bool:
@@ -91,6 +91,43 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                               dropout_p if key_rng is not None else 0.0,
                               is_causal, dropout_key=key_rng)
     return apply(fn, *args, name="sdpa")
+
+
+def sdpa_with_cache(query, key, value, k_cache, v_cache, pos):
+    """Incremental-decoding attention over a static-shape KV cache.
+
+    Writes ``key``/``value`` (new tokens, [B, S, KV, D]) into the caches
+    ([B, max_len, KV, D]) at sequence offset ``pos`` (int32 scalar tensor,
+    traceable), then attends ``query`` over the whole cache with the
+    positional causal mask ``cache_index <= pos + query_index``. Covers both
+    prefill (S = prompt len, pos = 0) and decode (S = 1, pos = current len)
+    uniformly. Role of the reference's decoder ``cache_kv`` path in
+    fused_multi_head_attention / PaddleNLP decoding (mount empty, no cites).
+
+    Returns ``(out, new_k_cache, new_v_cache)``.
+    """
+    q = as_tensor(query)
+    k, v = as_tensor(key), as_tensor(value)
+    kc, vc = as_tensor(k_cache), as_tensor(v_cache)
+    p = as_tensor(pos)
+
+    def fn(qq, kk, vv, kcache, vcache, pp):
+        pp = pp.astype(jnp.int32)
+        start = (jnp.zeros((), jnp.int32), pp,
+                 jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+        kcache = jax.lax.dynamic_update_slice(
+            kcache, kk.astype(kcache.dtype), start)
+        vcache = jax.lax.dynamic_update_slice(
+            vcache, vv.astype(vcache.dtype), start)
+        s, max_len = qq.shape[1], kcache.shape[1]
+        mask = (jnp.arange(max_len)[None, :]
+                <= pp + jnp.arange(s)[:, None])          # [S, max_len]
+        out = sdpa_reference(qq, kcache.astype(qq.dtype),
+                             vcache.astype(qq.dtype),
+                             attn_mask=mask[None, None])
+        return out, kcache, vcache
+
+    return apply(fn, q, k, v, kc, vc, p, n_outputs=3, name="sdpa_cached")
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
