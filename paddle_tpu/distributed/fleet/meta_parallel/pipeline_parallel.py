@@ -59,8 +59,11 @@ class PipelineParallel:
 
     def _build_plan(self):
         """Split run_function into prologue / uniform body / epilogue and
-        group the body into S stages of equal layer count."""
+        group the body into S*V chunks of equal layer count (V > 1 =
+        interleaved virtual stages; chunk c lives on device c % S)."""
         S = self._pp_degree
+        V = max(int(getattr(self._layers, "_num_virtual", 1) or 1), 1)
+        n_chunks = S * V
         layer_list = list(self._layers.run_function)
         sigs = [_param_sig(l) for l in layer_list]
         # longest contiguous run of identical non-empty signatures
@@ -77,20 +80,21 @@ class PipelineParallel:
                 best = (i, j - i)
             i = j
         start, length = best
-        usable = (length // S) * S
-        if usable < S:
+        usable = (length // n_chunks) * n_chunks
+        if usable < n_chunks:
             raise ValueError(
-                f"pipeline compile: need a run of >= {S} structurally "
-                f"identical layers to partition over {S} stages; found "
-                f"{length}. Adjust the PipelineLayer or pp_degree.")
+                f"pipeline compile: need a run of >= {n_chunks} "
+                f"structurally identical layers to partition over "
+                f"{S} stages x {V} virtual chunks; found {length}. "
+                f"Adjust the PipelineLayer or pp_degree.")
         # keep trailing non-uniform layers in the epilogue; any uniform
         # surplus (length - usable) also joins the epilogue
         body = layer_list[start:start + usable]
         prologue = layer_list[:start]
         epilogue = layer_list[start + usable:]
-        per_stage = usable // S
+        per_stage = usable // n_chunks
         groups = [body[g * per_stage:(g + 1) * per_stage]
-                  for g in range(S)]
+                  for g in range(n_chunks)]
         group_params = [[p for l in grp for p in l.parameters()]
                         for grp in groups]
         n_leaves = len(group_params[0])
@@ -103,6 +107,7 @@ class PipelineParallel:
             "group_params": group_params,
             "n_leaves": n_leaves,
             "per_stage": per_stage,
+            "n_virtual": V,
         }
 
     def _body_apply(self, h_micro):
@@ -111,6 +116,7 @@ class PipelineParallel:
         from ...pipeline import run_pipeline
         plan = self._compiled_plan
         S = self._pp_degree
+        V = plan["n_virtual"]
         n_leaves = plan["n_leaves"]
         template = plan["groups"][0]
         template_params = [p for l in template for p in l.parameters()]
@@ -120,9 +126,20 @@ class PipelineParallel:
         flat = [p for gp in plan["group_params"] for p in gp]
 
         def fn(hm, *leaves):
-            stacked = tuple(
-                jnp.stack([leaves[g * n_leaves + i] for g in range(S)])
-                for i in range(n_leaves))
+            if V == 1:
+                stacked = tuple(
+                    jnp.stack([leaves[g * n_leaves + i]
+                               for g in range(S)])
+                    for i in range(n_leaves))
+            else:
+                # [V, S, ...]: chunk c = v*S + d is device d's local
+                # chunk v (round-robin placement — see pipeline.py)
+                stacked = tuple(
+                    jnp.stack([
+                        jnp.stack([leaves[(v * S + d) * n_leaves + i]
+                                   for d in range(S)])
+                        for v in range(V)])
+                    for i in range(n_leaves))
 
             def stage_fn(params_one, x):
                 originals = [(p, p._data) for p in template_params]
@@ -140,7 +157,7 @@ class PipelineParallel:
 
             return run_pipeline(stage_fn, stacked, hm, mesh,
                                 axis_name=self._hcg.pp_axis_name,
-                                remat=remat)
+                                n_virtual=V, remat=remat)
 
         return apply(fn, h_micro, *flat, name="pipeline_body")
 

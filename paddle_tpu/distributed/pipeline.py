@@ -25,9 +25,18 @@ TPU-native design (SURVEY.md §7 "hard parts" #1) — NOT a port:
   (``remat='stage'`` recomputes each stage's forward during backward so
   only the S boundary activations per microbatch stay alive, not every
   layer intermediate).
-- Interleaved/virtual-stage and zero-bubble schedules are follow-up work
-  (they need a collision-free circular ingress schedule); the API keeps
-  the ``n_virtual`` hook so callers can request them when they land.
+- Interleaved/virtual-stage (Megatron "virtual pipeline") is the
+  ``n_virtual > 1`` path: the model is split into L = S*V chunks laid
+  out round-robin (chunk c lives on device c % S as its local chunk
+  c // S), so one ``ppermute`` hop per tick still moves every
+  activation to its next chunk — the ring simply wraps V times.
+  Microbatches are processed in groups of S (the classic interleaved
+  constraint), giving the collision-free closed-form schedule
+  t(m, c) = (m // S)·S·V + (c // S)·S + (m % S) + (c % S): per-device
+  bubble (S-1)/(M·V) of total ticks vs (S-1)/(M·?) for FThenB — the
+  1/V bubble shrink Megatron's interleaved schedule buys (FThenB's
+  bubble is (S-1)/(M+S-1) of its ticks), in one compiled scan.
+  Zero-bubble (ZB-H1) stays follow-up work.
 
 Everything is shape-static; ``pipeline_spmd`` must run inside a
 partial-manual ``jax.shard_map(axis_names={'pipe'})`` region (see
@@ -59,15 +68,17 @@ def pipeline_spmd(stage_fn, stage_params, x_micro, axis_name,
       compute.
     stage_params: pytree; every leaf has leading dim S (the per-stage
       stack), sharded over 'pipe' outside this manual region. Inside,
-      each device sees [1, ...] local leaves.
+      each device sees [1, ...] local leaves. With n_virtual=V > 1,
+      leaves are instead [V, S, ...] with dim 1 sharded over 'pipe'
+      (locally [V, 1, ...]): device d's local chunk v is global chunk
+      v*S + d (see _pipeline_interleaved).
     x_micro: [M, ...] microbatched stage-0 inputs (replicated over pipe).
     remat: None | 'stage' — rematerialize each stage call in backward.
     Returns [M, ...] last-stage outputs (replicated over the pipe axis).
     """
     if n_virtual != 1:
-        raise NotImplementedError(
-            "interleaved/virtual-stage schedules not yet implemented; "
-            "use n_virtual=1 (FThenB with optional remat)")
+        return _pipeline_interleaved(stage_fn, stage_params, x_micro,
+                                     axis_name, n_virtual, remat)
     S = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     M = x_micro.shape[0]
@@ -107,14 +118,97 @@ def pipeline_spmd(stage_fn, stage_params, x_micro, axis_name,
     return lax.psum(outbuf * mask, axis_name)
 
 
+def _pipeline_interleaved(stage_fn, stage_params, x_micro, axis_name,
+                          n_virtual, remat=None):
+    """Interleaved (virtual-stage) schedule: Megatron-style 1/V bubble.
+
+    stage_params leaves are locally [V, 1, ...] (globally [V, S, ...]
+    with dim 1 sharded over the pipe axis): device d's local chunk v is
+    global chunk  c = v*S + d  — the round-robin chunk placement of the
+    reference's interleaved-1F1B (fleet pipeline_parallel.py virtual-pp,
+    UNVERIFIED — mount empty).
+
+    Schedule (see module docstring): microbatches run in G groups of S;
+    device d at tick t works on slot t' = t - d, decoded as
+    group g = t' // (S*V), chunk v = (t' % (S*V)) // S and microbatch
+    m = g*S + t' % S. Each tick's output takes ONE ppermute hop to the
+    next device, which holds the next global chunk; outputs of the last
+    chunk (on device S-1) wrap around to device 0, which banks them
+    into the output buffer instead of consuming them.
+    """
+    S = lax.psum(1, axis_name)
+    d = lax.axis_index(axis_name)
+    V = int(n_virtual)
+    M = x_micro.shape[0]
+    mb_shape = x_micro.shape[1:]
+    G = -(-M // S)  # microbatch groups of S (ragged last group = bubble)
+    T = G * S * V + S  # +S: drain final-chunk outputs back to device 0
+
+    def one_chunk(p, x):
+        return stage_fn(p, x)
+
+    if remat == "stage":
+        one_chunk = jax.checkpoint(one_chunk)
+
+    perm = [(i, (i + 1) % S) for i in range(S)]
+    ring = S * V
+
+    def decode(tp):
+        g = tp // ring
+        r = tp % ring
+        return g, r // S, g * S + r % S  # group, chunk, microbatch
+
+    def tick(carry, t):
+        act, outbuf = carry
+        # 1) bank an arriving final-chunk output (device 0 only): the
+        #    carry is device S-1's output from tick t-1 = slot t-S.
+        em_tp = t - S
+        _, em_v, em_m = decode(jnp.maximum(em_tp, 0))
+        em_ok = ((d == 0) & (em_tp >= 0) & (em_v == V - 1)
+                 & (em_m < M))
+        slot = jnp.clip(em_m, 0, M - 1)
+        cur = lax.dynamic_index_in_dim(outbuf, slot, 0, False)
+        outbuf = lax.dynamic_update_index_in_dim(
+            outbuf, jnp.where(em_ok, act, cur), slot, 0)
+        # 2) this tick's work unit
+        tp = t - d
+        g, v, m = decode(jnp.maximum(tp, 0))
+        x0 = lax.dynamic_index_in_dim(
+            x_micro, jnp.clip(m, 0, M - 1), 0, False)
+        fresh = (d == 0) & (v == 0)
+        # x0 is indexed by the device-dependent m, so it is already
+        # axis-varying — no pcast needed (unlike the FThenB path).
+        inp = jnp.where(fresh, x0, act)
+        p = jax.tree.map(
+            lambda q: lax.index_in_dim(
+                lax.dynamic_index_in_dim(q, jnp.clip(v, 0, V - 1), 0,
+                                         False), 0, 0, False),
+            stage_params)
+        out = one_chunk(p, inp)
+        act = lax.ppermute(out, axis_name, perm)
+        return (act, outbuf), None
+
+    act0 = _vary(jnp.zeros(mb_shape, x_micro.dtype), axis_name)
+    outbuf0 = _vary(jnp.zeros((M,) + mb_shape, x_micro.dtype), axis_name)
+    (act, outbuf), _ = lax.scan(tick, (act0, outbuf0), jnp.arange(T))
+    mask = (d == 0).astype(outbuf.dtype)
+    return lax.psum(outbuf * mask, axis_name)
+
+
 def run_pipeline(stage_fn, stacked_params, x_micro, mesh, axis_name="pipe",
                  n_virtual=1, remat=None):
     """Global-view entry: partial-manual shard_map over the pipe axis only
     (other mesh axes stay under GSPMD). ``stacked_params`` leaves are
-    [S, ...] arrays sharded on dim 0 over 'pipe'."""
+    [S, ...] arrays sharded on dim 0 over 'pipe' (n_virtual == 1), or
+    [V, S, ...] sharded on dim 1 (interleaved: global chunk v*S + d is
+    device d's local chunk v)."""
     from jax.sharding import PartitionSpec as P
 
-    pspecs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    if n_virtual == 1:
+        pspecs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    else:
+        pspecs = jax.tree.map(lambda _: P(None, axis_name),
+                              stacked_params)
 
     f = jax.shard_map(
         functools.partial(pipeline_spmd, stage_fn, axis_name=axis_name,
