@@ -26,6 +26,7 @@ from .parallel_layers import (ColumnParallelLinear, RowParallelLinear,
 from .auto_parallel_api import (to_static as dist_to_static, Strategy,
                                 DistAttr, DistModel, unshard_dtensor)
 from . import launch  # noqa: F401
+from .zero_bubble import (run_pipeline_train, make_schedule)
 from ..native import TCPStore  # noqa: F401 — rendezvous control plane
 
 __all__ = [
@@ -40,4 +41,5 @@ __all__ = [
     "save_state_dict", "load_state_dict", "ColumnParallelLinear",
     "RowParallelLinear", "VocabParallelEmbedding", "ParallelCrossEntropy",
     "Strategy", "DistAttr", "DistModel", "unshard_dtensor", "stream",
+    "run_pipeline_train", "make_schedule",
 ]

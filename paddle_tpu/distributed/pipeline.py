@@ -33,10 +33,12 @@ TPU-native design (SURVEY.md §7 "hard parts" #1) — NOT a port:
   Microbatches are processed in groups of S (the classic interleaved
   constraint), giving the collision-free closed-form schedule
   t(m, c) = (m // S)·S·V + (c // S)·S + (m % S) + (c % S): per-device
-  bubble (S-1)/(M·V) of total ticks vs (S-1)/(M·?) for FThenB — the
-  1/V bubble shrink Megatron's interleaved schedule buys (FThenB's
-  bubble is (S-1)/(M+S-1) of its ticks), in one compiled scan.
-  Zero-bubble (ZB-H1) stays follow-up work.
+  bubble (S-1)/(M·V) of total ticks vs (S-1)/(M+S-1) for FThenB — the
+  1/V bubble shrink Megatron's interleaved schedule buys, in one
+  compiled scan.
+  True 1F1B and zero-bubble (ZB-H1) with explicit B/W scheduling live
+  in ``zero_bubble.py`` (table-driven tick machine over the same
+  ppermute ring).
 
 Everything is shape-static; ``pipeline_spmd`` must run inside a
 partial-manual ``jax.shard_map(axis_names={'pipe'})`` region (see

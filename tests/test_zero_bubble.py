@@ -1,0 +1,182 @@
+"""Explicit-schedule pipeline (1F1B / ZB-H1 zero-bubble / FThenB).
+
+Oracles (SURVEY.md §4): schedule-table validity by construction rules,
+and loss+gradient parity vs a sequential single-device reference for
+every schedule kind.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from paddle_tpu.distributed.zero_bubble import (
+    NOP, F, B, W, make_schedule, run_pipeline_train)
+
+KINDS = ("fthenb", "1f1b", "zb_h1")
+
+
+# --------------------------------------------------------------------------
+# schedule-table properties
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("S,M", [(2, 2), (4, 8), (4, 5), (8, 16)])
+@pytest.mark.parametrize("kind", KINDS)
+def test_schedule_valid(S, M, kind):
+    op, mb = make_schedule(S, M, kind)
+    assert op.shape == mb.shape and op.shape[0] == S
+    T = op.shape[1]
+    f_done = {}
+    b_done = {}
+    w_done = {}
+    for t in range(T):
+        for d in range(S):
+            o, m = int(op[d, t]), int(mb[d, t])
+            if o == NOP:
+                continue
+            if o == F:
+                if d > 0:
+                    assert f_done[(d - 1, m)] <= t - 1, (d, t, m)
+                f_done[(d, m)] = t
+            elif o == B:
+                if d == S - 1:
+                    assert f_done[(d, m)] <= t - 1, (d, t, m)
+                else:
+                    assert b_done[(d + 1, m)] <= t - 1, (d, t, m)
+                b_done[(d, m)] = t
+            elif o == W:
+                assert b_done[(d, m)] < t, (d, t, m)
+                w_done[(d, m)] = t
+    # completeness
+    assert len(f_done) == S * M
+    assert len(b_done) == S * M
+    if kind == "zb_h1":
+        assert len(w_done) == S * M
+    else:
+        assert not w_done
+
+
+def test_zb_h1_fills_bubbles():
+    """ZB-H1's W units occupy ticks 1F1B leaves idle: within the span
+    where B work exists, stage 0's idle ticks must shrink."""
+    S, M = 4, 8
+    op1, _ = make_schedule(S, M, "1f1b")
+    opz, _ = make_schedule(S, M, "zb_h1")
+    # per-stage busy fraction between first and last non-NOP tick
+    def idle_frac(op, d):
+        row = op[d]
+        nz = np.nonzero(row)[0]
+        span = row[nz[0]:nz[-1] + 1]
+        return float((span == NOP).mean())
+    # zb_h1 does 3 unit types so it is busier inside its span
+    assert idle_frac(opz, 0) < idle_frac(op1, 0) + 1e-9
+    assert (opz == W).sum() == S * M
+
+
+def test_1f1b_inflight_cap():
+    """In-flight microbatches on stage d never exceed S - d (the memory
+    bound that distinguishes 1F1B from FThenB)."""
+    S, M = 4, 12
+    op, mb = make_schedule(S, M, "1f1b")
+    T = op.shape[1]
+    for d in range(S):
+        inflight = 0
+        peak = 0
+        for t in range(T):
+            if op[d, t] == F:
+                inflight += 1
+            elif op[d, t] == B:
+                inflight -= 1
+            peak = max(peak, inflight)
+        assert peak <= S - d, (d, peak)
+
+
+# --------------------------------------------------------------------------
+# numeric parity vs sequential reference
+# --------------------------------------------------------------------------
+
+def _stage_fn(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+def _loss_fn(y, tgt):
+    return jnp.mean((y - tgt) ** 2)
+
+
+def _reference(params, x_micro, tgt_micro):
+    """Sequential single-device execution of the same stacked stages."""
+    S = params["w"].shape[0]
+
+    def total_loss(ps):
+        acc = 0.0
+        for m in range(x_micro.shape[0]):
+            h = x_micro[m]
+            for s in range(S):
+                h = _stage_fn(
+                    {"w": ps["w"][s], "b": ps["b"][s]}, h)
+            acc = acc + _loss_fn(h, tgt_micro[m])
+        return acc
+
+    loss, grads = jax.value_and_grad(total_loss)(params)
+    return loss, grads
+
+
+@pytest.mark.parametrize("kind", KINDS)
+def test_train_step_parity(kind):
+    S, M, mb, dim = 4, 6, 2, 8
+    rng = np.random.RandomState(0)
+    params = {
+        "w": jnp.asarray(rng.randn(S, dim, dim) * 0.3, jnp.float32),
+        "b": jnp.asarray(rng.randn(S, dim) * 0.1, jnp.float32),
+    }
+    x = jnp.asarray(rng.randn(M, mb, dim), jnp.float32)
+    tgt = jnp.asarray(rng.randn(M, mb, dim), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pipe",))
+
+    loss, dp, y_micro = run_pipeline_train(
+        _stage_fn, _loss_fn, params, x, tgt, mesh, "pipe", kind)
+
+    ref_loss, ref_grads = _reference(params, x, tgt)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dp["w"]),
+                               np.asarray(ref_grads["w"]),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dp["b"]),
+                               np.asarray(ref_grads["b"]),
+                               rtol=1e-4, atol=1e-5)
+    # forward outputs banked on the last stage
+    h = x
+    for s in range(S):
+        h = jax.vmap(lambda xm: _stage_fn(
+            {"w": params["w"][s], "b": params["b"][s]}, xm))(h)
+    np.testing.assert_allclose(np.asarray(y_micro), np.asarray(h),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_jit_wrapped():
+    """The whole schedule compiles into one jitted program."""
+    S, M, mb, dim = 4, 4, 2, 4
+    rng = np.random.RandomState(1)
+    params = {
+        "w": jnp.asarray(rng.randn(S, dim, dim) * 0.3, jnp.float32),
+        "b": jnp.asarray(rng.randn(S, dim) * 0.1, jnp.float32),
+    }
+    x = jnp.asarray(rng.randn(M, mb, dim), jnp.float32)
+    tgt = jnp.asarray(rng.randn(M, mb, dim), jnp.float32)
+    mesh = Mesh(np.array(jax.devices()[:S]), ("pipe",))
+
+    @jax.jit
+    def step(p, x, t):
+        return run_pipeline_train(_stage_fn, _loss_fn, p, x, t,
+                                  mesh, "pipe", "zb_h1")
+
+    loss, dp, _ = step(params, x, tgt)
+    ref_loss, ref_grads = _reference(params, x, tgt)
+    np.testing.assert_allclose(np.asarray(loss), np.asarray(ref_loss),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(dp["w"]),
+                               np.asarray(ref_grads["w"]),
+                               rtol=1e-4, atol=1e-5)
