@@ -71,6 +71,7 @@ from . import vision  # noqa: F401
 from . import sparse  # noqa: F401
 from . import version  # noqa: F401
 from . import models  # noqa: F401
+from . import inference  # noqa: F401
 
 from .hapi.model import Model  # noqa: F401
 from .nn.layer.layers import Layer  # noqa: F401  (paddle.nn.Layer shortcut)

@@ -96,3 +96,6 @@ define_flag("FLAGS_enable_pallas_kernels", True,
             "Use Pallas kernels (flash-attn, rms_norm, rope) when on TPU.")
 define_flag("FLAGS_flash_attn_block_q", 128, "Pallas flash-attn q block.")
 define_flag("FLAGS_flash_attn_block_kv", 128, "Pallas flash-attn kv block.")
+define_flag("FLAGS_use_pallas_paged_attention", 1,
+            "Serving decode: use the Pallas paged-attention kernel on "
+            "TPU (0 = jnp gather/softmax reference path).")

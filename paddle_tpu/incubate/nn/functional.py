@@ -19,7 +19,7 @@ __all__ = ["fused_multi_head_attention", "fused_feedforward",
            "fused_linear", "fused_linear_activation", "fused_rms_norm",
            "fused_layer_norm", "fused_dropout_add", "fused_rotary_position_embedding",
            "fused_softmax_mask", "fused_softmax_mask_upper_triangle",
-           "swiglu"]
+           "swiglu", "paged_attention"]
 
 
 def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
@@ -84,6 +84,19 @@ def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
                                           neox=use_neox_rotary_style),
             t, name="fused_rope"))
     return tuple(outs)
+
+
+def paged_attention(q, key_pages, value_pages, block_tables, context_lens,
+                    scale=None, name=None):
+    """Serving decode-step attention over a paged KV cache (Pallas TPU
+    kernel; see ops/paged_attention.py for layouts)."""
+    from ...ops.paged_attention import paged_attention as _pa
+
+    def fn(qq, kp, vp, bt, cl):
+        return _pa(qq, kp, vp, bt, cl, scale)
+    return apply(fn, as_tensor(q), as_tensor(key_pages),
+                 as_tensor(value_pages), as_tensor(block_tables),
+                 as_tensor(context_lens), name="paged_attention")
 
 
 def fused_softmax_mask(x, mask, name=None):

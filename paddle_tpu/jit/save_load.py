@@ -36,10 +36,20 @@ def save(layer, path, input_spec=None, **configs):
         # export stablehlo if an input_spec is given
         if input_spec is not None:
             arrays = []
-            for spec in input_spec:
+            shape_strs = []
+            has_dyn = False
+            for i, spec in enumerate(input_spec):
                 shape = tuple(1 if s in (-1, None) else s
                               for s in spec.shape)
                 arrays.append(jnp.zeros(shape, spec.dtype))
+                parts = []
+                for j, sdim in enumerate(spec.shape):
+                    if sdim in (-1, None):
+                        parts.append(f"d{i}_{j}")
+                        has_dyn = True
+                    else:
+                        parts.append("_")
+                shape_strs.append(", ".join(parts) if parts else "")
 
             def fwd(*xs):
                 outs = layer(*[Tensor(x) for x in xs])
@@ -56,6 +66,25 @@ def save(layer, path, input_spec=None, **configs):
             except Exception as e:  # export is best-effort
                 meta["stablehlo"] = False
                 meta["export_error"] = str(e)
+            # serialized jax.export artifact: the executable pdmodel
+            # (runs without the python class — the inference engine's
+            # real load format; .pdmodel text is for inspection).
+            # InputSpec dims of -1/None export as symbolic dims so the
+            # artifact serves any batch size.
+            try:
+                from jax import export as jexport
+                if has_dyn:
+                    args_specs = jexport.symbolic_args_specs(
+                        arrays, shape_strs)
+                    exp = jexport.export(jax.jit(fwd))(*args_specs)
+                else:
+                    exp = jexport.export(jax.jit(fwd))(*arrays)
+                with open(path + ".pdexported", "wb") as f:
+                    f.write(bytes(exp.serialize()))
+                meta["exported"] = True
+            except Exception as e:
+                meta["exported"] = False
+                meta["exported_error"] = str(e)
     else:
         meta["type"] = "function"
     with open(path + ".pdmeta", "wb") as f:
@@ -67,10 +96,11 @@ class TranslatedLayer:
     layer class is supplied (``load(path, layer=...)`` or via program()),
     runs it; otherwise exposes the raw state dict."""
 
-    def __init__(self, state_dict, meta, layer=None):
+    def __init__(self, state_dict, meta, layer=None, exported=None):
         self._state_dict = state_dict
         self._meta = meta
         self._layer = layer
+        self._exported = exported  # jax.export.Exported (class-free path)
         if layer is not None:
             layer.set_state_dict(state_dict)
             layer.eval()
@@ -79,11 +109,24 @@ class TranslatedLayer:
         return self._state_dict
 
     def __call__(self, *args, **kwargs):
-        if self._layer is None:
-            raise RuntimeError(
-                "TranslatedLayer loaded without a layer object; pass "
-                "`layer=` to paddle_tpu.jit.load or use .state_dict()")
-        return self._layer(*args, **kwargs)
+        if self._layer is not None:
+            return self._layer(*args, **kwargs)
+        if self._exported is not None:
+            if kwargs:
+                raise TypeError(
+                    "TranslatedLayer loaded from a serialized export "
+                    "takes positional inputs only (keyword arguments "
+                    f"were baked in at save time): got {list(kwargs)}")
+            xs = [a.jax() if isinstance(a, Tensor) else jnp.asarray(a)
+                  for a in args]
+            out = self._exported.call(*xs)
+            if isinstance(out, (list, tuple)):
+                return tuple(Tensor(o) for o in out)
+            return Tensor(out)
+        raise RuntimeError(
+            "TranslatedLayer loaded without a layer object or exported "
+            "artifact; pass `layer=` to paddle_tpu.jit.load or use "
+            ".state_dict()")
 
     def eval(self):
         if self._layer is not None:
@@ -102,4 +145,17 @@ def load(path, layer=None, **configs):
     if os.path.exists(path + ".pdmeta"):
         with open(path + ".pdmeta", "rb") as f:
             meta = pickle.load(f)
-    return TranslatedLayer(state, meta, layer)
+    exported = None
+    if layer is None and os.path.exists(path + ".pdexported"):
+        try:
+            from jax import export as jexport
+            with open(path + ".pdexported", "rb") as f:
+                exported = jexport.deserialize(bytearray(f.read()))
+        except Exception as e:
+            import warnings
+            warnings.warn(
+                f"{path}.pdexported exists but could not be "
+                f"deserialized ({type(e).__name__}: {e}); the loaded "
+                f"model is state-dict-only", RuntimeWarning)
+            exported = None
+    return TranslatedLayer(state, meta, layer, exported)

@@ -1,0 +1,93 @@
+"""Paged attention for serving-time autoregressive decode.
+
+Role of the reference inference engine's paged/ragged KV-cache attention
+(Paddle Inference fused attention ops + PaddleNLP serving kernels,
+UNVERIFIED — reference mount empty). The KV cache is stored as fixed-size
+*pages* in a global pool; each sequence owns a list of pages (its block
+table), so cache memory is allocated per-page instead of per-max-length —
+the vLLM/TPU-serving design (see PAPERS.md ragged-paged-attention).
+
+TPU-native: the fast path is the Pallas TPU paged-attention kernel that
+ships with jax (``jax.experimental.pallas.ops.tpu.paged_attention``, a
+scalar-prefetch kernel that streams only the pages named in the block
+table through VMEM). The reference path below is pure jnp (gather +
+masked softmax) — the numeric oracle and the CPU/debug fallback.
+
+Layouts (decode step, one query token per sequence):
+  q            [B, H, D]
+  key_pages    [KVH, num_pages, page_size, D]
+  value_pages  [KVH, num_pages, page_size, D]
+  block_tables [B, pages_per_seq] int32 — page ids, row-padded with any
+               valid id past the sequence's last page
+  context_lens [B] int32 — tokens currently in cache per sequence
+GQA/MQA: H a multiple of KVH; q head h attends kv head h // (H // KVH).
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["paged_attention", "paged_attention_reference"]
+
+_NEG_INF = -1e30
+
+
+def paged_attention_reference(q, key_pages, value_pages, block_tables,
+                              context_lens, scale=None):
+    """Pure-jnp oracle: gather each sequence's pages, mask, soft-max."""
+    b, h, d = q.shape
+    kvh, _, page_size, _ = key_pages.shape
+    rep = h // kvh
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    max_len = block_tables.shape[1] * page_size
+
+    def one_seq(qi, table, ctx_len):
+        # [KVH, pages_per_seq, page, D] -> [KVH, max_len, D]
+        k = key_pages[:, table].reshape(kvh, max_len, d)
+        v = value_pages[:, table].reshape(kvh, max_len, d)
+        k = jnp.repeat(k, rep, axis=0)  # [H, max_len, D]
+        v = jnp.repeat(v, rep, axis=0)
+        logits = jnp.einsum("hd,hkd->hk", qi, k,
+                            preferred_element_type=jnp.float32) * s
+        mask = jnp.arange(max_len) < ctx_len
+        logits = jnp.where(mask[None, :], logits, _NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        return jnp.einsum("hk,hkd->hd", probs, v)
+
+    return jax.vmap(one_seq)(q, block_tables, context_lens)
+
+
+def paged_attention(q, key_pages, value_pages, block_tables, context_lens,
+                    scale=None):
+    """Decode-step paged attention; Pallas kernel on TPU, jnp oracle
+    elsewhere (flag ``FLAGS_use_pallas_paged_attention`` forces the
+    reference path off TPU too)."""
+    from ..framework import flags
+    platform = jax.devices()[0].platform
+    use_kernel = (platform == "tpu"
+                  and bool(int(flags.flag(
+                      "FLAGS_use_pallas_paged_attention"))))
+    if use_kernel:
+        import warnings
+        try:
+            from jax.experimental.pallas.ops.tpu.paged_attention import (
+                paged_attention as _kernel)
+            s = scale if scale is not None else 1.0 / math.sqrt(
+                q.shape[-1])
+            pages_per_seq = block_tables.shape[1]
+            ppcb = next(c for c in (8, 4, 2, 1)
+                        if pages_per_seq % c == 0)
+            # the kernel applies no softmax scale — fold it into q
+            return _kernel(q * jnp.asarray(s, q.dtype), key_pages,
+                           value_pages, context_lens, block_tables,
+                           pages_per_compute_block=ppcb)
+        except Exception as e:
+            warnings.warn(
+                f"Pallas paged-attention kernel unavailable "
+                f"({type(e).__name__}: {e}); using the jnp reference "
+                f"path", RuntimeWarning)
+    return paged_attention_reference(q, key_pages, value_pages,
+                                     block_tables, context_lens, scale)

@@ -1,0 +1,205 @@
+"""Inference engine: Config/Predictor API, export round-trip, paged
+attention.
+
+Oracles (SURVEY.md §4 "Inference tests"): predictor numeric parity vs
+the eager layer, class-free execution from the serialized export, and
+paged attention vs a dense-attention oracle.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.inference import (Config, PrecisionType, create_predictor)
+from paddle_tpu.ops.paged_attention import (paged_attention,
+                                            paged_attention_reference)
+from paddle_tpu.ops.pallas.flash_attention import flash_attention_reference
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+@pytest.fixture
+def saved_model(tmp_path):
+    paddle.seed(7)
+    net = SmallNet()
+    net.eval()
+    path = str(tmp_path / "net")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([2, 8], "float32")])
+    x = np.random.RandomState(0).randn(2, 8).astype("float32")
+    ref = net(paddle.to_tensor(x)).numpy()
+    return path, x, ref
+
+
+def test_predictor_from_export(saved_model):
+    """Class-free execution: Config(prog_file) -> handles -> run."""
+    path, x, ref = saved_model
+    cfg = Config(path + ".pdmodel")
+    cfg.disable_gpu()
+    pred = create_predictor(cfg)
+    names = pred.get_input_names()
+    assert len(names) == 1
+    h = pred.get_input_handle(names[0])
+    h.copy_from_cpu(x)
+    assert pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0])
+    np.testing.assert_allclose(out.copy_to_cpu(), ref,
+                               rtol=1e-5, atol=1e-6)
+    assert out.shape() == [2, 4]
+
+
+def test_predictor_run_convenience(saved_model):
+    path, x, ref = saved_model
+    cfg = Config(path + ".pdmodel")
+    outs = create_predictor(cfg).run([x])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_clone_shares_program(saved_model):
+    path, x, ref = saved_model
+    pred = create_predictor(Config(path + ".pdmodel"))
+    clone = pred.clone()
+    assert clone._fn is pred._fn
+    np.testing.assert_allclose(clone.run([x])[0], ref,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_predictor_from_layer(saved_model):
+    """In-memory layer serving path."""
+    path, x, ref = saved_model
+    paddle.seed(7)
+    net = SmallNet()
+    net.set_state_dict(paddle.load(path + ".pdiparams"))
+    cfg = Config()
+    cfg.set_layer(net)
+    outs = create_predictor(cfg).run([x])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-6)
+
+
+def test_jit_load_without_class(saved_model, tmp_path):
+    """paddle.jit.load with no layer runs via the serialized export."""
+    path, x, ref = saved_model
+    loaded = paddle.jit.load(path)
+    out = loaded(paddle.to_tensor(x))
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-6)
+
+
+def test_dynamic_batch_export(tmp_path):
+    """InputSpec dims of -1 export symbolically: the class-free artifact
+    serves any batch size."""
+    paddle.seed(11)
+    net = SmallNet()
+    net.eval()
+    path = str(tmp_path / "dyn")
+    paddle.jit.save(net, path,
+                    input_spec=[paddle.static.InputSpec([-1, 8],
+                                                        "float32")])
+    pred = create_predictor(Config(path + ".pdmodel"))
+    for bs in (1, 4, 7):
+        x = np.random.RandomState(bs).randn(bs, 8).astype("float32")
+        ref = net(paddle.to_tensor(x)).numpy()
+        np.testing.assert_allclose(pred.run([x])[0], ref,
+                                   rtol=1e-5, atol=1e-6)
+
+
+def test_config_api_surface(tmp_path):
+    d = str(tmp_path / "dir_model")
+    import os
+    os.makedirs(d)
+    cfg = Config(d)
+    assert cfg.model_dir() == d
+    cfg2 = Config("m.pdmodel", "m.pdiparams")
+    assert cfg2.prog_file() == "m.pdmodel"
+    cfg2.enable_use_gpu(100, 0, PrecisionType.Bfloat16)
+    assert cfg2.use_gpu()
+    cfg2.switch_ir_optim(False)
+    assert not cfg2.ir_optim()
+    cfg2.enable_memory_optim()
+    assert cfg2.memory_optim_enabled()
+    assert not cfg2.tensorrt_engine_enabled()
+    assert "precision" in cfg2.summary()
+
+
+# --------------------------------------------------------------------------
+# paged attention
+# --------------------------------------------------------------------------
+
+def _build_paged_case(rng, B, H, KVH, D, page, n_pages_per_seq,
+                      total_pages, lens):
+    """Scatter dense K/V into a shuffled page pool; return both views."""
+    max_len = page * n_pages_per_seq
+    k_dense = rng.randn(B, max_len, KVH, D).astype("float32")
+    v_dense = rng.randn(B, max_len, KVH, D).astype("float32")
+    key_pages = np.zeros((KVH, total_pages, page, D), "float32")
+    value_pages = np.zeros((KVH, total_pages, page, D), "float32")
+    perm = rng.permutation(total_pages)
+    tables = np.zeros((B, n_pages_per_seq), "int32")
+    pid = 0
+    for b in range(B):
+        for j in range(n_pages_per_seq):
+            pg = perm[pid]
+            pid += 1
+            tables[b, j] = pg
+            sl = slice(j * page, (j + 1) * page)
+            key_pages[:, pg] = k_dense[b, sl].transpose(1, 0, 2)
+            value_pages[:, pg] = v_dense[b, sl].transpose(1, 0, 2)
+    return k_dense, v_dense, key_pages, value_pages, tables
+
+
+@pytest.mark.parametrize("H,KVH", [(4, 4), (8, 2)])
+def test_paged_attention_vs_dense(H, KVH):
+    """Paged gather path == dense attention over the valid prefix."""
+    rng = np.random.RandomState(0)
+    B, D, page, npps = 3, 16, 8, 4
+    total = B * npps + 2
+    lens = np.array([5, 17, 32], "int32")
+    k_dense, v_dense, kp, vp, tables = _build_paged_case(
+        rng, B, H, KVH, D, page, npps, total, lens)
+    q = rng.randn(B, H, D).astype("float32")
+
+    out = paged_attention(jnp.asarray(q), jnp.asarray(kp),
+                          jnp.asarray(vp), jnp.asarray(tables),
+                          jnp.asarray(lens))
+
+    # dense oracle per sequence over its valid prefix, GQA-expanded
+    rep = H // KVH
+    for b in range(B):
+        L = int(lens[b])
+        k = np.repeat(k_dense[b, :L], rep, axis=1)  # [L, H, D]
+        v = np.repeat(v_dense[b, :L], rep, axis=1)
+        ref = flash_attention_reference(
+            jnp.asarray(q[b][None, None]),           # [1, 1, H, D]
+            jnp.asarray(k[None]), jnp.asarray(v[None]))
+        np.testing.assert_allclose(np.asarray(out[b]),
+                                   np.asarray(ref[0, 0]),
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_paged_attention_incubate_api():
+    rng = np.random.RandomState(1)
+    B, H, KVH, D, page, npps = 2, 4, 4, 8, 4, 2
+    lens = np.array([3, 8], "int32")
+    _, _, kp, vp, tables = _build_paged_case(
+        rng, B, H, KVH, D, page, npps, B * npps, lens)
+    q = rng.randn(B, H, D).astype("float32")
+    from paddle_tpu.incubate.nn.functional import paged_attention as pa
+    out = pa(paddle.to_tensor(q), paddle.to_tensor(kp),
+             paddle.to_tensor(vp), paddle.to_tensor(tables),
+             paddle.to_tensor(lens))
+    ref = paged_attention_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(lens))
+    np.testing.assert_allclose(out.numpy(), np.asarray(ref),
+                               rtol=1e-6, atol=1e-6)
