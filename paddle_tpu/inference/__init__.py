@@ -210,6 +210,8 @@ class Tensor:
         return self._name
 
     def reshape(self, shape):
+        if not self._is_input:
+            raise RuntimeError(f"{self._name} is an output handle")
         cur = self._owner._inputs.get(self._name)
         dtype = cur.dtype if cur is not None else np.float32
         self._owner._inputs[self._name] = jnp.zeros(tuple(shape), dtype)
@@ -249,7 +251,6 @@ class Predictor:
         self._config = config
         self._inputs = {}
         self._outputs = None
-        self._compiled = {}  # shape signature -> jitted callable
         if _shared is not None:
             # clone(): share the loaded program/weights AND the
             # signature->compiled cache (the reference's Scope sharing;
@@ -260,12 +261,15 @@ class Predictor:
                                  else None)
             self._n_outputs = _shared._n_outputs
             self._can_cast = _shared._can_cast
-            self._compiled = _shared._compiled
+            self._jitted = _shared._jitted
             return
         self._fn, self._input_names, self._n_outputs = self._load(config)
         # a serialized export pins its input dtypes; precision casting
         # is only possible on the retraceable in-memory layer path
         self._can_cast = config._layer is not None
+        # jax.jit's own cache keys on shape/dtype/device, so one jitted
+        # callable covers every signature (and clones share it)
+        self._jitted = jax.jit(self._fn)
 
     # -- loading -----------------------------------------------------------
     def _load(self, config):
@@ -306,7 +310,9 @@ class Predictor:
     def get_input_names(self):
         if self._input_names is not None:
             return list(self._input_names)
-        return sorted(self._inputs.keys()) or ["x0"]
+        # handle-binding (insertion) order = the layer's positional
+        # argument order
+        return list(self._inputs.keys()) or ["x0"]
 
     def get_input_handle(self, name):
         if self._input_names is None and name not in self._inputs:
@@ -318,7 +324,7 @@ class Predictor:
 
     def get_output_names(self):
         if self._outputs is not None:
-            return sorted(self._outputs.keys())
+            return list(self._outputs.keys())  # out0..outN index order
         n = self._n_outputs or 1
         return [f"out{i}" for i in range(n)]
 
@@ -347,7 +353,7 @@ class Predictor:
         else:
             names = (self._input_names
                      if self._input_names is not None
-                     else sorted(self._inputs.keys()))
+                     else list(self._inputs.keys()))
             missing = [n for n in names if self._inputs.get(n) is None]
             if missing:
                 raise RuntimeError(f"inputs not set: {missing}")
@@ -358,12 +364,7 @@ class Predictor:
             # disable_gpu(): actually execute on host, not just fetch
             cpu = jax.local_devices(backend="cpu")[0]
             xs = [jax.device_put(x, cpu) for x in xs]
-        sig = (tuple((tuple(x.shape), str(x.dtype)) for x in xs), on_cpu)
-        jitted = self._compiled.get(sig)
-        if jitted is None:
-            jitted = jax.jit(lambda *a: self._fn(*a))
-            self._compiled[sig] = jitted
-        outs = jitted(*xs)
+        outs = self._jitted(*xs)
         if not isinstance(outs, (list, tuple)):
             outs = (outs,)
         outs = [jax.device_get(o) if on_cpu else o for o in outs]

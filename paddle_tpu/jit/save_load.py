@@ -73,12 +73,17 @@ def save(layer, path, input_spec=None, **configs):
             # artifact serves any batch size.
             try:
                 from jax import export as jexport
-                if has_dyn:
-                    args_specs = jexport.symbolic_args_specs(
-                        arrays, shape_strs)
-                    exp = jexport.export(jax.jit(fwd))(*args_specs)
-                else:
-                    exp = jexport.export(jax.jit(fwd))(*arrays)
+                spec_args = (jexport.symbolic_args_specs(arrays,
+                                                         shape_strs)
+                             if has_dyn else arrays)
+                try:
+                    # multi-platform so the artifact serves on either
+                    # a CPU dev box or a TPU host
+                    exp = jexport.export(
+                        jax.jit(fwd),
+                        platforms=("cpu", "tpu"))(*spec_args)
+                except Exception:
+                    exp = jexport.export(jax.jit(fwd))(*spec_args)
                 with open(path + ".pdexported", "wb") as f:
                     f.write(bytes(exp.serialize()))
                 meta["exported"] = True

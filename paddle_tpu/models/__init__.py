@@ -7,7 +7,11 @@ from .gpt2 import GPT2Config, GPT2Model, GPT2ForCausalLM
 from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM
 from .qwen2 import (Qwen2Config, Qwen2MoeConfig, Qwen2ForCausalLM,
                     Qwen2MoeForCausalLM)
+from .ernie import (ErnieConfig, ErnieModel, ErnieForPretraining,
+                    ErnieForMaskedLM, ErnieForSequenceClassification)
 
 __all__ = ["GPT2Config", "GPT2Model", "GPT2ForCausalLM", "LlamaConfig",
            "LlamaModel", "LlamaForCausalLM", "Qwen2Config",
-           "Qwen2MoeConfig", "Qwen2ForCausalLM", "Qwen2MoeForCausalLM"]
+           "Qwen2MoeConfig", "Qwen2ForCausalLM", "Qwen2MoeForCausalLM",
+           "ErnieConfig", "ErnieModel", "ErnieForPretraining",
+           "ErnieForMaskedLM", "ErnieForSequenceClassification"]

@@ -1,0 +1,251 @@
+"""ERNIE encoder family (BASELINE config 3: ERNIE-3.0-base pretrain DP).
+
+Role of PaddleNLP's ``paddlenlp/transformers/ernie`` model family driving
+the reference framework (SURVEY.md §0; reference mount empty, no file:line
+cites). ERNIE is a BERT-shaped bidirectional encoder with an extra
+*task-type* embedding; pretraining pairs masked-LM with a sentence-order
+objective.
+
+TPU-first: full-sequence bidirectional attention goes through
+``F.scaled_dot_product_attention`` (Pallas flash-attention kernel on TPU);
+everything is static-shape so XLA tiles the 12 encoder matmuls onto the
+MXU back-to-back.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import nn
+from ..nn import functional as F
+from ..ops import creation, manipulation as M
+
+__all__ = ["ErnieConfig", "ErnieModel", "ErnieForPretraining",
+           "ErnieForSequenceClassification", "ErnieForMaskedLM"]
+
+
+@dataclass
+class ErnieConfig:
+    vocab_size: int = 40000
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 2048
+    type_vocab_size: int = 4
+    task_type_vocab_size: int = 3
+    use_task_id: bool = True
+    hidden_dropout_prob: float = 0.1
+    attention_dropout_prob: float = 0.1
+    layer_norm_epsilon: float = 1e-12
+    initializer_range: float = 0.02
+    pad_token_id: int = 0
+
+    @classmethod
+    def base(cls):
+        """ERNIE-3.0-base shape."""
+        return cls()
+
+    @classmethod
+    def tiny(cls):
+        return cls(vocab_size=512, hidden_size=64, num_hidden_layers=2,
+                   num_attention_heads=4, intermediate_size=128,
+                   max_position_embeddings=128, type_vocab_size=2,
+                   hidden_dropout_prob=0.0, attention_dropout_prob=0.0)
+
+
+def _attr(cfg):
+    return nn.ParamAttr(
+        initializer=nn.initializer.Normal(0.0, cfg.initializer_range))
+
+
+class ErnieEmbeddings(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.word_embeddings = nn.Embedding(
+            cfg.vocab_size, cfg.hidden_size, weight_attr=_attr(cfg))
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size,
+            weight_attr=_attr(cfg))
+        self.token_type_embeddings = nn.Embedding(
+            cfg.type_vocab_size, cfg.hidden_size, weight_attr=_attr(cfg))
+        self.use_task_id = cfg.use_task_id
+        if cfg.use_task_id:
+            self.task_type_embeddings = nn.Embedding(
+                cfg.task_type_vocab_size, cfg.hidden_size,
+                weight_attr=_attr(cfg))
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       cfg.layer_norm_epsilon)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        s = input_ids.shape[1]
+        if position_ids is None:
+            position_ids = creation.arange(0, s, dtype="int64")
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids))
+        if token_type_ids is None:
+            token_type_ids = creation.zeros_like(input_ids)
+        x = x + self.token_type_embeddings(token_type_ids)
+        if self.use_task_id:
+            if task_type_ids is None:
+                task_type_ids = creation.zeros_like(input_ids)
+            x = x + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class ErnieSelfAttention(nn.Layer):
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.num_heads = cfg.num_attention_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.qkv = nn.Linear(cfg.hidden_size, 3 * cfg.hidden_size,
+                             weight_attr=_attr(cfg))
+        self.out = nn.Linear(cfg.hidden_size, cfg.hidden_size,
+                             weight_attr=_attr(cfg))
+        self.attn_dropout = cfg.attention_dropout_prob
+
+    def forward(self, x, attn_mask=None):
+        b, s, e = x.shape
+        qkv = M.reshape(self.qkv(x),
+                        [b, s, 3, self.num_heads, self.head_dim])
+        ctx = F.scaled_dot_product_attention(
+            qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2],
+            attn_mask=attn_mask, dropout_p=self.attn_dropout,
+            training=self.training)
+        return self.out(M.reshape(ctx, [b, s, e]))
+
+
+class ErnieLayer(nn.Layer):
+    """Post-norm encoder block (BERT/ERNIE convention)."""
+
+    def __init__(self, cfg: ErnieConfig):
+        super().__init__()
+        self.attn = ErnieSelfAttention(cfg)
+        self.ln1 = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_epsilon)
+        self.fc1 = nn.Linear(cfg.hidden_size, cfg.intermediate_size,
+                             weight_attr=_attr(cfg))
+        self.fc2 = nn.Linear(cfg.intermediate_size, cfg.hidden_size,
+                             weight_attr=_attr(cfg))
+        self.ln2 = nn.LayerNorm(cfg.hidden_size, cfg.layer_norm_epsilon)
+        self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
+
+    def forward(self, x, attn_mask=None):
+        x = self.ln1(x + self.dropout(self.attn(x, attn_mask)))
+        h = self.fc2(F.gelu(self.fc1(x)))
+        return self.ln2(x + self.dropout(h))
+
+
+class ErnieModel(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.config = config
+        self.embeddings = ErnieEmbeddings(config)
+        self.encoder = nn.LayerList(
+            [ErnieLayer(config)
+             for _ in range(config.num_hidden_layers)])
+        self.pooler = nn.Linear(config.hidden_size, config.hidden_size,
+                                weight_attr=_attr(config))
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        """Returns (sequence_output [B,S,E], pooled_output [B,E]).
+
+        attention_mask: [B, S] with 1 = attend, 0 = padding."""
+        mask = None
+        if attention_mask is not None:
+            # [B, S] -> additive [B, 1, 1, S]
+            neg = (1.0 - attention_mask.astype("float32")) * -1e30
+            mask = M.reshape(neg, [neg.shape[0], 1, 1, neg.shape[1]])
+        x = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        for layer in self.encoder:
+            x = layer(x, mask)
+        pooled = F.tanh(self.pooler(x[:, 0]))
+        return x, pooled
+
+
+class ErnieForPretraining(nn.Layer):
+    """Masked-LM (tied decoder) + sentence-order prediction heads."""
+
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.config = config
+        cfg = config
+        self.mlm_transform = nn.Linear(cfg.hidden_size, cfg.hidden_size,
+                                       weight_attr=_attr(cfg))
+        self.mlm_ln = nn.LayerNorm(cfg.hidden_size,
+                                   cfg.layer_norm_epsilon)
+        self.mlm_bias = self.create_parameter(
+            [cfg.vocab_size], is_bias=True)
+        self.sop_head = nn.Linear(cfg.hidden_size, 2,
+                                  weight_attr=_attr(cfg))
+
+    def _mlm_logits(self, hidden):
+        from ..ops.linalg import matmul
+        h = self.mlm_ln(F.gelu(self.mlm_transform(hidden)))
+        return matmul(h, self.ernie.embeddings.word_embeddings.weight,
+                      transpose_y=True) + self.mlm_bias
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, masked_lm_labels=None,
+                sop_labels=None):
+        """masked_lm_labels: [B, S] with -100 = unmasked (ignored).
+        Returns (mlm_logits, sop_logits) or the summed loss when labels
+        are given (mean over masked positions + mean sop CE)."""
+        seq, pooled = self.ernie(input_ids, token_type_ids, position_ids,
+                                 attention_mask)
+        mlm_logits = self._mlm_logits(seq)
+        sop_logits = self.sop_head(pooled)
+        if masked_lm_labels is None:
+            return mlm_logits, sop_logits
+        V = self.config.vocab_size
+        loss = F.cross_entropy(M.reshape(mlm_logits, [-1, V]),
+                               M.reshape(masked_lm_labels, [-1]),
+                               ignore_index=-100)
+        if sop_labels is not None:
+            loss = loss + F.cross_entropy(sop_logits,
+                                          M.reshape(sop_labels, [-1]))
+        return loss
+
+
+class ErnieForMaskedLM(nn.Layer):
+    def __init__(self, config: ErnieConfig):
+        super().__init__()
+        self._pre = ErnieForPretraining(config)
+        self.ernie = self._pre.ernie
+        self.config = config
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None, labels=None):
+        seq, _ = self.ernie(input_ids, token_type_ids, None,
+                            attention_mask)
+        logits = self._pre._mlm_logits(seq)
+        if labels is None:
+            return logits
+        V = self.config.vocab_size
+        return F.cross_entropy(M.reshape(logits, [-1, V]),
+                               M.reshape(labels, [-1]),
+                               ignore_index=-100)
+
+
+class ErnieForSequenceClassification(nn.Layer):
+    def __init__(self, config: ErnieConfig, num_classes=2, dropout=None):
+        super().__init__()
+        self.ernie = ErnieModel(config)
+        self.num_classes = num_classes
+        p = (config.hidden_dropout_prob if dropout is None else dropout)
+        self.dropout = nn.Dropout(p)
+        self.classifier = nn.Linear(config.hidden_size, num_classes,
+                                    weight_attr=_attr(config))
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None, labels=None):
+        _, pooled = self.ernie(input_ids, token_type_ids, None,
+                               attention_mask)
+        logits = self.classifier(self.dropout(pooled))
+        if labels is None:
+            return logits
+        return F.cross_entropy(logits, M.reshape(labels, [-1]))

@@ -33,7 +33,13 @@ def _norm_shape(shape):
         return tuple(int(v) for v in np.asarray(shape._data))
     out = []
     for s in shape:
-        out.append(int(s.item()) if isinstance(s, Tensor) else int(s))
+        if isinstance(s, Tensor):
+            out.append(int(s.item()))
+        elif isinstance(s, (int, np.integer)):
+            out.append(int(s))
+        else:
+            # symbolic dim (jax.export shape polymorphism) — pass through
+            out.append(s)
     return tuple(out)
 
 
