@@ -19,6 +19,7 @@ from ...framework.random import get_rng_state_tracker
 from .sharding import (DygraphShardingOptimizer, group_sharded_parallel,
                        GroupShardedStage3)
 from . import utils
+from . import elastic
 
 __all__ = ["fleet", "init", "DistributedStrategy", "Fleet",
            "CommunicateTopology", "HybridCommunicateGroup", "meta_parallel",

@@ -1,0 +1,22 @@
+"""Elastic training — fleet ``elastic/manager.py`` parity (UNVERIFIED;
+reference mount empty).
+
+Reference design (SURVEY.md §5 "Failure detection / elastic"): etcd node
+registry + heartbeats; on peer loss the launch controller tears down
+local trainers and re-launches; recovery is checkpoint-restart, not
+in-process resume.
+
+TPU-native: the registry is the framework's own ``TCPStore`` control
+plane (paddle_tpu.native — the same store that does rendezvous), or a
+shared-filesystem heartbeat directory when no store is reachable (the
+single-host / tests path). Worker processes run a daemon heartbeat
+thread; the launcher (or any watcher) polls for stale peers and drives
+SIGTERM → relaunch. Recovery stays checkpoint-restart: see
+``latest_checkpoint`` / ``checkpoint_step`` helpers.
+"""
+
+from .manager import (ElasticManager, ElasticStatus, start_heartbeat,
+                      stop_heartbeat, latest_checkpoint, checkpoint_step)
+
+__all__ = ["ElasticManager", "ElasticStatus", "start_heartbeat",
+           "stop_heartbeat", "latest_checkpoint", "checkpoint_step"]

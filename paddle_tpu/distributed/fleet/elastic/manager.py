@@ -1,0 +1,201 @@
+"""Elastic manager: heartbeat registry + fault watch + checkpoint-restart
+helpers (fleet ``elastic/manager.py`` role; reference mount empty, no
+file:line cites).
+
+Two registry backends behind one API:
+
+- **store**: a ``TCPStore`` (host:port) — each worker ``set``s its
+  heartbeat key every interval; the watcher reads all keys and flags
+  ranks whose timestamp went stale. Multi-host path (the role etcd
+  plays in the reference).
+- **dir**: a shared directory — each worker touches
+  ``heartbeat.{rank}``; the watcher checks mtimes. Single-host /
+  CI path (and the natural fit for the launcher's per-node watchdog).
+"""
+
+from __future__ import annotations
+
+import enum
+import os
+import threading
+import time
+
+__all__ = ["ElasticManager", "ElasticStatus", "start_heartbeat",
+           "stop_heartbeat", "latest_checkpoint", "checkpoint_step"]
+
+
+class ElasticStatus(enum.Enum):
+    HEALTHY = 0
+    STALE = 1       # some rank missed its heartbeat window
+    INCOMPLETE = 2  # not all ranks have registered yet
+
+
+# --------------------------------------------------------------------------
+# worker side: heartbeat thread
+# --------------------------------------------------------------------------
+
+_worker = {"thread": None, "stop": None}
+
+
+def _beat_once(rank, directory=None, store=None):
+    now = str(time.time()).encode()
+    if directory is not None:
+        path = os.path.join(directory, f"heartbeat.{rank}")
+        with open(path, "w") as f:
+            f.write(now.decode())
+    if store is not None:
+        store.set(f"elastic/beat/{rank}", now)
+
+
+def start_heartbeat(rank=None, directory=None, store=None, interval=1.0):
+    """Start the daemon heartbeat thread for this worker process.
+
+    directory and/or store select the registry backend(s). When rank or
+    directory is None they default from the launcher-set env
+    (``PADDLE_ELASTIC_HEARTBEAT_RANK`` / ``_DIR``) — note the rank key
+    is the *node-local* rank: each node's launcher watches only its own
+    workers, so a training script can call ``start_heartbeat()`` with
+    no arguments under any topology."""
+    if rank is None:
+        rank = int(os.environ.get("PADDLE_ELASTIC_HEARTBEAT_RANK",
+                                  os.environ.get("PADDLE_LOCAL_RANK",
+                                                 "0")))
+    if directory is None:
+        directory = os.environ.get("PADDLE_ELASTIC_HEARTBEAT_DIR")
+    if directory is None and store is None:
+        return False
+    stop_heartbeat()  # one heartbeat thread per process
+    if directory is not None:
+        os.makedirs(directory, exist_ok=True)
+    stop = threading.Event()
+
+    def loop():
+        while not stop.is_set():
+            try:
+                _beat_once(rank, directory, store)
+            except Exception:
+                pass  # registry hiccups must never kill the trainer
+            stop.wait(interval)
+
+    _beat_once(rank, directory, store)
+    t = threading.Thread(target=loop, name="elastic-heartbeat",
+                         daemon=True)
+    t.start()
+    _worker["thread"], _worker["stop"] = t, stop
+    return True
+
+
+def stop_heartbeat():
+    if _worker["stop"] is not None:
+        _worker["stop"].set()
+        _worker["thread"].join(timeout=2.0)
+        _worker["thread"] = _worker["stop"] = None
+
+
+# --------------------------------------------------------------------------
+# watcher side
+# --------------------------------------------------------------------------
+
+class ElasticManager:
+    """Fault watcher over the heartbeat registry.
+
+    watch() returns an ElasticStatus; the caller (launcher) decides the
+    response — the reference semantics: kill local trainers and
+    re-launch from the latest checkpoint."""
+
+    def __init__(self, world_size, directory=None, store=None,
+                 timeout=10.0):
+        if directory is None and store is None:
+            raise ValueError("ElasticManager needs a directory or store")
+        self.world_size = int(world_size)
+        self.directory = directory
+        self.store = store
+        self.timeout = float(timeout)
+
+    def _beats(self):
+        beats = {}
+        if self.directory is not None:
+            for r in range(self.world_size):
+                p = os.path.join(self.directory, f"heartbeat.{r}")
+                try:
+                    beats[r] = os.path.getmtime(p)
+                except OSError:
+                    pass
+        if self.store is not None:
+            for r in range(self.world_size):
+                v = self.store.get(f"elastic/beat/{r}")
+                if v:
+                    beats[r] = max(beats.get(r, 0.0), float(v))
+        return beats
+
+    def watch(self, ignore=()):
+        """One poll: (status, stale_ranks). ``ignore``: ranks exempt
+        from staleness (e.g. workers that already exited cleanly)."""
+        beats = self._beats()
+        watched = [r for r in range(self.world_size) if r not in ignore]
+        missing = [r for r in watched if r not in beats]
+        if missing:
+            return ElasticStatus.INCOMPLETE, missing
+        now = time.time()
+        stale = [r for r in watched
+                 if now - beats[r] > self.timeout]
+        if stale:
+            return ElasticStatus.STALE, stale
+        return ElasticStatus.HEALTHY, []
+
+    def wait_all_registered(self, timeout=60.0, poll=0.2):
+        end = time.time() + timeout
+        while time.time() < end:
+            status, _ = self.watch()
+            if status is not ElasticStatus.INCOMPLETE:
+                return True
+            time.sleep(poll)
+        return False
+
+    def reset(self):
+        """Clear registered beats (before a relaunch round)."""
+        if self.directory is not None:
+            for r in range(self.world_size):
+                p = os.path.join(self.directory, f"heartbeat.{r}")
+                try:
+                    os.remove(p)
+                except OSError:
+                    pass
+        if self.store is not None:
+            for r in range(self.world_size):
+                try:
+                    self.store.delete_key(f"elastic/beat/{r}")
+                except Exception:
+                    pass
+
+
+# --------------------------------------------------------------------------
+# checkpoint-restart helpers
+# --------------------------------------------------------------------------
+
+def checkpoint_step(path):
+    """Step number encoded in a ``step_N`` checkpoint dir name, else -1."""
+    base = os.path.basename(os.path.normpath(path))
+    if base.startswith("step_"):
+        try:
+            return int(base[len("step_"):])
+        except ValueError:
+            pass
+    return -1
+
+
+def latest_checkpoint(root):
+    """Newest ``step_N`` subdirectory of root (the resume point after a
+    relaunch), or None. Ignores in-progress dirs marked with a
+    ``.tmp`` suffix (async-save convention)."""
+    if not os.path.isdir(root):
+        return None
+    best, best_step = None, -1
+    for name in os.listdir(root):
+        full = os.path.join(root, name)
+        if not os.path.isdir(full) or name.endswith(".tmp"):
+            continue
+        s = checkpoint_step(full)
+        if s > best_step:
+            best, best_step = full, s
+    return best

@@ -33,6 +33,10 @@ def _parse():
     p.add_argument("--max_restarts", type=int,
                    default=int(os.environ.get("PADDLE_MAX_RESTARTS", "0")))
     p.add_argument("--elastic_timeout", type=int, default=30)
+    p.add_argument("--heartbeat_timeout", type=float, default=0.0,
+                   help="if > 0, watch worker heartbeats (workers call "
+                        "fleet.elastic.start_heartbeat) and treat a "
+                        "stale rank as a fault -> kill + relaunch")
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
@@ -63,41 +67,103 @@ def _spawn(rank, world, args, extra_env=None):
     return proc, logf
 
 
+def _terminate_all(procs, grace=5.0):
+    for proc, _ in procs:
+        if proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+    end = time.time() + grace
+    for proc, logf in procs:
+        try:
+            proc.wait(timeout=max(0.1, end - time.time()))
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+        logf.close()
+
+
+def _run_round(procs, args, manager):
+    """Poll all workers concurrently (a failed or hung rank must be
+    noticed while others still run — the fault-watch role of the
+    reference's elastic manager). Returns 'ok' | 'failed' | 'stale'."""
+    start = time.time()
+    # a worker hung *before* it ever heartbeats must also be caught:
+    # give registration a bounded grace window
+    register_deadline = start + max(5 * args.heartbeat_timeout, 30.0)
+    while True:
+        alive = False
+        done_ok = set()
+        for local, (proc, _) in enumerate(procs):
+            ret = proc.poll()
+            if ret is None:
+                alive = True
+            elif ret != 0:
+                return "failed"
+            else:
+                done_ok.add(local)
+        if not alive:
+            for _, logf in procs:
+                logf.close()
+            return "ok"
+        if manager is not None:
+            from ..fleet.elastic import ElasticStatus
+            # cleanly-exited workers stop heartbeating legitimately
+            status, bad = manager.watch(ignore=done_ok)
+            if status is ElasticStatus.STALE:
+                print(f"paddle_tpu.launch: stale heartbeats from ranks "
+                      f"{bad}", file=sys.stderr)
+                return "stale"
+            if (status is ElasticStatus.INCOMPLETE
+                    and time.time() > register_deadline):
+                print(f"paddle_tpu.launch: ranks {bad} never "
+                      f"registered a heartbeat", file=sys.stderr)
+                return "stale"
+        time.sleep(0.2)
+
+
 def launch_main():
     args = _parse()
     world = args.nnodes * args.nproc_per_node
     restarts = 0
+    manager = None
+    hb_dir = None
+    if args.heartbeat_timeout > 0:
+        from ..fleet.elastic import ElasticManager
+        hb_dir = os.path.join(args.log_dir, "heartbeat")
+        os.makedirs(hb_dir, exist_ok=True)
+        # watch only this node's ranks; peer nodes watch their own
+        manager = ElasticManager(args.nproc_per_node, directory=hb_dir,
+                                 timeout=args.heartbeat_timeout)
     while True:
         procs = []
         base = args.rank * args.nproc_per_node
+        if manager is not None:
+            manager.reset()
         for local in range(args.nproc_per_node):
             rank = base + local
-            extra = {}
+            extra = {"PADDLE_LOCAL_RANK": str(local)}
+            if hb_dir is not None:
+                extra["PADDLE_ELASTIC_HEARTBEAT_DIR"] = hb_dir
+                extra["PADDLE_ELASTIC_HEARTBEAT_RANK"] = str(local)
             if args.nproc_per_node > 1:
                 # CPU-simulated cluster: isolate each proc onto CPU devices
                 extra["JAX_PLATFORMS"] = "cpu"
             procs.append(_spawn(rank, world, args, extra))
-        failed = False
         try:
-            for proc, logf in procs:
-                ret = proc.wait()
-                logf.close()
-                if ret != 0:
-                    failed = True
+            outcome = _run_round(procs, args, manager)
         except KeyboardInterrupt:
-            for proc, _ in procs:
-                proc.send_signal(signal.SIGTERM)
+            _terminate_all(procs)
             raise
-        if not failed:
+        if outcome == "ok":
             print("paddle_tpu.launch: all workers exited cleanly")
             return 0
+        _terminate_all(procs)
         # failure detection → checkpoint-restart (elastic mode)
         if restarts >= args.max_restarts:
-            print("paddle_tpu.launch: worker failed; restarts exhausted",
-                  file=sys.stderr)
+            print(f"paddle_tpu.launch: worker {outcome}; restarts "
+                  f"exhausted", file=sys.stderr)
             return 1
         restarts += 1
-        print(f"paddle_tpu.launch: worker failed; relaunching "
+        print(f"paddle_tpu.launch: worker {outcome}; relaunching "
               f"({restarts}/{args.max_restarts}) after "
               f"{args.elastic_timeout}s", file=sys.stderr)
         time.sleep(args.elastic_timeout)

@@ -1,0 +1,167 @@
+"""Elastic launch: fault detection, heartbeat watchdog, checkpoint-restart
+(SURVEY.md §5; test pattern = reference's subprocess-kill simulation).
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from paddle_tpu.distributed.fleet.elastic import (
+    ElasticManager, ElasticStatus, latest_checkpoint, checkpoint_step,
+    start_heartbeat, stop_heartbeat)
+
+LAUNCH = [sys.executable, "-m", "paddle_tpu.distributed.launch"]
+ENV = dict(os.environ, JAX_PLATFORMS="cpu",
+           PYTHONPATH=os.path.dirname(os.path.dirname(
+               os.path.abspath(__file__))))
+
+
+# --------------------------------------------------------------------------
+# manager unit behavior
+# --------------------------------------------------------------------------
+
+def test_heartbeat_and_watch(tmp_path):
+    d = str(tmp_path)
+    mgr = ElasticManager(2, directory=d, timeout=0.5)
+    status, missing = mgr.watch()
+    assert status is ElasticStatus.INCOMPLETE and missing == [0, 1]
+    start_heartbeat(0, directory=d, interval=0.1)
+    status, missing = mgr.watch()
+    assert status is ElasticStatus.INCOMPLETE and missing == [1]
+    start_heartbeat(1, directory=d, interval=0.1)  # replaces thread 0...
+    assert mgr.wait_all_registered(timeout=5.0)
+    status, stale = mgr.watch()
+    assert status is ElasticStatus.HEALTHY
+    # rank 0's thread was replaced by rank 1's: rank 0 goes stale
+    time.sleep(0.8)
+    status, stale = mgr.watch()
+    assert status is ElasticStatus.STALE and stale == [0]
+    stop_heartbeat()
+    mgr.reset()
+    assert mgr.watch()[0] is ElasticStatus.INCOMPLETE
+
+
+def test_heartbeat_store_backend():
+    from paddle_tpu.native import TCPStore
+    store = TCPStore("127.0.0.1", 29877, is_master=True, world_size=1)
+    try:
+        mgr = ElasticManager(1, store=store, timeout=5.0)
+        assert mgr.watch()[0] is ElasticStatus.INCOMPLETE
+        from paddle_tpu.distributed.fleet.elastic.manager import _beat_once
+        _beat_once(0, store=store)
+        assert mgr.watch()[0] is ElasticStatus.HEALTHY
+        mgr.reset()
+        assert mgr.watch()[0] is ElasticStatus.INCOMPLETE
+    finally:
+        store.close()
+
+
+def test_watch_ignores_exited_ranks(tmp_path):
+    """A rank that exited cleanly stops heartbeating but must not be
+    treated as stale (launcher passes it in ignore=)."""
+    d = str(tmp_path)
+    mgr = ElasticManager(2, directory=d, timeout=0.3)
+    from paddle_tpu.distributed.fleet.elastic.manager import _beat_once
+    _beat_once(0, directory=d)
+    _beat_once(1, directory=d)
+    time.sleep(0.5)
+    _beat_once(1, directory=d)  # rank 1 still alive; rank 0 exited
+    assert mgr.watch()[0] is ElasticStatus.STALE
+    status, bad = mgr.watch(ignore={0})
+    assert status is ElasticStatus.HEALTHY, bad
+
+
+def test_start_heartbeat_rank_from_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("PADDLE_ELASTIC_HEARTBEAT_RANK", "3")
+    monkeypatch.setenv("PADDLE_ELASTIC_HEARTBEAT_DIR", str(tmp_path))
+    assert start_heartbeat(interval=0.2)
+    try:
+        assert os.path.exists(tmp_path / "heartbeat.3")
+    finally:
+        stop_heartbeat()
+
+
+def test_latest_checkpoint(tmp_path):
+    assert latest_checkpoint(str(tmp_path / "nope")) is None
+    for s in (10, 200, 30):
+        os.makedirs(tmp_path / f"step_{s}")
+    os.makedirs(tmp_path / "step_999.tmp")  # in-progress: ignored
+    os.makedirs(tmp_path / "unrelated")
+    best = latest_checkpoint(str(tmp_path))
+    assert os.path.basename(best) == "step_200"
+    assert checkpoint_step(best) == 200
+    assert checkpoint_step("/x/unrelated") == -1
+
+
+# --------------------------------------------------------------------------
+# launcher integration (subprocess-kill simulation)
+# --------------------------------------------------------------------------
+
+CRASH_ONCE = """
+import os, sys
+marker = sys.argv[1]
+if not os.path.exists(marker):
+    open(marker, "w").write("x")
+    sys.exit(1)          # first run: fail -> launcher must relaunch
+open(marker + ".done", "w").write("ok")
+"""
+
+
+def test_launcher_restarts_after_crash(tmp_path):
+    script = tmp_path / "crash_once.py"
+    script.write_text(CRASH_ONCE)
+    marker = str(tmp_path / "marker")
+    r = subprocess.run(
+        LAUNCH + ["--max_restarts", "2", "--elastic_timeout", "0",
+                  "--log_dir", str(tmp_path / "log"),
+                  str(script), marker],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(marker + ".done")
+    assert "relaunching (1/2)" in r.stderr
+
+
+def test_launcher_exhausts_restarts(tmp_path):
+    script = tmp_path / "always_fail.py"
+    script.write_text("import sys; sys.exit(3)")
+    r = subprocess.run(
+        LAUNCH + ["--max_restarts", "1", "--elastic_timeout", "0",
+                  "--log_dir", str(tmp_path / "log"), str(script)],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "restarts exhausted" in r.stderr
+
+
+HANG_ONCE = """
+import os, sys, time
+from paddle_tpu.distributed.fleet.elastic import start_heartbeat
+marker = sys.argv[1]
+rank = int(os.environ.get("PADDLE_ELASTIC_HEARTBEAT_RANK", "0"))
+start_heartbeat(rank, interval=0.1)
+if not os.path.exists(marker):
+    open(marker, "w").write("x")
+    from paddle_tpu.distributed.fleet.elastic import stop_heartbeat
+    stop_heartbeat()     # heartbeat stops but the process hangs
+    time.sleep(300)
+open(marker + ".done", "w").write("ok")
+"""
+
+
+def test_launcher_detects_hung_worker(tmp_path):
+    """A worker that stops heartbeating (but does not exit) must be
+    killed and relaunched — the watchdog path."""
+    script = tmp_path / "hang_once.py"
+    script.write_text(HANG_ONCE)
+    marker = str(tmp_path / "marker")
+    r = subprocess.run(
+        LAUNCH + ["--max_restarts", "1", "--elastic_timeout", "0",
+                  "--heartbeat_timeout", "2.0",
+                  "--log_dir", str(tmp_path / "log"),
+                  str(script), marker],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    assert os.path.exists(marker + ".done")
+    assert "stale heartbeats" in r.stderr
