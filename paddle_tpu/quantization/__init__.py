@@ -1,0 +1,363 @@
+"""``paddle.quantization`` — QAT / PTQ (reference: quant passes +
+fake_quantize kernels, ``paddle/fluid/contrib/slim`` hooks and
+``phi/kernels/*/fake_quantize*``; SURVEY.md §2.1 "Quant/compression";
+reference mount empty, no file:line cites).
+
+TPU-native design:
+
+- ``quant_abs_max`` / ``fake_quant_dequant`` are jnp ops with a
+  straight-through-estimator custom VJP — the role the fake_quantize
+  CUDA kernels play, but fused by XLA into the surrounding graph.
+- QAT wraps layers with ``FakeQuanterWithAbsMax`` (weights: per-channel
+  abs-max; activations: EMA abs-max collected while training).
+- PTQ inserts observers, calibrates on sample batches, then ``convert``
+  produces ``QuantedLinear``: weights stored **int8**, matmul runs
+  int8xint8 -> int32 with ``preferred_element_type`` so XLA can use the
+  MXU's int8 path, then rescales — the TPU analogue of the reference's
+  int8 inference kernels.
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply
+from .. import nn
+
+__all__ = ["quant_abs_max_scale", "fake_quant_dequant",
+           "FakeQuanterWithAbsMax", "MovingAverageAbsmaxObserver",
+           "QuantConfig", "QAT", "PTQ", "QuantedLinear"]
+
+
+# --------------------------------------------------------------------------
+# fake-quant ops (STE)
+# --------------------------------------------------------------------------
+
+def quant_abs_max_scale(x, axis=None, eps=1e-8):
+    """Per-tensor (axis=None) or per-channel abs-max scale for int8."""
+    a = x.jax() if isinstance(x, Tensor) else jnp.asarray(x)
+    if axis is None:
+        m = jnp.max(jnp.abs(a))
+    else:
+        red = tuple(i for i in range(a.ndim) if i != axis)
+        m = jnp.max(jnp.abs(a), axis=red, keepdims=False)
+    return jnp.maximum(m, eps) / 127.0
+
+
+@jax.custom_vjp
+def _fqdq(a, scale):
+    q = jnp.clip(jnp.round(a / scale), -127, 127)
+    return q * scale
+
+
+def _fqdq_fwd(a, scale):
+    return _fqdq(a, scale), None
+
+
+def _fqdq_bwd(_, g):
+    return g, None  # straight-through estimator
+
+
+_fqdq.defvjp(_fqdq_fwd, _fqdq_bwd)
+
+
+def fake_quant_dequant(x, scale=None, axis=None):
+    """Quantize to int8 grid and back (training-time simulation) with a
+    straight-through gradient."""
+    def fn(a):
+        s = scale
+        if s is None:
+            if axis is None:
+                m = jnp.max(jnp.abs(a))
+            else:
+                red = tuple(i for i in range(a.ndim) if i != axis)
+                m = jnp.max(jnp.abs(a), axis=red, keepdims=True)
+            s = jnp.maximum(m, 1e-8) / 127.0
+        else:
+            s = jnp.asarray(s)
+            if axis is not None and s.ndim == 1:
+                shape = [1] * a.ndim
+                shape[axis] = s.shape[0]
+                s = s.reshape(shape)
+        return _fqdq(a, s.astype(a.dtype))
+    if isinstance(x, Tensor):
+        return apply(fn, x, name="fake_quant_dequant")
+    return fn(jnp.asarray(x))
+
+
+# --------------------------------------------------------------------------
+# observers / quanters
+# --------------------------------------------------------------------------
+
+class MovingAverageAbsmaxObserver:
+    """PTQ/QAT activation observer: EMA of per-tensor abs-max. The EMA
+    stays a device scalar (no host sync on the training hot path); it
+    is only pulled to a python float at convert() time."""
+
+    def __init__(self, momentum=0.9):
+        self.momentum = float(momentum)
+        self.absmax = None  # jnp scalar once observed
+
+    def observe(self, x):
+        a = x.jax() if isinstance(x, Tensor) else jnp.asarray(x)
+        m = jnp.max(jnp.abs(a)).astype(jnp.float32)
+        if self.absmax is None:
+            self.absmax = m
+        else:
+            self.absmax = (self.momentum * self.absmax
+                           + (1 - self.momentum) * m)
+        return x
+
+    @property
+    def scale(self):
+        """Device scalar scale (use scale_float at convert time)."""
+        return jnp.maximum(self.absmax, 1e-8) / 127.0
+
+    @property
+    def scale_float(self):
+        return max(float(self.absmax), 1e-8) / 127.0
+
+
+class FakeQuanterWithAbsMax(nn.Layer):
+    """QAT quanter: fake-quant with live abs-max (weights) or EMA
+    (activations)."""
+
+    def __init__(self, ema=False, momentum=0.9, channel_axis=None):
+        super().__init__()
+        self._ema = ema
+        self._observer = (MovingAverageAbsmaxObserver(momentum)
+                          if ema else None)
+        self._axis = channel_axis
+
+    def forward(self, x):
+        if self._ema:
+            if self.training:
+                self._observer.observe(x)
+            if self._observer.absmax is not None:
+                return fake_quant_dequant(x, scale=self._observer.scale)
+            return fake_quant_dequant(x)
+        return fake_quant_dequant(x, axis=self._axis)
+
+
+# --------------------------------------------------------------------------
+# config + QAT/PTQ drivers
+# --------------------------------------------------------------------------
+
+class QuantConfig:
+    """Which layer types get quantized, and how."""
+
+    def __init__(self, activation=True, weight=True,
+                 weight_channel_axis=1, momentum=0.9):
+        self.activation = activation
+        self.weight = weight
+        self.weight_channel_axis = weight_channel_axis
+        self.momentum = momentum
+        self._types = {nn.Linear}
+        self._overrides = {}
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        self._types.add(layer_type)
+        ov = self._overrides.setdefault(layer_type, {})
+        if activation is not None:
+            ov["activation"] = bool(activation)
+        if weight is not None:
+            ov["weight"] = bool(weight)
+        return self
+
+    def matches(self, layer):
+        return type(layer) in self._types
+
+    def activation_for(self, layer):
+        return self._overrides.get(type(layer), {}).get(
+            "activation", self.activation)
+
+    def weight_for(self, layer):
+        return self._overrides.get(type(layer), {}).get(
+            "weight", self.weight)
+
+
+class _QATLinear(nn.Layer):
+    """Linear with fake-quant on weight (per-out-channel) and input."""
+
+    def __init__(self, inner, cfg: QuantConfig):
+        super().__init__()
+        self.inner = inner
+        self.cfg = cfg
+        self._quant_weight = cfg.weight_for(inner)
+        self.act_quanter = (FakeQuanterWithAbsMax(
+            ema=True, momentum=cfg.momentum)
+            if cfg.activation_for(inner) else None)
+
+    def forward(self, x):
+        from ..ops.linalg import matmul
+        if self.act_quanter is not None:
+            x = self.act_quanter(x)
+        w = self.inner.weight
+        if self._quant_weight:
+            w = fake_quant_dequant(w, axis=self.cfg.weight_channel_axis)
+        y = matmul(x, w)
+        if self.inner.bias is not None:
+            y = y + self.inner.bias
+        return y
+
+
+def _swap_layers(model, predicate, factory):
+    """Replace matching sublayers in place; returns count."""
+    n = 0
+    for name, child in list(model.named_children()):
+        if predicate(child):
+            setattr(model, name, factory(child))
+            n += 1
+        else:
+            n += _swap_layers(child, predicate, factory)
+    return n
+
+
+class QAT:
+    """Quantization-aware training driver."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        if not inplace:
+            model = copy.deepcopy(model)
+        n = _swap_layers(model, self.config.matches,
+                         lambda l: _QATLinear(l, self.config))
+        if n == 0:
+            raise ValueError("QAT.quantize: no quantizable layers found")
+        return model
+
+    def convert(self, model, inplace=True):
+        """Fold fake-quant into real int8 QuantedLinear layers. Layers
+        whose config had weight=False keep float weights (they were
+        never trained against weight quantization)."""
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def factory(q):
+            if not q._quant_weight:
+                return q.inner
+            obs = (q.act_quanter._observer
+                   if q.act_quanter is not None else None)
+            scale = (obs.scale_float
+                     if obs is not None and obs.absmax is not None
+                     else None)
+            return QuantedLinear.from_linear(
+                q.inner, act_scale=scale,
+                channel_axis=self.config.weight_channel_axis)
+        _swap_layers(model, lambda l: isinstance(l, _QATLinear), factory)
+        return model
+
+
+class _PTQObserved(nn.Layer):
+    def __init__(self, inner, cfg):
+        super().__init__()
+        self.inner = inner
+        self.observer = MovingAverageAbsmaxObserver(cfg.momentum)
+
+    def forward(self, x):
+        self.observer.observe(x)
+        return self.inner(x)
+
+
+class PTQ:
+    """Post-training quantization: observe -> calibrate -> convert."""
+
+    def __init__(self, config: QuantConfig | None = None):
+        self.config = config or QuantConfig()
+
+    def quantize(self, model, inplace=True):
+        if not inplace:
+            model = copy.deepcopy(model)
+        n = _swap_layers(model, self.config.matches,
+                         lambda l: _PTQObserved(l, self.config))
+        if n == 0:
+            raise ValueError("PTQ.quantize: no quantizable layers found")
+        return model
+
+    def convert(self, model, inplace=True):
+        if not inplace:
+            model = copy.deepcopy(model)
+
+        def factory(o):
+            if not self.config.weight_for(o.inner):
+                return o.inner
+            use_act = self.config.activation_for(o.inner)
+            scale = (o.observer.scale_float
+                     if use_act and o.observer.absmax is not None
+                     else None)
+            return QuantedLinear.from_linear(
+                o.inner, act_scale=scale,
+                channel_axis=self.config.weight_channel_axis)
+        _swap_layers(model, lambda l: isinstance(l, _PTQObserved),
+                     factory)
+        return model
+
+
+# --------------------------------------------------------------------------
+# converted inference layer
+# --------------------------------------------------------------------------
+
+class QuantedLinear(nn.Layer):
+    """Int8-weight linear: w stored as int8 + per-out-channel scales;
+    the matmul runs int8 x int8 -> int32 on the MXU when the activation
+    scale is known, else int8-dequant x float."""
+
+    def __init__(self, w_int8, w_scale, bias=None, act_scale=None,
+                 channel_axis=1):
+        super().__init__()
+        self._w_int8 = jnp.asarray(w_int8, jnp.int8)
+        self._w_scale = jnp.asarray(w_scale, jnp.float32)
+        self._axis = int(channel_axis)
+        self._bias = None if bias is None else jnp.asarray(bias)
+        self._act_scale = (None if act_scale is None
+                           else float(act_scale))
+
+    @classmethod
+    def from_linear(cls, linear, act_scale=None, channel_axis=1):
+        w = linear.weight.jax()  # [in, out] (paddle layout)
+        scale = quant_abs_max_scale(w, axis=channel_axis)
+        bshape = [1, 1]
+        bshape[channel_axis] = scale.shape[0]
+        q = jnp.clip(jnp.round(w / scale.reshape(bshape)), -127,
+                     127).astype(jnp.int8)
+        b = None if linear.bias is None else linear.bias.jax()
+        return cls(q, scale, b, act_scale, channel_axis)
+
+    @property
+    def weight_int8(self):
+        return self._w_int8
+
+    def forward(self, x):
+        def fn(a):
+            # per-OUT-channel scales (axis 1 of [in, out]) factor out of
+            # the contraction, enabling the int8 MXU path; per-in-channel
+            # scales must be applied before summation -> dequant path
+            if self._act_scale is not None and self._axis == 1:
+                qa = jnp.clip(jnp.round(a / self._act_scale), -127,
+                              127).astype(jnp.int8)
+                acc = jax.lax.dot_general(
+                    qa, self._w_int8,
+                    (((a.ndim - 1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                y = (acc.astype(jnp.float32)
+                     * (self._act_scale * self._w_scale)).astype(a.dtype)
+            else:
+                bshape = [1, 1]
+                bshape[self._axis] = self._w_scale.shape[0]
+                w = (self._w_int8.astype(jnp.float32)
+                     * self._w_scale.reshape(bshape)).astype(a.dtype)
+                y = a @ w
+            if self._bias is not None:
+                y = y + self._bias.astype(y.dtype)
+            return y
+        if isinstance(x, Tensor):
+            return apply(fn, x, name="quanted_linear")
+        return fn(jnp.asarray(x))

@@ -1,0 +1,169 @@
+"""Quantization: fake-quant STE, QAT wrap/train/convert, PTQ calibrate,
+int8 QuantedLinear numerics (SURVEY.md §2.1 quant row).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import (
+    QAT, PTQ, QuantConfig, QuantedLinear, fake_quant_dequant,
+    quant_abs_max_scale)
+
+
+def test_fake_quant_dequant_error_bound():
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(64, 32).astype("float32"))
+    y = fake_quant_dequant(x)
+    scale = float(jnp.max(jnp.abs(x))) / 127.0
+    assert float(jnp.max(jnp.abs(y - x))) <= scale / 2 + 1e-7
+    # values land exactly on the int8 grid
+    q = np.asarray(y) / scale
+    np.testing.assert_allclose(q, np.round(q), atol=1e-4)
+
+
+def test_fake_quant_ste_gradient():
+    """Straight-through: d(fake_quant(x))/dx == 1."""
+    x = jnp.asarray(np.linspace(-2, 2, 11, dtype="float32"))
+    g = jax.grad(lambda a: jnp.sum(fake_quant_dequant(a)))(x)
+    np.testing.assert_allclose(np.asarray(g), np.ones(11), atol=1e-6)
+
+
+def test_per_channel_scale():
+    rng = np.random.RandomState(1)
+    w = rng.randn(8, 4).astype("float32")
+    w[:, 2] *= 100.0  # one hot channel must not wreck the others
+    s = quant_abs_max_scale(jnp.asarray(w), axis=1)
+    assert s.shape == (4,)
+    y = np.asarray(fake_quant_dequant(jnp.asarray(w), axis=1))
+    err = np.abs(y - w)
+    assert err[:, 0].max() <= float(s[0]) / 2 + 1e-7
+    assert err[:, 2].max() <= float(s[2]) / 2 + 1e-4
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(16, 32)
+        self.fc2 = nn.Linear(32, 4)
+
+    def forward(self, x):
+        return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
+
+
+def test_qat_train_and_convert():
+    paddle.seed(0)
+    rng = np.random.RandomState(0)
+    model = MLP()
+    x = paddle.to_tensor(rng.randn(16, 16).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 4, (16,)).astype("int64"))
+
+    qat = QAT(QuantConfig())
+    qat.quantize(model)
+    opt = paddle.optimizer.AdamW(3e-3, parameters=model.parameters())
+    losses = []
+    for _ in range(15):
+        loss = paddle.nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.item()))
+    assert losses[-1] < losses[0] * 0.8, losses
+
+    model.eval()
+    fq_out = model(x).numpy()
+    qat.convert(model)
+    assert isinstance(model.fc1, QuantedLinear)
+    assert model.fc1.weight_int8.dtype == jnp.int8
+    q_out = model(x).numpy()
+    # converted int8 path tracks the fake-quant training numerics
+    assert np.mean(np.abs(q_out - fq_out)) < 0.1 * np.abs(fq_out).mean()
+
+
+def test_ptq_calibrate_and_convert():
+    paddle.seed(1)
+    rng = np.random.RandomState(1)
+    model = MLP()
+    model.eval()
+    x = paddle.to_tensor(rng.randn(32, 16).astype("float32"))
+    ref = model(x).numpy()
+
+    ptq = PTQ(QuantConfig())
+    ptq.quantize(model)
+    for _ in range(4):  # calibration passes
+        model(x)
+    ptq.convert(model)
+    assert isinstance(model.fc2, QuantedLinear)
+    out = model(x).numpy()
+    rel = np.abs(out - ref).mean() / (np.abs(ref).mean() + 1e-8)
+    assert rel < 0.12, rel
+
+
+def test_quanted_linear_int8_matmul_path():
+    """With a known act scale the layer runs int8 x int8 -> int32."""
+    rng = np.random.RandomState(2)
+    w = rng.randn(16, 8).astype("float32") * 0.5
+    b = rng.randn(8).astype("float32") * 0.1
+    x = rng.randn(4, 16).astype("float32")
+
+    lin = nn.Linear(16, 8)
+    lin.weight.set_value(paddle.to_tensor(w))
+    lin.bias.set_value(paddle.to_tensor(b))
+    act_scale = float(np.abs(x).max()) / 127.0
+    q = QuantedLinear.from_linear(lin, act_scale=act_scale)
+    out = np.asarray(q(jnp.asarray(x)))
+    ref = x @ w + b
+    rel = np.abs(out - ref).mean() / np.abs(ref).mean()
+    assert rel < 0.05, rel
+
+
+def test_quanted_linear_channel_axis0():
+    """Per-in-channel scales use the dequant path and stay correct."""
+    rng = np.random.RandomState(3)
+    w = rng.randn(16, 8).astype("float32") * 0.5
+    x = rng.randn(4, 16).astype("float32")
+    lin = nn.Linear(16, 8)
+    lin.weight.set_value(paddle.to_tensor(w))
+    lin.bias.set_value(paddle.to_tensor(np.zeros(8, "float32")))
+    q = QuantedLinear.from_linear(lin, channel_axis=0)
+    out = np.asarray(q(jnp.asarray(x)))
+    ref = x @ w
+    rel = np.abs(out - ref).mean() / np.abs(ref).mean()
+    assert rel < 0.05, rel
+
+
+def test_quantize_inplace_false_preserves_original():
+    paddle.seed(5)
+    model = MLP()
+    q = QAT().quantize(model, inplace=False)
+    assert isinstance(model.fc1, nn.Linear)       # original untouched
+    assert not isinstance(model.fc1, QuantedLinear)
+    assert type(q.fc1).__name__ == "_QATLinear"
+
+
+def test_per_type_override_weight_false():
+    """weight=False layers train unquantized and convert keeps float."""
+    paddle.seed(6)
+    model = MLP()
+    cfg = QuantConfig()
+    cfg.add_type_config(nn.Linear, weight=False)
+    qat = QAT(cfg)
+    qat.quantize(model)
+    x = paddle.to_tensor(
+        np.random.RandomState(6).randn(4, 16).astype("float32"))
+    model(x)
+    qat.convert(model)
+    assert isinstance(model.fc1, nn.Linear)
+    assert not isinstance(model.fc1, QuantedLinear)
+
+
+def test_qat_no_quantizable_layers_raises():
+    class NoLinear(nn.Layer):
+        def forward(self, x):
+            return x
+    with pytest.raises(ValueError):
+        QAT().quantize(NoLinear())
