@@ -73,6 +73,9 @@ from . import version  # noqa: F401
 from . import models  # noqa: F401
 from . import inference  # noqa: F401
 from . import quantization  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
+from . import audio  # noqa: F401
 
 from .hapi.model import Model  # noqa: F401
 from .nn.layer.layers import Layer  # noqa: F401  (paddle.nn.Layer shortcut)

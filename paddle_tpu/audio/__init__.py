@@ -1,0 +1,9 @@
+"""``paddle.audio`` — audio feature extraction (python/paddle/audio/
+parity, UNVERIFIED): window functions, mel filterbanks, Spectrogram /
+MelSpectrogram / LogMelSpectrogram / MFCC feature layers built on
+``paddle.signal.stft`` (all-XLA, differentiable)."""
+
+from . import functional
+from . import features
+
+__all__ = ["functional", "features"]

@@ -1,0 +1,98 @@
+"""paddle.audio.features — Spectrogram / MelSpectrogram /
+LogMelSpectrogram / MFCC layers (python/paddle/audio/features/ parity,
+UNVERIFIED)."""
+
+from __future__ import annotations
+
+from .. import nn
+from ..framework.core import Tensor
+from ..ops.linalg import matmul
+from ..ops.manipulation import transpose as _transpose
+from ..signal import stft
+from . import functional as AF
+
+__all__ = ["Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]
+
+
+class Spectrogram(nn.Layer):
+    def __init__(self, n_fft=512, hop_length=None, win_length=None,
+                 window="hann", power=2.0, center=True,
+                 pad_mode="reflect", dtype="float32"):
+        super().__init__()
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        self.window = AF.get_window(window, self.win_length,
+                                    dtype=dtype)
+
+    def forward(self, x):
+        spec = stft(x, self.n_fft, self.hop_length, self.win_length,
+                    self.window, center=self.center,
+                    pad_mode=self.pad_mode)
+        mag = spec.abs()
+        if self.power != 1.0:
+            mag = mag.pow(self.power)
+        return mag
+
+
+class MelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", dtype="float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.n_mels = n_mels
+        self.fbank = AF.compute_fbank_matrix(
+            sr, n_fft, n_mels, f_min, f_max, htk, norm, dtype)
+
+    def forward(self, x):
+        spec = self._spectrogram(x)  # [..., freq, time]
+        return matmul(self.fbank, spec)  # [..., n_mels, time]
+
+
+class LogMelSpectrogram(nn.Layer):
+    def __init__(self, sr=22050, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._mel = MelSpectrogram(sr, n_fft, hop_length, win_length,
+                                   window, power, center, pad_mode,
+                                   n_mels, f_min, f_max, htk, norm,
+                                   dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        return AF.power_to_db(self._mel(x), self.ref_value, self.amin,
+                              self.top_db)
+
+
+class MFCC(nn.Layer):
+    def __init__(self, sr=22050, n_mfcc=40, n_fft=512, hop_length=None,
+                 win_length=None, window="hann", power=2.0, center=True,
+                 pad_mode="reflect", n_mels=64, f_min=50.0, f_max=None,
+                 htk=False, norm="slaney", ref_value=1.0, amin=1e-10,
+                 top_db=None, dtype="float32"):
+        super().__init__()
+        self._log_mel = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.dct = AF.create_dct(n_mfcc, n_mels, dtype=dtype)
+
+    def forward(self, x):
+        log_mel = self._log_mel(x)  # [..., n_mels, time]
+        # [..., time, n_mfcc] -> [..., n_mfcc, time]
+        perm = list(range(len(log_mel.shape)))
+        perm[-2], perm[-1] = perm[-1], perm[-2]
+        out = matmul(_transpose(log_mel, perm), self.dct)
+        return _transpose(out, perm)

@@ -1,0 +1,138 @@
+"""paddle.audio.functional — windows, mel scale, filterbanks, dct
+(python/paddle/audio/functional/ parity, UNVERIFIED)."""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+
+__all__ = ["get_window", "hz_to_mel", "mel_to_hz", "mel_frequencies",
+           "fft_frequencies", "compute_fbank_matrix", "power_to_db",
+           "create_dct"]
+
+
+def get_window(window, win_length, fftbins=True, dtype="float64"):
+    """'hann' | 'hamming' | 'blackman' | ('gaussian', std) | 'bohman' |
+    'triang' | 'rect'/'ones' — periodic (fftbins=True) or symmetric."""
+    if isinstance(window, tuple):
+        name, *args = window
+    else:
+        name, args = window, []
+    n = win_length + (1 if fftbins else 0)  # periodic = sym of n+1
+    k = np.arange(n)
+    if name in ("rect", "ones", "boxcar"):
+        w = np.ones(n)
+    elif name == "hann":
+        w = 0.5 - 0.5 * np.cos(2 * np.pi * k / (n - 1))
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * np.pi * k / (n - 1))
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * np.pi * k / (n - 1))
+             + 0.08 * np.cos(4 * np.pi * k / (n - 1)))
+    elif name == "bohman":
+        x = np.abs(np.linspace(-1, 1, n))
+        w = (1 - x) * np.cos(np.pi * x) + np.sin(np.pi * x) / np.pi
+    elif name == "triang":
+        w = 1 - np.abs((k - (n - 1) / 2) / ((n - 1) / 2))
+    elif name == "gaussian":
+        std = args[0] if args else 7.0
+        w = np.exp(-0.5 * ((k - (n - 1) / 2) / std) ** 2)
+    else:
+        raise ValueError(f"unknown window '{name}'")
+    if fftbins:
+        w = w[:-1]
+    return Tensor(jnp.asarray(w.astype(dtype)))
+
+
+def hz_to_mel(freq, htk=False):
+    scalar = not isinstance(freq, (np.ndarray, jnp.ndarray))
+    f = np.asarray(freq, np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        # Slaney formula (librosa/paddle default)
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep,
+                       mel)
+    return float(mel) if scalar else mel
+
+
+def mel_to_hz(mel, htk=False):
+    scalar = not isinstance(mel, (np.ndarray, jnp.ndarray))
+    m = np.asarray(mel, np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)),
+                      hz)
+    return float(hz) if scalar else hz
+
+
+def mel_frequencies(n_mels=64, f_min=0.0, f_max=11025.0, htk=False):
+    mels = np.linspace(hz_to_mel(f_min, htk), hz_to_mel(f_max, htk),
+                       n_mels)
+    return mel_to_hz(mels, htk)
+
+
+def fft_frequencies(sr, n_fft):
+    return np.linspace(0, sr / 2, 1 + n_fft // 2)
+
+
+def compute_fbank_matrix(sr, n_fft, n_mels=64, f_min=0.0, f_max=None,
+                         htk=False, norm="slaney", dtype="float32"):
+    """[n_mels, 1 + n_fft//2] triangular mel filterbank."""
+    f_max = f_max or sr / 2
+    fft_f = fft_frequencies(sr, n_fft)
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    lower = -ramps[:-2] / fdiff[:-1][:, None]
+    upper = ramps[2:] / fdiff[1:][:, None]
+    fb = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        fb *= enorm[:, None]
+    return Tensor(jnp.asarray(fb.astype(dtype)))
+
+
+def power_to_db(spect, ref_value=1.0, amin=1e-10, top_db=80.0):
+    def fn(x):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(x, amin))
+        log_spec = log_spec - 10.0 * math.log10(max(ref_value, amin))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+    if isinstance(spect, Tensor):
+        from ..framework.core import apply
+        return apply(fn, spect, name="power_to_db")
+    return fn(jnp.asarray(spect))
+
+
+def create_dct(n_mfcc, n_mels, norm="ortho", dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II basis (feature @ basis -> mfcc)."""
+    k = np.arange(n_mels)
+    basis = np.cos(np.pi / n_mels * (k[:, None] + 0.5)
+                   * np.arange(n_mfcc)[None, :])
+    if norm == "ortho":
+        basis[:, 0] *= 1.0 / math.sqrt(n_mels)
+        basis[:, 1:] *= math.sqrt(2.0 / n_mels)
+    else:
+        basis *= 2.0
+    return Tensor(jnp.asarray(basis.astype(dtype)))

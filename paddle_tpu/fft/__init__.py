@@ -1,0 +1,85 @@
+"""``paddle.fft`` — FFT family (python/paddle/fft.py parity, UNVERIFIED;
+SURVEY.md §2.2 tensor-ops row). Thin differentiable wrappers over
+jnp.fft — XLA lowers these to the TPU FFT HLO."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply
+from ..ops.common import as_tensor
+
+__all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+           "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+           "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift",
+           "ifftshift"]
+
+
+def _norm(norm):
+    # paddle uses 'backward' | 'forward' | 'ortho' like numpy
+    return norm if norm is not None else "backward"
+
+
+def _wrap1(jfn, opname):
+    def op(x, n=None, axis=-1, norm="backward", name=None):
+        return apply(lambda a: jfn(a, n=n, axis=axis, norm=_norm(norm)),
+                     as_tensor(x), name=opname)
+    op.__name__ = opname
+    return op
+
+
+def _wrapn(jfn, opname):
+    def op(x, s=None, axes=None, norm="backward", name=None):
+        kw = {"s": s, "axes": axes, "norm": _norm(norm)}
+        return apply(lambda a: jfn(a, **kw), as_tensor(x), name=opname)
+    op.__name__ = opname
+    return op
+
+
+fft = _wrap1(jnp.fft.fft, "fft")
+ifft = _wrap1(jnp.fft.ifft, "ifft")
+rfft = _wrap1(jnp.fft.rfft, "rfft")
+irfft = _wrap1(jnp.fft.irfft, "irfft")
+hfft = _wrap1(jnp.fft.hfft, "hfft")
+ihfft = _wrap1(jnp.fft.ihfft, "ihfft")
+
+fftn = _wrapn(jnp.fft.fftn, "fftn")
+ifftn = _wrapn(jnp.fft.ifftn, "ifftn")
+rfftn = _wrapn(jnp.fft.rfftn, "rfftn")
+irfftn = _wrapn(jnp.fft.irfftn, "irfftn")
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return fftn(x, s=s, axes=axes, norm=norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ifftn(x, s=s, axes=axes, norm=norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return rfftn(x, s=s, axes=axes, norm=norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d)
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d)
+    return Tensor(out.astype(dtype) if dtype else out)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.fftshift(a, axes=axes), as_tensor(x),
+                 name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply(lambda a: jnp.fft.ifftshift(a, axes=axes), as_tensor(x),
+                 name="ifftshift")

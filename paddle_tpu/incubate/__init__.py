@@ -4,6 +4,7 @@ MoE layers."""
 
 from . import nn
 from . import autograd
+from . import asp
 from .nn import functional
 
 __all__ = ["nn", "autograd", "functional", "softmax_mask_fuse",

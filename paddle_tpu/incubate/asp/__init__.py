@@ -1,0 +1,128 @@
+"""``paddle.incubate.asp`` — automatic structured (n:m) sparsity
+(reference: ``python/paddle/incubate/asp/`` pruning masks + mask-aware
+optimizer, UNVERIFIED; SURVEY.md §2.2 incubate row).
+
+TPU note: XLA has no sparse-tensor-core path, so n:m sparsity is a
+*model compression / regularization* feature here: masks are applied to
+weights, and the decorated optimizer re-applies them after every step so
+pruned weights stay zero through training. The masked matmuls still run
+dense on the MXU (the reference's 2:4 speedup is an Ampere
+sparse-tensor-core feature with no TPU analogue — documented, not
+emulated).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ... import nn
+
+__all__ = ["calculate_density", "decorate", "prune_model",
+           "reset_excluded_layers", "set_excluded_layers",
+           "check_sparsity", "create_mask", "clear_masks"]
+
+_excluded = set()
+_masks = {}  # id(param) -> (param, jnp mask)
+
+
+def set_excluded_layers(layers, main_program=None):
+    """Exclude layers (by full sublayer name) from pruning."""
+    global _excluded
+    _excluded |= set(layers)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+def create_mask(weight, func_name="mask_1d", n=2, m=4):
+    """n:m mask along the LAST axis: keep the n largest |w| in every
+    group of m. Returns a {0,1} array shaped like weight."""
+    w = np.asarray(weight.jax() if isinstance(weight, Tensor)
+                   else weight)
+    if w.shape[-1] % m:
+        return np.ones_like(w)  # non-divisible: leave dense
+    g = w.reshape(-1, m)
+    order = np.argsort(-np.abs(g), axis=1)
+    mask = np.zeros_like(g)
+    np.put_along_axis(mask, order[:, :n], 1.0, axis=1)
+    return mask.reshape(w.shape)
+
+
+def check_sparsity(weight, n=2, m=4, func_name="mask_1d"):
+    """True iff every group of m (last axis) has <= n nonzeros."""
+    w = np.asarray(weight.jax() if isinstance(weight, Tensor)
+                   else weight)
+    if w.shape[-1] % m:
+        return False
+    g = (w.reshape(-1, m) != 0).sum(axis=1)
+    return bool((g <= n).all())
+
+
+def calculate_density(weight):
+    w = np.asarray(weight.jax() if isinstance(weight, Tensor)
+                   else weight)
+    return float((w != 0).mean())
+
+
+def prune_model(model, n=2, m=4, mask_algo="mask_1d", with_mask=True):
+    """Apply n:m masks to every Linear weight (excluded layers skipped).
+    Returns {param_name: mask}."""
+    out = {}
+    for name, layer in model.named_sublayers():
+        if name in _excluded or not isinstance(layer, nn.Linear):
+            continue
+        p = layer.weight
+        mask = jnp.asarray(create_mask(p, mask_algo, n, m), p.jax().dtype)
+        p.set_value(Tensor(p.jax() * mask))
+        if with_mask:
+            _masks[id(p)] = (p, mask)
+        out[name + ".weight"] = mask
+    return out
+
+
+class ASPOptimizer:
+    """Optimizer wrapper: after each step, re-apply the pruning masks so
+    pruned weights stay exactly zero (the reference's mask-aware
+    optimizer semantics). Only masks belonging to THIS optimizer's
+    parameters are applied — masks registered by other models are not
+    touched."""
+
+    def __init__(self, optimizer):
+        self._inner = optimizer
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _owned_masks(self):
+        try:
+            owned = {id(p) for p in self._inner._parameter_list}
+        except AttributeError:
+            return list(_masks.values())
+        return [(p, m) for pid, (p, m) in _masks.items() if pid in owned]
+
+    def _reapply(self):
+        for p, mask in self._owned_masks():
+            p.set_value(Tensor(p.jax() * mask))
+
+    def step(self):
+        self._inner.step()
+        self._reapply()
+
+    def minimize(self, loss, *a, **k):
+        r = self._inner.minimize(loss, *a, **k)
+        self._reapply()
+        return r
+
+
+def decorate(optimizer):
+    return ASPOptimizer(optimizer)
+
+
+def clear_masks():
+    """Drop all registered masks (call between unrelated models; masks
+    hold strong references to their parameters)."""
+    _masks.clear()

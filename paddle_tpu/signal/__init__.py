@@ -1,0 +1,124 @@
+"""``paddle.signal`` — STFT / iSTFT (python/paddle/signal.py parity,
+UNVERIFIED). Framed via gather + jnp.fft so the whole transform is one
+XLA program (differentiable; oracle = overlap-add reconstruction)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply
+from ..ops.common import as_tensor
+
+__all__ = ["stft", "istft", "frame", "overlap_add"]
+
+
+def _window_array(window, n_fft, dtype):
+    if window is None:
+        return jnp.ones((n_fft,), dtype)
+    w = window.jax() if isinstance(window, Tensor) else jnp.asarray(window)
+    if w.shape[0] != n_fft:
+        raise ValueError(f"window length {w.shape[0]} != n_fft {n_fft}")
+    return w.astype(dtype)
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Split the last axis into overlapping frames:
+    [..., N] -> [..., frame_length, num_frames] (paddle layout)."""
+    if axis != -1:
+        raise NotImplementedError("frame: axis=-1 only")
+
+    def fn(a):
+        n = a.shape[-1]
+        num = 1 + (n - frame_length) // hop_length
+        starts = jnp.arange(num) * hop_length
+        idx = starts[:, None] + jnp.arange(frame_length)[None, :]
+        return jnp.moveaxis(a[..., idx], -2, -1)  # [..., flen, num]
+    return apply(fn, as_tensor(x), name="frame")
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: [..., frame_length, num_frames] -> [..., N]."""
+
+    def fn(a):
+        flen, num = a.shape[-2], a.shape[-1]
+        n = (num - 1) * hop_length + flen
+        out = jnp.zeros(a.shape[:-2] + (n,), a.dtype)
+        for i in range(num):  # static unroll; num is compile-time
+            out = out.at[..., i * hop_length:i * hop_length + flen].add(
+                a[..., i])
+        return out
+    return apply(fn, as_tensor(x), name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    """[B, N] (or [N]) -> complex [B, n_fft//2+1, num_frames]
+    (onesided) — paddle.signal.stft semantics."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+
+    def fn(a):
+        squeeze = a.ndim == 1
+        if squeeze:
+            a = a[None]
+        w = _window_array(window, wl, a.dtype)
+        if wl < n_fft:  # center-pad window to n_fft
+            lp = (n_fft - wl) // 2
+            w = jnp.pad(w, (lp, n_fft - wl - lp))
+        if center:
+            pad = n_fft // 2
+            a = jnp.pad(a, ((0, 0), (pad, pad)), mode=pad_mode)
+        n = a.shape[-1]
+        num = 1 + (n - n_fft) // hop
+        starts = jnp.arange(num) * hop
+        idx = starts[:, None] + jnp.arange(n_fft)[None, :]
+        frames = a[:, idx] * w[None, None, :]  # [B, num, n_fft]
+        spec = (jnp.fft.rfft(frames, axis=-1) if onesided
+                else jnp.fft.fft(frames, axis=-1))
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        out = jnp.swapaxes(spec, -2, -1)  # [B, freq, num]
+        return out[0] if squeeze else out
+    return apply(fn, as_tensor(x), name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with window-envelope normalization (NOLA)."""
+    hop = hop_length or n_fft // 4
+    wl = win_length or n_fft
+
+    def fn(a):
+        squeeze = a.ndim == 2
+        if squeeze:
+            a = a[None]
+        spec = jnp.swapaxes(a, -2, -1)  # [B, num, freq]
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        frames = (jnp.fft.irfft(spec, n=n_fft, axis=-1) if onesided
+                  else jnp.fft.ifft(spec, axis=-1).real)
+        w = _window_array(window, wl, frames.dtype)
+        if wl < n_fft:
+            lp = (n_fft - wl) // 2
+            w = jnp.pad(w, (lp, n_fft - wl - lp))
+        frames = frames * w[None, None, :]
+        num = frames.shape[1]
+        n = (num - 1) * hop + n_fft
+        out = jnp.zeros(frames.shape[:1] + (n,), frames.dtype)
+        env = jnp.zeros((n,), frames.dtype)
+        for i in range(num):
+            sl = slice(i * hop, i * hop + n_fft)
+            out = out.at[:, sl].add(frames[:, i])
+            env = env.at[sl].add(w * w)
+        out = out / jnp.maximum(env, 1e-11)[None, :]
+        if center:
+            pad = n_fft // 2
+            out = out[:, pad:n - pad]
+        if length is not None:
+            out = out[:, :length]
+        return out[0] if squeeze else out
+    return apply(fn, as_tensor(x), name="istft")

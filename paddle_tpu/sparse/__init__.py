@@ -1,86 +1,363 @@
-"""``paddle.sparse`` — COO/CSR tensors (python/paddle/sparse/ parity,
-UNVERIFIED). Backed by jax.experimental.sparse (BCOO) where it matters;
-round-1 scope: creation/conversion + matmul/add."""
+"""``paddle.sparse`` — COO/CSR tensors + sparse ops
+(python/paddle/sparse/ parity, UNVERIFIED; reference: SURVEY.md §2.2
+"paddle.sparse" row — COO/CSR tensors, sparse conv/attention ops; PHI
+sparse kernels in §2.1).
+
+TPU-native: COO is backed by ``jax.experimental.sparse.BCOO`` so sparse
+matmul lowers to XLA gather/scatter+dot (not a python loop), and values
+participate in the framework's autograd through ``apply`` — gradients
+flow to the value array, with the sparsity pattern static (the same
+contract the reference's sparse kernels have). CSR keeps the compressed
+layout for API parity and converts to COO for compute.
+"""
 
 from __future__ import annotations
 
-import jax.numpy as jnp
 import numpy as np
 
-from ..framework.core import Tensor
+import jax
+import jax.numpy as jnp
+from jax.experimental import sparse as jsparse
+
+from ..framework.core import Tensor, apply
 from ..ops.common import as_tensor
 
 __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
-           "matmul", "add"]
+           "SparseCsrTensor", "matmul", "masked_matmul", "mv", "add",
+           "multiply", "subtract", "divide", "is_same_shape", "relu",
+           "tanh", "sin", "abs", "sqrt", "pow", "neg", "coalesce",
+           "transpose", "nn"]
+
+
+def _jx(x):
+    if isinstance(x, Tensor):
+        return x.jax()
+    return jnp.asarray(x)
 
 
 class SparseCooTensor:
+    """COO tensor: ``indices`` [ndim, nnz], ``values`` [nnz]."""
+
     def __init__(self, indices, values, shape):
         self.indices_ = as_tensor(indices)
         self.values_ = as_tensor(values)
-        self.shape = list(shape)
+        self.shape = list(int(s) for s in shape)
 
+    # -- paddle API --------------------------------------------------------
     def indices(self):
         return self.indices_
 
     def values(self):
         return self.values_
 
-    def to_dense(self):
-        out = np.zeros(self.shape,
-                       dtype=np.asarray(self.values_._data).dtype)
-        idx = np.asarray(self.indices_._data)
-        vals = np.asarray(self.values_._data)
-        out[tuple(idx)] = vals
-        return Tensor(jnp.asarray(out))
+    @property
+    def nnz(self):
+        return int(self.values_.shape[0])
+
+    @property
+    def dtype(self):
+        return self.values_.dtype
 
     def is_sparse(self):
         return True
 
+    def is_sparse_coo(self):
+        return True
+
+    def is_sparse_csr(self):
+        return False
+
+    def _bcoo(self, values=None):
+        v = self.values_.jax() if values is None else values
+        idx = self.indices_.jax().T  # BCOO wants [nnz, ndim]
+        return jsparse.BCOO((v, idx), shape=tuple(self.shape))
+
+    def to_dense(self):
+        def fn(v):
+            idx = self.indices_.jax().T
+            return jsparse.BCOO(
+                (v, idx), shape=tuple(self.shape)).todense()
+        return apply(fn, self.values_, name="sparse_to_dense")
+
+    def to_sparse_csr(self):
+        """2-D only; rows must be sorted (coalesce() first if unsure)."""
+        if len(self.shape) != 2:
+            raise ValueError("to_sparse_csr: 2-D tensors only")
+        idx = np.asarray(self.indices_.jax())
+        order = np.lexsort((idx[1], idx[0]))
+        rows, cols = idx[0][order], idx[1][order]
+        vals = self.values_.jax()[jnp.asarray(order)]
+        crows = np.zeros(self.shape[0] + 1, np.int64)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows)
+        return SparseCsrTensor(crows, cols, Tensor(vals), self.shape)
+
+    def coalesce(self):
+        """Sort indices, sum duplicates (static nnz shrink)."""
+        idx = np.asarray(self.indices_.jax())
+        keys = np.ravel_multi_index(tuple(idx), tuple(self.shape))
+        uniq, inv = np.unique(keys, return_inverse=True)
+        new_idx = np.stack(np.unravel_index(uniq, tuple(self.shape)))
+
+        def fn(v):
+            return jax.ops.segment_sum(v, jnp.asarray(inv),
+                                       num_segments=len(uniq))
+        vals = apply(fn, self.values_, name="sparse_coalesce")
+        return SparseCooTensor(Tensor(jnp.asarray(new_idx)), vals,
+                               self.shape)
+
+    def transpose(self, perm):
+        idx = self.indices_.jax()[jnp.asarray(list(perm))]
+        shape = [self.shape[p] for p in perm]
+        return SparseCooTensor(Tensor(idx), self.values_, shape)
+
+    def _apply_values(self, fn, name):
+        return SparseCooTensor(self.indices_,
+                               apply(fn, self.values_, name=name),
+                               self.shape)
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
 
 class SparseCsrTensor:
+    """CSR tensor (2-D): crows [rows+1], cols [nnz], values [nnz]."""
+
     def __init__(self, crows, cols, values, shape):
         self.crows_ = as_tensor(crows)
         self.cols_ = as_tensor(cols)
         self.values_ = as_tensor(values)
-        self.shape = list(shape)
+        self.shape = list(int(s) for s in shape)
+
+    def crows(self):
+        return self.crows_
+
+    def cols(self):
+        return self.cols_
+
+    def values(self):
+        return self.values_
+
+    @property
+    def nnz(self):
+        return int(self.values_.shape[0])
+
+    @property
+    def dtype(self):
+        return self.values_.dtype
+
+    def is_sparse(self):
+        return True
+
+    def is_sparse_coo(self):
+        return False
+
+    def is_sparse_csr(self):
+        return True
+
+    def to_sparse_coo(self, sparse_dim=2):
+        crows = np.asarray(self.crows_.jax())
+        rows = np.repeat(np.arange(len(crows) - 1), np.diff(crows))
+        idx = np.stack([rows, np.asarray(self.cols_.jax())])
+        return SparseCooTensor(Tensor(jnp.asarray(idx)), self.values_,
+                               self.shape)
 
     def to_dense(self):
-        crows = np.asarray(self.crows_._data)
-        cols = np.asarray(self.cols_._data)
-        vals = np.asarray(self.values_._data)
-        out = np.zeros(self.shape, dtype=vals.dtype)
-        for r in range(len(crows) - 1):
-            for j in range(crows[r], crows[r + 1]):
-                out[r, cols[j]] = vals[j]
-        return Tensor(jnp.asarray(out))
+        return self.to_sparse_coo().to_dense()
 
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz}, "
+                f"dtype={self.dtype})")
+
+
+# --------------------------------------------------------------------------
+# creation
+# --------------------------------------------------------------------------
 
 def sparse_coo_tensor(indices, values, shape=None, dtype=None, place=None,
                       stop_gradient=True):
+    idx_t = as_tensor(indices)
+    val_t = as_tensor(values)
+    if dtype is not None:
+        val_t = val_t.astype(dtype)
     if shape is None:
-        idx = np.asarray(as_tensor(indices)._data)
+        idx = np.asarray(idx_t.jax())
         shape = (idx.max(axis=1) + 1).tolist()
-    return SparseCooTensor(indices, values, shape)
+    return SparseCooTensor(idx_t, val_t, shape)
 
 
 def sparse_csr_tensor(crows, cols, values, shape, dtype=None, place=None,
                       stop_gradient=True):
-    return SparseCsrTensor(crows, cols, values, shape)
+    val_t = as_tensor(values)
+    if dtype is not None:
+        val_t = val_t.astype(dtype)
+    return SparseCsrTensor(crows, cols, val_t, shape)
 
+
+def _as_coo(x):
+    if isinstance(x, SparseCsrTensor):
+        return x.to_sparse_coo()
+    return x
+
+
+def is_same_shape(x, y):
+    xs = x.shape if isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
+        else list(x.shape)
+    ys = y.shape if isinstance(y, (SparseCooTensor, SparseCsrTensor)) \
+        else list(y.shape)
+    return list(xs) == list(ys)
+
+
+# --------------------------------------------------------------------------
+# compute
+# --------------------------------------------------------------------------
 
 def matmul(x, y, name=None):
-    xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
-        else as_tensor(x)
-    yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) \
-        else as_tensor(y)
+    """sparse @ dense -> dense (XLA-lowered BCOO contraction);
+    dense @ dense passes through; sparse @ sparse densifies y."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        xc = _as_coo(x)
+        yd = y.to_dense() if isinstance(
+            y, (SparseCooTensor, SparseCsrTensor)) else as_tensor(y)
+
+        def fn(v, d):
+            return xc._bcoo(v) @ d
+        return apply(fn, xc.values_, yd, name="sparse_matmul")
     from ..ops.linalg import matmul as mm
-    return mm(xd, yd)
+    yd = y.to_dense() if isinstance(
+        y, (SparseCooTensor, SparseCsrTensor)) else y
+    return mm(as_tensor(x), yd)
+
+
+def mv(x, vec, name=None):
+    return matmul(x, vec, name=name)
+
+
+def masked_matmul(x, y, mask, name=None):
+    """(x @ y) sampled at mask's sparsity pattern (SDDMM) -> sparse with
+    mask's pattern. x, y dense; mask sparse."""
+    mc = _as_coo(mask)
+    xd, yd = as_tensor(x), as_tensor(y)
+
+    def fn(a, b):
+        rows = mc.indices_.jax()[0]
+        cols = mc.indices_.jax()[1]
+        # gather the needed rows/cols; one dot per nnz, vectorized
+        return jnp.einsum("nk,nk->n", a[rows], b[:, cols].T)
+    vals = apply(fn, xd, yd, name="masked_matmul")
+    return SparseCooTensor(mc.indices_, vals, mc.shape)
 
 
 def add(x, y, name=None):
-    xd = x.to_dense() if isinstance(x, (SparseCooTensor, SparseCsrTensor)) \
-        else as_tensor(x)
-    yd = y.to_dense() if isinstance(y, (SparseCooTensor, SparseCsrTensor)) \
-        else as_tensor(y)
-    return xd + yd
+    xs = isinstance(x, (SparseCooTensor, SparseCsrTensor))
+    ys = isinstance(y, (SparseCooTensor, SparseCsrTensor))
+    if xs and ys:
+        xc, yc = _as_coo(x), _as_coo(y)
+        if xc.shape != yc.shape:
+            raise ValueError("sparse add: shape mismatch")
+        idx = Tensor(jnp.concatenate(
+            [xc.indices_.jax(), yc.indices_.jax()], axis=1))
+
+        def fn(a, b):
+            return jnp.concatenate([a, b])
+        vals = apply(fn, xc.values_, yc.values_, name="sparse_add")
+        return SparseCooTensor(idx, vals, xc.shape).coalesce()
+    if xs or ys:
+        sp, de = (x, y) if xs else (y, x)
+        return _as_coo(sp).to_dense() + as_tensor(de)
+    return as_tensor(x) + as_tensor(y)
+
+
+def subtract(x, y, name=None):
+    yc = _as_coo(y) if isinstance(
+        y, (SparseCooTensor, SparseCsrTensor)) else y
+    if isinstance(yc, SparseCooTensor):
+        yn = yc._apply_values(lambda v: -v, "sparse_neg")
+        return add(x, yn, name=name)
+    return add(x, as_tensor(yc) * -1.0, name=name)
+
+
+def multiply(x, y, name=None):
+    """Elementwise; sparse * scalar/dense keeps the sparse pattern."""
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        xc = _as_coo(x)
+        if isinstance(y, (int, float)):
+            return xc._apply_values(lambda v: v * y, "sparse_scale")
+        yt = (y.to_dense() if isinstance(
+            y, (SparseCooTensor, SparseCsrTensor)) else as_tensor(y))
+        rows_cols = tuple(xc.indices_.jax())
+        vals = apply(lambda v, d: v * d[rows_cols],
+                     xc.values_, yt, name="sparse_mul")
+        return SparseCooTensor(xc.indices_, vals, xc.shape)
+    return as_tensor(x) * y
+
+
+def divide(x, y, name=None):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)) and \
+            isinstance(y, (int, float)):
+        return _as_coo(x)._apply_values(lambda v: v / y, "sparse_div")
+    return multiply(x, 1.0 / y, name=name)
+
+
+def coalesce(x, name=None):
+    return _as_coo(x).coalesce()
+
+
+def transpose(x, perm, name=None):
+    return _as_coo(x).transpose(perm)
+
+
+# unary ops on values (zero-preserving set, paddle.sparse convention)
+def _unary(jfn, pyname):
+    def op(x, name=None):
+        if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+            return _as_coo(x)._apply_values(jfn, f"sparse_{pyname}")
+        return apply(jfn, as_tensor(x), name=pyname)
+    op.__name__ = pyname
+    return op
+
+
+relu = _unary(lambda v: jnp.maximum(v, 0), "relu")
+tanh = _unary(jnp.tanh, "tanh")
+sin = _unary(jnp.sin, "sin")
+abs = _unary(jnp.abs, "abs")
+sqrt = _unary(jnp.sqrt, "sqrt")
+neg = _unary(lambda v: -v, "neg")
+
+
+def pow(x, factor, name=None):
+    if isinstance(x, (SparseCooTensor, SparseCsrTensor)):
+        return _as_coo(x)._apply_values(lambda v: v ** factor,
+                                        "sparse_pow")
+    return apply(lambda v: v ** factor, as_tensor(x), name="pow")
+
+
+class _SparseNN:
+    """``paddle.sparse.nn`` namespace (ReLU / Softmax on values)."""
+
+    class ReLU:
+        def __call__(self, x):
+            return relu(x)
+
+    class Softmax:
+        """Row-wise softmax over a 2-D sparse pattern."""
+
+        def __init__(self, axis=-1):
+            if axis != -1:
+                raise NotImplementedError("sparse softmax: axis=-1 only")
+
+        def __call__(self, x):
+            xc = _as_coo(x)
+            rows = xc.indices_.jax()[0]
+            n_rows = xc.shape[0]
+
+            def fn(v):
+                rmax = jax.ops.segment_max(v, rows, num_segments=n_rows)
+                e = jnp.exp(v - rmax[rows])
+                rsum = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+                return e / rsum[rows]
+            return xc._apply_values(fn, "sparse_softmax")
+
+
+nn = _SparseNN()

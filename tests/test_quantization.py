@@ -167,3 +167,39 @@ def test_qat_no_quantizable_layers_raises():
             return x
     with pytest.raises(ValueError):
         QAT().quantize(NoLinear())
+
+
+# --------------------------------------------------------------------------
+# ASP n:m structured sparsity (incubate.asp)
+# --------------------------------------------------------------------------
+
+def test_asp_prune_and_train_preserves_sparsity():
+    from paddle_tpu.incubate import asp
+    paddle.seed(7)
+    rng = np.random.RandomState(7)
+    model = MLP()
+    masks = asp.prune_model(model, n=2, m=4)
+    assert masks, "no layers pruned"
+    assert asp.check_sparsity(model.fc1.weight, 2, 4)
+    assert abs(asp.calculate_density(model.fc1.weight) - 0.5) < 0.1
+
+    x = paddle.to_tensor(rng.randn(16, 16).astype("float32"))
+    y = paddle.to_tensor(rng.randint(0, 4, (16,)).astype("int64"))
+    opt = asp.decorate(
+        paddle.optimizer.AdamW(3e-3, parameters=model.parameters()))
+    for _ in range(5):
+        loss = paddle.nn.functional.cross_entropy(model(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    # sparsity survives optimizer updates
+    assert asp.check_sparsity(model.fc1.weight, 2, 4)
+    assert asp.check_sparsity(model.fc2.weight, 2, 4)
+
+
+def test_asp_mask_keeps_largest():
+    from paddle_tpu.incubate import asp
+    w = np.array([[1.0, -5.0, 0.1, 3.0, 2.0, 0.2, -0.3, 4.0]], "float32")
+    mask = asp.create_mask(w, n=2, m=4)
+    np.testing.assert_array_equal(
+        mask, [[0., 1., 0., 1., 1., 0., 0., 1.]])

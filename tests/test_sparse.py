@@ -1,0 +1,158 @@
+"""paddle.sparse: COO/CSR round-trips, BCOO-backed matmul, elementwise
+ops, softmax, and gradient flow through values (SURVEY.md §2.2 sparse
+row; oracle = dense numpy equivalents).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _random_coo(rng, shape, nnz, dtype="float32"):
+    flat = rng.choice(shape[0] * shape[1], nnz, replace=False)
+    idx = np.stack(np.unravel_index(flat, shape)).astype(np.int64)
+    vals = rng.randn(nnz).astype(dtype)
+    dense = np.zeros(shape, dtype)
+    dense[tuple(idx)] = vals
+    return idx, vals, dense
+
+
+def test_coo_round_trip():
+    rng = np.random.RandomState(0)
+    idx, vals, dense = _random_coo(rng, (6, 8), 10)
+    t = sparse.sparse_coo_tensor(idx, vals, [6, 8])
+    assert t.nnz == 10 and t.is_sparse_coo()
+    np.testing.assert_allclose(t.to_dense().numpy(), dense)
+
+
+def test_csr_round_trip_and_coo_conversion():
+    rng = np.random.RandomState(1)
+    idx, vals, dense = _random_coo(rng, (5, 7), 9)
+    coo = sparse.sparse_coo_tensor(idx, vals, [5, 7])
+    csr = coo.to_sparse_csr()
+    assert csr.is_sparse_csr() and csr.nnz == 9
+    np.testing.assert_allclose(csr.to_dense().numpy(), dense)
+    back = csr.to_sparse_coo()
+    np.testing.assert_allclose(back.to_dense().numpy(), dense)
+
+
+def test_sparse_matmul_vs_dense():
+    rng = np.random.RandomState(2)
+    idx, vals, dense = _random_coo(rng, (6, 8), 12)
+    sp = sparse.sparse_coo_tensor(idx, vals, [6, 8])
+    d = rng.randn(8, 4).astype("float32")
+    out = sparse.matmul(sp, paddle.to_tensor(d))
+    np.testing.assert_allclose(out.numpy(), dense @ d,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sparse_matmul_gradient_flows_to_values():
+    """Grad w.r.t. sparse values through the framework tape."""
+    rng = np.random.RandomState(3)
+    idx, vals, dense = _random_coo(rng, (4, 5), 6)
+    sp = sparse.sparse_coo_tensor(idx, vals, [4, 5])
+    sp.values_.stop_gradient = False
+    d = paddle.to_tensor(rng.randn(5, 3).astype("float32"))
+    out = sparse.matmul(sp, d)
+    loss = paddle.sum(out * out)
+    loss.backward()
+    g = sp.values_.grad.numpy()
+    # oracle: d(sum((A@D)^2))/dA = 2 (A@D) D^T, sampled at the pattern
+    ga_dense = 2 * (dense @ np.asarray(d.numpy())) @ d.numpy().T
+    np.testing.assert_allclose(g, ga_dense[tuple(idx)],
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_add_coalesces_overlap():
+    a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, 2.0], [2, 2])
+    b = sparse.sparse_coo_tensor([[0, 1], [0, 0]], [10.0, 5.0], [2, 2])
+    c = sparse.add(a, b)
+    np.testing.assert_allclose(c.to_dense().numpy(),
+                               [[11.0, 0.0], [5.0, 2.0]])
+
+
+def test_subtract_multiply_divide():
+    rng = np.random.RandomState(4)
+    idx, vals, dense = _random_coo(rng, (4, 4), 5)
+    sp = sparse.sparse_coo_tensor(idx, vals, [4, 4])
+    np.testing.assert_allclose(
+        sparse.subtract(sp, sp).to_dense().numpy(), np.zeros((4, 4)),
+        atol=1e-7)
+    np.testing.assert_allclose(
+        sparse.multiply(sp, 3.0).to_dense().numpy(), dense * 3.0,
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        sparse.divide(sp, 2.0).to_dense().numpy(), dense / 2.0,
+        rtol=1e-6)
+    dmul = rng.randn(4, 4).astype("float32")
+    np.testing.assert_allclose(
+        sparse.multiply(sp, dmul).to_dense().numpy(), dense * dmul,
+        rtol=1e-5, atol=1e-6)
+
+
+def test_masked_matmul_sddmm():
+    rng = np.random.RandomState(5)
+    x = rng.randn(4, 6).astype("float32")
+    y = rng.randn(6, 5).astype("float32")
+    midx, _, mdense = _random_coo(rng, (4, 5), 7)
+    mask = sparse.sparse_coo_tensor(midx, np.ones(7, "float32"), [4, 5])
+    out = sparse.masked_matmul(paddle.to_tensor(x), paddle.to_tensor(y),
+                               mask)
+    full = x @ y
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               full * (mdense != 0),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_unary_ops_zero_preserving():
+    rng = np.random.RandomState(6)
+    idx, vals, dense = _random_coo(rng, (4, 4), 5)
+    sp = sparse.sparse_coo_tensor(idx, vals, [4, 4])
+    np.testing.assert_allclose(sparse.relu(sp).to_dense().numpy(),
+                               np.maximum(dense, 0), rtol=1e-6)
+    np.testing.assert_allclose(sparse.tanh(sp).to_dense().numpy(),
+                               np.tanh(dense), rtol=1e-6)
+    np.testing.assert_allclose(sparse.sin(sp).to_dense().numpy(),
+                               np.sin(dense), rtol=1e-6)
+    np.testing.assert_allclose(
+        sparse.pow(sp, 2).to_dense().numpy(), dense ** 2, rtol=1e-6)
+
+
+def test_sparse_softmax_rowwise():
+    rng = np.random.RandomState(7)
+    idx, vals, dense = _random_coo(rng, (4, 6), 8)
+    sp = sparse.sparse_coo_tensor(idx, vals, [4, 6])
+    out = sparse.nn.Softmax()(sp).to_dense().numpy()
+    # oracle: softmax over each row's nonzero entries only
+    for r in range(4):
+        cols = idx[1][idx[0] == r]
+        if len(cols) == 0:
+            continue
+        e = np.exp(dense[r, cols] - dense[r, cols].max())
+        np.testing.assert_allclose(out[r, cols], e / e.sum(),
+                                   rtol=1e-5)
+
+
+def test_transpose_and_coalesce():
+    idx = [[0, 0, 1], [1, 1, 2]]
+    sp = sparse.sparse_coo_tensor(idx, [1.0, 2.0, 3.0], [2, 3])
+    co = sp.coalesce()
+    assert co.nnz == 2  # duplicate (0,1) summed
+    np.testing.assert_allclose(co.to_dense().numpy(),
+                               [[0, 3, 0], [0, 0, 3]])
+    tr = sparse.transpose(co, [1, 0])
+    assert tr.shape == [3, 2]
+    np.testing.assert_allclose(tr.to_dense().numpy(),
+                               np.asarray([[0, 3, 0], [0, 0, 3]]).T)
+
+
+def test_is_same_shape():
+    a = sparse.sparse_coo_tensor([[0], [0]], [1.0], [2, 2])
+    b = sparse.sparse_coo_tensor([[1], [1]], [1.0], [2, 2])
+    assert sparse.is_same_shape(a, b)
+    assert not sparse.is_same_shape(a, paddle.zeros([3, 2]))
