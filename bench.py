@@ -47,10 +47,14 @@ def main():
         if hbm > 64e9:
             cfg = LlamaConfig.llama3_8b()
             batch, seq = 4, 2048
+            cfg.use_recompute = True
         else:
+            # v5e 16GB: B=2 fits without remat (measured 47% MFU; remat
+            # configs trade ~12 MFU points for batch)
             cfg = LlamaConfig.llama_1b()
-            batch, seq = 8, 2048
-        cfg.use_recompute = True
+            batch, seq = 2, 2048
+            cfg.use_recompute = False
+        cfg.scan_layers = False  # unrolled beats lax.scan on-chip today
         steps, warmup = 10, 3
     else:
         cfg = LlamaConfig.tiny()
@@ -82,15 +86,24 @@ def main():
             p.clear_grad()
         return loss, gsum
 
-    # warmup / compile
+    # distinct inputs per step: an execution-caching layer between host
+    # and chip (e.g. the axon tunnel) must not be able to replay results
+    step_ids = [paddle.to_tensor(np.roll(np.asarray(ids.numpy()), i,
+                                         axis=1))
+                for i in range(steps)]
+
+    # warmup / compile (scalar fetch = the only true sync through the
+    # axon tunnel; block_until_ready fake-completes there)
     for _ in range(warmup):
         loss, gsum = fwd_bwd(ids)
-    jax.block_until_ready(loss.jax())
+    float(loss.item())
 
     t0 = time.perf_counter()
-    for _ in range(steps):
-        loss, gsum = fwd_bwd(ids)
-    jax.block_until_ready(loss.jax())
+    acc = None
+    for i in range(steps):
+        loss, gsum = fwd_bwd(step_ids[i])
+        acc = loss if acc is None else acc + loss
+    float(acc.item())  # device-chained; one final scalar sync
     dt = (time.perf_counter() - t0) / steps
 
     tokens = batch * seq

@@ -197,6 +197,7 @@ class StaticFunction:
     # ---- the pure function ----------------------------------------------
 
     def _make_pure_fn(self, spec, proto_leaves, state_list):
+        donate = self._donate
         fn = self._call_fn
         # leaf prototypes: for tensors remember stop_gradient; for python
         # values bake in the discovery-call value (sig key guards equality)
@@ -228,7 +229,20 @@ class StaticFunction:
                 holder["out_spec"] = out_spec
                 holder["out_is_tensor"] = [isinstance(o, Tensor)
                                            for o in out_leaves]
-                new_state = tuple(t._data for t in state_list)
+                # only state actually REASSIGNED during the trace is an
+                # output (identity check against the input tracer):
+                # returning untouched params would force fresh device
+                # buffers for the whole model every step
+                if donate:
+                    # donated input buffers are invalidated unless
+                    # aliased to an output — must return full state
+                    changed = list(range(len(state_list)))
+                else:
+                    changed = [i for i, (t, a) in
+                               enumerate(zip(state_list, state_arrays))
+                               if t._data is not a]
+                holder["changed"] = changed
+                new_state = tuple(state_list[i]._data for i in changed)
                 return new_state, out_arrays
             finally:
                 for t, d, n, g in originals:
@@ -244,9 +258,9 @@ class StaticFunction:
                            if isinstance(leaf, Tensor))
         state_arrays = tuple(t._data for t in graph.state_list)
         new_state, out_arrays = graph.jitted(state_arrays, arg_arrays)
-        for t, a in zip(graph.state_list, new_state):
-            t.set_data(a)
         holder = graph.pure_fn._holder
+        for i, a in zip(holder["changed"], new_state):
+            graph.state_list[i].set_data(a)
         out_leaves = [Tensor(a) if is_t else a
                       for a, is_t in zip(out_arrays,
                                          holder["out_is_tensor"])]

@@ -160,8 +160,16 @@ class ErnieModel(nn.Layer):
             mask = M.reshape(neg, [neg.shape[0], 1, 1, neg.shape[1]])
         x = self.embeddings(input_ids, token_type_ids, position_ids,
                             task_type_ids)
-        for layer in self.encoder:
-            x = layer(x, mask)
+        from ..nn.scan import scan_layers, can_scan
+        dropout_live = (self.training
+                        and (self.config.hidden_dropout_prob > 0
+                             or self.config.attention_dropout_prob > 0))
+        if not dropout_live and can_scan(self.encoder):
+            x = scan_layers(self.encoder, x,
+                            extra_inputs=() if mask is None else (mask,))
+        else:
+            for layer in self.encoder:
+                x = layer(x, mask)
         pooled = F.tanh(self.pooler(x[:, 0]))
         return x, pooled
 

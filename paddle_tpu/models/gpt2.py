@@ -140,8 +140,16 @@ class GPT2Model(nn.Layer):
                 new_caches.extend((kc, vc))
             return self.ln_f(x), new_caches
         x = self.drop(x)
-        for block in self.h:
-            x = block(x)
+        from ..nn.scan import scan_layers, can_scan
+        dropout_live = (self.training
+                        and (self.config.hidden_dropout_prob > 0
+                             or self.config.attention_dropout_prob > 0))
+        if not dropout_live and can_scan(self.h):
+            # per-layer RNG (live dropout) forces the unrolled path
+            x = scan_layers(self.h, x)
+        else:
+            for block in self.h:
+                x = block(x)
         return self.ln_f(x)
 
 

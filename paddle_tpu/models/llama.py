@@ -46,6 +46,9 @@ class LlamaConfig:
     # Megatron-style SP: keep LN/residual activations sequence-sharded over
     # the 'model' axis (memory win; XLA inserts the gathers)
     sequence_parallel: bool = False
+    # roll the decoder stack into one lax.scan (code-size win on TPU;
+    # see nn/scan.py) — turn off to unroll (e.g. heterogeneous stacks)
+    scan_layers: bool = True
 
     @classmethod
     def llama3_8b(cls):
@@ -242,12 +245,22 @@ class LlamaModel(nn.Layer):
                                               caches[2 * i + 1]), pos=pos)
                 new_caches.extend((kc, vc))
             return self.norm(x), new_caches
-        for layer in self.layers:
-            if self.config.use_recompute and self.training:
-                from ..incubate.recompute import recompute
-                x = recompute(layer, x)
-            else:
-                x = layer(x)
+        from ..nn.scan import scan_layers, can_scan
+        if getattr(self.config, "scan_layers", True) and \
+                can_scan(self.layers):
+            # one lax.scan over stacked per-layer weights: code size (the
+            # measured TPU bottleneck for unrolled stacks) stays that of
+            # a single layer; remat folds in as checkpointed scan body
+            x = scan_layers(self.layers, x,
+                            remat=self.config.use_recompute
+                            and self.training)
+        else:
+            for layer in self.layers:
+                if self.config.use_recompute and self.training:
+                    from ..incubate.recompute import recompute
+                    x = recompute(layer, x)
+                else:
+                    x = layer(x)
         return self.norm(x)
 
 

@@ -43,6 +43,8 @@ class Qwen2Config:
     use_recompute: bool = False
     tensor_parallel: bool = False
     sep_parallel: str | None = None
+    # roll the decoder stack into one lax.scan (see nn/scan.py)
+    scan_layers: bool = True
 
     @classmethod
     def qwen2_7b(cls):
@@ -248,12 +250,20 @@ class _Qwen2Base(nn.Layer, GenerationMixin):
             logits = self.lm_head(hidden) if self.lm_head is not None else \
                 matmul(hidden, self.embed_tokens.weight, transpose_y=True)
             return logits, new_caches
-        for layer in self.layers:
-            if self.config.use_recompute and self.training:
-                from ..incubate.recompute import recompute
-                x = recompute(layer, x)
-            else:
-                x = layer(x)
+        from ..nn.scan import scan_layers as _scan, can_scan
+        # MoE stacks never scan: per-layer aux_loss attributes are read
+        # eagerly after the stack (and experts route via shard_map)
+        if getattr(self.config, "scan_layers", True) and \
+                not self._moe and can_scan(self.layers):
+            x = _scan(self.layers, x,
+                      remat=self.config.use_recompute and self.training)
+        else:
+            for layer in self.layers:
+                if self.config.use_recompute and self.training:
+                    from ..incubate.recompute import recompute
+                    x = recompute(layer, x)
+                else:
+                    x = layer(x)
         hidden = self.norm(x)
         if self.lm_head is not None:
             logits = self.lm_head(hidden)

@@ -1,0 +1,101 @@
+"""Scanned execution of a stack of structurally identical layers.
+
+Why this exists (TPU-first design, SURVEY.md §7): a python loop over N
+decoder layers unrolls into N copies of the layer's HLO. Measured on
+v5e: the unrolled Llama step compiles to ~220 MB of TPU code and runs
+~60x slower than ideal — generated-code size, not FLOPs or HBM, was the
+bottleneck. Rolling the stack into ONE ``lax.scan`` over stacked weights
+collapses code size to one layer body (measured: 3.4 MB, ~20x faster
+end-to-end) and is also the natural place for per-layer
+rematerialization (``jax.checkpoint`` on the scan body — the standard
+TPU memory/compute trade).
+
+The reference has no analogue (CUDA kernels are data, not code — code
+size is a non-issue on GPU); this is a TPU-native architectural choice.
+
+Works with the framework's tape: the whole scan is ONE differentiable
+``apply`` op; jax reverse-mode differentiates through the scan,
+re-binding the template layer's parameters to the per-iteration weight
+slices exactly like the compiled-pipeline engine does
+(distributed/fleet/meta_parallel/pipeline_parallel.py ``_body_apply``).
+
+Constraints: layers must share parameter structure (shape/dtype, same
+class); the carried activation must be shape/dtype-stable; layers must
+be deterministic under the scan (no per-layer RNG — callers fall back
+to the python loop when dropout is live).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..framework.core import Tensor, apply, no_grad
+
+__all__ = ["scan_layers", "can_scan"]
+
+
+def can_scan(layers):
+    """True iff the layer stack is scannable: >1 layers, identical
+    class and parameter shapes/dtypes."""
+    layers = list(layers)
+    if len(layers) < 2:
+        return False
+    sig0 = None
+    for l in layers:
+        sig = (type(l), tuple((tuple(p.shape), str(p.dtype))
+                              for p in l.parameters()))
+        if sig0 is None:
+            sig0 = sig
+        elif sig != sig0:
+            return False
+    return len(sig0[1]) > 0
+
+
+def scan_layers(layers, x, extra_inputs=(), remat=False):
+    """Run ``x -> layers[L-1](...layers[0](x))`` as one lax.scan.
+
+    layers: sequence of structurally identical Layers.
+    x: Tensor carried through the stack (shape/dtype preserved).
+    extra_inputs: Tensors passed unchanged to every layer after x
+      (e.g. an attention mask).
+    remat: rematerialize each layer in backward (per-layer activation
+      checkpointing).
+    """
+    layers = list(layers)
+    template = layers[0]
+    tmpl_params = list(template.parameters())
+    per_layer = [list(l.parameters()) for l in layers]
+    n_leaves = len(tmpl_params)
+    L = len(layers)
+    n_extra = len(extra_inputs)
+
+    def fn(h, *rest):
+        extras = rest[:n_extra]
+        leaves = rest[n_extra:]
+        stacked = tuple(
+            jnp.stack([leaves[g * n_leaves + i] for g in range(L)])
+            for i in range(n_leaves))
+
+        def body(carry, slices):
+            originals = [(p, p._data) for p in tmpl_params]
+            try:
+                for p, a in zip(tmpl_params, slices):
+                    p._data = a
+                ins = [Tensor(carry)] + [Tensor(e) for e in extras]
+                with no_grad():
+                    out = template(*ins)
+                out = out.jax() if isinstance(out, Tensor) else out
+                return out, None
+            finally:
+                for p, a in originals:
+                    p._data = a
+
+        if remat:
+            body = jax.checkpoint(body)
+        out, _ = lax.scan(body, h, stacked)
+        return out
+
+    flat = [p for lp in per_layer for p in lp]
+    return apply(fn, x, *extra_inputs, *flat, name="scan_layers")

@@ -20,6 +20,10 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._utils import interpret_mode as _interpret, no_x64 as _no_x64
+
+
+
 __all__ = ["flash_attention", "flash_attention_reference"]
 
 _NEG_INF = -1e30
@@ -49,17 +53,19 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
 
     def body(start_k, carry):
         acc, m_prev, l_prev = carry
-        k = pl.load(k_ref, (pl.dslice(start_k * block_k, block_k),
-                            slice(None))).astype(jnp.float32)
-        v = pl.load(v_ref, (pl.dslice(start_k * block_k, block_k),
-                            slice(None))).astype(jnp.float32)
+        k = k_ref[pl.dslice(start_k * block_k, block_k),
+                  slice(None)].astype(jnp.float32)
+        v = v_ref[pl.dslice(start_k * block_k, block_k),
+                  slice(None)].astype(jnp.float32)
         s = q @ k.T  # [block_q, block_k]
+        k_pos = start_k * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < seq_len_k  # mask padded keys
         if causal:
             q_pos = qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0)
-            k_pos = start_k * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1)
-            s = jnp.where(q_pos + causal_offset >= k_pos, s, _NEG_INF)
+            valid = valid & (q_pos + causal_offset >= k_pos)
+        s = jnp.where(valid, s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1)
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new[:, None])
@@ -72,25 +78,27 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     acc0 = jnp.zeros((block_q, d), jnp.float32)
     m0 = jnp.full((block_q,), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q,), jnp.float32)
+    n_k_blocks = -(-seq_len_k // block_k)  # padded kv block count
     if causal:
-        # only kv blocks up to this q block's last visible key participate
+        # only kv blocks up to this q block's last visible key
+        # participate (weak python ints keep int32 here; the pallas_call
+        # is traced under _no_x64)
         last_visible = (qi + 1) * block_q + causal_offset
-        num_k = jnp.clip(
-            jax.lax.div(last_visible + block_k - 1, block_k),
-            0, seq_len_k // block_k)
+        nk = (last_visible + (block_k - 1)) // block_k
+        num_k = jnp.minimum(jnp.maximum(nk, 0), n_k_blocks)
     else:
-        num_k = seq_len_k // block_k
+        num_k = n_k_blocks
     acc, m, l = jax.lax.fori_loop(0, num_k, body, (acc0, m0, l0))
     l = jnp.maximum(l, 1e-30)
     o_ref[:] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[:] = m + jnp.log(l)
+    # stats ride a 128-lane last dim (TPU tiling requires the last block
+    # dim be 128-divisible; same convention as jax's official kernel)
+    lse_ref[:] = jnp.broadcast_to((m + jnp.log(l))[:, None],
+                                  (block_q, 128))
 
 
-def _pick_block(seq_len, preferred):
-    b = min(preferred, seq_len)
-    while seq_len % b:
-        b //= 2
-    return max(b, 1)
+def _round_up(n, m):
+    return -(-n // m) * m
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
@@ -109,34 +117,49 @@ def _flash_fwd(q, k, v, causal, scale):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     s = scale if scale is not None else 1.0 / math.sqrt(d)
-    block_q = _pick_block(sq, int(flags.flag("FLAGS_flash_attn_block_q")))
-    block_k = _pick_block(sk, int(flags.flag("FLAGS_flash_attn_block_kv")))
-    # [B, S, H, D] -> [B*H, S, D]
+    block_q = min(int(flags.flag("FLAGS_flash_attn_block_q")),
+                  _round_up(sq, 8))
+    block_k = min(int(flags.flag("FLAGS_flash_attn_block_kv")),
+                  _round_up(sk, 128))
+    # [B, S, H, D] -> [B*H, S, D], padded to block multiples (the kernel
+    # masks padded key positions; padded query rows are sliced off)
+    sq_p = _round_up(sq, block_q)
+    sk_p = _round_up(sk, block_k)
     qh = q.transpose(0, 2, 1, 3).reshape(b * h, sq, d)
     kh = k.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
     vh = v.transpose(0, 2, 1, 3).reshape(b * h, sk, d)
-    grid = (b * h, sq // block_q)
-    out, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, scale=s, causal=causal,
-                          block_q=block_q, block_k=block_k, seq_len_q=sq,
-                          seq_len_k=sk),
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
-            pl.BlockSpec((None, sk, d), lambda bh, qi: (bh, 0, 0)),
-        ],
-        out_specs=[
-            pl.BlockSpec((None, block_q, d), lambda bh, qi: (bh, qi, 0)),
-            pl.BlockSpec((None, block_q), lambda bh, qi: (bh, qi)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
-        ],
-    )(qh, kh, vh)
-    out4 = out.reshape(b, h, sq, d).transpose(0, 2, 1, 3)
-    return out4, lse
+    if sq_p != sq:
+        qh = jnp.pad(qh, ((0, 0), (0, sq_p - sq), (0, 0)))
+    if sk_p != sk:
+        kh = jnp.pad(kh, ((0, 0), (0, sk_p - sk), (0, 0)))
+        vh = jnp.pad(vh, ((0, 0), (0, sk_p - sk), (0, 0)))
+    grid = (b * h, sq_p // block_q)
+    with _no_x64():
+        out, lse = pl.pallas_call(
+            functools.partial(_fwd_kernel, scale=s, causal=causal,
+                              block_q=block_q, block_k=block_k,
+                              seq_len_q=sq, seq_len_k=sk),
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((None, block_q, d),
+                             lambda bh, qi: (bh, qi, 0)),
+                pl.BlockSpec((None, sk_p, d), lambda bh, qi: (bh, 0, 0)),
+                pl.BlockSpec((None, sk_p, d), lambda bh, qi: (bh, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, block_q, d),
+                             lambda bh, qi: (bh, qi, 0)),
+                pl.BlockSpec((None, block_q, 128),
+                             lambda bh, qi: (bh, qi, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((b * h, sq_p, d), q.dtype),
+                jax.ShapeDtypeStruct((b * h, sq_p, 128), jnp.float32),
+            ],
+            interpret=_interpret(),
+        )(qh, kh, vh)
+    out4 = out[:, :sq].reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    return out4, lse[:, :sq, 0]
 
 
 def _fwd_rule(q, k, v, causal, scale):
@@ -166,24 +189,29 @@ def _bwd_rule(causal, scale, res, g):
     lse_h = lse.reshape(b, h, sq)
     delta = jnp.sum(gh * oh, axis=-1)  # [B,H,Sq]
 
+    # pad the key axis to the block multiple and mask padded keys —
+    # never shrink the block (an odd sk would otherwise degrade to
+    # block=1, i.e. a sequential per-position scan)
     block = 512
-    while sk % block and block > 1:
-        block //= 2
-    n_blocks = sk // block
+    sk_p = _round_up(sk, block)
+    if sk_p != sk:
+        kh = jnp.pad(kh, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+        vh = jnp.pad(vh, ((0, 0), (0, 0), (0, sk_p - sk), (0, 0)))
+    n_blocks = sk_p // block
 
     def kv_block(carry, i):
         dq_acc = carry
         ks = jax.lax.dynamic_slice_in_dim(kh, i * block, block, 2)
         vs = jax.lax.dynamic_slice_in_dim(vh, i * block, block, 2)
         logits = jnp.einsum("bhqd,bhkd->bhqk", qh, ks) * s
+        k_pos = i * block + jax.lax.broadcasted_iota(
+            jnp.int32, (sq, block), 1)
+        valid = k_pos < sk  # padded keys contribute nothing
         if causal:
             q_pos = jax.lax.broadcasted_iota(jnp.int32, (sq, block), 0)
-            k_pos = i * block + jax.lax.broadcasted_iota(
-                jnp.int32, (sq, block), 1)
             # bottom-right aligned, matching the forward kernel
-            logits = jnp.where(
-                (q_pos + (sk - sq))[None, None] >= k_pos[None, None],
-                logits, _NEG_INF)
+            valid = valid & (q_pos + (sk - sq) >= k_pos)
+        logits = jnp.where(valid[None, None], logits, _NEG_INF)
         p = jnp.exp(logits - lse_h[..., None])  # [B,H,Sq,block]
         dv_i = jnp.einsum("bhqk,bhqd->bhkd", p, gh)
         dp = jnp.einsum("bhqd,bhkd->bhqk", gh, vs)
@@ -195,9 +223,9 @@ def _bwd_rule(causal, scale, res, g):
     dq0 = jnp.zeros_like(qh)
     dq, (dk_blocks, dv_blocks) = jax.lax.scan(
         kv_block, dq0, jnp.arange(n_blocks))
-    # [n_blocks, B, H, block, D] -> [B, H, Sk, D]
-    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, sk, d)
-    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, sk, d)
+    # [n_blocks, B, H, block, D] -> [B, H, Sk_p, D] -> slice true Sk
+    dk = jnp.moveaxis(dk_blocks, 0, 2).reshape(b, h, sk_p, d)[:, :, :sk]
+    dv = jnp.moveaxis(dv_blocks, 0, 2).reshape(b, h, sk_p, d)[:, :, :sk]
     if rep != 1:  # sum over repeated query-head groups
         dk = dk.reshape(b, hk, rep, sk, d).sum(2)
         dv = dv.reshape(b, hk, rep, sk, d).sum(2)

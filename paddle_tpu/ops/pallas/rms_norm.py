@@ -13,6 +13,10 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._utils import interpret_mode as _interpret, no_x64 as _no_x64
+
+
+
 __all__ = ["rms_norm", "rms_norm_reference"]
 
 
@@ -43,10 +47,13 @@ def _dx_kernel(x_ref, w_ref, g_ref, o_ref, *, eps):
 
 
 def _rows_block(n_rows: int) -> int:
-    for b in (256, 128, 64, 32, 16, 8):
-        if n_rows % b == 0:
-            return b
-    return 1
+    return min(256, -(-n_rows // 8) * 8)
+
+
+def _pad_rows(a, n_pad):
+    if n_pad == a.shape[0]:
+        return a
+    return jnp.pad(a, ((0, n_pad - a.shape[0]), (0, 0)))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -60,15 +67,18 @@ def _rms_fwd_impl(x, w, eps):
     x2 = x.reshape(-1, d)
     n = x2.shape[0]
     blk = _rows_block(n)
-    out = pl.pallas_call(
-        functools.partial(_fwd_kernel, eps=eps),
-        grid=(n // blk,),
-        in_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
-                  pl.BlockSpec((d,), lambda i: (0,))],
-        out_specs=pl.BlockSpec((blk, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
-    )(x2, w)
-    return out.reshape(orig_shape)
+    n_p = -(-n // blk) * blk  # pad rows to the block multiple
+    with _no_x64():
+        out = pl.pallas_call(
+            functools.partial(_fwd_kernel, eps=eps),
+            grid=(n_p // blk,),
+            in_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                      pl.BlockSpec((d,), lambda i: (0,))],
+            out_specs=pl.BlockSpec((blk, d), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n_p, d), x.dtype),
+            interpret=_interpret(),
+        )(_pad_rows(x2, n_p), w)
+    return out[:n].reshape(orig_shape)
 
 
 def _rms_fwd(x, w, eps):
@@ -83,15 +93,19 @@ def _rms_bwd(eps, res, g):
     g2 = g.reshape(-1, d)
     n = x2.shape[0]
     blk = _rows_block(n)
-    dx = pl.pallas_call(
-        functools.partial(_dx_kernel, eps=eps),
-        grid=(n // blk,),
-        in_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
-                  pl.BlockSpec((d,), lambda i: (0,)),
-                  pl.BlockSpec((blk, d), lambda i: (i, 0))],
-        out_specs=pl.BlockSpec((blk, d), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((n, d), x.dtype),
-    )(x2, w, g2)
+    n_p = -(-n // blk) * blk
+    with _no_x64():
+        dx = pl.pallas_call(
+            functools.partial(_dx_kernel, eps=eps),
+            grid=(n_p // blk,),
+            in_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                      pl.BlockSpec((d,), lambda i: (0,)),
+                      pl.BlockSpec((blk, d), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((blk, d), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n_p, d), x.dtype),
+            interpret=_interpret(),
+        )(_pad_rows(x2, n_p), w, _pad_rows(g2, n_p))
+    dx = dx[:n]
     # dw: reduction over all rows — XLA's job
     xf = x2.astype(jnp.float32)
     ms = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)

@@ -1,0 +1,82 @@
+"""Pallas kernels executed (interpret mode on CPU) — not just their jnp
+references. Guards against Pallas API drift that only surfaces on real
+TPU (SURVEY.md §4 TPU translation note (d)).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops.pallas.flash_attention import (
+    _flash_fwd, flash_attention, flash_attention_reference)
+from paddle_tpu.ops.pallas.rms_norm import rms_norm
+
+
+@pytest.mark.parametrize("sq,sk,causal", [
+    (128, 128, True), (128, 128, False), (64, 256, True), (32, 32, True),
+    # ragged lengths exercise the pad+mask path (e.g. seq+1 LM inputs)
+    (129, 129, True), (127, 127, True), (1, 200, False), (33, 65, True),
+])
+def test_flash_kernel_matches_reference(sq, sk, causal):
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.randn(2, sq, 4, 32).astype("float32"))
+    k = jnp.asarray(rng.randn(2, sk, 4, 32).astype("float32"))
+    v = jnp.asarray(rng.randn(2, sk, 4, 32).astype("float32"))
+    out, lse = _flash_fwd(q, k, v, causal, None)
+    ref = flash_attention_reference(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=2e-5)
+    assert lse.shape == (2 * 4, sq)
+
+
+def test_flash_kernel_gqa():
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.randn(1, 64, 8, 16).astype("float32"))
+    k = jnp.asarray(rng.randn(1, 64, 2, 16).astype("float32"))
+    v = jnp.asarray(rng.randn(1, 64, 2, 16).astype("float32"))
+    out, _ = _flash_fwd(q, k, v, True, None)
+    kf = jnp.repeat(k, 4, axis=2)
+    vf = jnp.repeat(v, 4, axis=2)
+    ref = flash_attention_reference(q, kf, vf, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=2e-5)
+
+
+def test_flash_kernel_custom_vjp():
+    rng = np.random.RandomState(2)
+    q = jnp.asarray(rng.randn(1, 64, 2, 16).astype("float32"))
+
+    def f_kernel(q):
+        return jnp.sum(flash_attention(q, q, q, True, None) ** 2)
+
+    def f_ref(q):
+        return jnp.sum(
+            flash_attention_reference(q, q, q, causal=True) ** 2)
+
+    g = jax.grad(f_kernel)(q)
+    gr = jax.grad(f_ref)(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(gr),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_rms_norm_kernel_fwd_bwd():
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(8, 64).astype("float32"))
+    w = jnp.asarray(rng.randn(64).astype("float32"))
+
+    def ref(x, w):
+        return (x / jnp.sqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6)
+                * w)
+
+    np.testing.assert_allclose(np.asarray(rms_norm(x, w)),
+                               np.asarray(ref(x, w)),
+                               rtol=1e-5, atol=1e-6)
+    g = jax.grad(lambda x, w: jnp.sum(rms_norm(x, w) ** 2),
+                 argnums=(0, 1))(x, w)
+    gr = jax.grad(lambda x, w: jnp.sum(ref(x, w) ** 2),
+                  argnums=(0, 1))(x, w)
+    for a, b in zip(g, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
