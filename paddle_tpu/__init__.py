@@ -53,6 +53,7 @@ from .ops import *  # noqa: F401,F403  (creation/math/manip/linalg/... ops)
 from .ops import creation as _creation
 from .autograd import grad, backward  # noqa: F401
 from .framework.core import Parameter  # noqa: F401
+from .nn.param_attr import ParamAttr  # noqa: F401
 
 from . import nn  # noqa: F401
 from . import optimizer  # noqa: F401
@@ -76,6 +77,29 @@ from . import quantization  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import audio  # noqa: F401
+# the ops wildcard above bound ``linalg`` to ops.linalg; rebind to the
+# full paddle.linalg namespace module
+import importlib as _importlib
+linalg = _importlib.import_module(".linalg", __name__)
+from . import utils  # noqa: F401
+from . import regularizer  # noqa: F401
+from . import hub  # noqa: F401
+from . import sysconfig  # noqa: F401
+from . import onnx  # noqa: F401
+from .hapi import callbacks  # noqa: F401
+from .regularizer import L1Decay, L2Decay  # noqa: F401
+
+
+def iinfo(dtype):
+    """paddle.iinfo — integer dtype machine limits."""
+    import numpy as _np
+    return _np.iinfo(_np.dtype(str(_jnp.dtype(dtype))))
+
+
+def finfo(dtype):
+    """paddle.finfo — floating dtype machine limits (ml_dtypes-aware, so
+    bfloat16/float8 work)."""
+    return _jnp.finfo(dtype)
 
 from .hapi.model import Model  # noqa: F401
 from .nn.layer.layers import Layer  # noqa: F401  (paddle.nn.Layer shortcut)

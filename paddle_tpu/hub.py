@@ -1,0 +1,70 @@
+"""paddle.hub — load models/entrypoints from a hubconf.py.
+
+Upstream (``python/paddle/hapi/hub.py``, UNVERIFIED) supports
+github/gitee/local sources. This environment has zero egress, so only the
+``source='local'`` path is functional; remote sources raise with a clear
+message. API shape (list/help/load) is preserved.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+MODULE_HUBCONF = "hubconf.py"
+_hubconf_cache: dict = {}
+
+
+def _load_local(repo_dir, force_reload=False):
+    repo_dir = os.path.abspath(repo_dir)
+    if not force_reload and repo_dir in _hubconf_cache:
+        return _hubconf_cache[repo_dir]
+    hub_path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(hub_path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    spec = importlib.util.spec_from_file_location("hubconf", hub_path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.path.insert(0, repo_dir)
+    try:
+        spec.loader.exec_module(mod)
+    finally:
+        sys.path.remove(repo_dir)
+    _hubconf_cache[repo_dir] = mod
+    return mod
+
+
+def _entrypoint(mod, model, repo_dir):
+    if not hasattr(mod, model):
+        raise RuntimeError(f"entrypoint {model!r} not found in {repo_dir}")
+    return getattr(mod, model)
+
+def _check_source(source):
+    if source != "local":
+        raise RuntimeError(
+            f"paddle.hub source={source!r} needs network access, which this "
+            "environment does not have; clone the repo and use "
+            "source='local'.")
+
+
+def list(repo_dir, source="local", force_reload=False):  # noqa: A001
+    _check_source(source)
+    mod = _load_local(repo_dir, force_reload)
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="local", force_reload=False):  # noqa: A001
+    _check_source(source)
+    mod = _load_local(repo_dir, force_reload)
+    return _entrypoint(mod, model, repo_dir).__doc__
+
+
+def load(repo_dir, model, *args, source="local", force_reload=False,
+         **kwargs):
+    _check_source(source)
+    mod = _load_local(repo_dir, force_reload)
+    return _entrypoint(mod, model, repo_dir)(*args, **kwargs)
+
+
+__all__ = ["list", "help", "load"]

@@ -1,0 +1,29 @@
+"""paddle.onnx — model export.
+
+Upstream (``python/paddle/onnx/export.py``, UNVERIFIED) delegates to the
+external ``paddle2onnx`` package. The TPU-native serialized form is
+StableHLO (portable across XLA runtimes), produced by
+``paddle_tpu.jit.save`` / ``paddle_tpu.inference``; ONNX proper would need
+``onnx``/``paddle2onnx`` wheels, which are not in this image. ``export``
+therefore emits StableHLO next to the requested path and raises only if the
+caller demands a real .onnx protobuf (``format='onnx'``).
+"""
+
+from __future__ import annotations
+
+
+def export(layer, path, input_spec=None, opset_version=9, format="stablehlo",
+           **configs):
+    if format == "onnx":
+        raise RuntimeError(
+            "ONNX protobuf export requires the paddle2onnx/onnx packages, "
+            "which are unavailable in this environment. Use the default "
+            "format='stablehlo' — a portable XLA program with the same "
+            "deploy-elsewhere role.")
+    from . import jit
+    base = path[:-len(".stablehlo")] if path.endswith(".stablehlo") else path
+    jit.save(layer, base, input_spec=input_spec)
+    return base + ".pdmodel"  # StableHLO text emitted by jit.save
+
+
+__all__ = ["export"]

@@ -129,14 +129,28 @@ class Optimizer:
             pgs.append((p, p.grad))
         return pgs
 
+    def _decay_grad(self, p, gd):
+        """Fold coupled weight decay into a raw grad array. Handles scalar
+        coefficients and ``paddle.regularizer`` objects; a per-parameter
+        regularizer attached via ParamAttr takes precedence over the
+        optimizer-level ``weight_decay`` (paddle semantics)."""
+        from ..regularizer import WeightDecayRegularizer
+        wd = getattr(p, "regularizer", None)
+        if wd is None:
+            wd = self._weight_decay
+        if wd is None or wd == 0.0:
+            return gd
+        pd = p._data.astype(gd.dtype)
+        if isinstance(wd, WeightDecayRegularizer):
+            return wd(pd, gd)
+        coeff = float(wd) if not isinstance(wd, (list, tuple)) \
+            else float(wd[0])
+        return gd + coeff * pd
+
     def _apply_decay(self, p, g, lr):
         """L2 regularization folded into grad (paddle weight_decay on
         non-AdamW optimizers)."""
-        wd = self._weight_decay
-        if wd is None or wd == 0.0:
-            return g
-        coeff = float(wd) if not isinstance(wd, (list, tuple)) else wd[0]
-        return g + coeff * p.astype(g.dtype)
+        return Tensor(self._decay_grad(p, g._data))
 
     def _lr_array(self):
         """Scalar lr used by update math. Outside a trace it is refreshed
@@ -228,8 +242,7 @@ class SGD(Optimizer):
                          name)
 
     def _update_param(self, p, g, lr):
-        gd = self._apply_decay(Tensor(p._data), Tensor(g._data), lr)._data \
-            if self._weight_decay else g._data
+        gd = self._decay_grad(p, g._data)
         m = self._master(p)
         if m is not None:
             new = m._data - lr * gd.astype(jnp.float32)
@@ -249,10 +262,7 @@ class Momentum(Optimizer):
         self._nesterov = use_nesterov
 
     def _update_param(self, p, g, lr):
-        gd = g._data.astype(jnp.float32)
-        if self._weight_decay:
-            gd = gd + float(self._weight_decay) * \
-                p._data.astype(jnp.float32)
+        gd = self._decay_grad(p, g._data.astype(jnp.float32))
         vel = self._acc("velocity", p)
         v = self._momentum * vel._data + gd
         vel.set_data(v)
@@ -283,9 +293,8 @@ class _AdamBase(Optimizer):
 
     def _adam_update(self, p, g, lr, decoupled_wd=0.0, apply_l2=True):
         gd = g._data.astype(jnp.float32)
-        if apply_l2 and self._weight_decay and not decoupled_wd:
-            gd = gd + float(self._weight_decay) * \
-                p._data.astype(jnp.float32)
+        if apply_l2 and not decoupled_wd:
+            gd = self._decay_grad(p, gd)
         m_t = self._acc("moment1", p)
         v_t = self._acc("moment2", p)
         b1p = self._acc("beta1_pow", p,
@@ -343,10 +352,7 @@ class AdamW(_AdamBase):
 
 class Adamax(_AdamBase):
     def _update_param(self, p, g, lr):
-        gd = g._data.astype(jnp.float32)
-        if self._weight_decay:
-            gd = gd + float(self._weight_decay) * \
-                p._data.astype(jnp.float32)
+        gd = self._decay_grad(p, g._data.astype(jnp.float32))
         m_t = self._acc("moment", p)
         u_t = self._acc("inf_norm", p)
         b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
@@ -375,10 +381,7 @@ class Adagrad(Optimizer):
         self._init_acc = initial_accumulator_value
 
     def _update_param(self, p, g, lr):
-        gd = g._data.astype(jnp.float32)
-        if self._weight_decay:
-            gd = gd + float(self._weight_decay) * \
-                p._data.astype(jnp.float32)
+        gd = self._decay_grad(p, g._data.astype(jnp.float32))
         acc = self._acc("moment", p,
                         init=jnp.full(p._data.shape, self._init_acc,
                                       jnp.float32))
@@ -398,10 +401,7 @@ class Adadelta(Optimizer):
         self._rho = rho
 
     def _update_param(self, p, g, lr):
-        gd = g._data.astype(jnp.float32)
-        if self._weight_decay:
-            gd = gd + float(self._weight_decay) * \
-                p._data.astype(jnp.float32)
+        gd = self._decay_grad(p, g._data.astype(jnp.float32))
         avg_sq = self._acc("avg_squared_grad", p)
         avg_up = self._acc("avg_squared_update", p)
         asg = self._rho * avg_sq._data + (1 - self._rho) * jnp.square(gd)
@@ -425,10 +425,7 @@ class RMSProp(Optimizer):
         self._centered = centered
 
     def _update_param(self, p, g, lr):
-        gd = g._data.astype(jnp.float32)
-        if self._weight_decay:
-            gd = gd + float(self._weight_decay) * \
-                p._data.astype(jnp.float32)
+        gd = self._decay_grad(p, g._data.astype(jnp.float32))
         ms = self._acc("mean_square", p)
         mom = self._acc("momentum", p)
         new_ms = self._rho * ms._data + (1 - self._rho) * jnp.square(gd)

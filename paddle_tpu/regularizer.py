@@ -1,0 +1,56 @@
+"""paddle.regularizer — L1Decay / L2Decay.
+
+Upstream (``python/paddle/regularizer.py``, UNVERIFIED) attaches these to
+``ParamAttr`` or passes them as ``weight_decay=`` on optimizers; the decay
+is folded into the gradient before the update rule. Same semantics here —
+the fold happens in ``Optimizer._apply_decay`` inside the (traced) step, so
+XLA fuses it into the optimizer kernel.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class WeightDecayRegularizer:
+    coeff: float = 0.0
+
+    def __call__(self, param, grad):
+        raise NotImplementedError
+
+
+def _data_of(x):
+    return x._data if hasattr(x, "_data") else jnp.asarray(x)
+
+
+class L1Decay(WeightDecayRegularizer):
+    """L1 weight decay: grad += coeff * sign(param)."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param, grad):
+        p, g = _data_of(param), _data_of(grad)
+        out = g + self.coeff * jnp.sign(p).astype(g.dtype)
+        return type(grad)(out) if hasattr(grad, "_data") else out
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """L2 weight decay: grad += coeff * param."""
+
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def __call__(self, param, grad):
+        p, g = _data_of(param), _data_of(grad)
+        out = g + self.coeff * p.astype(g.dtype)
+        return type(grad)(out) if hasattr(grad, "_data") else out
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"
+
+
+__all__ = ["WeightDecayRegularizer", "L1Decay", "L2Decay"]

@@ -1,0 +1,19 @@
+"""paddle.sysconfig — install path introspection (upstream
+``python/paddle/sysconfig.py``, UNVERIFIED)."""
+
+from __future__ import annotations
+
+import os
+
+
+def get_include():
+    """Directory of native headers (the C runtime core's sources double as
+    the public headers — there is no generated libpaddle on TPU)."""
+    return os.path.join(os.path.dirname(__file__), "native", "src")
+
+
+def get_lib():
+    return os.path.join(os.path.dirname(__file__), "native")
+
+
+__all__ = ["get_include", "get_lib"]

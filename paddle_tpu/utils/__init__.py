@@ -1,0 +1,99 @@
+"""paddle.utils — misc utilities (upstream ``python/paddle/utils/``,
+UNVERIFIED paths; see SURVEY.md provenance warning).
+
+Provides: ``deprecated``, ``try_import``, ``run_check``, ``unique_name``,
+``dlpack`` (zero-copy jax interop), ``flatten``/``pack_sequence_as`` pytree
+helpers, and a ``download`` shim (offline environment — local cache only).
+"""
+
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from . import unique_name  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import download  # noqa: F401
+from . import cpp_extension  # noqa: F401
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Decorator marking an API deprecated (paddle.utils.deprecated)."""
+    def wrapper(fn):
+        @functools.wraps(fn)
+        def inner(*args, **kwargs):
+            msg = f"API '{fn.__module__}.{fn.__name__}' is deprecated"
+            if since:
+                msg += f" since {since}"
+            if update_to:
+                msg += f", use '{update_to}' instead"
+            if reason:
+                msg += f". Reason: {reason}"
+            if level == 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return fn(*args, **kwargs)
+        return inner
+    return wrapper
+
+
+def try_import(module_name, err_msg=None):
+    """Import a module, raising a friendly error if missing."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError as e:
+        raise ImportError(
+            err_msg or f"Failed to import {module_name}: {e}. "
+            "This environment is offline; the dependency must be "
+            "pre-installed.") from e
+
+
+def run_check():
+    """paddle.utils.run_check — verify the install can compile and run a
+    matmul on the available device, and (if >1 device) a psum over a mesh."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices()
+    x = jnp.ones((128, 128), jnp.float32)
+    y = jax.jit(lambda a: a @ a)(x)
+    np.testing.assert_allclose(np.asarray(y[0, 0]), 128.0, rtol=1e-5)
+    print(f"paddle_tpu is installed successfully! "
+          f"{len(devs)} {devs[0].platform} device(s) available.")
+    if len(devs) > 1:
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec
+        mesh = Mesh(np.array(devs), ("x",))
+        s = jax.device_put(jnp.arange(len(devs), dtype=jnp.float32),
+                           NamedSharding(mesh, PartitionSpec("x")))
+        total = jax.jit(jnp.sum)(s)
+        np.testing.assert_allclose(np.asarray(total),
+                                   sum(range(len(devs))))
+        print(f"paddle_tpu works well on {len(devs)} devices (mesh check).")
+
+
+def flatten(nest):
+    """Flatten a nested structure into a flat list (paddle.utils.flatten)."""
+    import jax
+    return jax.tree_util.tree_leaves(nest)
+
+
+def pack_sequence_as(structure, flat_sequence):
+    """Inverse of flatten given a template structure."""
+    import jax
+    treedef = jax.tree_util.tree_structure(structure)
+    return jax.tree_util.tree_unflatten(treedef, flat_sequence)
+
+
+def to_list(value):
+    if value is None:
+        return value
+    if isinstance(value, (list, tuple)):
+        return list(value)
+    return [value]
+
+
+__all__ = ["deprecated", "try_import", "run_check", "unique_name", "dlpack",
+           "download", "cpp_extension", "flatten", "pack_sequence_as",
+           "to_list"]

@@ -1,0 +1,61 @@
+"""paddle.utils.cpp_extension — custom-op build helper.
+
+Upstream (``python/paddle/utils/cpp_extension/``, UNVERIFIED) compiles C++/
+CUDA custom operators against libpaddle. The TPU-native equivalent of a
+"custom op" is (a) a Pallas kernel registered through ``paddle_tpu.ops``,
+or (b) a C extension built with setuptools (pybind11 is not available in
+this image; the native runtime under ``paddle_tpu/native`` uses the raw
+CPython C API + ctypes). ``CppExtension``/``load`` here drive a plain
+setuptools build for host-side native code and document the Pallas path for
+device code.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+import tempfile
+
+
+def CppExtension(sources, *args, **kwargs):
+    from setuptools import Extension
+    include_dirs = kwargs.pop("include_dirs", [])
+    include_dirs.append(sysconfig.get_paths()["include"])
+    return Extension(kwargs.pop("name", "custom_ext"), sources,
+                     include_dirs=include_dirs, language="c++",
+                     extra_compile_args=["-std=c++17", "-O3"], **kwargs)
+
+
+def CUDAExtension(*args, **kwargs):
+    raise RuntimeError(
+        "CUDAExtension is not supported on the TPU build: device kernels "
+        "are written in Pallas (see /opt/skills/guides/pallas_guide.md and "
+        "paddle_tpu/ops/pallas_kernels.py). Host-side native code can use "
+        "CppExtension.")
+
+
+def load(name, sources, extra_cxx_cflags=None, build_directory=None,
+         verbose=False, **kwargs):
+    """JIT-compile a C++ source list into a shared library and dlopen it via
+    ctypes. Returns the ctypes CDLL (call exported C symbols directly)."""
+    import ctypes
+
+    build_directory = build_directory or tempfile.mkdtemp(prefix="pd_ext_")
+    out = os.path.join(build_directory, f"{name}.so")
+    cmd = ["g++", "-shared", "-fPIC", "-O3", "-std=c++17",
+           "-I", sysconfig.get_paths()["include"]]
+    cmd += list(extra_cxx_cflags or [])
+    cmd += list(sources) + ["-o", out]
+    if verbose:
+        print(" ".join(cmd))
+    subprocess.check_call(cmd)
+    return ctypes.CDLL(out)
+
+
+def setup(**kwargs):
+    from setuptools import setup as _setup
+    return _setup(**kwargs)
+
+
+__all__ = ["CppExtension", "CUDAExtension", "load", "setup"]

@@ -1,0 +1,46 @@
+"""paddle.utils.download — weight/dataset fetch shim.
+
+Upstream (``python/paddle/utils/download.py``, UNVERIFIED) downloads from
+bj.bcebos.com with md5 checks. This environment has zero egress, so the
+resolver is cache-only: it serves files already present under
+``$PADDLE_TPU_HOME/weights`` (default ``~/.cache/paddle_tpu``) and raises a
+clear error otherwise — the same API surface, minus the network.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+
+WEIGHTS_HOME = os.environ.get(
+    "PADDLE_TPU_HOME", osp.join(osp.expanduser("~"), ".cache", "paddle_tpu"))
+
+
+def _md5check(path, md5sum=None):
+    if md5sum is None:
+        return True
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest() == md5sum
+
+
+def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):
+    root_dir = root_dir or WEIGHTS_HOME
+    fname = osp.split(url)[-1]
+    path = osp.join(root_dir, fname)
+    if osp.exists(path) and (not check_exist or _md5check(path, md5sum)):
+        return path
+    raise RuntimeError(
+        f"'{fname}' not found in local cache ({root_dir}) and this "
+        f"environment has no network access. Place the file there manually "
+        f"to use it (requested url: {url}).")
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)
+
+
+__all__ = ["get_path_from_url", "get_weights_path_from_url", "WEIGHTS_HOME"]

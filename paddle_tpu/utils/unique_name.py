@@ -1,0 +1,48 @@
+"""paddle.utils.unique_name — process-wide unique name generator
+(upstream ``python/paddle/utils/unique_name.py``, UNVERIFIED)."""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+
+class _Generator:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ids: dict[str, int] = {}
+
+    def __call__(self, key: str) -> str:
+        with self._lock:
+            i = self._ids.get(key, 0)
+            self._ids[key] = i + 1
+        return f"{key}_{i}"
+
+
+_generator = _Generator()
+_guard_stack: list[str] = []
+
+
+def generate(key: str) -> str:
+    prefix = "".join(_guard_stack)
+    return _generator(prefix + key)
+
+
+@contextlib.contextmanager
+def guard(new_prefix=None):
+    """Namespace subsequent generate() calls under a prefix."""
+    _guard_stack.append(new_prefix or "")
+    try:
+        yield
+    finally:
+        _guard_stack.pop()
+
+
+def switch(new_generator=None):
+    global _generator
+    old = _generator
+    _generator = new_generator or _Generator()
+    return old
+
+
+__all__ = ["generate", "guard", "switch"]

@@ -1,0 +1,221 @@
+"""Tests for the paddle.linalg / utils / regularizer / hub / sysconfig /
+onnx / iinfo-finfo namespaces (SURVEY.md §2.2 rows: tensor linalg APIs,
+``python/paddle/utils/``, ``python/paddle/regularizer.py`` — UNVERIFIED
+reference paths; provenance warning in SURVEY.md)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+class TestLinalgNamespace:
+    def test_reexports(self):
+        for name in ("svd", "qr", "inv", "det", "norm", "matmul", "pinv",
+                     "cholesky", "eigh", "solve", "lstsq", "matrix_rank"):
+            assert callable(getattr(paddle.linalg, name)), name
+
+    def test_vector_matrix_norm(self):
+        x = paddle.to_tensor(np.arange(6, dtype="float32").reshape(2, 3))
+        v = paddle.linalg.vector_norm(x)
+        np.testing.assert_allclose(
+            float(v.item()), np.linalg.norm(np.arange(6)), rtol=1e-5)
+        m = paddle.linalg.matrix_norm(x, p="fro")
+        np.testing.assert_allclose(
+            float(m.item()), np.linalg.norm(np.arange(6)), rtol=1e-5)
+
+    def test_matrix_exp(self):
+        a = np.diag([1.0, 2.0]).astype("float32")
+        out = paddle.linalg.matrix_exp(paddle.to_tensor(a)).numpy()
+        np.testing.assert_allclose(out, np.diag(np.exp([1.0, 2.0])),
+                                   rtol=1e-5)
+
+    def test_lu_unpack_reconstructs(self):
+        rng = np.random.RandomState(0)
+        a = rng.randn(5, 5).astype("float32")
+        lu, piv = paddle.linalg.lu(paddle.to_tensor(a))
+        P, L, U = paddle.linalg.lu_unpack(lu, piv)
+        rec = P.numpy() @ L.numpy() @ U.numpy()
+        np.testing.assert_allclose(rec, a, atol=1e-4)
+
+    def test_cdist_matches_numpy(self):
+        rng = np.random.RandomState(1)
+        x, y = rng.randn(4, 3).astype("float32"), rng.randn(5, 3).astype(
+            "float32")
+        out = paddle.linalg.cdist(
+            paddle.to_tensor(x), paddle.to_tensor(y)).numpy()
+        ref = np.sqrt(((x[:, None, :] - y[None, :, :]) ** 2).sum(-1))
+        np.testing.assert_allclose(out, ref, atol=1e-5)
+        out1 = paddle.linalg.cdist(
+            paddle.to_tensor(x), paddle.to_tensor(y), p=1.0).numpy()
+        ref1 = np.abs(x[:, None, :] - y[None, :, :]).sum(-1)
+        np.testing.assert_allclose(out1, ref1, atol=1e-5)
+
+    def test_svd_lowrank_rank_revealing(self):
+        rng = np.random.RandomState(2)
+        base = rng.randn(20, 3).astype("float32") @ rng.randn(3, 15).astype(
+            "float32")
+        u, s, v = paddle.linalg.svd_lowrank(paddle.to_tensor(base), q=6)
+        s = s.numpy()
+        assert s[0] > 1e-2 and s[3] < 1e-3 * s[0]  # true rank is 3
+
+    def test_cdist_grad_flows(self):
+        x = paddle.to_tensor(np.random.RandomState(3).randn(3, 4).astype(
+            "float32"))
+        x.stop_gradient = False
+        d = paddle.linalg.cdist(x, x * 0.5).sum()
+        d.backward()
+        assert x.grad is not None and np.isfinite(x.grad.numpy()).all()
+
+
+class TestUtils:
+    def test_unique_name(self):
+        from paddle_tpu.utils import unique_name
+        a, b = unique_name.generate("w"), unique_name.generate("w")
+        assert a != b and a.startswith("w_")
+        with unique_name.guard("block/"):
+            c = unique_name.generate("w")
+        assert c.startswith("block/w")
+
+    def test_deprecated_warns(self):
+        @paddle.utils.deprecated(update_to="paddle.new_api", since="0.1")
+        def old():
+            return 7
+
+        with pytest.warns(DeprecationWarning):
+            assert old() == 7
+
+    def test_dlpack_roundtrip(self):
+        x = paddle.to_tensor(np.arange(12, dtype="float32").reshape(3, 4))
+        cap = paddle.utils.dlpack.to_dlpack(x)
+        y = paddle.utils.dlpack.from_dlpack(cap)
+        np.testing.assert_array_equal(y.numpy(), x.numpy())
+        # numpy consumes the protocol object directly
+        np.testing.assert_array_equal(np.from_dlpack(
+            paddle.utils.dlpack.to_dlpack(x)), x.numpy())
+
+    def test_flatten_pack(self):
+        nest = {"a": [1, 2], "b": (3,)}
+        flat = paddle.utils.flatten(nest)
+        assert flat == [1, 2, 3]
+        back = paddle.utils.pack_sequence_as(nest, flat)
+        assert back == {"a": [1, 2], "b": (3,)}
+
+    def test_run_check(self, capsys):
+        paddle.utils.run_check()
+        assert "successfully" in capsys.readouterr().out
+
+    def test_download_is_cache_only(self):
+        with pytest.raises(RuntimeError, match="no network access"):
+            paddle.utils.download.get_weights_path_from_url(
+                "https://example.com/nonexistent_weights.pdparams")
+
+
+class TestRegularizer:
+    def test_l2_decay_changes_update(self):
+        rng = np.random.RandomState(0)
+        w0 = rng.randn(4, 4).astype("float32")
+
+        def run(reg):
+            paddle.seed(0)
+            lin = paddle.nn.Linear(4, 4)
+            lin.weight.set_value(paddle.to_tensor(w0.copy()))
+            opt = paddle.optimizer.Momentum(
+                learning_rate=0.1, parameters=lin.parameters(),
+                weight_decay=reg)
+            x = paddle.to_tensor(rng.randn(2, 4).astype("float32"))
+            loss = lin(x).sum()
+            loss.backward()
+            opt.step()
+            return lin.weight.numpy()
+
+        none_w = run(None)
+        l2_w = run(paddle.regularizer.L2Decay(0.5))
+        l1_w = run(paddle.regularizer.L1Decay(0.5))
+        assert not np.allclose(none_w, l2_w)
+        assert not np.allclose(l2_w, l1_w)
+
+    def test_regularizer_object_on_every_optimizer(self):
+        rng = np.random.RandomState(0)
+        for cls, kw in [(paddle.optimizer.SGD, {}),
+                        (paddle.optimizer.Momentum, {}),
+                        (paddle.optimizer.Adam, {}),
+                        (paddle.optimizer.Adamax, {}),
+                        (paddle.optimizer.Adagrad, {}),
+                        (paddle.optimizer.Adadelta, {}),
+                        (paddle.optimizer.RMSProp, {})]:
+            paddle.seed(0)
+            lin = paddle.nn.Linear(3, 3)
+            opt = cls(learning_rate=0.1, parameters=lin.parameters(),
+                      weight_decay=paddle.regularizer.L2Decay(0.1), **kw)
+            x = paddle.to_tensor(rng.randn(2, 3).astype("float32"))
+            lin(x).sum().backward()
+            opt.step()  # must not raise on regularizer-object weight_decay
+            assert np.isfinite(lin.weight.numpy()).all(), cls.__name__
+
+    def test_param_attr_regularizer_takes_effect(self):
+        rng = np.random.RandomState(0)
+        x_np = rng.randn(2, 4).astype("float32")
+
+        def run(attr):
+            paddle.seed(0)
+            lin = paddle.nn.Linear(
+                4, 4, weight_attr=paddle.ParamAttr(regularizer=attr))
+            opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                       parameters=lin.parameters())
+            lin(paddle.to_tensor(x_np)).sum().backward()
+            opt.step()
+            return lin.weight.numpy()
+
+        plain = run(None)
+        reg = run(paddle.regularizer.L2Decay(0.5))
+        assert not np.allclose(plain, reg)
+
+    def test_l2_matches_scalar_weight_decay(self):
+        p = np.array([[2.0, -3.0]], dtype="float32")
+        g = np.array([[0.1, 0.1]], dtype="float32")
+        out = paddle.regularizer.L2Decay(0.01)(p, g)
+        np.testing.assert_allclose(np.asarray(out), g + 0.01 * p, rtol=1e-6)
+
+
+class TestMiscNamespaces:
+    def test_iinfo_finfo(self):
+        assert paddle.iinfo(paddle.int8).max == 127
+        assert paddle.finfo(paddle.float32).eps > 0
+        assert paddle.finfo(paddle.bfloat16).bits == 16
+
+    def test_sysconfig_paths_exist(self):
+        import os
+        assert os.path.isdir(paddle.sysconfig.get_include())
+        assert os.path.isdir(paddle.sysconfig.get_lib())
+
+    def test_hub_local(self, tmp_path):
+        (tmp_path / "hubconf.py").write_text(
+            "def tiny_model(scale=1):\n"
+            "    'a tiny model entrypoint'\n"
+            "    import paddle_tpu as paddle\n"
+            "    return paddle.nn.Linear(2 * scale, 2 * scale)\n")
+        names = paddle.hub.list(str(tmp_path))
+        assert "tiny_model" in names
+        assert "tiny" in paddle.hub.help(str(tmp_path), "tiny_model")
+        m = paddle.hub.load(str(tmp_path), "tiny_model", scale=2)
+        assert m.weight.shape == [4, 4]
+
+    def test_hub_remote_raises(self):
+        with pytest.raises(RuntimeError, match="network"):
+            paddle.hub.list("someorg/somerepo", source="github")
+
+    def test_onnx_export_stablehlo(self, tmp_path):
+        lin = paddle.nn.Linear(3, 2)
+        spec = [paddle.static.InputSpec([1, 3], "float32", "x")]
+        out = paddle.onnx.export(lin, str(tmp_path / "m"), input_spec=spec)
+        import os
+        assert os.path.exists(out)
+        assert "stablehlo" in open(out).read() or "module" in open(out).read()
+        with pytest.raises(RuntimeError, match="paddle2onnx"):
+            paddle.onnx.export(lin, str(tmp_path / "m2"), input_spec=spec,
+                               format="onnx")
+
+    def test_callbacks_namespace(self):
+        assert hasattr(paddle.callbacks, "Callback") or hasattr(
+            paddle.callbacks, "EarlyStopping")
