@@ -1,10 +1,40 @@
-"""``paddle.vision`` — models/transforms/datasets scaffold
-(python/paddle/vision/ parity, UNVERIFIED). Round-1 scope: ResNet family +
-basic transforms + ops used by OpTest-style suites."""
+"""``paddle.vision`` — models / transforms / datasets / detection ops
+(upstream ``python/paddle/vision/``, UNVERIFIED paths; see SURVEY.md
+provenance warning)."""
 
 from . import transforms
 from . import models
-from .models import ResNet, resnet18, resnet34, resnet50, resnet101, LeNet
+from . import datasets
+from . import ops
+from .models import (ResNet, resnet18, resnet34, resnet50, resnet101,
+                     resnet152, LeNet, AlexNet, alexnet, VGG, vgg11, vgg13,
+                     vgg16, vgg19, MobileNetV1, mobilenet_v1, MobileNetV2,
+                     mobilenet_v2, MobileNetV3Small, MobileNetV3Large,
+                     mobilenet_v3_small, mobilenet_v3_large, SqueezeNet,
+                     squeezenet1_0, squeezenet1_1, ShuffleNetV2,
+                     shufflenet_v2_x1_0, DenseNet, densenet121, GoogLeNet,
+                     googlenet, resnext50_32x4d, resnext101_32x4d,
+                     wide_resnet50_2, wide_resnet101_2, BasicBlock,
+                     BottleneckBlock)
 
-__all__ = ["transforms", "models", "ResNet", "resnet18", "resnet34",
-           "resnet50", "resnet101", "LeNet"]
+
+def set_image_backend(backend):
+    """paddle.vision.set_image_backend — 'pil' is the only bundled backend
+    (cv2 is not in this image)."""
+    if backend not in ("pil",):
+        raise ValueError(f"unsupported image backend {backend!r}; only "
+                         "'pil' is available in this environment")
+
+
+def get_image_backend():
+    return "pil"
+
+
+def image_load(path, backend=None):
+    from .datasets import _default_loader
+    return _default_loader(path)
+
+
+__all__ = ["transforms", "models", "datasets", "ops",
+           "set_image_backend", "get_image_backend", "image_load"]
+__all__ += models.__all__

@@ -1,0 +1,238 @@
+"""paddle.vision.datasets — dataset classes.
+
+Reference surface: upstream ``python/paddle/vision/datasets/`` (UNVERIFIED;
+see SURVEY.md provenance warning): MNIST/FashionMNIST (idx-ubyte files),
+Cifar10/100 (pickled batches), DatasetFolder/ImageFolder (directory trees).
+Upstream auto-downloads from bcebos; this environment has zero egress, so
+every dataset reads from a local path (``image_path=``/``data_file=`` or
+the ``$PADDLE_TPU_HOME`` cache) and raises a clear error when absent —
+``backend='generate'`` produces a small deterministic synthetic split so
+examples/tests run offline.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+from ..utils.download import WEIGHTS_HOME
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100",
+           "DatasetFolder", "ImageFolder"]
+
+_IMG_EXTS = (".jpg", ".jpeg", ".png", ".bmp", ".ppm", ".webp", ".npy")
+
+
+def _missing(name, path):
+    raise RuntimeError(
+        f"{name}: data file {path!r} not found and this environment has no "
+        f"network access. Place the file there (or under {WEIGHTS_HOME}), "
+        f"or pass backend='generate' for a synthetic offline split.")
+
+
+class _GeneratedSplit:
+    """Deterministic synthetic images: class-dependent gaussian blobs, so a
+    small model can actually fit the split (useful for offline examples)."""
+
+    def __init__(self, n, shape, num_classes, seed):
+        rng = np.random.RandomState(seed)
+        self.labels = rng.randint(0, num_classes, n).astype("int64")
+        protos = rng.rand(num_classes, *shape).astype("float32")
+        noise = rng.rand(n, *shape).astype("float32") * 0.3
+        self.images = (protos[self.labels] * 255 * 0.7 + noise * 255) \
+            .astype("uint8")
+
+
+class MNIST(Dataset):
+    """MNIST (idx-ubyte format, same files as upstream paddle's
+    ``train-images-idx3-ubyte.gz``)."""
+
+    NAME = "mnist"
+    NUM_CLASSES = 10
+    IMAGE_SHAPE = (28, 28)
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=True, backend=None):
+        assert mode in ("train", "test")
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend
+        if backend == "generate":
+            n = 2000 if mode == "train" else 400
+            g = _GeneratedSplit(n, self.IMAGE_SHAPE, self.NUM_CLASSES,
+                                seed=0 if mode == "train" else 1)
+            self.images, self.labels = g.images, g.labels
+            return
+        prefix = "train" if mode == "train" else "t10k"
+        image_path = image_path or os.path.join(
+            WEIGHTS_HOME, self.NAME, f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            WEIGHTS_HOME, self.NAME, f"{prefix}-labels-idx1-ubyte.gz")
+        if not os.path.exists(image_path):
+            _missing(type(self).__name__, image_path)
+        if not os.path.exists(label_path):
+            _missing(type(self).__name__, label_path)
+        self.images = self._read_idx(image_path, dims=3)
+        self.labels = self._read_idx(label_path, dims=1).astype("int64")
+
+    @staticmethod
+    def _read_idx(path, dims):
+        op = gzip.open if path.endswith(".gz") else open
+        with op(path, "rb") as f:
+            data = f.read()
+        _, _, dt, nd = struct.unpack(">BBBB", data[:4])
+        shape = struct.unpack(f">{nd}I", data[4:4 + 4 * nd])
+        return np.frombuffer(data[4 + 4 * nd:],
+                             dtype=np.uint8).reshape(shape)
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"
+
+
+class Cifar10(Dataset):
+    """CIFAR-10 from the python-version tar.gz (``cifar-10-python.tar.gz``,
+    the same artifact upstream downloads)."""
+
+    NUM_CLASSES = 10
+    _TRAIN_MEMBERS = [f"data_batch_{i}" for i in range(1, 6)]
+    _TEST_MEMBERS = ["test_batch"]
+    _LABEL_KEY = b"labels"
+    _ARCHIVE = "cifar-10-python.tar.gz"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        assert mode in ("train", "test")
+        self.mode = mode
+        self.transform = transform
+        if backend == "generate":
+            n = 2000 if mode == "train" else 400
+            g = _GeneratedSplit(n, (32, 32, 3), self.NUM_CLASSES,
+                                seed=2 if mode == "train" else 3)
+            self.images, self.labels = g.images, g.labels
+            return
+        data_file = data_file or os.path.join(WEIGHTS_HOME, self._ARCHIVE)
+        if not os.path.exists(data_file):
+            _missing(type(self).__name__, data_file)
+        members = self._TRAIN_MEMBERS if mode == "train" \
+            else self._TEST_MEMBERS
+        images, labels = [], []
+        with tarfile.open(data_file, "r:*") as tar:
+            for m in tar.getmembers():
+                base = os.path.basename(m.name)
+                if base in members:
+                    d = pickle.load(tar.extractfile(m), encoding="bytes")
+                    images.append(d[b"data"])
+                    labels.extend(d[self._LABEL_KEY])
+        self.images = np.concatenate(images).reshape(-1, 3, 32, 32) \
+            .transpose(0, 2, 3, 1)  # HWC uint8, paddle convention
+        self.labels = np.asarray(labels, dtype="int64")
+
+    def __len__(self):
+        return len(self.images)
+
+    def __getitem__(self, idx):
+        img, label = self.images[idx], self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class Cifar100(Cifar10):
+    NUM_CLASSES = 100
+    _TRAIN_MEMBERS = ["train"]
+    _TEST_MEMBERS = ["test"]
+    _LABEL_KEY = b"fine_labels"
+    _ARCHIVE = "cifar-100-python.tar.gz"
+
+
+def _default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    with open(path, "rb") as f:
+        return Image.open(f).convert("RGB")
+
+
+class DatasetFolder(Dataset):
+    """Directory-per-class image dataset (upstream DatasetFolder):
+    root/class_x/xxx.png -> (sample, class_index)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or _default_loader
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or _IMG_EXTS))
+        classes = sorted(d.name for d in os.scandir(root) if d.is_dir())
+        if not classes:
+            raise RuntimeError(f"no class directories found under {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, files in sorted(os.walk(cdir)):
+                for fname in sorted(files):
+                    path = os.path.join(dirpath, fname)
+                    ok = (is_valid_file(path) if is_valid_file
+                          else fname.lower().endswith(exts))
+                    if ok:
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+
+class ImageFolder(Dataset):
+    """Flat (unlabeled) image folder: returns [sample] per item, matching
+    upstream ImageFolder's list-valued items."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.loader = loader or _default_loader
+        self.transform = transform
+        exts = tuple(e.lower() for e in (extensions or _IMG_EXTS))
+        self.samples = []
+        for dirpath, _, files in sorted(os.walk(root)):
+            for fname in sorted(files):
+                path = os.path.join(dirpath, fname)
+                ok = (is_valid_file(path) if is_valid_file
+                      else fname.lower().endswith(exts))
+                if ok:
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __len__(self):
+        return len(self.samples)
+
+    def __getitem__(self, idx):
+        sample = self.loader(self.samples[idx])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]

@@ -1,20 +1,30 @@
 """Vision models (python/paddle/vision/models/ parity, UNVERIFIED):
-ResNet family + LeNet — conv-net coverage for the framework (NCHW,
-BatchNorm, pooling, the full CNN path on the MXU)."""
+ResNet/ResNeXt/WideResNet, VGG, AlexNet, MobileNetV1/V2/V3, SqueezeNet,
+ShuffleNetV2, DenseNet, GoogLeNet, LeNet — conv-net coverage for the
+framework (NCHW, BatchNorm, pooling, the full CNN path on the MXU)."""
 
 from __future__ import annotations
 
 from ..nn.layer.layers import Layer
-from ..nn.layer.common import Linear, Flatten
+from ..nn.layer.common import Linear, Flatten, Dropout
 from ..nn.layer.container import Sequential
 from ..nn.layer.conv import Conv2D
 from ..nn.layer.norm import BatchNorm2D
-from ..nn.layer.activation import ReLU
-from ..nn.layer.pooling import MaxPool2D, AdaptiveAvgPool2D
+from ..nn.layer.activation import (ReLU, ReLU6, Hardswish, Hardsigmoid,
+                                   Sigmoid)
+from ..nn.layer.pooling import (MaxPool2D, AvgPool2D, AdaptiveAvgPool2D)
 from ..nn import functional as F
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
-           "resnet152", "LeNet", "BasicBlock", "BottleneckBlock"]
+           "resnet152", "resnext50_32x4d", "resnext101_32x4d",
+           "wide_resnet50_2", "wide_resnet101_2", "LeNet", "BasicBlock",
+           "BottleneckBlock", "AlexNet", "alexnet", "VGG", "vgg11", "vgg13",
+           "vgg16", "vgg19", "MobileNetV1", "mobilenet_v1", "MobileNetV2",
+           "mobilenet_v2", "MobileNetV3Small", "MobileNetV3Large",
+           "mobilenet_v3_small", "mobilenet_v3_large", "SqueezeNet",
+           "squeezenet1_0", "squeezenet1_1", "ShuffleNetV2",
+           "shufflenet_v2_x1_0", "DenseNet", "densenet121", "GoogLeNet",
+           "googlenet"]
 
 
 class BasicBlock(Layer):
@@ -42,14 +52,16 @@ class BasicBlock(Layer):
 class BottleneckBlock(Layer):
     expansion = 4
 
-    def __init__(self, inplanes, planes, stride=1, downsample=None):
+    def __init__(self, inplanes, planes, stride=1, downsample=None,
+                 groups=1, base_width=64):
         super().__init__()
-        self.conv1 = Conv2D(inplanes, planes, 1, bias_attr=False)
-        self.bn1 = BatchNorm2D(planes)
-        self.conv2 = Conv2D(planes, planes, 3, stride=stride, padding=1,
-                            bias_attr=False)
-        self.bn2 = BatchNorm2D(planes)
-        self.conv3 = Conv2D(planes, planes * self.expansion, 1,
+        width = int(planes * (base_width / 64.0)) * groups
+        self.conv1 = Conv2D(inplanes, width, 1, bias_attr=False)
+        self.bn1 = BatchNorm2D(width)
+        self.conv2 = Conv2D(width, width, 3, stride=stride, padding=1,
+                            groups=groups, bias_attr=False)
+        self.bn2 = BatchNorm2D(width)
+        self.conv3 = Conv2D(width, planes * self.expansion, 1,
                             bias_attr=False)
         self.bn3 = BatchNorm2D(planes * self.expansion)
         self.downsample = downsample
@@ -69,6 +81,8 @@ class ResNet(Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
                  with_pool=True, groups=1):
         super().__init__()
+        self.groups = groups
+        self.base_width = width
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3],
                      50: [3, 4, 6, 3], 101: [3, 4, 23, 3],
                      152: [3, 8, 36, 3]}
@@ -97,10 +111,12 @@ class ResNet(Layer):
                 Conv2D(self.inplanes, planes * block.expansion, 1,
                        stride=stride, bias_attr=False),
                 BatchNorm2D(planes * block.expansion))
-        layers = [block(self.inplanes, planes, stride, downsample)]
+        extra = ({"groups": self.groups, "base_width": self.base_width}
+                 if block is BottleneckBlock else {})
+        layers = [block(self.inplanes, planes, stride, downsample, **extra)]
         self.inplanes = planes * block.expansion
         for _ in range(1, blocks):
-            layers.append(block(self.inplanes, planes))
+            layers.append(block(self.inplanes, planes, **extra))
         return Sequential(*layers)
 
     def forward(self, x):
@@ -150,3 +166,589 @@ class LeNet(Layer):
         x = self.features(x)
         from ..ops.manipulation import flatten
         return self.fc(flatten(x, 1))
+
+
+def resnext50_32x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, width=4, groups=32, **kwargs)
+
+
+def resnext101_32x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width=4, groups=32, **kwargs)
+
+
+def wide_resnet50_2(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, width=128, **kwargs)
+
+
+def wide_resnet101_2(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width=128, **kwargs)
+
+
+def _flatten1(x):
+    from ..ops.manipulation import flatten
+    return flatten(x, 1)
+
+
+class AlexNet(Layer):
+    def __init__(self, num_classes=1000):
+        super().__init__()
+        self.features = Sequential(
+            Conv2D(3, 64, 11, stride=4, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(64, 192, 5, padding=2), ReLU(),
+            MaxPool2D(3, stride=2),
+            Conv2D(192, 384, 3, padding=1), ReLU(),
+            Conv2D(384, 256, 3, padding=1), ReLU(),
+            Conv2D(256, 256, 3, padding=1), ReLU(),
+            MaxPool2D(3, stride=2))
+        self.avgpool = AdaptiveAvgPool2D((6, 6))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(0.5), Linear(256 * 36, 4096), ReLU(),
+                Dropout(0.5), Linear(4096, 4096), ReLU(),
+                Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.avgpool(self.features(x))
+        if self.num_classes > 0:
+            x = self.classifier(_flatten1(x))
+        return x
+
+
+def alexnet(pretrained=False, **kwargs):
+    return AlexNet(**kwargs)
+
+
+_VGG_CFGS = {
+    11: [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    13: [64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M",
+         512, 512, "M"],
+    16: [64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+         512, 512, 512, "M"],
+    19: [64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M",
+         512, 512, 512, 512, "M", 512, 512, 512, 512, "M"],
+}
+
+
+class VGG(Layer):
+    def __init__(self, features, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.features = features
+        self.with_pool = with_pool
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D((7, 7))
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(512 * 49, 4096), ReLU(), Dropout(0.5),
+                Linear(4096, 4096), ReLU(), Dropout(0.5),
+                Linear(4096, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.classifier(_flatten1(x))
+        return x
+
+
+def _vgg_features(cfg, batch_norm):
+    layers, c_in = [], 3
+    for v in cfg:
+        if v == "M":
+            layers.append(MaxPool2D(2, 2))
+        else:
+            layers.append(Conv2D(c_in, v, 3, padding=1))
+            if batch_norm:
+                layers.append(BatchNorm2D(v))
+            layers.append(ReLU())
+            c_in = v
+    return Sequential(*layers)
+
+
+def _vgg(depth, batch_norm=False, **kwargs):
+    return VGG(_vgg_features(_VGG_CFGS[depth], batch_norm), **kwargs)
+
+
+def vgg11(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg(11, batch_norm, **kwargs)
+
+
+def vgg13(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg(13, batch_norm, **kwargs)
+
+
+def vgg16(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg(16, batch_norm, **kwargs)
+
+
+def vgg19(pretrained=False, batch_norm=False, **kwargs):
+    return _vgg(19, batch_norm, **kwargs)
+
+
+def _conv_bn(c_in, c_out, k, stride=1, padding=0, groups=1, act=ReLU):
+    layers = [Conv2D(c_in, c_out, k, stride=stride, padding=padding,
+                     groups=groups, bias_attr=False), BatchNorm2D(c_out)]
+    if act is not None:
+        layers.append(act())
+    return Sequential(*layers)
+
+
+class MobileNetV1(Layer):
+    """Depthwise-separable conv net. Depthwise = grouped conv with
+    groups == channels (XLA lowers this to a channel-parallel conv)."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        s = lambda c: max(int(c * scale), 8)
+        cfg = [(s(32), s(64), 1), (s(64), s(128), 2), (s(128), s(128), 1),
+               (s(128), s(256), 2), (s(256), s(256), 1),
+               (s(256), s(512), 2)] + [(s(512), s(512), 1)] * 5 + \
+              [(s(512), s(1024), 2), (s(1024), s(1024), 1)]
+        blocks = [_conv_bn(3, s(32), 3, stride=2, padding=1)]
+        for c_in, c_out, stride in cfg:
+            blocks.append(_conv_bn(c_in, c_in, 3, stride=stride, padding=1,
+                                   groups=c_in))        # depthwise
+            blocks.append(_conv_bn(c_in, c_out, 1))      # pointwise
+        self.features = Sequential(*blocks)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(_flatten1(x))
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, c_in, c_out, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(c_in * expand_ratio))
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_conv_bn(c_in, hidden, 1, act=ReLU6))
+        layers += [
+            _conv_bn(hidden, hidden, 3, stride=stride, padding=1,
+                     groups=hidden, act=ReLU6),
+            _conv_bn(hidden, c_out, 1, act=None),
+        ]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        s = lambda c: max(int(c * scale), 8)
+        c_in = s(32)
+        blocks = [_conv_bn(3, c_in, 3, stride=2, padding=1, act=ReLU6)]
+        for t, c, n, stride in cfg:
+            for i in range(n):
+                blocks.append(_InvertedResidual(
+                    c_in, s(c), stride if i == 0 else 1, t))
+                c_in = s(c)
+        last = max(s(1280), 1280) if scale > 1.0 else 1280
+        blocks.append(_conv_bn(c_in, last, 1, act=ReLU6))
+        self.features = Sequential(*blocks)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(last, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(_flatten1(x))
+        return x
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV2(scale=scale, **kwargs)
+
+
+class _SEModule(Layer):
+    def __init__(self, ch, reduction=4):
+        super().__init__()
+        self.pool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(ch, ch // reduction, 1)
+        self.fc2 = Conv2D(ch // reduction, ch, 1)
+        self.relu = ReLU()
+        self.hsig = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsig(self.fc2(self.relu(self.fc1(self.pool(x)))))
+        return x * s
+
+
+class _MBV3Block(Layer):
+    def __init__(self, c_in, c_mid, c_out, k, stride, se, act):
+        super().__init__()
+        self.use_res = stride == 1 and c_in == c_out
+        layers = []
+        if c_mid != c_in:
+            layers.append(_conv_bn(c_in, c_mid, 1, act=act))
+        layers.append(_conv_bn(c_mid, c_mid, k, stride=stride,
+                               padding=k // 2, groups=c_mid, act=act))
+        if se:
+            layers.append(_SEModule(c_mid))
+        layers.append(_conv_bn(c_mid, c_out, 1, act=None))
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class _MobileNetV3(Layer):
+    def __init__(self, cfg, last_ch, num_classes=1000, with_pool=True,
+                 scale=1.0):
+        super().__init__()
+        s = lambda c: max(int(c * scale), 8)
+        c_in = s(16)
+        blocks = [_conv_bn(3, c_in, 3, stride=2, padding=1, act=Hardswish)]
+        for k, mid, out, se, act, stride in cfg:
+            blocks.append(_MBV3Block(c_in, s(mid), s(out), k, stride, se,
+                                     act))
+            c_in = s(out)
+        last_conv = s(cfg[-1][1])
+        blocks.append(_conv_bn(c_in, last_conv, 1, act=Hardswish))
+        self.features = Sequential(*blocks)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(last_conv, last_ch), Hardswish(), Dropout(0.2),
+                Linear(last_ch, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.classifier(_flatten1(x))
+        return x
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        cfg = [  # k, exp, out, SE, act, stride
+            (3, 16, 16, True, ReLU, 2), (3, 72, 24, False, ReLU, 2),
+            (3, 88, 24, False, ReLU, 1), (5, 96, 40, True, Hardswish, 2),
+            (5, 240, 40, True, Hardswish, 1),
+            (5, 240, 40, True, Hardswish, 1),
+            (5, 120, 48, True, Hardswish, 1),
+            (5, 144, 48, True, Hardswish, 1),
+            (5, 288, 96, True, Hardswish, 2),
+            (5, 576, 96, True, Hardswish, 1),
+            (5, 576, 96, True, Hardswish, 1)]
+        super().__init__(cfg, 1024, num_classes, with_pool, scale)
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        cfg = [
+            (3, 16, 16, False, ReLU, 1), (3, 64, 24, False, ReLU, 2),
+            (3, 72, 24, False, ReLU, 1), (5, 72, 40, True, ReLU, 2),
+            (5, 120, 40, True, ReLU, 1), (5, 120, 40, True, ReLU, 1),
+            (3, 240, 80, False, Hardswish, 2),
+            (3, 200, 80, False, Hardswish, 1),
+            (3, 184, 80, False, Hardswish, 1),
+            (3, 184, 80, False, Hardswish, 1),
+            (3, 480, 112, True, Hardswish, 1),
+            (3, 672, 112, True, Hardswish, 1),
+            (5, 672, 160, True, Hardswish, 2),
+            (5, 960, 160, True, Hardswish, 1),
+            (5, 960, 160, True, Hardswish, 1)]
+        super().__init__(cfg, 1280, num_classes, with_pool, scale)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV3Large(scale=scale, **kwargs)
+
+
+class _Fire(Layer):
+    def __init__(self, c_in, squeeze, e1, e3):
+        super().__init__()
+        self.squeeze = Sequential(Conv2D(c_in, squeeze, 1), ReLU())
+        self.expand1 = Sequential(Conv2D(squeeze, e1, 1), ReLU())
+        self.expand3 = Sequential(Conv2D(squeeze, e3, 3, padding=1), ReLU())
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        s = self.squeeze(x)
+        return concat([self.expand1(s), self.expand3(s)], axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(96, 16, 64, 64), _Fire(128, 16, 64, 64),
+                _Fire(128, 32, 128, 128), MaxPool2D(3, 2),
+                _Fire(256, 32, 128, 128), _Fire(256, 48, 192, 192),
+                _Fire(384, 48, 192, 192), _Fire(384, 64, 256, 256),
+                MaxPool2D(3, 2), _Fire(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(), MaxPool2D(3, 2),
+                _Fire(64, 16, 64, 64), _Fire(128, 16, 64, 64),
+                MaxPool2D(3, 2), _Fire(128, 32, 128, 128),
+                _Fire(256, 32, 128, 128), MaxPool2D(3, 2),
+                _Fire(256, 48, 192, 192), _Fire(384, 48, 192, 192),
+                _Fire(384, 64, 256, 256), _Fire(512, 64, 256, 256))
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(0.5), Conv2D(512, num_classes, 1), ReLU())
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.pool(x)
+        return _flatten1(x)
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    return SqueezeNet("1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    return SqueezeNet("1.1", **kwargs)
+
+
+def _channel_shuffle(x, groups):
+    from ..ops.manipulation import reshape, transpose
+    b, c, h, w = x.shape
+    x = reshape(x, [b, groups, c // groups, h, w])
+    x = transpose(x, [0, 2, 1, 3, 4])
+    return reshape(x, [b, c, h, w])
+
+
+class _ShuffleUnit(Layer):
+    def __init__(self, c_in, c_out, stride):
+        super().__init__()
+        self.stride = stride
+        branch = c_out // 2
+        if stride == 2:
+            self.branch1 = Sequential(
+                _conv_bn(c_in, c_in, 3, stride=2, padding=1, groups=c_in,
+                         act=None),
+                _conv_bn(c_in, branch, 1))
+            c_in2 = c_in
+        else:
+            self.branch1 = None
+            c_in2 = c_in // 2
+        self.branch2 = Sequential(
+            _conv_bn(c_in2, branch, 1),
+            _conv_bn(branch, branch, 3, stride=stride, padding=1,
+                     groups=branch, act=None),
+            _conv_bn(branch, branch, 1))
+
+    def forward(self, x):
+        from ..ops.manipulation import concat, split
+        if self.stride == 2:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        else:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        return _channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        stage_out = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+                     1.5: [176, 352, 704, 1024],
+                     2.0: [244, 488, 976, 2048]}[scale]
+        repeats = [4, 8, 4]
+        self.conv1 = _conv_bn(3, 24, 3, stride=2, padding=1)
+        self.maxpool = MaxPool2D(3, stride=2, padding=1)
+        c_in = 24
+        stages = []
+        for r, c_out in zip(repeats, stage_out[:3]):
+            units = [_ShuffleUnit(c_in, c_out, 2)]
+            for _ in range(r - 1):
+                units.append(_ShuffleUnit(c_out, c_out, 1))
+            stages.append(Sequential(*units))
+            c_in = c_out
+        self.stages = Sequential(*stages)
+        self.conv_last = _conv_bn(c_in, stage_out[3], 1)
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(stage_out[3], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(_flatten1(x))
+        return x
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+class _DenseLayer(Layer):
+    def __init__(self, c_in, growth_rate, bn_size):
+        super().__init__()
+        self.bn1 = BatchNorm2D(c_in)
+        self.conv1 = Conv2D(c_in, bn_size * growth_rate, 1, bias_attr=False)
+        self.bn2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3,
+                            padding=1, bias_attr=False)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        out = self.conv1(self.relu(self.bn1(x)))
+        out = self.conv2(self.relu(self.bn2(out)))
+        return concat([x, out], axis=1)
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, growth_rate=32, bn_size=4,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        block_cfg = {121: (6, 12, 24, 16), 161: (6, 12, 36, 24),
+                     169: (6, 12, 32, 32), 201: (6, 12, 48, 32),
+                     264: (6, 12, 64, 48)}[layers]
+        num_init = 2 * growth_rate
+        self.stem = Sequential(
+            Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(num_init), ReLU(), MaxPool2D(3, stride=2, padding=1))
+        blocks = []
+        ch = num_init
+        for i, n in enumerate(block_cfg):
+            for _ in range(n):
+                blocks.append(_DenseLayer(ch, growth_rate, bn_size))
+                ch += growth_rate
+            if i != len(block_cfg) - 1:
+                blocks.append(Sequential(
+                    BatchNorm2D(ch), ReLU(),
+                    Conv2D(ch, ch // 2, 1, bias_attr=False),
+                    AvgPool2D(2, stride=2)))
+                ch //= 2
+        self.blocks = Sequential(*blocks)
+        self.bn_last = BatchNorm2D(ch)
+        self.relu = ReLU()
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.fc = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.bn_last(self.blocks(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(_flatten1(x))
+        return x
+
+
+def densenet121(pretrained=False, **kwargs):
+    return DenseNet(121, **kwargs)
+
+
+class _Inception(Layer):
+    def __init__(self, c_in, c1, c3r, c3, c5r, c5, pp):
+        super().__init__()
+        self.b1 = Sequential(Conv2D(c_in, c1, 1), ReLU())
+        self.b2 = Sequential(Conv2D(c_in, c3r, 1), ReLU(),
+                             Conv2D(c3r, c3, 3, padding=1), ReLU())
+        self.b3 = Sequential(Conv2D(c_in, c5r, 1), ReLU(),
+                             Conv2D(c5r, c5, 5, padding=2), ReLU())
+        self.b4 = Sequential(MaxPool2D(3, stride=1, padding=1),
+                             Conv2D(c_in, pp, 1), ReLU())
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                      axis=1)
+
+
+class GoogLeNet(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.stem = Sequential(
+            Conv2D(3, 64, 7, stride=2, padding=3), ReLU(),
+            MaxPool2D(3, stride=2, padding=1),
+            Conv2D(64, 64, 1), ReLU(),
+            Conv2D(64, 192, 3, padding=1), ReLU(),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc3 = Sequential(
+            _Inception(192, 64, 96, 128, 16, 32, 32),
+            _Inception(256, 128, 128, 192, 32, 96, 64),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc4 = Sequential(
+            _Inception(480, 192, 96, 208, 16, 48, 64),
+            _Inception(512, 160, 112, 224, 24, 64, 64),
+            _Inception(512, 128, 128, 256, 24, 64, 64),
+            _Inception(512, 112, 144, 288, 32, 64, 64),
+            _Inception(528, 256, 160, 320, 32, 128, 128),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc5 = Sequential(
+            _Inception(832, 256, 160, 320, 32, 128, 128),
+            _Inception(832, 384, 192, 384, 48, 128, 128))
+        self.with_pool = with_pool
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        self.num_classes = num_classes
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(1024, num_classes)
+
+    def forward(self, x):
+        x = self.inc5(self.inc4(self.inc3(self.stem(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(_flatten1(x)))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    return GoogLeNet(**kwargs)

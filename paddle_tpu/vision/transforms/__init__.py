@@ -1,0 +1,311 @@
+"""Basic vision transforms (python/paddle/vision/transforms parity,
+UNVERIFIED) operating on numpy HWC arrays / Tensors."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = ["Compose", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomCrop", "RandomHorizontalFlip", "Transpose", "to_tensor",
+           "normalize"]
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, x):
+        for t in self.transforms:
+            x = t(x)
+        return x
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = np.asarray(pic, dtype=np.float32)
+    if arr.max() > 1.0:
+        arr = arr / 255.0
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(arr)
+
+
+class ToTensor:
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def __call__(self, pic):
+        return to_tensor(pic, self.data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    if isinstance(img, Tensor):
+        arr = np.asarray(img._data)
+    else:
+        arr = np.asarray(img, dtype=np.float32)
+    mean = np.asarray(mean, dtype=np.float32)
+    std = np.asarray(std, dtype=np.float32)
+    if data_format == "CHW":
+        arr = (arr - mean[:, None, None]) / std[:, None, None]
+    else:
+        arr = (arr - mean) / std
+    return Tensor(arr)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        self.mean = mean if isinstance(mean, (list, tuple)) else [mean] * 3
+        self.std = std if isinstance(std, (list, tuple)) else [std] * 3
+        self.data_format = data_format
+
+    def __call__(self, img):
+        return normalize(img, self.mean, self.std, self.data_format)
+
+
+class Resize:
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        import jax
+        import jax.numpy as jnp
+        arr = img._data if isinstance(img, Tensor) else jnp.asarray(img)
+        hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+        if hwc:
+            out_shape = self.size + (arr.shape[-1],)
+        else:
+            out_shape = arr.shape[:-2] + self.size
+        return Tensor(jax.image.resize(arr, out_shape, "linear"))
+
+
+class CenterCrop:
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def __call__(self, img):
+        arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        h, w = arr.shape[:2] if arr.shape[-1] in (1, 3, 4) else \
+            arr.shape[-2:]
+        th, tw = self.size
+        i, j = (h - th) // 2, (w - tw) // 2
+        if arr.ndim == 3 and arr.shape[-1] in (1, 3, 4):
+            return Tensor(arr[i:i + th, j:j + tw])
+        return Tensor(arr[..., i:i + th, j:j + tw])
+
+
+class RandomCrop:
+    def __init__(self, size, padding=0, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def __call__(self, img):
+        arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        if self.padding:
+            p = self.padding
+            pad = [(p, p), (p, p)] + [(0, 0)] * (arr.ndim - 2)
+            arr = np.pad(arr, pad)
+        h, w = arr.shape[:2]
+        th, tw = self.size
+        i = np.random.randint(0, h - th + 1)
+        j = np.random.randint(0, w - tw + 1)
+        return Tensor(arr[i:i + th, j:j + tw])
+
+
+class RandomHorizontalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        if np.random.rand() < self.prob:
+            arr = arr[:, ::-1].copy()
+        return Tensor(arr)
+
+
+class Transpose:
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def __call__(self, img):
+        arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        return Tensor(arr.transpose(self.order))
+
+
+from . import functional  # noqa: E402
+from . import functional as F  # noqa: E402
+
+__all__ += ["functional", "RandomVerticalFlip", "Pad", "ColorJitter",
+            "Grayscale", "RandomRotation", "RandomResizedCrop",
+            "BrightnessTransform", "ContrastTransform",
+            "SaturationTransform", "HueTransform", "RandomErasing"]
+
+
+class RandomVerticalFlip:
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() < self.prob:
+            return F.vflip(img)
+        return Tensor(np.asarray(img._data)) if isinstance(img, Tensor) \
+            else img
+
+
+class Pad:
+    def __init__(self, padding, fill=0, padding_mode="constant", keys=None):
+        self.padding = padding
+        self.fill = fill
+        self.padding_mode = padding_mode
+
+    def __call__(self, img):
+        return F.pad(img, self.padding, self.fill, self.padding_mode)
+
+
+class BrightnessTransform:
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_brightness(img, factor)
+
+
+class ContrastTransform(BrightnessTransform):
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_contrast(img, factor)
+
+
+class SaturationTransform(BrightnessTransform):
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(max(0, 1 - self.value), 1 + self.value)
+        return F.adjust_saturation(img, factor)
+
+
+class HueTransform:
+    def __init__(self, value, keys=None):
+        self.value = float(value)
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        factor = np.random.uniform(-self.value, self.value)
+        return F.adjust_hue(img, factor)
+
+
+class ColorJitter:
+    """Randomly jitter brightness/contrast/saturation/hue, applied in
+    random order (upstream semantics)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.transforms = [BrightnessTransform(brightness),
+                           ContrastTransform(contrast),
+                           SaturationTransform(saturation),
+                           HueTransform(hue)]
+
+    def __call__(self, img):
+        order = np.random.permutation(len(self.transforms))
+        for i in order:
+            img = self.transforms[i](img)
+        return img
+
+
+class Grayscale:
+    def __init__(self, num_output_channels=1, keys=None):
+        self.num_output_channels = num_output_channels
+
+    def __call__(self, img):
+        return F.to_grayscale(img, self.num_output_channels)
+
+
+class RandomRotation:
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        if isinstance(degrees, (int, float)):
+            degrees = (-abs(degrees), abs(degrees))
+        self.degrees = degrees
+        self.interpolation = interpolation
+        self.expand = expand
+        self.center = center
+        self.fill = fill
+
+    def __call__(self, img):
+        angle = np.random.uniform(*self.degrees)
+        return F.rotate(img, angle, self.interpolation, self.expand,
+                        self.center, self.fill)
+
+
+class RandomResizedCrop:
+    """Crop a random area/aspect-ratio patch and resize it (the Inception
+    training crop)."""
+
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.scale = scale
+        self.ratio = ratio
+        self.interpolation = interpolation
+
+    def __call__(self, img):
+        arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        h, w = arr.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            logr = np.random.uniform(np.log(self.ratio[0]),
+                                     np.log(self.ratio[1]))
+            ar = np.exp(logr)
+            cw = int(round(np.sqrt(target * ar)))
+            ch = int(round(np.sqrt(target / ar)))
+            if 0 < cw <= w and 0 < ch <= h:
+                i = np.random.randint(0, h - ch + 1)
+                j = np.random.randint(0, w - cw + 1)
+                patch = arr[i:i + ch, j:j + cw]
+                break
+        else:  # fallback: center crop to min side
+            s = min(h, w)
+            i, j = (h - s) // 2, (w - s) // 2
+            patch = arr[i:i + s, j:j + s]
+        return Resize(self.size, self.interpolation)(patch)
+
+
+class RandomErasing:
+    """Randomly erase a rectangle (Cutout/RandomErasing regularization)."""
+
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+        self.inplace = inplace
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
+        h, w = (arr.shape[:2] if hwc or arr.ndim == 2 else arr.shape[-2:])
+        area = h * w
+        for _ in range(10):
+            target = area * np.random.uniform(*self.scale)
+            ar = np.exp(np.random.uniform(np.log(self.ratio[0]),
+                                          np.log(self.ratio[1])))
+            eh = int(round(np.sqrt(target / ar)))
+            ew = int(round(np.sqrt(target * ar)))
+            if eh < h and ew < w:
+                i = np.random.randint(0, h - eh + 1)
+                j = np.random.randint(0, w - ew + 1)
+                return F.erase(img, i, j, eh, ew, self.value, self.inplace)
+        return img

@@ -1,0 +1,218 @@
+"""paddle.vision.transforms.functional — functional image ops on numpy HWC
+arrays, PIL images, or Tensors (upstream
+``python/paddle/vision/transforms/functional.py``, UNVERIFIED)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = ["to_tensor", "normalize", "resize", "pad", "crop", "center_crop",
+           "hflip", "vflip", "rotate", "adjust_brightness",
+           "adjust_contrast", "adjust_saturation", "adjust_hue",
+           "to_grayscale", "erase"]
+
+
+def _np(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._data)
+    return np.asarray(img)
+
+
+def _like(arr, img):
+    """Return arr in the caller's preferred container (Tensor in, Tensor
+    out; otherwise numpy)."""
+    if isinstance(img, Tensor):
+        return Tensor(arr)
+    return arr
+
+
+def to_tensor(pic, data_format="CHW"):
+    from . import to_tensor as _tt
+    return _tt(pic, data_format)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    from . import normalize as _n
+    return _n(img, mean, std, data_format, to_rgb)
+
+
+def resize(img, size, interpolation="bilinear"):
+    from . import Resize
+    return Resize(size, interpolation)(img)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _np(img)
+    if isinstance(padding, int):
+        pl = pr = pt = pb = padding
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    spec = [(pt, pb), (pl, pr)] + [(0, 0)] * (arr.ndim - 2)
+    mode = {"constant": "constant", "edge": "edge", "reflect": "reflect",
+            "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return _like(np.pad(arr, spec, mode=mode, **kw), img)
+
+
+def crop(img, top, left, height, width):
+    arr = _np(img)
+    return _like(arr[top:top + height, left:left + width].copy(), img)
+
+
+def center_crop(img, output_size):
+    from . import CenterCrop
+    return CenterCrop(output_size)(img)
+
+
+def hflip(img):
+    arr = _np(img)
+    return _like(arr[:, ::-1].copy(), img)
+
+
+def vflip(img):
+    arr = _np(img)
+    return _like(arr[::-1].copy(), img)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    """Rotate by `angle` degrees counter-clockwise via inverse affine
+    sampling (vectorized gather — no scipy dependency)."""
+    arr = _np(img)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    theta = np.deg2rad(angle)
+    cos, sin = np.cos(theta), np.sin(theta)
+    if expand:
+        corners = np.array([[-cx, -cy], [w - 1 - cx, -cy],
+                            [-cx, h - 1 - cy], [w - 1 - cx, h - 1 - cy]])
+        rot = corners @ np.array([[cos, sin], [-sin, cos]])
+        nw = int(np.ceil(rot[:, 0].max() - rot[:, 0].min() + 1))
+        nh = int(np.ceil(rot[:, 1].max() - rot[:, 1].min() + 1))
+        ocy, ocx = (nh - 1) / 2.0, (nw - 1) / 2.0
+    else:
+        nh, nw, ocy, ocx = h, w, cy, cx
+    yy, xx = np.meshgrid(np.arange(nh, dtype=np.float64),
+                         np.arange(nw, dtype=np.float64), indexing="ij")
+    # inverse map: output pixel -> source pixel (rotate by -angle)
+    dx, dy = xx - ocx, yy - ocy
+    sx = cos * dx - sin * dy + cx
+    sy = sin * dx + cos * dy + cy
+    if interpolation == "bilinear":
+        x0 = np.floor(sx).astype(int)
+        y0 = np.floor(sy).astype(int)
+        wx, wy = sx - x0, sy - y0
+
+        def g(yi, xi):
+            valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            yi, xi = np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)
+            v = arr[yi, xi].astype(np.float64)
+            if arr.ndim == 3:
+                valid = valid[..., None]
+            return np.where(valid, v, float(fill))
+
+        wyx = ((1 - wy) * (1 - wx), (1 - wy) * wx, wy * (1 - wx), wy * wx)
+        if arr.ndim == 3:
+            wyx = tuple(w_[..., None] for w_ in wyx)
+        out = (g(y0, x0) * wyx[0] + g(y0, x0 + 1) * wyx[1]
+               + g(y0 + 1, x0) * wyx[2] + g(y0 + 1, x0 + 1) * wyx[3])
+    else:  # nearest
+        yi = np.round(sy).astype(int)
+        xi = np.round(sx).astype(int)
+        valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+        yi, xi = np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)
+        out = arr[yi, xi].astype(np.float64)
+        mask = valid if arr.ndim == 2 else valid[..., None]
+        out = out * mask + fill * (~mask)
+    return _like(out.astype(arr.dtype), img)
+
+
+def adjust_brightness(img, brightness_factor):
+    src = _np(img)
+    hi = 255.0 if src.dtype == np.uint8 else 1.0
+    out = np.clip(src.astype(np.float32) * brightness_factor, 0, hi)
+    return _like(out.astype(src.dtype), img)
+
+
+def adjust_contrast(img, contrast_factor):
+    src = _np(img)
+    hi = 255.0 if src.dtype == np.uint8 else 1.0
+    arr = src.astype(np.float32)
+    mean = _rgb_to_gray(arr).mean()
+    out = np.clip((arr - mean) * contrast_factor + mean, 0, hi)
+    return _like(out.astype(src.dtype), img)
+
+
+def adjust_saturation(img, saturation_factor):
+    src = _np(img)
+    hi = 255.0 if src.dtype == np.uint8 else 1.0
+    arr = src.astype(np.float32)
+    gray = _rgb_to_gray(arr)[..., None]
+    out = np.clip(gray + (arr - gray) * saturation_factor, 0, hi)
+    return _like(out.astype(src.dtype), img)
+
+
+def _rgb_to_gray(arr):
+    if arr.ndim == 2 or arr.shape[-1] == 1:
+        return arr.reshape(arr.shape[:2])
+    return arr[..., 0] * 0.299 + arr[..., 1] * 0.587 + arr[..., 2] * 0.114
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5] revolutions) via RGB→HSV→RGB
+    in numpy."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    src = _np(img)
+    dtype = src.dtype
+    arr = src.astype(np.float32) / (255.0 if dtype == np.uint8 else 1.0)
+    r, g, b = arr[..., 0], arr[..., 1], arr[..., 2]
+    maxc = arr[..., :3].max(-1)
+    minc = arr[..., :3].min(-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    dz = np.maximum(delta, 1e-12)
+    h = np.select(
+        [maxc == r, maxc == g],
+        [((g - b) / dz) % 6.0, (b - r) / dz + 2.0],
+        default=(r - g) / dz + 4.0) / 6.0
+    h = np.where(delta > 0, h, 0.0)
+    h = (h + hue_factor) % 1.0
+    # HSV -> RGB
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * f)
+    t = v * (1.0 - s * (1.0 - f))
+    i = i.astype(int) % 6
+    rgb = np.choose(i[..., None], [
+        np.stack([v, t, p], -1), np.stack([q, v, p], -1),
+        np.stack([p, v, t], -1), np.stack([p, q, v], -1),
+        np.stack([t, p, v], -1), np.stack([v, p, q], -1)])
+    out = rgb * (255.0 if dtype == np.uint8 else 1.0)
+    return _like(out.astype(dtype), img)
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _np(img)
+    gray = _rgb_to_gray(arr.astype(np.float32))
+    out = np.repeat(gray[..., None], num_output_channels, axis=-1)
+    return _like(out.astype(arr.dtype), img)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = _np(img)
+    if not inplace:
+        arr = arr.copy()
+    if arr.ndim == 3 and arr.shape[-1] in (1, 3, 4):  # HWC
+        arr[i:i + h, j:j + w] = v
+    else:  # CHW
+        arr[..., i:i + h, j:j + w] = v
+    return _like(arr, img)

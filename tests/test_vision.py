@@ -1,0 +1,314 @@
+"""Tests for paddle.vision: datasets, transforms, detection ops, model zoo
+(SURVEY.md §2.2 `paddle.vision/text/audio` row; upstream
+``python/paddle/vision/`` — UNVERIFIED reference paths)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets, models, ops, transforms
+
+
+class TestVisionOps:
+    def test_box_iou(self):
+        a = paddle.to_tensor(np.array([[0, 0, 10, 10], [5, 5, 15, 15]],
+                                      dtype="float32"))
+        iou = ops.box_iou(a, a).numpy()
+        np.testing.assert_allclose(np.diag(iou), [1.0, 1.0], atol=1e-6)
+        expected = 25.0 / (100 + 100 - 25)
+        np.testing.assert_allclose(iou[0, 1], expected, atol=1e-6)
+
+    def _nms_ref(self, boxes, scores, thresh):
+        order = np.argsort(-scores)
+        keep = []
+        while order.size:
+            i = order[0]
+            keep.append(i)
+            if order.size == 1:
+                break
+            xx1 = np.maximum(boxes[i, 0], boxes[order[1:], 0])
+            yy1 = np.maximum(boxes[i, 1], boxes[order[1:], 1])
+            xx2 = np.minimum(boxes[i, 2], boxes[order[1:], 2])
+            yy2 = np.minimum(boxes[i, 3], boxes[order[1:], 3])
+            w = np.maximum(0.0, xx2 - xx1)
+            h = np.maximum(0.0, yy2 - yy1)
+            inter = w * h
+            a_i = (boxes[i, 2] - boxes[i, 0]) * (boxes[i, 3] - boxes[i, 1])
+            a_o = (boxes[order[1:], 2] - boxes[order[1:], 0]) * \
+                (boxes[order[1:], 3] - boxes[order[1:], 1])
+            iou = inter / (a_i + a_o - inter)
+            order = order[1:][iou <= thresh]
+        return np.asarray(keep)
+
+    def test_nms_matches_reference(self):
+        rng = np.random.RandomState(0)
+        xy = rng.rand(40, 2) * 50
+        wh = rng.rand(40, 2) * 20 + 1
+        boxes = np.concatenate([xy, xy + wh], -1).astype("float32")
+        scores = rng.rand(40).astype("float32")
+        got = ops.nms(paddle.to_tensor(boxes), 0.4,
+                      paddle.to_tensor(scores)).numpy()
+        ref = self._nms_ref(boxes, scores, 0.4)
+        np.testing.assert_array_equal(got, ref)
+
+    def test_nms_categories(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11]], dtype="float32")
+        scores = np.array([0.9, 0.8], dtype="float32")
+        cats = np.array([0, 1], dtype="int64")
+        # same location, different categories -> both kept
+        got = ops.nms(paddle.to_tensor(boxes), 0.3,
+                      paddle.to_tensor(scores),
+                      category_idxs=paddle.to_tensor(cats),
+                      categories=[0, 1]).numpy()
+        assert len(got) == 2
+        # same category -> one suppressed
+        got2 = ops.nms(paddle.to_tensor(boxes), 0.3,
+                       paddle.to_tensor(scores)).numpy()
+        assert len(got2) == 1
+
+    def test_roi_align_constant_feature(self):
+        feat = paddle.to_tensor(np.full((1, 2, 16, 16), 3.5, "float32"))
+        boxes = paddle.to_tensor(np.array([[2, 2, 10, 10]], "float32"))
+        num = paddle.to_tensor(np.array([1], "int32"))
+        out = ops.roi_align(feat, boxes, num, output_size=4)
+        assert out.shape == [1, 2, 4, 4]
+        np.testing.assert_allclose(out.numpy(), 3.5, atol=1e-5)
+
+    def test_roi_pool_shape_and_max(self):
+        arr = np.zeros((1, 1, 16, 16), "float32")
+        arr[0, 0, 4, 4] = 9.0
+        feat = paddle.to_tensor(arr)
+        boxes = paddle.to_tensor(np.array([[0, 0, 8, 8]], "float32"))
+        num = paddle.to_tensor(np.array([1], "int32"))
+        out = ops.roi_pool(feat, boxes, num, output_size=2).numpy()
+        assert out.shape == (1, 1, 2, 2)
+        assert out.max() > 1.0  # the spike is visible in some bin
+
+    def test_deform_conv_zero_offset_matches_conv(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(1, 3, 8, 8).astype("float32")
+        w = rng.randn(4, 3, 3, 3).astype("float32")
+        off = np.zeros((1, 18, 6, 6), "float32")
+        out = ops.deform_conv2d(paddle.to_tensor(x), paddle.to_tensor(off),
+                                paddle.to_tensor(w)).numpy()
+        ref = paddle.nn.functional.conv2d(
+            paddle.to_tensor(x), paddle.to_tensor(w)).numpy()
+        np.testing.assert_allclose(out, ref, atol=1e-3)
+
+    def test_deform_conv_grad(self):
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(1, 2, 6, 6).astype("float32"))
+        off = paddle.to_tensor(
+            rng.randn(1, 18, 4, 4).astype("float32") * 0.1)
+        w = paddle.to_tensor(rng.randn(2, 2, 3, 3).astype("float32"))
+        x.stop_gradient = False
+        off.stop_gradient = False
+        w.stop_gradient = False
+        out = ops.deform_conv2d(x, off, w).sum()
+        out.backward()
+        for t in (x, off, w):
+            assert t.grad is not None
+            assert np.isfinite(t.grad.numpy()).all()
+
+    def test_yolo_box_shapes(self):
+        rng = np.random.RandomState(0)
+        nc = 5
+        x = paddle.to_tensor(
+            rng.randn(2, 3 * (5 + nc), 4, 4).astype("float32"))
+        img = paddle.to_tensor(np.array([[64, 64], [32, 32]], "int32"))
+        boxes, scores = ops.yolo_box(x, img, anchors=[10, 13, 16, 30, 33, 23],
+                                     class_num=nc, conf_thresh=0.01,
+                                     downsample_ratio=8)
+        assert boxes.shape == [2, 48, 4]
+        assert scores.shape == [2, 48, nc]
+
+    def test_prior_box(self):
+        feat = paddle.to_tensor(np.zeros((1, 8, 4, 4), "float32"))
+        img = paddle.to_tensor(np.zeros((1, 3, 32, 32), "float32"))
+        boxes, var = ops.prior_box(feat, img, min_sizes=[8.0],
+                                   aspect_ratios=[2.0], flip=True, clip=True)
+        assert boxes.shape == [4, 4, 3, 4]
+        b = boxes.numpy()
+        assert (b >= 0).all() and (b <= 1).all()
+
+    def test_box_coder_decode(self):
+        prior = paddle.to_tensor(
+            np.array([[0, 0, 10, 10], [5, 5, 20, 20]], "float32"))
+        deltas = paddle.to_tensor(np.zeros((2, 2, 4), "float32"))
+        out = ops.box_coder(prior, [1.0, 1.0, 1.0, 1.0], deltas,
+                            code_type="decode_center_size", axis=1)
+        # zero deltas -> decoded boxes == the axis-1-broadcast priors
+        np.testing.assert_allclose(
+            out.numpy()[:, 0],
+            np.tile(prior.numpy()[0], (2, 1)), atol=1e-5)
+
+    def test_distribute_fpn_proposals(self):
+        rois = paddle.to_tensor(np.array(
+            [[0, 0, 10, 10], [0, 0, 200, 200], [0, 0, 50, 50]], "float32"))
+        outs, restore, nums = ops.distribute_fpn_proposals(
+            rois, 2, 5, 4, 224)
+        assert len(outs) == 4
+        total = sum(int(n.numpy()[0]) for n in nums)
+        assert total == 3
+        assert sorted(restore.numpy().tolist()) == [0, 1, 2]
+
+
+class TestReviewRegressions:
+    def test_star_import_surface(self):
+        import paddle_tpu.vision as V
+        for name in V.__all__:
+            assert hasattr(V, name), name
+
+    def test_box_coder_encode_list_var(self):
+        prior = paddle.to_tensor(
+            np.array([[0, 0, 10, 10]], "float32"))
+        target = paddle.to_tensor(np.array([[0, 0, 10, 10]], "float32"))
+        out = ops.box_coder(prior, [0.1, 0.1, 0.2, 0.2], target,
+                            code_type="encode_center_size")
+        # identical boxes -> zero deltas (scaled by 1/var stays zero)
+        np.testing.assert_allclose(out.numpy(), 0.0, atol=1e-5)
+
+    def test_yolo_box_iou_aware(self):
+        rng = np.random.RandomState(0)
+        nc, na = 4, 3
+        x = paddle.to_tensor(
+            rng.randn(1, na * (6 + nc), 4, 4).astype("float32"))
+        img = paddle.to_tensor(np.array([[64, 64]], "int32"))
+        boxes, scores = ops.yolo_box(
+            x, img, anchors=[10, 13, 16, 30, 33, 23], class_num=nc,
+            conf_thresh=0.01, downsample_ratio=8, iou_aware=True,
+            iou_aware_factor=0.5)
+        assert boxes.shape == [1, na * 16, 4]
+        assert np.isfinite(scores.numpy()).all()
+
+    def test_rotate_bilinear_fill(self):
+        img = np.full((9, 9), 100, "uint8")
+        out = np.asarray(transforms.functional.rotate(
+            img, 45, "bilinear", fill=255))
+        assert out[0, 0] == 255  # corner left uncovered gets the fill value
+
+
+class TestTransforms:
+    def test_color_jitter_runs(self):
+        img = (np.random.RandomState(0).rand(16, 16, 3) * 255).astype(
+            "uint8")
+        t = transforms.ColorJitter(0.4, 0.4, 0.4, 0.1)
+        out = t(img)
+        out = np.asarray(out)
+        assert out.shape == (16, 16, 3) and out.dtype == np.uint8
+
+    def test_adjust_hue_identity(self):
+        img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype("uint8")
+        out = np.asarray(transforms.functional.adjust_hue(img, 0.0))
+        np.testing.assert_allclose(out.astype(int), img.astype(int),
+                                   atol=2)
+
+    def test_rotate_90(self):
+        img = np.arange(16, dtype="float32").reshape(4, 4)
+        out = np.asarray(transforms.functional.rotate(img, 90))
+        # 90° CCW: rightmost column becomes top row
+        np.testing.assert_allclose(out, np.rot90(img, k=-1).T[::-1].T.T
+                                   if False else np.rot90(img, 1), atol=1e-4)
+
+    def test_pad_and_crop(self):
+        img = np.ones((4, 4, 3), "float32")
+        out = np.asarray(transforms.functional.pad(img, 2))
+        assert out.shape == (8, 8, 3)
+        c = np.asarray(transforms.functional.crop(out, 2, 2, 4, 4))
+        np.testing.assert_allclose(c, img)
+
+    def test_random_resized_crop(self):
+        img = (np.random.RandomState(0).rand(32, 32, 3) * 255).astype(
+            "uint8")
+        out = transforms.RandomResizedCrop(16)(img)
+        assert tuple(out.shape)[:2] == (16, 16)
+
+    def test_random_erasing(self):
+        img = np.ones((16, 16, 3), "float32")
+        np.random.seed(0)
+        out = np.asarray(transforms.RandomErasing(prob=1.0)(img))
+        assert (out == 0).any()
+
+    def test_grayscale(self):
+        img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype("uint8")
+        out = np.asarray(transforms.Grayscale(3)(img))
+        assert out.shape == (8, 8, 3)
+        np.testing.assert_array_equal(out[..., 0], out[..., 1])
+
+
+class TestDatasets:
+    def test_generated_mnist(self):
+        ds = datasets.MNIST(mode="train", backend="generate")
+        img, label = ds[0]
+        assert img.shape == (28, 28) and 0 <= int(label) < 10
+        assert len(ds) == 2000
+
+    def test_generated_cifar_with_transform(self):
+        t = transforms.Compose([transforms.ToTensor()])
+        ds = datasets.Cifar10(mode="test", backend="generate", transform=t)
+        img, label = ds[0]
+        assert list(img.shape) == [3, 32, 32]
+
+    def test_missing_file_raises(self):
+        with pytest.raises(RuntimeError, match="no network access"):
+            datasets.MNIST(image_path="/nonexistent/mnist.gz")
+
+    def test_dataset_folder(self, tmp_path):
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(3):
+                np.save(d / f"im{i}.npy",
+                        np.zeros((4, 4, 3), dtype="float32"))
+        ds = datasets.DatasetFolder(str(tmp_path))
+        assert ds.classes == ["cat", "dog"]
+        assert len(ds) == 6
+        sample, target = ds[0]
+        assert sample.shape == (4, 4, 3) and target == 0
+
+    def test_image_folder(self, tmp_path):
+        for i in range(2):
+            np.save(tmp_path / f"x{i}.npy", np.ones((2, 2, 3), "float32"))
+        ds = datasets.ImageFolder(str(tmp_path))
+        assert len(ds) == 2
+        assert isinstance(ds[0], list)
+
+    def test_dataloader_over_generated(self):
+        ds = datasets.MNIST(mode="test", backend="generate",
+                            transform=transforms.Compose(
+                                [transforms.ToTensor()]))
+        loader = paddle.io.DataLoader(ds, batch_size=16, shuffle=False)
+        batch = next(iter(loader))
+        imgs, labels = batch
+        assert list(imgs.shape) == [16, 1, 28, 28]
+
+
+class TestModelZoo:
+    @pytest.mark.parametrize("factory", [
+        models.alexnet, models.vgg11, models.mobilenet_v1,
+        models.mobilenet_v2, models.mobilenet_v3_small,
+        models.squeezenet1_1, models.shufflenet_v2_x1_0,
+        models.densenet121, models.googlenet, models.resnext50_32x4d,
+        models.wide_resnet50_2])
+    def test_forward(self, factory):
+        paddle.seed(0)
+        m = factory(num_classes=7)
+        m.eval()
+        size = 96 if factory is models.alexnet else 64
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(1, 3, size, size).astype("float32"))
+        out = m(x)
+        assert out.shape == [1, 7]
+        assert np.isfinite(out.numpy()).all()
+
+    def test_train_step_mobilenet(self):
+        paddle.seed(0)
+        m = models.mobilenet_v2(num_classes=4, scale=0.25)
+        opt = paddle.optimizer.Momentum(0.01, parameters=m.parameters())
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 3, 32, 32).astype("float32"))
+        y = paddle.to_tensor(np.array([1, 3], "int64"))
+        loss = paddle.nn.functional.cross_entropy(m(x), y)
+        loss.backward()
+        opt.step()
+        assert np.isfinite(float(loss.item()))
