@@ -87,6 +87,8 @@ from . import hub  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import onnx  # noqa: F401
 from .hapi import callbacks  # noqa: F401
+from . import geometric  # noqa: F401
+from . import text  # noqa: F401
 from .regularizer import L1Decay, L2Decay  # noqa: F401
 
 

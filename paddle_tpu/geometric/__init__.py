@@ -1,0 +1,165 @@
+"""paddle.geometric — graph-learning message passing ops.
+
+Reference surface: upstream ``python/paddle/geometric/`` (UNVERIFIED; see
+SURVEY.md provenance warning): message_passing (send_u_recv, send_ue_recv,
+send_uv), math (segment_sum/mean/max/min), and graph sampling/reindexing.
+The CUDA scatter kernels become ``jax.ops.segment_*`` (XLA lowers these to
+sorted-scatter, TPU-friendly); sampling — inherently dynamic-shaped — is an
+eager/host path, matching its data-prep role.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply
+from ..ops.common import as_tensor
+
+__all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
+           "segment_mean", "segment_max", "segment_min", "sample_neighbors",
+           "reindex_graph"]
+
+_SEG = {
+    "sum": jax.ops.segment_sum,
+    "mean": None,  # handled explicitly
+    "max": jax.ops.segment_max,
+    "min": jax.ops.segment_min,
+}
+
+
+def _segment_reduce(data, ids, pool_type, num_segments):
+    pool_type = pool_type.lower()
+    if pool_type == "mean":
+        s = jax.ops.segment_sum(data, ids, num_segments)
+        cnt = jax.ops.segment_sum(jnp.ones((data.shape[0],), data.dtype),
+                                  ids, num_segments)
+        return s / jnp.maximum(cnt, 1.0)[(...,) + (None,) * (data.ndim - 1)]
+    out = _SEG[pool_type](data, ids, num_segments)
+    if pool_type in ("max", "min"):
+        # empty segments produce +-inf in jax; paddle semantics: 0
+        out = jnp.where(jnp.isfinite(out), out, jnp.zeros_like(out))
+    return out
+
+
+def _out_size(out_size, dst, x_rows):
+    if out_size is not None:
+        return int(out_size)
+    return x_rows
+
+
+def _make_segment_op(pool_type):
+    def op(data, segment_ids, name=None):
+        d = as_tensor(data)
+        ids = as_tensor(segment_ids)
+        n = int(np.asarray(ids.jax()).max()) + 1 if ids.shape[0] else 0
+        return apply(lambda a, i: _segment_reduce(a, i, pool_type, n),
+                     d, ids, name=f"segment_{pool_type}")
+    op.__name__ = f"segment_{pool_type}"
+    op.__doc__ = (f"Segment {pool_type} over the leading axis "
+                  f"(paddle.geometric.segment_{pool_type}).")
+    return op
+
+
+segment_sum = _make_segment_op("sum")
+segment_mean = _make_segment_op("mean")
+segment_max = _make_segment_op("max")
+segment_min = _make_segment_op("min")
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op="sum", out_size=None,
+                name=None):
+    """Gather x[src] along edges and segment-reduce onto dst
+    (paddle.geometric.send_u_recv)."""
+    xt = as_tensor(x)
+    n = _out_size(out_size, dst_index, int(xt.shape[0]))
+
+    def fn(xa, src, dst):
+        return _segment_reduce(xa[src], dst, reduce_op, n)
+
+    return apply(fn, xt, as_tensor(src_index), as_tensor(dst_index),
+                 name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op="add",
+                 reduce_op="sum", out_size=None, name=None):
+    """Combine node features x[src] with edge features y (add/sub/mul/div),
+    then segment-reduce onto dst."""
+    xt = as_tensor(x)
+    n = _out_size(out_size, dst_index, int(xt.shape[0]))
+    ops_map = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}
+    mop = ops_map[message_op.lower()]
+
+    def fn(xa, ya, src, dst):
+        return _segment_reduce(mop(xa[src], ya), dst, reduce_op, n)
+
+    return apply(fn, xt, as_tensor(y), as_tensor(src_index),
+                 as_tensor(dst_index), name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
+    """Per-edge message from both endpoints: op(x[src], y[dst])."""
+    ops_map = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}
+    mop = ops_map[message_op.lower()]
+
+    def fn(xa, ya, src, dst):
+        return mop(xa[src], ya[dst])
+
+    return apply(fn, as_tensor(x), as_tensor(y), as_tensor(src_index),
+                 as_tensor(dst_index), name="send_uv")
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniformly sample up to sample_size neighbors per input node from a
+    CSC graph (host-side eager op — sampling is data prep, not a compiled
+    kernel)."""
+    rng = np.random.RandomState()
+    row_np = np.asarray(as_tensor(row).numpy())
+    colptr_np = np.asarray(as_tensor(colptr).numpy())
+    nodes = np.asarray(as_tensor(input_nodes).numpy())
+    eids_np = np.asarray(as_tensor(eids).numpy()) if eids is not None \
+        else None
+    out_neigh, out_cnt, out_eids = [], [], []
+    for v in nodes:
+        beg, end = int(colptr_np[v]), int(colptr_np[v + 1])
+        neigh = row_np[beg:end]
+        ids = np.arange(beg, end)
+        if 0 <= sample_size < len(neigh):
+            pick = rng.choice(len(neigh), sample_size, replace=False)
+            neigh, ids = neigh[pick], ids[pick]
+        out_neigh.append(neigh)
+        out_cnt.append(len(neigh))
+        if eids_np is not None:
+            out_eids.append(eids_np[ids])
+    neigh = np.concatenate(out_neigh) if out_neigh else np.zeros(0, "int64")
+    cnt = np.asarray(out_cnt, "int32")
+    res = (Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(cnt)))
+    if return_eids:
+        ei = np.concatenate(out_eids) if out_eids else np.zeros(0, "int64")
+        res += (Tensor(jnp.asarray(ei)),)
+    return res
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Relabel a sampled subgraph to contiguous ids: x first, then new
+    neighbor nodes in first-seen order (host-side eager op)."""
+    x_np = np.asarray(as_tensor(x).numpy())
+    neigh = np.asarray(as_tensor(neighbors).numpy())
+    cnt = np.asarray(as_tensor(count).numpy())
+    mapping: dict[int, int] = {int(v): i for i, v in enumerate(x_np)}
+    for v in neigh:
+        if int(v) not in mapping:
+            mapping[int(v)] = len(mapping)
+    reindex_src = np.asarray([mapping[int(v)] for v in neigh], "int64")
+    # edges are (neighbor -> center); centers repeat per their count
+    reindex_dst = np.repeat(np.arange(len(x_np), dtype="int64"), cnt)
+    nodes = np.asarray(sorted(mapping, key=mapping.get), "int64")
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(nodes)))

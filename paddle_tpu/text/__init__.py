@@ -1,0 +1,247 @@
+"""paddle.text — NLP utilities and datasets.
+
+Reference surface: upstream ``python/paddle/text/`` (UNVERIFIED; see
+SURVEY.md provenance warning): ViterbiDecoder / viterbi_decode plus classic
+datasets (Imdb, Imikolov, UCIHousing, ...). Datasets are cache-only in this
+zero-egress environment with a ``backend='generate'`` synthetic fallback,
+like paddle.vision.datasets here.
+"""
+
+from __future__ import annotations
+
+import os
+import tarfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply
+from ..io import Dataset
+from ..nn.layer.layers import Layer
+from ..ops.common import as_tensor
+from ..utils.download import WEIGHTS_HOME
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb",
+           "Imikolov"]
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag=True, name=None):
+    """Viterbi decoding of a linear-chain CRF (paddle.text.viterbi_decode).
+
+    potentials: [B, T, N] unary emissions; transition_params: [N, N] (or
+    [N+2, N+2] with BOS/EOS rows when include_bos_eos_tag); lengths: [B].
+    Returns (scores [B], paths [B, T]). The DP runs as a ``lax.scan`` over
+    time — one fused compiled loop, argmax backtrace scanned in reverse.
+    """
+    def fn(emit, trans, lens):
+        B, T, N = emit.shape
+        if include_bos_eos_tag:
+            # layout: tags [0..N-3], BOS = N-2, EOS = N-1 of the full
+            # (N x N) transition where emissions cover N tags already
+            # (paddle passes [N+2, N+2] trans with [B, T, N] emissions)
+            n_tags = emit.shape[-1]
+            bos, eos = n_tags, n_tags + 1
+            start = trans[bos, :n_tags][None, :] + emit[:, 0]
+            tr = trans[:n_tags, :n_tags]
+        else:
+            start = emit[:, 0]
+            tr = trans
+        t_steps = jnp.arange(1, T)
+
+        def step(carry, t):
+            alpha = carry  # [B, N]
+            scores = alpha[:, :, None] + tr[None]  # [B, N, N]
+            best_prev = jnp.argmax(scores, axis=1)  # [B, N]
+            best_score = jnp.max(scores, axis=1) + emit[:, t]
+            # positions past the sequence end keep their alpha
+            active = (t < lens)[:, None]
+            alpha_new = jnp.where(active, best_score, alpha)
+            bp = jnp.where(active, best_prev,
+                           jnp.broadcast_to(jnp.arange(alpha.shape[1]),
+                                            best_prev.shape))
+            return alpha_new, bp
+
+        alpha, bps = jax.lax.scan(step, start, t_steps)  # bps [T-1, B, N]
+        if include_bos_eos_tag:
+            n_tags = emit.shape[-1]
+            alpha = alpha + trans[:n_tags, n_tags + 1][None, :]
+        scores = jnp.max(alpha, -1)
+        last_tag = jnp.argmax(alpha, -1)  # [B]
+
+        def back(carry, bp):
+            tag = carry
+            prev = jnp.take_along_axis(bp, tag[:, None], 1)[:, 0]
+            # bp for step t maps tag_t -> tag_{t-1}; emit the predecessor so
+            # the stacked ys are [tag_0 .. tag_{T-2}]
+            return prev, prev
+
+        _, path_prefix = jax.lax.scan(back, last_tag, bps, reverse=True)
+        paths = jnp.concatenate(
+            [path_prefix, last_tag[None]], 0).transpose(1, 0)  # [B, T]
+        return scores, paths.astype(jnp.int64)
+
+    return apply(fn, as_tensor(potentials), as_tensor(transition_params),
+                 as_tensor(lengths), n_outputs=2, name="viterbi_decode",
+                 differentiable=False)
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper holding the transition matrix
+    (paddle.text.ViterbiDecoder)."""
+
+    def __init__(self, transitions, include_bos_eos_tag=True, name=None):
+        super().__init__()
+        self.transitions = as_tensor(transitions)
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+def _missing(name, path):
+    raise RuntimeError(
+        f"{name}: data file {path!r} not found and this environment has no "
+        f"network access. Place the file there (or under {WEIGHTS_HOME}), "
+        f"or pass backend='generate' for a synthetic offline split.")
+
+
+class UCIHousing(Dataset):
+    """Boston housing regression dataset (13 features -> price)."""
+
+    def __init__(self, data_file=None, mode="train", download=True,
+                 backend=None):
+        assert mode in ("train", "test")
+        if backend == "generate":
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 400 if mode == "train" else 100
+            x = rng.rand(n, 13).astype("float32")
+            w = np.linspace(-1, 1, 13).astype("float32")
+            y = (x @ w + 0.1 * rng.randn(n)).astype("float32")[:, None]
+            self.data = [(x[i], y[i]) for i in range(n)]
+            return
+        data_file = data_file or os.path.join(WEIGHTS_HOME, "housing.data")
+        if not os.path.exists(data_file):
+            _missing("UCIHousing", data_file)
+        raw = np.loadtxt(data_file).astype("float32")
+        split = int(len(raw) * 0.8)
+        part = raw[:split] if mode == "train" else raw[split:]
+        feats = (part[:, :13] - raw[:, :13].mean(0)) / \
+            (raw[:, :13].std(0) + 1e-8)
+        self.data = [(feats[i], part[i, 13:14]) for i in range(len(part))]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class Imdb(Dataset):
+    """IMDB movie-review sentiment dataset (aclImdb tar archive)."""
+
+    def __init__(self, data_file=None, mode="train", cutoff=150,
+                 download=True, backend=None):
+        assert mode in ("train", "test")
+        if backend == "generate":
+            rng = np.random.RandomState(2 if mode == "train" else 3)
+            n = 500 if mode == "train" else 100
+            vocab = 200
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+            docs, labels = [], []
+            for i in range(n):
+                label = rng.randint(0, 2)
+                # class-dependent token distribution so models can learn
+                lo, hi = (0, vocab // 2) if label == 0 else (vocab // 2,
+                                                             vocab)
+                docs.append(rng.randint(lo, hi,
+                                        rng.randint(5, 40)).astype("int64"))
+                labels.append(label)
+            self.docs, self.labels = docs, np.asarray(labels, "int64")
+            return
+        data_file = data_file or os.path.join(WEIGHTS_HOME,
+                                              "aclImdb_v1.tar.gz")
+        if not os.path.exists(data_file):
+            _missing("Imdb", data_file)
+        import re
+        pat = re.compile(rf"(?:\./)?aclImdb/{mode}/(pos|neg)/.*\.txt$")
+        freq: dict[str, int] = {}
+        texts, labels = [], []
+        with tarfile.open(data_file, "r:*") as tar:
+            for m in tar.getmembers():
+                match = pat.match(m.name)
+                if not match:
+                    continue
+                body = tar.extractfile(m).read().decode(
+                    "utf-8", errors="ignore").lower()
+                toks = body.split()
+                texts.append(toks)
+                labels.append(1 if match.group(1) == "pos" else 0)
+                for t in toks:
+                    freq[t] = freq.get(t, 0) + 1
+        words = sorted((w for w, c in freq.items() if c >= cutoff),
+                       key=lambda w: -freq[w])
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        oov = len(self.word_idx)
+        self.docs = [np.asarray([self.word_idx.get(t, oov) for t in toks],
+                                "int64") for toks in texts]
+        self.labels = np.asarray(labels, "int64")
+
+    def __len__(self):
+        return len(self.docs)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+
+class Imikolov(Dataset):
+    """PTB language-model n-gram dataset (imikolov simple-examples)."""
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, download=True,
+                 backend=None):
+        assert mode in ("train", "test")
+        if backend == "generate":
+            rng = np.random.RandomState(4 if mode == "train" else 5)
+            n, vocab = 1000, 100
+            self.word_idx = {f"w{i}": i for i in range(vocab)}
+            stream = rng.randint(0, vocab, n + window_size)
+            self.grams = [stream[i:i + window_size].astype("int64")
+                          for i in range(n)]
+            return
+        data_file = data_file or os.path.join(WEIGHTS_HOME,
+                                              "simple-examples.tgz")
+        if not os.path.exists(data_file):
+            _missing("Imikolov", data_file)
+        member = f"./simple-examples/data/ptb.{mode}.txt"
+        with tarfile.open(data_file, "r:*") as tar:
+            names = tar.getnames()
+            name = member if member in names else member.lstrip("./")
+            text = tar.extractfile(name).read().decode("utf-8")
+        freq: dict[str, int] = {}
+        sents = []
+        for line in text.strip().split("\n"):
+            toks = ["<s>"] + line.split() + ["<e>"]
+            sents.append(toks)
+            for t in toks:
+                freq[t] = freq.get(t, 0) + 1
+        words = sorted((w for w, c in freq.items()
+                        if c >= min_word_freq or w in ("<s>", "<e>")),
+                       key=lambda w: -freq[w])
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        unk = len(self.word_idx)
+        self.grams = []
+        for toks in sents:
+            ids = [self.word_idx.get(t, unk) for t in toks]
+            for i in range(len(ids) - window_size + 1):
+                self.grams.append(np.asarray(ids[i:i + window_size],
+                                             "int64"))
+
+    def __len__(self):
+        return len(self.grams)
+
+    def __getitem__(self, idx):
+        g = self.grams[idx]
+        return tuple(g[:-1]), g[-1]

@@ -23,8 +23,11 @@ class Compose:
 
 
 def to_tensor(pic, data_format="CHW"):
-    arr = np.asarray(pic, dtype=np.float32)
-    if arr.max() > 1.0:
+    src = np.asarray(pic)
+    arr = src.astype(np.float32)
+    # integer images scale to [0, 1] by dtype (not by content — a dark
+    # uint8 image must scale the same as a bright one)
+    if np.issubdtype(src.dtype, np.integer):
         arr = arr / 255.0
     if arr.ndim == 2:
         arr = arr[:, :, None]
@@ -66,9 +69,15 @@ class Normalize:
         return normalize(img, self.mean, self.std, self.data_format)
 
 
+_RESIZE_METHODS = {"nearest": "nearest", "bilinear": "linear",
+                   "linear": "linear", "bicubic": "cubic", "cubic": "cubic",
+                   "lanczos": "lanczos3", "area": "linear"}
+
+
 class Resize:
     def __init__(self, size, interpolation="bilinear", keys=None):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.method = _RESIZE_METHODS[interpolation]
 
     def __call__(self, img):
         import jax
@@ -79,7 +88,10 @@ class Resize:
             out_shape = self.size + (arr.shape[-1],)
         else:
             out_shape = arr.shape[:-2] + self.size
-        return Tensor(jax.image.resize(arr, out_shape, "linear"))
+        out = jax.image.resize(arr.astype(jnp.float32)
+                               if self.method != "nearest" else arr,
+                               out_shape, self.method)
+        return Tensor(out.astype(arr.dtype))
 
 
 class CenterCrop:
@@ -104,15 +116,21 @@ class RandomCrop:
 
     def __call__(self, img):
         arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        hwc = arr.ndim != 3 or arr.shape[-1] in (1, 3, 4)
         if self.padding:
             p = self.padding
-            pad = [(p, p), (p, p)] + [(0, 0)] * (arr.ndim - 2)
+            if hwc:
+                pad = [(p, p), (p, p)] + [(0, 0)] * (arr.ndim - 2)
+            else:
+                pad = [(0, 0)] * (arr.ndim - 2) + [(p, p), (p, p)]
             arr = np.pad(arr, pad)
-        h, w = arr.shape[:2]
+        h, w = arr.shape[:2] if hwc else arr.shape[-2:]
         th, tw = self.size
         i = np.random.randint(0, h - th + 1)
         j = np.random.randint(0, w - tw + 1)
-        return Tensor(arr[i:i + th, j:j + tw])
+        if hwc:
+            return Tensor(arr[i:i + th, j:j + tw])
+        return Tensor(arr[..., i:i + th, j:j + tw])
 
 
 class RandomHorizontalFlip:

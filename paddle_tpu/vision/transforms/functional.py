@@ -209,7 +209,10 @@ def to_grayscale(img, num_output_channels=1):
 
 def erase(img, i, j, h, w, v, inplace=False):
     arr = _np(img)
-    if not inplace:
+    # Tensor data is an immutable jax buffer — _np() is a read-only view,
+    # so a copy is required even for inplace=True (the returned Tensor is
+    # the mutation)
+    if not inplace or isinstance(img, Tensor):
         arr = arr.copy()
     if arr.ndim == 3 and arr.shape[-1] in (1, 3, 4):  # HWC
         arr[i:i + h, j:j + w] = v

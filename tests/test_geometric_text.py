@@ -1,0 +1,164 @@
+"""Tests for paddle.geometric (message passing / segment ops) and
+paddle.text (viterbi, datasets) — SURVEY.md §2.2 coverage rows; upstream
+``python/paddle/geometric/`` and ``python/paddle/text/`` (UNVERIFIED)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import geometric, text
+
+
+class TestSegmentOps:
+    def setup_method(self, _):
+        self.data = paddle.to_tensor(
+            np.array([[1., 2.], [3., 4.], [5., 6.], [7., 8.]], "float32"))
+        self.ids = paddle.to_tensor(np.array([0, 0, 1, 1], "int64"))
+
+    def test_sum(self):
+        out = geometric.segment_sum(self.data, self.ids).numpy()
+        np.testing.assert_allclose(out, [[4., 6.], [12., 14.]])
+
+    def test_mean(self):
+        out = geometric.segment_mean(self.data, self.ids).numpy()
+        np.testing.assert_allclose(out, [[2., 3.], [6., 7.]])
+
+    def test_max_min(self):
+        mx = geometric.segment_max(self.data, self.ids).numpy()
+        mn = geometric.segment_min(self.data, self.ids).numpy()
+        np.testing.assert_allclose(mx, [[3., 4.], [7., 8.]])
+        np.testing.assert_allclose(mn, [[1., 2.], [5., 6.]])
+
+    def test_empty_segment_is_zero(self):
+        ids = paddle.to_tensor(np.array([0, 0, 2, 2], "int64"))
+        out = geometric.segment_max(self.data, ids).numpy()
+        np.testing.assert_allclose(out[1], [0., 0.])
+
+
+class TestMessagePassing:
+    def test_send_u_recv_sum(self):
+        x = paddle.to_tensor(np.array([[1.], [2.], [4.]], "float32"))
+        src = paddle.to_tensor(np.array([0, 1, 2], "int64"))
+        dst = paddle.to_tensor(np.array([1, 2, 1], "int64"))
+        out = geometric.send_u_recv(x, src, dst, reduce_op="sum").numpy()
+        np.testing.assert_allclose(out, [[0.], [5.], [2.]])
+
+    def test_send_u_recv_mean_grad(self):
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 3).astype("float32"))
+        x.stop_gradient = False
+        src = paddle.to_tensor(np.array([0, 1, 2, 3], "int64"))
+        dst = paddle.to_tensor(np.array([0, 0, 1, 1], "int64"))
+        out = geometric.send_u_recv(x, src, dst, reduce_op="mean").sum()
+        out.backward()
+        np.testing.assert_allclose(x.grad.numpy(),
+                                   np.full((4, 3), 0.5), atol=1e-6)
+
+    def test_send_ue_recv(self):
+        x = paddle.to_tensor(np.array([[1.], [2.]], "float32"))
+        e = paddle.to_tensor(np.array([[10.], [20.]], "float32"))
+        src = paddle.to_tensor(np.array([0, 1], "int64"))
+        dst = paddle.to_tensor(np.array([1, 0], "int64"))
+        out = geometric.send_ue_recv(x, e, src, dst, "add", "sum").numpy()
+        np.testing.assert_allclose(out, [[22.], [11.]])
+
+    def test_send_uv(self):
+        x = paddle.to_tensor(np.array([[1.], [2.]], "float32"))
+        y = paddle.to_tensor(np.array([[5.], [7.]], "float32"))
+        src = paddle.to_tensor(np.array([0, 1], "int64"))
+        dst = paddle.to_tensor(np.array([1, 0], "int64"))
+        out = geometric.send_uv(x, y, src, dst, "mul").numpy()
+        np.testing.assert_allclose(out, [[7.], [10.]])
+
+    def test_sample_and_reindex(self):
+        # CSC graph: node 0 <- {1, 2}, node 1 <- {2}, node 2 <- {}
+        row = paddle.to_tensor(np.array([1, 2, 2], "int64"))
+        colptr = paddle.to_tensor(np.array([0, 2, 3, 3], "int64"))
+        nodes = paddle.to_tensor(np.array([0, 1], "int64"))
+        neigh, cnt = geometric.sample_neighbors(row, colptr, nodes)
+        assert cnt.numpy().tolist() == [2, 1]
+        assert sorted(neigh.numpy().tolist()[:2]) == [1, 2]
+        rsrc, rdst, out_nodes = geometric.reindex_graph(nodes, neigh, cnt)
+        assert out_nodes.numpy()[0] == 0 and out_nodes.numpy()[1] == 1
+        assert rdst.numpy().tolist() == [0, 0, 1]
+        assert rsrc.numpy().max() < len(out_nodes.numpy())
+
+
+class TestViterbi:
+    def _brute_force(self, emit, trans, length):
+        # enumerate all tag sequences for one batch item
+        import itertools
+        N = emit.shape[-1]
+        best, best_path = -np.inf, None
+        for path in itertools.product(range(N), repeat=length):
+            s = emit[0, path[0]]
+            for t in range(1, length):
+                s += trans[path[t - 1], path[t]] + emit[t, path[t]]
+            if s > best:
+                best, best_path = s, path
+        return best, list(best_path)
+
+    @pytest.mark.parametrize("seed", [0, 1, 2, 3, 7])
+    def test_matches_brute_force(self, seed):
+        rng = np.random.RandomState(seed)
+        B, T, N = 2, 4, 3
+        emit = (rng.randn(B, T, N) * 3).astype("float32")
+        trans = (rng.randn(N, N) * 3).astype("float32")
+        lens = np.array([4, 4], "int64")
+        scores, paths = text.viterbi_decode(
+            paddle.to_tensor(emit), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False)
+        for b in range(B):
+            ref_s, ref_p = self._brute_force(emit[b], trans, T)
+            np.testing.assert_allclose(float(scores.numpy()[b]), ref_s,
+                                       rtol=1e-5)
+            assert paths.numpy()[b].tolist() == ref_p
+
+    def test_alternating_path(self):
+        # non-constant optimum: emissions force 0,1,0
+        emit = np.array([[[5., 0.], [0., 5.], [5., 0.]]], "float32")
+        trans = np.zeros((2, 2), "float32")
+        lens = np.array([3], "int64")
+        _, paths = text.viterbi_decode(
+            paddle.to_tensor(emit), paddle.to_tensor(trans),
+            paddle.to_tensor(lens), include_bos_eos_tag=False)
+        assert paths.numpy()[0].tolist() == [0, 1, 0]
+
+    def test_decoder_layer_with_bos_eos(self):
+        rng = np.random.RandomState(1)
+        B, T, N = 2, 5, 4
+        emit = paddle.to_tensor(rng.randn(B, T, N).astype("float32"))
+        trans = paddle.to_tensor(rng.randn(N + 2, N + 2).astype("float32"))
+        lens = paddle.to_tensor(np.array([5, 5], "int64"))
+        dec = text.ViterbiDecoder(trans, include_bos_eos_tag=True)
+        scores, paths = dec(emit, lens)
+        assert paths.shape == [B, T]
+        assert (paths.numpy() < N).all()
+
+
+class TestTextDatasets:
+    def test_uci_housing_generated(self):
+        ds = text.UCIHousing(mode="train", backend="generate")
+        x, y = ds[0]
+        assert x.shape == (13,) and y.shape == (1,)
+        assert len(ds) == 400
+
+    def test_imdb_generated_learnable(self):
+        ds = text.Imdb(mode="train", backend="generate")
+        doc, label = ds[0]
+        assert doc.dtype == np.int64 and label in (0, 1)
+        # class-dependent vocab halves: verify signal exists
+        lo = [d.mean() for d, l in (ds[i] for i in range(100)) if l == 0]
+        hi = [d.mean() for d, l in (ds[i] for i in range(100)) if l == 1]
+        assert np.mean(lo) < np.mean(hi)
+
+    def test_imikolov_generated(self):
+        ds = text.Imikolov(mode="test", backend="generate", window_size=5)
+        ctx, target = ds[0]
+        assert len(ctx) == 4
+        assert isinstance(target, np.int64) or np.issubdtype(
+            type(target), np.integer)
+
+    def test_missing_file_raises(self):
+        with pytest.raises(RuntimeError, match="no network access"):
+            text.UCIHousing(data_file="/nonexistent/housing.data")

@@ -229,6 +229,31 @@ class TestTransforms:
         out = np.asarray(transforms.RandomErasing(prob=1.0)(img))
         assert (out == 0).any()
 
+    def test_resize_nearest_preserves_labels(self):
+        mask = np.zeros((4, 4, 1), "uint8")
+        mask[2:, 2:] = 1
+        out = np.asarray(transforms.Resize(8, "nearest")(mask))
+        assert set(np.unique(out)) <= {0, 1}
+        assert out.dtype == np.uint8
+
+    def test_to_tensor_dtype_based_scaling(self):
+        dark = np.ones((4, 4, 3), "uint8")  # max==1 but still uint8
+        out = transforms.to_tensor(dark).numpy()
+        np.testing.assert_allclose(out, 1.0 / 255.0, atol=1e-6)
+        fl = np.full((4, 4, 3), 2.0, "float32")  # float >1 stays as-is
+        out2 = transforms.to_tensor(fl).numpy()
+        np.testing.assert_allclose(out2, 2.0)
+
+    def test_random_crop_chw(self):
+        chw = paddle.to_tensor(np.zeros((3, 16, 16), "float32"))
+        out = transforms.RandomCrop(8)(chw)
+        assert list(out.shape) == [3, 8, 8]
+
+    def test_erase_inplace_tensor(self):
+        t = paddle.to_tensor(np.zeros((4, 4, 3), "float32"))
+        out = transforms.functional.erase(t, 0, 0, 2, 2, 1.0, inplace=True)
+        assert np.asarray(out.numpy())[0, 0, 0] == 1.0
+
     def test_grayscale(self):
         img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype("uint8")
         out = np.asarray(transforms.Grayscale(3)(img))
