@@ -28,6 +28,9 @@ _SEG = {
     "min": jax.ops.segment_min,
 }
 
+_MESSAGE_OPS = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+                "div": jnp.divide}
+
 
 def _segment_reduce(data, ids, pool_type, num_segments):
     pool_type = pool_type.lower()
@@ -88,9 +91,7 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
     then segment-reduce onto dst."""
     xt = as_tensor(x)
     n = _out_size(out_size, dst_index, int(xt.shape[0]))
-    ops_map = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
-               "div": jnp.divide}
-    mop = ops_map[message_op.lower()]
+    mop = _MESSAGE_OPS[message_op.lower()]
 
     def fn(xa, ya, src, dst):
         return _segment_reduce(mop(xa[src], ya), dst, reduce_op, n)
@@ -101,9 +102,7 @@ def send_ue_recv(x, y, src_index, dst_index, message_op="add",
 
 def send_uv(x, y, src_index, dst_index, message_op="add", name=None):
     """Per-edge message from both endpoints: op(x[src], y[dst])."""
-    ops_map = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
-               "div": jnp.divide}
-    mop = ops_map[message_op.lower()]
+    mop = _MESSAGE_OPS[message_op.lower()]
 
     def fn(xa, ya, src, dst):
         return mop(xa[src], ya[dst])
@@ -117,8 +116,10 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
                      name=None):
     """Uniformly sample up to sample_size neighbors per input node from a
     CSC graph (host-side eager op — sampling is data prep, not a compiled
-    kernel)."""
-    rng = np.random.RandomState()
+    kernel). Reproducible under ``paddle.seed`` via the framework RNG."""
+    from ..framework import random as framework_random
+    sub = np.asarray(framework_random.next_key())
+    rng = np.random.RandomState(int(sub[-1]) & 0x7FFFFFFF)
     row_np = np.asarray(as_tensor(row).numpy())
     colptr_np = np.asarray(as_tensor(colptr).numpy())
     nodes = np.asarray(as_tensor(input_nodes).numpy())

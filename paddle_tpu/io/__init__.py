@@ -8,6 +8,7 @@ background prefetch thread pool rather than fork-based workers."""
 
 from __future__ import annotations
 
+import collections
 import itertools
 import math
 import queue
@@ -342,7 +343,14 @@ class DataLoader:
         if self.num_workers <= 0:
             yield from self._iter_batches()
             return
-        # background prefetch: producer thread pool feeding a queue
+        if self._iterable:
+            yield from self._iter_prefetch_single()
+            return
+        yield from self._iter_pool()
+
+    def _iter_prefetch_single(self):
+        """IterableDataset path: one background producer thread (the stream
+        itself is sequential), bounded prefetch queue."""
         q: queue.Queue = queue.Queue(
             maxsize=self.num_workers * self.prefetch_factor)
         stop = object()
@@ -366,3 +374,42 @@ class DataLoader:
                 break
             yield b
         t.join()
+
+    def _iter_pool(self):
+        """Map-style path: num_workers threads load batches concurrently
+        (numpy/PIL/IO release the GIL), results yielded strictly in
+        batch-sampler order with a bounded in-flight window."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        window = max(self.num_workers * self.prefetch_factor, 1)
+
+        def init_worker(wid=[0]):
+            with self._pool_lock:
+                my_id = wid[0]
+                wid[0] += 1
+            _worker_info.info = _WorkerInfo(my_id, self.num_workers,
+                                            self.dataset)
+            if self.worker_init_fn is not None:
+                self.worker_init_fn(my_id)
+
+        def load(indices):
+            if getattr(_worker_info, "info", None) is None:
+                init_worker()
+            return self.collate_fn([self.dataset[i] for i in indices])
+
+        self._pool_lock = threading.Lock()
+        with ThreadPoolExecutor(max_workers=self.num_workers) as pool:
+            futures = collections.deque()
+            it = iter(self.batch_sampler)
+            try:
+                for _ in range(window):
+                    futures.append(pool.submit(load, next(it)))
+            except StopIteration:
+                it = None
+            while futures:
+                yield futures.popleft().result()
+                if it is not None:
+                    try:
+                        futures.append(pool.submit(load, next(it)))
+                    except StopIteration:
+                        it = None

@@ -1,0 +1,197 @@
+"""paddle.nn.utils — parameter utilities (upstream
+``python/paddle/nn/utils/``, UNVERIFIED; see SURVEY.md provenance warning):
+weight_norm / remove_weight_norm, spectral_norm, parameters_to_vector /
+vector_to_parameters, clip_grad_norm_ / clip_grad_value_.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Parameter, Tensor, apply
+from ...optimizer.clip import clip_grad_norm_  # noqa: F401
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters",
+           "clip_grad_norm_", "clip_grad_value_"]
+
+
+def clip_grad_value_(parameters, clip_value):
+    """Clamp every grad into [-clip_value, clip_value] in place."""
+    if isinstance(parameters, Tensor):
+        parameters = [parameters]
+    clip_value = float(clip_value)
+    for p in parameters:
+        if p.grad is not None:
+            p.grad.set_data(jnp.clip(p.grad._data, -clip_value, clip_value))
+
+
+def parameters_to_vector(parameters, name=None):
+    """Flatten parameters into one 1-D tensor (differentiable concat)."""
+    params = list(parameters)
+    from ...ops.manipulation import concat, reshape
+    return concat([reshape(p, [-1]) for p in params], axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    """Scatter a flat vector back into the parameter list (in place)."""
+    params = list(parameters)
+    arr = vec._data if isinstance(vec, Tensor) else jnp.asarray(vec)
+    sizes = []
+    for p in params:
+        n = 1
+        for s in p.shape:
+            n *= int(s)
+        sizes.append(n)
+    total = sum(sizes)
+    if total != arr.shape[0]:
+        # validate BEFORE mutating: a partial scatter would corrupt params
+        raise ValueError(
+            f"vector has {arr.shape[0]} elements but parameters need "
+            f"{total}")
+    offset = 0
+    for p, n in zip(params, sizes):
+        chunk = arr[offset:offset + n].reshape(tuple(int(s)
+                                                     for s in p.shape))
+        p.set_data(chunk.astype(p._data.dtype))
+        offset += n
+
+
+def _norm_except_dim(v, dim):
+    """||v|| reduced over every axis except `dim` (paddle weight_norm
+    semantics; dim=None or -1 -> single global norm)."""
+    if dim is None or dim == -1:
+        return jnp.sqrt(jnp.sum(jnp.square(v)))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    shape = [1] * v.ndim
+    shape[dim] = v.shape[dim]
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes)).reshape(shape)
+
+
+class _WeightNormHook:
+    """Forward-pre-hook recomputing ``name = g * v / ||v||`` from the
+    ``name_g`` / ``name_v`` parameters each call, so autograd flows into
+    g and v (the tape records the reparameterization ops)."""
+
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def compute(self, layer):
+        g = getattr(layer, self.name + "_g")
+        v = getattr(layer, self.name + "_v")
+        dim = self.dim
+
+        def fn(ga, va):
+            return ga * va / jnp.maximum(_norm_except_dim(va, dim), 1e-12)
+
+        w = apply(fn, g, v, name="weight_norm")
+        object.__setattr__(layer, self.name, w)
+
+    def __call__(self, layer, inputs):
+        self.compute(layer)
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    """Apply weight normalization to a layer parameter
+    (paddle.nn.utils.weight_norm): replaces ``name`` with ``name_g``
+    (magnitude) and ``name_v`` (direction)."""
+    if hasattr(layer, "_weight_norm_hooks") and \
+            name in layer._weight_norm_hooks:
+        raise RuntimeError(f"weight_norm already applied to {name!r}")
+    w = getattr(layer, name)
+    if not isinstance(w, Parameter):
+        raise ValueError(f"{name!r} is not a Parameter of {type(layer)}")
+    wd = w._data
+    g0 = _norm_except_dim(wd, dim)
+    v0 = wd
+    del layer._parameters[name]
+    setattr(layer, name + "_g", Parameter(g0, name=(w.name or name) + "_g"))
+    setattr(layer, name + "_v", Parameter(v0, name=(w.name or name) + "_v"))
+    hook = _WeightNormHook(name, dim)
+    handle = layer.register_forward_pre_hook(hook)
+    if not hasattr(layer, "_weight_norm_hooks"):
+        object.__setattr__(layer, "_weight_norm_hooks", {})
+    layer._weight_norm_hooks[name] = (hook, handle)
+    hook.compute(layer)  # materialize `name` for immediate use
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    """Undo weight_norm: fold g*v/||v|| back into a single parameter."""
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    if name not in hooks:
+        raise ValueError(f"no weight_norm on parameter {name!r}")
+    hook, handle = hooks.pop(name)
+    handle.remove()
+    g = getattr(layer, name + "_g")
+    v = getattr(layer, name + "_v")
+    w = g._data * v._data / jnp.maximum(
+        _norm_except_dim(v._data, hook.dim), 1e-12)
+    del layer._parameters[name + "_g"]
+    del layer._parameters[name + "_v"]
+    if name in layer.__dict__:
+        object.__delattr__(layer, name)
+    setattr(layer, name, Parameter(w, name=name))
+    return layer
+
+
+def spectral_norm(layer, name="weight", n_power_iterations=1, eps=1e-12,
+                  dim=None):
+    """Apply spectral normalization (power iteration) to a layer parameter
+    — divides the weight by its largest singular value each forward."""
+    w = getattr(layer, name)
+    if not isinstance(w, Parameter):
+        raise ValueError(f"{name!r} is not a Parameter of {type(layer)}")
+    if dim is None:
+        dim = 0
+    wd = w._data
+    mat = jnp.moveaxis(wd, dim, 0).reshape(wd.shape[dim], -1)
+    import numpy as _np
+    rng = _np.random.RandomState(0)
+    u0 = jnp.asarray(rng.randn(mat.shape[0]).astype(_np.float32))
+    u0 = u0 / jnp.maximum(jnp.linalg.norm(u0), eps)
+
+    state = {"u": u0}
+
+    def power_iter(m, u):
+        v = None
+        for _ in range(n_power_iterations):
+            v = m.T @ u
+            v = v / jnp.maximum(jnp.linalg.norm(v), eps)
+            u = m @ v
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        return u, v
+
+    def hook(lyr, inputs):
+        wp = getattr(lyr, name + "_orig")
+        u_in = state["u"]
+
+        def fn(wa):
+            m = jnp.moveaxis(wa, dim, 0).reshape(wa.shape[dim], -1)
+            u, v = power_iter(m, jax.lax.stop_gradient(u_in))
+            sigma = u @ (m @ v)
+            return wa / sigma
+
+        wn = apply(fn, wp, name="spectral_norm")
+        # persist the power-iteration vector across forwards (torch/paddle
+        # semantics: sigma converges over calls even with 1 iteration).
+        # Only outside a trace — a tracer leaking into `state` would poison
+        # later compiled calls.
+        from ...framework.core import trace_clean
+        if trace_clean():
+            m = jnp.moveaxis(wp._data, dim, 0).reshape(wp._data.shape[dim],
+                                                       -1)
+            u_new, _ = power_iter(m, u_in)
+            state["u"] = u_new
+        object.__setattr__(lyr, name, wn)
+        return None
+
+    del layer._parameters[name]
+    setattr(layer, name + "_orig", Parameter(wd, name=(w.name or name)
+                                             + "_orig"))
+    layer.register_forward_pre_hook(hook)
+    hook(layer, ())
+    return layer

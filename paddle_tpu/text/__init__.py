@@ -30,24 +30,27 @@ def viterbi_decode(potentials, transition_params, lengths,
                    include_bos_eos_tag=True, name=None):
     """Viterbi decoding of a linear-chain CRF (paddle.text.viterbi_decode).
 
-    potentials: [B, T, N] unary emissions; transition_params: [N, N] (or
-    [N+2, N+2] with BOS/EOS rows when include_bos_eos_tag); lengths: [B].
+    potentials: [B, T, N] unary emissions; transition_params: [N, N];
+    lengths: [B]. With ``include_bos_eos_tag`` the LAST TWO of the N tags
+    are the BOS/EOS tags (upstream paddle convention): the start scores add
+    ``trans[N-2, :]`` and the final scores add ``trans[:, N-1]``.
     Returns (scores [B], paths [B, T]). The DP runs as a ``lax.scan`` over
     time — one fused compiled loop, argmax backtrace scanned in reverse.
     """
     def fn(emit, trans, lens):
         B, T, N = emit.shape
+        if trans.shape[-1] != N:
+            raise ValueError(
+                f"transition_params must be [{N}, {N}] to match the "
+                f"emission tag count, got {tuple(trans.shape)}; with "
+                "include_bos_eos_tag the BOS/EOS tags are the last two of "
+                "the N tags, not extra rows")
+        tr = trans
         if include_bos_eos_tag:
-            # layout: tags [0..N-3], BOS = N-2, EOS = N-1 of the full
-            # (N x N) transition where emissions cover N tags already
-            # (paddle passes [N+2, N+2] trans with [B, T, N] emissions)
-            n_tags = emit.shape[-1]
-            bos, eos = n_tags, n_tags + 1
-            start = trans[bos, :n_tags][None, :] + emit[:, 0]
-            tr = trans[:n_tags, :n_tags]
+            bos, eos = N - 2, N - 1
+            start = trans[bos, :][None, :] + emit[:, 0]
         else:
             start = emit[:, 0]
-            tr = trans
         t_steps = jnp.arange(1, T)
 
         def step(carry, t):
@@ -65,8 +68,7 @@ def viterbi_decode(potentials, transition_params, lengths,
 
         alpha, bps = jax.lax.scan(step, start, t_steps)  # bps [T-1, B, N]
         if include_bos_eos_tag:
-            n_tags = emit.shape[-1]
-            alpha = alpha + trans[:n_tags, n_tags + 1][None, :]
+            alpha = alpha + trans[:, eos][None, :]
         scores = jnp.max(alpha, -1)
         last_tag = jnp.argmax(alpha, -1)  # [B]
 

@@ -25,10 +25,10 @@ class Compose:
 def to_tensor(pic, data_format="CHW"):
     src = np.asarray(pic)
     arr = src.astype(np.float32)
-    # integer images scale to [0, 1] by dtype (not by content — a dark
-    # uint8 image must scale the same as a bright one)
+    # scale to [0, 1] by dtype range (not by content — a dark image must
+    # scale the same as a bright one); floats pass through unscaled
     if np.issubdtype(src.dtype, np.integer):
-        arr = arr / 255.0
+        arr = arr / float(np.iinfo(src.dtype).max)
     if arr.ndim == 2:
         arr = arr[:, :, None]
     if data_format == "CHW":
@@ -88,9 +88,12 @@ class Resize:
             out_shape = self.size + (arr.shape[-1],)
         else:
             out_shape = arr.shape[:-2] + self.size
-        out = jax.image.resize(arr.astype(jnp.float32)
-                               if self.method != "nearest" else arr,
-                               out_shape, self.method)
+        if self.method == "nearest":
+            return Tensor(jax.image.resize(arr, out_shape, "nearest"))
+        out = jax.image.resize(arr.astype(jnp.float32), out_shape,
+                               self.method)
+        if jnp.issubdtype(arr.dtype, jnp.integer):
+            out = jnp.round(out)  # truncation would bias pixels downward
         return Tensor(out.astype(arr.dtype))
 
 

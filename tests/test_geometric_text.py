@@ -70,6 +70,17 @@ class TestMessagePassing:
         out = geometric.send_uv(x, y, src, dst, "mul").numpy()
         np.testing.assert_allclose(out, [[7.], [10.]])
 
+    def test_sample_neighbors_reproducible_under_seed(self):
+        row = paddle.to_tensor(np.arange(100, dtype="int64"))
+        colptr = paddle.to_tensor(
+            np.array([0, 50, 100], dtype="int64"))
+        nodes = paddle.to_tensor(np.array([0, 1], "int64"))
+        paddle.seed(7)
+        a, _ = geometric.sample_neighbors(row, colptr, nodes, sample_size=5)
+        paddle.seed(7)
+        b, _ = geometric.sample_neighbors(row, colptr, nodes, sample_size=5)
+        np.testing.assert_array_equal(a.numpy(), b.numpy())
+
     def test_sample_and_reindex(self):
         # CSC graph: node 0 <- {1, 2}, node 1 <- {2}, node 2 <- {}
         row = paddle.to_tensor(np.array([1, 2, 2], "int64"))
@@ -125,15 +136,24 @@ class TestViterbi:
         assert paths.numpy()[0].tolist() == [0, 1, 0]
 
     def test_decoder_layer_with_bos_eos(self):
+        # paddle convention: trans is [N, N] and the last two of the N tags
+        # are BOS/EOS
         rng = np.random.RandomState(1)
-        B, T, N = 2, 5, 4
+        B, T, N = 2, 5, 6
         emit = paddle.to_tensor(rng.randn(B, T, N).astype("float32"))
-        trans = paddle.to_tensor(rng.randn(N + 2, N + 2).astype("float32"))
+        trans = paddle.to_tensor(rng.randn(N, N).astype("float32"))
         lens = paddle.to_tensor(np.array([5, 5], "int64"))
         dec = text.ViterbiDecoder(trans, include_bos_eos_tag=True)
         scores, paths = dec(emit, lens)
         assert paths.shape == [B, T]
         assert (paths.numpy() < N).all()
+
+    def test_mismatched_transition_shape_raises(self):
+        emit = paddle.to_tensor(np.zeros((1, 3, 4), "float32"))
+        trans = paddle.to_tensor(np.zeros((6, 6), "float32"))
+        lens = paddle.to_tensor(np.array([3], "int64"))
+        with pytest.raises(ValueError, match="transition_params"):
+            text.viterbi_decode(emit, trans, lens)
 
 
 class TestTextDatasets:
