@@ -94,8 +94,13 @@ define_flag("FLAGS_tpu_matmul_precision", "default",
             "Matmul precision: default|high|highest (maps to jax precision).")
 define_flag("FLAGS_enable_pallas_kernels", True,
             "Use Pallas kernels (flash-attn, rms_norm, rope) when on TPU.")
-define_flag("FLAGS_flash_attn_block_q", 128, "Pallas flash-attn q block.")
-define_flag("FLAGS_flash_attn_block_kv", 128, "Pallas flash-attn kv block.")
+# 512/512 measured best on v5e for the Llama bench shapes (69.9% MFU vs
+# 54.2% at 128/128); both kernels clamp to the padded sequence length
+define_flag("FLAGS_flash_attn_block_q", 512, "Pallas flash-attn q block.")
+define_flag("FLAGS_flash_attn_block_kv", 512, "Pallas flash-attn kv block.")
+define_flag("FLAGS_flash_attn_pallas_bwd", True,
+            "Flash-attn backward via the hand-written Pallas dkv/dq "
+            "kernels (False = blockwise lax.scan recompute fallback).")
 define_flag("FLAGS_use_pallas_paged_attention", 1,
             "Serving decode: use the Pallas paged-attention kernel on "
             "TPU (0 = jnp gather/softmax reference path).")

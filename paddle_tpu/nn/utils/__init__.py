@@ -10,21 +10,12 @@ import jax
 import jax.numpy as jnp
 
 from ...framework.core import Parameter, Tensor, apply
-from ...optimizer.clip import clip_grad_norm_  # noqa: F401
+from ...optimizer.clip import (clip_grad_norm_,  # noqa: F401
+                               clip_grad_value_)
 
 __all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
            "parameters_to_vector", "vector_to_parameters",
            "clip_grad_norm_", "clip_grad_value_"]
-
-
-def clip_grad_value_(parameters, clip_value):
-    """Clamp every grad into [-clip_value, clip_value] in place."""
-    if isinstance(parameters, Tensor):
-        parameters = [parameters]
-    clip_value = float(clip_value)
-    for p in parameters:
-        if p.grad is not None:
-            p.grad.set_data(jnp.clip(p.grad._data, -clip_value, clip_value))
 
 
 def parameters_to_vector(parameters, name=None):

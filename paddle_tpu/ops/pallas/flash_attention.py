@@ -167,7 +167,224 @@ def _fwd_rule(q, k, v, causal, scale):
     return out, (q, k, v, out, lse)
 
 
+def _dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, causal, block_q, block_k,
+                seq_len_q, seq_len_k):
+    """One program owns one [block_k, d] kv block; loops over q blocks.
+    Matmuls keep bf16 operands with fp32 accumulation (MXU-native)."""
+    ki = pl.program_id(1)
+    causal_offset = seq_len_k - seq_len_q
+    k = k_ref[:]   # [block_k, d] input dtype
+    v = v_ref[:]
+    d = k_ref.shape[-1]
+
+    def body(qi, carry):
+        dk_acc, dv_acc = carry
+        q = q_ref[pl.dslice(qi * block_q, block_q), slice(None)]
+        g = g_ref[pl.dslice(qi * block_q, block_q), slice(None)]
+        # lse/delta ride a lane-broadcast [sq_p, 128] layout (the fwd lse
+        # convention — TPU tiling wants 128-lane tiles; reshaping across
+        # lanes is an unsupported Mosaic shape cast, so read one column)
+        lse = lse_ref[pl.dslice(qi * block_q, block_q), 0:1]
+        delta = delta_ref[pl.dslice(qi * block_q, block_q), 0:1]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = (q_pos < seq_len_q) & (k_pos < seq_len_k)
+        if causal:
+            valid = valid & (q_pos + causal_offset >= k_pos)
+        s = jnp.where(valid, s, _NEG_INF)
+        # fully-masked rows have lse ~= -1e30, so exp(s - lse) would be 1
+        # for masked entries — mask p explicitly, don't rely on s - lse
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        pb = p.astype(k.dtype)
+        # dv += p^T @ g ; dp = g @ v^T ; ds = p*(dp-delta)*scale
+        dv_acc = dv_acc + jax.lax.dot_general(
+            pb, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(k.dtype)
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_acc, dv_acc
+
+    n_q_blocks = -(-seq_len_q // block_q)
+    if causal:
+        # first q block whose last row can see this kv block
+        first = (ki * block_k - causal_offset) // block_q
+        q_start = jnp.clip(first, 0, n_q_blocks)
+    else:
+        q_start = 0
+    acc0 = (jnp.zeros((block_k, d), jnp.float32),
+            jnp.zeros((block_k, d), jnp.float32))
+    dk, dv = jax.lax.fori_loop(q_start, n_q_blocks, body, acc0)
+    dk_ref[:] = dk.astype(dk_ref.dtype)
+    dv_ref[:] = dv.astype(dv_ref.dtype)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, causal, block_q, block_k, seq_len_q, seq_len_k):
+    """One program owns one [block_q, d] q block; loops over kv blocks."""
+    qi = pl.program_id(1)
+    causal_offset = seq_len_k - seq_len_q
+    q = q_ref[:]
+    g = g_ref[:]
+    lse = lse_ref[:, 0:1]       # [block_q, 1] from the lane-broadcast tile
+    delta = delta_ref[:, 0:1]
+    d = q_ref.shape[-1]
+
+    def body(ki, dq_acc):
+        k = k_ref[pl.dslice(ki * block_k, block_k), slice(None)]
+        v = v_ref[pl.dslice(ki * block_k, block_k), slice(None)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1)
+        valid = k_pos < seq_len_k
+        if causal:
+            valid = valid & (q_pos + causal_offset >= k_pos)
+        s = jnp.where(valid, s, _NEG_INF)
+        # explicit mask: see _dkv_kernel (fully-masked rows break s - lse)
+        p = jnp.where(valid, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(g, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta) * scale).astype(q.dtype)
+        dq_acc = dq_acc + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dq_acc
+
+    n_k_blocks = -(-seq_len_k // block_k)
+    if causal:
+        last_visible = (qi + 1) * block_q + causal_offset
+        nk = (last_visible + (block_k - 1)) // block_k
+        num_k = jnp.minimum(jnp.maximum(nk, 0), n_k_blocks)
+    else:
+        num_k = n_k_blocks
+    dq = jax.lax.fori_loop(0, num_k, body,
+                           jnp.zeros((block_q, d), jnp.float32))
+    dq_ref[:] = dq.astype(dq_ref.dtype)
+
+
+def _flash_bwd_pallas(q, k_full, v_full, out, lse, g, causal, s):
+    """Pallas backward: dkv kernel (grid over kv blocks) + dq kernel (grid
+    over q blocks). All operands bf16 on the MXU, fp32 accumulators."""
+    from ...framework import flags
+    b, sq, h, d = q.shape
+    sk = k_full.shape[1]
+    # both block dims round up to 128 multiples: q blocks because the
+    # lse/delta side inputs ride 128-lane tiles, kv blocks because the
+    # dkv grid is sk_p/block_k programs and a non-divisor block would
+    # leave trailing kv rows with no program (uninitialized dk/dv)
+    block_q = min(_round_up(int(flags.flag("FLAGS_flash_attn_block_q")),
+                            128), _round_up(sq, 128))
+    block_k = min(_round_up(int(flags.flag("FLAGS_flash_attn_block_kv")),
+                            128), _round_up(sk, 128))
+    sq_p = _round_up(sq, block_q)
+    sk_p = _round_up(sk, block_k)
+    bh = b * h
+
+    def to_bh(x, s_len, s_pad):
+        x = x.transpose(0, 2, 1, 3).reshape(bh, s_len, x.shape[-1])
+        if s_pad != s_len:
+            x = jnp.pad(x, ((0, 0), (0, s_pad - s_len), (0, 0)))
+        return x
+
+    qh = to_bh(q, sq, sq_p)
+    kh = to_bh(k_full, sk, sk_p)
+    vh = to_bh(v_full, sk, sk_p)
+    gh = to_bh(g.astype(q.dtype), sq, sq_p)
+    oh = to_bh(out, sq, sq_p)
+    # delta = rowsum(g * out) in fp32; lse arrives as [bh, sq]
+    delta = jnp.sum(gh.astype(jnp.float32) * oh.astype(jnp.float32), -1)
+    lse_p = lse if lse.shape[1] == sq_p else jnp.pad(
+        lse, ((0, 0), (0, sq_p - sq)))
+    # lane-broadcast the per-row stats to 128-lane tiles (fwd lse
+    # convention; Mosaic can't reshape across lanes)
+    lse_p = jnp.broadcast_to(lse_p[..., None], (bh, sq_p, 128))
+    delta = jnp.broadcast_to(delta[..., None], (bh, sq_p, 128))
+
+    kw = dict(scale=s, causal=causal, block_q=block_q, block_k=block_k,
+              seq_len_q=sq, seq_len_k=sk)
+    with _no_x64():
+        dk, dv = pl.pallas_call(
+            functools.partial(_dkv_kernel, **kw),
+            grid=(bh, sk_p // block_k),
+            in_specs=[
+                pl.BlockSpec((None, sq_p, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, sq_p, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, sq_p, 128), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, sq_p, 128), lambda i, j: (i, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, block_k, d), lambda i, j: (i, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sk_p, d), k_full.dtype),
+                jax.ShapeDtypeStruct((bh, sk_p, d), v_full.dtype),
+            ],
+            interpret=_interpret(),
+        )(qh, kh, vh, gh, lse_p, delta)
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, **kw),
+            grid=(bh, sq_p // block_q),
+            in_specs=[
+                pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, sk_p, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, sk_p, d), lambda i, j: (i, 0, 0)),
+                pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, block_q, 128),
+                             lambda i, j: (i, j, 0)),
+                pl.BlockSpec((None, block_q, 128),
+                             lambda i, j: (i, j, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((None, block_q, d), lambda i, j: (i, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((bh, sq_p, d), q.dtype),
+            ],
+            interpret=_interpret(),
+        )(qh, kh, vh, gh, lse_p, delta)[0]
+    dq4 = dq[:, :sq].reshape(b, h, sq, d).transpose(0, 2, 1, 3)
+    dk4 = dk[:, :sk].reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    dv4 = dv[:, :sk].reshape(b, h, sk, d).transpose(0, 2, 1, 3)
+    return dq4, dk4, dv4
+
+
 def _bwd_rule(causal, scale, res, g):
+    q, k, v, out, lse = res
+    from ...framework import flags
+    if flags.flag("FLAGS_flash_attn_pallas_bwd"):
+        b, sq, h, d = q.shape
+        hk = k.shape[2]
+        rep = h // hk
+        k_full = jnp.repeat(k, rep, axis=2) if rep != 1 else k
+        v_full = jnp.repeat(v, rep, axis=2) if rep != 1 else v
+        s = scale if scale is not None else 1.0 / math.sqrt(d)
+        dq4, dk4, dv4 = _flash_bwd_pallas(q, k_full, v_full, out,
+                                          lse.reshape(b * h, sq), g,
+                                          causal, s)
+        if rep != 1:
+            sk = k.shape[1]
+            dk4 = dk4.reshape(b, sk, hk, rep, d).sum(3)
+            dv4 = dv4.reshape(b, sk, hk, rep, d).sum(3)
+        return (dq4.astype(q.dtype), dk4.astype(k.dtype),
+                dv4.astype(v.dtype))
+    return _bwd_rule_scan(causal, scale, res, g)
+
+
+def _bwd_rule_scan(causal, scale, res, g):
     """Blockwise recompute backward (fp32 accumulation, O(S·D) memory)."""
     q, k, v, out, lse = res
     b, sq, h, d = q.shape
@@ -212,7 +429,10 @@ def _bwd_rule(causal, scale, res, g):
             # bottom-right aligned, matching the forward kernel
             valid = valid & (q_pos + (sk - sq) >= k_pos)
         logits = jnp.where(valid[None, None], logits, _NEG_INF)
-        p = jnp.exp(logits - lse_h[..., None])  # [B,H,Sq,block]
+        # explicit mask: fully-masked rows have lse ~= -1e30 and would
+        # otherwise yield p = exp(0) = 1 on masked entries
+        p = jnp.where(valid[None, None],
+                      jnp.exp(logits - lse_h[..., None]), 0.0)
         dv_i = jnp.einsum("bhqk,bhqd->bhkd", p, gh)
         dp = jnp.einsum("bhqd,bhkd->bhqk", gh, vs)
         ds = p * (dp - delta[..., None]) * s

@@ -80,3 +80,74 @@ def test_rms_norm_kernel_fwd_bwd():
     for a, b in zip(g, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-4)
+
+
+@pytest.mark.parametrize("sq,sk,causal,hq,hk", [
+    (128, 128, True, 4, 4), (128, 128, False, 4, 4),
+    (64, 256, True, 4, 2),        # GQA + cross lengths
+    (129, 129, True, 2, 2),       # pad+mask path
+    (127, 255, False, 4, 1),      # MQA, ragged
+])
+def test_flash_bwd_pallas_matches_reference(sq, sk, causal, hq, hk):
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(2, sq, hq, 32).astype("float32"))
+    k = jnp.asarray(rng.randn(2, sk, hk, 32).astype("float32"))
+    v = jnp.asarray(rng.randn(2, sk, hk, 32).astype("float32"))
+
+    def f_kernel(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, causal, None) ** 2)
+
+    def f_ref(q, k, v):
+        kf = jnp.repeat(k, hq // hk, axis=2)
+        vf = jnp.repeat(v, hq // hk, axis=2)
+        return jnp.sum(
+            flash_attention_reference(q, kf, vf, causal=causal) ** 2)
+
+    gk = jax.grad(f_kernel, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gk, gr, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
+def test_flash_bwd_fully_masked_rows_zero_grad():
+    # sq > sk causal (bottom-right aligned): q rows 0..sk-1-offset see no
+    # keys at all. Their output is identically 0, so gradients through
+    # them must be exactly 0 — a naive p = exp(s - lse) gives p = 1 on
+    # masked entries because lse is itself -1e30 for those rows.
+    rng = np.random.RandomState(5)
+    sq, sk = 256, 128
+    q = jnp.asarray(rng.randn(1, sq, 2, 32).astype("float32"))
+    k = jnp.asarray(rng.randn(1, sk, 2, 32).astype("float32"))
+    v = jnp.asarray(rng.randn(1, sk, 2, 32).astype("float32"))
+    n_masked = sq - sk  # rows with zero visible keys
+
+    def loss(q, k, v):
+        out = flash_attention(q, k, v, True, None)
+        return jnp.sum(out[:, :n_masked])  # reads only masked rows
+
+    gq, gk, gv = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    np.testing.assert_allclose(np.asarray(gq), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gk), 0.0, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(gv), 0.0, atol=1e-6)
+
+
+def test_flash_bwd_pallas_matches_scan_fallback():
+    from paddle_tpu.framework import flags
+    rng = np.random.RandomState(4)
+    q = jnp.asarray(rng.randn(1, 96, 4, 32).astype("float32"))
+    k = jnp.asarray(rng.randn(1, 96, 2, 32).astype("float32"))
+    v = jnp.asarray(rng.randn(1, 96, 2, 32).astype("float32"))
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, None) ** 2)
+
+    g_pallas = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    flags.set_flags({"FLAGS_flash_attn_pallas_bwd": False})
+    try:
+        g_scan = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+    finally:
+        flags.set_flags({"FLAGS_flash_attn_pallas_bwd": True})
+    for a, b, name in zip(g_pallas, g_scan, "qkv"):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
