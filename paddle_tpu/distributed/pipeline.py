@@ -92,7 +92,8 @@ def pipeline_spmd(stage_fn, stage_params, x_micro, axis_name,
         return stage_fn(p, x)
 
     if remat == "stage":
-        one_stage = jax.checkpoint(one_stage)
+        from ..incubate.recompute import checkpoint_with_policy
+        one_stage = checkpoint_with_policy(one_stage)
 
     perm = [(i, (i + 1) % S) for i in range(S)]
     T = M + S - 1
@@ -150,7 +151,8 @@ def _pipeline_interleaved(stage_fn, stage_params, x_micro, axis_name,
         return stage_fn(p, x)
 
     if remat == "stage":
-        one_chunk = jax.checkpoint(one_chunk)
+        from ..incubate.recompute import checkpoint_with_policy
+        one_chunk = checkpoint_with_policy(one_chunk)
 
     perm = [(i, (i + 1) % S) for i in range(S)]
     ring = S * V

@@ -98,6 +98,10 @@ define_flag("FLAGS_enable_pallas_kernels", True,
 # 54.2% at 128/128); both kernels clamp to the padded sequence length
 define_flag("FLAGS_flash_attn_block_q", 512, "Pallas flash-attn q block.")
 define_flag("FLAGS_flash_attn_block_kv", 512, "Pallas flash-attn kv block.")
+define_flag("FLAGS_recompute_policy", "dots_saveable",
+            "jax.checkpoint policy for recompute()/use_recompute: "
+            "dots_saveable (default) | nothing_saveable | "
+            "dots_with_no_batch_dims_saveable | everything_saveable.")
 define_flag("FLAGS_flash_attn_pallas_bwd", True,
             "Flash-attn backward via the hand-written Pallas dkv/dq "
             "kernels (False = blockwise lax.scan recompute fallback).")

@@ -93,7 +93,8 @@ def scan_layers(layers, x, extra_inputs=(), remat=False):
                     p._data = a
 
         if remat:
-            body = jax.checkpoint(body)
+            from ..incubate.recompute import checkpoint_with_policy
+            body = checkpoint_with_policy(body)
         out, _ = lax.scan(body, h, stacked)
         return out
 

@@ -26,14 +26,13 @@ def to_tensor(pic, data_format="CHW"):
     src = np.asarray(pic)
     arr = src.astype(np.float32)
     # scale to [0, 1] by dtype (not by content — a dark image must scale
-    # the same as a bright one). uint8/uint16 divide by their range;
-    # wider int dtypes (e.g. PIL mode 'I' int32) conventionally hold
-    # 0-255 content, so they scale by 255 like upstream. Floats pass
-    # through unscaled.
-    if src.dtype == np.uint16:
-        arr = arr / 65535.0
-    elif np.issubdtype(src.dtype, np.integer):
+    # the same as a bright one). Only uint8/uint16 have an unambiguous
+    # pixel range; wider int dtypes (e.g. PIL mode 'I') pass through
+    # unscaled, matching upstream/torchvision.
+    if src.dtype == np.uint8:
         arr = arr / 255.0
+    elif src.dtype == np.uint16:
+        arr = arr / 65535.0
     if arr.ndim == 2:
         arr = arr[:, :, None]
     if data_format == "CHW":
