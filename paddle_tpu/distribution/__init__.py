@@ -58,6 +58,16 @@ class Normal(Distribution):
         z = jax.random.normal(_key(), shape)
         return Tensor(self.loc._data + self.scale._data * z)
 
+    def rsample(self, shape=()):
+        """Reparameterized sample: gradients flow to loc/scale (the tape
+        records loc + scale * eps via ``apply``)."""
+        from ..framework.core import apply
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.loc._data.shape, self.scale._data.shape))
+        z = jax.random.normal(_key(), shape)
+        return apply(lambda l, s: l + s * z, self.loc, self.scale,
+                     name="normal_rsample")
+
     def log_prob(self, value):
         v = as_tensor(value)._data
         var = jnp.square(self.scale._data)
@@ -303,7 +313,323 @@ class Poisson(Distribution):
 
 
 def kl_divergence(p, q):
+    # explicit registrations (register_kl) first, walking the MROs the way
+    # upstream's dispatch does; then the distribution's own method
+    for tp in type(p).__mro__:
+        for tq in type(q).__mro__:
+            fn = _KL_REGISTRY.get((tp, tq))
+            if fn is not None:
+                return fn(p, q)
     if hasattr(p, "kl_divergence"):
         return p.kl_divergence(q)
     raise NotImplementedError(
         f"kl_divergence({type(p).__name__}, {type(q).__name__})")
+
+
+from . import transform  # noqa: E402
+from .transform import (Transform, AffineTransform, ExpTransform,  # noqa
+                        PowerTransform, SigmoidTransform, TanhTransform,
+                        AbsTransform, SoftmaxTransform, ChainTransform,
+                        IndependentTransform, ReshapeTransform,
+                        StickBreakingTransform)
+
+__all__ += ["Geometric", "Cauchy", "Chi2", "StudentT", "Binomial",
+            "ContinuousBernoulli", "MultivariateNormal", "Independent",
+            "TransformedDistribution", "register_kl", "transform",
+            "Transform", "AffineTransform", "ExpTransform",
+            "PowerTransform", "SigmoidTransform", "TanhTransform",
+            "AbsTransform", "SoftmaxTransform", "ChainTransform",
+            "IndependentTransform", "ReshapeTransform",
+            "StickBreakingTransform"]
+
+
+class Geometric(Distribution):
+    """Number of failures before the first success, supported on 0, 1, ...
+    (upstream paddle.distribution.Geometric convention)."""
+
+    def __init__(self, probs):
+        self.probs = as_tensor(probs, "float32")
+
+    @property
+    def mean(self):
+        p = self.probs._data
+        return Tensor((1.0 - p) / p)
+
+    @property
+    def variance(self):
+        p = self.probs._data
+        return Tensor((1.0 - p) / jnp.square(p))
+
+    def sample(self, shape=()):
+        p = self.probs._data
+        shape = tuple(shape) + p.shape
+        u = jax.random.uniform(_key(), shape, minval=1e-7, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-p)))
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        p = self.probs._data
+        return Tensor(v * jnp.log1p(-p) + jnp.log(p))
+
+    def entropy(self):
+        p = self.probs._data
+        q = 1.0 - p
+        return Tensor(-(q * jnp.log(q) + p * jnp.log(p)) / p)
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = as_tensor(loc, "float32")
+        self.scale = as_tensor(scale, "float32")
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            self.loc._data.shape, self.scale._data.shape))
+        z = jax.random.cauchy(_key(), shape)
+        return Tensor(self.loc._data + self.scale._data * z)
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        z = (v - self.loc._data) / self.scale._data
+        return Tensor(-math.log(math.pi) - jnp.log(self.scale._data)
+                      - jnp.log1p(jnp.square(z)))
+
+    def entropy(self):
+        return Tensor(math.log(4 * math.pi) + jnp.log(self.scale._data))
+
+    def cdf(self, value):
+        v = as_tensor(value)._data
+        z = (v - self.loc._data) / self.scale._data
+        return Tensor(jnp.arctan(z) / math.pi + 0.5)
+
+
+class Chi2(Distribution):
+    """Chi-squared with ``df`` degrees of freedom (Gamma(df/2, rate=1/2))."""
+
+    def __init__(self, df):
+        self.df = as_tensor(df, "float32")
+
+    @property
+    def mean(self):
+        return self.df
+
+    @property
+    def variance(self):
+        return Tensor(2.0 * self.df._data)
+
+    def sample(self, shape=()):
+        k = self.df._data / 2.0
+        shape = tuple(shape) + k.shape
+        g = jax.random.gamma(_key(), k, shape)
+        return Tensor(2.0 * g)
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        k = self.df._data / 2.0
+        return Tensor((k - 1.0) * jnp.log(v) - v / 2.0
+                      - k * math.log(2.0)
+                      - jax.scipy.special.gammaln(k))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0):
+        self.df = as_tensor(df, "float32")
+        self.loc = as_tensor(loc, "float32")
+        self.scale = as_tensor(scale, "float32")
+
+    def sample(self, shape=()):
+        df = self.df._data
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(
+            df.shape, self.loc._data.shape, self.scale._data.shape))
+        z = jax.random.t(_key(), df, shape)
+        return Tensor(self.loc._data + self.scale._data * z)
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        df = self.df._data
+        z = (v - self.loc._data) / self.scale._data
+        ln = jax.scipy.special.gammaln
+        return Tensor(ln((df + 1) / 2) - ln(df / 2)
+                      - 0.5 * jnp.log(df * math.pi)
+                      - jnp.log(self.scale._data)
+                      - (df + 1) / 2 * jnp.log1p(jnp.square(z) / df))
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = as_tensor(total_count, "float32")
+        self.probs = as_tensor(probs, "float32")
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count._data * self.probs._data)
+
+    @property
+    def variance(self):
+        p = self.probs._data
+        return Tensor(self.total_count._data * p * (1 - p))
+
+    def sample(self, shape=()):
+        n = self.total_count._data
+        p = self.probs._data
+        shape = tuple(shape) + tuple(jnp.broadcast_shapes(n.shape, p.shape))
+        return Tensor(jax.random.binomial(_key(), n, p, shape=shape))
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        n = self.total_count._data
+        p = self.probs._data
+        ln = jax.scipy.special.gammaln
+        comb = ln(n + 1) - ln(v + 1) - ln(n - v + 1)
+        return Tensor(comb + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+
+class ContinuousBernoulli(Distribution):
+    """Continuous relaxation of Bernoulli on [0, 1] (Loaiza-Ganem &
+    Cunningham 2019)."""
+
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs = as_tensor(probs, "float32")
+        self._lims = lims
+
+    def _log_norm(self):
+        p = self.probs._data
+        # C(p) = 2*atanh(1-2p) / (1-2p), with the p ~ 0.5 limit -> 2
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near, 0.25, p)
+        x = 1.0 - 2.0 * safe
+        log_c = jnp.log(2.0 * jnp.arctanh(x) / x)
+        # taylor around p=0.5: log 2 + 4/3 eps^2, eps = p - 0.5
+        eps = p - 0.5
+        taylor = math.log(2.0) + (4.0 / 3.0) * jnp.square(eps)
+        return jnp.where(near, taylor, log_c)
+
+    def sample(self, shape=()):
+        p = self.probs._data
+        shape = tuple(shape) + p.shape
+        u = jax.random.uniform(_key(), shape, minval=1e-6, maxval=1 - 1e-6)
+        # inverse cdf: [log1p(-p + u(2p-1)) - log1p(-p)] /
+        #              [log(p) - log1p(-p)]; near p=0.5 the cdf is ~ u
+        near = (p > self._lims[0]) & (p < self._lims[1])
+        safe = jnp.where(near, 0.25, p)
+        icdf = (jnp.log1p(-safe + u * (2.0 * safe - 1.0))
+                - jnp.log1p(-safe)) / (jnp.log(safe) - jnp.log1p(-safe))
+        return Tensor(jnp.where(near, u, icdf))
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        p = self.probs._data
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p)
+                      + self._log_norm())
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, scale_tril=None):
+        self.loc = as_tensor(loc, "float32")
+        if scale_tril is not None:
+            self._tril = as_tensor(scale_tril, "float32")._data
+        elif covariance_matrix is not None:
+            cov = as_tensor(covariance_matrix, "float32")._data
+            self._tril = jnp.linalg.cholesky(cov)
+        else:
+            raise ValueError("need covariance_matrix or scale_tril")
+
+    @property
+    def mean(self):
+        return self.loc
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._tril @ jnp.swapaxes(self._tril, -1, -2))
+
+    def sample(self, shape=()):
+        shape = tuple(shape) + self.loc._data.shape
+        z = jax.random.normal(_key(), shape)
+        return Tensor(self.loc._data
+                      + jnp.einsum("...ij,...j->...i", self._tril, z))
+
+    def log_prob(self, value):
+        v = as_tensor(value)._data
+        d = v.shape[-1]
+        diff = v - self.loc._data
+        sol = jax.scipy.linalg.solve_triangular(
+            self._tril, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(jnp.square(sol), -1)
+        logdet = jnp.sum(
+            jnp.log(jnp.diagonal(self._tril, axis1=-2, axis2=-1)), -1)
+        return Tensor(-0.5 * (maha + d * math.log(2 * math.pi)) - logdet)
+
+    def entropy(self):
+        d = self.loc._data.shape[-1]
+        logdet = jnp.sum(
+            jnp.log(jnp.diagonal(self._tril, axis1=-2, axis2=-1)), -1)
+        return Tensor(0.5 * d * (1.0 + math.log(2 * math.pi)) + logdet)
+
+
+class Independent(Distribution):
+    """Reinterpret the last ``reinterpreted_batch_rank`` batch dims of a
+    base distribution as event dims (log_prob sums over them)."""
+
+    def __init__(self, base, reinterpreted_batch_rank=1):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def log_prob(self, value):
+        lp = self.base.log_prob(value)._data
+        return Tensor(jnp.sum(lp, axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        e = self.base.entropy()._data
+        return Tensor(jnp.sum(e, axis=tuple(range(-self.rank, 0))))
+
+
+class TransformedDistribution(Distribution):
+    """base distribution pushed through a chain of transforms."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        if isinstance(transforms, Transform):
+            transforms = [transforms]
+        self.transforms = list(transforms)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = as_tensor(value)
+        xs = [y]
+        for t in reversed(self.transforms):
+            xs.append(t.inverse(xs[-1]))
+        xs = list(reversed(xs))  # xs[0] = base value ... xs[-1] = y
+        lp = self.base.log_prob(xs[0])._data
+        for t, x in zip(self.transforms, xs[:-1]):
+            ld = t.forward_log_det_jacobian(x)._data
+            # reduce event-rank mismatch: sum trailing dims beyond base
+            while ld.ndim > lp.ndim:
+                ld = jnp.sum(ld, -1)
+            lp = lp - ld
+        return Tensor(lp)
+
+
+_KL_REGISTRY: dict = {}
+
+
+def register_kl(type_p, type_q):
+    """Decorator registering an explicit KL(p||q) implementation
+    (paddle.distribution.register_kl)."""
+    def decorator(fn):
+        _KL_REGISTRY[(type_p, type_q)] = fn
+        return fn
+    return decorator

@@ -1,0 +1,278 @@
+"""paddle.distribution.transform — invertible transforms for
+TransformedDistribution (upstream
+``python/paddle/distribution/transform.py``, UNVERIFIED; see SURVEY.md
+provenance warning)."""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..ops.common import as_tensor
+
+__all__ = ["Transform", "AffineTransform", "ExpTransform", "PowerTransform",
+           "SigmoidTransform", "TanhTransform", "AbsTransform",
+           "SoftmaxTransform", "ChainTransform", "IndependentTransform",
+           "ReshapeTransform", "StickBreakingTransform"]
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Transform:
+    """Bijection y = f(x) with log|det J| bookkeeping. The public methods
+    run through ``apply`` so gradients flow (rsample reparameterization
+    through TransformedDistribution stays differentiable)."""
+
+    _event_rank = 0  # rank of the event space the jacobian acts on
+
+    def forward(self, x):
+        from ..framework.core import apply
+        return apply(self._forward, as_tensor(x),
+                     name=type(self).__name__ + ".forward")
+
+    def inverse(self, y):
+        from ..framework.core import apply
+        return apply(self._inverse, as_tensor(y),
+                     name=type(self).__name__ + ".inverse")
+
+    def forward_log_det_jacobian(self, x):
+        from ..framework.core import apply
+        return apply(self._fldj, as_tensor(x),
+                     name=type(self).__name__ + ".fldj")
+
+    def inverse_log_det_jacobian(self, y):
+        from ..framework.core import apply
+        return apply(lambda a: -self._fldj(self._inverse(a)), as_tensor(y),
+                     name=type(self).__name__ + ".ildj")
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # subclass hooks on raw arrays
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _fldj(self, x):
+        raise NotImplementedError
+
+
+class AffineTransform(Transform):
+    """y = loc + scale * x."""
+
+    def __init__(self, loc, scale):
+        self.loc = as_tensor(loc, "float32")
+        self.scale = as_tensor(scale, "float32")
+
+    def _forward(self, x):
+        return self.loc._data + self.scale._data * x
+
+    def _inverse(self, y):
+        return (y - self.loc._data) / self.scale._data
+
+    def _fldj(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale._data)), x.shape)
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        return x
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = as_tensor(power, "float32")
+
+    def _forward(self, x):
+        return jnp.power(x, self.power._data)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power._data)
+
+    def _fldj(self, x):
+        p = self.power._data
+        return jnp.log(jnp.abs(p * jnp.power(x, p - 1.0)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _fldj(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _fldj(self, x):
+        # log(1 - tanh^2 x) = 2*(log2 - x - softplus(-2x))
+        return 2.0 * (math.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class AbsTransform(Transform):
+    """y = |x| (not bijective; inverse returns the positive branch)."""
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _fldj(self, x):
+        return jnp.zeros_like(x)
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (not volume preserving; ldj is
+    not defined — upstream also raises)."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _fldj(self, x):
+        raise NotImplementedError(
+            "SoftmaxTransform does not implement log_det_jacobian")
+
+
+class StickBreakingTransform(Transform):
+    """Maps R^{K-1} to the K-simplex via stick breaking."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        zp = jnp.cumprod(1.0 - z, axis=-1)
+        lead = jnp.concatenate(
+            [jnp.ones_like(z[..., :1]), zp], axis=-1)
+        probs = jnp.concatenate([z, jnp.ones_like(z[..., :1])], -1) * lead
+        return probs
+
+    def _inverse(self, y):
+        ycum = jnp.cumsum(y[..., :-1], axis=-1)
+        rest = 1.0 - jnp.concatenate(
+            [jnp.zeros_like(ycum[..., :1]), ycum[..., :-1]], -1)
+        z = y[..., :-1] / rest
+        k = y.shape[-1] - 1
+        offset = k - jnp.arange(k, dtype=y.dtype)
+        return jnp.log(z / (1 - z)) + jnp.log(offset)
+
+    def _fldj(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        u = x - jnp.log(offset)
+        y = self._forward(x)
+        # d simplex / d u: sum_k [ -u_k + log sigmoid(u_k) + log y_k ]
+        return jnp.sum(-u + jax.nn.log_sigmoid(u)
+                       + jnp.log(y[..., :-1]), axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ChainTransform(Transform):
+    """Composition: y = f_n(...f_1(x))."""
+
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+        self._event_rank = max((t._event_rank for t in self.transforms),
+                               default=0)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    def _fldj(self, x):
+        total = None
+        for t in self.transforms:
+            ld = t._fldj(x)
+            # reduce finer-grained ldj to this chain's event rank
+            total = ld if total is None else total + ld
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return shape
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return shape
+
+
+class IndependentTransform(Transform):
+    """Treat the last ``reinterpreted_batch_rank`` dims as event dims:
+    the ldj sums over them."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        self._event_rank = base._event_rank + self.rank
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _fldj(self, x):
+        ld = self.base._fldj(x)
+        return jnp.sum(ld, axis=tuple(range(-self.rank, 0)))
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self.in_event_shape = tuple(int(s) for s in in_event_shape)
+        self.out_event_shape = tuple(int(s) for s in out_event_shape)
+        self._event_rank = len(self.in_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(tuple(batch) + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(tuple(batch) + self.in_event_shape)
+
+    def _fldj(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)

@@ -19,8 +19,6 @@ def recompute(function, *args, **kwargs):
     """Run ``function(*args)`` under rematerialization. ``function`` may be
     a Layer (its parameters/buffers are captured as differentiable inputs)
     or a pure function of tensors."""
-    import jax
-
     from ..nn.layer.layers import Layer
     params: list[Tensor] = []
     if isinstance(function, Layer):

@@ -1,0 +1,213 @@
+"""Tests for the extended paddle.distribution surface (SURVEY.md §2.2
+`paddle.distribution` row): new distributions, transforms,
+TransformedDistribution, register_kl."""
+
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def _mc_check(dist, mean, var, n=20000, tol=0.15):
+    paddle.seed(0)
+    s = dist.sample((n,)).numpy()
+    assert abs(s.mean() - mean) < tol * max(1.0, abs(mean))
+    assert abs(s.var() - var) < 3 * tol * max(1.0, var)
+
+
+class TestNewDistributions:
+    def test_geometric(self):
+        g = D.Geometric(0.25)
+        _mc_check(g, 3.0, 12.0)
+        lp = g.log_prob(paddle.to_tensor(np.array(2.0, "float32")))
+        np.testing.assert_allclose(float(lp.item()),
+                                   math.log(0.75 ** 2 * 0.25), rtol=1e-5)
+
+    def test_cauchy_cdf_logprob(self):
+        c = D.Cauchy(1.0, 2.0)
+        np.testing.assert_allclose(
+            float(c.cdf(paddle.to_tensor(
+                np.array(1.0, "float32"))).item()), 0.5, atol=1e-6)
+        lp = float(c.log_prob(paddle.to_tensor(
+            np.array(1.0, "float32"))).item())
+        np.testing.assert_allclose(lp, math.log(1 / (math.pi * 2)),
+                                   rtol=1e-5)
+
+    def test_chi2(self):
+        c = D.Chi2(4.0)
+        _mc_check(c, 4.0, 8.0)
+        # log_prob matches scipy formula at a point
+        v = 3.0
+        k = 2.0
+        ref = (k - 1) * math.log(v) - v / 2 - k * math.log(2) \
+            - math.lgamma(k)
+        np.testing.assert_allclose(
+            float(c.log_prob(paddle.to_tensor(
+                np.array(v, "float32"))).item()), ref, rtol=1e-5)
+
+    def test_student_t(self):
+        t = D.StudentT(10.0, 1.0, 2.0)
+        _mc_check(t, 1.0, 4.0 * 10 / 8)
+
+    def test_binomial(self):
+        b = D.Binomial(10.0, 0.3)
+        _mc_check(b, 3.0, 2.1)
+        lp = float(b.log_prob(paddle.to_tensor(
+            np.array(3.0, "float32"))).item())
+        from math import comb, log
+        ref = log(comb(10, 3) * 0.3 ** 3 * 0.7 ** 7)
+        np.testing.assert_allclose(lp, ref, rtol=1e-4)
+
+    def test_continuous_bernoulli_integrates_to_one(self):
+        cb = D.ContinuousBernoulli(0.3)
+        xs = np.linspace(1e-4, 1 - 1e-4, 4001, dtype="float32")
+        lp = cb.log_prob(paddle.to_tensor(xs)).numpy()
+        integral = np.trapezoid(np.exp(lp), xs)
+        np.testing.assert_allclose(integral, 1.0, atol=1e-3)
+        s = cb.sample((5000,)).numpy()
+        assert (s >= 0).all() and (s <= 1).all()
+
+    def test_mvn(self):
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]], "float32")
+        mvn = D.MultivariateNormal(
+            paddle.to_tensor(np.array([1.0, -1.0], "float32")),
+            covariance_matrix=paddle.to_tensor(cov))
+        paddle.seed(0)
+        s = mvn.sample((20000,)).numpy()
+        np.testing.assert_allclose(s.mean(0), [1.0, -1.0], atol=0.05)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.1)
+        # log_prob vs explicit gaussian formula
+        x = np.array([0.0, 0.0], "float32")
+        diff = x - np.array([1.0, -1.0])
+        inv = np.linalg.inv(cov)
+        ref = -0.5 * (diff @ inv @ diff + 2 * math.log(2 * math.pi)
+                      + math.log(np.linalg.det(cov)))
+        np.testing.assert_allclose(
+            float(mvn.log_prob(paddle.to_tensor(x)).item()), ref,
+            rtol=1e-4)
+
+    def test_independent(self):
+        base = D.Normal(np.zeros(3, "float32"), np.ones(3, "float32"))
+        ind = D.Independent(base, 1)
+        x = paddle.to_tensor(np.array([0.5, -0.5, 1.0], "float32"))
+        lp_joint = float(ind.log_prob(x).item())
+        lp_sum = float(base.log_prob(x).numpy().sum())
+        np.testing.assert_allclose(lp_joint, lp_sum, rtol=1e-6)
+
+
+class TestTransforms:
+    @pytest.mark.parametrize("t,x", [
+        (D.AffineTransform(2.0, 3.0), 0.7),
+        (D.ExpTransform(), 0.3),
+        (D.SigmoidTransform(), 0.4),
+        (D.TanhTransform(), 0.2),
+        (D.PowerTransform(2.0), 1.5),
+    ])
+    def test_roundtrip_and_ldj(self, t, x):
+        xt = paddle.to_tensor(np.array([x], "float32"))
+        y = t.forward(xt)
+        back = t.inverse(y)
+        np.testing.assert_allclose(back.numpy(), xt.numpy(), rtol=1e-5)
+        # ldj vs numeric derivative
+        eps = 1e-3
+        y2 = t.forward(paddle.to_tensor(np.array([x + eps], "float32")))
+        num = (y2.numpy()[0] - y.numpy()[0]) / eps
+        ld = float(t.forward_log_det_jacobian(xt).numpy()[0])
+        np.testing.assert_allclose(ld, math.log(abs(num)), atol=1e-2)
+
+    def test_chain(self):
+        chain = D.ChainTransform([D.AffineTransform(0.0, 2.0),
+                                  D.ExpTransform()])
+        xt = paddle.to_tensor(np.array([0.5], "float32"))
+        y = chain.forward(xt)
+        np.testing.assert_allclose(y.numpy(), [math.exp(1.0)], rtol=1e-5)
+        np.testing.assert_allclose(chain.inverse(y).numpy(), [0.5],
+                                   rtol=1e-5)
+
+    def test_stick_breaking(self):
+        t = D.StickBreakingTransform()
+        x = paddle.to_tensor(np.array([0.3, -0.2, 0.8], "float32"))
+        y = t.forward(x)
+        assert y.shape == [4]
+        np.testing.assert_allclose(y.numpy().sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy(),
+                                   atol=1e-4)
+
+    def test_reshape(self):
+        t = D.ReshapeTransform((4,), (2, 2))
+        x = paddle.to_tensor(np.arange(4, dtype="float32"))
+        y = t.forward(x)
+        assert y.shape == [2, 2]
+        np.testing.assert_allclose(t.inverse(y).numpy(), x.numpy())
+
+
+class TestTransformedDistribution:
+    def test_lognormal_via_transform(self):
+        base = D.Normal(0.0, 1.0)
+        td = D.TransformedDistribution(base, [D.ExpTransform()])
+        ref = D.LogNormal(0.0, 1.0)
+        x = paddle.to_tensor(np.array(1.7, "float32"))
+        np.testing.assert_allclose(float(td.log_prob(x).item()),
+                                   float(ref.log_prob(x).item()),
+                                   rtol=1e-5)
+        paddle.seed(1)
+        s = td.sample((8000,)).numpy()
+        assert abs(np.log(s).mean()) < 0.05
+
+    def test_affine_normal(self):
+        base = D.Normal(0.0, 1.0)
+        td = D.TransformedDistribution(
+            base, [D.AffineTransform(3.0, 2.0)])
+        ref = D.Normal(3.0, 2.0)
+        x = paddle.to_tensor(np.array(4.0, "float32"))
+        np.testing.assert_allclose(float(td.log_prob(x).item()),
+                                   float(ref.log_prob(x).item()),
+                                   rtol=1e-5)
+
+
+class TestRsample:
+    def test_normal_rsample_differentiable(self):
+        from paddle_tpu.framework.core import Parameter
+        paddle.seed(0)
+        loc = Parameter(np.zeros(1, "float32"))
+        x = D.Normal(loc, 1.0).rsample((64,))
+        x.sum().backward()
+        assert loc.grad is not None
+        np.testing.assert_allclose(loc.grad.numpy(), [64.0], rtol=1e-5)
+
+    def test_transformed_rsample_trains(self):
+        from paddle_tpu.framework.core import Parameter
+        paddle.seed(0)
+        p = Parameter(np.zeros(1, "float32"))
+        opt = paddle.optimizer.Adam(0.1, parameters=[p])
+        for _ in range(60):
+            td = D.TransformedDistribution(D.Normal(p, 1.0),
+                                           [D.ExpTransform()])
+            x = td.rsample((256,))
+            loss = ((x.log() - 2.0) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert abs(float(p.numpy()[0]) - 2.0) < 0.3
+
+
+class TestRegisterKL:
+    def test_registry_dispatch(self):
+        class MyDist(D.Distribution):
+            pass
+
+        @D.register_kl(MyDist, MyDist)
+        def _kl_my(p, q):
+            return "custom-kl"
+
+        assert D.kl_divergence(MyDist(), MyDist()) == "custom-kl"
+
+    def test_normal_kl_still_works(self):
+        p = D.Normal(0.0, 1.0)
+        q = D.Normal(1.0, 2.0)
+        kl = float(D.kl_divergence(p, q).item())
+        ref = math.log(2.0) + (1 + 1) / (2 * 4) - 0.5
+        np.testing.assert_allclose(kl, ref, rtol=1e-5)
