@@ -1,0 +1,153 @@
+"""paddle.static.nn — legacy static-graph layer functions (upstream
+``python/paddle/static/nn/``, UNVERIFIED; see SURVEY.md provenance
+warning).
+
+These are function-style layers used by static-graph user code
+(``fc(x, size)`` creates parameters on first call inside the current
+Program). Here they desugar to the dygraph layers: each call creates the
+layer, registers it on the current Program so its parameters persist, and
+applies it — traced Programs then compile exactly like dygraph code.
+"""
+
+from __future__ import annotations
+
+from .. import nn as dynn
+from ..framework.core import Tensor
+from .program import default_main_program
+
+__all__ = ["fc", "conv2d", "conv3d", "batch_norm", "embedding",
+           "layer_norm", "conv2d_transpose", "sequence_expand", "prelu"]
+
+
+def _register(layer_factory):
+    """Get this call site's layer from the current Program's slot list
+    (created on first execution, reused on replays — see
+    Program._next_layer)."""
+    return default_main_program()._next_layer(layer_factory)
+
+
+def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
+       activation=None, name=None):
+    in_features = 1
+    for s in x.shape[num_flatten_dims:]:
+        in_features *= int(s)
+    layer = _register(lambda: dynn.Linear(in_features, size,
+                                  weight_attr=weight_attr,
+                                  bias_attr=bias_attr))
+    from ..ops.manipulation import flatten
+    out = layer(flatten(x, num_flatten_dims) if len(x.shape) >
+                num_flatten_dims + 1 else x)
+    if activation:
+        out = getattr(dynn.functional, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    in_ch = int(input.shape[1 if data_format == "NCHW" else -1])
+    layer = _register(lambda: dynn.Conv2D(in_ch, num_filters, filter_size,
+                                  stride=stride, padding=padding,
+                                  dilation=dilation, groups=groups,
+                                  weight_attr=param_attr,
+                                  bias_attr=bias_attr,
+                                  data_format=data_format))
+    out = layer(input)
+    if act:
+        out = getattr(dynn.functional, act)(out)
+    return out
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCDHW"):
+    in_ch = int(input.shape[1 if data_format == "NCDHW" else -1])
+    layer = _register(lambda: dynn.Conv3D(in_ch, num_filters, filter_size,
+                                          stride=stride, padding=padding,
+                                          dilation=dilation, groups=groups,
+                                          weight_attr=param_attr,
+                                          bias_attr=bias_attr,
+                                          data_format=data_format))
+    out = layer(input)
+    if act:
+        out = getattr(dynn.functional, act)(out)
+    return out
+
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, groups=1, param_attr=None,
+                     bias_attr=None, act=None, name=None,
+                     data_format="NCHW"):
+    if filter_size is None:
+        raise ValueError(
+            "conv2d_transpose requires filter_size (deriving the kernel "
+            "from output_size is not supported); pass output_size to "
+            "shape the output of a given kernel")
+    in_ch = int(input.shape[1 if data_format == "NCHW" else -1])
+    layer = _register(
+        lambda: dynn.Conv2DTranspose(in_ch, num_filters, filter_size,
+                                     stride=stride, padding=padding,
+                                     groups=groups, weight_attr=param_attr,
+                                     bias_attr=bias_attr,
+                                     data_format=data_format))
+    out = layer(input, output_size=output_size)
+    if act:
+        out = getattr(dynn.functional, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None):
+    first_layout = data_layout in ("NCHW", "NCL", "NCDHW")
+    ch = int(input.shape[1 if first_layout else -1])
+    rank = len(input.shape)
+    cls = {5: dynn.BatchNorm3D, 4: dynn.BatchNorm2D}.get(rank,
+                                                         dynn.BatchNorm1D)
+    # the BatchNorm layers use paddle layout names per rank
+    fmt = {dynn.BatchNorm3D: "NCDHW" if first_layout else "NDHWC",
+           dynn.BatchNorm2D: "NCHW" if first_layout else "NHWC",
+           dynn.BatchNorm1D: "NCL" if first_layout else "NLC"}[cls]
+    layer = _register(lambda: cls(ch, momentum=momentum, epsilon=epsilon,
+                                  weight_attr=param_attr,
+                                  bias_attr=bias_attr, data_format=fmt))
+    # mode is per-call (slot layers are shared across replays)
+    layer.eval() if is_test else layer.train()
+    out = layer(input)
+    if act:
+        out = getattr(dynn.functional, act)(out)
+    return out
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = [int(s) for s in input.shape[begin_norm_axis:]]
+    layer = _register(lambda: dynn.LayerNorm(shape, epsilon=epsilon,
+                                     weight_attr=param_attr,
+                                     bias_attr=bias_attr))
+    out = layer(input)
+    if act:
+        out = getattr(dynn.functional, act)(out)
+    return out
+
+
+def embedding(input, size, is_sparse=False, padding_idx=None,
+              param_attr=None, dtype="float32", name=None):
+    layer = _register(lambda: dynn.Embedding(size[0], size[1],
+                                     padding_idx=padding_idx,
+                                     weight_attr=param_attr))
+    return layer(input)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    num = 1 if mode == "all" else int(x.shape[1])
+    layer = _register(lambda: dynn.PReLU(num_parameters=num,
+                                 weight_attr=param_attr))
+    return layer(x)
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    raise NotImplementedError(
+        "LoD sequence ops are a parameter-server/CPU-era feature and out "
+        "of TPU scope (see PARITY.md known gaps)")

@@ -37,6 +37,48 @@ class Program:
         self.placeholders: dict[str, _DataPlaceholder] = {}
         self.build_fn = None  # callable feed_dict -> outputs (lazily set)
         self._recorded = []
+        # static.nn layer slots: layers are identified by call order, so
+        # re-running a captured builder reuses (not re-inits) parameters —
+        # the static-graph "parameters live in the Program" semantics
+        self._layer_slots: list = []
+        self._slot_idx = 0
+
+    def _next_layer(self, factory):
+        i = self._slot_idx
+        if i < len(self._layer_slots):
+            layer = self._layer_slots[i]
+        else:
+            layer = factory()
+            self._layer_slots.append(layer)
+        self._slot_idx = i + 1
+        return layer
+
+    def capture(self, fn):
+        """Register a builder ``fn(feed: dict[str, Tensor]) -> dict`` that
+        Executor.run replays per call under this program (static.nn layers
+        inside keep their parameters across runs). Re-capturing a
+        different builder resets the layer slots — slot reuse is only
+        valid for the same call sequence."""
+        if self.build_fn is not None and \
+                getattr(self, "_captured_fn", None) is not fn:
+            self._layer_slots = []
+
+        def build(feed):
+            self._slot_idx = 0
+            tensors = {k: (v if isinstance(v, Tensor)
+                           else Tensor(jnp.asarray(v)))
+                       for k, v in feed.items()}
+            with program_guard(self):
+                return fn(tensors)
+        self.build_fn = build
+        self._captured_fn = fn
+        return self
+
+    def parameters(self):
+        params = []
+        for layer in self._layer_slots:
+            params.extend(layer.parameters())
+        return params
 
     def global_block(self):
         return self
