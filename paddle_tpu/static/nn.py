@@ -141,9 +141,16 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
 
 
 def prelu(x, mode="all", param_attr=None, name=None):
-    num = 1 if mode == "all" else int(x.shape[1])
+    if mode == "all":
+        num = 1
+    elif mode == "channel":
+        num = int(x.shape[1])
+    else:
+        raise NotImplementedError(
+            "prelu mode='element' (one alpha per element) is not "
+            "supported; use 'all' or 'channel'")
     layer = _register(lambda: dynn.PReLU(num_parameters=num,
-                                 weight_attr=param_attr))
+                                         weight_attr=param_attr))
     return layer(x)
 
 

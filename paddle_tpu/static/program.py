@@ -75,6 +75,12 @@ class Program:
         return self
 
     def parameters(self):
+        if self.build_fn is not None and not self._layer_slots:
+            raise RuntimeError(
+                "Program.parameters() before the first Executor.run: "
+                "static.nn layers are created on the first replay, so "
+                "there are no parameters yet — run once, then build the "
+                "optimizer")
         params = []
         for layer in self._layer_slots:
             params.extend(layer.parameters())

@@ -1,0 +1,106 @@
+"""Tests for affine_grid/grid_sample (spatial transformer ops;
+SURVEY.md §2.2 `paddle.nn` functional row)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+
+class TestAffineGrid:
+    def test_identity_theta(self):
+        theta = paddle.to_tensor(np.tile(
+            np.array([[1, 0, 0], [0, 1, 0]], "float32"), (2, 1, 1)))
+        grid = F.affine_grid(theta, [2, 3, 4, 5])
+        assert grid.shape == [2, 4, 5, 2]
+        g = grid.numpy()
+        np.testing.assert_allclose(g[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(g[0, -1, -1], [1, 1], atol=1e-6)
+
+    def test_translation(self):
+        theta = paddle.to_tensor(np.array(
+            [[[1, 0, 0.5], [0, 1, -0.25]]], "float32"))
+        g = F.affine_grid(theta, [1, 1, 3, 3]).numpy()
+        np.testing.assert_allclose(g[0, 1, 1], [0.5, -0.25], atol=1e-6)
+
+
+class TestGridSample:
+    def test_identity_sampling(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(1, 2, 5, 7).astype("float32"))
+        theta = paddle.to_tensor(np.array(
+            [[[1, 0, 0], [0, 1, 0]]], "float32"))
+        grid = F.affine_grid(theta, [1, 2, 5, 7])
+        out = F.grid_sample(x, grid)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), atol=1e-5)
+
+    def test_horizontal_flip(self):
+        x = paddle.to_tensor(np.arange(6, dtype="float32")
+                             .reshape(1, 1, 2, 3))
+        theta = paddle.to_tensor(np.array(
+            [[[-1, 0, 0], [0, 1, 0]]], "float32"))
+        grid = F.affine_grid(theta, [1, 1, 2, 3])
+        out = F.grid_sample(x, grid).numpy()
+        np.testing.assert_allclose(out[0, 0], x.numpy()[0, 0][:, ::-1],
+                                   atol=1e-5)
+
+    def test_zeros_padding_outside(self):
+        x = paddle.to_tensor(np.ones((1, 1, 4, 4), "float32"))
+        grid = paddle.to_tensor(np.full((1, 2, 2, 2), 5.0, "float32"))
+        out = F.grid_sample(x, grid, padding_mode="zeros").numpy()
+        np.testing.assert_allclose(out, 0.0)
+
+    def test_border_padding_outside(self):
+        x = paddle.to_tensor(np.arange(4, dtype="float32")
+                             .reshape(1, 1, 2, 2))
+        grid = paddle.to_tensor(np.full((1, 1, 1, 2), 5.0, "float32"))
+        out = F.grid_sample(x, grid, padding_mode="border").numpy()
+        np.testing.assert_allclose(out[0, 0, 0, 0], 3.0)  # bottom-right
+
+    def test_nearest_mode(self):
+        x = paddle.to_tensor(np.arange(4, dtype="float32")
+                             .reshape(1, 1, 2, 2))
+        grid = paddle.to_tensor(np.array([[[[-0.9, -0.9]]]], "float32"))
+        out = F.grid_sample(x, grid, mode="nearest").numpy()
+        np.testing.assert_allclose(out[0, 0, 0, 0], 0.0)
+
+    def test_grad_flows_to_input_and_grid(self):
+        rng = np.random.RandomState(1)
+        x = paddle.to_tensor(rng.randn(1, 1, 4, 4).astype("float32"))
+        grid = paddle.to_tensor(
+            (rng.rand(1, 3, 3, 2).astype("float32") - 0.5))
+        x.stop_gradient = False
+        grid.stop_gradient = False
+        F.grid_sample(x, grid).sum().backward()
+        assert x.grad is not None and grid.grad is not None
+        assert np.isfinite(x.grad.numpy()).all()
+        assert np.isfinite(grid.grad.numpy()).all()
+
+    def test_spatial_transformer_trains(self):
+        # learn a rotation angle that aligns a pattern — the classic STN
+        # use: gradients must flow through affine_grid + grid_sample
+        rng = np.random.RandomState(0)
+        src = rng.rand(1, 1, 8, 8).astype("float32")
+        # target = horizontally flipped source
+        tgt = src[:, :, :, ::-1].copy()
+        a = paddle.to_tensor(np.array([0.0], "float32"))
+        from paddle_tpu.framework.core import Parameter
+        a = Parameter(np.array([0.0], "float32"))
+        opt = paddle.optimizer.Adam(0.1, parameters=[a])
+        xs = paddle.to_tensor(src)
+        for _ in range(60):
+            sx = paddle.concat([a.cos() * -1.0, a.sin() * 0.0,
+                                a.sin() * 0.0], axis=0)
+            # parameterize theta = [[-cos a, 0, 0], [0, 1, 0]]-ish via a
+            theta = paddle.stack([
+                paddle.concat([-(a.cos()), a * 0.0, a * 0.0]),
+                paddle.concat([a * 0.0, a * 0.0 + 1.0, a * 0.0]),
+            ]).unsqueeze(0)
+            grid = F.affine_grid(theta, [1, 1, 8, 8])
+            out = F.grid_sample(xs, grid)
+            loss = ((out - paddle.to_tensor(tgt)) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(loss.item()) < 0.01
