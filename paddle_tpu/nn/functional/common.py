@@ -322,6 +322,10 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
     (paddle.nn.functional.affine_grid). theta: [N, 2, 3];
     out_shape: [N, C, H, W]; returns [N, H, W, 2] (x, y) in [-1, 1]."""
     n, _, h, w = (int(s) for s in out_shape)
+    if int(as_tensor(theta).shape[0]) != n:
+        raise ValueError(
+            f"affine_grid: theta batch {as_tensor(theta).shape[0]} does "
+            f"not match out_shape batch {n}")
 
     def fn(th):
         if align_corners:

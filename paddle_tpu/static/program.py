@@ -42,6 +42,7 @@ class Program:
         # the static-graph "parameters live in the Program" semantics
         self._layer_slots: list = []
         self._slot_idx = 0
+        self._has_run = False
 
     def _next_layer(self, factory):
         i = self._slot_idx
@@ -62,6 +63,7 @@ class Program:
         if self.build_fn is not None and \
                 getattr(self, "_captured_fn", None) is not fn:
             self._layer_slots = []
+            self._has_run = False
 
         def build(feed):
             self._slot_idx = 0
@@ -69,13 +71,15 @@ class Program:
                            else Tensor(jnp.asarray(v)))
                        for k, v in feed.items()}
             with program_guard(self):
-                return fn(tensors)
+                out = fn(tensors)
+            self._has_run = True
+            return out
         self.build_fn = build
         self._captured_fn = fn
         return self
 
     def parameters(self):
-        if self.build_fn is not None and not self._layer_slots:
+        if self.build_fn is not None and not self._has_run:
             raise RuntimeError(
                 "Program.parameters() before the first Executor.run: "
                 "static.nn layers are created on the first replay, so "

@@ -9,7 +9,7 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax.experimental.shard_map import shard_map
+from jax import shard_map
 
 from paddle_tpu.ops import ring_attention as ra
 from paddle_tpu.ops.pallas.flash_attention import flash_attention_reference

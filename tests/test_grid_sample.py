@@ -79,28 +79,36 @@ class TestGridSample:
 
     def test_spatial_transformer_trains(self):
         # learn a rotation angle that aligns a pattern — the classic STN
-        # use: gradients must flow through affine_grid + grid_sample
-        rng = np.random.RandomState(0)
-        src = rng.rand(1, 1, 8, 8).astype("float32")
-        # target = horizontally flipped source
-        tgt = src[:, :, :, ::-1].copy()
-        a = paddle.to_tensor(np.array([0.0], "float32"))
+        # use: gradients must flow through affine_grid + grid_sample.
+        # Target is the source rotated by 30°; angle starts at 0 so the
+        # initial loss is far from the optimum.
         from paddle_tpu.framework.core import Parameter
-        a = Parameter(np.array([0.0], "float32"))
-        opt = paddle.optimizer.Adam(0.1, parameters=[a])
+        rng = np.random.RandomState(0)
+        src = rng.rand(1, 1, 16, 16).astype("float32")
         xs = paddle.to_tensor(src)
-        for _ in range(60):
-            sx = paddle.concat([a.cos() * -1.0, a.sin() * 0.0,
-                                a.sin() * 0.0], axis=0)
-            # parameterize theta = [[-cos a, 0, 0], [0, 1, 0]]-ish via a
+        target_angle = np.pi / 6
+
+        def rotate(a):
             theta = paddle.stack([
-                paddle.concat([-(a.cos()), a * 0.0, a * 0.0]),
-                paddle.concat([a * 0.0, a * 0.0 + 1.0, a * 0.0]),
+                paddle.concat([a.cos(), -(a.sin()), a * 0.0]),
+                paddle.concat([a.sin(), a.cos(), a * 0.0]),
             ]).unsqueeze(0)
-            grid = F.affine_grid(theta, [1, 1, 8, 8])
-            out = F.grid_sample(xs, grid)
-            loss = ((out - paddle.to_tensor(tgt)) ** 2).mean()
+            grid = F.affine_grid(theta, [1, 1, 16, 16])
+            return F.grid_sample(xs, grid)
+
+        with paddle.no_grad():
+            tgt = rotate(paddle.to_tensor(
+                np.array([target_angle], "float32")))
+        a = Parameter(np.array([0.0], "float32"))
+        opt = paddle.optimizer.Adam(0.05, parameters=[a])
+        first = None
+        for _ in range(80):
+            loss = ((rotate(a) - tgt) ** 2).mean()
             loss.backward()
             opt.step()
             opt.clear_grad()
-        assert float(loss.item()) < 0.01
+            if first is None:
+                first = float(loss.item())
+        assert first > 0.01          # starts genuinely misaligned
+        assert float(loss.item()) < first * 0.1
+        assert abs(float(a.numpy()[0]) - target_angle) < 0.1
