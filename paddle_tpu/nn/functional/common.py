@@ -322,10 +322,11 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
     (paddle.nn.functional.affine_grid). theta: [N, 2, 3];
     out_shape: [N, C, H, W]; returns [N, H, W, 2] (x, y) in [-1, 1]."""
     n, _, h, w = (int(s) for s in out_shape)
-    if int(as_tensor(theta).shape[0]) != n:
+    theta = as_tensor(theta)
+    if int(theta.shape[0]) != n:
         raise ValueError(
-            f"affine_grid: theta batch {as_tensor(theta).shape[0]} does "
-            f"not match out_shape batch {n}")
+            f"affine_grid: theta batch {theta.shape[0]} does not match "
+            f"out_shape batch {n}")
 
     def fn(th):
         if align_corners:
@@ -341,7 +342,7 @@ def affine_grid(theta, out_shape, align_corners=True, name=None):
         return jnp.einsum("hwk,njk->nhwj", base.astype(th.dtype), th,
                           precision=jax.lax.Precision.HIGHEST)
 
-    return apply(fn, as_tensor(theta), name="affine_grid")
+    return apply(fn, theta, name="affine_grid")
 
 
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",

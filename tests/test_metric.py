@@ -1,0 +1,100 @@
+"""Tests for paddle.metric (Accuracy/Precision/Recall/Auc) — SURVEY.md
+§2.2 `paddle.metric` row; numeric oracles are sklearn-style hand
+computations."""
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import metric
+
+
+class TestAccuracy:
+    def test_top1(self):
+        m = metric.Accuracy()
+        pred = paddle.to_tensor(np.array(
+            [[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]], "float32"))
+        label = paddle.to_tensor(np.array([[1], [1], [1]], "int64"))
+        correct = m.compute(pred, label)
+        m.update(correct)
+        np.testing.assert_allclose(m.accumulate(), 2.0 / 3.0, rtol=1e-6)
+
+    def test_topk_and_streaming(self):
+        m = metric.Accuracy(topk=(1, 2))
+        rng = np.random.RandomState(0)
+        hits1 = hits2 = total = 0
+        for _ in range(3):
+            pred = rng.rand(8, 5).astype("float32")
+            label = rng.randint(0, 5, (8, 1))
+            order = np.argsort(-pred, -1)
+            hits1 += (order[:, 0] == label[:, 0]).sum()
+            hits2 += (order[:, :2] == label).any(-1).sum()
+            total += 8
+            m.update(m.compute(paddle.to_tensor(pred),
+                               paddle.to_tensor(label)))
+        acc1, acc2 = m.accumulate()
+        np.testing.assert_allclose(acc1, hits1 / total, rtol=1e-6)
+        np.testing.assert_allclose(acc2, hits2 / total, rtol=1e-6)
+        assert m.name() == ["acc_top1", "acc_top2"]
+
+    def test_reset(self):
+        m = metric.Accuracy()
+        pred = paddle.to_tensor(np.array([[0.9, 0.1]], "float32"))
+        label = paddle.to_tensor(np.array([[0]], "int64"))
+        m.update(m.compute(pred, label))
+        m.reset()
+        assert m.accumulate() == 0.0
+
+
+class TestPrecisionRecall:
+    def test_values(self):
+        preds = np.array([0.9, 0.8, 0.2, 0.7, 0.1], "float32")
+        labels = np.array([1, 0, 1, 1, 0], "float32")
+        # predicted positive: idx 0,1,3 -> tp=2 fp=1; fn: idx 2 -> 1
+        p = metric.Precision()
+        p.update(paddle.to_tensor(preds), paddle.to_tensor(labels))
+        np.testing.assert_allclose(p.accumulate(), 2 / 3, rtol=1e-6)
+        r = metric.Recall()
+        r.update(paddle.to_tensor(preds), paddle.to_tensor(labels))
+        np.testing.assert_allclose(r.accumulate(), 2 / 3, rtol=1e-6)
+
+    def test_empty_is_zero(self):
+        assert metric.Precision().accumulate() == 0.0
+        assert metric.Recall().accumulate() == 0.0
+
+
+class TestAuc:
+    def test_perfect_separation(self):
+        m = metric.Auc()
+        preds = np.array([0.1, 0.2, 0.8, 0.9], "float32")
+        labels = np.array([0, 0, 1, 1], "int64")
+        m.update(paddle.to_tensor(preds), paddle.to_tensor(labels))
+        np.testing.assert_allclose(m.accumulate(), 1.0, atol=1e-3)
+
+    def test_random_is_half(self):
+        rng = np.random.RandomState(0)
+        m = metric.Auc()
+        preds = rng.rand(4000).astype("float32")
+        labels = rng.randint(0, 2, 4000)
+        m.update(paddle.to_tensor(preds), paddle.to_tensor(labels))
+        assert abs(m.accumulate() - 0.5) < 0.05
+
+    def test_matches_rank_statistic(self):
+        rng = np.random.RandomState(1)
+        preds = rng.rand(500).astype("float32")
+        labels = rng.randint(0, 2, 500)
+        m = metric.Auc()
+        m.update(paddle.to_tensor(preds), paddle.to_tensor(labels))
+        # Mann-Whitney U reference
+        pos = preds[labels == 1]
+        neg = preds[labels == 0]
+        u = (pos[:, None] > neg[None, :]).sum() + \
+            0.5 * (pos[:, None] == neg[None, :]).sum()
+        ref = u / (len(pos) * len(neg))
+        np.testing.assert_allclose(m.accumulate(), ref, atol=2e-3)
+
+    def test_two_column_probs(self):
+        m = metric.Auc()
+        preds = np.array([[0.9, 0.1], [0.1, 0.9]], "float32")
+        labels = np.array([0, 1], "int64")
+        m.update(paddle.to_tensor(preds), paddle.to_tensor(labels))
+        np.testing.assert_allclose(m.accumulate(), 1.0, atol=1e-3)
