@@ -73,8 +73,12 @@ def make_scheduler(closed=0, ready=0, record=1, repeat=0, skip_first=0):
 
 
 def export_chrome_tracing(dir_name, worker_name=None):
+    """Trace-ready handler directing the capture into ``dir_name``. The
+    Profiler reads ``handler.log_dir`` at construction so the directory is
+    set BEFORE recording starts (the jax trace is written at stop time)."""
     def handler(prof):
         prof._log_dir = dir_name
+    handler.log_dir = dir_name
     return handler
 
 
@@ -122,6 +126,9 @@ class Profiler:
         self._on_trace_ready = on_trace_ready
         self._log_dir = os.environ.get("PADDLE_PROFILER_LOG_DIR",
                                        "./profiler_log")
+        if on_trace_ready is not None and hasattr(on_trace_ready,
+                                                  "log_dir"):
+            self._log_dir = on_trace_ready.log_dir
         self._step = 0
         self._recording = False
         self._timer_only = timer_only

@@ -1,0 +1,61 @@
+"""Smoke tests for paddle.profiler (SURVEY.md §5 tracing row): Profiler
+windows over jax.profiler, RecordEvent annotations, scheduler states,
+export directory handling."""
+
+import glob
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+
+
+class TestProfiler:
+    def test_record_event_context(self):
+        with profiler.RecordEvent("my_op"):
+            x = paddle.to_tensor(np.ones((8, 8), "float32"))
+            (x @ x).numpy()
+
+    def test_profiler_capture_writes_trace(self, tmp_path):
+        p = profiler.Profiler(
+            scheduler=(0, 2),
+            on_trace_ready=profiler.export_chrome_tracing(str(tmp_path)))
+        p.start()
+        for _ in range(3):
+            with profiler.RecordEvent("step"):
+                x = paddle.to_tensor(np.ones((16, 16), "float32"))
+                (x @ x).sum().numpy()
+            p.step()
+        p.stop()
+        # jax writes a profile session under <dir>/plugins/profile/...
+        traces = glob.glob(str(tmp_path / "plugins" / "profile" / "*" / "*"))
+        assert traces, f"no trace written under {tmp_path}"
+
+    def test_scheduler_states(self):
+        S = profiler.ProfilerState
+        sched = profiler.make_scheduler(closed=1, ready=1, record=2,
+                                        repeat=1)
+        states = [sched(i) for i in range(5)]
+        assert states == [S.CLOSED, S.READY, S.RECORD,
+                          S.RECORD_AND_RETURN, S.CLOSED]
+
+    def test_scheduler_skip_first(self):
+        S = profiler.ProfilerState
+        sched = profiler.make_scheduler(closed=0, ready=0, record=1,
+                                        skip_first=2)
+        assert sched(0) == S.CLOSED
+        assert sched(1) == S.CLOSED
+        assert sched(2) == S.RECORD_AND_RETURN
+
+    def test_timer_only_summary(self, capsys):
+        p = profiler.Profiler(timer_only=True)
+        p.start()
+        for _ in range(4):
+            x = paddle.to_tensor(np.ones((4, 4), "float32"))
+            (x + x).numpy()
+            p.step()
+        p.stop()
+        p.summary()
+        out = capsys.readouterr().out
+        assert "steps: 4" in out and "throughput" in out
