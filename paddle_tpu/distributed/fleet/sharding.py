@@ -25,6 +25,16 @@ __all__ = ["DygraphShardingOptimizer", "group_sharded_parallel",
            "GroupShardedStage3", "shard_array_over"]
 
 
+def _warn_no_offload(where: str) -> None:
+    import warnings
+
+    warnings.warn(
+        f"{where}(offload=True) is not supported on the TPU backend: "
+        "parameters and optimizer state stay in HBM with NamedSharding; "
+        "use paddle.incubate.recompute or smaller shards instead. "
+        "Proceeding WITHOUT offload.", UserWarning, stacklevel=3)
+
+
 def shard_array_over(data, mesh, axis_name):
     """NamedSharding over the first dim divisible by the axis size;
     replicate if none."""
@@ -103,6 +113,10 @@ class GroupShardedStage3:
 
     def __init__(self, layer, optimizer=None, group=None, sync_buffers=False,
                  device="tpu", segment_size=2 ** 20, offload=False, hcg=None):
+        if offload:
+            _warn_no_offload("GroupShardedStage3")
+        # segment_size is accepted for API parity but has no effect: XLA
+        # schedules all-gathers itself, there is no manual bucketing.
         self._layer = layer
         self._optimizer = optimizer
         if hcg is None:
@@ -146,6 +160,8 @@ def group_sharded_parallel(model, optimizer, level="p_g_os", scaler=None,
     level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3)."""
     from .base import fleet
     hcg = fleet._hcg
+    if offload and level in ("os", "os_g"):
+        _warn_no_offload("group_sharded_parallel")
     if level in ("os", "os_g"):
         opt = DygraphShardingOptimizer(optimizer, hcg)
         opt._place_new_state()

@@ -49,13 +49,8 @@ def _constrain(t: Tensor, spec) -> Tensor:
     axis, mesh, world = _mp()
     if mesh is None or world <= 1:
         return t
-    ns = NamedSharding(mesh, spec)
-
-    def fn(a):
-        if isinstance(a, jax.core.Tracer):
-            return lax.with_sharding_constraint(a, ns)
-        return jax.device_put(a, ns)
-    return apply(fn, t, name="sp_constraint")
+    from ...parallel_layers import _constrain_tensor
+    return _constrain_tensor(t, mesh, spec, name="sp_constraint")
 
 
 def ScatterOp(x, axis=1):

@@ -42,6 +42,23 @@ def _constrain(data, mesh, spec):
     return jax.device_put(data, ns)
 
 
+def _constrain_tensor(t, mesh, spec, name="sharding_constraint"):
+    """Sharding-constrain a Tensor WITHOUT severing the autograd tape: the
+    constraint goes through ``apply`` so backward flows through it (the
+    identity vjp re-places the cotangent). Shared by the TP layers here and
+    fleet.utils.sequence_parallel_utils."""
+    if mesh is None:
+        return t
+    ns = NamedSharding(mesh, spec)
+
+    def fn(a):
+        if isinstance(a, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(a, ns)
+        return jax.device_put(a, ns)
+
+    return apply(fn, t, name=name)
+
+
 class ColumnParallelLinear(Layer):
     """Weight [in, out] sharded on out (the 'column'); forward output is
     sharded on the feature dim unless gather_output."""
@@ -85,13 +102,10 @@ class ColumnParallelLinear(Layer):
             return out
         out = F.linear(x, self.weight, self.bias)
         if mesh is not None:
-            nd = out.ndim
-            spec = [None] * nd
+            spec = [None] * out.ndim
             if not self.gather_output:
                 spec[-1] = axis
-            out = Tensor(_constrain(out._data, mesh, PartitionSpec(*spec)),
-                         stop_gradient=out.stop_gradient)
-            out._node, out._out_idx = out._node, out._out_idx
+            out = _constrain_tensor(out, mesh, PartitionSpec(*spec))
         return out
 
 
@@ -134,10 +148,8 @@ class RowParallelLinear(Layer):
             return out
         out = F.linear(x, self.weight, None)
         if mesh is not None:
-            nd = out.ndim
-            out = Tensor(_constrain(out._data, mesh,
-                                    PartitionSpec(*([None] * nd))),
-                         stop_gradient=out.stop_gradient)
+            out = _constrain_tensor(out, mesh,
+                                    PartitionSpec(*([None] * out.ndim)))
         if self.bias is not None:
             out = out + self.bias
         return out
