@@ -38,8 +38,11 @@ class DistributedStrategy:
         self.sharding = False
         self.sharding_configs = {}
         self.pipeline = False
+        # schedule_mode: FThenB (compiled lax.scan pipeline, supports
+        # interleaved virtual stages — the TPU-native default) | 1F1B |
+        # ZB-H1 (explicit tick-table engines, zero_bubble.py)
         self.pipeline_configs = {"accumulate_steps": 1,
-                                 "schedule_mode": "1F1B"}
+                                 "schedule_mode": "FThenB"}
         self.gradient_merge = False
         self.gradient_merge_configs = {}
         self.tensor_parallel = False
@@ -135,7 +138,8 @@ class Fleet:
             if self._strategy is not None:
                 accum = self._strategy.pipeline_configs.get(
                     "accumulate_steps", 1)
-            return PipelineParallel(model, self._hcg, accum)
+            return PipelineParallel(model, self._hcg, accum,
+                                    strategy=self._strategy)
         return model
 
     def distributed_optimizer(self, optimizer, strategy=None):

@@ -23,10 +23,44 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ....framework.core import Tensor, apply, no_grad
+from ....framework.core import (GradNode, Tensor, apply, current_tracking,
+                                no_grad)
+from ....framework import core as _core
 from ....ops.manipulation import split as split_op
 
 __all__ = ["PipelineParallel"]
+
+#: strategy.pipeline_configs["schedule_mode"] -> engine kind.
+#: 'FThenB' (default) = the compiled lax.scan pipeline with jax
+#: reverse-mode backward (supports interleaved virtual stages);
+#: '1F1B' / 'ZB-H1' = the explicit-schedule tick engine in
+#: distributed/zero_bubble.py (true warmup/steady/cooldown order, W-unit
+#: bubble filling for ZB-H1).
+_SCHEDULES = {
+    "fthenb": "fthenb", "f-then-b": "fthenb", "f_then_b": "fthenb",
+    "gpipe": "fthenb", "interleaved": "fthenb", "vpp": "fthenb",
+    "1f1b": "1f1b", "zb_h1": "zb_h1", "zb-h1": "zb_h1", "zbh1": "zb_h1",
+}
+
+
+def _make_stage_fn(template, template_params):
+    """Shape/dtype-preserving stage compute over ONE chunk's param leaves:
+    rebind the template layers' params, run them, restore. Shared by the
+    compiled FThenB body and the explicit-schedule engine."""
+    def stage_fn(params_one, x):
+        originals = [(p, p._data) for p in template_params]
+        try:
+            for p, a in zip(template_params, params_one):
+                p._data = a
+            t = Tensor(x)
+            with no_grad():
+                for l in template:
+                    t = l(t)
+            return t.jax() if isinstance(t, Tensor) else t
+        finally:
+            for p, a in originals:
+                p._data = a
+    return stage_fn
 
 
 def _param_sig(layer):
@@ -39,15 +73,32 @@ def _param_sig(layer):
 
 
 class PipelineParallel:
-    def __init__(self, layers, hcg, accumulate_steps=1, strategy=None):
+    def __init__(self, layers, hcg, accumulate_steps=1, strategy=None,
+                 schedule_mode=None):
         self._layers = layers
         self._hcg = hcg
         self.accumulate_steps = max(int(accumulate_steps), 1)
         self._pp_degree = (hcg.get_pipe_parallel_world_size()
                            if hcg is not None else 1)
+        if schedule_mode is None and strategy is not None:
+            schedule_mode = strategy.pipeline_configs.get(
+                "schedule_mode", "FThenB")
+        raw = str(schedule_mode or "FThenB")
+        try:
+            self._schedule = _SCHEDULES[raw.lower().strip()]
+        except KeyError:
+            raise ValueError(
+                f"unknown pipeline schedule_mode {raw!r}; one of "
+                f"{sorted(set(_SCHEDULES))}") from None
         self._compiled_plan = None
         if self._pp_degree > 1:
             self._compiled_plan = self._build_plan()
+            if self._schedule != "fthenb" and \
+                    self._compiled_plan["n_virtual"] > 1:
+                raise ValueError(
+                    "explicit schedules (1F1B/ZB-H1) do not support "
+                    "virtual pipeline stages; use schedule_mode='FThenB' "
+                    "(interleaved) or num_virtual_pipeline_stages=1")
 
     def __getattr__(self, name):
         return getattr(self._layers, name)
@@ -141,27 +192,15 @@ class PipelineParallel:
                         for v in range(V)])
                     for i in range(n_leaves))
 
-            def stage_fn(params_one, x):
-                originals = [(p, p._data) for p in template_params]
-                try:
-                    for p, a in zip(template_params, params_one):
-                        p._data = a
-                    t = Tensor(x)
-                    with no_grad():
-                        for l in template:
-                            t = l(t)
-                    return t.jax() if isinstance(t, Tensor) else t
-                finally:
-                    for p, a in originals:
-                        p._data = a
-
-            return run_pipeline(stage_fn, stacked, hm, mesh,
+            return run_pipeline(_make_stage_fn(template, template_params),
+                                stacked, hm, mesh,
                                 axis_name=self._hcg.pp_axis_name,
                                 n_virtual=V, remat=remat)
 
         return apply(fn, h_micro, *flat, name="pipeline_body")
 
-    def _forward_compiled(self, inputs):
+    def _prologue_micro(self, inputs):
+        """Run the prologue and reshape its output to [M, b//M, ...]."""
         plan = self._compiled_plan
         M = self.accumulate_steps
         h = inputs
@@ -172,12 +211,141 @@ class PipelineParallel:
             raise ValueError(f"batch {b} not divisible by "
                              f"accumulate_steps {M}")
         from ....ops.manipulation import reshape
-        h_micro = reshape(h, [M, b // M] + list(h.shape[1:]))
+        return reshape(h, [M, b // M] + list(h.shape[1:])), b
+
+    def _forward_compiled(self, inputs):
+        plan = self._compiled_plan
+        h_micro, b = self._prologue_micro(inputs)
+        from ....ops.manipulation import reshape
         out_micro = self._body_apply(h_micro)
         out = reshape(out_micro, [b] + list(out_micro.shape[2:]))
         for l in plan["epilogue"]:
             out = l(out)
         return out
+
+    # ---- explicit-schedule engine (1F1B / ZB-H1) -------------------------
+
+    def _engine_jit(self):
+        """One jitted program: explicit-schedule engine + grad unstack.
+
+        A single program matters beyond speed: slicing the pipe-sharded
+        grad stacks eagerly would dispatch many small collective programs
+        concurrently, which deadlocks XLA:CPU's rendezvous (and would
+        serialize on TPU). Memoized per engine instance."""
+        if getattr(self, "_engine_fn", None) is not None:
+            return self._engine_fn
+        from ...zero_bubble import run_pipeline_train
+        plan = self._compiled_plan
+        S = self._pp_degree
+        n_leaves = plan["n_leaves"]
+        template = plan["groups"][0]
+        template_params = [p for l in template for p in l.parameters()]
+        epi_layers = plan["epilogue"]
+        epi_refs = [p for l in epi_layers for p in l.parameters()]
+        mesh = self._hcg.global_mesh
+        axis = self._hcg.pp_axis_name
+        schedule = self._schedule
+        loss_layer = self._layers._loss_fn
+        stage_fn = _make_stage_fn(template, template_params)
+
+        def epi_fn(y, tgt, epi_leaves):
+            originals = [(p, p._data) for p in epi_refs]
+            try:
+                for p, a in zip(epi_refs, epi_leaves):
+                    p._data = a
+                t = Tensor(y)
+                with no_grad():
+                    for l in epi_layers:
+                        t = l(t)
+                    loss = loss_layer(t, Tensor(tgt))
+                return loss.jax().astype(jnp.float32).reshape(())
+            finally:
+                for p, a in originals:
+                    p._data = a
+
+        def engine_call(body_leaves, hm, tgt_micro, epi_leaves):
+            # stack [S, ...] inside the program so it fuses (and so no
+            # eager per-leaf dispatch happens on the host each step)
+            stacked = tuple(
+                jnp.stack([body_leaves[g * n_leaves + i]
+                           for g in range(S)])
+                for i in range(n_leaves))
+            loss, dp, _y, dx_micro, depi = run_pipeline_train(
+                stage_fn, None, stacked, hm, tgt_micro, mesh,
+                axis_name=axis, schedule=schedule,
+                epi_fn=epi_fn, epi_params=epi_leaves)
+            body_grads = tuple(dp[i][g] for g in range(S)
+                               for i in range(n_leaves))
+            return loss, body_grads, dx_micro, depi
+
+        # Replicate every output at the jit boundary: params are
+        # replicated, so grads must come back replicated too — otherwise
+        # each eager optimizer update op would trigger its own resharding
+        # collective (deadlock-prone on XLA:CPU, serialized on TPU).
+        from jax.sharding import NamedSharding, PartitionSpec
+        repl = NamedSharding(mesh, PartitionSpec())
+        out_sh = (repl, tuple(repl for _ in range(S * n_leaves)), repl,
+                  tuple(repl for _ in range(len(epi_refs))))
+        self._engine_fn = jax.jit(engine_call, out_shardings=out_sh)
+        return self._engine_fn
+
+    def _explicit_loss(self, h_micro, labels):
+        """Run the explicit tick engine (zero_bubble.py) as ONE tape op.
+
+        The engine computes the loss AND every gradient in its forward
+        pass (its backward IS the schedule); a manual GradNode hands the
+        precomputed grads to the enclosing backward, scaled by the
+        incoming cotangent — so prologue params still get their grads via
+        dx_micro and paddle's loss.backward()/opt.step() flow unchanged."""
+        plan = self._compiled_plan
+        epi_refs = [p for l in plan["epilogue"] for p in l.parameters()]
+        body_refs = [p for gp in plan["group_params"] for p in gp]
+
+        body_leaves = tuple(p._data for p in body_refs)
+        epi_leaves = tuple(p._data for p in epi_refs)
+        tgt = labels._data if isinstance(labels, Tensor) \
+            else jnp.asarray(labels)
+        M = h_micro.shape[0]
+        tgt_micro = jnp.reshape(tgt, (M, tgt.shape[0] // M) + tgt.shape[1:])
+
+        loss, body_grads, dx_micro, depi = self._engine_jit()(
+            body_leaves, h_micro._data, tgt_micro, epi_leaves)
+
+        # hand the precomputed grads to the tape
+        parents = [h_micro] + body_refs + list(epi_refs)
+        grads = [dx_micro] + list(body_grads) + list(depi)
+        tr = current_tracking()
+        if tr is not None:
+            for p in parents[1:]:
+                if p.persistable:
+                    tr.record_read(p)
+        needs = _core._grad_state.enabled and any(
+            not p._stop_gradient for p in parents)
+        loss_t = Tensor(loss, stop_gradient=not needs)
+        if needs:
+            pairs = [(p, g) for p, g in zip(parents, grads)
+                     if not p._stop_gradient]
+            node = GradNode(
+                lambda ct: tuple(ct * g for _, g in pairs),
+                [p for p, _ in pairs], 1, name="pipeline_explicit",
+                out_avals=[(loss.shape, loss.dtype)])
+            loss_t._node, loss_t._out_idx = node, 0
+        return loss_t
+
+    def _train_batch_explicit(self, inputs, labels, optimizer,
+                              lr_scheduler=None, scaler=None):
+        h_micro, _b = self._prologue_micro(inputs)
+        loss = self._explicit_loss(h_micro, labels) / float(
+            self.accumulate_steps)
+        loss.backward()
+        if scaler is not None:
+            scaler.step(optimizer)
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return loss
 
     # ---- train / eval ----------------------------------------------------
 
@@ -185,6 +353,9 @@ class PipelineParallel:
         """Microbatch-accumulated step; one optimizer step. Returns the
         mean loss (paddle semantics)."""
         inputs, labels = data
+        if self._compiled_plan is not None and self._schedule != "fthenb":
+            return self._train_batch_explicit(inputs, labels, optimizer,
+                                              lr_scheduler, scaler)
         if self._compiled_plan is not None:
             out = self._forward_compiled(inputs)
             loss = self._layers._loss_fn(out, labels)

@@ -212,7 +212,7 @@ from .pipeline import _vary  # noqa: E402 — shared pcast/pvary shim
 
 def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
                         tgt_micro, axis_name, n_stages,
-                        schedule="zb_h1"):
+                        schedule="zb_h1", epi_fn=None, epi_params=None):
     """Run one pipelined train step inside a shard_map region.
 
     stage_fn(params_one_stage, x) -> y, shape/dtype preserving.
@@ -223,7 +223,15 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
     x_micro, tgt_micro: [M, ...] replicated over the pipe axis.
     n_stages: static pipe-axis size (the mesh shape).
 
-    Returns (loss, dparams, y_micro): loss replicated after psum;
+    Full-model mode (``epi_fn`` given): the last-stage loss becomes
+    ``epi_fn(y, tgt, epi_params)`` — the PipelineLayer's epilogue
+    (norm/head) + loss, with ``epi_params`` a replicated pytree — and the
+    engine additionally returns the gradients an enclosing autograd tape
+    needs: d(loss)/d(x_micro) (for the prologue/embedding backward) and
+    d(loss)/d(epi_params).
+
+    Returns (loss, dparams, y_micro), or with ``epi_fn``:
+    (loss, dparams, y_micro, dx_micro, depi). loss replicated after psum;
     dparams matches stage_params' local structure; y_micro [M, ...]
     last-stage outputs.
     """
@@ -240,16 +248,35 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
     # bound the 1F1B/ZB schedules exist to provide)
     kx, kg = _buffer_slots(op_np, mb_np, S, M, split_w)
 
+    full_model = epi_fn is not None
+    epi = epi_params if full_model else ()
+    has_epi_params = bool(jax.tree.leaves(epi))
+
     p_local = jax.tree.map(lambda q: lax.index_in_dim(q, 0, 0, False),
                            stage_params)
+    # Differentiating wrt an UNVARIED value under the device-varying
+    # lax.cond(is_last, ...) would make jax insert the pvary-transpose
+    # psum INSIDE the last-stage-only branch — a collective that only one
+    # device reaches (deadlock). Cast epi params varying up front so
+    # their grads stay local; the single psum at the end does the reduce.
+    epi_v = jax.tree.map(lambda q: _vary(q, axis_name), epi)
 
     def apply_stage(p, x):
         return stage_fn(p, x)
 
+    def last_loss(pp, xx, ee, tgt):
+        y = apply_stage(pp, xx)
+        return epi_fn(y, tgt, ee) if full_model else loss_fn(y, tgt)
+
     xbuf0 = _vary(jnp.zeros((kx,) + mb_shape, x_micro.dtype), axis_name)
     ybuf0 = _vary(jnp.zeros_like(x_micro), axis_name)
     gbuf0 = _vary(jnp.zeros((kg,) + mb_shape, x_micro.dtype), axis_name)
+    dxbuf0 = _vary(jnp.zeros_like(x_micro), axis_name)
     dp0 = jax.tree.map(jnp.zeros_like, stage_params)
+    # epi_params arrive replicated (P()); the accumulator must be varying
+    # over the pipe axis like every other carry buffer
+    depi0 = jax.tree.map(
+        lambda q: _vary(jnp.zeros_like(q), axis_name), epi)
     # branch outputs must agree on varying-axis type: every constant a
     # branch can return is pre-cast to varying over the pipe axis
     zeros_mb = _vary(jnp.zeros(mb_shape, x_micro.dtype), axis_name)
@@ -257,6 +284,8 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
     zero_dp = jax.tree.map(
         lambda q: _vary(jnp.zeros(q.shape[1:], q.dtype), axis_name),
         stage_params)
+    zero_depi = jax.tree.map(
+        lambda q: _vary(jnp.zeros_like(q), axis_name), epi)
     fmsg0 = zeros_mb
     bmsg0 = zeros_mb
     loss0 = _vary(jnp.zeros((), jnp.float32), axis_name)
@@ -265,7 +294,7 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
     bwd_perm = [(i + 1, i) for i in range(S - 1)]
 
     def tick(carry, t):
-        xbuf, ybuf, gbuf, dp, loss, fmsg, bmsg = carry
+        xbuf, ybuf, gbuf, dxbuf, dp, depi, loss, fmsg, bmsg = carry
         tm1 = jnp.maximum(t - 1, 0)
         my_op = op_table[d, t]
         my_m = mb_table[d, t]
@@ -292,96 +321,138 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
         x = lax.dynamic_index_in_dim(xbuf, my_m % kx, 0, False)
         tgt = lax.dynamic_index_in_dim(tgt_micro, my_m, 0, False)
         is_last = d == S - 1
+        is_first = d == 0
 
-        def do_nop(xb, yb, gb, dp, loss):
-            return xb, yb, gb, dp, loss, zeros_mb, zeros_mb
+        def do_nop(xb, yb, gb, dxb, dp, depi, loss):
+            return xb, yb, gb, dxb, dp, depi, loss, zeros_mb, zeros_mb
 
-        def do_f(xb, yb, gb, dp, loss):
+        def do_f(xb, yb, gb, dxb, dp, depi, loss):
             y = apply_stage(p_local, x)
             cury = lax.dynamic_index_in_dim(yb, my_m, 0, False)
             yb = lax.dynamic_update_index_in_dim(
                 yb, jnp.where(is_last, y, cury), my_m, 0)
-            return xb, yb, gb, dp, loss, y, zeros_mb
+            return xb, yb, gb, dxb, dp, depi, loss, y, zeros_mb
 
-        def do_b(xb, yb, gb, dp, loss):
+        def do_b(xb, yb, gb, dxb, dp, depi, loss):
             dy = lax.dynamic_index_in_dim(gb, my_m % kg, 0, False)
 
             def last_branch(_):
                 if split_w:
                     lm, dx = jax.value_and_grad(
-                        lambda xx: loss_fn(apply_stage(p_local, xx),
-                                           tgt))(x)
-                    return lm.astype(jnp.float32), dx, zero_dp
-                lm, (dpm, dx) = jax.value_and_grad(
-                    lambda pp, xx: loss_fn(apply_stage(pp, xx), tgt),
-                    argnums=(0, 1))(p_local, x)
-                return lm.astype(jnp.float32), dx, dpm
+                        lambda xx: last_loss(p_local, xx, epi_v, tgt))(x)
+                    return lm.astype(jnp.float32), dx, zero_dp, zero_depi
+                if has_epi_params:
+                    lm, (dpm, dx, depim) = jax.value_and_grad(
+                        last_loss, argnums=(0, 1, 2))(p_local, x, epi_v,
+                                                      tgt)
+                else:
+                    lm, (dpm, dx) = jax.value_and_grad(
+                        last_loss, argnums=(0, 1))(p_local, x, epi_v, tgt)
+                    depim = zero_depi
+                return lm.astype(jnp.float32), dx, dpm, depim
 
             def mid_branch(_):
                 if split_w:
                     _, vjp = jax.vjp(
                         lambda xx: apply_stage(p_local, xx), x)
                     (dx,) = vjp(dy)
-                    return zero_loss, dx, zero_dp
+                    return zero_loss, dx, zero_dp, zero_depi
                 _, vjp = jax.vjp(apply_stage, p_local, x)
                 dpm, dx = vjp(dy)
-                return zero_loss, dx, dpm
+                return zero_loss, dx, dpm, zero_depi
 
-            lm, dx, dpm = lax.cond(is_last, last_branch, mid_branch, None)
+            lm, dx, dpm, depim = lax.cond(is_last, last_branch,
+                                          mid_branch, None)
             dp = jax.tree.map(lambda a, g: a + g[None], dp, dpm)
-            return xb, yb, gb, dp, loss + lm, zeros_mb, dx
+            depi = jax.tree.map(jnp.add, depi, depim)
+            # stage 0's input gradient feeds the enclosing tape's
+            # prologue backward; other stages ship dx over ICI instead
+            curdx = lax.dynamic_index_in_dim(dxb, my_m, 0, False)
+            dxb = lax.dynamic_update_index_in_dim(
+                dxb, jnp.where(is_first, dx, curdx), my_m, 0)
+            return xb, yb, gb, dxb, dp, depi, loss + lm, zeros_mb, dx
 
-        def do_w(xb, yb, gb, dp, loss):
+        def do_w(xb, yb, gb, dxb, dp, depi, loss):
             dy = lax.dynamic_index_in_dim(gb, my_m % kg, 0, False)
 
             def last_branch(_):
-                return jax.grad(
-                    lambda pp: loss_fn(apply_stage(pp, x), tgt))(p_local)
+                if has_epi_params:
+                    dpm, depim = jax.grad(
+                        last_loss, argnums=(0, 2))(p_local, x, epi_v, tgt)
+                    return dpm, depim
+                dpm = jax.grad(last_loss)(p_local, x, epi_v, tgt)
+                return dpm, zero_depi
 
             def mid_branch(_):
                 _, vjp = jax.vjp(lambda pp: apply_stage(pp, x), p_local)
                 (dpm,) = vjp(dy)
-                return dpm
+                return dpm, zero_depi
 
-            dpm = lax.cond(is_last, last_branch, mid_branch, None)
+            dpm, depim = lax.cond(is_last, last_branch, mid_branch, None)
             dp = jax.tree.map(lambda a, g: a + g[None], dp, dpm)
-            return xb, yb, gb, dp, loss, zeros_mb, zeros_mb
+            depi = jax.tree.map(jnp.add, depi, depim)
+            return xb, yb, gb, dxb, dp, depi, loss, zeros_mb, zeros_mb
 
-        xbuf, ybuf, gbuf, dp, loss, fout, bout = lax.switch(
-            my_op, [do_nop, do_f, do_b, do_w], xbuf, ybuf, gbuf, dp, loss)
+        xbuf, ybuf, gbuf, dxbuf, dp, depi, loss, fout, bout = lax.switch(
+            my_op, [do_nop, do_f, do_b, do_w],
+            xbuf, ybuf, gbuf, dxbuf, dp, depi, loss)
 
         fmsg_n = lax.ppermute(fout, axis_name, fwd_perm)
         bmsg_n = lax.ppermute(bout, axis_name, bwd_perm)
-        return (xbuf, ybuf, gbuf, dp, loss, fmsg_n, bmsg_n), None
+        return (xbuf, ybuf, gbuf, dxbuf, dp, depi, loss,
+                fmsg_n, bmsg_n), None
 
-    carry0 = (xbuf0, ybuf0, gbuf0, dp0, loss0, fmsg0, bmsg0)
-    (xbuf, ybuf, gbuf, dp, loss, _, _), _ = lax.scan(
+    carry0 = (xbuf0, ybuf0, gbuf0, dxbuf0, dp0, depi0, loss0, fmsg0, bmsg0)
+    (xbuf, ybuf, gbuf, dxbuf, dp, depi, loss, _, _), _ = lax.scan(
         tick, carry0, jnp.arange(T))
     last_mask = d == S - 1
     loss = lax.psum(jnp.where(last_mask, loss, 0.0), axis_name)
     y_micro = lax.psum(ybuf * last_mask.astype(ybuf.dtype), axis_name)
-    return loss, dp, y_micro
+    if not full_model:
+        return loss, dp, y_micro
+    first_mask = (d == 0).astype(dxbuf.dtype)
+    dx_micro = lax.psum(dxbuf * first_mask, axis_name)
+    depi = jax.tree.map(lambda q: lax.psum(q, axis_name), depi)
+    return loss, dp, y_micro, dx_micro, depi
 
 
 def run_pipeline_train(stage_fn, loss_fn, stacked_params, x_micro,
                        tgt_micro, mesh, axis_name="pipe",
-                       schedule="zb_h1"):
+                       schedule="zb_h1", epi_fn=None, epi_params=None):
     """Global-view entry: partial-manual shard_map over the pipe axis.
 
     stacked_params leaves: [S, ...] sharded on dim 0 over ``axis_name``.
-    Returns (loss_sum, dparams [S, ...] stacked, y_micro [M, ...]).
-    """
+    Returns (loss_sum, dparams [S, ...] stacked, y_micro [M, ...]); with
+    ``epi_fn`` (full-model mode, see pipeline_train_spmd) additionally
+    (..., dx_micro [M, ...], depi)."""
     from jax.sharding import PartitionSpec as P
 
     S = int(mesh.shape[axis_name])
     pspecs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    if epi_fn is None:
+        f = jax.shard_map(
+            functools.partial(pipeline_train_spmd, stage_fn, loss_fn,
+                              axis_name=axis_name, n_stages=S,
+                              schedule=schedule),
+            mesh=mesh,
+            in_specs=(pspecs, P(), P()),
+            out_specs=(P(), pspecs, P()),
+            axis_names={axis_name},
+        )
+        return f(stacked_params, x_micro, tgt_micro)
+    epi_specs = jax.tree.map(lambda _: P(), epi_params)
+
+    def wrapped(sp, xm, tm, ep):
+        return pipeline_train_spmd(stage_fn, loss_fn, sp, xm, tm,
+                                   axis_name=axis_name, n_stages=S,
+                                   schedule=schedule, epi_fn=epi_fn,
+                                   epi_params=ep)
+
     f = jax.shard_map(
-        functools.partial(pipeline_train_spmd, stage_fn, loss_fn,
-                          axis_name=axis_name, n_stages=S,
-                          schedule=schedule),
+        wrapped,
         mesh=mesh,
-        in_specs=(pspecs, P(), P()),
-        out_specs=(P(), pspecs, P()),
+        in_specs=(pspecs, P(), P(), epi_specs),
+        out_specs=(P(), pspecs, P(), P(), epi_specs),
         axis_names={axis_name},
     )
-    return f(stacked_params, x_micro, tgt_micro)
+    return f(stacked_params, x_micro, tgt_micro, epi_params)

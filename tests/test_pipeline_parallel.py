@@ -227,3 +227,75 @@ def test_pipeline_interleaved_train_parity(pipe_fleet):
     seq_losses = run(False)
     np.testing.assert_allclose(pp_losses, seq_losses, rtol=2e-4, atol=2e-4)
     assert pp_losses[-1] < pp_losses[0]
+
+
+# --------------------------------------------------------------------------
+# explicit schedules through the fleet API (strategy.pipeline_configs)
+# --------------------------------------------------------------------------
+
+def _fleet_schedule_losses(schedule_mode, steps=3):
+    """Drive PipelineParallel the way a user does: fleet.init with
+    strategy.pipeline_configs, fleet.distributed_model, train_batch."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 4, "sharding_degree": 1,
+                               "sep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": schedule_mode}
+    fleet.init(strategy=strategy)
+    try:
+        paddle.seed(42)
+        model = _make_pipe_model()
+        engine = fleet.fleet.distributed_model(model)
+        assert isinstance(engine, PipelineParallel)
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 16).astype(np.float32))
+        y = paddle.to_tensor(
+            np.random.RandomState(1).randn(8, 1).astype(np.float32))
+        return [float(engine.train_batch((x, y), opt).item())
+                for _ in range(steps)]
+    finally:
+        fleet.fleet._hcg = None
+        fleet.fleet._topology = None
+        fleet.fleet._is_initialized = False
+
+
+def _sequential_reference_losses(steps=3):
+    paddle.seed(42)
+    model = _make_pipe_model()
+    engine = PipelineParallel(model, None, accumulate_steps=1)
+    opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(8, 16).astype(np.float32))
+    y = paddle.to_tensor(
+        np.random.RandomState(1).randn(8, 1).astype(np.float32))
+    return [float(engine.train_batch((x, y), opt).item())
+            for _ in range(steps)]
+
+
+@pytest.mark.parametrize("schedule_mode", ["FThenB", "1F1B", "ZB-H1"])
+def test_fleet_schedule_mode_parity(schedule_mode):
+    """Every selectable schedule trains to the same losses as the eager
+    sequential loop on an identically-initialized model."""
+    losses = _fleet_schedule_losses(schedule_mode)
+    ref = _sequential_reference_losses()
+    np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-4)
+    assert losses[-1] < losses[0]
+
+
+def test_fleet_schedule_mode_unknown():
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 4, "sharding_degree": 1,
+                               "sep_degree": 1}
+    strategy.pipeline_configs = {"schedule_mode": "bogus"}
+    fleet.init(strategy=strategy)
+    try:
+        model = _make_pipe_model()
+        with pytest.raises(ValueError, match="schedule_mode"):
+            fleet.fleet.distributed_model(model)
+    finally:
+        fleet.fleet._hcg = None
+        fleet.fleet._topology = None
+        fleet.fleet._is_initialized = False
