@@ -287,6 +287,12 @@ class PipelineParallel:
         out_sh = (repl, tuple(repl for _ in range(S * n_leaves)), repl,
                   tuple(repl for _ in range(len(epi_refs))))
         self._engine_fn = jax.jit(engine_call, out_shardings=out_sh)
+        # fixed once the plan exists; cached so the hot loop doesn't walk
+        # every layer's parameters each step (ordering must match
+        # engine_call's body_leaves[g*n_leaves+i] layout)
+        self._engine_body_refs = [p for gp in plan["group_params"]
+                                  for p in gp]
+        self._engine_epi_refs = epi_refs
         return self._engine_fn
 
     def _explicit_loss(self, h_micro, labels):
@@ -297,9 +303,9 @@ class PipelineParallel:
         precomputed grads to the enclosing backward, scaled by the
         incoming cotangent — so prologue params still get their grads via
         dx_micro and paddle's loss.backward()/opt.step() flow unchanged."""
-        plan = self._compiled_plan
-        epi_refs = [p for l in plan["epilogue"] for p in l.parameters()]
-        body_refs = [p for gp in plan["group_params"] for p in gp]
+        engine = self._engine_jit()
+        body_refs = self._engine_body_refs
+        epi_refs = self._engine_epi_refs
 
         body_leaves = tuple(p._data for p in body_refs)
         epi_leaves = tuple(p._data for p in epi_refs)
@@ -308,7 +314,7 @@ class PipelineParallel:
         M = h_micro.shape[0]
         tgt_micro = jnp.reshape(tgt, (M, tgt.shape[0] // M) + tgt.shape[1:])
 
-        loss, body_grads, dx_micro, depi = self._engine_jit()(
+        loss, body_grads, dx_micro, depi = engine(
             body_leaves, h_micro._data, tgt_micro, epi_leaves)
 
         # hand the precomputed grads to the tape

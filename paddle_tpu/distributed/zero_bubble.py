@@ -271,7 +271,10 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
     xbuf0 = _vary(jnp.zeros((kx,) + mb_shape, x_micro.dtype), axis_name)
     ybuf0 = _vary(jnp.zeros_like(x_micro), axis_name)
     gbuf0 = _vary(jnp.zeros((kg,) + mb_shape, x_micro.dtype), axis_name)
-    dxbuf0 = _vary(jnp.zeros_like(x_micro), axis_name)
+    # the [M, ...] input-gradient bank exists only in full-model mode —
+    # plain callers keep the K-slot memory bound (None = empty pytree)
+    dxbuf0 = _vary(jnp.zeros_like(x_micro), axis_name) \
+        if full_model else None
     dp0 = jax.tree.map(jnp.zeros_like, stage_params)
     # epi_params arrive replicated (P()); the accumulator must be varying
     # over the pipe axis like every other carry buffer
@@ -365,11 +368,12 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
                                           mid_branch, None)
             dp = jax.tree.map(lambda a, g: a + g[None], dp, dpm)
             depi = jax.tree.map(jnp.add, depi, depim)
-            # stage 0's input gradient feeds the enclosing tape's
-            # prologue backward; other stages ship dx over ICI instead
-            curdx = lax.dynamic_index_in_dim(dxb, my_m, 0, False)
-            dxb = lax.dynamic_update_index_in_dim(
-                dxb, jnp.where(is_first, dx, curdx), my_m, 0)
+            if full_model:
+                # stage 0's input gradient feeds the enclosing tape's
+                # prologue backward; other stages ship dx over ICI
+                curdx = lax.dynamic_index_in_dim(dxb, my_m, 0, False)
+                dxb = lax.dynamic_update_index_in_dim(
+                    dxb, jnp.where(is_first, dx, curdx), my_m, 0)
             return xb, yb, gb, dxb, dp, depi, loss + lm, zeros_mb, dx
 
         def do_w(xb, yb, gb, dxb, dp, depi, loss):

@@ -223,7 +223,14 @@ class ChainTransform(Transform):
         total = None
         for t in self.transforms:
             ld = t._fldj(x)
-            # reduce finer-grained ldj to this chain's event rank
+            # reduce finer-grained ldj down to this chain's event rank
+            # BEFORE accumulating: an elementwise transform's per-element
+            # ldj must sum over the event dims a rank>0 transform (e.g.
+            # StickBreakingTransform) treats as one event, or the shapes
+            # broadcast-add and the result is wrong
+            extra = self._event_rank - t._event_rank
+            if extra > 0:
+                ld = jnp.sum(ld, axis=tuple(range(-extra, 0)))
             total = ld if total is None else total + ld
             x = t._forward(x)
         return total

@@ -53,15 +53,29 @@ def _out_size(out_size, dst, x_rows):
 
 
 def _make_segment_op(pool_type):
-    def op(data, segment_ids, name=None):
+    def op(data, segment_ids, name=None, num_segments=None):
         d = as_tensor(data)
         ids = as_tensor(segment_ids)
-        n = int(np.asarray(ids.jax()).max()) + 1 if ids.shape[0] else 0
+        if num_segments is not None:
+            n = int(num_segments)
+        else:
+            arr = ids.jax()
+            if isinstance(arr, jax.core.Tracer):
+                # ConcretizationTypeError so to_static treats this as a
+                # graph break (eager fallback) instead of a hard error
+                raise jax.errors.ConcretizationTypeError(
+                    arr,
+                    f"segment_{pool_type}: cannot infer the segment count "
+                    "from traced segment_ids; pass num_segments= to keep "
+                    "this op inside a compiled graph")
+            n = int(np.asarray(arr).max()) + 1 if ids.shape[0] else 0
         return apply(lambda a, i: _segment_reduce(a, i, pool_type, n),
                      d, ids, name=f"segment_{pool_type}")
     op.__name__ = f"segment_{pool_type}"
     op.__doc__ = (f"Segment {pool_type} over the leading axis "
-                  f"(paddle.geometric.segment_{pool_type}).")
+                  f"(paddle.geometric.segment_{pool_type}). The inferred "
+                  f"segment count is eager-only; pass num_segments when "
+                  f"tracing.")
     return op
 
 

@@ -320,8 +320,9 @@ class RandomErasing:
         if np.random.rand() >= self.prob:
             return img
         arr = np.asarray(img._data if isinstance(img, Tensor) else img)
-        hwc = arr.ndim == 3 and arr.shape[-1] in (1, 3, 4)
-        h, w = (arr.shape[:2] if hwc or arr.ndim == 2 else arr.shape[-2:])
+        # same convention as F.erase: Tensor is CHW, ndarray/PIL is HWC
+        hwc = not (isinstance(img, Tensor) and arr.ndim >= 3)
+        h, w = (arr.shape[:2] if hwc else arr.shape[-2:])
         area = h * w
         for _ in range(10):
             target = area * np.random.uniform(*self.scale)

@@ -214,8 +214,15 @@ def erase(img, i, j, h, w, v, inplace=False):
     # the mutation)
     if not inplace or isinstance(img, Tensor):
         arr = arr.copy()
-    if arr.ndim == 3 and arr.shape[-1] in (1, 3, 4):  # HWC
+    # paddle semantics: Tensor input is CHW, ndarray/PIL input is HWC.
+    # Keying on the input type (not a shape[-1] in (1,3,4) guess) means a
+    # CHW image whose width happens to be 1/3/4 is not misclassified.
+    # Batched (ndim>=4) arrays are NCHW either way.
+    if (isinstance(img, Tensor) and arr.ndim >= 3) or arr.ndim >= 4:
+        v_arr = np.asarray(v, dtype=arr.dtype)
+        if v_arr.ndim == 1:  # per-channel values -> broadcast over H, W
+            v_arr = v_arr.reshape(-1, 1, 1)
+        arr[..., i:i + h, j:j + w] = v_arr
+    else:  # HWC or 2-D
         arr[i:i + h, j:j + w] = v
-    else:  # CHW
-        arr[..., i:i + h, j:j + w] = v
     return _like(arr, img)

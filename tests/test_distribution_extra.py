@@ -211,3 +211,31 @@ class TestRegisterKL:
         kl = float(D.kl_divergence(p, q).item())
         ref = math.log(2.0) + (1 + 1) / (2 * 4) - 0.5
         np.testing.assert_allclose(kl, ref, rtol=1e-5)
+
+
+class TestChainMixedEventRank:
+    def test_chain_elementwise_then_stickbreaking_ldj(self):
+        """A rank-0 (elementwise) transform chained with a rank-1 one:
+        each ldj must reduce to the chain's event rank before summing —
+        the result is one scalar per batch element, not a vector."""
+        chain = D.ChainTransform([D.ExpTransform(),
+                                  D.StickBreakingTransform()])
+        x = paddle.to_tensor(np.array([0.3, -0.2, 0.8], "float32"))
+        ld = chain.forward_log_det_jacobian(x)
+        assert list(ld.shape) == []  # scalar: chain event rank is 1
+
+        # value check: exp ldj summed over the event dim + stick ldj at y
+        exp_ld = float(np.sum(x.numpy()))
+        y = D.ExpTransform().forward(x)
+        stick_ld = float(
+            D.StickBreakingTransform().forward_log_det_jacobian(y).numpy())
+        np.testing.assert_allclose(float(ld.numpy()),
+                                   exp_ld + stick_ld, rtol=1e-5)
+
+    def test_chain_batched_mixed_rank(self):
+        chain = D.ChainTransform([D.ExpTransform(),
+                                  D.StickBreakingTransform()])
+        xb = paddle.to_tensor(
+            np.random.RandomState(0).randn(5, 3).astype("float32"))
+        ld = chain.forward_log_det_jacobian(xb)
+        assert list(ld.shape) == [5]

@@ -182,3 +182,38 @@ class TestTextDatasets:
     def test_missing_file_raises(self):
         with pytest.raises(RuntimeError, match="no network access"):
             text.UCIHousing(data_file="/nonexistent/housing.data")
+
+
+class TestSegmentNumSegments:
+    def test_explicit_num_segments(self):
+        data = paddle.to_tensor(
+            np.array([[1., 2.], [3., 4.]], "float32"))
+        ids = paddle.to_tensor(np.array([0, 1], "int64"))
+        out = geometric.segment_sum(data, ids, num_segments=4).numpy()
+        assert out.shape == (4, 2)
+        np.testing.assert_allclose(out[2:], 0.0)
+
+    def test_traced_infer_breaks_graph_with_hint(self):
+        """Without num_segments, tracing breaks the graph (eager fallback
+        with a clear hint); with it, the op stays compiled."""
+        data = np.array([[1., 2.], [3., 4.]], "float32")
+        ids = np.array([0, 1], "int64")
+
+        @paddle.jit.to_static
+        def infer(d, i):
+            return geometric.segment_sum(d, i)
+
+        dt, it = paddle.to_tensor(data), paddle.to_tensor(ids)
+        out0 = infer(dt, it).numpy()          # discovery: eager, fine
+        with pytest.warns(UserWarning, match="num_segments"):
+            out1 = infer(dt, it).numpy()      # compile attempt -> break
+        np.testing.assert_allclose(out0, [[1., 2.], [3., 4.]])
+        np.testing.assert_allclose(out1, [[1., 2.], [3., 4.]])
+
+        @paddle.jit.to_static
+        def compiled(d, i):
+            return geometric.segment_sum(d, i, num_segments=2)
+
+        compiled(dt, it)
+        out = compiled(dt, it).numpy()        # second call: compiled
+        np.testing.assert_allclose(out, [[1., 2.], [3., 4.]])

@@ -337,3 +337,20 @@ class TestModelZoo:
         loss.backward()
         opt.step()
         assert np.isfinite(float(loss.item()))
+
+
+class TestEraseDataFormat:
+    def test_erase_tensor_is_chw_even_with_ambiguous_width(self):
+        """A CHW tensor whose width is 3 must NOT be treated as HWC:
+        Tensor inputs are CHW by convention (upstream parity)."""
+        t = paddle.to_tensor(np.zeros((3, 8, 3), "float32"))  # C,H,W=3,8,3
+        out = transforms.functional.erase(t, 0, 0, 2, 2, 1.0).numpy()
+        # erased rect spans ALL channels at rows 0:2, cols 0:2
+        np.testing.assert_allclose(out[:, 0:2, 0:2], 1.0)
+        np.testing.assert_allclose(out[:, 2:, :], 0.0)
+
+    def test_erase_ndarray_is_hwc(self):
+        a = np.zeros((8, 8, 3), "float32")
+        out = np.asarray(transforms.functional.erase(a, 0, 0, 2, 2, 1.0))
+        np.testing.assert_allclose(out[0:2, 0:2, :], 1.0)
+        np.testing.assert_allclose(out[2:, :, :], 0.0)
