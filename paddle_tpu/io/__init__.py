@@ -1,18 +1,29 @@
 """``paddle.io`` — Dataset / DataLoader / samplers
 (python/paddle/io/ parity, UNVERIFIED).
 
-TPU-first notes: the DataLoader feeds numpy batches converted to jax arrays;
-worker parallelism uses threads (jax arrays are produced on the host side
-anyway, and XLA transfers overlap with compute). ``num_workers`` > 0 uses a
-background prefetch thread pool rather than fork-based workers."""
+TPU-first notes: the DataLoader feeds numpy batches converted to jax
+arrays. ``num_workers > 0`` on a map-style dataset spawns real subprocess
+workers (spawn context; index queue -> result queue with ordered
+reassembly), so Python-heavy transforms run outside the trainer's GIL —
+the same process model as the reference's DataLoader. Workers collate to
+numpy; tensors materialize on device only in the trainer process (a data
+worker must never initialize the TPU client). When the dataset /
+collate_fn / worker_init_fn can't be pickled for spawn, the loader warns
+and falls back to a prefetch thread pool; IterableDataset streams use a
+single background producer thread (the stream itself is sequential)."""
 
 from __future__ import annotations
 
 import collections
 import itertools
 import math
+import multiprocessing
+import os
+import pickle
 import queue
 import threading
+import time
+import warnings
 from typing import Iterable, Iterator
 
 import numpy as np
@@ -261,25 +272,47 @@ class DistributedBatchSampler(BatchSampler):
 
 # ---- collate / loader -----------------------------------------------------
 
-def default_collate_fn(batch):
+class _TensorPayload:
+    """Marks 'this numpy array becomes a Tensor in the trainer process'.
+    Plain numpy arrays from a user collate_fn pass through untouched."""
+
+    __slots__ = ("array",)
+
+    def __init__(self, array):
+        self.array = array
+
+
+def _collate_impl(batch, stack, leaf):
+    """One collate structure, two leaf constructors: Tensor in the trainer
+    process (default_collate_fn), _TensorPayload in subprocess workers
+    (_np_collate) — so the type dispatch can't silently diverge."""
     sample = batch[0]
     if isinstance(sample, Tensor):
-        from ..native import parallel_stack
-        return Tensor(parallel_stack([np.asarray(s._data) for s in batch]))
+        return leaf(stack([np.asarray(s._data) for s in batch]))
     if isinstance(sample, np.ndarray):
-        from ..native import parallel_stack
-        return Tensor(parallel_stack(batch))
-    if isinstance(sample, (int, float)):
-        return Tensor(np.asarray(batch))
+        return leaf(stack(list(batch)))
+    if isinstance(sample, (int, float, np.integer, np.floating)):
+        return leaf(np.asarray(batch))
     if isinstance(sample, (str, bytes)):
-        return batch
+        return list(batch)
     if isinstance(sample, dict):
-        return {k: default_collate_fn([s[k] for s in batch])
+        return {k: _collate_impl([s[k] for s in batch], stack, leaf)
                 for k in sample}
     if isinstance(sample, (list, tuple)):
-        return type(sample)(default_collate_fn(list(items))
+        return type(sample)(_collate_impl(list(items), stack, leaf)
                             for items in zip(*batch))
     return batch
+
+
+def default_collate_fn(batch):
+    from ..native import parallel_stack
+    return _collate_impl(batch, parallel_stack, Tensor)
+
+
+def _np_collate(batch):
+    """default_collate_fn's structure, host-side only: workers stack with
+    numpy and never create device arrays."""
+    return _collate_impl(batch, np.stack, _TensorPayload)
 
 
 class _WorkerInfo:
@@ -296,6 +329,122 @@ def get_worker_info():
     return getattr(_worker_info, "info", None)
 
 
+# ---- subprocess workers ----------------------------------------------------
+
+class _WorkersDied(RuntimeError):
+    """All subprocess workers exited without reporting a result."""
+
+
+def _encode_for_ipc(obj):
+    """Tensor -> _TensorPayload (device arrays can't cross processes)."""
+    if isinstance(obj, Tensor):
+        return _TensorPayload(np.asarray(obj._data))
+    if isinstance(obj, dict):
+        return {k: _encode_for_ipc(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_encode_for_ipc(v) for v in obj)
+    return obj
+
+
+def _decode_from_ipc(obj):
+    if isinstance(obj, _TensorPayload):
+        return Tensor(obj.array)
+    if isinstance(obj, dict):
+        return {k: _decode_from_ipc(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_decode_from_ipc(v) for v in obj)
+    return obj
+
+
+def _mp_worker_loop(dataset, index_q, result_q, user_collate, wid,
+                    num_workers, worker_init_fn):
+    """Subprocess body: pull (epoch, batch_idx, indices) jobs, push
+    (epoch, batch_idx, ok, payload) results. Pins jax (if anything in the
+    worker imports it) to CPU — a data worker must never grab the TPU."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    import traceback
+
+    _worker_info.info = _WorkerInfo(wid, num_workers, dataset)
+    if worker_init_fn is not None:
+        worker_init_fn(wid)
+    collate = user_collate if user_collate is not None else _np_collate
+    while True:
+        job = index_q.get()
+        if job is None:
+            return
+        epoch, bidx, indices = job
+        try:
+            out = collate([dataset[i] for i in indices])
+            if user_collate is not None:
+                out = _encode_for_ipc(out)
+            result_q.put((epoch, bidx, True, out))
+        except Exception as e:  # noqa: BLE001 — forwarded to the trainer
+            try:
+                pickle.dumps(e)
+                payload = (e, traceback.format_exc())
+            except Exception:
+                payload = (None, traceback.format_exc())
+            result_q.put((epoch, bidx, False, payload))
+
+
+class _SpawnPool:
+    """num_workers spawn-context processes around one index queue and one
+    result queue (the reference DataLoader's process model)."""
+
+    def __init__(self, dataset, user_collate, num_workers, worker_init_fn):
+        ctx = multiprocessing.get_context("spawn")
+        self.index_q = ctx.Queue()
+        self.result_q = ctx.Queue()
+        self.workers = []
+        # children inherit the environment at start(): pin them to CPU jax
+        # from interpreter startup (before any unpickling can touch jax)
+        prev = os.environ.get("JAX_PLATFORMS")
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        try:
+            for wid in range(num_workers):
+                p = ctx.Process(
+                    target=_mp_worker_loop,
+                    args=(dataset, self.index_q, self.result_q,
+                          user_collate, wid, num_workers, worker_init_fn),
+                    daemon=True)
+                p.start()
+                self.workers.append(p)
+        except Exception:
+            self.shutdown()
+            raise
+        finally:
+            if prev is None:
+                os.environ.pop("JAX_PLATFORMS", None)
+            else:
+                os.environ["JAX_PLATFORMS"] = prev
+
+    def alive(self):
+        return all(p.is_alive() for p in self.workers)
+
+    def shutdown(self):
+        for _ in self.workers:
+            try:
+                self.index_q.put(None)
+            except Exception:
+                pass
+        for p in self.workers:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        for q_ in (self.index_q, self.result_q):
+            try:
+                q_.close()
+                q_.cancel_join_thread()
+            except Exception:
+                pass
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
@@ -308,6 +457,12 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
         self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self.persistent_workers = persistent_workers
+        self._pool: _SpawnPool | None = None
+        self._pool_active = False  # persistent pool owned by a live iter
+        self._mp_broken = False   # spawn failed once -> stay on threads
+        self._epoch = 0
         self._iterable = isinstance(dataset, IterableDataset)
         if self._iterable:
             self.batch_sampler = None
@@ -346,7 +501,15 @@ class DataLoader:
         if self._iterable:
             yield from self._iter_prefetch_single()
             return
-        yield from self._iter_pool()
+        if self._mp_broken:
+            yield from self._iter_pool()
+            return
+        yield from self._iter_mp()
+
+    def __del__(self):
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown()
 
     def _iter_prefetch_single(self):
         """IterableDataset path: one background producer thread (the stream
@@ -374,6 +537,117 @@ class DataLoader:
                 break
             yield b
         t.join()
+
+    # ---- subprocess path (map-style, the default) ------------------------
+
+    def _iter_mp(self):
+        """Map-style path: num_workers subprocesses; jobs are
+        (epoch, batch_idx, indices); results reassemble strictly in
+        batch-sampler order with a bounded in-flight window."""
+        pool = self._pool
+        # a second concurrent iterator must not share the persistent
+        # pool's result queue (it would steal/drop the first's batches) —
+        # give it a transient pool of its own
+        transient = pool is not None and self._pool_active
+        if transient:
+            pool = None
+        if pool is None:
+            user_collate = (None if self.collate_fn is default_collate_fn
+                            else self.collate_fn)
+            try:
+                pool = _SpawnPool(self.dataset, user_collate,
+                                  self.num_workers, self.worker_init_fn)
+            except Exception as e:
+                warnings.warn(
+                    f"DataLoader: could not spawn subprocess workers "
+                    f"({type(e).__name__}: {e}); the dataset/collate_fn/"
+                    f"worker_init_fn must be picklable. Falling back to "
+                    f"the prefetch thread pool.")
+                self._mp_broken = True
+                yield from self._iter_pool()
+                return
+        persist = self.persistent_workers and not transient
+        if persist:
+            self._pool = pool
+            self._pool_active = True
+        self._epoch += 1
+        epoch = self._epoch
+        window = max(self.num_workers * self.prefetch_factor, 1)
+        it = iter(self.batch_sampler)
+        it_done = False
+        submitted = 0
+        next_yield = 0
+        buf = {}
+        fall_back = False
+
+        def refill():
+            nonlocal submitted, it_done
+            if it_done:
+                return
+            try:
+                pool.index_q.put((epoch, submitted, list(next(it))))
+                submitted += 1
+            except StopIteration:
+                it_done = True
+
+        try:
+            while submitted < window and not it_done:
+                refill()
+            while next_yield < submitted or not it_done:
+                if next_yield in buf:
+                    b = buf.pop(next_yield)
+                    next_yield += 1
+                    refill()
+                    yield b
+                    continue
+                try:
+                    ep, bidx, ok, payload = self._result_get(pool)
+                except _WorkersDied:
+                    if next_yield == 0 and not buf:
+                        # children died before producing anything (e.g.
+                        # the dataset failed to unpickle in the fresh
+                        # interpreter) — the thread pool can still serve
+                        fall_back = True
+                        break
+                    raise RuntimeError(
+                        "DataLoader subprocess workers exited "
+                        "unexpectedly mid-epoch") from None
+                if ep != epoch:   # stale result from an abandoned epoch
+                    continue
+                if not ok:
+                    exc, tb = payload
+                    if exc is not None:
+                        raise exc from RuntimeError(
+                            f"DataLoader worker failed:\n{tb}")
+                    raise RuntimeError(f"DataLoader worker failed:\n{tb}")
+                buf[bidx] = _decode_from_ipc(payload)
+        finally:
+            if persist:
+                self._pool_active = False
+            if not persist or fall_back:
+                if pool is self._pool:
+                    self._pool = None
+                pool.shutdown()
+        if fall_back:
+            warnings.warn(
+                "DataLoader subprocess workers died during startup (the "
+                "dataset may not survive re-import in a spawned "
+                "interpreter); falling back to the prefetch thread pool.")
+            self._mp_broken = True
+            yield from self._iter_pool()
+
+    def _result_get(self, pool):
+        deadline = time.time() + self.timeout if self.timeout else None
+        while True:
+            try:
+                return pool.result_q.get(timeout=1.0)
+            except queue.Empty:
+                if not pool.alive():
+                    raise _WorkersDied() from None
+                if deadline is not None and time.time() > deadline:
+                    raise RuntimeError(
+                        f"DataLoader timed out after {self.timeout}s "
+                        "waiting for a worker batch") from None
 
     def _iter_pool(self):
         """Map-style path: num_workers threads load batches concurrently

@@ -320,9 +320,10 @@ class RandomErasing:
         if np.random.rand() >= self.prob:
             return img
         arr = np.asarray(img._data if isinstance(img, Tensor) else img)
-        # same convention as F.erase: Tensor is CHW, ndarray/PIL is HWC
-        hwc = not (isinstance(img, Tensor) and arr.ndim >= 3)
-        h, w = (arr.shape[:2] if hwc else arr.shape[-2:])
+        # same convention as F.erase: Tensor is CHW, ndarray/PIL is HWC,
+        # and batched (ndim>=4) arrays are NCHW either way
+        chw = (isinstance(img, Tensor) and arr.ndim >= 3) or arr.ndim >= 4
+        h, w = (arr.shape[-2:] if chw else arr.shape[:2])
         area = h * w
         for _ in range(10):
             target = area * np.random.uniform(*self.scale)

@@ -48,10 +48,16 @@ class TestWorkerPool:
         t0 = time.time()
         list(io.DataLoader(ds, batch_size=8, num_workers=0))
         serial = time.time() - t0
-        t0 = time.time()
-        list(io.DataLoader(ds, batch_size=8, num_workers=6))
-        pooled = time.time() - t0
-        assert pooled < serial  # sleep releases the GIL -> real overlap
+        loader = io.DataLoader(ds, batch_size=8, num_workers=6,
+                               persistent_workers=True)
+        try:
+            list(loader)              # warm-up epoch: worker spawn cost
+            t0 = time.time()
+            list(loader)              # steady state: real overlap
+            pooled = time.time() - t0
+        finally:
+            del loader
+        assert pooled < serial
 
     def test_worker_init_fn_and_info(self):
         ids = []
@@ -100,3 +106,132 @@ class TestWorkerPool:
         import pytest
         with pytest.raises(ValueError, match="boom"):
             list(loader)
+
+
+# --------------------------------------------------------------------------
+# subprocess workers (map-style default; VERDICT round-1 item 6)
+# --------------------------------------------------------------------------
+
+import os
+
+import pytest
+
+
+class _PidDataset(io.Dataset):
+    """Module-level (picklable) dataset that records which PROCESS ran the
+    transform for each item."""
+
+    def __init__(self, n=32):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, idx):
+        # the "transform": some numpy work + the worker's pid
+        x = np.full((4,), idx, dtype="float32") * 2.0
+        return x, np.int64(os.getpid())
+
+
+class _PickleBad:
+    def __reduce__(self):
+        raise TypeError("deliberately unpicklable")
+
+
+class TestSubprocessWorkers:
+    def test_transforms_run_in_worker_processes(self):
+        loader = io.DataLoader(_PidDataset(32), batch_size=4,
+                               shuffle=False, num_workers=2)
+        pids = set()
+        vals = []
+        for x, pid in loader:
+            pids.update(pid.numpy().astype(int).tolist())
+            vals.extend((x.numpy()[:, 0] / 2.0).astype(int).tolist())
+        assert os.getpid() not in pids, "items were loaded in-process"
+        assert len(pids) >= 1
+        assert vals == list(range(32))  # strict batch-sampler order
+
+    def test_persistent_workers_reuse_processes(self):
+        loader = io.DataLoader(_PidDataset(16), batch_size=4,
+                               num_workers=2, persistent_workers=True)
+        try:
+            pids1 = {int(p) for _, pid in loader
+                     for p in pid.numpy().astype(int)}
+            pids2 = {int(p) for _, pid in loader
+                     for p in pid.numpy().astype(int)}
+            # same process pool across epochs (a worker may get no jobs
+            # in a given epoch, so subset, not equality)
+            assert pids2 <= pids1
+        finally:
+            del loader
+
+    def test_unpicklable_falls_back_to_threads(self):
+        class LocalDs(io.Dataset):  # locally-defined: not picklable
+            blocker = _PickleBad()
+
+            def __len__(self):
+                return 8
+
+            def __getitem__(self, idx):
+                return np.float32(idx)
+
+        with pytest.warns(UserWarning, match="picklable"):
+            out = [float(b.numpy()[0]) for b in io.DataLoader(
+                LocalDs(), batch_size=8, num_workers=2)]
+        assert out == [0.0]
+
+    def test_worker_exception_type_propagates(self):
+        loader = io.DataLoader(_FailingDataset(), batch_size=2,
+                               num_workers=2)
+        with pytest.raises(ValueError, match="boom at 5"):
+            list(loader)
+
+
+class _FailingDataset(io.Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, idx):
+        if idx == 5:
+            raise ValueError("boom at 5")
+        return np.float32(idx)
+
+
+class _ChildPoisonDataset(io.Dataset):
+    """Pickles fine in the parent but refuses to unpickle in a worker —
+    models datasets that can't survive re-import in a spawned child."""
+
+    def __init__(self):
+        self.n = 8   # real state, so pickle actually calls __setstate__
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, idx):
+        return np.float32(idx)
+
+    def __setstate__(self, state):
+        raise RuntimeError("no unpickling in workers")
+
+
+class TestSubprocessEdgeCases:
+    def test_concurrent_iterators_share_persistent_pool_safely(self):
+        loader = io.DataLoader(_PidDataset(16), batch_size=4,
+                               num_workers=2, persistent_workers=True)
+        try:
+            pairs = list(zip(loader, loader))
+            a = [v for (x, _), _ in pairs
+                 for v in (x.numpy()[:, 0] / 2.0).astype(int)]
+            b = [v for _, (x, _) in pairs
+                 for v in (x.numpy()[:, 0] / 2.0).astype(int)]
+            assert a == list(range(16))
+            assert b == list(range(16))
+        finally:
+            del loader
+
+    def test_child_unpickle_failure_falls_back(self):
+        loader = io.DataLoader(_ChildPoisonDataset(), batch_size=4,
+                               num_workers=2)
+        with pytest.warns(UserWarning, match="thread pool"):
+            out = [b.numpy() for b in loader]
+        assert np.concatenate(out).tolist() == list(range(8))
