@@ -39,18 +39,22 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
     # save/restore existing .grad so paddle.grad is side-effect free;
     # accumulate_ids makes the engine deposit cotangents on the requested
     # inputs even when they are intermediates (non-leaves)
-    saved = [(t, t.grad) for t in _all_leaves(outputs) + inputs]
+    # _grad_value/_grad_stale, not .grad: an internal save/restore must
+    # neither fire nor consume the stale-grad warning
+    saved = [(t, t._grad_value, t._grad_stale)
+             for t in _all_leaves(outputs) + inputs]
     seen_saved = set()
-    saved = [(t, g) for t, g in saved
+    saved = [(t, g, st) for t, g, st in saved
              if not (id(t) in seen_saved or seen_saved.add(id(t)))]
-    for t, _ in saved:
-        t.grad = None
+    for t, _, _ in saved:
+        t._grad_value = None
+        t._grad_stale = False
     try:
         _backward_impl(outputs, grad_outputs, retain_graph=True,
                        accumulate_ids=frozenset(id(t) for t in inputs))
         res = []
         for i, t in enumerate(inputs):
-            if t.grad is None:
+            if t._grad_value is None:
                 if not allow_unused:
                     raise ValueError(
                         f"paddle.grad: input {i} is unreachable from the "
@@ -58,11 +62,12 @@ def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
                         "to get None for such inputs")
                 res.append(None)
             else:
-                res.append(Tensor(t.grad._data))
+                res.append(Tensor(t._grad_value._data))
         return res
     finally:
-        for t, g in saved:
-            t.grad = g
+        for t, g, st in saved:
+            t._grad_value = g
+            t._grad_stale = st
 
 
 def _all_leaves(outputs):

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import contextlib
 import threading
+import warnings
 from typing import Any, Callable, Sequence
 
 import jax
@@ -204,6 +205,106 @@ def track_state(tracking: StateTracking):
 
 
 # --------------------------------------------------------------------------
+# scalar concretization record/replay (to_static guarded specialization)
+# --------------------------------------------------------------------------
+
+class _ConcretizeState(threading.local):
+    """SOT-style branch specialization support. During to_static discovery
+    (eager) every scalar concretization (bool/int of a Tensor) is RECORDED;
+    during the jit trace the same sites REPLAY the recorded value as a
+    python constant and register the traced tensor as a GUARD output, so
+    the compiled program can verify each step that the branch decisions
+    still hold (mismatch -> re-specialize)."""
+
+    def __init__(self):
+        self.mode = None      # None | "record" | "replay"
+        self.log = None       # list of (kind, value)
+        self.cursor = 0
+        self.guards = None    # replay: list of (traced_array, kind, value)
+
+
+_concretize_state = _ConcretizeState()
+
+
+@contextlib.contextmanager
+def record_concretizations(log: list):
+    st = _concretize_state
+    prev = (st.mode, st.log, st.cursor, st.guards)
+    st.mode, st.log, st.cursor, st.guards = "record", log, 0, None
+    try:
+        yield log
+    finally:
+        st.mode, st.log, st.cursor, st.guards = prev
+
+
+@contextlib.contextmanager
+def replay_concretizations(log: list, guards: list):
+    st = _concretize_state
+    prev = (st.mode, st.log, st.cursor, st.guards)
+    st.mode, st.log, st.cursor, st.guards = "replay", log, 0, guards
+    try:
+        yield guards
+    finally:
+        st.mode, st.log, st.cursor, st.guards = prev
+
+
+class GraphBreak(Exception):
+    """Raised during a to_static replay trace when the graph cannot be
+    captured (replay divergence or an unguardable concretization); the
+    to_static runner treats it like jax's tracer errors: warn + eager
+    fallback. A plain exception — jax's ConcretizationTypeError requires
+    a Tracer to construct, and divergence can involve concrete data."""
+
+
+def _replay_divergence(data, why: str):
+    return GraphBreak(
+        f"to_static replay diverged from the discovery run ({why}); "
+        "breaking the graph")
+
+
+def _concretize(data, kind: str, cast):
+    """Single funnel for Tensor scalar conversions (bool/int/float/item)."""
+    st = _concretize_state
+    if st.mode == "replay":
+        if st.cursor >= len(st.log):
+            raise _replay_divergence(data, "more concretizations than "
+                                           "recorded")
+        rec_kind, rec_val = st.log[st.cursor]
+        st.cursor += 1
+        if rec_kind != kind:
+            raise _replay_divergence(
+                data, f"expected {rec_kind}, got {kind}")
+        if isinstance(data, jax.core.Tracer):
+            if not guardable_concretization(kind, rec_val):
+                raise GraphBreak(
+                    f"a {kind} concretization cannot be value-guarded "
+                    "(replaying a stale float would silently change "
+                    "numerics); breaking the graph")
+            # guardable scalar: feed the recorded value, emit a guard
+            st.guards.append((data, kind, rec_val))
+            return rec_val
+        val = cast(data)   # concrete even under trace: a baked constant
+        if val != rec_val:
+            raise _replay_divergence(
+                data, f"constant changed {rec_val!r} -> {val!r}")
+        return val
+    val = cast(data)       # eager (record mode or plain): concrete value
+    if st.mode == "record":
+        st.log.append((kind, val))
+    return val
+
+
+def guardable_concretization(kind: str, value) -> bool:
+    """Branch decisions / index choices can be value-guarded. float
+    concretizations can NOT — a replayed stale float would silently change
+    numerics (logging, lr math), and an equality guard on a moving loss
+    would mispredict every step — so they break the graph."""
+    if kind in ("bool", "int"):
+        return True
+    return kind == "item" and isinstance(value, (bool, int, np.integer))
+
+
+# --------------------------------------------------------------------------
 # autograd tape
 # --------------------------------------------------------------------------
 
@@ -242,9 +343,9 @@ class Tensor:
     # let Tensor win in e.g. np_array * tensor
     __array_priority__ = 100
 
-    __slots__ = ("_data", "_stop_gradient", "grad", "_node", "_out_idx",
-                 "name", "persistable", "_grad_hooks", "trainable",
-                 "__weakref__")
+    __slots__ = ("_data", "_stop_gradient", "_grad_value", "_grad_stale",
+                 "_node", "_out_idx", "name", "persistable", "_grad_hooks",
+                 "trainable", "__weakref__")
 
     def __init__(self, data, dtype=None, stop_gradient: bool = True,
                  name: str = ""):
@@ -257,7 +358,8 @@ class Tensor:
             data = data.astype(to_jax_dtype(dtype))
         self._data = data
         self._stop_gradient = stop_gradient
-        self.grad: Tensor | None = None
+        self._grad_value: Tensor | None = None
+        self._grad_stale = False
         self._node: GradNode | None = None
         self._out_idx: int = 0
         self.name = name
@@ -300,6 +402,23 @@ class Tensor:
     @stop_gradient.setter
     def stop_gradient(self, value: bool) -> None:
         self._stop_gradient = bool(value)
+
+    @property
+    def grad(self) -> "Tensor | None":
+        if self._grad_stale:
+            warnings.warn(
+                "reading .grad after a compiled to_static step: gradients "
+                "are consumed inside the compiled program and are NOT "
+                "synchronized back to eager .grad — this value is stale or "
+                "None. Inspect grads inside the compiled function, or run "
+                "the step eagerly.", UserWarning, stacklevel=2)
+            self._grad_stale = False
+        return self._grad_value
+
+    @grad.setter
+    def grad(self, value) -> None:
+        self._grad_value = value
+        self._grad_stale = False
 
     # -- metadata ----------------------------------------------------------
 
@@ -351,19 +470,22 @@ class Tensor:
         return a.astype(dtype) if dtype is not None else a
 
     def item(self):
-        return self._data.item()
+        return _concretize(self._data, "item", lambda d: d.item())
 
     def tolist(self):
         return np.asarray(self._data).tolist()
 
     def __float__(self):
-        return float(self._data)
+        return _concretize(self._data, "float", float)
 
     def __int__(self):
-        return int(self._data)
+        return _concretize(self._data, "int", int)
+
+    def __index__(self):
+        return _concretize(self._data, "int", int)
 
     def __bool__(self):
-        return bool(self._data)
+        return _concretize(self._data, "bool", bool)
 
     def __len__(self):
         if self.ndim == 0:
@@ -408,8 +530,10 @@ class Tensor:
         self.grad = None
 
     def clear_gradient(self, set_to_zero: bool = False) -> None:
-        if set_to_zero and self.grad is not None:
-            self.grad.set_data(jnp.zeros_like(self.grad._data))
+        self._grad_stale = False   # explicit reset supersedes staleness
+        if set_to_zero and self._grad_value is not None:
+            self._grad_value.set_data(
+                jnp.zeros_like(self._grad_value._data))
         else:
             self.grad = None
 
@@ -638,7 +762,10 @@ def tape_rebind(t: Tensor, out: Tensor) -> Tensor:
 def _accumulate_leaf(t: Tensor, g) -> None:
     if g.dtype != t.dtype and is_floating(t.dtype):
         g = g.astype(t.dtype)
-    if t.grad is None:
+    # _grad_value, not .grad: accumulating fresh grads must not trip the
+    # stale-after-compiled-step warning (and it supersedes staleness)
+    if t._grad_value is None:
         t.grad = Tensor(g, stop_gradient=True)
     else:
-        t.grad.set_data(t.grad._data + g)
+        t._grad_value.set_data(t._grad_value._data + g)
+        t._grad_stale = False

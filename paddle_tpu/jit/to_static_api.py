@@ -19,15 +19,22 @@ How functionalization works (this replaces SOT's bytecode interception):
 3. ``jax.jit`` compiles it; python scalars in the signature are baked in as
    constants (they're part of the cache key, like SOT guards).
 
-Graph breaks: if tracing fails on data-dependent Python control flow (the
-cases SOT handles with guards+fallback), we permanently fall back to eager
-for that signature and warn — same user-visible contract as paddle's SOT
-fallback, with XLA-grade whole-program fusion when tracing succeeds.
+Graph breaks and guarded specialization (the SOT role): data-dependent
+Python control flow on SCALARS (``if loss_improved:``, ``int(idx)``) does
+NOT break the graph. Discovery records every scalar concretization; the
+trace replays each recorded value as a baked constant and emits the traced
+tensor as a *guard output*; every compiled step re-checks the guards on
+device results before committing state. A guard mismatch discards that
+run, re-runs eagerly (correctness), and re-specializes — distinct branch
+patterns each get their own cached executable (SOT/dynamo branch
+specialization). Only unguardable concretizations — ``float()``/``item()``
+on floats (stale value would change numerics) and bulk host reads
+(``.numpy()``) — fall back to eager for the signature, with a warning.
 
 Caveat (documented divergence): ``.grad`` values left un-cleared across a
 compiled call are not synchronized back — the standard step pattern
 (backward → optimizer.step → clear_grad inside the function) is fully
-supported.
+supported; reading ``.grad`` after a compiled step warns.
 """
 
 from __future__ import annotations
@@ -38,8 +45,13 @@ import warnings
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
-from ..framework.core import Tensor, StateTracking, track_state
+from ..framework.core import (GraphBreak, Tensor, StateTracking,
+                              guardable_concretization,
+                              record_concretizations, replay_concretizations,
+                              track_state)
 
 __all__ = ["to_static", "StaticFunction", "not_to_static", "ignore_module"]
 
@@ -98,18 +110,42 @@ def _signature_key(leaves):
 
 
 class _CompiledGraph:
-    __slots__ = ("state_list", "jitted", "pure_fn")
+    __slots__ = ("state_list", "jitted", "pure_fn", "guard_log")
 
-    def __init__(self, state_list, jitted, pure_fn):
+    def __init__(self, state_list, jitted, pure_fn, guard_log):
         self.state_list = state_list
         self.jitted = jitted
         self.pure_fn = pure_fn
+        self.guard_log = guard_log   # [(kind, value)] from discovery
 
+
+class _SigEntry:
+    """Specializations for one input signature, keyed by the recorded
+    concretization log (the branch-decision vector)."""
+
+    __slots__ = ("by_key", "latest_key", "mispredicts")
+
+    def __init__(self):
+        self.by_key: dict = {}
+        self.latest_key = None
+        self.mispredicts = 0
+
+
+class _GuardMismatch(Exception):
+    pass
+
+
+#: CONSECUTIVE mispredict budget per signature before giving up on
+#: compilation (pathologically alternating branches); any successful
+#: guard-hit compiled run resets the counter, so occasional flips over a
+#: long training run never deoptimize
+_MAX_MISPREDICTS = 16
 
 _TRACE_ERRORS = (jax.errors.TracerBoolConversionError,
                  jax.errors.ConcretizationTypeError,
                  jax.errors.TracerArrayConversionError,
-                 jax.errors.TracerIntegerConversionError)
+                 jax.errors.TracerIntegerConversionError,
+                 GraphBreak)
 
 
 class StaticFunction:
@@ -119,7 +155,7 @@ class StaticFunction:
         functools.update_wrapper(self, function)
         self._fn = function
         self._input_spec = input_spec
-        self._graphs: dict[str, _CompiledGraph] = {}
+        self._graphs: dict[str, _SigEntry] = {}
         self._fallback_sigs: set[str] = set()
         self._instance = None
         self._donate = donate_state
@@ -161,11 +197,28 @@ class StaticFunction:
         sig = _signature_key(leaves)
         if sig in self._fallback_sigs:
             return self._call_fn(*args, **kwargs)
-        graph = self._graphs.get(sig)
-        if graph is None:
+        entry = self._graphs.get(sig)
+        if entry is None or entry.latest_key is None:
             return self._discover(sig, spec, leaves, args, kwargs)
+        graph = entry.by_key[entry.latest_key]
         try:
-            return self._run_compiled(graph, leaves)
+            result = self._run_compiled(graph, leaves)
+            entry.mispredicts = 0   # guard-hit run: healthy specialization
+            return result
+        except _GuardMismatch:
+            entry.mispredicts += 1
+            if entry.mispredicts > _MAX_MISPREDICTS:
+                warnings.warn(
+                    f"to_static: {getattr(self._fn, '__name__', '?')} "
+                    f"re-specialized more than {_MAX_MISPREDICTS} times "
+                    "(unstable data-dependent branches); falling back to "
+                    "eager for this signature")
+                self._fallback_sigs.add(sig)
+                self._graphs.pop(sig, None)
+                return self._call_fn(*args, **kwargs)
+            # the discarded run committed nothing; re-run eagerly (correct
+            # for the new branch pattern) and re-specialize
+            return self._discover(sig, spec, leaves, args, kwargs)
         except _TRACE_ERRORS as e:
             warnings.warn(
                 f"to_static: graph break in "
@@ -180,24 +233,48 @@ class StaticFunction:
 
     def _discover(self, sig, spec, leaves, args, kwargs):
         tracking = StateTracking()
-        with track_state(tracking):
+        log: list = []
+        with track_state(tracking), record_concretizations(log):
             outputs = self._call_fn(*args, **kwargs)
+        unguardable = [(k, v) for k, v in log
+                       if not guardable_concretization(k, v)]
+        if unguardable:
+            kinds = sorted({k for k, _ in unguardable})
+            warnings.warn(
+                f"to_static: graph break in "
+                f"{getattr(self._fn, '__name__', '?')}: {kinds} "
+                "concretization(s) pull device values into python "
+                "(unguardable — a replayed stale value would change "
+                "numerics); running eagerly for this signature. Keep "
+                "float()/item() reads outside the compiled function.")
+            self._fallback_sigs.add(sig)
+            self._graphs.pop(sig, None)
+            return outputs
         state, seen = [], set()
         for d in (tracking.read, tracking.written):
             for tid, t in d.items():
                 if tid not in seen:
                     seen.add(tid)
                     state.append(t)
-        pure_fn = self._make_pure_fn(spec, leaves, state)
-        donate = (0,) if self._donate else ()
-        jitted = jax.jit(pure_fn, donate_argnums=donate)
-        self._graphs[sig] = _CompiledGraph(state, jitted, pure_fn)
+        entry = self._graphs.get(sig)
+        if entry is None:
+            entry = self._graphs[sig] = _SigEntry()
+        key = tuple(log)
+        if key not in entry.by_key:
+            pure_fn = self._make_pure_fn(spec, leaves, state, log)
+            # guards require the ability to DISCARD a run on mismatch, so
+            # donation (which invalidates the input buffers) is only safe
+            # on guard-free graphs
+            donate = (0,) if self._donate and not log else ()
+            jitted = jax.jit(pure_fn, donate_argnums=donate)
+            entry.by_key[key] = _CompiledGraph(state, jitted, pure_fn, log)
+        entry.latest_key = key
         return outputs
 
     # ---- the pure function ----------------------------------------------
 
-    def _make_pure_fn(self, spec, proto_leaves, state_list):
-        donate = self._donate
+    def _make_pure_fn(self, spec, proto_leaves, state_list, guard_log):
+        donate = self._donate and not guard_log
         fn = self._call_fn
         # leaf prototypes: for tensors remember stop_gradient; for python
         # values bake in the discovery-call value (sig key guards equality)
@@ -206,7 +283,11 @@ class StaticFunction:
         holder = {}
 
         def pure_fn(state_arrays, arg_arrays):
-            originals = [(t, t._data, t._node, t.grad) for t in state_list]
+            # _grad_value (not .grad): internal save/restore must neither
+            # trigger nor clear the stale-grad warning
+            originals = [(t, t._data, t._node, t._grad_value)
+                         for t in state_list]
+            guards: list = []
             try:
                 for t, a in zip(state_list, state_arrays):
                     t._data = a
@@ -220,7 +301,8 @@ class StaticFunction:
                     else:
                         leaves2.append(v)
                 built_args, built_kwargs = _tree_unflatten(spec, leaves2)
-                outputs = fn(*built_args, **built_kwargs)
+                with replay_concretizations(guard_log, guards):
+                    outputs = fn(*built_args, **built_kwargs)
                 out_leaves: list = []
                 out_spec = _tree_flatten(outputs, out_leaves)
                 out_arrays = tuple(
@@ -243,12 +325,24 @@ class StaticFunction:
                                if t._data is not a]
                 holder["changed"] = changed
                 new_state = tuple(state_list[i]._data for i in changed)
-                return new_state, out_arrays
+                # only tracer-backed concretizations become guards
+                # (constants were verified equal at trace time). One
+                # stacked int64 vector => ONE host sync per step at check
+                # time, however many guards there are.
+                if guards:
+                    guard_vec = jnp.stack(
+                        [jnp.asarray(g, jnp.int64).reshape(())
+                         for g, _, _ in guards])
+                else:
+                    guard_vec = ()
+                holder["guard_expect"] = np.asarray(
+                    [int(v) for _, _, v in guards], dtype=np.int64)
+                return new_state, out_arrays, guard_vec
             finally:
                 for t, d, n, g in originals:
                     t._data = d
                     t._node = n
-                    t.grad = g
+                    t._grad_value = g
 
         pure_fn._holder = holder
         return pure_fn
@@ -257,10 +351,20 @@ class StaticFunction:
         arg_arrays = tuple(leaf._data for leaf in leaves
                            if isinstance(leaf, Tensor))
         state_arrays = tuple(t._data for t in graph.state_list)
-        new_state, out_arrays = graph.jitted(state_arrays, arg_arrays)
+        new_state, out_arrays, guard_vec = graph.jitted(state_arrays,
+                                                        arg_arrays)
         holder = graph.pure_fn._holder
+        # verify the guarded branch decisions BEFORE committing state —
+        # a mismatched run must leave no trace (its outputs followed the
+        # wrong branch). Single stacked vector: one host sync.
+        expect = holder.get("guard_expect")
+        if expect is not None and expect.size:
+            if not np.array_equal(np.asarray(guard_vec), expect):
+                raise _GuardMismatch()
         for i, a in zip(holder["changed"], new_state):
             graph.state_list[i].set_data(a)
+            if not graph.state_list[i]._stop_gradient:
+                graph.state_list[i]._grad_stale = True
         out_leaves = [Tensor(a) if is_t else a
                       for a, is_t in zip(out_arrays,
                                          holder["out_is_tensor"])]

@@ -1,0 +1,180 @@
+"""to_static guarded specialization (the SOT role, SURVEY.md §3.5):
+data-dependent python control flow on scalars stays COMPILED via
+discovery-recorded branch decisions replayed as constants + runtime
+guards; unguardable float pulls break the graph with a warning; .grad
+reads after a compiled step warn (documented divergence)."""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def _pos():
+    return paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+
+
+def _neg():
+    return paddle.to_tensor(np.array([-1.0, -2.0], "float32"))
+
+
+class TestGuardedSpecialization:
+    def test_scalar_branch_compiles(self):
+        calls = {"n": 0}
+
+        @paddle.jit.to_static
+        def f(x):
+            calls["n"] += 1          # python side effect: traces only
+            y = x * 2
+            if y.sum() > 0:          # Tensor.__bool__ -> guarded
+                return y + 1
+            return y - 1
+
+        x = _pos()
+        np.testing.assert_allclose(f(x).numpy(), [3.0, 5.0])   # discovery
+        np.testing.assert_allclose(f(x).numpy(), [3.0, 5.0])   # compiled
+        np.testing.assert_allclose(f(x).numpy(), [3.0, 5.0])
+        # compiled runs don't re-execute python: discovery + one trace
+        assert calls["n"] == 2
+        assert not f._fallback_sigs
+        (entry,) = f._graphs.values()
+        assert len(entry.by_key) == 1
+
+    def test_branch_flip_respecializes_correctly(self):
+        @paddle.jit.to_static
+        def f(x):
+            y = x * 2
+            if y.sum() > 0:
+                return y + 1
+            return y - 1
+
+        pos, neg = _pos(), _neg()
+        f(pos)
+        f(pos)                                   # compiled spec A
+        np.testing.assert_allclose(f(neg).numpy(), [-3.0, -5.0])  # flip
+        np.testing.assert_allclose(f(neg).numpy(), [-3.0, -5.0])  # spec B
+        np.testing.assert_allclose(f(pos).numpy(), [3.0, 5.0])    # flip
+        np.testing.assert_allclose(f(pos).numpy(), [3.0, 5.0])    # cached A
+        (entry,) = f._graphs.values()
+        assert len(entry.by_key) == 2            # one per branch pattern
+        assert not f._fallback_sigs
+
+    def test_int_concretization_guarded(self):
+        @paddle.jit.to_static
+        def f(x, idx):
+            k = int(idx)             # device int -> baked + guarded
+            return x * k
+
+        x = _pos()
+        two = paddle.to_tensor(np.int64(2))
+        three = paddle.to_tensor(np.int64(3))
+        np.testing.assert_allclose(f(x, two).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(f(x, two).numpy(), [2.0, 4.0])
+        np.testing.assert_allclose(f(x, three).numpy(), [3.0, 6.0])
+        assert not f._fallback_sigs
+
+    def test_float_pull_breaks_graph_with_warning(self):
+        @paddle.jit.to_static
+        def g(x):
+            return x * float(x.sum())   # unguardable
+
+        x = _pos()
+        with pytest.warns(UserWarning, match="graph break"):
+            out = g(x)
+        np.testing.assert_allclose(out.numpy(), [3.0, 6.0])
+        np.testing.assert_allclose(g(x).numpy(), [3.0, 6.0])  # eager
+        assert len(g._fallback_sigs) == 1
+
+    def test_unstable_branch_gives_up(self):
+        @paddle.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                return x + 1
+            return x - 1
+
+        pos, neg = _pos(), _neg()
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            for _ in range(40):       # alternate forever
+                np.testing.assert_allclose(f(pos).numpy(), [2.0, 3.0])
+                np.testing.assert_allclose(f(neg).numpy(), [-2.0, -3.0])
+        assert any("re-specialized" in str(x.message) for x in w)
+        assert len(f._fallback_sigs) == 1
+
+    def test_guarded_train_step_state_committed_once(self):
+        """A guarded mispredicted run must not commit state: train the
+        same model with eager and compiled+flipping-branch loops and
+        assert identical losses."""
+        x1 = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 4).astype("float32"))
+        y1 = paddle.to_tensor(
+            np.random.RandomState(1).randn(8, 1).astype("float32"))
+
+        def make_step(model, opt, compiled):
+            loss_fn = nn.MSELoss()
+
+            def step(x, y, flip):
+                pred = model(x)
+                loss = loss_fn(pred, y)
+                if flip.sum() > 0:     # guarded branch inside the step
+                    loss = loss * 1.0
+                else:
+                    loss = loss * 1.0
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+                return loss
+
+            return paddle.jit.to_static(step) if compiled else step
+
+        def run(compiled):
+            paddle.seed(3)
+            model = nn.Linear(4, 1)
+            opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+            step = make_step(model, opt, compiled)
+            out = []
+            for i in range(6):
+                flip = paddle.to_tensor(
+                    np.array([1.0 if i % 2 else -1.0], "float32"))
+                out.append(float(step(x1, y1, flip).item()))
+            return out
+
+        np.testing.assert_allclose(run(True), run(False), rtol=1e-5)
+
+
+class TestGradStaleWarning:
+    def test_grad_read_after_compiled_step_warns(self):
+        paddle.seed(0)
+        model = nn.Linear(4, 1)
+        opt = paddle.optimizer.SGD(0.1, parameters=model.parameters())
+        loss_fn = nn.MSELoss()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 4).astype("float32"))
+        y = paddle.to_tensor(
+            np.random.RandomState(1).randn(8, 1).astype("float32"))
+
+        @paddle.jit.to_static
+        def step(x, y):
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            return loss
+
+        step(x, y)      # discovery (eager)
+        step(x, y)      # compiled — grads consumed inside the program
+        with pytest.warns(UserWarning, match="stale"):
+            _ = model.weight.grad
+
+    def test_eager_grad_read_does_not_warn(self):
+        paddle.seed(0)
+        model = nn.Linear(4, 1)
+        loss = nn.MSELoss()(model(_pos().reshape((1, 2)).tile((1, 2))),
+                            paddle.to_tensor(np.zeros((1, 1), "float32")))
+        loss.backward()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert model.weight.grad is not None
