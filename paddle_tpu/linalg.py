@@ -16,10 +16,10 @@ import jax.numpy as jnp
 from .framework.core import Tensor, apply
 from .ops.common import as_tensor
 from .ops.linalg import (  # noqa: F401
-    cholesky, cholesky_solve, cond, corrcoef, cov, det, eig, eigh,
-    eigvals, eigvalsh, householder_product, inv, lstsq, lu, matmul,
-    matrix_power, matrix_rank, multi_dot, norm, pinv, qr, slogdet, solve,
-    svd, triangular_solve,
+    cholesky, cholesky_inverse, cholesky_solve, cond, corrcoef, cov, det,
+    eig, eigh, eigvals, eigvalsh, householder_product, inv, lstsq, lu,
+    matmul, matrix_power, matrix_rank, multi_dot, norm, pdist, pinv, qr,
+    slogdet, solve, svd, triangular_solve,
 )
 
 
@@ -170,6 +170,7 @@ def pca_lowrank(x, q=None, center=True, niter=2, name=None):
 
 
 __all__ = [
+    "cholesky_inverse", "pdist",
     "cholesky", "cholesky_solve", "cond", "corrcoef", "cov", "det", "eig",
     "eigh", "eigvals", "eigvalsh", "householder_product", "inv", "lstsq",
     "lu", "lu_unpack", "matmul", "matrix_exp", "matrix_norm", "matrix_power",

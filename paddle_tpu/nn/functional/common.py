@@ -7,7 +7,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from ...framework.core import Tensor, apply
+from ...framework.core import Tensor, apply, to_jax_dtype
 from ...framework import random as framework_random
 from ...ops.common import as_tensor
 
@@ -424,3 +424,111 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
 
 
 __all__ += ["affine_grid", "grid_sample"]
+
+
+# ---- round-2 breadth -------------------------------------------------------
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """[...,] lengths -> [..., maxlen] 0/1 mask (paddle sequence_mask)."""
+    x = as_tensor(x)
+    if maxlen is None:
+        import numpy as _np
+        maxlen = int(_np.asarray(x._data).max())
+    m = int(maxlen)
+
+    def fn(a):
+        rng = jnp.arange(m)
+        return (rng < a[..., None]).astype(to_jax_dtype(dtype))
+    return apply(fn, x, name="sequence_mask", differentiable=False)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal shift: part of the channels shift one step forward /
+    backward along the segment (time) axis."""
+    x = as_tensor(x)
+
+    def fn(a):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        nt, c, h, w = a.shape
+        n = nt // int(seg_num)
+        v = a.reshape(n, int(seg_num), c, h, w)
+        fold = int(c * shift_ratio)
+        back = jnp.concatenate(
+            [v[:, 1:, :fold], jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        fwd = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, fold:2 * fold]),
+             v[:, :-1, fold:2 * fold]], axis=1)
+        keep = v[:, :, 2 * fold:]
+        out = jnp.concatenate([back, fwd, keep], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply(fn, x, name="temporal_shift")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    x = as_tensor(x)
+    l, r, t, b = (padding if isinstance(padding, (list, tuple))
+                  else [int(padding)] * 4)
+
+    def fn(a):
+        if data_format == "NHWC":
+            cfg = ((0, 0), (t, b), (l, r), (0, 0))
+        else:
+            cfg = ((0, 0), (0, 0), (t, b), (l, r))
+        return jnp.pad(a, cfg)
+    return apply(fn, x, name="zeropad2d")
+
+
+def gather_tree(ids, parents, name=None):
+    """Reconstruct full beam-search sequences from per-step ids and parent
+    beam indices ([T, B, W] layout, paddle.nn.functional.gather_tree)."""
+    ids_t, par_t = as_tensor(ids), as_tensor(parents)
+
+    def fn(idd, par):
+        T = idd.shape[0]
+
+        def step(beam, t):
+            # beam: [B, W] current beam index at step t+1; emit ids[t]
+            picked = jnp.take_along_axis(idd[t], beam, axis=-1)
+            parent = jnp.take_along_axis(par[t], beam, axis=-1)
+            return parent, picked
+
+        init = jnp.broadcast_to(jnp.arange(idd.shape[-1]),
+                                idd.shape[1:]).astype(idd.dtype)
+        _, out = jax.lax.scan(step, init, jnp.arange(T - 1, -1, -1))
+        return out[::-1]
+    return apply(fn, ids_t, par_t, name="gather_tree",
+                 differentiable=False)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None,
+                        name=None):
+    """Sample negative class centers (PartialFC): returns the remapped
+    labels and the sorted unique set of sampled class ids. Single-process
+    TPU variant of the reference's distributed sampler."""
+    import numpy as _np
+    lab = as_tensor(label)
+    host = _np.asarray(lab._data)
+    pos = _np.unique(host)
+    n_extra = max(int(num_samples) - pos.size, 0)
+    rest = _np.setdiff1d(_np.arange(int(num_classes)), pos)
+    # fresh negatives every call, reproducible under paddle.seed (the
+    # framework RNG hands out a distinct subkey per draw)
+    seed = int(jax.random.randint(framework_random.next_key(),
+                                  (), 0, 2 ** 31 - 1))
+    rng = _np.random.default_rng(seed)
+    extra = rng.choice(rest, size=min(n_extra, rest.size), replace=False) \
+        if n_extra and rest.size else _np.empty((0,), host.dtype)
+    sampled = _np.sort(_np.concatenate([pos, extra.astype(host.dtype)]))
+    remap = {c: i for i, c in enumerate(sampled.tolist())}
+    remapped = _np.asarray([remap[c] for c in host.tolist()], host.dtype)
+    from ...framework.core import Tensor as _T
+    return _T(jnp.asarray(remapped)), _T(jnp.asarray(sampled))
+
+
+__all__ += ["sequence_mask", "temporal_shift", "zeropad2d", "gather_tree",
+            "class_center_sample"]

@@ -106,8 +106,13 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, name=None):
-    return _pool(x, kernel_size, stride, padding, 1, "max", False,
-                 ceil_mode, name="max_pool1d")
+    out = _pool(x, kernel_size, stride, padding, 1, "max", False,
+                ceil_mode, name="max_pool1d")
+    if return_mask:
+        idx = _max_pool_indices_nd(as_tensor(x), kernel_size, stride,
+                                   padding, 1, False)
+        return out, idx
+    return out
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -115,43 +120,21 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
     out = _pool(x, kernel_size, stride, padding, 2, "max",
                 data_format == "NHWC", ceil_mode, name="max_pool2d")
     if return_mask:
-        idx = _max_pool_indices(as_tensor(x), kernel_size, stride, padding,
-                                2, data_format == "NHWC")
+        idx = _max_pool_indices_nd(as_tensor(x), kernel_size, stride,
+                                   padding, 2, data_format == "NHWC")
         return out, idx
     return out
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 3, "max",
-                 data_format == "NDHWC", ceil_mode, name="max_pool3d")
-
-
-def _max_pool_indices(x, kernel, stride, padding, n, channel_last):
-    # host-side index computation (eager debugging aid, like paddle's mask)
-    kernel = _tuplize(kernel, n)
-    stride = _tuplize(stride, n) or kernel
-    p = _tuplize(padding, n)
-    a = np.asarray(x._data)
-    if channel_last:
-        a = np.moveaxis(a, -1, 1)
-    N, C, H, W = a.shape
-    oh = (H + 2 * p[0] - kernel[0]) // stride[0] + 1
-    ow = (W + 2 * p[1] - kernel[1]) // stride[1] + 1
-    idx = np.zeros((N, C, oh, ow), np.int64)
-    padded = np.pad(a, ((0, 0), (0, 0), (p[0], p[0]), (p[1], p[1])),
-                    constant_values=-np.inf)
-    for i in range(oh):
-        for j in range(ow):
-            win = padded[:, :, i * stride[0]:i * stride[0] + kernel[0],
-                         j * stride[1]:j * stride[1] + kernel[1]]
-            flat = win.reshape(N, C, -1)
-            am = flat.argmax(-1)
-            wi, wj = np.unravel_index(am, kernel)
-            src_i = np.clip(i * stride[0] + wi - p[0], 0, H - 1)
-            src_j = np.clip(j * stride[1] + wj - p[1], 0, W - 1)
-            idx[:, :, i, j] = src_i * W + src_j
-    return Tensor(jnp.asarray(idx))
+    out = _pool(x, kernel_size, stride, padding, 3, "max",
+                data_format == "NDHWC", ceil_mode, name="max_pool3d")
+    if return_mask:
+        idx = _max_pool_indices_nd(as_tensor(x), kernel_size, stride,
+                                   padding, 3, data_format == "NDHWC")
+        return out, idx
+    return out
 
 
 def _adaptive_pool(x, output_size, n, op, channel_last, name):
@@ -226,3 +209,93 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
 def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
     return _adaptive_pool(x, output_size, 3, "max", False,
                           "adaptive_max_pool3d")
+
+
+# ---- max_unpool family (round-2 breadth) ----------------------------------
+
+def _unpool(x, indices, n, kernel_size, stride, padding, output_size,
+            data_format_first, name):
+    """Scatter pooled values back to their argmax positions. ``indices``
+    holds flat positions within the (spatial...) plane, the format
+    max_poolNd(return_mask=True) produces."""
+    x, idx = as_tensor(x), as_tensor(indices)
+    kernel = _tuplize(kernel_size, n)
+    stride_t = _tuplize(stride, n) or kernel
+    pad_t = _tuplize(padding, n)
+    if output_size is None:
+        spatial_in = x.shape[2:] if data_format_first else x.shape[1:-1]
+        out_sp = tuple((s - 1) * st - 2 * p + k for s, st, p, k in
+                       zip(spatial_in, stride_t, pad_t, kernel))
+    else:
+        out_sp = tuple(int(s) for s in output_size[-n:])
+    import numpy as _np
+    plane = int(_np.prod(out_sp))
+
+    def fn(a, ii):
+        if not data_format_first:
+            a = jnp.moveaxis(a, -1, 1)
+            ii = jnp.moveaxis(ii, -1, 1)
+        N, C = a.shape[:2]
+        flat_v = a.reshape(N, C, -1)
+        flat_i = ii.reshape(N, C, -1)
+        out = jnp.zeros((N, C, plane), a.dtype)
+        bidx = jnp.arange(N)[:, None, None]
+        cidx = jnp.arange(C)[None, :, None]
+        out = out.at[bidx, cidx, flat_i].set(flat_v)
+        out = out.reshape((N, C) + out_sp)
+        if not data_format_first:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply(fn, x, idx, name=name)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool(x, indices, 1, kernel_size, stride, padding,
+                   output_size, data_format == "NCL", "max_unpool1d")
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool(x, indices, 2, kernel_size, stride, padding,
+                   output_size, data_format == "NCHW", "max_unpool2d")
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool(x, indices, 3, kernel_size, stride, padding,
+                   output_size, data_format == "NCDHW", "max_unpool3d")
+
+
+def _max_pool_indices_nd(x, kernel, stride, padding, n, channel_last):
+    """Flat spatial argmax positions for any rank (mask for unpool)."""
+    import numpy as _np
+    kernel = _tuplize(kernel, n)
+    stride = _tuplize(stride, n) or kernel
+    p = _tuplize(padding, n)
+    a = _np.asarray(x._data)
+    if channel_last:
+        a = _np.moveaxis(a, -1, 1)
+    N, C = a.shape[:2]
+    sp = a.shape[2:]
+    out_sp = tuple((s + 2 * pi - k) // st + 1
+                   for s, pi, k, st in zip(sp, p, kernel, stride))
+    padded = _np.pad(a, ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p),
+                     constant_values=-_np.inf)
+    idx = _np.zeros((N, C) + out_sp, _np.int64)
+    for pos in _np.ndindex(*out_sp):
+        sl = tuple(_np.s_[pos[d] * stride[d]:pos[d] * stride[d] + kernel[d]]
+                   for d in range(n))
+        win = padded[(_np.s_[:], _np.s_[:]) + sl].reshape(N, C, -1)
+        am = win.argmax(-1)
+        rel = _np.unravel_index(am, kernel)
+        src = [_np.clip(pos[d] * stride[d] + rel[d] - p[d], 0, sp[d] - 1)
+               for d in range(n)]
+        flat = src[0]
+        for d in range(1, n):
+            flat = flat * sp[d] + src[d]
+        idx[(_np.s_[:], _np.s_[:]) + pos] = flat
+    return Tensor(jnp.asarray(idx))
+
+
+__all__ += ["max_unpool1d", "max_unpool2d", "max_unpool3d"]

@@ -195,3 +195,16 @@ class GLU(Layer):
 
     def forward(self, x):
         return F.glu(x, self.axis)
+
+
+SiLU = Silu   # upstream exposes both spellings
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW inputs (paddle.nn.Softmax2D)."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+__all__ += ["SiLU", "Softmax2D"]

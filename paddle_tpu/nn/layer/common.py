@@ -275,3 +275,26 @@ class Fold(Layer):
     def forward(self, x):
         return F.fold(x, self.output_sizes, self.kernel_sizes, self.strides,
                       self.paddings, self.dilations)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self.shape = axis, shape
+
+    def forward(self, x):
+        from ...ops.manipulation import unflatten
+        return unflatten(x, self.axis, self.shape)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, p=self.p, epsilon=self.epsilon,
+                                   keepdim=self.keepdim)
+
+
+__all__ += ["Unflatten", "PairwiseDistance"]

@@ -92,3 +92,39 @@ class AdaptiveMaxPool2D(_AdaptivePool):
 class AdaptiveMaxPool3D(_AdaptivePool):
     def forward(self, x):
         return F.adaptive_max_pool3d(x, self.output_size)
+
+
+class MaxUnPool1D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._a
+        return F.max_unpool1d(x, indices, k, s, p, df, osz)
+
+
+class MaxUnPool2D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._a
+        return F.max_unpool2d(x, indices, k, s, p, df, osz)
+
+
+class MaxUnPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__()
+        self._a = (kernel_size, stride, padding, data_format, output_size)
+
+    def forward(self, x, indices):
+        k, s, p, df, osz = self._a
+        return F.max_unpool3d(x, indices, k, s, p, df, osz)
+
+
+__all__ += ["MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D"]

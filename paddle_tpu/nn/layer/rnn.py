@@ -311,3 +311,96 @@ class GRU(_RNNBase):
                  direction="forward", time_major=False, dropout=0.0, **kw):
         super().__init__("gru", input_size, hidden_size, num_layers,
                          direction, time_major, dropout, **kw)
+
+
+#: public alias (paddle.nn.RNNCellBase) of the cell base class
+RNNCellBase = _RNNCellBase
+__all__ += ["RNNCellBase"]
+
+
+class BeamSearchDecoder:
+    """Beam-search decoding over an RNN cell (paddle.nn.BeamSearchDecoder).
+
+    ``cell(inputs, states) -> (outputs, new_states)``; ``output_fn`` maps
+    cell outputs to vocabulary logits; ``embedding_fn`` maps token ids to
+    the next step's inputs. Drive it with ``paddle.nn.dynamic_decode`` —
+    decode loops are host-driven, matching the reference's dygraph
+    decoding (each step is still XLA-compiled compute).
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def _expand(self, t):
+        """[B, ...] -> [B*W, ...] by repeating each row W times."""
+        from ...ops.manipulation import repeat_interleave
+        return repeat_interleave(t, self.beam_size, axis=0)
+
+    def initialize(self, initial_states):
+        states = jax.tree.map(
+            self._expand, initial_states,
+            is_leaf=lambda v: isinstance(v, Tensor))
+        any_leaf = jax.tree.leaves(
+            states, is_leaf=lambda v: isinstance(v, Tensor))[0]
+        bw = any_leaf.shape[0]
+        from ...ops.creation import full
+        ids = full([bw], self.start_token, "int64")
+        # beam 0 active, beams 1..W-1 start muted so step 1 expands one beam
+        import numpy as _np
+        lp = _np.full((bw,), -1e9, _np.float32)
+        lp[:: self.beam_size] = 0.0
+        return ids, states, Tensor(jnp.asarray(lp))
+
+    def step(self, ids, states, log_probs):
+        """One decode step over flattened [B*W] beams. Returns
+        (ids, states, log_probs, finished_mask)."""
+        inputs = self.embedding_fn(ids) if self.embedding_fn else ids
+        out, new_states = self.cell(inputs, states)
+        logits = self.output_fn(out) if self.output_fn else out
+        logp = Tensor(jax.nn.log_softmax(logits._data, axis=-1))
+        W = self.beam_size
+        V = logp.shape[-1]
+        bw = logp.shape[0]
+        B = bw // W
+
+        total = logp._data + log_probs._data[:, None]      # [B*W, V]
+        flat = total.reshape(B, W * V)
+        top_lp, top_idx = jax.lax.top_k(flat, W)           # [B, W]
+        beam = top_idx // V                                # source beam
+        token = top_idx % V
+        src = (jnp.arange(B)[:, None] * W + beam).reshape(-1)
+        new_ids = Tensor(token.reshape(-1).astype(jnp.int64))
+        gathered = jax.tree.map(
+            lambda s: Tensor(jnp.take(s._data, src, axis=0)),
+            new_states, is_leaf=lambda v: isinstance(v, Tensor))
+        finished = new_ids._data == self.end_token
+        return (new_ids, gathered, Tensor(top_lp.reshape(-1)),
+                Tensor(finished))
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Run a decoder to completion (paddle.nn.dynamic_decode): returns
+    (ids [B, W, T], final_log_probs [B, W])."""
+    ids, states, lp = decoder.initialize(inits)
+    W = decoder.beam_size
+    steps = []
+    done = jnp.zeros((ids.shape[0],), bool)
+    for _ in range(int(max_step_num)):
+        ids, states, lp, fin = decoder.step(ids, states, lp)
+        steps.append(ids._data)
+        done = done | fin._data
+        if bool(done.all()):
+            break
+    seq = jnp.stack(steps, axis=-1)                        # [B*W, T]
+    B = seq.shape[0] // W
+    return (Tensor(seq.reshape(B, W, -1)),
+            Tensor(lp._data.reshape(B, W)))
+
+
+__all__ += ["BeamSearchDecoder", "dynamic_decode"]

@@ -285,3 +285,65 @@ def householder_product(x, tau, name=None):
             q = q @ (jnp.eye(m, dtype=a.dtype) - t_[..., i, None, None] * vv)
         return q[..., :, :n] if m >= n else q
     return apply(fn, as_tensor(x), as_tensor(tau), name="householder_product")
+
+
+
+# ---- long-tail linalg (round-2 breadth) -----------------------------------
+# (matrix_exp / cdist / vecdot / ormqr / lu_unpack / svd_lowrank /
+#  pca_lowrank / vector_norm / matrix_norm / matrix_transpose live in
+#  paddle_tpu/linalg.py — the namespace upstream exposes them under)
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A from its Cholesky factor (paddle.linalg.cholesky_inverse)."""
+    def fn(a):
+        ident = jnp.eye(a.shape[-1], dtype=a.dtype)
+        inv_f = jax.scipy.linalg.solve_triangular(a, ident, lower=not upper)
+        # A = L L^T -> A^-1 = L^-T L^-1  (or U^-1 U^-T for upper)
+        if upper:
+            return inv_f @ inv_f.T
+        return inv_f.T @ inv_f
+    return apply(fn, as_tensor(x), name="cholesky_inverse")
+
+
+def pdist(x, p=2.0, name=None):
+    """Condensed pairwise distances of one row batch (paddle.pdist)."""
+    x = as_tensor(x)
+    n = x.shape[0]
+    iu = jnp.triu_indices(n, k=1)
+
+    def fn(a):
+        d = a[:, None, :] - a[None, :, :]
+        if p == 2.0:
+            full = jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-30)
+        elif jnp.isinf(p):
+            full = jnp.max(jnp.abs(d), axis=-1)
+        else:
+            full = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+        return full[iu]
+    return apply(fn, x, name="pdist")
+
+
+def histogram_bin_edges(input, bins=100, min=0, max=0, name=None):
+    x = as_tensor(input)
+    lo, hi = float(min), float(max)
+
+    def fn(a):
+        if lo == 0 and hi == 0:
+            mn, mx = jnp.min(a), jnp.max(a)
+        else:
+            mn = jnp.asarray(lo, a.dtype)
+            mx = jnp.asarray(hi, a.dtype)
+        mx = jnp.where(mx == mn, mn + 1, mx)
+        return jnp.linspace(mn, mx, int(bins) + 1)
+    return apply(fn, x, name="histogram_bin_edges", differentiable=False)
+
+
+__all__ += ["cholesky_inverse", "pdist", "histogram_bin_edges"]
+
+
+def inverse(x, name=None):
+    """paddle.inverse — alias of linalg.inv at the top level."""
+    return inv(x, name=name)
+
+
+__all__ += ["inverse"]

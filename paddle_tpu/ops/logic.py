@@ -59,3 +59,28 @@ def is_empty(x, name=None):
 
 def is_tensor(x):
     return isinstance(x, Tensor)
+
+
+def is_complex(x, name=None):
+    from .common import as_tensor as _at
+    import jax.numpy as _jnp
+    return bool(_jnp.issubdtype(_at(x).dtype, _jnp.complexfloating))
+
+
+def is_floating_point(x, name=None):
+    from .common import as_tensor as _at
+    import jax.numpy as _jnp
+    return bool(_jnp.issubdtype(_at(x).dtype, _jnp.floating))
+
+
+def is_integer(x, name=None):
+    from .common import as_tensor as _at
+    import jax.numpy as _jnp
+    return bool(_jnp.issubdtype(_at(x).dtype, _jnp.integer))
+
+
+def less(x, y, name=None):
+    return less_than(x, y, name=name)
+
+
+__all__ += ["is_complex", "is_floating_point", "is_integer", "less"]

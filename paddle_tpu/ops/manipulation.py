@@ -25,6 +25,13 @@ __all__ = [
     "clone", "flatten_", "tolist", "unique", "unique_consecutive",
     "split_sections", "crop", "pad", "shard_index", "view", "view_as",
     "atleast_1d", "atleast_2d", "atleast_3d", "diff", "rot90",
+    "tensor_split", "hsplit", "vsplit", "dsplit", "hstack", "vstack",
+    "row_stack", "dstack", "column_stack", "unflatten", "unfold",
+    "as_complex", "as_real", "diag_embed", "fill_diagonal_",
+    "fill_diagonal_tensor", "fill_diagonal_tensor_", "select_scatter",
+    "slice_scatter", "index_fill", "index_fill_", "masked_fill_",
+    "masked_scatter_", "block_diag", "cartesian_prod", "combinations",
+    "vander", "take",
 ]
 
 
@@ -617,3 +624,322 @@ def clone(x, name=None):
 
 def tolist(x):
     return as_tensor(x).tolist()
+
+
+# ---- split/stack family long tail -----------------------------------------
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    """paddle.tensor_split: uneven splits allowed (numpy array_split)."""
+    x = as_tensor(x)
+    axis = int(axis)
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        size = x.shape[axis]
+        base, extra = divmod(size, n)
+        sizes = [base + (1 if i < extra else 0) for i in range(n)]
+        bounds = np.cumsum(sizes)[:-1].tolist()
+    else:
+        bounds = [int(i) for i in num_or_indices]
+    outs = apply(lambda a: tuple(jnp.split(a, bounds, axis=axis)), x,
+                 n_outputs=len(bounds) + 1, name="tensor_split")
+    return list(outs)
+
+
+def hsplit(x, num_or_indices, name=None):
+    x = as_tensor(x)
+    if x.ndim < 1:
+        raise ValueError("hsplit expects at least a 1-D tensor")
+    return tensor_split(x, num_or_indices, axis=0 if x.ndim == 1 else 1)
+
+
+def vsplit(x, num_or_indices, name=None):
+    x = as_tensor(x)
+    if x.ndim < 2:
+        raise ValueError("vsplit expects at least a 2-D tensor")
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    x = as_tensor(x)
+    if x.ndim < 3:
+        raise ValueError("dsplit expects at least a 3-D tensor")
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hstack(x, name=None):
+    ts = [atleast_1d(as_tensor(t)) for t in x]
+    axis = 0 if ts[0].ndim <= 1 else 1
+    from . import manipulation as _m
+    return _m.concat(ts, axis=axis)
+
+
+def vstack(x, name=None):
+    ts = [atleast_2d(as_tensor(t)) for t in x]
+    from . import manipulation as _m
+    return _m.concat(ts, axis=0)
+
+
+row_stack = vstack
+
+
+def dstack(x, name=None):
+    ts = [atleast_3d(as_tensor(t)) for t in x]
+    from . import manipulation as _m
+    return _m.concat(ts, axis=2)
+
+
+def column_stack(x, name=None):
+    ts = []
+    for t in x:
+        t = as_tensor(t)
+        if t.ndim <= 1:
+            t = reshape(t, [-1, 1])
+        ts.append(t)
+    from . import manipulation as _m
+    return _m.concat(ts, axis=1)
+
+
+def unflatten(x, axis, shape, name=None):
+    x = as_tensor(x)
+    axis = int(axis) % max(x.ndim, 1)
+    shape = _norm_shape(shape)
+    new_shape = list(x.shape[:axis]) + list(shape) + list(x.shape[axis + 1:])
+    return reshape(x, new_shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis`` (paddle.Tensor.unfold): output gains
+    a trailing window dim of length ``size``."""
+    x = as_tensor(x)
+    axis = int(axis) % x.ndim
+    n = (x.shape[axis] - int(size)) // int(step) + 1
+
+    def fn(a):
+        idx = (np.arange(n)[:, None] * int(step) +
+               np.arange(int(size))[None, :])
+        win = jnp.take(a, jnp.asarray(idx.reshape(-1)), axis=axis)
+        win = jnp.reshape(
+            win, a.shape[:axis] + (n, int(size)) + a.shape[axis + 1:])
+        return jnp.moveaxis(win, axis + 1, -1)
+    return apply(fn, x, name="unfold")
+
+
+# ---- complex views ---------------------------------------------------------
+
+def as_complex(x, name=None):
+    """[..., 2] float -> [...] complex (paddle.as_complex)."""
+    x = as_tensor(x)
+    return apply(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x,
+                 name="as_complex")
+
+
+def as_real(x, name=None):
+    x = as_tensor(x)
+    return apply(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                 x, name="as_real")
+
+
+# ---- diagonal / scatter-style writes --------------------------------------
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    x = as_tensor(input)
+
+    def fn(a):
+        n = a.shape[-1] + builtins.abs(int(offset))
+        nd = a.ndim + 1
+        d1, d2 = int(dim1) % nd, int(dim2) % nd
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        ii = jnp.arange(a.shape[-1])
+        rows = ii + builtins.max(-int(offset), 0)
+        cols = ii + builtins.max(int(offset), 0)
+        base = base.at[..., rows, cols].set(a)
+        # embedded plane currently at (-2, -1); move to (dim1, dim2)
+        perm = [i for i in range(nd) if i not in (d1, d2)]
+        out_axes = sorted((d1, d2))
+        full = list(range(nd - 2)) + [nd - 2, nd - 1]
+        dest = perm + [d1, d2]
+        inv = [0] * nd
+        for src, dst in zip(full, dest):
+            inv[dst] = src
+        return jnp.transpose(base, inv)
+    return apply(fn, x, name="diag_embed")
+
+
+def _diag_len(rows, cols, offset):
+    """Number of elements on diagonal ``offset`` of a (rows, cols) plane."""
+    if offset >= 0:
+        return builtins.max(builtins.min(rows, cols - offset), 0)
+    return builtins.max(builtins.min(rows + offset, cols), 0)
+
+
+def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
+    def fn(a):
+        if a.ndim == 2:
+            off = int(offset)
+            if wrap and a.shape[0] > a.shape[1] and off == 0:
+                # tall matrices: the diagonal restarts every cols+1 rows
+                per = a.shape[1] + 1
+                r = np.arange(a.shape[0])
+                c = r % per
+                keep = c < a.shape[1]
+                r, c = r[keep], c[keep]
+            else:
+                n = _diag_len(a.shape[0], a.shape[1], off)
+                ii = np.arange(n)
+                r = ii + builtins.max(-off, 0)
+                c = ii + builtins.max(off, 0)
+            return a.at[r, c].set(jnp.asarray(value, a.dtype))
+        off = int(offset)
+        n = _diag_len(a.shape[-2], a.shape[-1], off)
+        ii = jnp.arange(n)
+        return a.at[..., ii + builtins.max(-off, 0),
+                    ii + builtins.max(off, 0)].set(
+            jnp.asarray(value, a.dtype))
+    out = apply(fn, tape_alias(x), name="fill_diagonal_")
+    return tape_rebind(x, out)
+
+
+def fill_diagonal_tensor(x, y, offset=0, dim1=0, dim2=1, name=None):
+    x, y = as_tensor(x), as_tensor(y)
+
+    def fn(a, b):
+        d1, d2 = int(dim1) % a.ndim, int(dim2) % a.ndim
+        off = int(offset)
+        moved = jnp.moveaxis(a, (d1, d2), (-2, -1))
+        n = _diag_len(moved.shape[-2], moved.shape[-1], off)
+        ii = jnp.arange(n)
+        rows = ii + builtins.max(-off, 0)
+        cols = ii + builtins.max(off, 0)
+        moved = moved.at[..., rows, cols].set(b)   # b: [..., n]
+        return jnp.moveaxis(moved, (-2, -1), (d1, d2))
+    return apply(fn, x, y, name="fill_diagonal_tensor")
+
+
+def fill_diagonal_tensor_(x, y, offset=0, dim1=0, dim2=1, name=None):
+    return tape_rebind(x, fill_diagonal_tensor(tape_alias(x), y, offset,
+                                               dim1, dim2))
+
+
+def select_scatter(x, values, axis, index, name=None):
+    x, v = as_tensor(x), as_tensor(values)
+    axis_i, idx = int(axis), int(index)
+    return apply(
+        lambda a, b: a.at[(np.s_[:],) * (axis_i % a.ndim) + (idx,)].set(
+            b.astype(a.dtype)),
+        x, v, name="select_scatter")
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    x, v = as_tensor(x), as_tensor(value)
+
+    def fn(a, b):
+        sl = [np.s_[:]] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            sl[int(ax)] = np.s_[int(s):int(e):int(st)]
+        return a.at[tuple(sl)].set(b.astype(a.dtype))
+    return apply(fn, x, v, name="slice_scatter")
+
+
+def index_fill(x, index, axis, value, name=None):
+    x = as_tensor(x)
+    index = as_tensor(index)
+
+    def fn(a, idx):
+        moved = jnp.moveaxis(a, int(axis), 0)
+        moved = moved.at[idx].set(jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(moved, 0, int(axis))
+    return apply(fn, x, index, name="index_fill")
+
+
+def index_fill_(x, index, axis, value, name=None):
+    return tape_rebind(x, index_fill(tape_alias(x), index, axis, value))
+
+
+def masked_fill_(x, mask, value, name=None):
+    return tape_rebind(x, masked_fill(tape_alias(x), mask, value))
+
+
+def masked_scatter_(x, mask, value, name=None):
+    return tape_rebind(x, masked_scatter(tape_alias(x), mask, value))
+
+
+# ---- combinatoric constructors --------------------------------------------
+
+def block_diag(inputs, name=None):
+    ts = [as_tensor(t) for t in inputs]
+
+    def fn(*arrs):
+        arrs = [jnp.atleast_2d(a) for a in arrs]
+        rows = builtins.sum(a.shape[0] for a in arrs)
+        cols = builtins.sum(a.shape[1] for a in arrs)
+        out = jnp.zeros((rows, cols), arrs[0].dtype)
+        r = c = 0
+        for a in arrs:
+            out = out.at[r:r + a.shape[0], c:c + a.shape[1]].set(a)
+            r += a.shape[0]
+            c += a.shape[1]
+        return out
+    return apply(fn, *ts, name="block_diag")
+
+
+def cartesian_prod(x, name=None):
+    ts = [as_tensor(t) for t in x]
+
+    def fn(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    out = apply(fn, *ts, name="cartesian_prod")
+    if len(ts) == 1:
+        return reshape(out, [-1])
+    return out
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    import itertools
+    x = as_tensor(x)
+    n = x.shape[0]
+    gen = (itertools.combinations_with_replacement if with_replacement
+           else itertools.combinations)
+    idx = np.asarray(list(gen(range(n), int(r))), dtype=np.int64)
+    if idx.size == 0:
+        idx = idx.reshape(0, int(r))
+    return apply(lambda a: jnp.take(a, jnp.asarray(idx), axis=0), x,
+                 name="combinations")
+
+
+def vander(x, n=None, increasing=False, name=None):
+    x = as_tensor(x)
+    num = x.shape[0] if n is None else int(n)
+    return apply(lambda a: jnp.vander(a, num, increasing=increasing), x,
+                 name="vander")
+
+
+def take(x, index, mode="raise", name=None):
+    """Flat-index gather with paddle's mode semantics
+    ('raise' clips like numpy-on-device, 'wrap', 'clip')."""
+    x = as_tensor(x)
+    index = as_tensor(index)
+
+    def fn(a, idx):
+        flat = a.reshape(-1)
+        size = flat.shape[0]
+        if mode == "wrap":
+            idx = ((idx % size) + size) % size
+        else:
+            idx = jnp.where(idx < 0, idx + size, idx)
+            idx = jnp.clip(idx, 0, size - 1)
+        return jnp.take(flat, idx)
+    return apply(fn, x, index, name="take")
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    """Write y onto the selected diagonal of x (paddle.diagonal_scatter)."""
+    x, y = as_tensor(x), as_tensor(y)
+    return fill_diagonal_tensor(x, y, offset=offset, dim1=axis1, dim2=axis2)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+__all__ += ["diagonal_scatter", "broadcast_shape"]

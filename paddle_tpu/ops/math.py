@@ -382,3 +382,189 @@ def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
 def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
     return Tensor(jnp.allclose(as_tensor(x)._data, as_tensor(y)._data,
                                rtol=rtol, atol=atol, equal_nan=equal_nan))
+
+
+# ---- long-tail math (round-2 breadth) -------------------------------------
+
+import numpy as _np
+
+signbit = unary(jnp.signbit, "signbit")
+isposinf = unary(jnp.isposinf, "isposinf")
+isneginf = unary(jnp.isneginf, "isneginf")
+sinc = unary(jnp.sinc, "sinc")
+positive = unary(lambda a: a, "positive")
+negative = unary(jnp.negative, "negative")
+gammaln = unary(jax.scipy.special.gammaln, "gammaln")
+gammainc = binary(jax.scipy.special.gammainc, "gammainc")
+gammaincc = binary(jax.scipy.special.gammaincc, "gammaincc")
+bitwise_invert = unary(jnp.invert, "bitwise_invert")
+
+
+def isreal(x, name=None):
+    x = as_tensor(x)
+    return apply(lambda a: (jnp.imag(a) == 0 if jnp.iscomplexobj(a)
+                            else jnp.ones(a.shape, bool)), x, name="isreal")
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return apply(lambda a, t: jnp.isin(a, t, invert=invert),
+                 as_tensor(x), as_tensor(test_x), name="isin")
+
+
+def frexp(x, name=None):
+    x = as_tensor(x)
+    m, e = apply(lambda a: tuple(jnp.frexp(a)), x, n_outputs=2,
+                 name="frexp", differentiable=False)
+    return m, e
+
+
+def multigammaln(x, p, name=None):
+    x = as_tensor(x)
+    return apply(lambda a: jax.scipy.special.multigammaln(a, int(p)), x,
+                 name="multigammaln")
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = as_tensor(y)
+    if x is not None:
+        return apply(lambda yy, xx: jnp.trapezoid(yy, xx, axis=axis),
+                     y, as_tensor(x), name="trapezoid")
+    return apply(lambda yy: jnp.trapezoid(
+        yy, dx=1.0 if dx is None else float(dx), axis=axis),
+        y, name="trapezoid")
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    y = as_tensor(y)
+    ax = int(axis)
+
+    def pair_sum(yy, spacing):
+        y0 = jax.lax.slice_in_dim(yy, 0, yy.shape[ax] - 1, axis=ax)
+        y1 = jax.lax.slice_in_dim(yy, 1, yy.shape[ax], axis=ax)
+        return jnp.cumsum((y0 + y1) * 0.5 * spacing, axis=ax)
+
+    if x is not None:
+        def fn(yy, xx):
+            x0 = jax.lax.slice_in_dim(xx, 0, xx.shape[ax if xx.ndim > 1
+                                                      else 0] - 1,
+                                      axis=ax if xx.ndim > 1 else 0)
+            x1 = jax.lax.slice_in_dim(xx, 1, xx.shape[ax if xx.ndim > 1
+                                                      else 0],
+                                      axis=ax if xx.ndim > 1 else 0)
+            d = x1 - x0
+            if xx.ndim == 1 and yy.ndim > 1:
+                shape = [1] * yy.ndim
+                shape[ax] = -1
+                d = d.reshape(shape)
+            return pair_sum(yy, d)
+        return apply(fn, y, as_tensor(x), name="cumulative_trapezoid")
+    return apply(lambda yy: pair_sum(yy, 1.0 if dx is None else float(dx)),
+                 y, name="cumulative_trapezoid")
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    """Rescale sub-tensors along ``axis`` whose p-norm exceeds max_norm
+    (paddle.renorm)."""
+    x = as_tensor(x)
+
+    def fn(a):
+        moved = jnp.moveaxis(a, int(axis), 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / (norms + 1e-7), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, int(axis))
+    return apply(fn, x, name="renorm")
+
+
+def renorm_(x, p, axis, max_norm, name=None):
+    return tape_rebind(x, renorm(tape_alias(x), p, axis, max_norm))
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x down to target's shape (paddle.reduce_as)."""
+    x, target = as_tensor(x), as_tensor(target)
+    tshape = tuple(target.shape)
+
+    def fn(a):
+        extra = a.ndim - len(tshape)
+        if extra > 0:
+            a = jnp.sum(a, axis=tuple(range(extra)))
+        keep = tuple(i for i, (s, t) in enumerate(zip(a.shape, tshape))
+                     if s != t)
+        if keep:
+            a = jnp.sum(a, axis=keep, keepdims=True)
+        return a
+    return apply(fn, x, name="reduce_as")
+
+
+# ---- the in-place op family (paddle `op_`) --------------------------------
+
+def _make_inplace(op_fn, op_name):
+    def op_(x, *args, **kwargs):
+        kwargs.pop("name", None)
+        return tape_rebind(x, op_fn(tape_alias(x), *args, **kwargs))
+    op_.__name__ = op_name
+    op_.__doc__ = f"In-place variant of ``{op_name[:-1]}`` (paddle parity)."
+    return op_
+
+
+_INPLACE_UNARY = [
+    "exp", "sqrt", "rsqrt", "reciprocal", "round", "ceil", "floor",
+    "trunc", "abs", "sin", "cos", "tan", "tanh", "asin", "acos", "atan",
+    "sinh", "cosh", "asinh", "acosh", "atanh", "sigmoid", "log", "log2",
+    "log10", "log1p", "erf", "expm1", "neg", "square", "digamma",
+    "lgamma", "i0", "frac", "logit", "nan_to_num", "bitwise_not",
+    "bitwise_invert", "gammaln",
+]
+_INPLACE_BINARY = [
+    "add", "subtract", "multiply", "divide", "remainder", "floor_divide",
+    "mod", "pow", "lerp", "copysign", "hypot", "ldexp", "nextafter",
+    "heaviside", "bitwise_and", "bitwise_or", "bitwise_xor",
+    "logical_and", "logical_or", "logical_xor", "gammainc", "gammaincc",
+    "fmax", "fmin", "maximum", "minimum", "atan2",
+]
+_INPLACE_OTHER = ["clip", "scale", "lcm", "gcd"]
+
+_g = globals()
+for _n in _INPLACE_UNARY + _INPLACE_BINARY + _INPLACE_OTHER:
+    _fn = _g.get(_n)
+    if _fn is None:
+        from . import logic as _logic_mod
+        _fn = getattr(_logic_mod, _n, None)
+    if _fn is None:
+        continue
+    _g[_n + "_"] = _make_inplace(_fn, _n + "_")
+    __all__.append(_n + "_")
+
+__all__ += [
+    "signbit", "isposinf", "isneginf", "isreal", "isin", "sinc", "frexp",
+    "positive", "negative", "gammaln", "gammainc", "gammaincc",
+    "multigammaln", "bitwise_invert", "trapezoid", "cumulative_trapezoid",
+    "renorm", "renorm_", "reduce_as",
+]
+
+
+def logaddexp2(x, y, name=None):
+    return apply(jnp.logaddexp2, as_tensor(x), as_tensor(y),
+                 name="logaddexp2")
+
+
+def add_n(inputs, name=None):
+    """Elementwise sum of a tensor list (paddle.add_n)."""
+    if isinstance(inputs, Tensor):
+        return inputs
+    import functools
+    ts = [as_tensor(t) for t in inputs]
+    return apply(lambda *arrs: functools.reduce(jnp.add, arrs), *ts,
+                 name="add_n")
+
+
+def rank(input, name=None):
+    """Runtime rank as a 0-D int32 tensor (paddle.rank)."""
+    from .creation import to_tensor
+    return to_tensor(int(as_tensor(input).ndim), dtype="int32")
+
+
+__all__ += ["logaddexp2", "add_n", "rank"]

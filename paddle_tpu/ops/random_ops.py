@@ -144,3 +144,26 @@ def exponential_(x, lam=1.0, name=None):
     u = jax.random.uniform(_key(), tuple(x.shape), x.dtype)
     x.set_data(-jnp.log(1.0 - u) / lam)
     return x
+
+
+def bernoulli_(x, p=0.5, name=None):
+    x.set_data(jax.random.bernoulli(
+        _key(), p, tuple(x.shape)).astype(x.dtype))
+    return x
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    u = jax.random.uniform(_key(), tuple(x.shape), x.dtype,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    x.set_data(loc + scale * jnp.tan(jnp.pi * (u - 0.5)))
+    return x
+
+
+def geometric_(x, probs, name=None):
+    u = jax.random.uniform(_key(), tuple(x.shape), x.dtype,
+                           minval=1e-7, maxval=1.0 - 1e-7)
+    x.set_data(jnp.floor(jnp.log1p(-u) / jnp.log1p(-probs)) + 1)
+    return x
+
+
+__all__ += ["bernoulli_", "cauchy_", "geometric_"]

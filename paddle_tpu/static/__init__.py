@@ -8,9 +8,12 @@ for static-graph user code."""
 from .mode import enable_static, disable_static, in_dynamic_mode
 from .program import (Program, default_main_program, default_startup_program,
                       program_guard, data, Executor, InputSpec, name_scope)
+from .passes import (PassManager, register_pass, apply_build_strategy,
+                     XLA_DELEGATED_PASSES)
 from . import nn  # noqa: F401
 
 __all__ = ["enable_static", "disable_static", "in_dynamic_mode", "Program",
            "default_main_program", "default_startup_program",
            "program_guard", "data", "Executor", "InputSpec", "name_scope",
-           "nn"]
+           "nn", "PassManager", "register_pass", "apply_build_strategy",
+           "XLA_DELEGATED_PASSES"]

@@ -79,10 +79,24 @@ def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
                      bias_attr=None, act=None, name=None,
                      data_format="NCHW"):
     if filter_size is None:
-        raise ValueError(
-            "conv2d_transpose requires filter_size (deriving the kernel "
-            "from output_size is not supported); pass output_size to "
-            "shape the output of a given kernel")
+        if output_size is None:
+            raise ValueError(
+                "conv2d_transpose needs filter_size or output_size")
+        # derive the kernel from the requested output extent (upstream
+        # legacy rule, dilation 1): k = out - (in - 1) * stride + 2 * pad
+        hw = (input.shape[2:4] if data_format == "NCHW"
+              else input.shape[1:3])
+        out_hw = ([output_size] * 2 if isinstance(output_size, int)
+                  else list(output_size))
+        st = [stride] * 2 if isinstance(stride, int) else list(stride)
+        pd = [padding] * 2 if isinstance(padding, int) else list(padding)
+        filter_size = [int(o) - (int(i) - 1) * s + 2 * p
+                       for o, i, s, p in zip(out_hw, hw, st, pd)]
+        if min(filter_size) < 1:
+            raise ValueError(
+                f"conv2d_transpose: derived kernel {filter_size} from "
+                f"output_size {out_hw} is invalid for input {list(hw)}, "
+                f"stride {st}, padding {pd}")
     in_ch = int(input.shape[1 if data_format == "NCHW" else -1])
     layer = _register(
         lambda: dynn.Conv2DTranspose(in_ch, num_filters, filter_size,
@@ -140,15 +154,34 @@ def embedding(input, size, is_sparse=False, padding_idx=None,
     return layer(input)
 
 
+class _ElementPReLU(dynn.Layer):
+    """prelu mode='element': one learned alpha per (non-batch) element."""
+
+    def __init__(self, elem_shape, weight_attr=None):
+        super().__init__()
+        from ..nn import initializer as I
+        self.alpha = self.create_parameter(
+            list(elem_shape), attr=weight_attr,
+            default_initializer=I.Constant(0.25))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        z = paddle.zeros_like(x)
+        return paddle.maximum(x, z) + self.alpha * paddle.minimum(x, z)
+
+
 def prelu(x, mode="all", param_attr=None, name=None):
     if mode == "all":
         num = 1
     elif mode == "channel":
         num = int(x.shape[1])
+    elif mode == "element":
+        elem_shape = [int(s) for s in x.shape[1:]]
+        layer = _register(lambda: _ElementPReLU(elem_shape,
+                                                weight_attr=param_attr))
+        return layer(x)
     else:
-        raise NotImplementedError(
-            "prelu mode='element' (one alpha per element) is not "
-            "supported; use 'all' or 'channel'")
+        raise ValueError(f"prelu: unknown mode {mode!r}")
     layer = _register(lambda: dynn.PReLU(num_parameters=num,
                                          weight_attr=param_attr))
     return layer(x)

@@ -1,0 +1,101 @@
+"""Program pass manager — the role of the reference's PIR/IR pass
+infrastructure (``paddle/fluid/pir/transforms``, UNVERIFIED; reference
+mount empty).
+
+TPU-native stance: XLA already runs the reference's optimization passes
+(constant folding, DCE, CSE, elementwise/matmul fusion, layout
+assignment) on every jitted Program, so those pass NAMES are accepted
+and recorded as delegated no-ops — requesting them is never an error.
+What remains genuinely useful at the Program level is *function-to-
+function rewriting* of the captured builder (feed->fetch callable):
+``register_pass`` installs such a rewrite under a name, and
+``PassManager([...]).apply(program)`` threads the program's builder
+through each pass. ``auto_mixed_precision`` ships as a real example —
+it wraps the builder in ``paddle.amp.auto_cast``.
+"""
+
+from __future__ import annotations
+
+import functools
+
+__all__ = ["PassManager", "register_pass", "apply_build_strategy",
+           "XLA_DELEGATED_PASSES"]
+
+#: reference pass names whose work XLA performs automatically on every
+#: compiled Program; accepted and recorded, nothing to do
+XLA_DELEGATED_PASSES = frozenset({
+    "constant_folding", "dead_code_elimination",
+    "common_subexpression_elimination", "fuse_gemm_epilogue",
+    "fuse_elewise_add_act", "fuse_bn_act", "fuse_bn_add_act",
+    "fused_attention", "fused_feedforward", "inplace_addto_op",
+    "identity_op_clean", "map_op_to_another", "matmul_scale_fuse",
+})
+
+_PASS_REGISTRY: dict = {}
+
+
+def register_pass(name):
+    """Register a builder rewrite: ``fn(build_fn) -> new_build_fn`` where
+    build_fn maps a feed dict to the fetch dict. Mirrors
+    ``paddle.incubate.passes``' role with python functions instead of IR
+    pattern DSL (the jaxpr IR is rewritten by XLA; python rewrites happen
+    at the builder level)."""
+    def deco(fn):
+        _PASS_REGISTRY[name] = fn
+        return fn
+    return deco
+
+
+@register_pass("auto_mixed_precision")
+def _amp_pass(build_fn):
+    """Run the captured Program under bf16 autocast (the reference's AMP
+    pass inserts cast ops; on TPU the same effect comes from autocast +
+    XLA fusion)."""
+    from ..amp import auto_cast
+
+    @functools.wraps(build_fn)
+    def wrapped(feed):
+        with auto_cast(enable=True, dtype="bfloat16"):
+            return build_fn(feed)
+    return wrapped
+
+
+class PassManager:
+    """``paddle.incubate.pass_utils``-shaped driver: validates names,
+    applies registered rewrites in order, records delegated ones."""
+
+    def __init__(self, passes):
+        self.names = list(passes)
+        unknown = [n for n in self.names
+                   if n not in _PASS_REGISTRY and
+                   n not in XLA_DELEGATED_PASSES]
+        if unknown:
+            raise ValueError(
+                f"unknown pass(es) {unknown}; registered: "
+                f"{sorted(_PASS_REGISTRY)}, XLA-delegated: "
+                f"{sorted(XLA_DELEGATED_PASSES)}")
+
+    def apply(self, program):
+        applied = getattr(program, "_applied_passes", None)
+        if applied is None:
+            applied = program._applied_passes = []
+        for n in self.names:
+            fn = _PASS_REGISTRY.get(n)
+            if fn is not None:
+                if program.build_fn is None:
+                    raise RuntimeError(
+                        f"pass {n!r} rewrites the captured builder; call "
+                        "Program.capture(...) first")
+                program.build_fn = fn(program.build_fn)
+            applied.append(n)
+        return program
+
+
+def apply_build_strategy(main_program, startup_program, build_strategy,
+                         pass_attrs=None):
+    """``paddle.static.apply_build_strategy`` parity: map the strategy's
+    enabled fusions onto the pass manager (all XLA-delegated)."""
+    names = [n for n in ("fuse_elewise_add_act", "fuse_bn_act",
+                         "fuse_bn_add_act", "fuse_gemm_epilogue")
+             if getattr(build_strategy, n, False)]
+    return PassManager(names).apply(main_program)

@@ -1,0 +1,241 @@
+"""Round-2 nn breadth: losses (incl. RNN-T vs brute-force DP oracle),
+unpooling round-trips, sequence utilities, beam-search decoding."""
+
+import numpy as np
+import pytest
+import scipy.special
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+R = np.random.RandomState(0)
+
+
+class TestLossLongTail:
+    def test_soft_margin_loss(self):
+        x = R.randn(4, 5).astype("float32")
+        y = ((R.rand(4, 5) > 0.5) * 2.0 - 1.0).astype("float32")
+        out = F.soft_margin_loss(paddle.to_tensor(x), paddle.to_tensor(y))
+        np.testing.assert_allclose(float(out),
+                                   np.log1p(np.exp(-y * x)).mean(),
+                                   rtol=1e-5)
+        layer = nn.SoftMarginLoss(reduction="sum")
+        np.testing.assert_allclose(
+            float(layer(paddle.to_tensor(x), paddle.to_tensor(y))),
+            np.log1p(np.exp(-y * x)).sum(), rtol=1e-5)
+
+    def test_multi_margin_loss(self):
+        x = R.randn(6, 4).astype("float32")
+        y = R.randint(0, 4, (6,)).astype("int64")
+        out = nn.MultiMarginLoss()(paddle.to_tensor(x), paddle.to_tensor(y))
+        per = np.maximum(1.0 - x[np.arange(6), y][:, None] + x, 0)
+        per[np.arange(6), y] = 0
+        np.testing.assert_allclose(float(out), (per.sum(1) / 4).mean(),
+                                   rtol=1e-5)
+
+    def test_triplet_with_distance_matches_plain(self):
+        a, p, n = (R.randn(5, 8).astype("float32") for _ in range(3))
+        t1 = F.triplet_margin_with_distance_loss(
+            paddle.to_tensor(a), paddle.to_tensor(p), paddle.to_tensor(n))
+        t2 = F.triplet_margin_loss(
+            paddle.to_tensor(a), paddle.to_tensor(p), paddle.to_tensor(n))
+        np.testing.assert_allclose(float(t1), float(t2), rtol=1e-4)
+
+    def test_hsigmoid_loss_trains(self):
+        """The hierarchical path probabilities must be trainable: loss on
+        a fixed batch decreases under SGD."""
+        paddle.seed(0)
+        layer = nn.HSigmoidLoss(8, 10)
+        opt = paddle.optimizer.SGD(0.5, parameters=layer.parameters())
+        x = paddle.to_tensor(R.randn(16, 8).astype("float32"))
+        y = paddle.to_tensor(R.randint(0, 10, (16,)).astype("int64"))
+        losses = []
+        for _ in range(10):
+            loss = layer(x, y)
+            assert list(loss.shape) == [16, 1]   # un-reduced, paddle shape
+            loss = loss.mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_margin_cross_entropy_reduces_to_plain_ce(self):
+        """With all margins off and scale 1, margin CE == cross entropy on
+        cosine logits."""
+        x = (R.rand(5, 7).astype("float32") - 0.5) * 1.6
+        y = R.randint(0, 7, (5,)).astype("int64")
+        out = F.margin_cross_entropy(paddle.to_tensor(x),
+                                     paddle.to_tensor(y), margin1=1.0,
+                                     margin2=0.0, margin3=0.0, scale=1.0)
+        lp = scipy.special.log_softmax(x, axis=-1)
+        np.testing.assert_allclose(float(out),
+                                   -lp[np.arange(5), y].mean(), rtol=1e-4)
+
+    def test_rnnt_loss_vs_bruteforce(self):
+        def np_rnnt(logits, ys, tlen, ulen, blank=0):
+            lp = scipy.special.log_softmax(logits, axis=-1)
+            out = []
+            for b in range(logits.shape[0]):
+                Tb, Ub = tlen[b], ulen[b]
+                alpha = np.full((Tb, Ub + 1), -np.inf)
+                alpha[0, 0] = 0
+                for t in range(Tb):
+                    for u in range(Ub + 1):
+                        if t == 0 and u == 0:
+                            continue
+                        c = []
+                        if t > 0:
+                            c.append(alpha[t - 1, u] + lp[b, t - 1, u, blank])
+                        if u > 0:
+                            c.append(alpha[t, u - 1]
+                                     + lp[b, t, u - 1, ys[b, u - 1]])
+                        alpha[t, u] = np.logaddexp.reduce(c)
+                out.append(-(alpha[Tb - 1, Ub] + lp[b, Tb - 1, Ub, blank]))
+            return np.asarray(out)
+
+        B, T, U, V = 3, 6, 4, 5
+        logits = R.randn(B, T, U + 1, V).astype("float32")
+        ys = R.randint(1, V, (B, U)).astype("int64")
+        tlen = np.array([6, 5, 4])
+        ulen = np.array([4, 3, 2])
+        out = F.rnnt_loss(paddle.to_tensor(logits), paddle.to_tensor(ys),
+                          paddle.to_tensor(tlen), paddle.to_tensor(ulen),
+                          reduction="none")
+        np.testing.assert_allclose(out.numpy(),
+                                   np_rnnt(logits, ys, tlen, ulen),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_adaptive_log_softmax_normalizes(self):
+        """exp(log-prob) over every class must sum to 1 per sample."""
+        D = 8
+        x = R.randn(3, D).astype("float32")
+        hw = (R.randn(D, 6).astype("float32") * 0.3)   # cutoff0=4 + 2
+        tails = [
+            (paddle.to_tensor(R.randn(D, 4).astype("float32") * 0.3),
+             paddle.to_tensor(R.randn(4, 4).astype("float32") * 0.3)),
+            (paddle.to_tensor(R.randn(D, 2).astype("float32") * 0.3),
+             paddle.to_tensor(R.randn(2, 4).astype("float32") * 0.3))]
+        cutoffs = [4, 8]   # head 0-3, cluster0 4-7, cluster1 8-11
+        total = np.zeros(3)
+        for c in range(12):
+            lab = paddle.to_tensor(np.full((3,), c, "int64"))
+            lp, _ = F.adaptive_log_softmax_with_loss(
+                paddle.to_tensor(x), lab, paddle.to_tensor(hw), tails,
+                cutoffs)
+            total += np.exp(lp.numpy())
+        np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+
+
+class TestUnpool:
+    @pytest.mark.parametrize("nd", [1, 2, 3])
+    def test_unpool_places_maxima_back(self, nd):
+        shape = {1: (2, 3, 8), 2: (2, 3, 8, 8), 3: (1, 2, 4, 4, 4)}[nd]
+        x = R.randn(*shape).astype("float32") + 5.0   # positive maxima
+        pool = getattr(F, f"max_pool{nd}d")
+        unpool = getattr(F, f"max_unpool{nd}d")
+        out, mask = pool(paddle.to_tensor(x), 2, 2, return_mask=True)
+        rec = unpool(out, mask, 2, 2)
+        assert list(rec.shape) == list(shape)
+        # pooling the reconstruction recovers the same maxima
+        np.testing.assert_allclose(pool(rec, 2, 2).numpy(), out.numpy())
+
+    def test_unpool_layers(self):
+        x = paddle.to_tensor(R.randn(2, 3, 8, 8).astype("float32"))
+        out, mask = F.max_pool2d(x, 2, 2, return_mask=True)
+        rec = nn.MaxUnPool2D(2, 2)(out, mask)
+        assert list(rec.shape) == [2, 3, 8, 8]
+
+
+class TestSequenceUtils:
+    def test_sequence_mask(self):
+        out = F.sequence_mask(paddle.to_tensor(np.array([2, 0, 3])),
+                              maxlen=4)
+        np.testing.assert_array_equal(
+            out.numpy(), [[1, 1, 0, 0], [0, 0, 0, 0], [1, 1, 1, 0]])
+
+    def test_temporal_shift_moves_channels(self):
+        nt, c, h, w = 4, 8, 2, 2
+        x = np.arange(nt * c * h * w, dtype="float32").reshape(nt, c, h, w)
+        out = F.temporal_shift(paddle.to_tensor(x), seg_num=2,
+                               shift_ratio=0.25).numpy()
+        v = x.reshape(2, 2, c, h, w)
+        # first quarter shifted backward: segment t takes t+1's channels
+        np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[:, 0, :2],
+                                   v[:, 1, :2])
+        # last half untouched
+        np.testing.assert_allclose(out.reshape(2, 2, c, h, w)[..., 4:, :, :],
+                                   v[..., 4:, :, :])
+
+    def test_zeropad2d(self):
+        out = F.zeropad2d(paddle.to_tensor(np.ones((1, 1, 2, 2),
+                                                   "float32")),
+                          [1, 2, 0, 1])
+        assert list(out.shape) == [1, 1, 3, 5]
+        np.testing.assert_allclose(out.numpy().sum(), 4.0)
+
+    def test_gather_tree(self):
+        # the documented paddle example
+        ids = paddle.to_tensor(np.array(
+            [[[2, 2], [6, 1]], [[3, 9], [6, 1]], [[0, 1], [9, 0]]],
+            "int64"))
+        parents = paddle.to_tensor(np.array(
+            [[[0, 0], [1, 1]], [[1, 0], [1, 0]], [[0, 0], [0, 1]]],
+            "int64"))
+        out = F.gather_tree(ids, parents).numpy()
+        ref = np.array([[[2, 2], [1, 6]], [[3, 3], [6, 1]],
+                        [[0, 1], [9, 0]]])
+        np.testing.assert_array_equal(out, ref)
+
+    def test_class_center_sample(self):
+        lab = paddle.to_tensor(np.array([3, 9, 3, 15], "int64"))
+        remapped, sampled = F.class_center_sample(lab, 20, 8)
+        s = sampled.numpy()
+        assert set([3, 9, 15]) <= set(s.tolist())
+        assert len(s) == 8 and (np.diff(s) > 0).all()
+        np.testing.assert_array_equal(
+            s[remapped.numpy()], lab.numpy())
+
+
+class TestBeamSearch:
+    def test_beam_search_greedy_consistency(self):
+        """With beam_size=1, beam search equals greedy argmax decoding."""
+        paddle.seed(7)
+        cell = nn.GRUCell(8, 16)
+        emb = nn.Embedding(10, 8)
+        proj = nn.Linear(16, 10)
+        h0 = paddle.to_tensor(R.randn(2, 16).astype("float32"))
+
+        dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
+                                   beam_size=1, embedding_fn=emb,
+                                   output_fn=proj)
+        ids, lp = nn.dynamic_decode(dec, inits=h0, max_step_num=5)
+
+        # greedy reference
+        import jax.numpy as jnp
+        tok = paddle.to_tensor(np.array([1, 1], "int64"))
+        h = h0
+        ref = []
+        for _ in range(5):
+            out, h = cell(emb(tok), h)
+            logits = proj(out)
+            tok = paddle.to_tensor(
+                np.argmax(logits.numpy(), -1).astype("int64"))
+            ref.append(tok.numpy())
+        ref = np.stack(ref, -1)
+        np.testing.assert_array_equal(ids.numpy()[:, 0, :], ref)
+
+    def test_beam_scores_monotonic(self):
+        paddle.seed(3)
+        cell = nn.LSTMCell(8, 16)
+        emb = nn.Embedding(12, 8)
+        proj = nn.Linear(16, 12)
+        h0 = (paddle.to_tensor(R.randn(3, 16).astype("float32")),
+              paddle.to_tensor(R.randn(3, 16).astype("float32")))
+        dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
+                                   beam_size=4, embedding_fn=emb,
+                                   output_fn=proj)
+        ids, lp = nn.dynamic_decode(dec, inits=h0, max_step_num=6)
+        assert list(ids.shape)[:2] == [3, 4]
+        assert (np.diff(lp.numpy(), axis=1) <= 1e-5).all()

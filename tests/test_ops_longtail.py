@@ -1,0 +1,374 @@
+"""Round-2 op-breadth batch: forward parity vs NumPy oracles and
+finite-difference gradient checks through the OpTest harness
+(SURVEY.md §4 — the reference's per-op test discipline)."""
+
+import numpy as np
+import pytest
+import scipy.linalg
+import scipy.spatial.distance
+import scipy.special
+
+import paddle_tpu as paddle
+from op_test import check_forward, check_grad
+
+R = np.random.RandomState(0)
+
+
+class TestManipulationLongTail:
+    def test_tensor_split_uneven(self):
+        x = R.randn(7, 3).astype("float32")
+        outs = paddle.tensor_split(paddle.to_tensor(x), 3)
+        ref = np.array_split(x, 3)
+        for o, e in zip(outs, ref):
+            np.testing.assert_array_equal(o.numpy(), e)
+
+    @pytest.mark.parametrize("name,npfn", [
+        ("hsplit", np.hsplit), ("vsplit", np.vsplit), ("dsplit", np.dsplit)])
+    def test_xsplit(self, name, npfn):
+        x = R.randn(4, 4, 4).astype("float32")
+        outs = getattr(paddle, name)(paddle.to_tensor(x), 2)
+        for o, e in zip(outs, npfn(x, 2)):
+            np.testing.assert_array_equal(o.numpy(), e)
+
+    @pytest.mark.parametrize("name,npfn", [
+        ("hstack", np.hstack), ("vstack", np.vstack),
+        ("dstack", np.dstack), ("column_stack", np.column_stack),
+        ("row_stack", np.vstack)])
+    def test_xstack(self, name, npfn):
+        xs = [R.randn(3, 4).astype("float32") for _ in range(2)]
+        out = getattr(paddle, name)([paddle.to_tensor(a) for a in xs])
+        np.testing.assert_array_equal(out.numpy(), npfn(xs))
+
+    def test_unflatten_forward_grad(self):
+        x = R.randn(2, 6).astype("float32")
+        check_forward(lambda x: paddle.unflatten(x, 1, [2, 3]),
+                      lambda x: x.reshape(2, 2, 3), {"x": x})
+        check_grad(lambda x: paddle.unflatten(x, 1, [2, 3]), {"x": x})
+
+    def test_unfold(self):
+        x = np.arange(10, dtype="float32")
+        out = paddle.unfold(paddle.to_tensor(x), 0, 3, 2)
+        ref = np.stack([x[i:i + 3] for i in range(0, 8, 2)])
+        np.testing.assert_array_equal(out.numpy(), ref)
+        check_grad(lambda x: paddle.unfold(x, 0, 3, 2), {"x": x})
+
+    def test_as_complex_real_roundtrip(self):
+        x = R.randn(3, 2).astype("float32")
+        c = paddle.as_complex(paddle.to_tensor(x))
+        np.testing.assert_allclose(c.numpy(), x[..., 0] + 1j * x[..., 1])
+        back = paddle.as_real(c)
+        np.testing.assert_allclose(back.numpy(), x)
+
+    @pytest.mark.parametrize("offset", [0, 1, -1])
+    def test_diag_embed(self, offset):
+        x = R.randn(2, 3).astype("float32")
+        out = paddle.diag_embed(paddle.to_tensor(x), offset=offset)
+        ref = np.stack([np.diag(r, k=offset) for r in x])
+        np.testing.assert_allclose(out.numpy(), ref)
+        check_grad(lambda x: paddle.diag_embed(x, offset=offset), {"x": x})
+
+    def test_select_scatter(self):
+        x = R.randn(3, 4).astype("float32")
+        v = R.randn(4).astype("float32")
+        ref = x.copy()
+        ref[1] = v
+        check_forward(lambda x, v: paddle.select_scatter(x, v, 0, 1),
+                      lambda x, v: ref, {"x": x, "v": v})
+        check_grad(lambda x, v: paddle.select_scatter(x, v, 0, 1),
+                   {"x": x, "v": v})
+
+    def test_slice_scatter(self):
+        x = R.randn(4, 6).astype("float32")
+        v = R.randn(4, 2).astype("float32")
+        ref = x.copy()
+        ref[:, 1:5:2] = v
+        out = paddle.slice_scatter(paddle.to_tensor(x), paddle.to_tensor(v),
+                                   [1], [1], [5], [2])
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_index_fill(self):
+        x = R.randn(4, 3).astype("float32")
+        idx = np.array([0, 2], dtype="int64")
+        ref = x.copy()
+        ref[[0, 2]] = 7.0
+        out = paddle.index_fill(paddle.to_tensor(x), paddle.to_tensor(idx),
+                                0, 7.0)
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_block_diag(self):
+        a = R.randn(2, 3).astype("float32")
+        b = R.randn(1, 2).astype("float32")
+        out = paddle.block_diag([paddle.to_tensor(a), paddle.to_tensor(b)])
+        np.testing.assert_allclose(out.numpy(), scipy.linalg.block_diag(a, b))
+
+    def test_cartesian_prod_combinations_vander(self):
+        a = np.array([1, 2, 3])
+        b = np.array([4, 5])
+        out = paddle.cartesian_prod(
+            [paddle.to_tensor(a), paddle.to_tensor(b)])
+        ref = np.array([[x, y] for x in a for y in b])
+        np.testing.assert_array_equal(out.numpy(), ref)
+        comb = paddle.combinations(paddle.to_tensor(a), 2)
+        np.testing.assert_array_equal(comb.numpy(), [[1, 2], [1, 3], [2, 3]])
+        v = R.randn(4).astype("float32")
+        np.testing.assert_allclose(
+            paddle.vander(paddle.to_tensor(v)).numpy(), np.vander(v),
+            rtol=1e-5)
+
+    @pytest.mark.parametrize("mode", ["raise", "wrap", "clip"])
+    def test_take(self, mode):
+        x = R.randn(3, 4).astype("float32")
+        idx = np.array([0, 5, 11, -1, 25 if mode != "raise" else 11])
+        ref_idx = (idx % 12 if mode == "wrap"
+                   else np.clip(np.where(idx < 0, idx + 12, idx), 0, 11))
+        out = paddle.take(paddle.to_tensor(x), paddle.to_tensor(idx),
+                          mode=mode)
+        np.testing.assert_allclose(out.numpy(), x.reshape(-1)[ref_idx])
+
+    def test_diagonal_scatter(self):
+        x = R.randn(3, 3).astype("float32")
+        y = np.array([9.0, 9.0, 9.0], "float32")
+        out = paddle.diagonal_scatter(paddle.to_tensor(x),
+                                      paddle.to_tensor(y))
+        ref = x.copy()
+        np.fill_diagonal(ref, 9.0)
+        np.testing.assert_allclose(out.numpy(), ref)
+
+    def test_fill_diagonal_inplace(self):
+        t = paddle.to_tensor(np.zeros((4, 3), "float32"))
+        paddle.Tensor.fill_diagonal_(t, 2.0)
+        ref = np.zeros((4, 3), "float32")
+        np.fill_diagonal(ref, 2.0)
+        np.testing.assert_allclose(t.numpy(), ref)
+
+
+class TestMathLongTail:
+    @pytest.mark.parametrize("name,npfn,data", [
+        ("signbit", np.signbit, R.randn(8).astype("float32")),
+        ("isposinf", np.isposinf,
+         np.array([1.0, np.inf, -np.inf, np.nan], "float32")),
+        ("isneginf", np.isneginf,
+         np.array([1.0, np.inf, -np.inf, np.nan], "float32")),
+        ("sinc", np.sinc, R.randn(8).astype("float32")),
+        ("gammaln", scipy.special.gammaln,
+         R.rand(8).astype("float32") + 0.5),
+    ])
+    def test_unary_forward(self, name, npfn, data):
+        check_forward(getattr(paddle, name), lambda x: npfn(x),
+                      {"x": data}, rtol=1e-4, atol=1e-5)
+
+    def test_sinc_grad(self):
+        check_grad(paddle.sinc, {"x": R.randn(4).astype("float32") + 1.1})
+
+    def test_gammainc(self):
+        a = R.rand(6).astype("float32") + 0.5
+        x = R.rand(6).astype("float32") + 0.5
+        check_forward(paddle.gammainc,
+                      lambda x, y: scipy.special.gammainc(x, y),
+                      {"x": a, "y": x}, rtol=1e-4, atol=1e-5)
+        check_forward(paddle.gammaincc,
+                      lambda x, y: scipy.special.gammaincc(x, y),
+                      {"x": a, "y": x}, rtol=1e-4, atol=1e-5)
+
+    def test_multigammaln(self):
+        x = R.rand(5).astype("float32") + 3.0
+        check_forward(lambda x: paddle.multigammaln(x, 2),
+                      lambda x: scipy.special.multigammaln(x, 2),
+                      {"x": x}, rtol=1e-4, atol=1e-4)
+
+    def test_frexp(self):
+        x = np.array([8.0, 0.75, -3.0], "float32")
+        m, e = paddle.frexp(paddle.to_tensor(x))
+        rm, re = np.frexp(x)
+        np.testing.assert_allclose(m.numpy(), rm)
+        np.testing.assert_array_equal(e.numpy(), re)
+
+    def test_trapezoid(self):
+        y = R.randn(3, 8).astype("float32")
+        x = np.sort(R.rand(8).astype("float32"))
+        check_forward(paddle.trapezoid,
+                      lambda y: np.trapezoid(y, axis=-1), {"y": y})
+        check_forward(lambda y, x: paddle.trapezoid(y, x),
+                      lambda y, x: np.trapezoid(y, x, axis=-1),
+                      {"y": y, "x": x}, rtol=1e-4, atol=1e-5)
+        check_grad(paddle.trapezoid, {"y": y})
+
+    def test_cumulative_trapezoid(self):
+        import scipy.integrate
+        y = R.randn(2, 6).astype("float32")
+        check_forward(
+            paddle.cumulative_trapezoid,
+            lambda y: scipy.integrate.cumulative_trapezoid(y, axis=-1),
+            {"y": y}, rtol=1e-4, atol=1e-5)
+        check_grad(paddle.cumulative_trapezoid, {"y": y})
+
+    def test_renorm(self):
+        x = R.randn(3, 4).astype("float32") * 3
+        out = paddle.renorm(paddle.to_tensor(x), 2.0, 0, 1.0)
+        norms = np.linalg.norm(out.numpy().reshape(3, -1), axis=1)
+        assert (norms <= 1.0 + 1e-4).all()
+        small = R.randn(2, 2).astype("float32") * 0.01
+        np.testing.assert_allclose(
+            paddle.renorm(paddle.to_tensor(small), 2.0, 0, 1.0).numpy(),
+            small, rtol=1e-5)
+
+    def test_reduce_as(self):
+        x = R.randn(2, 3, 4).astype("float32")
+        t = np.zeros((3, 1), "float32")
+        check_forward(lambda x: paddle.reduce_as(x, paddle.to_tensor(t)),
+                      lambda x: x.sum(0).sum(-1, keepdims=True), {"x": x})
+        check_grad(lambda x: paddle.reduce_as(x, paddle.to_tensor(t)),
+                   {"x": x})
+
+    def test_isin_isreal(self):
+        x = np.array([1.0, 2.0, 3.0], "float32")
+        np.testing.assert_array_equal(
+            paddle.isin(paddle.to_tensor(x),
+                        paddle.to_tensor(np.array([2.0, 9.0]))).numpy(),
+            [False, True, False])
+        assert paddle.isreal(paddle.to_tensor(x)).numpy().all()
+
+    def test_logaddexp2_add_n(self):
+        x = R.randn(5).astype("float32")
+        y = R.randn(5).astype("float32")
+        check_forward(paddle.logaddexp2, lambda x, y: np.logaddexp2(x, y),
+                      {"x": x, "y": y}, rtol=1e-4, atol=1e-5)
+        check_grad(paddle.logaddexp2, {"x": x, "y": y})
+        out = paddle.add_n([paddle.to_tensor(x), paddle.to_tensor(y),
+                            paddle.to_tensor(x)])
+        np.testing.assert_allclose(out.numpy(), x + y + x, rtol=1e-6)
+
+    def test_inplace_family_matches_functional(self):
+        x = R.rand(6).astype("float32") + 0.5
+        for name in ["exp", "sqrt", "log", "tanh", "abs", "floor",
+                     "sigmoid", "square"]:
+            t = paddle.to_tensor(x.copy())
+            ret = getattr(paddle, name + "_")(t)
+            np.testing.assert_allclose(
+                t.numpy(), getattr(paddle, name)(
+                    paddle.to_tensor(x)).numpy(), rtol=1e-6,
+                err_msg=name)
+            assert ret is t
+        t = paddle.to_tensor(x.copy())
+        paddle.add_(t, 2.0)
+        np.testing.assert_allclose(t.numpy(), x + 2.0, rtol=1e-6)
+        t = paddle.to_tensor(x.copy())
+        paddle.pow_(t, 2.0)
+        np.testing.assert_allclose(t.numpy(), x ** 2, rtol=1e-5)
+
+    def test_inplace_keeps_autograd(self):
+        """In-place ops rebind the tape: grads flow through exp_."""
+        x = paddle.to_tensor(np.array([0.5, 1.0], "float32"),
+                             stop_gradient=False)
+        y = x * 2.0
+        paddle.exp_(y)
+        y.sum().backward()
+        np.testing.assert_allclose(
+            x.grad.numpy(), 2.0 * np.exp(np.array([1.0, 2.0])), rtol=1e-5)
+
+
+class TestLinalgLongTail:
+    def test_cholesky_inverse(self):
+        a = R.randn(4, 4).astype("float32")
+        spd = a @ a.T + 4 * np.eye(4, dtype="float32")
+        L = np.linalg.cholesky(spd)
+        np.testing.assert_allclose(
+            paddle.linalg.cholesky_inverse(paddle.to_tensor(L)).numpy(),
+            np.linalg.inv(spd), rtol=1e-3, atol=1e-3)
+        U = scipy.linalg.cholesky(spd, lower=False).astype("float32")
+        np.testing.assert_allclose(
+            paddle.linalg.cholesky_inverse(paddle.to_tensor(U),
+                                           upper=True).numpy(),
+            np.linalg.inv(spd), rtol=1e-3, atol=1e-3)
+
+    def test_pdist(self):
+        x = R.randn(5, 3).astype("float32")
+        np.testing.assert_allclose(
+            paddle.linalg.pdist(paddle.to_tensor(x)).numpy(),
+            scipy.spatial.distance.pdist(x), rtol=1e-4, atol=1e-4)
+
+    def test_histogram_bin_edges(self):
+        x = R.randn(20).astype("float32")
+        np.testing.assert_allclose(
+            paddle.histogram_bin_edges(paddle.to_tensor(x), bins=8).numpy(),
+            np.histogram_bin_edges(x, bins=8), rtol=1e-5)
+        np.testing.assert_allclose(
+            paddle.histogram_bin_edges(paddle.to_tensor(x), bins=4,
+                                       min=-1, max=1).numpy(),
+            np.histogram_bin_edges(x, bins=4, range=(-1, 1)), rtol=1e-6)
+
+    def test_inverse_alias(self):
+        a = R.randn(3, 3).astype("float32") + 3 * np.eye(3, dtype="float32")
+        np.testing.assert_allclose(
+            paddle.inverse(paddle.to_tensor(a)).numpy(),
+            np.linalg.inv(a), rtol=1e-3, atol=1e-4)
+
+
+class TestRandomInplace:
+    def test_bernoulli_(self):
+        t = paddle.to_tensor(np.zeros((2000,), "float32"))
+        paddle.bernoulli_(t, 0.25)
+        vals = t.numpy()
+        assert set(np.unique(vals)) <= {0.0, 1.0}
+        assert abs(vals.mean() - 0.25) < 0.08
+
+    def test_cauchy_geometric_(self):
+        t = paddle.to_tensor(np.zeros((1000,), "float32"))
+        paddle.cauchy_(t, loc=0.0, scale=1.0)
+        assert np.isfinite(t.numpy()).all()
+        assert abs(np.median(t.numpy())) < 0.3   # Cauchy median = loc
+        g = paddle.to_tensor(np.zeros((2000,), "float32"))
+        paddle.geometric_(g, 0.5)
+        assert (g.numpy() >= 1).all()
+        assert abs(g.numpy().mean() - 2.0) < 0.4  # E[geom(0.5)] = 2
+
+
+class TestLogicLongTail:
+    def test_dtype_predicates(self):
+        f = paddle.to_tensor(np.zeros(2, "float32"))
+        i = paddle.to_tensor(np.zeros(2, "int64"))
+        assert paddle.is_floating_point(f) and not paddle.is_integer(f)
+        assert paddle.is_integer(i) and not paddle.is_floating_point(i)
+        assert not paddle.is_complex(f)
+        c = paddle.as_complex(paddle.to_tensor(np.zeros((2, 2), "float32")))
+        assert paddle.is_complex(c)
+
+    def test_less_alias(self):
+        x = paddle.to_tensor(np.array([1, 5]))
+        y = paddle.to_tensor(np.array([3, 3]))
+        np.testing.assert_array_equal(paddle.less(x, y).numpy(),
+                                      [True, False])
+
+
+class TestReviewRegressions:
+    def test_fill_diagonal_rect_offset(self):
+        out = paddle.fill_diagonal_tensor(
+            paddle.to_tensor(np.zeros((2, 5), "float32")),
+            paddle.to_tensor(np.array([1.0, 2.0], "float32")), offset=1)
+        ref = np.zeros((2, 5), "float32")
+        ref[0, 1], ref[1, 2] = 1.0, 2.0
+        np.testing.assert_allclose(out.numpy(), ref)
+        t = paddle.to_tensor(np.zeros((3, 5), "float32"))
+        paddle.Tensor.fill_diagonal_(t, 7.0, offset=1)
+        ref = np.zeros((3, 5), "float32")
+        ref[0, 1] = ref[1, 2] = ref[2, 3] = 7.0
+        np.testing.assert_allclose(t.numpy(), ref)
+
+    def test_hstack_scalars(self):
+        out = paddle.hstack([paddle.to_tensor(np.float32(1.0)),
+                             paddle.to_tensor(np.float32(2.0))])
+        np.testing.assert_allclose(out.numpy(), [1.0, 2.0])
+
+    def test_soft_margin_loss_stable(self):
+        import paddle_tpu.nn.functional as F
+        out = F.soft_margin_loss(
+            paddle.to_tensor(np.array([100.0], "float32")),
+            paddle.to_tensor(np.array([-1.0], "float32")))
+        np.testing.assert_allclose(float(out), 100.0, rtol=1e-5)
+
+    def test_class_center_sample_varies(self):
+        import paddle_tpu.nn.functional as F
+        lab = paddle.to_tensor(np.array([3], "int64"))
+        draws = {tuple(F.class_center_sample(lab, 100, 10)[1].numpy())
+                 for _ in range(5)}
+        assert len(draws) > 1   # negatives resampled per call

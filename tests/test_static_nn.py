@@ -95,3 +95,84 @@ class TestStaticNN:
         exe = static.Executor()
         with pytest.raises(RuntimeError, match="capture"):
             exe.run(prog, feed={}, fetch_list=[])
+
+
+class TestStaticNnFilled:
+    """Previously-raising static.nn rows (VERDICT round-1 item 8)."""
+
+    def test_conv2d_transpose_derives_kernel_from_output_size(self):
+        prog = static.Program()
+
+        def net(feed):
+            y = static.nn.conv2d_transpose(feed["x"], num_filters=2,
+                                           output_size=16, stride=2,
+                                           padding=1)
+            return {"y": y}
+
+        prog.capture(net)
+        x = np.random.RandomState(0).randn(1, 3, 8, 8).astype("float32")
+        (out,) = static.Executor().run(prog, feed={"x": x},
+                                       fetch_list=["y"])
+        # k = 16 - (8-1)*2 + 2*1 = 4 -> output exactly 16x16
+        assert out.shape == (1, 2, 16, 16)
+
+    def test_prelu_element_mode(self):
+        prog = static.Program()
+
+        def net(feed):
+            return {"y": static.nn.prelu(feed["x"], mode="element")}
+
+        prog.capture(net)
+        x = np.array([[[-2.0, 4.0], [-6.0, 8.0]]], "float32")
+        (out,) = static.Executor().run(prog, feed={"x": x},
+                                       fetch_list=["y"])
+        # alpha init 0.25: negatives scaled, positives passed through
+        np.testing.assert_allclose(out, [[[-0.5, 4.0], [-1.5, 8.0]]])
+        # one alpha per element (non-batch dims)
+        (param,) = prog.parameters()
+        assert list(param.shape) == [2, 2]
+
+
+class TestPassManager:
+    def test_delegated_passes_accepted(self):
+        prog = static.Program()
+        prog.capture(lambda feed: {"y": feed["x"] * 2})
+        static.PassManager(["constant_folding",
+                            "fuse_gemm_epilogue"]).apply(prog)
+        assert prog._applied_passes == ["constant_folding",
+                                        "fuse_gemm_epilogue"]
+        x = np.ones((2, 2), "float32")
+        (out,) = static.Executor().run(prog, feed={"x": x},
+                                       fetch_list=["y"])
+        np.testing.assert_allclose(out, 2.0)
+
+    def test_unknown_pass_rejected(self):
+        with pytest.raises(ValueError, match="unknown pass"):
+            static.PassManager(["bogus_pass"])
+
+    def test_amp_pass_rewrites_builder(self):
+        prog = static.Program()
+
+        def net(feed):
+            h = static.nn.fc(feed["x"], 4)
+            return {"y": h}
+
+        prog.capture(net)
+        static.PassManager(["auto_mixed_precision"]).apply(prog)
+        x = np.random.RandomState(0).randn(2, 4).astype("float32")
+        (out,) = static.Executor().run(prog, feed={"x": x},
+                                       fetch_list=["y"])
+        assert str(out.dtype) == "bfloat16"  # matmul ran under autocast
+        # the registered custom-pass hook works end to end
+        calls = []
+
+        @static.register_pass("test_counting_pass")
+        def counting(build):
+            def wrapped(feed):
+                calls.append(1)
+                return build(feed)
+            return wrapped
+
+        static.PassManager(["test_counting_pass"]).apply(prog)
+        static.Executor().run(prog, feed={"x": x}, fetch_list=["y"])
+        assert calls == [1]
