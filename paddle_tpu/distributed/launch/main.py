@@ -67,6 +67,22 @@ def _spawn(rank, world, args, extra_env=None):
     return proc, logf
 
 
+def _dump_worker_log(args, local, ret, logf, tail_lines=40):
+    """Surface the failing rank's log tail on the launcher's stderr —
+    the observability contract of the reference launcher (a failure must
+    be diagnosable without hunting for workerlog files)."""
+    logf.flush()
+    path = os.path.join(args.log_dir, f"workerlog.{local}")
+    print(f"paddle_tpu.launch: rank {local} exited rc={ret}; "
+          f"last {tail_lines} lines of {path}:", file=sys.stderr)
+    try:
+        with open(path) as f:
+            for line in f.readlines()[-tail_lines:]:
+                print(f"  [rank {local}] {line.rstrip()}", file=sys.stderr)
+    except OSError as e:
+        print(f"  (log unreadable: {e})", file=sys.stderr)
+
+
 def _terminate_all(procs, grace=5.0):
     for proc, _ in procs:
         if proc.poll() is None:
@@ -92,11 +108,12 @@ def _run_round(procs, args, manager):
     while True:
         alive = False
         done_ok = set()
-        for local, (proc, _) in enumerate(procs):
+        for local, (proc, logf) in enumerate(procs):
             ret = proc.poll()
             if ret is None:
                 alive = True
             elif ret != 0:
+                _dump_worker_log(args, local, ret, logf)
                 return "failed"
             else:
                 done_ok.add(local)

@@ -134,6 +134,73 @@ class GenerationMixin:
             self.__dict__["_generate_step_fn"] = cached
         return cached
 
+    def _gen_fused_static(self):
+        """Whole-generation compiled path: prefill + a ``lax.scan`` over
+        every decode step in ONE program. Used when no eos early-exit is
+        requested (the scan has a static trip count). This is the
+        TPU-native serving shape — a device-side decode loop instead of
+        one host dispatch per token (each of which pays scheduling /
+        tunnel latency)."""
+        cached = self.__dict__.get("_generate_fused_fn")
+        if cached is None:
+            from ..jit import to_static
+            from ..framework.core import no_grad
+
+            def run(ids32, key_t, buf, caches, temperature, top_k, top_p,
+                    rep, greedy, pad_id, n_new):
+                # temperature/top_k/top_p/rep/greedy/pad_id/n_new are
+                # python scalars: part of the to_static signature key
+                prompt_len = ids32.shape[1]
+                with no_grad():
+                    logits, caches = self.forward(
+                        ids32, caches=caches,
+                        pos=Tensor(jnp.zeros((), jnp.int32)))
+                last = logits[:, -1]
+                fwd = self.forward
+
+                def fn(lg, key, bufa, *cache_leaves):
+                    b = lg.shape[0]
+                    fin = jnp.zeros((b,), bool)
+                    tok, lp, key, bufa, _ = _process_and_sample(
+                        lg, key, bufa,
+                        jnp.asarray(prompt_len, jnp.int32), fin,
+                        temperature=temperature, top_k=top_k, top_p=top_p,
+                        rep=rep, greedy=greedy, eos_id=-1, pad_id=pad_id)
+
+                    def body(carry, i):
+                        tok_c, key_c, buf_c, cl, acc = carry
+                        with no_grad():
+                            lg2, nc = fwd(
+                                Tensor(tok_c.reshape(b, 1)),
+                                caches=[Tensor(a) for a in cl],
+                                pos=Tensor((prompt_len + i)
+                                           .astype(jnp.int32)))
+                        t2, lp2, key2, buf2, _ = _process_and_sample(
+                            lg2[:, -1]._data, key_c, buf_c,
+                            (jnp.asarray(prompt_len + 1, jnp.int32)
+                             + i.astype(jnp.int32)), fin,
+                            temperature=temperature, top_k=top_k,
+                            top_p=top_p, rep=rep, greedy=greedy,
+                            eos_id=-1, pad_id=pad_id)
+                        new_cl = [t._data for t in nc]
+                        return (t2, key2, buf2, new_cl,
+                                acc + lp2.astype(jnp.float32)), None
+
+                    carry0 = (tok, key, bufa, list(cache_leaves),
+                              lp.astype(jnp.float32))
+                    carry, _ = jax.lax.scan(body, carry0,
+                                            jnp.arange(n_new - 1))
+                    _, key_f, buf_f, _, lp_f = carry
+                    return buf_f, lp_f, key_f
+
+                outs = apply(fn, last, key_t, buf, *caches, n_outputs=3,
+                             name="fused_decode", differentiable=False)
+                return outs
+
+            cached = to_static(run)
+            self.__dict__["_generate_fused_fn"] = cached
+        return cached
+
     # -- public API ----------------------------------------------------------
 
     def generate(self, input_ids, generation_config=None, max_new_tokens=None,
@@ -181,8 +248,18 @@ class GenerationMixin:
             [ids32._data, jnp.full((b, n_new), pad_, jnp.int32)], axis=1))
         finished = Tensor(jnp.zeros((b,), bool))
         caches = self.init_kv_cache(b, total)
-        step = self._gen_step_static()
         eos_i = -1 if eos_ is None else int(eos_)
+        if eos_i < 0:
+            # no eos early-exit -> static trip count -> the whole decode
+            # runs as ONE compiled program (prefill + lax.scan over steps)
+            buf_f, lp_f, _key_f = self._gen_fused_static()(
+                ids32, key_t, buf, caches, temperature_, top_k_, top_p_,
+                rep_, greedy, int(pad_), n_new)
+            gen = Tensor(buf_f._data[:, prompt_len:prompt_len + n_new])
+            scores = Tensor(lp_f._data / float(n_new))
+            return gen, scores
+
+        step = self._gen_step_static()
 
         pos = Tensor(jnp.zeros((), jnp.int32))
         tok, lp, key_t, buf, finished, caches = step(
@@ -193,10 +270,16 @@ class GenerationMixin:
         counts = np.ones((b,), np.float32)
         steps_done = 1
         for i in range(1, n_new):
-            fin_np = np.asarray(finished.jax())
-            if eos_i >= 0 and bool(fin_np.all()):
-                break
-            counts += (~fin_np).astype(np.float32)
+            if eos_i >= 0:
+                # early-exit polling only matters when an eos can finish
+                # rows; without one, skipping the poll avoids a host sync
+                # (a full tunnel round-trip) per generated token
+                fin_np = np.asarray(finished.jax())
+                if bool(fin_np.all()):
+                    break
+                counts += (~fin_np).astype(np.float32)
+            else:
+                counts += 1.0
             pos = Tensor(jnp.asarray(prompt_len + i - 1, jnp.int32))
             tok2d = Tensor(tok._data.reshape(b, 1))
             tok, lp, key_t, buf, finished, caches = step(

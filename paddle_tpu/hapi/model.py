@@ -72,6 +72,8 @@ class Model:
                     else (batch,)
                 loss = self.train_batch(xs, y)
                 losses.append(loss[0])
+                from ..utils import monitor
+                monitor.emit_step_metrics(epoch=epoch, loss=loss[0])
                 if verbose and step % log_freq == 0:
                     print(f"epoch {epoch} step {step}: "
                           f"loss {loss[0]:.5f}")

@@ -70,6 +70,17 @@ class LlamaConfig:
                    max_position_embeddings=4096, rope_theta=10000.0)
 
     @classmethod
+    def llama_2_7b(cls):
+        """Largest-fit v5e training config: with bf16 params+grads
+        (2 x 2.4B x 2B = 9.6GB) plus remat'd activations it fills a 16GB
+        chip; 8B (16GB params+grads alone) cannot fit — see BASELINE.md."""
+        return cls(vocab_size=32000, hidden_size=2560,
+                   num_hidden_layers=32, num_attention_heads=20,
+                   num_key_value_heads=4, intermediate_size=6912,
+                   max_position_embeddings=4096, rope_theta=10000.0,
+                   use_recompute=True)
+
+    @classmethod
     def tiny(cls):
         return cls(vocab_size=256, hidden_size=64, num_hidden_layers=2,
                    num_attention_heads=4, num_key_value_heads=2,

@@ -455,8 +455,7 @@ def triplet_margin_with_distance_loss(input, positive, negative,
 
     def fn(x, pp, nn):
         if distance_function is not None:
-            dp = distance_function(Tensor(x), Tensor(pp)).jax() \
-                if not isinstance(x, jnp.ndarray) or True else None
+            dp = distance_function(Tensor(x), Tensor(pp)).jax()
             dn = distance_function(Tensor(x), Tensor(nn)).jax()
             if swap:
                 dpn = distance_function(Tensor(pp), Tensor(nn)).jax()
@@ -641,8 +640,7 @@ def adaptive_log_softmax_with_loss(input, label, head_weight, tail_weights,
         safe_head = jnp.clip(y, 0, cuts[1] - 1)
         lp = jnp.take_along_axis(head_lp, safe_head[:, None], 1)[:, 0]
         for c in range(n_clusters):
-            lo, hi = cuts[c + 1], (cuts[c + 2] if c + 1 < len(cuts) - 0 and
-                                   c + 2 < len(cuts) else None)
+            lo = cuts[c + 1]
             hi = cuts[c + 2] if c + 2 < len(cuts) else None
             in_c = (y >= lo) & ((y < hi) if hi is not None else True)
             proj, cls_w = tails[2 * c], tails[2 * c + 1]

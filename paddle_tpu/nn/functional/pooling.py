@@ -57,6 +57,21 @@ def _pool(x, kernel, stride, padding, n, op, channel_last, ceil_mode=False,
             strides[d] = stride[i]
             if pads is not None:
                 padding_full[d] = pads[i]
+        if ceil_mode and pad_mode is None:
+            # ceil output sizing = extend the high-side padding so the
+            # partial tail window is produced. reduce_window pads with
+            # the init value (-inf for max, 0 for avg), so tail windows
+            # stay correct; the exclusive-avg count uses the same
+            # padding on a ones array and also stays correct.
+            for i, d in enumerate(sp_dims):
+                lo, hi = padding_full[d]
+                s_in = a.shape[d]
+                out_ceil = -(-(s_in + lo + hi - window[d]) //
+                             strides[d]) + 1
+                need = (out_ceil - 1) * strides[d] + window[d] \
+                    - (s_in + lo + hi)
+                if need > 0:
+                    padding_full[d] = (lo, hi + need)
         if pad_mode == "SAME":
             padding_cfg = "SAME"
         elif pad_mode == "VALID":
@@ -110,7 +125,7 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                 ceil_mode, name="max_pool1d")
     if return_mask:
         idx = _max_pool_indices_nd(as_tensor(x), kernel_size, stride,
-                                   padding, 1, False)
+                                   padding, 1, False, ceil_mode)
         return out, idx
     return out
 
@@ -121,7 +136,8 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                 data_format == "NHWC", ceil_mode, name="max_pool2d")
     if return_mask:
         idx = _max_pool_indices_nd(as_tensor(x), kernel_size, stride,
-                                   padding, 2, data_format == "NHWC")
+                                   padding, 2, data_format == "NHWC",
+                                   ceil_mode)
         return out, idx
     return out
 
@@ -132,7 +148,8 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                 data_format == "NDHWC", ceil_mode, name="max_pool3d")
     if return_mask:
         idx = _max_pool_indices_nd(as_tensor(x), kernel_size, stride,
-                                   padding, 3, data_format == "NDHWC")
+                                   padding, 3, data_format == "NDHWC",
+                                   ceil_mode)
         return out, idx
     return out
 
@@ -267,9 +284,14 @@ def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
                    output_size, data_format == "NCDHW", "max_unpool3d")
 
 
-def _max_pool_indices_nd(x, kernel, stride, padding, n, channel_last):
+def _max_pool_indices_nd(x, kernel, stride, padding, n, channel_last,
+                         ceil_mode=False):
     """Flat spatial argmax positions for any rank (mask for unpool)."""
     import numpy as _np
+    if isinstance(padding, str):
+        raise NotImplementedError(
+            "max_pool(return_mask=True) needs explicit int padding "
+            f"(got {padding!r}); 'SAME'/'VALID' masks are unsupported")
     kernel = _tuplize(kernel, n)
     stride = _tuplize(stride, n) or kernel
     p = _tuplize(padding, n)
@@ -278,9 +300,17 @@ def _max_pool_indices_nd(x, kernel, stride, padding, n, channel_last):
         a = _np.moveaxis(a, -1, 1)
     N, C = a.shape[:2]
     sp = a.shape[2:]
-    out_sp = tuple((s + 2 * pi - k) // st + 1
-                   for s, pi, k, st in zip(sp, p, kernel, stride))
-    padded = _np.pad(a, ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p),
+    if ceil_mode:
+        out_sp = tuple(-(-(s + 2 * pi - k) // st) + 1
+                       for s, pi, k, st in zip(sp, p, kernel, stride))
+    else:
+        out_sp = tuple((s + 2 * pi - k) // st + 1
+                       for s, pi, k, st in zip(sp, p, kernel, stride))
+    # ceil_mode windows may run past the padded extent: pad the tail too
+    extra = tuple(max((o - 1) * st + k - (s + 2 * pi), 0)
+                  for o, st, k, s, pi in zip(out_sp, stride, kernel, sp, p))
+    padded = _np.pad(a, ((0, 0), (0, 0)) +
+                     tuple((pi, pi + e) for pi, e in zip(p, extra)),
                      constant_values=-_np.inf)
     idx = _np.zeros((N, C) + out_sp, _np.int64)
     for pos in _np.ndindex(*out_sp):

@@ -357,9 +357,12 @@ class BeamSearchDecoder:
         lp[:: self.beam_size] = 0.0
         return ids, states, Tensor(jnp.asarray(lp))
 
-    def step(self, ids, states, log_probs):
+    def step(self, ids, states, log_probs, finished=None):
         """One decode step over flattened [B*W] beams. Returns
-        (ids, states, log_probs, finished_mask)."""
+        (ids, parent_beams, states, log_probs, finished_mask); parents
+        are the source-beam indices each output beam extended — feed the
+        (ids, parents) history to ``F.gather_tree`` to reconstruct full
+        hypotheses (``dynamic_decode`` does this)."""
         inputs = self.embedding_fn(ids) if self.embedding_fn else ids
         out, new_states = self.cell(inputs, states)
         logits = self.output_fn(out) if self.output_fn else out
@@ -379,28 +382,38 @@ class BeamSearchDecoder:
         gathered = jax.tree.map(
             lambda s: Tensor(jnp.take(s._data, src, axis=0)),
             new_states, is_leaf=lambda v: isinstance(v, Tensor))
-        finished = new_ids._data == self.end_token
-        return (new_ids, gathered, Tensor(top_lp.reshape(-1)),
-                Tensor(finished))
+        # a beam's finished flag follows its SOURCE hypothesis
+        prev_fin = (jnp.zeros((bw,), bool) if finished is None
+                    else jnp.take(finished._data, src))
+        fin = prev_fin | (new_ids._data == self.end_token)
+        return (new_ids, Tensor(beam.reshape(-1).astype(jnp.int64)),
+                gathered, Tensor(top_lp.reshape(-1)), Tensor(fin))
 
 
 def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
     """Run a decoder to completion (paddle.nn.dynamic_decode): returns
-    (ids [B, W, T], final_log_probs [B, W])."""
+    (ids [B, W, T], final_log_probs [B, W]). Hypotheses are reconstructed
+    through the parent-beam pointers with ``F.gather_tree`` — a beam's
+    returned row is its full history, not a positional stitch."""
+    from .. import functional as F
+
     ids, states, lp = decoder.initialize(inits)
     W = decoder.beam_size
-    steps = []
-    done = jnp.zeros((ids.shape[0],), bool)
+    bw = ids.shape[0]
+    B = bw // W
+    id_steps, parent_steps = [], []
+    fin = None
     for _ in range(int(max_step_num)):
-        ids, states, lp, fin = decoder.step(ids, states, lp)
-        steps.append(ids._data)
-        done = done | fin._data
-        if bool(done.all()):
+        ids, parents, states, lp, fin = decoder.step(ids, states, lp, fin)
+        id_steps.append(ids._data.reshape(B, W))
+        parent_steps.append(parents._data.reshape(B, W))
+        if bool(fin._data.all()):
             break
-    seq = jnp.stack(steps, axis=-1)                        # [B*W, T]
-    B = seq.shape[0] // W
-    return (Tensor(seq.reshape(B, W, -1)),
-            Tensor(lp._data.reshape(B, W)))
+    seq = Tensor(jnp.stack(id_steps, axis=0))          # [T, B, W]
+    par = Tensor(jnp.stack(parent_steps, axis=0))
+    full = F.gather_tree(seq, par)                     # backtracked
+    out = jnp.transpose(full._data, (1, 2, 0))         # [B, W, T]
+    return Tensor(out), Tensor(lp._data.reshape(B, W))
 
 
 __all__ += ["BeamSearchDecoder", "dynamic_decode"]

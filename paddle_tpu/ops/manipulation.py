@@ -669,14 +669,12 @@ def dsplit(x, num_or_indices, name=None):
 def hstack(x, name=None):
     ts = [atleast_1d(as_tensor(t)) for t in x]
     axis = 0 if ts[0].ndim <= 1 else 1
-    from . import manipulation as _m
-    return _m.concat(ts, axis=axis)
+    return concat(ts, axis=axis)
 
 
 def vstack(x, name=None):
     ts = [atleast_2d(as_tensor(t)) for t in x]
-    from . import manipulation as _m
-    return _m.concat(ts, axis=0)
+    return concat(ts, axis=0)
 
 
 row_stack = vstack
@@ -684,8 +682,7 @@ row_stack = vstack
 
 def dstack(x, name=None):
     ts = [atleast_3d(as_tensor(t)) for t in x]
-    from . import manipulation as _m
-    return _m.concat(ts, axis=2)
+    return concat(ts, axis=2)
 
 
 def column_stack(x, name=None):
@@ -695,8 +692,7 @@ def column_stack(x, name=None):
         if t.ndim <= 1:
             t = reshape(t, [-1, 1])
         ts.append(t)
-    from . import manipulation as _m
-    return _m.concat(ts, axis=1)
+    return concat(ts, axis=1)
 
 
 def unflatten(x, axis, shape, name=None):
@@ -789,12 +785,17 @@ def fill_diagonal_(x, value, offset=0, wrap=False, name=None):
                 r = ii + builtins.max(-off, 0)
                 c = ii + builtins.max(off, 0)
             return a.at[r, c].set(jnp.asarray(value, a.dtype))
-        off = int(offset)
-        n = _diag_len(a.shape[-2], a.shape[-1], off)
-        ii = jnp.arange(n)
-        return a.at[..., ii + builtins.max(-off, 0),
-                    ii + builtins.max(off, 0)].set(
-            jnp.asarray(value, a.dtype))
+        # ndim > 2: paddle/torch fill the main HYPER-diagonal
+        # x[i, i, ..., i] (all dims must be equal, offset 0)
+        if int(offset) != 0:
+            raise ValueError(
+                "fill_diagonal_: offset must be 0 for ndim > 2")
+        if builtins.len(set(a.shape)) != 1:
+            raise ValueError(
+                "fill_diagonal_: all dimensions must be equal for "
+                f"ndim > 2, got {a.shape}")
+        ii = jnp.arange(a.shape[0])
+        return a.at[(ii,) * a.ndim].set(jnp.asarray(value, a.dtype))
     out = apply(fn, tape_alias(x), name="fill_diagonal_")
     return tape_rebind(x, out)
 

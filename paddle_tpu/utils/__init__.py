@@ -16,6 +16,7 @@ from . import unique_name  # noqa: F401
 from . import dlpack  # noqa: F401
 from . import download  # noqa: F401
 from . import cpp_extension  # noqa: F401
+from . import monitor  # noqa: F401
 
 
 def deprecated(update_to="", since="", reason="", level=0):

@@ -2,6 +2,10 @@
 SURVEY.md §2.2 `paddle.io` row (multiproc workers -> thread pool on TPU
 hosts)."""
 
+import pytest as _pytest_mod
+
+pytestmark = _pytest_mod.mark.slow
+
 import time
 
 import numpy as np

@@ -165,3 +165,23 @@ def test_launcher_detects_hung_worker(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert os.path.exists(marker + ".done")
     assert "stale heartbeats" in r.stderr
+
+
+def test_launcher_dumps_failed_worker_log(tmp_path):
+    """Observability: the failing rank's log tail must surface on the
+    launcher's stderr (no hunting for workerlog files)."""
+    script = tmp_path / "noisy_fail.py"
+    script.write_text(
+        "print('useful diagnostic line A')\n"
+        "print('useful diagnostic line B')\n"
+        "raise RuntimeError('worker exploded: cuda_oom_equivalent')\n")
+    r = subprocess.run(
+        LAUNCH + ["--max_restarts", "0", "--elastic_timeout", "0",
+                  "--log_dir", str(tmp_path / "log"), str(script)],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "exited rc=1" in r.stderr
+    assert "worker exploded: cuda_oom_equivalent" in r.stderr
+    assert "[rank 0]" in r.stderr
+    # the per-rank log file itself also exists
+    assert (tmp_path / "log" / "workerlog.0").exists()

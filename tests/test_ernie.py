@@ -2,6 +2,10 @@
 semantics, heads, and DP-sharded data parity.
 """
 
+import pytest as _pytest_mod
+
+pytestmark = _pytest_mod.mark.slow
+
 import numpy as np
 import pytest
 

@@ -1,6 +1,10 @@
 """Tests for affine_grid/grid_sample (spatial transformer ops;
 SURVEY.md §2.2 `paddle.nn` functional row)."""
 
+import pytest as _pytest_mod
+
+pytestmark = _pytest_mod.mark.slow
+
 import numpy as np
 import pytest
 

@@ -98,3 +98,50 @@ class TestAuc:
         labels = np.array([0, 1], "int64")
         m.update(paddle.to_tensor(preds), paddle.to_tensor(labels))
         np.testing.assert_allclose(m.accumulate(), 1.0, atol=1e-3)
+
+
+class TestStepMetricsMonitor:
+    def test_hooks_and_scalar_writer(self, tmp_path):
+        from paddle_tpu.utils import monitor
+        seen = []
+        remove = monitor.register_step_metrics_hook(seen.append)
+        with monitor.ScalarWriter(str(tmp_path)) as w:
+            rm2 = monitor.register_step_metrics_hook(w)
+            monitor.emit_step_metrics(loss=1.5, lr=0.1)
+            monitor.emit_step_metrics(loss=1.2, lr=0.1)
+            rm2()
+        remove()
+        assert len(seen) == 2
+        assert seen[0]["loss"] == 1.5 and "step" in seen[0]
+        import json
+        lines = [json.loads(l) for l in open(w.path)]
+        assert len(lines) == 2 and lines[1]["loss"] == 1.2
+        # removers worked: further emits reach nothing
+        monitor.emit_step_metrics(loss=9.9)
+        assert len(seen) == 2
+
+    def test_hapi_fit_emits(self, tmp_path):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.hapi import Model
+        from paddle_tpu.utils import monitor
+
+        seen = []
+        remove = monitor.register_step_metrics_hook(seen.append)
+        try:
+            paddle.seed(0)
+            net = nn.Linear(4, 1)
+            m = Model(net)
+            m.prepare(paddle.optimizer.SGD(0.1,
+                                           parameters=net.parameters()),
+                      nn.MSELoss())
+            x = np.random.RandomState(0).randn(8, 4).astype("float32")
+            y = np.random.RandomState(1).randn(8, 1).astype("float32")
+            ds = paddle.io.TensorDataset([paddle.to_tensor(x),
+                                          paddle.to_tensor(y)])
+            m.fit(ds, batch_size=4, epochs=1, verbose=0)
+        finally:
+            remove()
+        assert len(seen) == 2        # 8 samples / batch 4
+        assert all("loss" in s and "epoch" in s for s in seen)

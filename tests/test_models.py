@@ -2,6 +2,10 @@
 drops (the reference's loss-parity-style oracle, SURVEY.md §4); attention
 numerics vs reference."""
 
+import pytest as _pytest_mod
+
+pytestmark = _pytest_mod.mark.slow
+
 import numpy as np
 import pytest
 

@@ -3,6 +3,10 @@
 Oracles (SURVEY.md §4): dense per-token brute force for the capacity
 dispatch math, and EP-vs-dense parity over the 8-device CPU mesh."""
 
+import pytest as _pytest_mod
+
+pytestmark = _pytest_mod.mark.slow
+
 import numpy as np
 import pytest
 

@@ -239,3 +239,79 @@ class TestBeamSearch:
         ids, lp = nn.dynamic_decode(dec, inits=h0, max_step_num=6)
         assert list(ids.shape)[:2] == [3, 4]
         assert (np.diff(lp.numpy(), axis=1) <= 1e-5).all()
+
+
+class TestReviewRound2Regressions:
+    def test_ceil_mode_pool_and_mask_agree(self):
+        x = R.randn(1, 1, 5, 5).astype("float32")
+        out, mask = F.max_pool2d(paddle.to_tensor(x), 2, 2,
+                                 ceil_mode=True, return_mask=True)
+        assert list(out.shape) == [1, 1, 3, 3]       # ceil(5/2) = 3
+        assert list(mask.shape) == [1, 1, 3, 3]
+        # last window is the partial tail: its argmax is a real element
+        assert int(mask.numpy()[0, 0, 2, 2]) == 24   # element (4,4)
+        rec = F.max_unpool2d(out, mask, 2, 2, output_size=[5, 5])
+        assert list(rec.shape) == [1, 1, 5, 5]
+
+    def test_pool_mask_string_padding_rejected(self):
+        with pytest.raises(NotImplementedError, match="padding"):
+            F.max_pool2d(paddle.to_tensor(R.randn(1, 1, 4, 4)
+                                          .astype("float32")),
+                         2, 2, padding="SAME", return_mask=True)
+
+    def test_fill_diagonal_hyper(self):
+        t = paddle.to_tensor(np.zeros((3, 3, 3), "float32"))
+        paddle.Tensor.fill_diagonal_(t, 1.0)
+        out = t.numpy()
+        assert out.sum() == 3.0
+        for i in range(3):
+            assert out[i, i, i] == 1.0
+        bad = paddle.to_tensor(np.zeros((2, 3, 3), "float32"))
+        with pytest.raises(ValueError, match="equal"):
+            paddle.Tensor.fill_diagonal_(bad, 1.0)
+
+    def test_beam_search_backtracks_parents(self):
+        """Beam rows must be FULL hypotheses (parent-pointer backtracked),
+        verified against exhaustive search over all token sequences on a
+        deterministic cell whose scores force beam reordering."""
+        import itertools
+        import jax.numpy as jnp
+
+        V, W, T = 4, 3, 3
+        rng = np.random.RandomState(9)
+        trans = rng.randn(V, V).astype("float32") * 2.0  # score[prev, next]
+
+        class Cell2:
+            """Cell whose logits depend only on the current token (the
+            state), via a fixed score table — exhaustively searchable."""
+
+            def __call__(self, ids, states):
+                logits = paddle.Tensor(jnp.take(jnp.asarray(trans),
+                                                ids._data.astype(jnp.int32),
+                                                axis=0))
+                return logits, ids
+
+        dec = paddle.nn.BeamSearchDecoder(Cell2(), start_token=0,
+                                          end_token=-1, beam_size=W,
+                                          embedding_fn=None,
+                                          output_fn=None)
+        h0 = paddle.to_tensor(np.zeros((1,), "int64"))   # state: last token
+        ids, lp = paddle.nn.dynamic_decode(dec, inits=h0, max_step_num=T)
+
+        # exhaustive best sequences by summed log-softmax score
+        import scipy.special
+        logp = scipy.special.log_softmax(trans, axis=-1)
+        scored = []
+        for seq in itertools.product(range(V), repeat=T):
+            s, prev = 0.0, 0
+            for t in seq:
+                s += logp[prev, t]
+                prev = t
+            scored.append((s, seq))
+        scored.sort(reverse=True)
+        best = [list(seq) for _, seq in scored[:W]]
+        got = [ids.numpy()[0, w].tolist() for w in range(W)]
+        assert got == best, (got, best)
+        np.testing.assert_allclose(
+            sorted(lp.numpy()[0], reverse=True),
+            [s for s, _ in scored[:W]], rtol=1e-4)

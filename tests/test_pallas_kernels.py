@@ -3,6 +3,10 @@ references. Guards against Pallas API drift that only surfaces on real
 TPU (SURVEY.md §4 TPU translation note (d)).
 """
 
+import pytest as _pytest_mod
+
+pytestmark = _pytest_mod.mark.slow
+
 import numpy as np
 import pytest
 

@@ -4,6 +4,10 @@ Oracles (SURVEY.md §4): forward/loss parity vs the same PipelineLayer run
 sequentially, and multi-step training parity vs an identical model trained
 with the eager microbatch loop."""
 
+import pytest as _pytest_mod
+
+pytestmark = _pytest_mod.mark.slow
+
 import numpy as np
 import pytest
 

@@ -1,6 +1,10 @@
 """Qwen2 / Qwen2-MoE model tests: eager training sanity, compiled-step
 parity, and expert-parallel execution under a fleet 'expert' mesh axis."""
 
+import pytest as _pytest_mod
+
+pytestmark = _pytest_mod.mark.slow
+
 import numpy as np
 import pytest
 

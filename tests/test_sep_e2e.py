@@ -2,6 +2,10 @@
 sharded over a 4-way 'sep' mesh axis inside one compiled train step, vs the
 same model run eagerly on a single device (SURVEY.md §4 oracle)."""
 
+import pytest as _pytest_mod
+
+pytestmark = _pytest_mod.mark.slow
+
 import numpy as np
 import pytest
 

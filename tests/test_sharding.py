@@ -6,6 +6,10 @@ Covers fleet ``DygraphShardingOptimizer`` (stage 1/2),
 sharding x mp case — all over the virtual 8-device CPU mesh, multi-step,
 against an identically-initialized unsharded run."""
 
+import pytest as _pytest_mod
+
+pytestmark = _pytest_mod.mark.slow
+
 import warnings
 
 import numpy as np

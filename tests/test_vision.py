@@ -2,6 +2,10 @@
 (SURVEY.md §2.2 `paddle.vision/text/audio` row; upstream
 ``python/paddle/vision/`` — UNVERIFIED reference paths)."""
 
+import pytest as _pytest_mod
+
+pytestmark = _pytest_mod.mark.slow
+
 import numpy as np
 import pytest
 
