@@ -53,7 +53,7 @@ def _train_bench(on_tpu, dev):
         else:
             # v5e 16GB: largest-fit ~2.4B with remat (dots_saveable);
             # shows the deep-config MFU, not just the 1B sweet spot
-            cfg = LlamaConfig.llama_2_7b()
+            cfg = LlamaConfig.llama_2_4b()
             batch, seq = 2, 2048
         cfg.scan_layers = False  # unrolled beats lax.scan on-chip today
         steps, warmup = 10, 3

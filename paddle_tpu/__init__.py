@@ -113,12 +113,10 @@ from .static.mode import (enable_static, disable_static,  # noqa: F401
                           in_dynamic_mode)
 
 
-def DataParallel(layers, **kwargs):
-    """paddle.DataParallel — on TPU, data parallelism is mesh-sharded
-    (GSPMD inserts the gradient psum); the wrapper exists for source parity
-    and marks the layer for the 'data' mesh axis."""
-    from .distributed.parallel import DataParallel as _DP
-    return _DP(layers, **kwargs)
+# paddle.DataParallel — on TPU, data parallelism is mesh-sharded (GSPMD
+# inserts the gradient psum); the class exists for source parity
+# (isinstance checks, no_sync ctx) and marks the layer for the 'data' axis
+from .distributed.parallel import DataParallel  # noqa: F401
 
 
 def summary(net, input_size=None, dtypes=None, input=None):

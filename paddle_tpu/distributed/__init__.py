@@ -23,7 +23,7 @@ from . import checkpoint
 from .checkpoint.save_load import (save_state_dict, load_state_dict)
 from .parallel_layers import (ColumnParallelLinear, RowParallelLinear,
                               VocabParallelEmbedding, ParallelCrossEntropy)
-from .auto_parallel_api import (to_static as dist_to_static, Strategy,
+from .auto_parallel_api import (to_static, Strategy,
                                 DistAttr, DistModel, unshard_dtensor)
 from . import launch  # noqa: F401
 from .zero_bubble import (run_pipeline_train, make_schedule)

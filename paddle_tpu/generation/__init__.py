@@ -269,17 +269,14 @@ class GenerationMixin:
         # per-row generated-token counts: a row stops accruing once finished
         counts = np.ones((b,), np.float32)
         steps_done = 1
+        # this step loop only serves the eos path now (eos-less decode
+        # returned above via the fused scan); poll finished per token to
+        # early-exit once every row hit eos
         for i in range(1, n_new):
-            if eos_i >= 0:
-                # early-exit polling only matters when an eos can finish
-                # rows; without one, skipping the poll avoids a host sync
-                # (a full tunnel round-trip) per generated token
-                fin_np = np.asarray(finished.jax())
-                if bool(fin_np.all()):
-                    break
-                counts += (~fin_np).astype(np.float32)
-            else:
-                counts += 1.0
+            fin_np = np.asarray(finished.jax())
+            if bool(fin_np.all()):
+                break
+            counts += (~fin_np).astype(np.float32)
             pos = Tensor(jnp.asarray(prompt_len + i - 1, jnp.int32))
             tok2d = Tensor(tok._data.reshape(b, 1))
             tok, lp, key_t, buf, finished, caches = step(

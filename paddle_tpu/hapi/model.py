@@ -26,6 +26,29 @@ class Model:
         if metrics is not None:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) \
                 else [metrics]
+        # AMP integration (upstream: amp_configs='O1'/'O2' or a dict):
+        # O1 = bf16 autocast around fwd/loss; O2 additionally keeps fp32
+        # master weights via GradScaler-less bf16-native flow (TPU bf16
+        # needs no loss scaling)
+        self._amp_level = None
+        if amp_configs is not None:
+            if isinstance(amp_configs, str):
+                self._amp_level = amp_configs.upper()
+            else:
+                self._amp_level = str(amp_configs.get("level",
+                                                      "O1")).upper()
+            if self._amp_level not in ("O0", "O1", "O2"):
+                raise ValueError(
+                    f"amp_configs level must be O0/O1/O2, got "
+                    f"{self._amp_level}")
+            if self._amp_level == "O0":
+                self._amp_level = None
+            elif self._amp_level == "O2":
+                from ..amp import decorate
+                out = decorate(models=self.network,
+                               optimizers=self._optimizer, level="O2")
+                self.network = out[0] if isinstance(out, (list, tuple)) \
+                    else out
 
     def _compute_loss(self, outputs, labels):
         if callable(self._loss):
@@ -35,8 +58,15 @@ class Model:
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
-        outputs = self.network(*inputs)
-        loss = self._compute_loss(outputs, labels)
+        if getattr(self, "_amp_level", None):
+            from ..amp import auto_cast
+            with auto_cast(enable=True,
+                           level=self._amp_level):
+                outputs = self.network(*inputs)
+                loss = self._compute_loss(outputs, labels)
+        else:
+            outputs = self.network(*inputs)
+            loss = self._compute_loss(outputs, labels)
         loss.backward()
         if update:
             self._optimizer.step()

@@ -14,6 +14,8 @@ from dataclasses import dataclass
 import jax.numpy as jnp
 
 from ..framework.core import Tensor, apply
+from ..framework import flags
+from ..distributed.communication import in_traced_collective
 from .. import nn
 from ..nn import functional as F
 from ..ops import creation, manipulation as M
@@ -70,8 +72,9 @@ class LlamaConfig:
                    max_position_embeddings=4096, rope_theta=10000.0)
 
     @classmethod
-    def llama_2_7b(cls):
-        """Largest-fit v5e training config: with bf16 params+grads
+    def llama_2_4b(cls):
+        """Largest-fit v5e training config (2.4B params — NOT the
+        Llama-2-7B checkpoint shape): with bf16 params+grads
         (2 x 2.4B x 2B = 9.6GB) plus remat'd activations it fills a 16GB
         chip; 8B (16GB params+grads alone) cannot fit — see BASELINE.md."""
         return cls(vocab_size=32000, hidden_size=2560,
@@ -297,6 +300,22 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
             hidden, caches = self.llama(input_ids, caches=caches, pos=pos)
         else:
             hidden = self.llama(input_ids)
+        if labels is not None and caches is None and \
+                self.lm_head is not None and \
+                flags.flag("FLAGS_fused_linear_cross_entropy") and \
+                not in_traced_collective():
+            # chunked fused lm_head+CE: never materializes [N, V] logits
+            # (~0.8GB of HBM traffic at N=4k, V=32k). Logits are not
+            # computed on this path — the labeled training forward
+            # returns (None, loss).
+            from ..ops.fused_ce import fused_linear_cross_entropy as flce
+            from ..framework.core import apply
+            h2 = M.reshape(hidden[:, :-1, :],
+                           [-1, self.config.hidden_size])
+            l2 = M.reshape(labels[:, 1:], [-1])
+            loss = apply(flce, h2, self.lm_head.weight, l2,
+                         name="fused_linear_xent")
+            return None, loss
         if self.lm_head is not None:
             logits = self.lm_head(hidden)
         else:

@@ -68,6 +68,10 @@ def _pool(x, kernel, stride, padding, n, op, channel_last, ceil_mode=False,
                 s_in = a.shape[d]
                 out_ceil = -(-(s_in + lo + hi - window[d]) //
                              strides[d]) + 1
+                # the last window must START inside input+left-pad
+                # (paddle/torch rule) — otherwise it would be all padding
+                if (out_ceil - 1) * strides[d] >= s_in + lo:
+                    out_ceil -= 1
                 need = (out_ceil - 1) * strides[d] + window[d] \
                     - (s_in + lo + hi)
                 if need > 0:
@@ -301,8 +305,13 @@ def _max_pool_indices_nd(x, kernel, stride, padding, n, channel_last,
     N, C = a.shape[:2]
     sp = a.shape[2:]
     if ceil_mode:
-        out_sp = tuple(-(-(s + 2 * pi - k) // st) + 1
-                       for s, pi, k, st in zip(sp, p, kernel, stride))
+        out_sp = []
+        for s_, pi, k, st in zip(sp, p, kernel, stride):
+            o = -(-(s_ + 2 * pi - k) // st) + 1
+            if (o - 1) * st >= s_ + pi:   # window must start inside
+                o -= 1
+            out_sp.append(o)
+        out_sp = tuple(out_sp)
     else:
         out_sp = tuple((s + 2 * pi - k) // st + 1
                        for s, pi, k, st in zip(sp, p, kernel, stride))

@@ -366,13 +366,21 @@ class BeamSearchDecoder:
         inputs = self.embedding_fn(ids) if self.embedding_fn else ids
         out, new_states = self.cell(inputs, states)
         logits = self.output_fn(out) if self.output_fn else out
-        logp = Tensor(jax.nn.log_softmax(logits._data, axis=-1))
+        logp = jax.nn.log_softmax(logits._data, axis=-1)
         W = self.beam_size
         V = logp.shape[-1]
         bw = logp.shape[0]
         B = bw // W
 
-        total = logp._data + log_probs._data[:, None]      # [B*W, V]
+        if finished is not None and self.end_token >= 0:
+            # freeze finished hypotheses: they may only emit end_token at
+            # zero cost, so their score stays put and they stay rankable
+            frozen = jnp.full((V,), -1e9, logp.dtype).at[
+                self.end_token].set(0.0)
+            logp = jnp.where(finished._data[:, None], frozen[None, :],
+                             logp)
+
+        total = logp + log_probs._data[:, None]            # [B*W, V]
         flat = total.reshape(B, W * V)
         top_lp, top_idx = jax.lax.top_k(flat, W)           # [B, W]
         beam = top_idx // V                                # source beam

@@ -1,0 +1,104 @@
+"""Fused linear + cross-entropy (the Liger/chunked-vocab trick).
+
+The LM loss tail — ``logits = h @ W; ce(logits, labels)`` — materializes
+a [N, V] logits tensor (bf16 fwd + f32 softmax + bf16 dlogits in bwd);
+at N=4k, V=32k that is ~0.8GB of HBM traffic per step. This op never
+materializes the full logits: the forward scans token chunks computing
+only logsumexp + the target logit, and the custom VJP re-computes each
+chunk's softmax on the fly, emitting dh rows and accumulating dW.
+FLOPs are unchanged (plus one re-matmul, the classic remat trade);
+peak memory drops from N*V to chunk*V.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["fused_linear_cross_entropy"]
+
+
+def _chunk_rows(v: int, target_bytes: int = 32 * 2 ** 20) -> int:
+    """Rows per chunk so one f32 logits chunk is ~target_bytes (32MB
+    measured best on the v5e 2.4B bench: 62.7% MFU vs 26.4% at 256MB
+    chunks, which HBM-thrash against remat)."""
+    return max(target_bytes // max(4 * v, 1), 16)
+
+
+def _chunked(h, labels, v, ignore_index):
+    """[N, D] -> [C, rows, D], padding N up to a multiple of the chunk
+    rows (pad rows carry ignore_index, contributing nothing) — so a
+    prime N never degrades to single-row chunks."""
+    n = h.shape[0]
+    rows = min(_chunk_rows(v), n)
+    c = -(-n // rows)
+    pad = c * rows - n
+    if pad:
+        h = jnp.concatenate(
+            [h, jnp.zeros((pad, h.shape[1]), h.dtype)], axis=0)
+        labels = jnp.concatenate(
+            [labels, jnp.full((pad,), ignore_index, labels.dtype)], axis=0)
+    return (h.reshape(c, rows, h.shape[1]),
+            labels.reshape(c, rows), pad)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_linear_cross_entropy(h, w, labels, ignore_index=-100):
+    """mean CE of ``h @ w`` against ``labels`` without materializing
+    logits. h: [N, D] (any float dtype), w: [D, V], labels: [N] int;
+    rows with ``ignore_index`` contribute nothing."""
+    loss, _ = _flce_fwd(h, w, labels, ignore_index)
+    return loss
+
+
+def _flce_fwd(h, w, labels, ignore_index):
+    v = w.shape[1]
+    hc, lc, _pad = _chunked(h, labels, v, ignore_index)
+
+    def chunk(carry, xs):
+        hh, ll = xs
+        logits = (hh @ w).astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        valid = ll != ignore_index
+        safe = jnp.where(valid, ll, 0)
+        tgt = jnp.take_along_axis(logits, safe[:, None], -1)[:, 0]
+        per = jnp.where(valid, lse - tgt, 0.0)
+        tot, cnt = carry
+        return (tot + jnp.sum(per),
+                cnt + jnp.sum(valid.astype(jnp.float32))), None
+
+    (total, count), _ = lax.scan(chunk, (jnp.zeros(()), jnp.zeros(())),
+                                 (hc, lc))
+    loss = total / jnp.maximum(count, 1.0)
+    return loss, (h, w, labels, count)
+
+
+def _flce_bwd(ignore_index, res, g):
+    h, w, labels, count = res
+    n, v = h.shape[0], w.shape[1]
+    hc, lc, _pad = _chunked(h, labels, v, ignore_index)
+    scale = g / jnp.maximum(count, 1.0)
+
+    def chunk(dw_acc, xs):
+        hh, ll = xs
+        logits = (hh @ w).astype(jnp.float32)
+        p = jax.nn.softmax(logits, axis=-1)
+        valid = (ll != ignore_index)
+        safe = jnp.where(valid, ll, 0)
+        onehot = jax.nn.one_hot(safe, v, dtype=jnp.float32)
+        dlogits = (p - onehot) * valid[:, None].astype(jnp.float32) * scale
+        dlogits = dlogits.astype(h.dtype)
+        dh = dlogits @ w.T
+        dw_acc = dw_acc + (hh.T @ dlogits).astype(jnp.float32)
+        return dw_acc, dh
+
+    dw0 = jnp.zeros(w.shape, jnp.float32)
+    dw, dh_chunks = lax.scan(chunk, dw0, (hc, lc))
+    dh = dh_chunks.reshape(-1, h.shape[1])[:n].astype(h.dtype)
+    return dh, dw.astype(w.dtype), None
+
+
+fused_linear_cross_entropy.defvjp(_flce_fwd, _flce_bwd)

@@ -145,3 +145,46 @@ class TestStepMetricsMonitor:
             remove()
         assert len(seen) == 2        # 8 samples / batch 4
         assert all("loss" in s and "epoch" in s for s in seen)
+
+
+class TestModelPrepareAmp:
+    def test_o1_autocast_trains(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.hapi import Model
+
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        m = Model(net)
+        m.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  nn.MSELoss(), amp_configs="O1")
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(8, 4).astype("float32"))
+        y = paddle.to_tensor(
+            np.random.RandomState(1).randn(8, 1).astype("float32"))
+        l0 = m.train_batch([x], y)[0]
+        for _ in range(10):
+            l1 = m.train_batch([x], y)[0]
+        assert l1 < l0
+
+    def test_o2_decorates_params(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.hapi import Model
+
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        m = Model(net)
+        m.prepare(paddle.optimizer.SGD(0.1, parameters=net.parameters()),
+                  nn.MSELoss(), amp_configs={"level": "O2"})
+        assert str(net.weight.dtype) == "bfloat16"
+
+    def test_bad_level_rejected(self):
+        import pytest
+        from paddle_tpu.hapi import Model
+        from paddle_tpu import nn
+        m = Model(nn.Linear(2, 1))
+        with pytest.raises(ValueError, match="O0/O1/O2"):
+            m.prepare(amp_configs="O7")

@@ -315,3 +315,36 @@ class TestReviewRound2Regressions:
         np.testing.assert_allclose(
             sorted(lp.numpy()[0], reverse=True),
             [s for s, _ in scored[:W]], rtol=1e-4)
+
+
+def test_beam_search_freezes_finished_hypotheses():
+    """A hypothesis that hits end_token must keep its score (emitting only
+    end_token at zero cost) instead of decaying and dropping out."""
+    import jax.numpy as jnp
+
+    V, W = 4, 2
+    # token 3 = eos; from state 0, token 3 is by far the best move
+    trans = np.full((V, V), -5.0, "float32")
+    trans[0, 3] = 5.0      # finish immediately (best)
+    trans[0, 1] = 2.0      # or continue via 1
+    trans[1, 2] = 4.0
+    trans[3, :] = -10.0    # post-eos moves are terrible: a non-frozen
+    trans[3, 0] = -9.0     # finished beam would decay fast
+
+    class Cell:
+        def __call__(self, ids, states):
+            logits = paddle.Tensor(jnp.take(jnp.asarray(trans),
+                                            ids._data.astype(jnp.int32),
+                                            axis=0))
+            return logits, ids
+
+    dec = paddle.nn.BeamSearchDecoder(Cell(), start_token=0, end_token=3,
+                                      beam_size=W)
+    h0 = paddle.to_tensor(np.zeros((1,), "int64"))
+    ids, lp = paddle.nn.dynamic_decode(dec, inits=h0, max_step_num=4)
+    # best hypothesis: [3, 3, 3, 3] (finished at step 1, then frozen)
+    assert ids.numpy()[0, 0].tolist() == [3, 3, 3, 3]
+    # its score must be exactly the single-step logprob of emitting eos
+    import scipy.special
+    expect = scipy.special.log_softmax(trans[0])[3]
+    np.testing.assert_allclose(lp.numpy()[0, 0], expect, rtol=1e-5)
