@@ -16,7 +16,9 @@ from .communication import (all_reduce, all_gather, all_gather_object,
                             broadcast, broadcast_object_list, reduce, scatter,
                             send, recv, isend, irecv, barrier, new_group,
                             get_group, wait, ReduceOp, P2POp,
-                            batch_isend_irecv, stream)
+                            batch_isend_irecv, stream, gather,
+                            scatter_object_list, destroy_process_group,
+                            get_backend, is_available)
 from .parallel import DataParallel
 from . import fleet
 from . import checkpoint
@@ -28,8 +30,17 @@ from .auto_parallel_api import (to_static, Strategy,
 from . import launch  # noqa: F401
 from .zero_bubble import (run_pipeline_train, make_schedule)
 from ..native import TCPStore  # noqa: F401 — rendezvous control plane
+from . import rpc  # noqa: F401 — control-plane RPC (init_rpc/rpc_sync/...)
+from . import sharding  # noqa: F401 — group_sharded_parallel namespace
+from . import utils  # noqa: F401
+from .spawn_api import spawn
+from .parallelize import (parallelize, ColWiseParallel, RowWiseParallel,
+                          PrepareLayerInput, PrepareLayerOutput)
 
 __all__ = [
+    "spawn", "gather", "scatter_object_list",
+    "destroy_process_group", "get_backend", "is_available",
+    "parallelize", "ColWiseParallel", "RowWiseParallel",
     "init_parallel_env", "get_rank", "get_world_size", "is_initialized",
     "ParallelEnv", "ProcessMesh", "Shard", "Replicate", "Partial",
     "shard_tensor", "reshard", "dtensor_from_fn", "shard_layer", "get_mesh",

@@ -84,8 +84,25 @@ def _axis_bound(name: str) -> bool:
 
 
 def in_traced_collective(group=None) -> bool:
+    """Inside a traced manual-collective region for ``group``. With no
+    group (or the axis-less default group): inside ANY mapped-axis
+    region (shard_map) — per-device values there must not be treated as
+    global."""
     g = group or _default_group
-    return g.axis_name is not None and _axis_bound(g.axis_name)
+    if g.axis_name is not None:
+        return _axis_bound(g.axis_name)
+    from jax._src import core as _core
+    try:
+        return bool(_core.nonempty_axis_env())
+    except Exception:
+        return False
+
+
+def axis_in_traced_region(name) -> bool:
+    """True when the NAMED mesh axis is bound in the current trace — the
+    guard TP/SP layers need (a shard_map over 'pipe' must not flip a
+    'model'-axis layer into its explicit-collective branch)."""
+    return _axis_bound(name)
 
 
 def new_group(ranks=None, backend=None, timeout=None, axis_name=None):
@@ -269,12 +286,20 @@ def broadcast_object_list(object_list, src=0, group=None):
     import pickle
     import numpy as np
     from jax.experimental import multihost_utils
+    # broadcast_one_to_all needs identical shapes on every host:
+    # broadcast the byte length first, then the zero-padded payload
     if get_rank() == src:
         payload = np.frombuffer(pickle.dumps(object_list), np.uint8)
     else:
         payload = np.zeros(0, np.uint8)
+    n = multihost_utils.broadcast_one_to_all(
+        jnp.asarray([payload.size], jnp.int32),
+        is_source=get_rank() == src)
+    total = int(np.asarray(n)[0])
+    padded = np.zeros(total, np.uint8)
+    padded[: payload.size] = payload[:total]
     out = multihost_utils.broadcast_one_to_all(
-        jnp.asarray(payload), is_source=get_rank() == src)
+        jnp.asarray(padded), is_source=get_rank() == src)
     if get_rank() != src:
         object_list[:] = pickle.loads(np.asarray(out).tobytes())
     return object_list
@@ -389,3 +414,61 @@ class stream:
     scatter = staticmethod(scatter)
     send = staticmethod(send)
     recv = staticmethod(recv)
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Gather to the dst rank. SPMD/TPU note: inside a traced collective
+    this is an all_gather (every shard holds the result — a root-only
+    gather has no cheaper lowering over ICI); single-process it fills
+    gather_list from the tensor."""
+    if gather_list is None:
+        gather_list = []
+    if in_traced_collective(group) or not _single(group):
+        parts = all_gather([], tensor, group=group)
+        gather_list.extend(parts if isinstance(parts, list) else [parts])
+        return gather_list
+    gather_list.append(tensor)
+    return gather_list
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    """Host-side object scatter (control plane): broadcast the src list,
+    each rank keeps its group-rank element."""
+    if get_world_size() <= 1:
+        out_object_list[:] = [in_object_list[0]] if in_object_list else []
+        return out_object_list
+    payload = list(in_object_list) if get_rank() == src \
+        and in_object_list is not None else []
+    broadcast_object_list(payload, src=src, group=group)
+    g = group or _default_group
+    r = g.ranks.index(get_rank()) if g.ranks and get_rank() in g.ranks \
+        else get_rank()
+    out_object_list[:] = [payload[r]]
+    return out_object_list
+
+
+def destroy_process_group(group=None):
+    """Tear down process-group state (paddle parity). PJRT owns the real
+    collectives context; this clears the python-side env/topology so a
+    fresh init_parallel_env starts clean."""
+    from . import env as _env
+    _env._initialized = False
+    from .fleet import base as _fb
+    _fb.fleet._hcg = None
+    _fb.fleet._topology = None
+    _fb.fleet._is_initialized = False
+
+
+def get_backend(group=None) -> str:
+    """The collective backend name ('xla': ICI/DCN collectives compiled
+    by XLA — the role NCCL plays in the reference)."""
+    return "xla"
+
+
+def is_available() -> bool:
+    return True
+
+
+__all__ += ["gather", "scatter_object_list", "destroy_process_group",
+            "get_backend", "is_available"]

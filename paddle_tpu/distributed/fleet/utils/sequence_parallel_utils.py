@@ -28,7 +28,7 @@ from ....framework.core import Tensor, apply
 from ....nn.layer.layers import Layer
 from ....nn import functional as F
 from ....nn import initializer as I
-from ...communication import in_traced_collective
+from ...communication import axis_in_traced_region
 
 __all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
            "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
@@ -60,7 +60,7 @@ def ScatterOp(x, axis=1):
     axis_name, mesh, world = _mp()
     if world <= 1:
         return x
-    if in_traced_collective():
+    if axis_in_traced_region(axis_name):
         def fn(a):
             r = lax.axis_index(axis_name)
             per = a.shape[axis] // lax.axis_size(axis_name)
@@ -76,7 +76,7 @@ def GatherOp(x, axis=1):
     axis_name, mesh, world = _mp()
     if world <= 1:
         return x
-    if in_traced_collective():
+    if axis_in_traced_region(axis_name):
         return apply(lambda a: lax.all_gather(a, axis_name, axis=axis,
                                               tiled=True), x,
                      name="sp_gather")
@@ -93,7 +93,7 @@ def ReduceScatterOp(x, axis=1):
     axis_name, mesh, world = _mp()
     if world <= 1:
         return x
-    if in_traced_collective():
+    if axis_in_traced_region(axis_name):
         return apply(lambda a: lax.psum_scatter(a, axis_name,
                                                 scatter_dimension=axis,
                                                 tiled=True), x,
@@ -132,11 +132,11 @@ class ColumnSequenceParallelLinear(Layer):
 
     def forward(self, x):
         axis, world = self._axis, self.world_size
-        if in_traced_collective() and axis is not None and world > 1:
+        if axis_in_traced_region(axis) and world > 1:
             x = GatherOp(x, axis=1)
         out = F.linear(x, self.weight, self.bias)
         if not self.gather_output and self._mesh is not None and world > 1 \
-                and not in_traced_collective():
+                and not axis_in_traced_region(axis):
             spec = [None] * out.ndim
             spec[-1] = axis
             out = _constrain(out, PartitionSpec(*spec))
@@ -169,7 +169,7 @@ class RowSequenceParallelLinear(Layer):
 
     def forward(self, x):
         axis, world = self._axis, self.world_size
-        if in_traced_collective() and axis is not None and world > 1:
+        if axis_in_traced_region(axis) and world > 1:
             out = F.linear(x, self.weight, None)
             out = ReduceScatterOp(out, axis=1)
             if self.bias is not None:

@@ -18,7 +18,7 @@ from ..framework.core import Tensor, apply
 from ..nn.layer.layers import Layer
 from ..nn import functional as F
 from ..nn import initializer as I
-from .communication import in_traced_collective
+from .communication import axis_in_traced_region
 
 __all__ = ["ColumnParallelLinear", "RowParallelLinear",
            "VocabParallelEmbedding", "ParallelCrossEntropy"]
@@ -92,7 +92,7 @@ class ColumnParallelLinear(Layer):
 
     def forward(self, x):
         axis, mesh = self._axis, self._mesh
-        if in_traced_collective() and axis is not None:
+        if axis_in_traced_region(axis):
             # explicit shard_map path: local matmul, output stays sharded
             out = F.linear(x, self.weight, self.bias)
             if self.gather_output:
@@ -139,7 +139,7 @@ class RowParallelLinear(Layer):
 
     def forward(self, x):
         axis, mesh = self._axis, self._mesh
-        if in_traced_collective() and axis is not None:
+        if axis_in_traced_region(axis):
             out = F.linear(x, self.weight, None)
             out = apply(lambda a: lax.psum(a, axis), out,
                         name="mp_allreduce")
@@ -176,7 +176,7 @@ class VocabParallelEmbedding(Layer):
 
     def forward(self, x):
         axis = self._axis
-        if in_traced_collective() and axis is not None:
+        if axis_in_traced_region(axis):
             world = lax.axis_size(axis)
             per = self.num_embeddings // world
 
@@ -208,7 +208,7 @@ class ParallelCrossEntropy(Layer):
 
     def forward(self, input, label):
         axis = self._axis
-        if in_traced_collective() and axis is not None:
+        if axis_in_traced_region(axis):
             ignore = self.ignore_index
 
             def fn(logits, lab):

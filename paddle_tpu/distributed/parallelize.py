@@ -1,0 +1,124 @@
+"""``paddle.distributed.parallelize`` — the paddle-3.x one-call
+auto-parallel API (upstream ``python/paddle/distributed/auto_parallel/
+intermediate/parallelize.py``, UNVERIFIED; reference mount empty).
+
+TPU-native: a parallelize_plan maps sublayer-name patterns to placement
+markers (ColWiseParallel / RowWiseParallel / PrepareLayerInput/Output);
+applying the plan device_puts the matched weights with a NamedSharding
+over the mesh's 'model' axis and GSPMD compiles the collectives. dp
+sharding needs no model rewrite (batch sharding at the input is enough);
+pp is served by the PipelineLayer engine, not this entry point.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+__all__ = ["parallelize", "ColWiseParallel", "RowWiseParallel",
+           "PrepareLayerInput", "PrepareLayerOutput"]
+
+
+class _Placement:
+    pass
+
+
+class ColWiseParallel(_Placement):
+    """Linear weight [in, out]: shard the OUT dim; Embedding weight
+    [V, D]: shard the D dim (upstream semantics)."""
+
+    def spec_for(self, param_name, shape):
+        if param_name.endswith("bias") and len(shape) == 1:
+            return PartitionSpec("model")
+        if len(shape) == 2:
+            return PartitionSpec(None, "model")
+        return PartitionSpec()
+
+
+class RowWiseParallel(_Placement):
+    """Linear weight [in, out]: shard the IN dim; Embedding weight
+    [V, D]: shard the vocab dim."""
+
+    def spec_for(self, param_name, shape):
+        if len(shape) == 2:
+            return PartitionSpec("model", None)
+        return PartitionSpec()   # bias replicated (output is full)
+
+
+class PrepareLayerInput(_Placement):
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def spec_for(self, param_name, shape):
+        return None
+
+
+class PrepareLayerOutput(_Placement):
+    def __init__(self, fn=None):
+        self.fn = fn
+
+    def spec_for(self, param_name, shape):
+        return None
+
+
+def _get_mesh(config):
+    from .fleet import base as fb
+
+    mp = 1
+    if config and "mp_config" in config:
+        # degree may be given; else fill from devices
+        mp = int(config.get("mp_degree", 0)) or 0
+    if fb.fleet._hcg is None:
+        strategy = fb.DistributedStrategy()
+        n = jax.device_count()
+        strategy.hybrid_configs = {"dp_degree": -1,
+                                   "mp_degree": mp or n,
+                                   "pp_degree": 1, "sharding_degree": 1,
+                                   "sep_degree": 1, "ep_degree": 1}
+        fb.fleet.init(strategy=strategy)
+    return fb.fleet._hcg.global_mesh
+
+
+def parallelize(model, optimizer=None, mesh=None, config=None):
+    """Apply a parallelize_plan to ``model`` (and wrap ``optimizer`` for
+    sharding when dp_config asks). Returns (model, optimizer)."""
+    config = config or {}
+    plan = (config.get("mp_config") or {}).get("parallelize_plan") or {}
+    if plan:
+        the_mesh = mesh if mesh is not None and hasattr(mesh, "shape") \
+            else _get_mesh(config)
+        matched = set()
+        for lname, layer in model.named_sublayers():
+            for pattern, placement in plan.items():
+                if not isinstance(placement, _Placement):
+                    raise TypeError(
+                        f"parallelize_plan values must be placements, "
+                        f"got {placement!r}")
+                if fnmatch.fnmatch(lname, pattern) or lname == pattern:
+                    matched.add(pattern)
+                    for pname, p in layer.named_parameters(
+                            include_sublayers=False):
+                        spec = placement.spec_for(pname, p.shape)
+                        if spec is None:
+                            continue
+                        p.set_data(jax.device_put(
+                            p._data, NamedSharding(the_mesh, spec)))
+                        p.is_distributed = True
+        unmatched = set(plan) - matched
+        if unmatched:
+            import warnings
+
+            warnings.warn(
+                f"parallelize: plan patterns matched no sublayer: "
+                f"{sorted(unmatched)}")
+    if optimizer is not None and (config.get("dp_config") or {}).get(
+            "sharding_level"):
+        from .fleet.sharding import DygraphShardingOptimizer
+        from .fleet import base as fb
+
+        if fb.fleet._hcg is not None:
+            optimizer = DygraphShardingOptimizer(optimizer, fb.fleet._hcg)
+            optimizer._place_new_state()
+    return model, optimizer

@@ -1,0 +1,221 @@
+"""``paddle.distributed.rpc`` — RPC framework parity (upstream
+``python/paddle/distributed/rpc/`` over brpc, UNVERIFIED; reference
+mount empty).
+
+TPU-native design: the control plane is plain TCP (one listener thread
+per worker serving pickled call requests) with rendezvous through the
+native ``TCPStore`` (paddle_tpu/native — the same C++ store the
+launcher/elastic stack uses). This is host-side coordination machinery:
+tensors never ride RPC on TPU (collectives do that); RPC exists for the
+reference's control-plane uses — parameter-server-style coordination,
+metrics aggregation, custom orchestration.
+
+API parity: ``init_rpc``, ``rpc_sync``, ``rpc_async`` (returns a future
+with ``wait()``), ``get_worker_info``, ``get_all_worker_infos``,
+``shutdown``.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import socketserver
+import threading
+import time
+from dataclasses import dataclass
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "shutdown",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _Future:
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def _set(self, value=None, exc=None):
+        self._value, self._exc = value, exc
+        self._event.set()
+
+    def wait(self, timeout=None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("rpc future timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+    def done(self):
+        return self._event.is_set()
+
+
+class _State:
+    def __init__(self):
+        self.name = None
+        self.rank = None
+        self.workers: dict[str, WorkerInfo] = {}
+        self.server = None
+        self.server_thread = None
+        self.store = None
+
+
+_state = _State()
+_MAGIC = b"PTRPC1"
+
+
+def _recv_exact(sock, n):
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            raise ConnectionError("rpc peer closed")
+        buf += part
+    return buf
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj)
+    sock.sendall(_MAGIC + len(payload).to_bytes(8, "big") + payload)
+
+
+def _recv_msg(sock):
+    head = _recv_exact(sock, len(_MAGIC) + 8)
+    if head[:len(_MAGIC)] != _MAGIC:
+        raise ConnectionError("rpc protocol mismatch")
+    n = int.from_bytes(head[len(_MAGIC):], "big")
+    return pickle.loads(_recv_exact(sock, n))
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        try:
+            req = _recv_msg(self.request)
+        except ConnectionError:
+            return
+        try:
+            fn, args, kwargs = req
+            result = fn(*args, **(kwargs or {}))
+            _send_msg(self.request, ("ok", result))
+        except Exception as e:  # noqa: BLE001 — forwarded to the caller
+            _send_msg(self.request, ("err", e))
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
+    """Start this worker's RPC server and rendezvous with peers through
+    the TCPStore at ``master_endpoint`` (rank 0 hosts the store)."""
+    import os
+
+    from ..native import TCPStore
+
+    if _state.server is not None:
+        raise RuntimeError("init_rpc already called; shutdown() first")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else int(rank)
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else int(world_size)
+    master_endpoint = master_endpoint or os.environ.get(
+        "PADDLE_MASTER", "127.0.0.1:29550")
+    host, port_s = master_endpoint.rsplit(":", 1)
+
+    server = _Server(("0.0.0.0", 0), _Handler)
+    my_port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+
+    store = TCPStore(host, int(port_s), is_master=(rank == 0),
+                     world_size=world_size)
+    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else \
+        socket.gethostbyname(socket.gethostname())
+    store.set(f"rpc/{rank}",
+              pickle.dumps(WorkerInfo(name, rank, my_ip, my_port)))
+    workers = {}
+    deadline = time.time() + 60
+    for r in range(world_size):
+        while True:
+            raw = store.get(f"rpc/{r}")
+            if raw:
+                info = pickle.loads(raw)
+                workers[info.name] = info
+                break
+            if time.time() > deadline:
+                raise TimeoutError(f"rpc rendezvous: rank {r} missing")
+            time.sleep(0.05)
+
+    _state.name, _state.rank = name, rank
+    _state.workers = workers
+    _state.server, _state.server_thread = server, t
+    _state.store = store
+    return get_worker_info(name)
+
+
+def get_worker_info(name=None) -> WorkerInfo:
+    if name is None:
+        name = _state.name
+    try:
+        return _state.workers[name]
+    except KeyError:
+        raise RuntimeError(f"unknown rpc worker {name!r}; "
+                           "init_rpc first") from None
+
+
+def get_all_worker_infos():
+    return sorted(_state.workers.values(), key=lambda w: w.rank)
+
+
+def rpc_async(to, fn, args=None, kwargs=None, timeout=None) -> _Future:
+    """Run ``fn(*args, **kwargs)`` on worker ``to``; returns a future.
+    ``fn`` must be picklable (module-level) and importable on the
+    callee."""
+    info = get_worker_info(to)
+    fut = _Future()
+
+    def call():
+        try:
+            with socket.create_connection((info.ip, info.port),
+                                          timeout=timeout) as sock:
+                _send_msg(sock, (fn, tuple(args or ()), dict(kwargs or {})))
+                status, value = _recv_msg(sock)
+            if status == "ok":
+                fut._set(value=value)
+            else:
+                fut._set(exc=value)
+        except Exception as e:  # noqa: BLE001
+            fut._set(exc=e)
+
+    threading.Thread(target=call, daemon=True).start()
+    return fut
+
+
+def rpc_sync(to, fn, args=None, kwargs=None, timeout=None):
+    return rpc_async(to, fn, args=args, kwargs=kwargs,
+                     timeout=timeout).wait(timeout)
+
+
+def shutdown():
+    """Barrier with peers, then stop the server (upstream: graceful
+    shutdown waits for outstanding work)."""
+    st = _state
+    if st.server is None:
+        return
+    if st.store is not None and len(st.workers) > 1:
+        done = st.store.add("rpc/shutdown", 1)
+        deadline = time.time() + 30
+        while done < len(st.workers) and time.time() < deadline:
+            time.sleep(0.05)
+            done = st.store.add("rpc/shutdown", 0)
+    st.server.shutdown()
+    st.server.server_close()
+    st.__init__()

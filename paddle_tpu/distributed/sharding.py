@@ -1,0 +1,26 @@
+"""``paddle.distributed.sharding`` namespace (upstream
+python/paddle/distributed/sharding/, UNVERIFIED) — re-exports the fleet
+group-sharded implementations."""
+
+from .fleet.sharding import (group_sharded_parallel, GroupShardedStage3,
+                             DygraphShardingOptimizer, shard_array_over)
+
+__all__ = ["group_sharded_parallel", "GroupShardedStage3",
+           "DygraphShardingOptimizer", "shard_array_over"]
+
+
+def save_group_sharded_model(model, output, optimizer=None):
+    """Save a group-sharded model (upstream parity): gathers shards are
+    NamedSharding-backed, so a plain state_dict save is already global."""
+    import os
+
+    from ..framework.io import save
+
+    os.makedirs(output, exist_ok=True)
+    target = model._layer if hasattr(model, "_layer") else model
+    save(target.state_dict(), os.path.join(output, "model.pdparams"))
+    if optimizer is not None:
+        save(optimizer.state_dict(), os.path.join(output, "model.pdopt"))
+
+
+__all__.append("save_group_sharded_model")

@@ -1,0 +1,25 @@
+"""``paddle.distributed.utils`` helpers (upstream parity, minimal)."""
+
+from __future__ import annotations
+
+__all__ = ["get_available_device", "global_scatter", "global_gather"]
+
+
+def get_available_device():
+    """Device ids visible to this process (TPU chips, else CPU)."""
+    import jax
+
+    return [str(i) for i in range(jax.local_device_count())]
+
+
+def global_scatter(x, local_count, global_count, group=None):
+    raise NotImplementedError(
+        "utils.global_scatter is the GPU MoE dispatch primitive; on TPU "
+        "use paddle_tpu.ops.moe (all-to-all dispatch inside the compiled "
+        "step)")
+
+
+def global_gather(x, local_count, global_count, group=None):
+    raise NotImplementedError(
+        "utils.global_gather is the GPU MoE combine primitive; on TPU "
+        "use paddle_tpu.ops.moe")

@@ -309,7 +309,6 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
             # computed on this path — the labeled training forward
             # returns (None, loss).
             from ..ops.fused_ce import fused_linear_cross_entropy as flce
-            from ..framework.core import apply
             h2 = M.reshape(hidden[:, :-1, :],
                            [-1, self.config.hidden_size])
             l2 = M.reshape(labels[:, 1:], [-1])

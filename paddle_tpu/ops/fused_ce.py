@@ -33,7 +33,7 @@ def _chunked(h, labels, v, ignore_index):
     rows (pad rows carry ignore_index, contributing nothing) — so a
     prime N never degrades to single-row chunks."""
     n = h.shape[0]
-    rows = min(_chunk_rows(v), n)
+    rows = min(_chunk_rows(v), n) if n else 1
     c = -(-n // rows)
     pad = c * rows - n
     if pad:
