@@ -1,0 +1,86 @@
+"""End-to-end LLM serving flow: build a Llama, export it with
+``paddle.jit.save``, load it into the inference engine
+(``Config``/``create_predictor``), and run batched KV-cache generation —
+greedy and sampling — through the fused device-side decode loop.
+
+CPU-runnable (tiny config); on a TPU chip the same script serves the 1B
+config at ~4 ms/token for a batch of 8 (see BASELINE.md / bench.py).
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+ON_TPU = False
+try:
+    import jax
+
+    ON_TPU = jax.devices()[0].platform.lower() in ("tpu", "axon")
+except Exception:
+    pass
+
+cfg = LlamaConfig.llama_1b() if ON_TPU else LlamaConfig.tiny()
+cfg.tensor_parallel = False
+cfg.scan_layers = False
+
+paddle.seed(0)
+model = LlamaForCausalLM(cfg)
+if ON_TPU:
+    model.to(dtype="bfloat16")
+model.eval()
+
+batch, prompt_len, n_new = (8, 128, 64) if ON_TPU else (2, 8, 12)
+prompt = paddle.to_tensor(np.random.RandomState(0).randint(
+    0, cfg.vocab_size, (batch, prompt_len)).astype(np.int64))
+
+# ---- 1. generation: greedy (deterministic) and sampling ------------------
+print("== generate ==")
+t0 = time.time()
+ids_greedy, scores = model.generate(prompt, max_new_tokens=n_new,
+                                    decode_strategy="greedy_search",
+                                    eos_token_id=None, pad_token_id=0)
+print(f"greedy [{batch}x{n_new}] in {time.time() - t0:.2f}s "
+      f"(first compile included); scores {scores.numpy().round(3).tolist()}")
+ids_sampled, _ = model.generate(prompt, max_new_tokens=n_new,
+                                decode_strategy="sampling", top_p=0.9,
+                                temperature=0.8, seed=7,
+                                eos_token_id=None, pad_token_id=0)
+assert list(ids_greedy.shape) == [batch, n_new]
+assert list(ids_sampled.shape) == [batch, n_new]
+print("sampled row 0:", ids_sampled.numpy()[0][:10].tolist(), "...")
+
+# ---- 2. export for the inference engine ----------------------------------
+print("== export / predictor ==")
+export_dir = os.path.join(os.path.dirname(__file__) or ".",
+                          "_llama_export")
+from paddle_tpu.jit import save as jit_save
+from paddle_tpu.static import InputSpec
+
+jit_save(model, os.path.join(export_dir, "llama"),
+         input_spec=[InputSpec([None, prompt_len], "int64", "input_ids")])
+
+from paddle_tpu.inference import Config, create_predictor
+
+config = Config(os.path.join(export_dir, "llama.pdmodel"),
+                os.path.join(export_dir, "llama.pdiparams"))
+predictor = create_predictor(config)
+in_names = predictor.get_input_names()
+h = predictor.get_input_handle(in_names[0])
+h.copy_from_cpu(np.asarray(prompt.numpy()))
+predictor.run()
+out = predictor.get_output_handle(predictor.get_output_names()[0])
+logits = out.copy_to_cpu()
+print("predictor logits:", logits.shape)
+assert logits.shape[:2] == (batch, prompt_len)
+
+# exported predictor and the live model agree
+with paddle.no_grad():
+    ref = model(prompt).numpy()
+np.testing.assert_allclose(logits, ref, rtol=2e-2, atol=2e-2)
+print("predictor == live model OK")
+print("ALL OK")

@@ -122,9 +122,19 @@ def _axis(group) -> str | None:
     return g.axis_name
 
 
+def _traced_axis_active(group) -> bool:
+    """The collective-routing guard: this group carries an axis name AND
+    that axis is bound in the current trace. (in_traced_collective with
+    no group answers the broader 'inside any shard_map region' question
+    — wrong for routing an axis-less default-group collective, which
+    must stay an identity/single-process op.)"""
+    a = _axis(group)
+    return a is not None and _axis_bound(a)
+
+
 def _single(group) -> bool:
     g = group or _default_group
-    return not in_traced_collective(g) and g.nranks <= 1
+    return not _traced_axis_active(g) and g.nranks <= 1
 
 
 def _raise_eager(op: str):
@@ -136,7 +146,7 @@ def _raise_eager(op: str):
 
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
-    if in_traced_collective(group):
+    if _traced_axis_active(group):
         name = _axis(group)
         fns = {ReduceOp.SUM: lax.psum, ReduceOp.MAX: lax.pmax,
                ReduceOp.MIN: lax.pmin,
@@ -156,7 +166,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
-    if in_traced_collective(group):
+    if _traced_axis_active(group):
         name = _axis(group)
         out = apply(lambda a: lax.all_gather(a, name), tensor,
                     name="all_gather")
@@ -202,7 +212,7 @@ def all_gather_object(object_list, obj, group=None):
 
 def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
                    sync_op=True):
-    if in_traced_collective(group):
+    if _traced_axis_active(group):
         name = _axis(group)
         src = tensor_list
         if isinstance(src, (list, tuple)):
@@ -223,7 +233,7 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
 
 
 def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
-    if in_traced_collective(group):
+    if _traced_axis_active(group):
         name = _axis(group)
         from ..ops.manipulation import stack, unbind
         stacked = stack(list(in_tensor_list), axis=0)
@@ -245,7 +255,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
-    if in_traced_collective(group):
+    if _traced_axis_active(group):
         name = _axis(group)
         out = apply(lambda a: lax.all_to_all(
             a, name, split_axis=0, concat_axis=0, tiled=True),
@@ -263,7 +273,7 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
 
 
 def broadcast(tensor, src=0, group=None, sync_op=True):
-    if in_traced_collective(group):
+    if _traced_axis_active(group):
         name = _axis(group)
         g = group or _default_group
         src_local = g.get_group_rank(src) if g.ranks else src
@@ -312,7 +322,7 @@ def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    if in_traced_collective(group):
+    if _traced_axis_active(group):
         name = _axis(group)
         from ..ops.manipulation import stack
         stacked = stack(list(tensor_list), axis=0)
@@ -334,7 +344,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
 
 
 def send(tensor, dst=0, group=None, sync_op=True):
-    if in_traced_collective(group):
+    if _traced_axis_active(group):
         raise RuntimeError(
             "point-to-point send/recv inside traced code should use "
             "lax.ppermute via paddle_tpu.distributed.fleet p2p helpers")
@@ -423,7 +433,7 @@ def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
     gather_list from the tensor."""
     if gather_list is None:
         gather_list = []
-    if in_traced_collective(group) or not _single(group):
+    if _traced_axis_active(group) or not _single(group):
         parts = all_gather([], tensor, group=group)
         gather_list.extend(parts if isinstance(parts, list) else [parts])
         return gather_list

@@ -66,10 +66,10 @@ class PrepareLayerOutput(_Placement):
 def _get_mesh(config):
     from .fleet import base as fb
 
-    mp = 1
+    mp = 0
     if config and "mp_config" in config:
-        # degree may be given; else fill from devices
-        mp = int(config.get("mp_degree", 0)) or 0
+        # degree lives inside mp_config (upstream layout); 0 = all devices
+        mp = int((config.get("mp_config") or {}).get("mp_degree", 0)) or 0
     if fb.fleet._hcg is None:
         strategy = fb.DistributedStrategy()
         n = jax.device_count()
@@ -86,16 +86,16 @@ def parallelize(model, optimizer=None, mesh=None, config=None):
     sharding when dp_config asks). Returns (model, optimizer)."""
     config = config or {}
     plan = (config.get("mp_config") or {}).get("parallelize_plan") or {}
+    bad = [v for v in plan.values() if not isinstance(v, _Placement)]
+    if bad:
+        raise TypeError(
+            f"parallelize_plan values must be placements, got {bad[:3]}")
     if plan:
         the_mesh = mesh if mesh is not None and hasattr(mesh, "shape") \
             else _get_mesh(config)
         matched = set()
         for lname, layer in model.named_sublayers():
             for pattern, placement in plan.items():
-                if not isinstance(placement, _Placement):
-                    raise TypeError(
-                        f"parallelize_plan values must be placements, "
-                        f"got {placement!r}")
                 if fnmatch.fnmatch(lname, pattern) or lname == pattern:
                     matched.add(pattern)
                     for pname, p in layer.named_parameters(
@@ -118,7 +118,8 @@ def parallelize(model, optimizer=None, mesh=None, config=None):
         from .fleet.sharding import DygraphShardingOptimizer
         from .fleet import base as fb
 
-        if fb.fleet._hcg is not None:
-            optimizer = DygraphShardingOptimizer(optimizer, fb.fleet._hcg)
-            optimizer._place_new_state()
+        if fb.fleet._hcg is None:
+            _get_mesh(config)   # dp-only configs still need the mesh
+        optimizer = DygraphShardingOptimizer(optimizer, fb.fleet._hcg)
+        optimizer._place_new_state()
     return model, optimizer

@@ -103,9 +103,16 @@ class _Handler(socketserver.BaseRequestHandler):
         try:
             fn, args, kwargs = req
             result = fn(*args, **(kwargs or {}))
-            _send_msg(self.request, ("ok", result))
+            reply = ("ok", result)
         except Exception as e:  # noqa: BLE001 — forwarded to the caller
-            _send_msg(self.request, ("err", e))
+            reply = ("err", e)
+        try:
+            _send_msg(self.request, reply)
+        except Exception:
+            # unpicklable result/exception: degrade to a picklable error
+            # carrying the repr instead of dropping the connection
+            _send_msg(self.request, ("err", RuntimeError(
+                f"rpc: reply not picklable: {reply[1]!r}")))
 
 
 class _Server(socketserver.ThreadingTCPServer):
@@ -216,6 +223,10 @@ def shutdown():
         while done < len(st.workers) and time.time() < deadline:
             time.sleep(0.05)
             done = st.store.add("rpc/shutdown", 0)
+        if st.rank == 0:
+            # peers poll every 50ms: give them a beat to observe the
+            # completed barrier before the master store goes away
+            time.sleep(0.5)
     st.server.shutdown()
     st.server.server_close()
     st.__init__()
