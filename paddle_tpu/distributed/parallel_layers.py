@@ -32,14 +32,32 @@ def _mp_axis_and_mesh():
     return hcg.mp_axis_name, hcg.global_mesh, hcg.get_model_parallel_world_size()
 
 
+def _ctx_mesh(mesh):
+    """Mesh a trace-time sharding constraint must be built on: inside a
+    (partial-)manual shard_map region — e.g. the compiled pipeline engine,
+    manual over 'pipe' while 'model'/'data'/'sharding' stay auto — the
+    constraint has to reference the current ABSTRACT mesh, whose manual
+    axes are typed Manual (a concrete-mesh NamedSharding raises
+    "Axes mentioned in vma … should be of type Manual"). Outside any
+    manual region, the concrete fleet mesh."""
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        if am is not None and not am.empty and any(
+                t == jax.sharding.AxisType.Manual for t in am.axis_types):
+            return am
+    except Exception:
+        pass
+    return mesh
+
+
 def _constrain(data, mesh, spec):
     """Apply a sharding constraint when tracing; device_put when eager."""
     if mesh is None:
         return data
-    ns = NamedSharding(mesh, spec)
     if isinstance(data, jax.core.Tracer):
-        return jax.lax.with_sharding_constraint(data, ns)
-    return jax.device_put(data, ns)
+        return jax.lax.with_sharding_constraint(
+            data, NamedSharding(_ctx_mesh(mesh), spec))
+    return jax.device_put(data, NamedSharding(mesh, spec))
 
 
 def _constrain_tensor(t, mesh, spec, name="sharding_constraint"):
@@ -49,12 +67,12 @@ def _constrain_tensor(t, mesh, spec, name="sharding_constraint"):
     fleet.utils.sequence_parallel_utils."""
     if mesh is None:
         return t
-    ns = NamedSharding(mesh, spec)
 
     def fn(a):
         if isinstance(a, jax.core.Tracer):
-            return jax.lax.with_sharding_constraint(a, ns)
-        return jax.device_put(a, ns)
+            return jax.lax.with_sharding_constraint(
+                a, NamedSharding(_ctx_mesh(mesh), spec))
+        return jax.device_put(a, NamedSharding(mesh, spec))
 
     return apply(fn, t, name=name)
 

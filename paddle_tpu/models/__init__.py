@@ -4,14 +4,16 @@ scripts driving the framework). TPU-first implementations built on
 paddle_tpu's nn + parallel layers + Pallas kernels."""
 
 from .gpt2 import GPT2Config, GPT2Model, GPT2ForCausalLM
-from .llama import LlamaConfig, LlamaModel, LlamaForCausalLM
+from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,
+                    LlamaForCausalLMPipe, LlamaPretrainingCriterion)
 from .qwen2 import (Qwen2Config, Qwen2MoeConfig, Qwen2ForCausalLM,
                     Qwen2MoeForCausalLM)
 from .ernie import (ErnieConfig, ErnieModel, ErnieForPretraining,
                     ErnieForMaskedLM, ErnieForSequenceClassification)
 
 __all__ = ["GPT2Config", "GPT2Model", "GPT2ForCausalLM", "LlamaConfig",
-           "LlamaModel", "LlamaForCausalLM", "Qwen2Config",
+           "LlamaModel", "LlamaForCausalLM", "LlamaForCausalLMPipe",
+           "LlamaPretrainingCriterion", "Qwen2Config",
            "Qwen2MoeConfig", "Qwen2ForCausalLM", "Qwen2MoeForCausalLM",
            "ErnieConfig", "ErnieModel", "ErnieForPretraining",
            "ErnieForMaskedLM", "ErnieForSequenceClassification"]

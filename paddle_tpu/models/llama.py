@@ -25,7 +25,8 @@ from ..distributed.parallel_layers import (ColumnParallelLinear,
                                            VocabParallelEmbedding)
 from ..generation import GenerationMixin
 
-__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM"]
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+           "LlamaForCausalLMPipe", "LlamaPretrainingCriterion"]
 
 
 @dataclass
@@ -330,3 +331,81 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
             M.reshape(shift_logits, [-1, self.config.vocab_size]),
             M.reshape(shift_labels, [-1]))
         return logits, loss
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel Llama (the reference's PaddleNLP LlamaForCausalLMPipe
+# shape — BASELINE config 4's 4D hybrid workload). The decoder stack is the
+# uniform pipeline body: PipelineParallel stacks the per-layer weights
+# [S, ...] over the 'pipe' mesh axis while each layer's TP layers keep their
+# 'model'-axis sharding and the optimizer state stays ZeRO-sharded over
+# 'sharding' — one compiled program, all four axes live.
+# ---------------------------------------------------------------------------
+
+
+class LlamaEmbeddingPipe(nn.Layer):
+    """Pipeline prologue: token embedding (vocab-parallel under TP)."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        init = nn.initializer.Normal(0.0, cfg.initializer_range)
+        if cfg.tensor_parallel:
+            self.embed_tokens = VocabParallelEmbedding(
+                cfg.vocab_size, cfg.hidden_size,
+                weight_attr=nn.ParamAttr(initializer=init))
+        else:
+            self.embed_tokens = nn.Embedding(
+                cfg.vocab_size, cfg.hidden_size,
+                weight_attr=nn.ParamAttr(initializer=init))
+
+    def forward(self, input_ids):
+        return self.embed_tokens(input_ids)
+
+
+class LlamaHeadPipe(nn.Layer):
+    """Pipeline epilogue: final RMSNorm + LM head -> logits."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps)
+        self.lm_head = _lin(cfg, cfg.hidden_size, cfg.vocab_size,
+                            column=True, gather_output=True)
+
+    def forward(self, hidden):
+        return self.lm_head(self.norm(hidden))
+
+
+class LlamaPretrainingCriterion(nn.Layer):
+    """Shifted next-token cross entropy — identical numerics to
+    ``LlamaForCausalLM``'s labeled forward, so pipelined training is
+    loss-parity-comparable against the monolithic model."""
+
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__()
+        self.vocab_size = cfg.vocab_size
+
+    def forward(self, logits, labels):
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        return F.cross_entropy(
+            M.reshape(shift_logits, [-1, self.vocab_size]),
+            M.reshape(shift_labels, [-1]))
+
+
+def LlamaForCausalLMPipe(config: LlamaConfig, **pipeline_kwargs):
+    """Build the pipelined Llama as a ``PipelineLayer``.
+
+    Layer construction order (embedding, decoder stack, norm+head) matches
+    ``LlamaForCausalLM`` exactly, so with the same seed both models draw
+    identical initial weights — the basis of every parity test. Pass
+    ``num_virtual_pipeline_stages`` etc. through ``pipeline_kwargs``."""
+    from ..distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+
+    descs = [LayerDesc(LlamaEmbeddingPipe, config)] + \
+        [LayerDesc(LlamaDecoderLayer, config)
+         for _ in range(config.num_hidden_layers)] + \
+        [LayerDesc(LlamaHeadPipe, config)]
+    pipeline_kwargs.setdefault("loss_fn", LlamaPretrainingCriterion(config))
+    pipeline_kwargs.setdefault(
+        "recompute_interval", 1 if config.use_recompute else 0)
+    return PipelineLayer(descs, **pipeline_kwargs)

@@ -288,6 +288,81 @@ def test_fleet_schedule_mode_parity(schedule_mode):
     assert losses[-1] < losses[0]
 
 
+# --------------------------------------------------------------------------
+# 4D hybrid: pipeline COMPOSED with TP + ZeRO sharding + DP (BASELINE
+# config 4's workload shape) — the pp axis no longer runs in isolation
+# --------------------------------------------------------------------------
+
+def test_hybrid_4d_pipeline_llama_parity():
+    """dp1 x sharding2 x pp2 x mp2 over 8 devices in ONE compiled pipeline
+    program: stage weights stacked over 'pipe' while each stage's TP
+    linears stay 'model'-sharded and optimizer state is ZeRO-sharded over
+    'sharding'. Oracle: multi-step loss parity vs the single-device eager
+    model (SURVEY.md §4's key parallelism oracle)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaForCausalLMPipe)
+
+    def cfg(par):
+        return LlamaConfig(vocab_size=256, hidden_size=64,
+                           num_hidden_layers=4, num_attention_heads=4,
+                           num_key_value_heads=2, intermediate_size=128,
+                           max_position_embeddings=32, rope_theta=10000.0,
+                           tensor_parallel=par)
+
+    ids_np = np.random.RandomState(0).randint(
+        0, 256, (4, 16)).astype(np.int64)
+    steps = 2
+
+    paddle.seed(0)
+    ref_model = LlamaForCausalLM(cfg(False))
+    ref_opt = paddle.optimizer.AdamW(1e-3,
+                                     parameters=ref_model.parameters())
+    ids_t = paddle.to_tensor(ids_np)
+    ref = []
+    for _ in range(steps):
+        _, loss = ref_model(ids_t, labels=ids_t)
+        loss.backward()
+        ref_opt.step()
+        ref_opt.clear_grad()
+        ref.append(float(loss.item()))
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                               "pp_degree": 2, "sharding_degree": 2,
+                               "sep_degree": 1, "ep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": "FThenB"}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        hcg = fleet.get_hybrid_communicate_group()
+        mesh = hcg.global_mesh
+        paddle.seed(0)
+        model = LlamaForCausalLMPipe(cfg(True))
+        engine = fleet.fleet.distributed_model(model)
+        assert isinstance(engine, PipelineParallel)
+        opt = fleet.fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(1e-3, parameters=model.parameters()))
+        ids = jax.device_put(
+            jnp.asarray(ids_np),
+            NamedSharding(mesh, PartitionSpec(("data", "sharding"))))
+        ids_p = paddle.Tensor(ids)
+        losses = [float(engine.train_batch((ids_p, ids_p), opt).item())
+                  for _ in range(steps)]
+        np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-5)
+        # TP weights really are sharded over 'model', and optimizer state
+        # over 'sharding' — the axes are live, not degenerate
+        q = model.run_function[1].self_attn.q_proj.weight
+        assert "model" in str(q._data.sharding.spec)
+        accs = opt._inner._inner._accumulators
+        assert any("sharding" in str(t._data.sharding.spec)
+                   for store in accs.values() for t in store.values())
+    finally:
+        fleet.fleet._hcg = None
+        fleet.fleet._topology = None
+        fleet.fleet._is_initialized = False
+
+
 def test_fleet_schedule_mode_unknown():
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
