@@ -262,6 +262,197 @@ def _replay_divergence(data, why: str):
         "breaking the graph")
 
 
+class _ObsCell:
+    """Bookkeeping for one observed float concretization site (a
+    ``float()``/``.item()`` read recorded during to_static discovery)."""
+
+    __slots__ = ("misused", "strict")
+
+    def __init__(self, strict=False):
+        self.misused = False
+        self.strict = strict     # replay trace: misuse must abort, not flag
+
+
+class ObservedFloat(float):
+    """A float ``.item()``-read out of a to_static-captured function
+    (SOT-style partial capture, SURVEY.md §3.5 "graph breaks").
+
+    Observation-only uses — logging, formatting, returning the value —
+    keep the graph compiled: the read becomes an extra program output
+    (fresh every call when returned). Uses that would change the program
+    — branching on it, feeding it back into tensor math, int() indexing —
+    flag ``misused`` during discovery (→ eager fallback for the
+    signature) and raise ``GraphBreak`` during a replay trace.
+    Arithmetic propagates observation: the python result mirrors onto the
+    traced scalar, so derived returned values stay fresh too.
+
+    Only ``.item()`` reads get this treatment: CPython force-converts
+    ``__float__`` results to exact float, so ``float(t)`` cannot carry
+    the taint and stays a hard graph break (its warning steers users to
+    ``.item()``). Known hole (documented divergence): conversions that
+    coerce via ``__float__`` (``math.isnan(f)``, ``"%f" % f``) are
+    treated as observation; branching on the coerced value goes
+    undetected."""
+
+    __slots__ = ("_origins", "_traced")
+
+    def __new__(cls, value, origins=(), traced=None):
+        obj = super().__new__(cls, value)
+        obj._origins = tuple(origins)
+        obj._traced = traced
+        return obj
+
+    def _misuse(self, what):
+        strict = False
+        for c in self._origins:
+            c.misused = True
+            strict = strict or c.strict
+        if strict:
+            raise GraphBreak(
+                f"a float read from the compiled graph was used for "
+                f"{what} — this cannot be captured (a stale value would "
+                "change the program); breaking the graph")
+
+    # -- uses that change the program: flag / abort ------------------------
+
+    def __bool__(self):
+        self._misuse("branching")
+        return super().__bool__()
+
+    def _cmp(self, name, other):
+        self._misuse("a comparison (likely branching)")
+        return getattr(float, name)(float(self), other)
+
+    def __lt__(self, o):
+        return self._cmp("__lt__", o)
+
+    def __le__(self, o):
+        return self._cmp("__le__", o)
+
+    def __gt__(self, o):
+        return self._cmp("__gt__", o)
+
+    def __ge__(self, o):
+        return self._cmp("__ge__", o)
+
+    def __eq__(self, o):
+        return self._cmp("__eq__", o)
+
+    def __ne__(self, o):
+        return self._cmp("__ne__", o)
+
+    __hash__ = float.__hash__
+
+    def __int__(self):
+        self._misuse("int conversion (indexing/branching)")
+        return super().__int__()
+
+    __index__ = __trunc__ = __int__
+
+    def __round__(self, *a):
+        self._misuse("rounding to int")
+        return float(self).__round__(*a)
+
+    # -- observation-preserving arithmetic ---------------------------------
+
+    def _binop(self, name, other):
+        if not isinstance(other, (int, float)):
+            return NotImplemented
+        res = getattr(float, name)(float(self), float(other))
+        if res is NotImplemented:
+            return res
+        origins = self._origins
+        o_traced = None
+        if isinstance(other, ObservedFloat):
+            origins = origins + other._origins
+            o_traced = other._traced
+        traced = None
+        if self._traced is not None or o_traced is not None:
+            # keep the traced value's own dtype (no float32 forcing):
+            # under x64 a float64 loss must mirror in float64, or
+            # compiled-call results would drift from the eager discovery
+            a = self._traced if self._traced is not None else float(self)
+            b = o_traced if o_traced is not None else float(other)
+            try:
+                traced = getattr(jnp.asarray(a), name)(jnp.asarray(b))
+                if traced is NotImplemented:
+                    traced = None
+            except Exception:
+                traced = None
+        return ObservedFloat(res, origins, traced)
+
+    def __add__(self, o):
+        return self._binop("__add__", o)
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop("__sub__", o)
+
+    def __rsub__(self, o):
+        return self._binop("__rsub__", o)
+
+    def __mul__(self, o):
+        return self._binop("__mul__", o)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop("__truediv__", o)
+
+    def __rtruediv__(self, o):
+        return self._binop("__rtruediv__", o)
+
+    def __pow__(self, o):
+        return self._binop("__pow__", o)
+
+    def __rpow__(self, o):
+        return self._binop("__rpow__", o)
+
+    def __mod__(self, o):
+        return self._binop("__mod__", o)
+
+    def __rmod__(self, o):
+        return self._binop("__rmod__", o)
+
+    def __floordiv__(self, o):
+        return self._binop("__floordiv__", o)
+
+    def __rfloordiv__(self, o):
+        return self._binop("__rfloordiv__", o)
+
+    def __divmod__(self, o):
+        return (self.__floordiv__(o), self.__mod__(o))
+
+    def __rdivmod__(self, o):
+        return (self.__rfloordiv__(o), self.__rmod__(o))
+
+    def __neg__(self):
+        return ObservedFloat(
+            -float(self), self._origins,
+            None if self._traced is None else -self._traced)
+
+    def __pos__(self):
+        return self
+
+    def __abs__(self):
+        return ObservedFloat(
+            abs(float(self)), self._origins,
+            None if self._traced is None else jnp.abs(self._traced))
+
+    def __float__(self):
+        # exact float (CPython deprecates returning a strict subclass
+        # from __float__); the taint ends here — documented hole
+        return float.__add__(self, 0.0)
+
+
+def _is_obs_float_kind(kind, value):
+    # only .item() reads: float() results are force-converted to exact
+    # float by CPython, so they cannot carry the observation taint
+    return (kind == "item" and isinstance(value, float)
+            and not isinstance(value, bool))
+
+
 def _concretize(data, kind: str, cast):
     """Single funnel for Tensor scalar conversions (bool/int/float/item)."""
     st = _concretize_state
@@ -269,17 +460,27 @@ def _concretize(data, kind: str, cast):
         if st.cursor >= len(st.log):
             raise _replay_divergence(data, "more concretizations than "
                                            "recorded")
-        rec_kind, rec_val = st.log[st.cursor]
+        entry = st.log[st.cursor]
+        rec_kind, rec_val = entry[0], entry[1]
         st.cursor += 1
         if rec_kind != kind:
             raise _replay_divergence(
                 data, f"expected {rec_kind}, got {kind}")
         if isinstance(data, jax.core.Tracer):
             if not guardable_concretization(kind, rec_val):
+                if _is_obs_float_kind(kind, rec_val):
+                    # observed float read (SOT partial capture): hand the
+                    # user code the recorded value but keep the TRACED
+                    # scalar alongside — observation (logging, return)
+                    # stays compiled; misuse aborts the trace (strict)
+                    return ObservedFloat(rec_val, (_ObsCell(strict=True),),
+                                         traced=data)
                 raise GraphBreak(
                     f"a {kind} concretization cannot be value-guarded "
-                    "(replaying a stale float would silently change "
-                    "numerics); breaking the graph")
+                    "(replaying a stale value would silently change "
+                    "numerics); breaking the graph. Observation-only "
+                    ".item() reads stay compiled — prefer .item() over "
+                    "float() inside compiled functions")
             # guardable scalar: feed the recorded value, emit a guard
             st.guards.append((data, kind, rec_val))
             return rec_val
@@ -290,6 +491,11 @@ def _concretize(data, kind: str, cast):
         return val
     val = cast(data)       # eager (record mode or plain): concrete value
     if st.mode == "record":
+        if _is_obs_float_kind(kind, val) and not \
+                guardable_concretization(kind, val):
+            cell = _ObsCell()
+            st.log.append((kind, val, cell))
+            return ObservedFloat(val, (cell,))
         st.log.append((kind, val))
     return val
 
@@ -604,6 +810,11 @@ def apply(fn: Callable, *tensors, n_outputs: int = 1, name: str = "",
                 tr.record_read(t)
             datas.append(t._data)
         else:
+            if isinstance(t, ObservedFloat):
+                # a float read out of the compiled graph feeding back into
+                # tensor math: the recorded value would go stale — flag
+                # (discovery) or abort the trace (replay)
+                t._misuse("tensor computation")
             datas.append(t)
 
     needs_grad = (

@@ -48,8 +48,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.core import (GraphBreak, Tensor, StateTracking,
-                              guardable_concretization,
+from ..framework.core import (GraphBreak, ObservedFloat, Tensor,
+                              StateTracking, guardable_concretization,
                               record_concretizations, replay_concretizations,
                               track_state)
 
@@ -236,17 +236,25 @@ class StaticFunction:
         log: list = []
         with track_state(tracking), record_concretizations(log):
             outputs = self._call_fn(*args, **kwargs)
-        unguardable = [(k, v) for k, v in log
-                       if not guardable_concretization(k, v)]
+        # 3-tuple log entries are OBSERVED float reads (SOT partial
+        # capture): when only observed (logged/formatted/returned) they
+        # ride the compiled program as extra outputs instead of breaking
+        # the graph; a misused one (branched on / fed back into tensors)
+        # is a genuine break
+        unguardable = [(e[0], e[1]) for e in log
+                       if not guardable_concretization(e[0], e[1])
+                       and not (len(e) == 3 and not e[2].misused)]
         if unguardable:
             kinds = sorted({k for k, _ in unguardable})
             warnings.warn(
                 f"to_static: graph break in "
                 f"{getattr(self._fn, '__name__', '?')}: {kinds} "
-                "concretization(s) pull device values into python "
-                "(unguardable — a replayed stale value would change "
-                "numerics); running eagerly for this signature. Keep "
-                "float()/item() reads outside the compiled function.")
+                "concretization(s) pull device values into python in a "
+                "way that can change the computation (unguardable — a "
+                "replayed stale value would change numerics); running "
+                "eagerly for this signature. Observation-only .item() "
+                "reads (logging, returning) stay compiled; prefer "
+                ".item() over float() inside compiled functions.")
             self._fallback_sigs.add(sig)
             self._graphs.pop(sig, None)
             return outputs
@@ -259,7 +267,11 @@ class StaticFunction:
         entry = self._graphs.get(sig)
         if entry is None:
             entry = self._graphs[sig] = _SigEntry()
-        key = tuple(log)
+        # specialization key = the branch-decision vector. Observed float
+        # VALUES move every step and decide nothing — key them by site
+        # only, or every call would re-specialize
+        key = tuple((e[0], e[1]) if len(e) == 2 else (e[0], "<obs>")
+                    for e in log)
         if key not in entry.by_key:
             pure_fn = self._make_pure_fn(spec, leaves, state, log)
             # guards require the ability to DISCARD a run on mismatch, so
@@ -305,9 +317,23 @@ class StaticFunction:
                     outputs = fn(*built_args, **built_kwargs)
                 out_leaves: list = []
                 out_spec = _tree_flatten(outputs, out_leaves)
-                out_arrays = tuple(
-                    o._data if isinstance(o, Tensor) else o
-                    for o in out_leaves)
+                # observed floats in the return value: emit the TRACED
+                # scalar instead of baking the stale python value, and
+                # remember to convert back to float per call (the "eager
+                # read" of the partial-capture scheme)
+                obs_ret = []
+                out_list = []
+                for i, o in enumerate(out_leaves):
+                    if isinstance(o, Tensor):
+                        out_list.append(o._data)
+                    elif isinstance(o, ObservedFloat) and \
+                            o._traced is not None:
+                        out_list.append(o._traced)
+                        obs_ret.append(i)
+                    else:
+                        out_list.append(o)
+                out_arrays = tuple(out_list)
+                holder["obs_ret"] = obs_ret
                 holder["out_spec"] = out_spec
                 holder["out_is_tensor"] = [isinstance(o, Tensor)
                                            for o in out_leaves]
@@ -329,14 +355,24 @@ class StaticFunction:
                 # (constants were verified equal at trace time). One
                 # stacked int64 vector => ONE host sync per step at check
                 # time, however many guards there are.
+                # the staged dtype must match what the device actually
+                # stores: with x64 disabled jnp silently downcasts int64
+                # to int32, so guard_expect must wrap identically or an
+                # out-of-int32-range guard value would mismatch forever
+                # (permanent eager fallback for the signature)
+                import jax as _jax
+                gdt = jnp.int64 if _jax.config.jax_enable_x64 \
+                    else jnp.int32
                 if guards:
                     guard_vec = jnp.stack(
-                        [jnp.asarray(g, jnp.int64).reshape(())
+                        [jnp.asarray(g).astype(gdt).reshape(())
                          for g, _, _ in guards])
                 else:
                     guard_vec = ()
                 holder["guard_expect"] = np.asarray(
-                    [int(v) for _, _, v in guards], dtype=np.int64)
+                    [int(v) for _, _, v in guards],
+                    dtype=np.int64).astype(np.int64 if gdt == jnp.int64
+                                           else np.int32)
                 return new_state, out_arrays, guard_vec
             finally:
                 for t, d, n, g in originals:
@@ -365,9 +401,11 @@ class StaticFunction:
             graph.state_list[i].set_data(a)
             if not graph.state_list[i]._stop_gradient:
                 graph.state_list[i]._grad_stale = True
-        out_leaves = [Tensor(a) if is_t else a
-                      for a, is_t in zip(out_arrays,
-                                         holder["out_is_tensor"])]
+        obs = set(holder.get("obs_ret", ()))
+        out_leaves = [Tensor(a) if is_t else
+                      (float(a) if i in obs else a)
+                      for i, (a, is_t) in enumerate(
+                          zip(out_arrays, holder["out_is_tensor"]))]
         return _tree_unflatten(holder["out_spec"], out_leaves)
 
 

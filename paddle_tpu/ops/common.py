@@ -4,7 +4,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..framework.core import Tensor, apply, to_jax_dtype
+from ..framework.core import ObservedFloat, Tensor, apply, to_jax_dtype
 
 __all__ = ["Tensor", "apply", "to_jax_dtype", "as_tensor", "unary", "binary"]
 
@@ -12,6 +12,8 @@ __all__ = ["Tensor", "apply", "to_jax_dtype", "as_tensor", "unary", "binary"]
 def as_tensor(x, dtype=None) -> Tensor:
     if isinstance(x, Tensor):
         return x
+    if isinstance(x, ObservedFloat):
+        x._misuse("tensor creation")
     return Tensor(jnp.asarray(x, dtype=to_jax_dtype(dtype)))
 
 

@@ -29,6 +29,9 @@ def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
     if isinstance(data, Tensor):
         t = Tensor(data._data, dtype=dtype, stop_gradient=stop_gradient)
         return t
+    from ..framework.core import ObservedFloat
+    if isinstance(data, ObservedFloat):
+        data._misuse("tensor creation")
     return Tensor(jnp.asarray(data, dtype=to_jax_dtype(dtype)),
                   stop_gradient=stop_gradient)
 

@@ -79,7 +79,7 @@ class TestGuardedSpecialization:
     def test_float_pull_breaks_graph_with_warning(self):
         @paddle.jit.to_static
         def g(x):
-            return x * float(x.sum())   # unguardable
+            return x * float(x.sum())   # fed back into tensors: unguardable
 
         x = _pos()
         with pytest.warns(UserWarning, match="graph break"):
@@ -87,6 +87,75 @@ class TestGuardedSpecialization:
         np.testing.assert_allclose(out.numpy(), [3.0, 6.0])
         np.testing.assert_allclose(g(x).numpy(), [3.0, 6.0])  # eager
         assert len(g._fallback_sigs) == 1
+
+    def test_float_branch_breaks_graph(self):
+        @paddle.jit.to_static
+        def g(x):
+            s = x.sum().item()
+            if s > 0:                   # branching on the read: unguardable
+                return x + 1
+            return x - 1
+
+        with pytest.warns(UserWarning, match="graph break"):
+            out = g(_pos())
+        np.testing.assert_allclose(out.numpy(), [2.0, 3.0])
+        assert len(g._fallback_sigs) == 1
+
+    def test_observed_float_logging_stays_compiled(self):
+        """SOT-style partial capture: loss.item() used only for logging /
+        returning does NOT break the graph — the matmuls stay compiled
+        (python runs only at discovery+trace), and the RETURNED float is
+        fresh every call (emitted as a program output, synced on read)."""
+        host_log = []
+        calls = {"n": 0}
+
+        @paddle.jit.to_static
+        def step(x, w):
+            calls["n"] += 1
+            y = x @ w                     # the compute that must compile
+            loss = (y * y).sum()
+            f = loss.item()               # observation-only read
+            host_log.append(f)            # logged (side effect at trace)
+            return y, f
+
+        rng = np.random.RandomState(0)
+        w = paddle.to_tensor(rng.randn(4, 4).astype("float32"))
+        x1 = paddle.to_tensor(rng.randn(2, 4).astype("float32"))
+        x2 = paddle.to_tensor(rng.randn(2, 4).astype("float32"))
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")   # any graph-break warns -> fail
+            y1, f1 = step(x1, w)             # discovery
+            y1b, f1b = step(x1, w)           # compiled
+            y2, f2 = step(x2, w)             # compiled, same signature
+        assert not step._fallback_sigs       # did NOT fall back to eager
+        (entry,) = step._graphs.values()
+        assert len(entry.by_key) == 1        # one compiled specialization
+        # compiled runs execute no python: discovery + one trace
+        assert calls["n"] == 2
+        # the returned float is FRESH each call, not the baked trace value
+        exp1 = float((np.asarray(x1.numpy()) @ np.asarray(w.numpy()))
+                     .astype(np.float32).__pow__(2).sum())
+        exp2 = float((np.asarray(x2.numpy()) @ np.asarray(w.numpy()))
+                     .astype(np.float32).__pow__(2).sum())
+        np.testing.assert_allclose([f1, f1b, f2], [exp1, exp1, exp2],
+                                   rtol=1e-5)
+
+    def test_observed_float_arithmetic_return_fresh(self):
+        """Derived values (f * scale) returned from the step mirror onto
+        the traced scalar and stay fresh per call."""
+        @paddle.jit.to_static
+        def step(x):
+            return 2.0 * x.sum().item() + 1.0
+
+        a = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+        b = paddle.to_tensor(np.array([5.0, 2.0], "float32"))
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            assert step(a) == 7.0        # discovery
+            assert step(a) == 7.0        # compiled
+            assert step(b) == 15.0       # compiled, fresh value
+        assert not step._fallback_sigs
 
     def test_unstable_branch_gives_up(self):
         @paddle.jit.to_static
