@@ -37,12 +37,18 @@ def init_parallel_env():
     nranks = _env_int("PADDLE_TRAINERS_NUM",
                       _env_int("PADDLE_NNODES", 1))
     rank = _env_int("PADDLE_TRAINER_ID", _env_int("PADDLE_RANK", 0))
-    if coord and nranks > 1 and jax.process_count() == 1:
-        port = os.environ.get("MASTER_PORT", "8476")
-        addr = coord if ":" in coord else f"{coord}:{port}"
-        jax.distributed.initialize(coordinator_address=addr,
-                                   num_processes=nranks,
-                                   process_id=rank)
+    if coord and nranks > 1:
+        # must NOT probe jax.process_count() here: it would initialize
+        # the XLA backend, after which jax.distributed.initialize
+        # refuses to run. Ask the distributed client state directly.
+        from jax._src import distributed as _jdist
+        already = getattr(_jdist.global_state, "client", None) is not None
+        if not already:
+            port = os.environ.get("MASTER_PORT", "8476")
+            addr = coord if ":" in coord else f"{coord}:{port}"
+            jax.distributed.initialize(coordinator_address=addr,
+                                       num_processes=nranks,
+                                       process_id=rank)
     _initialized = True
     return ParallelEnv()
 

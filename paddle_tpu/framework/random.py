@@ -23,11 +23,27 @@ __all__ = ["seed", "Generator", "default_generator", "get_rng_state",
 class Generator:
     def __init__(self, seed_: int = 0, name: str = "default"):
         self.name = name
-        self._state = Tensor(jax.random.PRNGKey(seed_), stop_gradient=True)
-        self._state.persistable = True
-        self._state.name = f"rng_{name}"
+        self._seed = seed_
+        # key creation is LAZY: building a PRNGKey initializes the XLA
+        # backend, and the module-level default generator would otherwise
+        # do that at import time — breaking jax.distributed.initialize
+        # (which must run before any backend init) for every worker that
+        # imports paddle_tpu first
+        self._state_t: Tensor | None = None
+
+    @property
+    def _state(self) -> Tensor:
+        if self._state_t is None:
+            t = Tensor(jax.random.PRNGKey(self._seed), stop_gradient=True)
+            t.persistable = True
+            t.name = f"rng_{self.name}"
+            self._state_t = t
+        return self._state_t
 
     def manual_seed(self, seed_: int) -> "Generator":
+        self._seed = seed_
+        if self._state_t is None:
+            return self    # stays lazy: key built from _seed on first use
         self._state.set_data(jax.random.PRNGKey(seed_))
         return self
 

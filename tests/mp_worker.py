@@ -1,0 +1,72 @@
+"""Worker script for test_multiprocess.py — the SURVEY.md §4(c)
+localhost-simulated multi-host bring-up: each process pins the CPU
+backend, calls ``init_parallel_env`` (→ ``jax.distributed.initialize``
+against the launcher-provided coordinator), then exercises the L8
+control plane end-to-end: host-side object collective, barrier, and a
+coordinated distributed-checkpoint save + reload.
+
+Run via ``python -m paddle_tpu.distributed.launch --nproc_per_node 2
+--master 127.0.0.1:<port> tests/mp_worker.py <tmpdir>`` (the test does
+exactly this).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# the container's sitecustomize pins jax_platforms="axon,cpu" via
+# jax.config, so the env var alone cannot force CPU — re-pin here,
+# before any backend initialization
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    out_dir = sys.argv[1]
+    world = int(os.environ["PADDLE_TRAINERS_NUM"])
+
+    dist.init_parallel_env()
+    assert jax.process_count() == world, (
+        f"jax.distributed bring-up failed: process_count="
+        f"{jax.process_count()} != {world}")
+    rank = dist.get_rank()
+    assert rank == int(os.environ["PADDLE_TRAINER_ID"])
+
+    # host-side object collective through the coordination service
+    objs = []
+    dist.all_gather_object(objs, {"rank": rank, "tag": "x" * (rank + 1)})
+    assert [o["rank"] for o in objs] == list(range(world)), objs
+    assert objs[world - 1]["tag"] == "x" * world
+
+    # barrier: all ranks must pass together
+    dist.barrier()
+
+    # coordinated distributed checkpoint: every rank saves its (replicated)
+    # state, rank 0's metadata wins; then all reload and verify
+    t = paddle.to_tensor(
+        np.arange(8, dtype=np.float32) + 1.0)
+    ckpt = {"w": t}
+    dist.save_state_dict(ckpt, out_dir)
+    dist.barrier()
+    t2 = paddle.to_tensor(np.zeros(8, dtype=np.float32))
+    target = {"w": t2}
+    dist.load_state_dict(target, out_dir)
+    np.testing.assert_allclose(np.asarray(target["w"].numpy()),
+                               np.arange(8, dtype=np.float32) + 1.0)
+    dist.barrier()
+
+    # rank-stamped proof file the test asserts on
+    with open(os.path.join(out_dir, f"ok.{rank}"), "w") as f:
+        f.write(f"MP_WORKER_OK {rank}/{world}\n")
+    print(f"MP_WORKER_OK {rank}/{world}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,64 @@
+"""Multi-process ``jax.distributed`` bring-up (SURVEY.md §4(c): multi-node
+is simulated as multi-process on localhost — the role Gloo plays in the
+reference's no-GPU CI).
+
+The launcher (``paddle_tpu.distributed.launch``) spawns N real worker
+processes; each calls ``init_parallel_env`` → ``jax.distributed
+.initialize`` against the coordinator, then runs a host-side object
+collective, barriers, and a coordinated distributed-checkpoint
+save/reload (see ``mp_worker.py``). This certifies the L8 control plane
+end-to-end instead of by parts."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_REPO, "tests", "mp_worker.py")
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+@pytest.mark.parametrize("nproc", [2])
+def test_launcher_jax_distributed_bringup(tmp_path, nproc):
+    port = _free_port()
+    env = dict(os.environ)
+    env.pop("PADDLE_TRAINER_ID", None)
+    env.pop("PADDLE_TRAINERS_NUM", None)
+    # workers must not inherit this test process's virtual-device flags
+    env["XLA_FLAGS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    log_dir = str(tmp_path / "logs")
+    out_dir = str(tmp_path / "out")
+    os.makedirs(out_dir, exist_ok=True)
+    proc = subprocess.run(
+        [sys.executable, "-m", "paddle_tpu.distributed.launch",
+         "--master", f"127.0.0.1:{port}",
+         "--nproc_per_node", str(nproc),
+         "--log_dir", log_dir,
+         _WORKER, out_dir],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=300)
+    logs = ""
+    if os.path.isdir(log_dir):
+        for fn in sorted(os.listdir(log_dir)):
+            p = os.path.join(log_dir, fn)
+            if os.path.isfile(p):
+                with open(p) as f:
+                    logs += f"--- {fn} ---\n{f.read()}\n"
+    assert proc.returncode == 0, (
+        f"launcher rc={proc.returncode}\nstdout={proc.stdout}\n"
+        f"stderr={proc.stderr}\nworker logs:\n{logs}")
+    for r in range(nproc):
+        ok = os.path.join(out_dir, f"ok.{r}")
+        assert os.path.exists(ok), f"rank {r} never finished:\n{logs}"
+        with open(ok) as f:
+            assert f.read().strip() == f"MP_WORKER_OK {r}/{nproc}"
