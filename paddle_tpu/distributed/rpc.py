@@ -65,6 +65,7 @@ class _State:
         self.server = None
         self.server_thread = None
         self.store = None
+        self.token = None
 
 
 _state = _State()
@@ -81,24 +82,42 @@ def _recv_exact(sock, n):
     return buf
 
 
+def _mac(payload: bytes) -> bytes:
+    import hashlib
+    import hmac as _hmac
+
+    key = (_state.token or "").encode()
+    return _hmac.new(key, payload, hashlib.sha256).digest()
+
+
 def _send_msg(sock, obj):
     payload = pickle.dumps(obj)
-    sock.sendall(_MAGIC + len(payload).to_bytes(8, "big") + payload)
+    sock.sendall(_MAGIC + _mac(payload)
+                 + len(payload).to_bytes(8, "big") + payload)
 
 
 def _recv_msg(sock):
-    head = _recv_exact(sock, len(_MAGIC) + 8)
+    import hmac as _hmac
+
+    head = _recv_exact(sock, len(_MAGIC) + 32 + 8)
     if head[:len(_MAGIC)] != _MAGIC:
         raise ConnectionError("rpc protocol mismatch")
-    n = int.from_bytes(head[len(_MAGIC):], "big")
-    return pickle.loads(_recv_exact(sock, n))
+    mac = head[len(_MAGIC):len(_MAGIC) + 32]
+    n = int.from_bytes(head[len(_MAGIC) + 32:], "big")
+    payload = _recv_exact(sock, n)
+    # authenticate BEFORE deserializing: unpickling attacker bytes is
+    # itself arbitrary code execution, so the HMAC (keyed by the per-job
+    # secret from the rendezvous store) must gate pickle.loads
+    if not _hmac.compare_digest(mac, _mac(payload)):
+        raise PermissionError("rpc: bad or missing auth token")
+    return pickle.loads(payload)
 
 
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         try:
             req = _recv_msg(self.request)
-        except ConnectionError:
+        except (ConnectionError, PermissionError):
             return
         try:
             fn, args, kwargs = req
@@ -137,15 +156,45 @@ def init_rpc(name, rank=None, world_size=None, master_endpoint=None):
         "PADDLE_MASTER", "127.0.0.1:29550")
     host, port_s = master_endpoint.rsplit(":", 1)
 
-    server = _Server(("0.0.0.0", 0), _Handler)
-    my_port = server.server_address[1]
-    t = threading.Thread(target=server.serve_forever, daemon=True)
-    t.start()
+    # bind only the interface peers will actually dial (loopback when the
+    # rendezvous is local) — not 0.0.0.0 — so the pickled-callable
+    # listener does not face every interface. Fall back to the wildcard
+    # only when the resolved hostname is not locally bindable (NAT'd
+    # cloud hosts); the HMAC gate in _recv_msg still authenticates every
+    # request before any unpickling.
+    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else \
+        socket.gethostbyname(socket.gethostname())
 
     store = TCPStore(host, int(port_s), is_master=(rank == 0),
                      world_size=world_size)
-    my_ip = "127.0.0.1" if host in ("127.0.0.1", "localhost") else \
-        socket.gethostbyname(socket.gethostname())
+    # per-job shared secret: rank 0 mints it, everyone reads it from the
+    # store; requests are HMAC'd with it and rejected before unpickling
+    # (see _recv_msg). The listener only starts AFTER the token exists —
+    # no empty-key window.
+    import secrets as _secrets
+    if rank == 0:
+        token = _secrets.token_hex(32)
+        store.set("rpc/token", token.encode())
+    else:
+        token = None
+        deadline0 = time.time() + 60
+        while not token:
+            raw = store.get("rpc/token")
+            if raw:
+                token = raw.decode()
+                break
+            if time.time() > deadline0:
+                raise TimeoutError("rpc rendezvous: auth token missing")
+            time.sleep(0.05)
+    _state.token = token
+
+    try:
+        server = _Server((my_ip, 0), _Handler)
+    except OSError:
+        server = _Server(("0.0.0.0", 0), _Handler)
+    my_port = server.server_address[1]
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
     store.set(f"rpc/{rank}",
               pickle.dumps(WorkerInfo(name, rank, my_ip, my_port)))
     workers = {}
@@ -193,7 +242,8 @@ def rpc_async(to, fn, args=None, kwargs=None, timeout=None) -> _Future:
         try:
             with socket.create_connection((info.ip, info.port),
                                           timeout=timeout) as sock:
-                _send_msg(sock, (fn, tuple(args or ()), dict(kwargs or {})))
+                _send_msg(sock, (fn, tuple(args or ()),
+                                 dict(kwargs or {})))
                 status, value = _recv_msg(sock)
             if status == "ok":
                 fut._set(value=value)

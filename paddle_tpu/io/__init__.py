@@ -461,6 +461,7 @@ class DataLoader:
         self.persistent_workers = persistent_workers
         self._pool: _SpawnPool | None = None
         self._pool_active = False  # persistent pool owned by a live iter
+        self._pool_owner = None    # weakref to the owning iterator
         self._mp_broken = False   # spawn failed once -> stay on threads
         self._epoch = 0
         self._iterable = isinstance(dataset, IterableDataset)
@@ -496,15 +497,29 @@ class DataLoader:
 
     def __iter__(self):
         if self.num_workers <= 0:
-            yield from self._iter_batches()
-            return
+            return self._iter_batches()
         if self._iterable:
-            yield from self._iter_prefetch_single()
-            return
+            return self._iter_prefetch_single()
         if self._mp_broken:
-            yield from self._iter_pool()
-            return
-        yield from self._iter_mp()
+            return self._iter_pool()
+        import weakref
+        if (self._pool is not None and self._pool_active
+                and self._pool_owner is not None
+                and self._pool_owner() is None):
+            # the iterator that CLAIMED the pool is gone but its finally
+            # never reset the flag (e.g. close() raised, or a reference
+            # cycle delayed collection past the flag check) — reclaim the
+            # persistent pool instead of silently demoting every
+            # subsequent epoch to a transient per-epoch spawn pool
+            self._pool_active = False
+        owner_box: list = []
+        g = self._iter_mp(owner_box)
+        # the generator stores this ref as _pool_owner only if/when it
+        # actually claims the persistent pool (inside _iter_mp) — setting
+        # it here for every iterator would let a later never-started
+        # iterator usurp ownership from the live claimant
+        owner_box.append(weakref.ref(g))
+        return g
 
     def __del__(self):
         pool, self._pool = self._pool, None
@@ -540,7 +555,7 @@ class DataLoader:
 
     # ---- subprocess path (map-style, the default) ------------------------
 
-    def _iter_mp(self):
+    def _iter_mp(self, owner_box=None):
         """Map-style path: num_workers subprocesses; jobs are
         (epoch, batch_idx, indices); results reassemble strictly in
         batch-sampler order with a bounded in-flight window."""
@@ -570,6 +585,8 @@ class DataLoader:
         if persist:
             self._pool = pool
             self._pool_active = True
+            if owner_box:
+                self._pool_owner = owner_box[0]
         self._epoch += 1
         epoch = self._epoch
         window = max(self.num_workers * self.prefetch_factor, 1)

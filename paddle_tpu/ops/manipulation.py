@@ -916,10 +916,23 @@ def vander(x, n=None, increasing=False, name=None):
 
 
 def take(x, index, mode="raise", name=None):
-    """Flat-index gather with paddle's mode semantics
-    ('raise' clips like numpy-on-device, 'wrap', 'clip')."""
+    """Flat-index gather with paddle's mode semantics ('raise', 'wrap',
+    'clip'). mode='raise' validates eagerly when the index is concrete;
+    under tracing (where raising is impossible) it clips like
+    numpy-on-device."""
     x = as_tensor(x)
     index = as_tensor(index)
+    if mode == "raise" and not isinstance(index._data, jax.core.Tracer):
+        size = 1
+        for s in x.shape:
+            size *= int(s)
+        idx_np = np.asarray(index._data)
+        if idx_np.size and (int(idx_np.min()) < -size
+                            or int(idx_np.max()) >= size):
+            raise IndexError(
+                f"paddle.take(mode='raise'): index out of range for "
+                f"input with {size} elements "
+                f"(min {int(idx_np.min())}, max {int(idx_np.max())})")
 
     def fn(a, idx):
         flat = a.reshape(-1)

@@ -4,10 +4,12 @@ Role of the reference's flash-attn CUDA integration
 (phi fused attention kernels, UNVERIFIED). Layout: [B, S, H, D] in/out
 (paddle convention); internally blocks over (batch*heads, q_blocks) with an
 online-softmax accumulation loop over kv blocks — the classic TPU flash
-forward. Backward is a blockwise lax.scan recompute using the saved
-log-sum-exp: memory stays O(S·D) (no S×S materialization) while XLA fuses
-the per-block matmuls onto the MXU; a fully hand-scheduled Pallas backward
-is a later optimization (PAPERS.md Liger-style).
+forward. Backward is HAND-WRITTEN Pallas too (``_dkv_kernel`` /
+``_dq_kernel`` below): bf16 operands with fp32 accumulation, recomputing
+per-block logits from the saved log-sum-exp so memory stays O(S·D) (no
+S×S materialization). Block sizes come from
+``FLAGS_flash_attn_block_q/kv`` (512/512 measured best on v5e — see
+BASELINE.md).
 
 GQA/MQA (fewer kv heads than q heads) is handled by repeating kv heads."""
 

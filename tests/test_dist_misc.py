@@ -41,6 +41,7 @@ class TestDistMisc:
         dist.destroy_process_group()
         assert dist.fleet.fleet._hcg is None
 
+    @pytest.mark.slow
     def test_spawn_runs_ranked_processes(self, tmp_path):
         tag = str(tmp_path / "w")
         dist.spawn(_spawn_worker, args=(tag,), nprocs=2)

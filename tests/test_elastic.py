@@ -150,6 +150,7 @@ open(marker + ".done", "w").write("ok")
 """
 
 
+@pytest.mark.slow
 def test_launcher_detects_hung_worker(tmp_path):
     """A worker that stops heartbeating (but does not exit) must be
     killed and relaunched — the watchdog path."""

@@ -411,6 +411,7 @@ class TestFusedLinearCrossEntropy:
                                    np.asarray(g_ref[1]), rtol=1e-4,
                                    atol=1e-6)
 
+    @pytest.mark.slow
     def test_llama_paths_agree(self):
         import paddle_tpu as paddle
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM

@@ -55,6 +55,7 @@ class MLP(nn.Layer):
         return self.fc2(paddle.nn.functional.relu(self.fc1(x)))
 
 
+@pytest.mark.slow
 def test_qat_train_and_convert():
     paddle.seed(0)
     rng = np.random.RandomState(0)
