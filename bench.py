@@ -20,6 +20,28 @@ import sys
 import time
 
 
+def _retry_transient(fn, what, tries=3, wait=20.0):
+    """Retry a timed section on transient runtime errors. The axon tunnel
+    occasionally drops a compile/execute HTTP call (e.g. 'remote_compile:
+    read body: response body closed'); one flake must not erase a whole
+    round's metric (round-2 lost the decode number exactly this way)."""
+    for attempt in range(tries):
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 — transient classification below
+            msg = f"{type(e).__name__}: {e}"
+            transient = any(s in msg for s in (
+                "remote_compile", "response body", "DEADLINE_EXCEEDED",
+                "UNAVAILABLE", "Connection", "connection", "timed out",
+                "Timeout", "INTERNAL", "Socket"))
+            if attempt + 1 >= tries or not transient:
+                raise
+            print(f"# {what}: transient failure (attempt {attempt + 1}/"
+                  f"{tries}): {msg}; retrying in {wait:.0f}s",
+                  file=sys.stderr)
+            time.sleep(wait)
+
+
 def _peak_flops(device) -> float:
     kind = getattr(device, "device_kind", "").lower()
     # order matters: 'v6 lite' (v6e) must match before the generic
@@ -187,18 +209,90 @@ def _decode_bench(on_tpu):
     return tok_per_s
 
 
+def _cb_bench(on_tpu):
+    """Continuous batching over paged KV (the serving-depth metric):
+    mixed-length prompt streams scheduled through fixed decode slots,
+    aggregate generated tokens/s. More streams than slots, so the run
+    exercises drain + re-admit mid-flight."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig.llama_1b()
+        slots, page, chunk = 8, 32, 32
+        max_len, buckets = 384, (64, 128, 256)
+        specs = [(64, 128), (128, 96), (192, 128), (64, 64),
+                 (128, 128), (192, 96), (64, 128), (128, 64),
+                 (96, 128), (160, 96), (64, 96), (128, 128)]
+        reps = 2
+    else:
+        cfg = LlamaConfig.tiny()
+        slots, page, chunk = 2, 8, 4
+        max_len, buckets = 48, (8, 16)
+        specs = [(6, 8), (12, 5), (9, 10), (4, 6)]
+        reps = 1
+    cfg.tensor_parallel = False
+    cfg.scan_layers = False
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+
+    # ONE engine across warmup + timed reps: the compiled prefill-bucket
+    # and decode-chunk programs are cached per engine instance, and a
+    # remote compile through the tunnel costs seconds — rebuilding the
+    # engine inside the timed region would benchmark compilation
+    eng = ContinuousBatchingEngine(model, num_slots=slots, page_size=page,
+                                   max_len=max_len, decode_chunk=chunk,
+                                   prompt_buckets=buckets, greedy=True)
+
+    def run(seed):
+        rng = np.random.RandomState(seed)
+        for plen, n in specs:
+            # distinct prompts per run: the tunnel replay-caches whole
+            # executions keyed on inputs
+            eng.add_request(rng.randint(0, cfg.vocab_size,
+                                        (plen,)).astype(np.int32), n)
+        done = eng.run()
+        return sum(len(r.tokens) for r in done)
+
+    run(100)                       # warmup: compiles prefill buckets+chunk
+    best = 0.0
+    toks = 0
+    for i in range(reps):
+        t0 = time.perf_counter()
+        toks = run(101 + i)
+        dt = time.perf_counter() - t0
+        best = max(best, toks / dt)
+    print(f"# continuous batching: {toks} tokens across "
+          f"{len(specs)} mixed-length streams, {best:.0f} tokens/s",
+          file=sys.stderr)
+    return best
+
+
 def main():
     import jax
 
     dev = jax.devices()[0]
     on_tpu = dev.platform.lower() in ("tpu", "axon")
 
-    n_params, train_tok_s, mfu = _train_bench(on_tpu, dev)
+    n_params, train_tok_s, mfu = _retry_transient(
+        lambda: _train_bench(on_tpu, dev), "train bench")
     try:
-        decode_tok_s = _decode_bench(on_tpu)
+        decode_tok_s = _retry_transient(
+            lambda: _decode_bench(on_tpu), "decode bench")
     except Exception as e:  # decode is secondary: never sink the headline
         print(f"# decode bench failed: {e!r}", file=sys.stderr)
         decode_tok_s = None
+    try:
+        cb_tok_s = _retry_transient(lambda: _cb_bench(on_tpu), "cb bench")
+    except Exception as e:
+        print(f"# continuous-batching bench failed: {e!r}", file=sys.stderr)
+        cb_tok_s = None
 
     suffix = "" if on_tpu else "_cpu_smoke"
     record = {
@@ -212,6 +306,11 @@ def main():
         record["decode_metric"] = "llama_1B_kv_cache_greedy_decode" + suffix
         record["decode_value"] = round(decode_tok_s, 2)
         record["decode_unit"] = "tokens/s/chip"
+    if cb_tok_s is not None:
+        record["cb_metric"] = ("llama_1B_continuous_batching_mixed_lengths"
+                               + suffix)
+        record["cb_value"] = round(cb_tok_s, 2)
+        record["cb_unit"] = "tokens/s/chip"
     print(json.dumps(record))
 
 

@@ -11,6 +11,9 @@ import os
 import sys
 import time
 
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".."))
+
 import numpy as np
 
 import paddle_tpu as paddle
@@ -83,4 +86,43 @@ with paddle.no_grad():
     ref = model(prompt).numpy()
 np.testing.assert_allclose(logits, ref, rtol=2e-2, atol=2e-2)
 print("predictor == live model OK")
+
+# ---- 3. continuous batching: mixed-length streams over paged KV ----------
+print("== continuous batching ==")
+from paddle_tpu.inference import ContinuousBatchingEngine
+
+if ON_TPU:
+    eng_kw = dict(num_slots=4, page_size=16, max_len=prompt_len + 128,
+                  decode_chunk=16, prompt_buckets=(64, 128))
+    req_specs = [(prompt_len, 64), (prompt_len // 2, 48),
+                 (prompt_len // 4, 96), (prompt_len, 32),
+                 (prompt_len // 2, 64), (prompt_len // 4, 80)]
+else:
+    eng_kw = dict(num_slots=2, page_size=8, max_len=48,
+                  decode_chunk=4, prompt_buckets=(8, 16))
+    req_specs = [(6, 8), (12, 5), (9, 10), (4, 6), (14, 7)]
+
+engine = ContinuousBatchingEngine(model, greedy=True, **eng_kw)
+rng = np.random.RandomState(3)
+reqs = []
+for plen, n in req_specs:
+    p = rng.randint(0, cfg.vocab_size, (plen,)).astype(np.int32)
+    reqs.append((p, n, engine.add_request(p, n)))
+t0 = time.time()
+done = engine.run()
+dt = time.time() - t0
+total_toks = sum(len(r.tokens) for r in done)
+print(f"served {len(done)} mixed-length streams "
+      f"({[s for s, _, _ in [(p.size, n, i) for p, n, i in reqs]]}-token "
+      f"prompts) -> {total_toks} tokens in {dt:.2f}s "
+      f"(compile included)")
+# spot-check one stream against the dense-cache generate path
+p0, n0, id0 = reqs[0]
+ref_ids, _ = model.generate(
+    paddle.to_tensor(p0.reshape(1, -1).astype(np.int64)),
+    max_new_tokens=n0, decode_strategy="greedy_search",
+    eos_token_id=None, pad_token_id=0)
+got = next(r for r in done if r.request_id == id0).tokens
+assert got == np.asarray(ref_ids.numpy())[0].tolist(), "CB != generate"
+print("continuous batching == dense generate OK")
 print("ALL OK")

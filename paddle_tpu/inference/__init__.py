@@ -34,8 +34,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .serving import ContinuousBatchingEngine, ServedRequest
+
 __all__ = ["Config", "Predictor", "Tensor", "PrecisionType", "PlaceType",
-           "create_predictor", "get_version"]
+           "create_predictor", "get_version", "ContinuousBatchingEngine",
+           "ServedRequest"]
 
 
 class PrecisionType(enum.Enum):

@@ -1,0 +1,397 @@
+"""Continuous-batching LLM serving engine over paged KV caches.
+
+Reference role: the serving layer PaddleNLP/FastDeploy put on top of
+Paddle Inference (dynamic batching + paged/ragged KV attention for mixed-
+length streams; reference mount empty, no cites — SURVEY.md §2.1
+inference row, PAPERS.md ragged-paged-attention).
+
+TPU-native design — the vLLM recipe restructured for XLA's static-shape
+world:
+
+- The KV cache is a global PAGE POOL per layer ([KVH, num_pages,
+  page_size, D]); each admitted request owns a page list (its block
+  table row). Page 0 is a reserved trash page for drained slots.
+- A fixed number of SLOTS (the decode batch dimension) keeps every
+  compiled shape static. Admission = host-side: allocate pages from the
+  free list, run a compiled PREFILL (dense-cache forward over the
+  bucket-padded prompt, then scatter into the slot's pages), seed the
+  slot's first token.
+- Decoding runs in compiled CHUNKS: ONE program advances ALL active
+  slots ``decode_chunk`` tokens via a ``lax.scan`` (per-slot positions,
+  paged attention reads, trash-page-guarded writes). Chunked continuous
+  batching bounds host↔device round-trips — mandatory through the axon
+  tunnel where per-step dispatch costs 100s of ms.
+- Between chunks the host scheduler drains finished slots (eos or token
+  budget), frees their pages, and admits queued requests into the freed
+  slots — mixed-length streams flow through without ever reshaping the
+  compiled program.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, no_grad
+
+__all__ = ["ContinuousBatchingEngine", "ServedRequest"]
+
+
+@dataclass
+class ServedRequest:
+    request_id: int
+    prompt: np.ndarray                 # [S] int
+    max_new_tokens: int
+    eos_token_id: int | None = None
+    tokens: list = field(default_factory=list)   # generated ids
+    finished: bool = False
+    finish_reason: str | None = None   # "eos" | "length"
+
+
+def _next_bucket(n, buckets):
+    for b in buckets:
+        if n <= b:
+            return b
+    return n        # longer than every bucket: its own (exact) signature
+
+
+class ContinuousBatchingEngine:
+    """Schedules mixed-length generation streams through one compiled
+    decode program. Greedy or temperature sampling.
+
+    model: a ``LlamaForCausalLM``-shaped Layer (``forward(ids, caches=,
+    pos=, tables=)`` + ``init_kv_cache``). num_slots is the decode batch
+    size; total pool memory = num_pages * page_size tokens of KV per
+    layer."""
+
+    def __init__(self, model, num_slots=4, page_size=16, num_pages=None,
+                 max_len=512, decode_chunk=16, prompt_buckets=(32, 64, 128),
+                 eos_token_id=None, greedy=True, temperature=1.0,
+                 seed=0):
+        self.model = model
+        cfg = model.config
+        self.cfg = cfg
+        self.num_slots = int(num_slots)
+        self.page_size = int(page_size)
+        self.max_len = int(max_len)
+        self.pages_per_slot = -(-self.max_len // self.page_size)
+        # +1: page 0 is the reserved trash page
+        self.num_pages = int(num_pages) if num_pages is not None else \
+            self.num_slots * self.pages_per_slot + 1
+        self.decode_chunk = int(decode_chunk)
+        self.prompt_buckets = tuple(sorted(prompt_buckets))
+        self.eos = -1 if eos_token_id is None else int(eos_token_id)
+        self.greedy = bool(greedy)
+        self.temperature = float(temperature)
+
+        dtype = next(iter(model.parameters()))._data.dtype
+        kvh, d = cfg.num_key_value_heads, cfg.head_dim
+        # per layer: (key_pages, value_pages) — flat list like dense caches
+        self.pools = []
+        for _ in range(cfg.num_hidden_layers):
+            for _kv in range(2):
+                self.pools.append(Tensor(jnp.zeros(
+                    (kvh, self.num_pages, self.page_size, d), dtype)))
+
+        self._free_pages = deque(range(1, self.num_pages))
+        # host-side slot state
+        B, MP = self.num_slots, self.pages_per_slot
+        self.tables = np.zeros((B, MP), np.int32)
+        self.ctx = np.zeros((B,), np.int32)
+        self.active = np.zeros((B,), bool)
+        self.last_tok = np.zeros((B,), np.int32)
+        self.limits = np.zeros((B,), np.int32)    # ctx budget per slot
+        self.slot_eos = np.full((B,), -1, np.int32)  # per-request eos
+        self.slot_req: list[ServedRequest | None] = [None] * B
+        self.slot_pages: list[list] = [[] for _ in range(B)]
+
+        self.queue: deque[ServedRequest] = deque()
+        self.completed: list[ServedRequest] = []
+        self._next_id = 0
+        self._key = jax.random.PRNGKey(seed)
+        self._prefill_fns = {}
+        self._chunk_fn = None
+
+    # ---- public API ------------------------------------------------------
+
+    def add_request(self, prompt_ids, max_new_tokens,
+                    eos_token_id=None) -> int:
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.size + int(max_new_tokens) > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_new_tokens "
+                f"({max_new_tokens}) exceeds engine max_len {self.max_len}")
+        # reject what the pool can NEVER satisfy — otherwise run() would
+        # spin forever waiting for pages that cannot exist
+        worst = max(self._bucket_for(prompt.size),
+                    prompt.size + int(max_new_tokens))
+        if -(-worst // self.page_size) > self.num_pages - 1:
+            raise ValueError(
+                f"request needs {-(-worst // self.page_size)} pages but "
+                f"the pool only has {self.num_pages - 1} allocatable")
+        req = ServedRequest(self._next_id, prompt, int(max_new_tokens),
+                            eos_token_id if eos_token_id is not None
+                            else (self.eos if self.eos >= 0 else None))
+        self._next_id += 1
+        self.queue.append(req)
+        return req.request_id
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or bool(self.active.any())
+
+    def step(self):
+        """Admit what fits, decode one chunk, drain finished slots.
+        Returns the requests completed by this step."""
+        self._admit()
+        if self.active.any():
+            self._decode_chunk()
+        return self._drain()
+
+    def run(self):
+        """Drive until every queued request completes; returns them in
+        completion order."""
+        done = []
+        while self.has_work():
+            n_before = len(done)
+            done.extend(self.step())
+            if (len(done) == n_before and not self.active.any()
+                    and self.queue
+                    and all(r is None for r in self.slot_req)):
+                # nothing running, nothing finished, head request still
+                # unadmittable — spinning would never terminate
+                raise RuntimeError(
+                    "serving engine stalled: queued request cannot be "
+                    "admitted (page pool exhausted?)")
+        return done
+
+    # ---- admission / prefill --------------------------------------------
+
+    def _bucket_for(self, prompt_len):
+        """Padded prefill length: the smallest bucket covering the prompt,
+        clamped to max_len, never below the prompt itself."""
+        return min(max(_next_bucket(prompt_len, self.prompt_buckets),
+                       prompt_len), self.max_len)
+
+    def _alloc_pages(self, n):
+        if len(self._free_pages) < n:
+            return None
+        return [self._free_pages.popleft() for _ in range(n)]
+
+    def _admit(self):
+        for slot in range(self.num_slots):
+            if not self.queue:
+                return
+            if self.active[slot] or self.slot_req[slot] is not None:
+                continue
+            req = self.queue[0]
+            bucket = self._bucket_for(len(req.prompt))
+            need_tokens = max(bucket, len(req.prompt) + req.max_new_tokens)
+            need = -(-need_tokens // self.page_size)
+            pages = self._alloc_pages(need)
+            if pages is None:
+                return        # pool exhausted; retry after a drain
+            self.queue.popleft()
+            self.slot_pages[slot] = pages
+            row = np.zeros((self.pages_per_slot,), np.int32)
+            row[:len(pages)] = pages
+            self.tables[slot] = row
+            self._prefill(slot, req, bucket)
+
+    def _prefill_fn(self, bucket):
+        fn = self._prefill_fns.get(bucket)
+        if fn is not None:
+            return fn
+        from ..jit import to_static
+        model = self.model
+
+        def prefill(ids, true_len_t, slot_tables, temperature, greedy,
+                    key_t, *pools):
+            """ids: [1, bucket]; returns (first_tok[1], new_pools...)."""
+            with no_grad():
+                dense = model.init_kv_cache(1, ids.shape[1])
+                logits, dense = model(ids, caches=dense,
+                                      pos=Tensor(jnp.zeros((), jnp.int32)))
+
+            def fn(lg, tl, tbl, key, *leaves):
+                from ..ops.paged_attention import pack_prompt_into_pages
+                last = jax.lax.dynamic_index_in_dim(
+                    lg[0], tl - 1, 0, False)          # [V]
+                lgf = last.astype(jnp.float32)
+                if greedy:
+                    tok = jnp.argmax(lgf).astype(jnp.int32)
+                else:
+                    key, sub = jax.random.split(key)
+                    tok = jax.random.categorical(
+                        sub, lgf / temperature).astype(jnp.int32)
+                n = len(leaves) // 2
+                pool_l, dense_l = leaves[:n], leaves[n:]
+                out = []
+                for i in range(0, n, 2):   # pairs: (k pages, v pages)
+                    kp, vp = pack_prompt_into_pages(
+                        pool_l[i], pool_l[i + 1],
+                        dense_l[i], dense_l[i + 1], tbl)
+                    out.extend((kp, vp))
+                return (tok.reshape(1), key) + tuple(out)
+
+            res = _apply_multi(fn, [logits, true_len_t, slot_tables, key_t]
+                               + list(pools) + list(dense),
+                               n_out=2 + len(pools))
+            return res
+
+        fn = to_static(prefill)
+        self._prefill_fns[bucket] = fn
+        return fn
+
+    def _prefill(self, slot, req, bucket):
+        ids = np.zeros((1, bucket), np.int32)
+        ids[0, :len(req.prompt)] = req.prompt
+        tl = len(req.prompt)
+        fn = self._prefill_fn(bucket)
+        res = fn(Tensor(jnp.asarray(ids)),
+                 Tensor(jnp.asarray(tl, jnp.int32)),
+                 Tensor(jnp.asarray(self.tables[slot])),
+                 self.temperature, self.greedy, Tensor(self._key),
+                 *self.pools)
+        tok, key = res[0], res[1]
+        self.pools = list(res[2:])
+        self._key = key._data if isinstance(key, Tensor) else key
+        first = int(np.asarray(tok._data)[0])
+        req.tokens.append(first)
+        self.slot_req[slot] = req
+        self.last_tok[slot] = first
+        self.ctx[slot] = tl
+        self.slot_eos[slot] = -1 if req.eos_token_id is None \
+            else int(req.eos_token_id)
+        # ctx counts CACHE entries; one generated token is always pending
+        # outside the cache, so the n-th token lands when ctx hits
+        # tl + n - 1 (not tl + n)
+        self.limits[slot] = tl + req.max_new_tokens - 1
+        eos = req.eos_token_id
+        if (eos is not None and first == eos) or req.max_new_tokens <= 1:
+            # one-token request or instant eos: slot never becomes active
+            self.active[slot] = False
+            req.finished = True
+            req.finish_reason = "eos" if (eos is not None and first == eos) \
+                else "length"
+        else:
+            self.active[slot] = True
+
+    # ---- chunked decode --------------------------------------------------
+
+    def _chunk_static(self):
+        if self._chunk_fn is not None:
+            return self._chunk_fn
+        from ..jit import to_static
+        model = self.model
+        greedy = self.greedy
+        temperature = self.temperature
+        n_steps = self.decode_chunk
+
+        def chunk(tok_t, ctx_t, act_t, lim_t, eos_t, tables_t, key_t,
+                  *pools):
+            fwd = model.forward
+
+            def fn(tok, ctx, act, lim, eos_arr, tbl, key, *pool_leaves):
+                b = tok.shape[0]
+
+                def body(carry, _):
+                    tok_c, ctx_c, act_c, key_c, leaves = carry
+                    with no_grad():
+                        logits, ncaches = fwd(
+                            Tensor(tok_c.reshape(b, 1)),
+                            caches=[Tensor(a) for a in leaves],
+                            pos=Tensor(ctx_c[:, None]),
+                            tables=(Tensor(tbl), Tensor(act_c)))
+                    lg = logits[:, -1]._data.astype(jnp.float32)
+                    if greedy:
+                        nxt = jnp.argmax(lg, -1).astype(jnp.int32)
+                    else:
+                        key_c, sub = jax.random.split(key_c)
+                        nxt = jax.random.categorical(
+                            sub, lg / temperature).astype(jnp.int32)
+                    ctx_n = ctx_c + act_c.astype(jnp.int32)
+                    nxt = jnp.where(act_c, nxt, tok_c)
+                    # per-slot eos (a traced [B] array, -1 = none): each
+                    # request may carry its own stop token
+                    still = act_c & (ctx_n < lim) & \
+                        ((eos_arr < 0) | (nxt != eos_arr))
+                    new_leaves = tuple(t._data for t in ncaches)
+                    out_tok = jnp.where(act_c, nxt, -1)
+                    return (nxt, ctx_n, still, key_c, new_leaves), \
+                        (out_tok, act_c)
+
+                carry0 = (tok, ctx, act, key, tuple(pool_leaves))
+                carry, (toks, emitted) = jax.lax.scan(
+                    body, carry0, jnp.arange(n_steps))
+                tok_f, ctx_f, act_f, key_f, leaves_f = carry
+                return (toks.T, emitted.T, tok_f, ctx_f, act_f, key_f) \
+                    + tuple(leaves_f)
+
+            return _apply_multi(
+                fn, [tok_t, ctx_t, act_t, lim_t, eos_t, tables_t, key_t]
+                + list(pools), n_out=6 + len(pools))
+
+        self._chunk_fn = to_static(chunk)
+        return self._chunk_fn
+
+    def _decode_chunk(self):
+        fn = self._chunk_static()
+        res = fn(Tensor(jnp.asarray(self.last_tok)),
+                 Tensor(jnp.asarray(self.ctx)),
+                 Tensor(jnp.asarray(self.active)),
+                 Tensor(jnp.asarray(self.limits)),
+                 Tensor(jnp.asarray(self.slot_eos)),
+                 Tensor(jnp.asarray(self.tables)),
+                 Tensor(self._key), *self.pools)
+        toks, emitted, tok_f, ctx_f, act_f, key_f = res[:6]
+        self.pools = list(res[6:])
+        toks_np = np.asarray(toks._data)          # [B, n_steps]
+        emitted_np = np.asarray(emitted._data)    # [B, n_steps] bool
+        self.last_tok = np.asarray(tok_f._data).copy()
+        self.ctx = np.asarray(ctx_f._data).copy()
+        self.active = np.asarray(act_f._data).copy()
+        self._key = key_f._data
+        for slot in range(self.num_slots):
+            req = self.slot_req[slot]
+            if req is None or req.finished:
+                continue
+            for j in range(toks_np.shape[1]):
+                if emitted_np[slot, j]:
+                    req.tokens.append(int(toks_np[slot, j]))
+
+    # ---- completion ------------------------------------------------------
+
+    def _drain(self):
+        done = []
+        for slot in range(self.num_slots):
+            req = self.slot_req[slot]
+            if req is None:
+                continue
+            if not self.active[slot]:
+                if not req.finished:
+                    req.finished = True
+                    eos = req.eos_token_id
+                    req.finish_reason = "eos" if (
+                        eos is not None and req.tokens
+                        and req.tokens[-1] == eos) else "length"
+                self._free_pages.extend(self.slot_pages[slot])
+                self.slot_pages[slot] = []
+                self.slot_req[slot] = None
+                self.tables[slot] = 0
+                self.ctx[slot] = 0
+                self.limits[slot] = 0
+                self.slot_eos[slot] = -1
+                self.completed.append(req)
+                done.append(req)
+        return done
+
+
+def _apply_multi(fn, tensors, n_out):
+    """apply() with a tuple return of n_out arrays."""
+    from ..framework.core import apply
+    return apply(fn, *tensors, n_outputs=n_out, differentiable=False,
+                 name="serving_engine")

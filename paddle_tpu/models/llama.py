@@ -154,13 +154,38 @@ class LlamaAttention(nn.Layer):
         self.o_proj = _lin(cfg, self.num_heads * self.head_dim,
                            cfg.hidden_size, column=False)
 
-    def forward(self, x, sin_cos=None, cache=None, pos=None):
+    def forward(self, x, sin_cos=None, cache=None, pos=None, tables=None):
         b, s, _ = x.shape
         q = M.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
         k = M.reshape(self.k_proj(x),
                       [b, s, self.num_kv_heads, self.head_dim])
         v = M.reshape(self.v_proj(x),
                       [b, s, self.num_kv_heads, self.head_dim])
+        if cache is not None and tables is not None:
+            # continuous-batching decode step over the PAGED pool:
+            # per-slot positions (mixed-length streams), trash-page
+            # routing for drained slots (serving engine path)
+            tbl, active = tables
+            q = rope_with_offset(q, pos, self.cfg.max_position_embeddings,
+                                 self.cfg.rope_theta)
+            k = rope_with_offset(k, pos, self.cfg.max_position_embeddings,
+                                 self.cfg.rope_theta)
+
+            def fn(qa, ka, va, kpa, vpa, tba, acta, cta):
+                from ..ops import paged_attention as PA
+                ct = cta[:, 0]
+                kpa, vpa = PA.paged_decode_write(kpa, vpa, ka, va, tba,
+                                                 ct, acta)
+                out = PA.paged_attention(qa[:, 0], kpa, vpa, tba, ct + 1)
+                return out[:, None], kpa, vpa
+
+            ctx_out, kp2, vp2 = apply(
+                fn, q, k, v, cache[0], cache[1], tbl, active, pos,
+                n_outputs=3, name="paged_decode_attention",
+                differentiable=False)
+            ctx_out = M.reshape(ctx_out,
+                                [b, s, self.num_heads * self.head_dim])
+            return self.o_proj(ctx_out), (kp2, vp2)
         if cache is not None:
             q = rope_with_offset(q, pos, self.cfg.max_position_embeddings,
                                  self.cfg.rope_theta)
@@ -222,10 +247,11 @@ class LlamaDecoderLayer(nn.Layer):
         from ..distributed.fleet.utils import ScatterOp
         return ScatterOp(t, axis=1)
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, tables=None):
         if cache is not None:
             attn, new_cache = self.self_attn(self.input_layernorm(x),
-                                             cache=cache, pos=pos)
+                                             cache=cache, pos=pos,
+                                             tables=tables)
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, new_cache
@@ -251,13 +277,14 @@ class LlamaModel(nn.Layer):
                                     for _ in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids, caches=None, pos=None):
+    def forward(self, input_ids, caches=None, pos=None, tables=None):
         x = self.embed_tokens(input_ids)
         if caches is not None:
             new_caches = []
             for i, layer in enumerate(self.layers):
                 x, (kc, vc) = layer(x, cache=(caches[2 * i],
-                                              caches[2 * i + 1]), pos=pos)
+                                              caches[2 * i + 1]), pos=pos,
+                                    tables=tables)
                 new_caches.extend((kc, vc))
             return self.norm(x), new_caches
         from ..nn.scan import scan_layers, can_scan
@@ -296,9 +323,11 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
             dtype = next(iter(self.parameters())).dtype
         return _alloc_kv_caches(self.config, batch_size, max_length, dtype)
 
-    def forward(self, input_ids, labels=None, caches=None, pos=None):
+    def forward(self, input_ids, labels=None, caches=None, pos=None,
+                tables=None):
         if caches is not None:
-            hidden, caches = self.llama(input_ids, caches=caches, pos=pos)
+            hidden, caches = self.llama(input_ids, caches=caches, pos=pos,
+                                        tables=tables)
         else:
             hidden = self.llama(input_ids)
         if labels is not None and caches is None and \

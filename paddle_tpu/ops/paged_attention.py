@@ -30,7 +30,8 @@ import math
 import jax
 import jax.numpy as jnp
 
-__all__ = ["paged_attention", "paged_attention_reference"]
+__all__ = ["paged_attention", "paged_attention_reference",
+           "paged_decode_write", "pack_prompt_into_pages"]
 
 _NEG_INF = -1e30
 
@@ -91,3 +92,38 @@ def paged_attention(q, key_pages, value_pages, block_tables, context_lens,
                 f"path", RuntimeWarning)
     return paged_attention_reference(q, key_pages, value_pages,
                                      block_tables, context_lens, scale)
+
+
+def paged_decode_write(kp, vp, k, v, block_tables, ctx, active=None):
+    """Write one decode step's k/v into the page pools.
+
+    k, v: [B, 1, KVH, D] (the step's projections, already rotated).
+    ctx: [B] int32 — current cache length per slot; the new token lands at
+    position ctx. Inactive slots (``active`` False) write to page 0 — the
+    engine reserves it as a trash page so a freed/reassigned real page is
+    never clobbered by a drained slot."""
+    page = kp.shape[2]
+    pid = jnp.take_along_axis(block_tables,
+                              (ctx // page)[:, None], axis=1)[:, 0]
+    if active is not None:
+        pid = jnp.where(active, pid, 0)
+    off = ctx % page
+    kp = kp.at[:, pid, off, :].set(jnp.swapaxes(k[:, 0], 0, 1))
+    vp = vp.at[:, pid, off, :].set(jnp.swapaxes(v[:, 0], 0, 1))
+    return kp, vp
+
+
+def pack_prompt_into_pages(kp, vp, k_dense, v_dense, slot_tables):
+    """Scatter a prefilled dense cache into the slot's pages.
+
+    k_dense, v_dense: [1, S, KVH, D] (positions 0..S-1 of one sequence);
+    slot_tables: [pages_per_slot] int32 — must cover ceil(S/page) pages.
+    Positions beyond the true prompt length may hold pad garbage; the
+    per-slot context length masks them at attention time."""
+    s = k_dense.shape[1]
+    page = kp.shape[2]
+    pid = jnp.take(slot_tables, jnp.arange(s) // page)
+    off = jnp.arange(s) % page
+    kp = kp.at[:, pid, off, :].set(jnp.swapaxes(k_dense[0], 0, 1))
+    vp = vp.at[:, pid, off, :].set(jnp.swapaxes(v_dense[0], 0, 1))
+    return kp, vp

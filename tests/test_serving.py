@@ -1,0 +1,116 @@
+"""Continuous-batching serving engine (SURVEY.md §2.1 inference row):
+mixed-length streams through paged KV caches, one compiled decode chunk
+for all slots. Oracle: per-stream greedy parity with ``model.generate``
+(dense-cache fused decode) on the same prompts."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.inference import ContinuousBatchingEngine
+
+
+def _model():
+    cfg = LlamaConfig.tiny()
+    cfg.tensor_parallel = False
+    cfg.scan_layers = False
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return m, cfg
+
+
+def _ref_greedy(model, prompt, n_new):
+    ids = paddle.to_tensor(prompt.reshape(1, -1).astype(np.int64))
+    out, _ = model.generate(ids, max_new_tokens=n_new,
+                            decode_strategy="greedy_search",
+                            eos_token_id=None, pad_token_id=0)
+    return np.asarray(out.numpy())[0].tolist()
+
+
+def test_paged_pool_matches_dense_generate():
+    """Single stream sanity: paged prefill + chunked paged decode must
+    reproduce the dense-cache greedy tokens exactly."""
+    model, cfg = _model()
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, cfg.vocab_size, (11,)).astype(np.int32)
+    n_new = 9
+    ref = _ref_greedy(model, prompt, n_new)
+
+    eng = ContinuousBatchingEngine(model, num_slots=2, page_size=8,
+                                   max_len=64, decode_chunk=4,
+                                   prompt_buckets=(16,), greedy=True)
+    eng.add_request(prompt, n_new)
+    done = eng.run()
+    assert len(done) == 1
+    assert done[0].tokens == ref, (done[0].tokens, ref)
+    assert done[0].finish_reason == "length"
+
+
+@pytest.mark.slow
+def test_mixed_length_streams_more_requests_than_slots():
+    """The continuous part: 5 mixed-length requests through 2 slots —
+    slots drain and re-admit mid-flight; every stream must match its
+    single-stream greedy reference, and page accounting must balance."""
+    model, cfg = _model()
+    rng = np.random.RandomState(1)
+    specs = [(5, 7), (13, 4), (9, 11), (21, 6), (3, 8)]  # (prompt, new)
+    prompts = [rng.randint(0, cfg.vocab_size, (p,)).astype(np.int32)
+               for p, _ in specs]
+    refs = [_ref_greedy(model, pr, n) for pr, (_, n) in zip(prompts, specs)]
+
+    eng = ContinuousBatchingEngine(model, num_slots=2, page_size=8,
+                                   max_len=64, decode_chunk=4,
+                                   prompt_buckets=(8, 16, 32), greedy=True)
+    ids = [eng.add_request(pr, n) for pr, (_, n) in zip(prompts, specs)]
+    free_before = len(eng._free_pages)
+    done = eng.run()
+    assert sorted(r.request_id for r in done) == sorted(ids)
+    by_id = {r.request_id: r for r in done}
+    for rid, ref in zip(ids, refs):
+        assert by_id[rid].tokens == ref, (rid, by_id[rid].tokens, ref)
+    # every page returned to the pool
+    assert len(eng._free_pages) == free_before
+    assert not eng.active.any()
+
+
+def test_eos_stops_stream_early():
+    model, cfg = _model()
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+    ref = _ref_greedy(model, prompt, 12)
+    eos = ref[3]     # force an early stop at the 4th generated token
+    # engine-level eos unset: the PER-REQUEST eos alone must stop decode
+    eng = ContinuousBatchingEngine(model, num_slots=1, page_size=8,
+                                   max_len=64, decode_chunk=4,
+                                   prompt_buckets=(8,), greedy=True)
+    eng.add_request(prompt, 12, eos_token_id=eos)
+    (req,) = eng.run()
+    assert req.finish_reason == "eos"
+    assert req.tokens == ref[:4], (req.tokens, ref)
+
+
+def test_oversized_prompt_uses_exact_bucket():
+    """A prompt longer than every configured bucket must still serve
+    (its own exact-length prefill signature), not crash at admission."""
+    model, cfg = _model()
+    rng = np.random.RandomState(4)
+    prompt = rng.randint(0, cfg.vocab_size, (20,)).astype(np.int32)
+    ref = _ref_greedy(model, prompt, 5)
+    eng = ContinuousBatchingEngine(model, num_slots=1, page_size=8,
+                                   max_len=64, decode_chunk=4,
+                                   prompt_buckets=(8, 16), greedy=True)
+    eng.add_request(prompt, 5)
+    (req,) = eng.run()
+    assert req.tokens == ref, (req.tokens, ref)
+
+
+def test_impossible_request_rejected():
+    import pytest as _pytest
+    model, cfg = _model()
+    eng = ContinuousBatchingEngine(model, num_slots=1, page_size=8,
+                                   num_pages=3, max_len=64,
+                                   prompt_buckets=(8,), greedy=True)
+    with _pytest.raises(ValueError, match="pages"):
+        eng.add_request(np.zeros((20,), np.int32), 10)
