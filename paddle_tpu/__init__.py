@@ -48,6 +48,8 @@ from .framework.device import (CPUPlace, TPUPlace, CUDAPlace, XPUPlace,
                                is_compiled_with_tpu)
 from .framework.flags import get_flags, set_flags
 from .framework.io import save, load
+from .framework.default_dtype import (get_default_dtype, set_default_dtype,
+                                      set_printoptions)
 
 from .ops import *  # noqa: F401,F403  (creation/math/manip/linalg/... ops)
 from .ops import creation as _creation

@@ -4,3 +4,76 @@ from .grad_scaler import GradScaler, AmpScaler
 
 __all__ = ["auto_cast", "amp_guard", "GradScaler", "AmpScaler", "decorate",
            "is_auto_cast_enabled", "white_list", "black_list"]
+
+
+def is_float16_supported(device=None):
+    """fp16 compute support. TPU MXU natively computes bf16; fp16 works
+    via XLA conversion, so the API answers True on accelerator backends."""
+    import jax
+    return jax.devices()[0].platform != "cpu"
+
+
+def is_bfloat16_supported(device=None):
+    return True   # bf16 is the native TPU compute dtype
+
+
+class debugging:
+    """paddle.amp.debugging namespace: numerics checking maps to jax's
+    debug_nans/debug_infs flags (TensorChecker role)."""
+
+    @staticmethod
+    def enable_operator_stats_collection():
+        from ..utils import monitor
+        monitor.enable_op_stats()
+
+    @staticmethod
+    def disable_operator_stats_collection():
+        from ..utils import monitor
+        monitor.disable_op_stats()
+
+    @staticmethod
+    def collect_operator_stats():
+        """Context manager: count ops by (name, dtype) within the block
+        and print the summary on exit (amp.debugging parity)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            from ..utils import monitor
+            monitor.enable_op_stats()
+            try:
+                yield
+            finally:
+                monitor.disable_op_stats()
+                summary = monitor.op_stats_summary()
+                print("operator stats:")
+                for k, v in summary.items():
+                    print(f"  {k}: {v}")
+        return ctx()
+
+    @staticmethod
+    def check_numerics(tensor, op_type="", var_name="",
+                       debug_mode=None):
+        import jax.numpy as jnp
+        import numpy as np
+        from ..framework.core import Tensor
+        a = tensor._data if isinstance(tensor, Tensor) else tensor
+        bad = int(jnp.sum(~jnp.isfinite(a.astype(jnp.float32))))
+        if bad:
+            raise RuntimeError(
+                f"check_numerics: {bad} non-finite element(s) in "
+                f"{op_type or 'tensor'} {var_name}")
+        return tensor
+
+    @staticmethod
+    def enable_check_nan_inf():
+        import jax
+        jax.config.update("jax_debug_nans", True)
+
+    @staticmethod
+    def disable_check_nan_inf():
+        import jax
+        jax.config.update("jax_debug_nans", False)
+
+
+__all__ += ["is_float16_supported", "is_bfloat16_supported", "debugging"]

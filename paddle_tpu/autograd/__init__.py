@@ -13,7 +13,18 @@ from ..framework.core import (Tensor, apply, backward as _backward_impl,
 
 __all__ = ["backward", "grad", "no_grad", "enable_grad", "is_grad_enabled",
            "set_grad_enabled", "PyLayer", "PyLayerContext", "hessian",
-           "jacobian"]
+           "jacobian", "saved_tensors_hooks", "jvp", "vjp"]
+
+
+def jvp(func, xs, v=None):
+    """Forward-mode JVP (delegates to the jax-native incubate impl)."""
+    from ..incubate.autograd import jvp as _jvp
+    return _jvp(func, xs, v)
+
+
+def vjp(func, xs, v=None):
+    from ..incubate.autograd import vjp as _vjp
+    return _vjp(func, xs, v)
 
 
 def backward(tensors, grad_tensors=None, retain_graph=False):
@@ -88,15 +99,45 @@ def _all_leaves(outputs):
     return leaves
 
 
+#: active (pack, unpack) hook pair installed by saved_tensors_hooks
+_saved_hooks: list = []
+
+
+class saved_tensors_hooks:
+    """``paddle.autograd.saved_tensors_hooks(pack, unpack)`` — intercept
+    tensors saved for backward (e.g. offload/compress activations).
+    Applies to ``PyLayerContext.save_for_backward`` within the context:
+    pack runs at save time, unpack at read time."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        _saved_hooks.append((self.pack_hook, self.unpack_hook))
+        return self
+
+    def __exit__(self, *exc):
+        _saved_hooks.pop()
+
+
 class PyLayerContext:
     def __init__(self):
         self._saved = ()
+        self._unpack = None
         self.__dict__["_attrs"] = {}
 
     def save_for_backward(self, *tensors):
-        self._saved = tensors
+        if _saved_hooks:
+            pack, unpack = _saved_hooks[-1]
+            self._saved = tuple(pack(t) for t in tensors)
+            self._unpack = unpack
+        else:
+            self._saved = tensors
 
     def saved_tensor(self):
+        if self._unpack is not None:
+            return tuple(self._unpack(t) for t in self._saved)
         return self._saved
 
 

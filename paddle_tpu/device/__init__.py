@@ -119,3 +119,95 @@ class cuda:
 
         def synchronize(self):
             synchronize()
+
+
+# ---- top-level Stream/Event/stream APIs (paddle.device parity) ------------
+# XLA owns scheduling on TPU (SURVEY.md §2.1 new-executor row): a Stream is
+# a compatibility handle; ordering is what the runtime already guarantees.
+
+class Stream:
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize(self.device)
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        ev = event or Event()
+        ev.record(self)
+        return ev
+
+    def query(self):
+        return True
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self.device = device
+
+    def record(self, stream=None):
+        pass
+
+    def query(self):
+        return True
+
+    def synchronize(self):
+        synchronize(self.device)
+
+
+_current_stream = Stream()
+
+
+def current_stream(device=None):
+    return _current_stream
+
+
+def set_stream(stream):
+    global _current_stream
+    prev, _current_stream = _current_stream, stream
+    return prev
+
+
+class stream_guard:
+    def __init__(self, stream):
+        self._stream = stream
+
+    def __enter__(self):
+        self._prev = set_stream(self._stream)
+        return self._stream
+
+    def __exit__(self, *exc):
+        set_stream(self._prev)
+
+
+def is_compiled_with_rocm():
+    return False
+
+
+def get_available_custom_device():
+    return [f"{d.platform}:{d.id}" for d in jax.devices()
+            if d.platform not in ("cpu", "gpu", "tpu")]
+
+
+class xpu:
+    """paddle.device.xpu namespace shim (no XPU on this backend)."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+    @staticmethod
+    def synchronize(device=None):
+        return synchronize(device)
+
+
+__all__ += ["Stream", "Event", "current_stream", "set_stream",
+            "stream_guard", "is_compiled_with_rocm",
+            "get_available_custom_device", "xpu"]

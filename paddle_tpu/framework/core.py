@@ -225,6 +225,10 @@ class _ConcretizeState(threading.local):
 
 _concretize_state = _ConcretizeState()
 
+#: set by utils.monitor.enable_op_stats(): called as hook(name, dtype)
+#: from apply() — amp.debugging operator-stats collection
+_op_stat_hook = None
+
 
 @contextlib.contextmanager
 def record_concretizations(log: list):
@@ -802,6 +806,10 @@ def apply(fn: Callable, *tensors, n_outputs: int = 1, name: str = "",
     ``fn`` must be a pure jax function. Tensor-valued kwargs are not allowed;
     pass tensors positionally.
     """
+    if _op_stat_hook is not None:
+        _op_stat_hook(name, str(getattr(
+            next((t._data for t in tensors if isinstance(t, Tensor)),
+                 None), "dtype", "-")))
     tr = _track_state.current
     datas = []
     for t in tensors:

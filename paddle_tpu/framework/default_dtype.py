@@ -14,6 +14,27 @@ def set_default_dtype(d) -> None:
     _default_dtype = to_jax_dtype(d)
 
 
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """paddle.set_printoptions — tensor repr formatting. Tensor __repr__
+    renders through numpy, so this delegates to np.set_printoptions
+    (sci_mode maps to numpy's ``suppress`` inverse)."""
+    import numpy as np
+
+    kw = {}
+    if precision is not None:
+        kw["precision"] = int(precision)
+    if threshold is not None:
+        kw["threshold"] = int(threshold)
+    if edgeitems is not None:
+        kw["edgeitems"] = int(edgeitems)
+    if linewidth is not None:
+        kw["linewidth"] = int(linewidth)
+    if sci_mode is not None:
+        kw["suppress"] = not bool(sci_mode)
+    np.set_printoptions(**kw)
+
+
 def get_default_dtype():
     return _default_dtype
 

@@ -110,3 +110,117 @@ class LRScheduler(Callback):
             s = self._sched()
             if s:
                 s.step()
+
+
+class VisualDL(Callback):
+    """Scalar logging callback (hapi VisualDL parity). The visualdl
+    package is not available on this backend; scalars are written through
+    ``paddle_tpu.utils.monitor.ScalarWriter`` (JSONL, TensorBoard-style
+    tags) so training curves are still captured."""
+
+    def __init__(self, log_dir="vdl_log"):
+        super().__init__()
+        from ..utils.monitor import ScalarWriter
+        self._writer = ScalarWriter(log_dir)
+        self._step = 0
+
+    def on_train_batch_end(self, step, logs=None):
+        self._step += 1
+        for k, v in (logs or {}).items():
+            try:
+                self._writer.add_scalar(f"train/{k}", float(v), self._step)
+            except (TypeError, ValueError):
+                pass
+
+    def on_eval_end(self, logs=None):
+        for k, v in (logs or {}).items():
+            try:
+                self._writer.add_scalar(f"eval/{k}", float(v), self._step)
+            except (TypeError, ValueError):
+                pass
+
+
+class ReduceLROnPlateau(Callback):
+    """Drop LR when a monitored metric plateaus (hapi parity)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = float(factor)
+        self.patience = int(patience)
+        self.min_delta = float(min_delta)
+        self.cooldown = int(cooldown)
+        self.min_lr = float(min_lr)
+        self.mode = mode
+        self._best = None
+        self._bad = 0
+        self._cool = 0
+
+    def _better(self, cur):
+        if self._best is None:
+            return True
+        if self.mode == "max":
+            return cur > self._best + self.min_delta
+        return cur < self._best - self.min_delta
+
+    def on_eval_end(self, logs=None):
+        logs = logs or {}
+        cur = logs.get(self.monitor)
+        if cur is None:
+            return
+        cur = float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+        if self._cool > 0:
+            self._cool -= 1
+        if self._better(cur):
+            self._best = cur
+            self._bad = 0
+            return
+        if self._cool > 0:
+            return
+        self._bad += 1
+        if self._bad > self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                lr = opt.get_lr()
+                new = max(lr * self.factor, self.min_lr)
+                if new < lr:
+                    opt.set_lr(new)
+            self._bad = 0
+            self._cool = self.cooldown
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logging (hapi parity). Gated on the wandb
+    package; when absent (this image has no network), the callback warns
+    once and disables itself."""
+
+    def __init__(self, project=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb
+            self._wandb = wandb
+            self._run = wandb.init(project=project, **kwargs)
+        except Exception as e:  # noqa: BLE001 — missing pkg, no API key,
+            import warnings     # no network: all degrade to a no-op
+            warnings.warn(f"wandb unavailable ({type(e).__name__}: {e}); "
+                          "WandbCallback is a no-op", UserWarning)
+            self._wandb = None
+
+    def on_train_batch_end(self, step, logs=None):
+        if self._wandb is not None:
+            self._wandb.log({f"train/{k}": v
+                             for k, v in (logs or {}).items()})
+
+    def on_eval_end(self, logs=None):
+        if self._wandb is not None:
+            self._wandb.log({f"eval/{k}": v
+                             for k, v in (logs or {}).items()})
+
+    def on_train_end(self, logs=None):
+        if self._wandb is not None:
+            self._run.finish()
+
+
+__all__ += ["VisualDL", "ReduceLROnPlateau", "WandbCallback"]

@@ -4,3 +4,20 @@ from .input_spec import InputSpec
 
 __all__ = ["to_static", "StaticFunction", "not_to_static", "save", "load",
            "InputSpec", "TranslatedLayer", "ignore_module"]
+
+
+def enable_to_static(enable=True):
+    """paddle.jit.enable_to_static — global kill-switch: with False every
+    StaticFunction call runs its original eager function."""
+    StaticFunction._globally_enabled = bool(enable)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """Transform-logging verbosity (dy2static parity): >0 enables DEBUG
+    logs from the to_static module logger."""
+    import logging
+    logging.getLogger("paddle_tpu.jit.to_static_api").setLevel(
+        logging.DEBUG if level and int(level) > 0 else logging.WARNING)
+
+
+__all__ += ["enable_to_static", "set_verbosity"]

@@ -33,65 +33,69 @@ def save(layer, path, input_spec=None, **configs):
         _save_obj(layer.state_dict(), path + ".pdiparams")
         meta["type"] = "layer"
         meta["class"] = type(layer).__name__
-        # export stablehlo if an input_spec is given
-        if input_spec is not None:
-            arrays = []
-            shape_strs = []
-            has_dyn = False
-            for i, spec in enumerate(input_spec):
-                shape = tuple(1 if s in (-1, None) else s
-                              for s in spec.shape)
-                arrays.append(jnp.zeros(shape, spec.dtype))
-                parts = []
-                for j, sdim in enumerate(spec.shape):
-                    if sdim in (-1, None):
-                        parts.append(f"d{i}_{j}")
-                        has_dyn = True
-                    else:
-                        parts.append("_")
-                shape_strs.append(", ".join(parts) if parts else "")
-
-            def fwd(*xs):
-                outs = layer(*[Tensor(x) for x in xs])
-                if isinstance(outs, (list, tuple)):
-                    return tuple(o._data for o in outs)
-                return outs._data
-            try:
-                lowered = jax.jit(fwd).lower(*arrays)
-                with open(path + ".pdmodel", "w") as f:
-                    f.write(lowered.as_text())
-                meta["stablehlo"] = True
-                meta["input_shapes"] = [tuple(a.shape) for a in arrays]
-                meta["input_dtypes"] = [str(a.dtype) for a in arrays]
-            except Exception as e:  # export is best-effort
-                meta["stablehlo"] = False
-                meta["export_error"] = str(e)
-            # serialized jax.export artifact: the executable pdmodel
-            # (runs without the python class — the inference engine's
-            # real load format; .pdmodel text is for inspection).
-            # InputSpec dims of -1/None export as symbolic dims so the
-            # artifact serves any batch size.
-            try:
-                from jax import export as jexport
-                spec_args = (jexport.symbolic_args_specs(arrays,
-                                                         shape_strs)
-                             if has_dyn else arrays)
-                try:
-                    # multi-platform so the artifact serves on either
-                    # a CPU dev box or a TPU host
-                    exp = jexport.export(
-                        jax.jit(fwd),
-                        platforms=("cpu", "tpu"))(*spec_args)
-                except Exception:
-                    exp = jexport.export(jax.jit(fwd))(*spec_args)
-                with open(path + ".pdexported", "wb") as f:
-                    f.write(bytes(exp.serialize()))
-                meta["exported"] = True
-            except Exception as e:
-                meta["exported"] = False
-                meta["exported_error"] = str(e)
     else:
+        # plain function: no parameters, but the export artifacts
+        # below still make it a loadable inference model
+        # (static.save_inference_model builds on this)
+        _save_obj({}, path + ".pdiparams")
         meta["type"] = "function"
+    # export stablehlo if an input_spec is given
+    if input_spec is not None:
+        arrays = []
+        shape_strs = []
+        has_dyn = False
+        for i, spec in enumerate(input_spec):
+            shape = tuple(1 if s in (-1, None) else s
+                          for s in spec.shape)
+            arrays.append(jnp.zeros(shape, spec.dtype))
+            parts = []
+            for j, sdim in enumerate(spec.shape):
+                if sdim in (-1, None):
+                    parts.append(f"d{i}_{j}")
+                    has_dyn = True
+                else:
+                    parts.append("_")
+            shape_strs.append(", ".join(parts) if parts else "")
+
+        def fwd(*xs):
+            outs = layer(*[Tensor(x) for x in xs])
+            if isinstance(outs, (list, tuple)):
+                return tuple(o._data for o in outs)
+            return outs._data
+        try:
+            lowered = jax.jit(fwd).lower(*arrays)
+            with open(path + ".pdmodel", "w") as f:
+                f.write(lowered.as_text())
+            meta["stablehlo"] = True
+            meta["input_shapes"] = [tuple(a.shape) for a in arrays]
+            meta["input_dtypes"] = [str(a.dtype) for a in arrays]
+        except Exception as e:  # export is best-effort
+            meta["stablehlo"] = False
+            meta["export_error"] = str(e)
+        # serialized jax.export artifact: the executable pdmodel
+        # (runs without the python class — the inference engine's
+        # real load format; .pdmodel text is for inspection).
+        # InputSpec dims of -1/None export as symbolic dims so the
+        # artifact serves any batch size.
+        try:
+            from jax import export as jexport
+            spec_args = (jexport.symbolic_args_specs(arrays,
+                                                     shape_strs)
+                         if has_dyn else arrays)
+            try:
+                # multi-platform so the artifact serves on either
+                # a CPU dev box or a TPU host
+                exp = jexport.export(
+                    jax.jit(fwd),
+                    platforms=("cpu", "tpu"))(*spec_args)
+            except Exception:
+                exp = jexport.export(jax.jit(fwd))(*spec_args)
+            with open(path + ".pdexported", "wb") as f:
+                f.write(bytes(exp.serialize()))
+            meta["exported"] = True
+        except Exception as e:
+            meta["exported"] = False
+            meta["exported_error"] = str(e)
     with open(path + ".pdmeta", "wb") as f:
         pickle.dump(meta, f)
 

@@ -189,8 +189,12 @@ class StaticFunction:
             return self._fn(self._instance, *args, **kwargs)
         return self._fn(*args, **kwargs)
 
+    #: flipped by paddle.jit.enable_to_static(False): every StaticFunction
+    #: runs its original eager function
+    _globally_enabled = True
+
     def __call__(self, *args, **kwargs):
-        if not self._enabled:
+        if not self._enabled or not StaticFunction._globally_enabled:
             return self._call_fn(*args, **kwargs)
         leaves: list = []
         spec = _tree_flatten((args, kwargs), leaves)

@@ -12,6 +12,7 @@ from ...framework import random as framework_random
 from ...ops.common import as_tensor
 
 __all__ = ["linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+           "feature_alpha_dropout",
            "embedding", "normalize", "cosine_similarity", "pad",
            "interpolate", "upsample", "unfold", "fold", "pixel_shuffle",
            "pixel_unshuffle", "channel_shuffle", "label_smooth",
@@ -78,6 +79,27 @@ def alpha_dropout(x, p=0.5, training=True, name=None):
         m = keep.astype(a.dtype)
         return a_coef * (a * m + alpha_p * (1 - m)) + b_coef
     return apply(fn, x, name="alpha_dropout")
+
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    """Alpha dropout that drops whole channels (dim 1), keeping SELU
+    self-normalizing statistics (paddle.nn.functional parity)."""
+    x = as_tensor(x)
+    if not training or p == 0.0:
+        return x
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+    key = framework_random.default_generator.next_key()
+    mask_shape = tuple(s if d <= 1 else 1 for d, s in enumerate(x.shape))
+    keep = jax.random.bernoulli(key, 1.0 - p, mask_shape)
+    a_coef = (1.0 - p + p * alpha_p ** 2 * (1.0 - p)) ** -0.5
+    b_coef = -a_coef * p * alpha_p
+
+    def fn(a):
+        m = jnp.broadcast_to(keep, a.shape).astype(a.dtype)
+        return a_coef * (a * m + alpha_p * (1 - m)) + b_coef
+    return apply(fn, x, name="feature_alpha_dropout")
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):

@@ -338,3 +338,154 @@ def _max_pool_indices_nd(x, kernel, stride, padding, n, channel_last,
 
 
 __all__ += ["max_unpool1d", "max_unpool2d", "max_unpool3d"]
+
+
+# ---- LP pooling (paddle 3.0 lp_pool1d/2d parity) --------------------------
+
+def _lp_pool(x, norm_type, kernel_size, stride, padding, n, channel_last,
+             ceil_mode, name):
+    x = as_tensor(x)
+    p = float(norm_type)
+    kernel = _tuplize(kernel_size, n)
+    count = 1
+    for k in kernel:
+        count *= k
+    if p == float("inf"):
+        return _pool(x, kernel_size, stride, padding, n, "max",
+                     channel_last, ceil_mode, name=name)
+    powed = apply(lambda a: jnp.power(a, p), x, name=f"{name}_pow")
+    # exclusive=False: avg*count must equal the true window SUM of x^p —
+    # zero-pads contribute 0 to it, so border windows must divide by the
+    # full kernel count, not the valid count
+    avg = _pool(powed, kernel_size, stride, padding, n, "avg",
+                channel_last, ceil_mode, exclusive=False, name=name)
+    return apply(lambda a: jnp.power(a * count, 1.0 / p), avg,
+                 name=f"{name}_root")
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    """paddle.nn.functional.lp_pool1d — (sum over window of x^p)^(1/p)."""
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 1,
+                    data_format == "NLC", ceil_mode, "lp_pool1d")
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    return _lp_pool(x, norm_type, kernel_size, stride, padding, 2,
+                    data_format == "NHWC", ceil_mode, "lp_pool2d")
+
+
+__all__ += ["lp_pool1d", "lp_pool2d"]
+
+
+# ---- fractional max pooling (Graham 2014; paddle 2.6 parity) --------------
+
+def _frac_starts(in_sz, out_sz, kernel, u):
+    """Pseudo-random pooling-region start indices (host-side: ``u`` is a
+    concrete python float, so the index grid is a compile-time constant)."""
+    import math as _math
+
+    if out_sz == 1:
+        return np.zeros((1,), np.int64), in_sz
+    if kernel:
+        # overlapping windows of fixed size `kernel`
+        alpha = (in_sz - kernel) / (out_sz - 1)
+        starts = [min(int(_math.ceil(alpha * (i + u))) - 1, in_sz - kernel)
+                  if i else 0 for i in range(out_sz)]
+        starts = [max(0, s) for s in starts]
+        return np.asarray(starts, np.int64), kernel
+    # disjoint regions: boundaries a_i = ceil(alpha*(i+u)) - 1, a_0 = 0
+    alpha = in_sz / out_sz
+    bounds = [0]
+    for i in range(1, out_sz):
+        bounds.append(min(max(int(_math.ceil(alpha * (i + u))) - 1, i),
+                          in_sz - (out_sz - i)))
+    bounds.append(in_sz)
+    starts = np.asarray(bounds[:-1], np.int64)
+    widths = np.diff(np.asarray(bounds, np.int64))
+    return starts, int(widths.max()), np.asarray(widths, np.int64)
+
+
+def _fractional_pool(x, output_size, kernel_size, random_u, n, return_mask,
+                     name):
+    x = as_tensor(x)
+    if random_u is None:
+        from ...framework import random as framework_random
+        key = framework_random.default_generator.next_key()
+        random_u = float(jax.random.uniform(key))
+    u = float(random_u)
+    out_sz = _tuplize(output_size, n)
+    kern = _tuplize(kernel_size, n) if kernel_size is not None else \
+        (None,) * n
+    spatial = x.shape[-n:]
+    grids = []          # per dim: (index grid [out, maxw], mask [out, maxw])
+    for d in range(n):
+        res = _frac_starts(int(spatial[d]), int(out_sz[d]), kern[d], u)
+        if len(res) == 3:
+            starts, maxw, widths = res
+        else:
+            starts, maxw = res
+            widths = np.full((len(starts),), maxw, np.int64)
+        idx = starts[:, None] + np.arange(maxw)[None, :]
+        mask = np.arange(maxw)[None, :] < widths[:, None]
+        idx = np.clip(idx, 0, int(spatial[d]) - 1)
+        grids.append((jnp.asarray(idx), jnp.asarray(mask)))
+
+    def pool_fn(a):
+        # windowed gather per spatial dim (innermost last so axis
+        # numbering stays stable), mask the ragged tail, reduce
+        r = a.astype(jnp.float32)
+        base = r.ndim - n
+        for d in range(n - 1, -1, -1):
+            idx, mask = grids[d]
+            r = jnp.take(r, idx, axis=base + d)   # [..., out, w, ...]
+            m = mask.reshape(mask.shape + (1,) * (r.ndim - base - d - 2))
+            r = jnp.where(m, r, -jnp.inf)
+            r = jnp.max(r, axis=base + d + 1)
+        return r.astype(a.dtype)
+
+    out = apply(pool_fn, x, name=name)
+    if not return_mask:
+        return out
+
+    def idx_fn(a):
+        # same gathers, but carry each element's flat spatial coordinate
+        # alongside the value and argmax-select it per window
+        base = a.ndim - n
+        pos = jnp.arange(int(np.prod(a.shape[base:])),
+                         dtype=jnp.int32).reshape(a.shape[base:])
+        rr = a.astype(jnp.float32)
+        rp = jnp.broadcast_to(pos, a.shape).astype(jnp.int32)
+        for d in range(n - 1, -1, -1):
+            idx, mask = grids[d]
+            rr = jnp.take(rr, idx, axis=base + d)
+            rp = jnp.take(rp, idx, axis=base + d)
+            m = mask.reshape(mask.shape + (1,) * (rr.ndim - base - d - 2))
+            rr = jnp.where(m, rr, -jnp.inf)
+            am = jnp.argmax(rr, axis=base + d + 1, keepdims=True)
+            rr = jnp.squeeze(jnp.take_along_axis(rr, am, base + d + 1),
+                             base + d + 1)
+            rp = jnp.squeeze(jnp.take_along_axis(rp, am, base + d + 1),
+                             base + d + 1)
+        return rp
+
+    idx_t = apply(idx_fn, x, name=f"{name}_mask", differentiable=False)
+    return out, idx_t
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    """paddle.nn.functional.fractional_max_pool2d — pseudo-random pooling
+    regions (Graham, "Fractional Max-Pooling")."""
+    return _fractional_pool(x, output_size, kernel_size, random_u, 2,
+                            return_mask, "fractional_max_pool2d")
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None, random_u=None,
+                          return_mask=False, name=None):
+    return _fractional_pool(x, output_size, kernel_size, random_u, 3,
+                            return_mask, "fractional_max_pool3d")
+
+
+__all__ += ["fractional_max_pool2d", "fractional_max_pool3d"]

@@ -298,3 +298,27 @@ class PairwiseDistance(Layer):
 
 
 __all__ += ["Unflatten", "PairwiseDistance"]
+
+
+class ZeroPad1D(_PadN):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class ZeroPad3D(_PadN):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, "constant", 0.0, data_format)
+
+
+class FeatureAlphaDropout(Layer):
+    """Channel-wise alpha dropout (SELU-preserving; paddle parity)."""
+
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, self.training)
+
+
+__all__ += ["ZeroPad1D", "ZeroPad3D", "FeatureAlphaDropout"]

@@ -128,3 +128,53 @@ class MaxUnPool3D(Layer):
 
 
 __all__ += ["MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D"]
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self._a = (norm_type, kernel_size, stride, padding, ceil_mode,
+                   data_format)
+
+    def forward(self, x):
+        p, k, s, pad, cm, df = self._a
+        return F.lp_pool1d(x, p, k, s, pad, cm, df)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self._a = (norm_type, kernel_size, stride, padding, ceil_mode,
+                   data_format)
+
+    def forward(self, x):
+        p, k, s, pad, cm, df = self._a
+        return F.lp_pool2d(x, p, k, s, pad, cm, df)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        osz, k, u, rm = self._a
+        return F.fractional_max_pool2d(x, osz, k, u, rm)
+
+
+class FractionalMaxPool3D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self._a = (output_size, kernel_size, random_u, return_mask)
+
+    def forward(self, x):
+        osz, k, u, rm = self._a
+        return F.fractional_max_pool3d(x, osz, k, u, rm)
+
+
+__all__ += ["LPPool1D", "LPPool2D", "FractionalMaxPool2D",
+            "FractionalMaxPool3D"]

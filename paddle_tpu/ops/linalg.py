@@ -6,6 +6,8 @@ and the AMP auto-cast policy (bf16-first on TPU).
 
 from __future__ import annotations
 
+import builtins
+
 import jax
 import jax.numpy as jnp
 
@@ -347,3 +349,76 @@ def inverse(x, name=None):
 
 
 __all__ += ["inverse"]
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """paddle.linalg.lu_unpack — split ``lu()``'s packed output into
+    (P, L, U) with A = P @ L @ U. Pivots are 1-based (LAPACK/``lu()``
+    convention)."""
+    x, y = as_tensor(x), as_tensor(y)
+    m, n = x.shape[-2], x.shape[-1]
+    k = builtins.min(m, n)
+
+    def fn(a, piv):
+        eye_k = jnp.eye(m, k, dtype=a.dtype)
+        l_full = jnp.tril(a[..., :k], -1) + eye_k
+        u_full = jnp.triu(a[..., :k, :])
+
+        def perm_of(p1):
+            # apply LAPACK row swaps to the identity permutation
+            def body(i, perm):
+                j = p1[i] - 1
+                pi, pj = perm[i], perm[j]
+                perm = perm.at[i].set(pj)
+                return perm.at[j].set(pi)
+            return jax.lax.fori_loop(0, k, body, jnp.arange(m))
+
+        batch = a.shape[:-2]
+        if batch:
+            perms = jax.vmap(perm_of)(piv.reshape((-1, k))).reshape(
+                batch + (m,))
+        else:
+            perms = perm_of(piv)
+        # rows of A were swapped into LU order: P undoes that on the left
+        p_mat = jax.nn.one_hot(perms, m, dtype=a.dtype)
+        p_mat = jnp.swapaxes(p_mat, -1, -2)
+        return p_mat, l_full, u_full
+
+    p_t, l_t, u_t = apply(fn, x, y, n_outputs=3, name="lu_unpack")
+    return (p_t if unpack_pivots else None,
+            l_t if unpack_ludata else None,
+            u_t if unpack_ludata else None)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary",
+          name=None):
+    """paddle.cdist — batched pairwise p-norm distances:
+    x [*, P, M], y [*, R, M] -> [*, P, R]."""
+    x, y = as_tensor(x), as_tensor(y)
+    pv = float(p)
+
+    def fn(a, b):
+        if pv == 2.0 and str(compute_mode) != \
+                "donot_use_mm_for_euclid_dist":
+            # |a-b|^2 = |a|^2 + |b|^2 - 2 a.b — one big MXU matmul
+            a2 = jnp.sum(a * a, -1)[..., :, None]
+            b2 = jnp.sum(b * b, -1)[..., None, :]
+            ab = jnp.einsum("...pm,...rm->...pr", a, b)
+            return jnp.sqrt(jnp.maximum(a2 + b2 - 2 * ab, 0.0))
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if pv == 0:
+            return jnp.sum((d != 0).astype(a.dtype), -1)
+        if pv == float("inf"):
+            return jnp.max(jnp.abs(d), -1)
+        return jnp.sum(jnp.abs(d) ** pv, -1) ** (1.0 / pv)
+    return apply(fn, x, y, name="cdist")
+
+
+def vecdot(x, y, axis=-1, name=None):
+    """paddle.linalg.vecdot — broadcasted vector dot along ``axis``."""
+    x, y = as_tensor(x), as_tensor(y)
+    return apply(lambda a, b: jnp.sum(a * b, axis=axis), x, y,
+                 name="vecdot")
+
+
+__all__ += ["lu_unpack", "cdist", "vecdot"]

@@ -568,3 +568,15 @@ def rank(input, name=None):
 
 
 __all__ += ["logaddexp2", "add_n", "rank"]
+
+# torch-convention incomplete gamma pair (paddle 2.6 added these
+# following torch.igamma/igammac): igamma = regularized LOWER P(a, x),
+# igammac = regularized UPPER Q(a, x), first argument is the shape a.
+igamma = binary(jax.scipy.special.gammainc, "igamma")
+igammac = binary(jax.scipy.special.gammaincc, "igammac")
+
+
+igamma_ = _make_inplace(igamma, "igamma_")
+igammac_ = _make_inplace(igammac, "igammac_")
+
+__all__ += ["igamma", "igammac", "igamma_", "igammac_"]

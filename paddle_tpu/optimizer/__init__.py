@@ -1,9 +1,11 @@
 from .optimizer import (Optimizer, SGD, Momentum, Adam, AdamW, Adamax,
-                        Adagrad, Adadelta, RMSProp, Lamb, LBFGS)
+                        Adagrad, Adadelta, RMSProp, Lamb, LBFGS, Rprop,
+                        ASGD, NAdam, RAdam)
 from . import lr
 from .clip import (ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue,
                    clip_grad_norm_)
 
 __all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
-           "Adagrad", "Adadelta", "RMSProp", "Lamb", "LBFGS", "lr",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb", "LBFGS", "Rprop",
+           "ASGD", "NAdam", "RAdam", "lr",
            "ClipGradByGlobalNorm", "ClipGradByNorm", "ClipGradByValue"]

@@ -494,3 +494,126 @@ class LBFGS(Optimizer):
                 if g is not None:
                     p.set_data(p._data - self.get_lr() * g._data)
         return loss
+
+
+class Rprop(Optimizer):
+    """Resilient backpropagation (paddle.optimizer.Rprop parity): per-
+    element step sizes grown/shrunk by the sign agreement of successive
+    gradients; only the gradient SIGN is used."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = (float(learning_rate_range[0]),
+                                      float(learning_rate_range[1]))
+        self._eta_minus, self._eta_plus = float(etas[0]), float(etas[1])
+
+    def _update_param(self, p, g, lr):
+        gd = g._data.astype(jnp.float32)
+        prev = self._acc("prev_grad", p)
+        step = self._acc("step_size", p,
+                         init=jnp.full(p._data.shape, float(lr),
+                                       jnp.float32))
+        sign = jnp.sign(gd) * jnp.sign(prev._data)
+        factor = jnp.where(sign > 0, self._eta_plus,
+                           jnp.where(sign < 0, self._eta_minus, 1.0))
+        new_step = jnp.clip(step._data * factor, self._lr_min, self._lr_max)
+        # on sign flip: revert nothing (iRprop-), zero the stored grad so
+        # the next step is neutral
+        g_eff = jnp.where(sign < 0, 0.0, gd)
+        upd = -jnp.sign(g_eff) * new_step
+        prev.set_data(g_eff)
+        step.set_data(new_step)
+        p.set_data((p._data.astype(jnp.float32) + upd).astype(p.dtype))
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (paddle.optimizer.ASGD parity): SGD steps plus a
+    running average of the iterates stored per parameter."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._batch_num = max(int(batch_num), 1)
+
+    def _update_param(self, p, g, lr):
+        gd = self._decay_grad(p, g._data.astype(jnp.float32))
+        # running mean of the last batch_num grads (paddle keeps a
+        # d-buffer; the streaming mean is the TPU-friendly equivalent)
+        buf = self._acc("grad_mean", p)
+        n_t = self._acc("n_seen", p, init=jnp.zeros((), jnp.float32))
+        n = jnp.minimum(n_t._data + 1.0, float(self._batch_num))
+        mean = buf._data + (gd - buf._data) / n
+        buf.set_data(mean)
+        n_t.set_data(n)
+        p.set_data((p._data.astype(jnp.float32) - lr * mean)
+                   .astype(p.dtype))
+
+
+class _NAdamRAdamBase(_AdamBase):
+    def _moments(self, p, gd):
+        m_t = self._acc("moment1", p)
+        v_t = self._acc("moment2", p)
+        b1p = self._acc("beta1_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        b2p = self._acc("beta2_pow", p, init=jnp.asarray(1.0, jnp.float32))
+        m = self._beta1 * m_t._data + (1 - self._beta1) * gd
+        v = self._beta2 * v_t._data + (1 - self._beta2) * jnp.square(gd)
+        b1 = b1p._data * self._beta1
+        b2 = b2p._data * self._beta2
+        m_t.set_data(m)
+        v_t.set_data(v)
+        b1p.set_data(b1)
+        b2p.set_data(b2)
+        return m, v, b1, b2
+
+    def _write(self, p, new):
+        master = self._master(p)
+        if master is not None:
+            master.set_data(new)
+        p.set_data(new.astype(p.dtype))
+
+    def _base(self, p):
+        master = self._master(p)
+        return master._data if master is not None else \
+            p._data.astype(jnp.float32)
+
+
+class NAdam(_NAdamRAdamBase):
+    """Nesterov-momentum Adam (paddle.optimizer.NAdam parity)."""
+
+    def _update_param(self, p, g, lr):
+        gd = self._decay_grad(p, g._data.astype(jnp.float32))
+        m, v, b1, b2 = self._moments(p, gd)
+        m_hat = (self._beta1 * m / (1 - b1 * self._beta1)
+                 + (1 - self._beta1) * gd / (1 - b1))
+        v_hat = v / (1 - b2)
+        new = self._base(p) - lr * m_hat / (jnp.sqrt(v_hat) + self._epsilon)
+        self._write(p, new)
+
+
+class RAdam(_NAdamRAdamBase):
+    """Rectified Adam (paddle.optimizer.RAdam parity): per-step variance
+    rectification; falls back to momentum SGD while the variance estimate
+    is untrustworthy (small t)."""
+
+    def _update_param(self, p, g, lr):
+        gd = self._decay_grad(p, g._data.astype(jnp.float32))
+        m, v, b1, b2 = self._moments(p, gd)
+        rho_inf = 2.0 / (1 - self._beta2) - 1.0
+        # t from beta2^t (avoids a separate step counter accumulator)
+        t = jnp.log(b2) / jnp.log(jnp.asarray(self._beta2, jnp.float32))
+        rho_t = rho_inf - 2.0 * t * b2 / (1 - b2)
+        m_hat = m / (1 - b1)
+        r_num = (rho_t - 4) * (rho_t - 2) * rho_inf
+        r_den = (rho_inf - 4) * (rho_inf - 2) * rho_t
+        rect = jnp.sqrt(jnp.maximum(r_num / jnp.maximum(r_den, 1e-30),
+                                    0.0))
+        v_hat = jnp.sqrt(v / (1 - b2))
+        adam_step = rect * m_hat / (v_hat + self._epsilon)
+        sgd_step = m_hat
+        new = self._base(p) - lr * jnp.where(rho_t > 5.0, adam_step,
+                                             sgd_step)
+        self._write(p, new)

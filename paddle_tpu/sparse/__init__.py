@@ -26,7 +26,9 @@ __all__ = ["sparse_coo_tensor", "sparse_csr_tensor", "SparseCooTensor",
            "SparseCsrTensor", "matmul", "masked_matmul", "mv", "add",
            "multiply", "subtract", "divide", "is_same_shape", "relu",
            "tanh", "sin", "abs", "sqrt", "pow", "neg", "coalesce",
-           "transpose", "nn"]
+           "transpose", "nn", "tan", "asin", "atan", "sinh", "asinh",
+           "atanh", "square", "log1p", "expm1", "deg2rad", "rad2deg",
+           "addmm"]
 
 
 def _jx(x):
@@ -324,6 +326,26 @@ sin = _unary(jnp.sin, "sin")
 abs = _unary(jnp.abs, "abs")
 sqrt = _unary(jnp.sqrt, "sqrt")
 neg = _unary(lambda v: -v, "neg")
+# the rest of paddle.sparse's zero-preserving unary set
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+asinh = _unary(jnp.arcsinh, "asinh")
+atanh = _unary(jnp.arctanh, "atanh")
+square = _unary(jnp.square, "square")
+log1p = _unary(jnp.log1p, "log1p")
+expm1 = _unary(jnp.expm1, "expm1")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """paddle.sparse.addmm — beta*input + alpha*(x @ y); x sparse (COO or
+    CSR), input/y dense."""
+    prod = matmul(x, y)
+    return apply(lambda i, m: beta * i + alpha * m, as_tensor(input),
+                 as_tensor(prod), name="sparse_addmm")
 
 
 def pow(x, factor, name=None):

@@ -1,0 +1,210 @@
+"""``paddle.static`` long tail: scopes, places, py_func, gradients,
+inference-model save/load (python/paddle/static/ parity, UNVERIFIED —
+reference mount empty).
+
+Design notes (TPU-native): a "scope" is a plain name→Tensor dict (the
+C++ Scope exists to own variables across executor runs; here Tensors own
+themselves), ``py_func`` lowers to ``jax.pure_callback`` so host python
+runs inside compiled programs, and the inference-model pair delegates to
+``paddle.jit.save/load`` (StableHLO export) with the feed/fetch wrapper
+the legacy API promises."""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply
+from ..framework.device import CPUPlace, CUDAPlace
+from .program import Program, default_main_program
+
+__all__ = ["Variable", "Scope", "global_scope", "scope_guard",
+           "cpu_places", "cuda_places", "device_guard", "py_func",
+           "gradients", "append_backward", "normalize_program",
+           "save_inference_model", "load_inference_model"]
+
+#: static-mode variables ARE Tensors in paddle_tpu (no VarDesc layer)
+Variable = Tensor
+
+
+class Scope:
+    """Name → variable map (the role of the C++ ``Scope``)."""
+
+    def __init__(self):
+        self._vars: dict[str, Tensor] = {}
+
+    def var(self, name):
+        if name not in self._vars:
+            self._vars[name] = Tensor(jnp.zeros((), jnp.float32))
+            self._vars[name].name = name
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def erase(self, names):
+        for n in names:
+            self._vars.pop(n, None)
+
+
+_global_scope = Scope()
+_scope_stack = [_global_scope]
+
+
+def global_scope() -> Scope:
+    return _scope_stack[-1]
+
+
+@contextlib.contextmanager
+def scope_guard(scope: Scope):
+    _scope_stack.append(scope)
+    try:
+        yield
+    finally:
+        _scope_stack.pop()
+
+
+def cpu_places(device_count=None):
+    import os
+    n = device_count or int(os.environ.get("CPU_NUM", 1))
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """Device places for the accelerator — TPU chips here (the name is
+    API parity; there is no CUDA)."""
+    ids = device_ids if device_ids is not None else \
+        range(len(jax.devices()))
+    return [CUDAPlace(i) for i in ids]
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Op-placement hint. XLA owns placement on TPU; the guard exists for
+    source parity and records nothing."""
+    yield
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Run a host python function as an op. Eager: direct call; under a
+    trace it lowers to ``jax.pure_callback`` with ``out``'s shape/dtype
+    as the result contract. ``backward_func`` is accepted for parity; the
+    op is non-differentiable (matching py_func's host boundary)."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    templates = [jax.ShapeDtypeStruct(tuple(o.shape), o._data.dtype)
+                 for o in outs]
+
+    def fn(*arrays):
+        def host(*np_arrays):
+            r = func(*np_arrays)
+            rs = r if isinstance(r, (list, tuple)) else [r]
+            packed = tuple(np.asarray(v, dtype=t.dtype).reshape(t.shape)
+                           for v, t in zip(rs, templates))
+            return packed if len(templates) > 1 else packed[0]
+        out_tmpl = tuple(templates) if len(templates) > 1 else templates[0]
+        if any(isinstance(a, jax.core.Tracer) for a in arrays):
+            return jax.pure_callback(host, out_tmpl, *arrays)
+        return host(*[np.asarray(a) for a in arrays])
+
+    result = apply(fn, *xs, n_outputs=len(templates), name="py_func",
+                   differentiable=False)
+    if len(templates) == 1:
+        return result[0] if isinstance(result, tuple) else result
+    return list(result)
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """d(sum targets)/d(inputs) — static API over the eager autograd."""
+    from ..autograd import grad as _grad
+    tl = targets if isinstance(targets, (list, tuple)) else [targets]
+    il = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    gl = None
+    if target_gradients is not None:
+        gl = target_gradients if isinstance(target_gradients, (list, tuple)) \
+            else [target_gradients]
+    return _grad(tl, il, grad_outputs=gl, allow_unused=True)
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    """Run backward from ``loss``; returns [(param, grad)] like the
+    reference (which appends grad ops to the program — here the tape IS
+    the program)."""
+    loss.backward()
+    params = parameter_list
+    if params is None:
+        prog = default_main_program()
+        try:
+            params = prog.parameters()
+        except RuntimeError:
+            params = []
+    return [(p, p.grad) for p in params if p.grad is not None]
+
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Prune/normalize for export. The captured-replay Program is already
+    minimal (the jaxpr XLA traces is the pruned graph); returns it."""
+    return program
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor,
+                         program=None, **kwargs):
+    """Export a captured Program as an inference model via jit.save
+    (StableHLO `.pdmodel` + params). feed_vars order defines the input
+    signature."""
+    from ..jit import save as jit_save
+    from ..jit.input_spec import InputSpec
+
+    program = program or default_main_program()
+    if not callable(program.build_fn):
+        raise RuntimeError(
+            "save_inference_model needs Program.capture(build_fn) "
+            "(paddle_tpu static programs are captured replays)")
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) \
+        else [feed_vars]
+    fetches = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    feed_names = [f.name for f in feeds]
+    fetch_names = [f.name if hasattr(f, "name") else str(f)
+                   for f in fetches]
+
+    def fn(*xs):
+        outs = program.build_fn(dict(zip(feed_names, xs)))
+        return tuple(outs[n] for n in fetch_names)
+
+    spec = [InputSpec(list(f.shape), str(f._data.dtype), f.name)
+            for f in feeds]
+    jit_save(fn, path_prefix, input_spec=spec)
+    import pickle
+    with open(path_prefix + ".pdnames", "wb") as fh:
+        pickle.dump({"feed": feed_names, "fetch": fetch_names}, fh)
+
+
+def load_inference_model(path_prefix, executor, **kwargs):
+    """Returns [program, feed_names, fetch_names]: the loaded callable
+    wrapped back into a captured Program so Executor.run drives it."""
+    import os
+    import pickle
+
+    from ..jit import load as jit_load
+
+    loaded = jit_load(path_prefix)
+    names = {"feed": [], "fetch": []}
+    if os.path.exists(path_prefix + ".pdnames"):
+        with open(path_prefix + ".pdnames", "rb") as fh:
+            names = pickle.load(fh)
+
+    prog = Program()
+
+    def build(feed):
+        xs = [feed[n] for n in names["feed"]]
+        outs = loaded(*xs)
+        outs = outs if isinstance(outs, (list, tuple)) else [outs]
+        return dict(zip(names["fetch"], outs))
+
+    prog.build_fn = build
+    return [prog, names["feed"], names["fetch"]]

@@ -103,3 +103,33 @@ class ScalarWriter:
 
     def __exit__(self, *exc):
         self.close()
+
+
+# ---- operator-call statistics (paddle.amp.debugging op stats role) --------
+
+op_stats: dict = {}
+
+
+def _record_op(name: str, dtype: str) -> None:
+    key = (name or "op", dtype)
+    op_stats[key] = op_stats.get(key, 0) + 1
+
+
+def enable_op_stats() -> None:
+    """Count every ``apply``-dispatched op by (name, input dtype) —
+    the amp.debugging operator-stats role. One hook-pointer check per op
+    when disabled."""
+    from ..framework import core
+    core._op_stat_hook = _record_op
+
+
+def disable_op_stats() -> None:
+    from ..framework import core
+    core._op_stat_hook = None
+
+
+def op_stats_summary(reset=True) -> dict:
+    out = {f"{n}[{d}]": c for (n, d), c in sorted(op_stats.items())}
+    if reset:
+        op_stats.clear()
+    return out

@@ -1,0 +1,237 @@
+"""Round-3 op/API long-tail: linalg (lu_unpack/cdist/vecdot), incomplete
+gamma, LP/fractional pooling, feature alpha dropout, pad layers, and the
+Rprop/ASGD/NAdam/RAdam optimizers. Oracles: reconstruction identities,
+scipy, torch functionals, and convergence checks (SURVEY.md §4 OpTest
+discipline)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+
+class TestLinalgLongTail:
+    def test_lu_unpack_reconstructs(self):
+        rng = np.random.RandomState(0)
+        for shape in [(4, 4), (5, 3), (3, 5), (2, 4, 4)]:
+            a = rng.randn(*shape).astype(np.float32)
+            x = paddle.to_tensor(a)
+            lu_, piv = paddle.linalg.lu(x)
+            p, l, u = paddle.linalg.lu_unpack(lu_, piv)
+            rec = np.asarray((p @ l @ u).numpy())
+            np.testing.assert_allclose(rec, a, rtol=1e-4, atol=1e-5)
+
+    def test_cdist_matches_numpy(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(3, 5, 4).astype(np.float32)
+        y = rng.randn(3, 6, 4).astype(np.float32)
+        for p in (1.0, 2.0, 3.0, float("inf")):
+            got = np.asarray(paddle.cdist(paddle.to_tensor(x),
+                                          paddle.to_tensor(y),
+                                          p=p).numpy())
+            d = np.abs(x[:, :, None, :] - y[:, None, :, :])
+            if p == float("inf"):
+                ref = d.max(-1)
+            else:
+                ref = (d ** p).sum(-1) ** (1.0 / p)
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_vecdot(self):
+        rng = np.random.RandomState(2)
+        x = rng.randn(4, 5).astype(np.float32)
+        y = rng.randn(4, 5).astype(np.float32)
+        got = np.asarray(paddle.linalg.vecdot(
+            paddle.to_tensor(x), paddle.to_tensor(y)).numpy())
+        np.testing.assert_allclose(got, (x * y).sum(-1), rtol=1e-5)
+
+
+class TestIncompleteGamma:
+    def test_igamma_igammac_vs_scipy(self):
+        import scipy.special as sp
+        rng = np.random.RandomState(3)
+        a = (rng.rand(32).astype(np.float32) * 4 + 0.2)
+        x = (rng.rand(32).astype(np.float32) * 5)
+        got_p = np.asarray(paddle.igamma(paddle.to_tensor(a),
+                                         paddle.to_tensor(x)).numpy())
+        got_q = np.asarray(paddle.igammac(paddle.to_tensor(a),
+                                          paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(got_p, sp.gammainc(a, x), rtol=2e-5,
+                                   atol=2e-6)
+        np.testing.assert_allclose(got_q, sp.gammaincc(a, x), rtol=2e-5,
+                                   atol=2e-6)
+        np.testing.assert_allclose(got_p + got_q, np.ones_like(a),
+                                   rtol=1e-5)
+
+    def test_igamma_inplace(self):
+        a = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        x = paddle.to_tensor(np.array([0.5, 1.5], np.float32))
+        ref = np.asarray(paddle.igamma(a, x).numpy())
+        a.igamma_(x) if hasattr(a, "igamma_") else paddle.igamma_(a, x)
+        np.testing.assert_allclose(np.asarray(a.numpy()), ref, rtol=1e-5)
+
+
+class TestLpAndFractionalPool:
+    def test_lp_pool_vs_torch(self):
+        import torch
+        rng = np.random.RandomState(4)
+        x = np.abs(rng.randn(2, 3, 8, 10)).astype(np.float32)
+        for p in (1.0, 2.0, 3.0):
+            got = np.asarray(F.lp_pool2d(paddle.to_tensor(x), p, 2,
+                                         stride=2).numpy())
+            ref = torch.nn.functional.lp_pool2d(
+                torch.from_numpy(x), p, 2, stride=2).numpy()
+            np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+        x1 = np.abs(rng.randn(2, 3, 12)).astype(np.float32)
+        got = np.asarray(F.lp_pool1d(paddle.to_tensor(x1), 2.0, 3,
+                                     stride=3).numpy())
+        ref = torch.nn.functional.lp_pool1d(
+            torch.from_numpy(x1), 2.0, 3, stride=3).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_fractional_uniform_regions_match_max_pool(self):
+        """u chosen so regions are exactly uniform -> equals max_pool."""
+        rng = np.random.RandomState(5)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        got = np.asarray(F.fractional_max_pool2d(
+            paddle.to_tensor(x), output_size=4, random_u=0.4).numpy())
+        ref = np.asarray(F.max_pool2d(paddle.to_tensor(x), 2, 2).numpy())
+        np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    def test_fractional_mask_consistent(self):
+        rng = np.random.RandomState(6)
+        x = rng.randn(1, 2, 9, 7).astype(np.float32)
+        out, mask = F.fractional_max_pool2d(
+            paddle.to_tensor(x), output_size=(3, 3), random_u=0.7,
+            return_mask=True)
+        out_np = np.asarray(out.numpy())
+        m = np.asarray(mask.numpy())
+        flat = x.reshape(1, 2, -1)
+        for b in range(1):
+            for c in range(2):
+                picked = flat[b, c][m[b, c].reshape(-1)]
+                np.testing.assert_allclose(picked,
+                                           out_np[b, c].reshape(-1),
+                                           rtol=1e-6)
+
+    def test_fractional_3d_shape_and_membership(self):
+        rng = np.random.RandomState(7)
+        x = rng.randn(1, 2, 6, 8, 10).astype(np.float32)
+        out = F.fractional_max_pool3d(paddle.to_tensor(x),
+                                      output_size=(2, 3, 4),
+                                      random_u=0.3)
+        assert list(out.shape) == [1, 2, 2, 3, 4]
+        # every pooled value is an element of the input
+        assert np.isin(np.asarray(out.numpy()).ravel(),
+                       x.ravel()).all()
+
+    def test_layers_wrap_functionals(self):
+        rng = np.random.RandomState(8)
+        x = paddle.to_tensor(np.abs(rng.randn(1, 2, 8, 8))
+                             .astype(np.float32))
+        l1 = nn.LPPool2D(2.0, 2, stride=2)
+        np.testing.assert_allclose(np.asarray(l1(x).numpy()),
+                                   np.asarray(F.lp_pool2d(x, 2.0, 2,
+                                                          2).numpy()))
+        l2 = nn.FractionalMaxPool2D(4, random_u=0.4)
+        assert list(l2(x).shape) == [1, 2, 4, 4]
+
+
+class TestPadAndDropoutLayers:
+    def test_zeropad_1d_3d(self):
+        x1 = paddle.to_tensor(np.ones((1, 2, 4), np.float32))
+        y1 = nn.ZeroPad1D([1, 2])(x1)
+        assert list(y1.shape) == [1, 2, 7]
+        assert float(y1.numpy()[0, 0, 0]) == 0.0
+        x3 = paddle.to_tensor(np.ones((1, 1, 2, 2, 2), np.float32))
+        y3 = nn.ZeroPad3D([1, 1, 1, 1, 1, 1])(x3)
+        assert list(y3.shape) == [1, 1, 4, 4, 4]
+
+    def test_feature_alpha_dropout_channelwise(self):
+        paddle.seed(11)
+        x = paddle.to_tensor(np.random.RandomState(9)
+                             .randn(4, 8, 5, 5).astype(np.float32))
+        layer = nn.FeatureAlphaDropout(0.5)
+        layer.train()
+        y = np.asarray(layer(x).numpy())
+        xn = np.asarray(x.numpy())
+        # each channel is either an affine map of x (kept) or constant
+        # (dropped) — alpha dropout semantics at channel granularity
+        kept = dropped = 0
+        for b in range(4):
+            for c in range(8):
+                ch = y[b, c]
+                if np.allclose(ch, ch.flat[0], atol=1e-6):
+                    dropped += 1
+                else:
+                    corr = np.corrcoef(ch.ravel(), xn[b, c].ravel())[0, 1]
+                    assert corr > 0.99
+                    kept += 1
+        assert kept > 0 and dropped > 0
+        layer.eval()
+        np.testing.assert_allclose(np.asarray(layer(x).numpy()), xn)
+
+
+class TestNewOptimizers:
+    def _quad_losses(self, opt_cls, steps=60, **kw):
+        paddle.seed(0)
+        target = paddle.to_tensor(
+            np.random.RandomState(10).randn(8).astype(np.float32))
+        w = paddle.to_tensor(np.zeros(8, np.float32), stop_gradient=False)
+        # a Parameter-like persistable leaf
+        w.persistable = True
+        opt = opt_cls(learning_rate=kw.pop("lr", 0.05), parameters=[w],
+                      **kw)
+        losses = []
+        for _ in range(steps):
+            loss = ((w - target) * (w - target)).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.item()))
+        return losses
+
+    @pytest.mark.parametrize("cls_name,steps,lr",
+                             [("Rprop", 60, 0.05), ("ASGD", 60, 0.05),
+                              ("NAdam", 60, 0.05),
+                              ("RAdam", 250, 0.1)])  # rectification warmup
+    def test_converges_on_quadratic(self, cls_name, steps, lr):
+        cls = getattr(paddle.optimizer, cls_name)
+        losses = self._quad_losses(cls, steps=steps, lr=lr)
+        assert losses[-1] < losses[0] * 0.05, (cls_name, losses[::20])
+
+    def test_state_dict_roundtrip(self):
+        """Restore-then-continue must match continue-without-restore
+        (accumulators materialize lazily from the pending state)."""
+        cls = paddle.optimizer.RAdam
+
+        def run(restart):
+            w = paddle.to_tensor(np.ones(4, np.float32),
+                                 stop_gradient=False)
+            w.persistable = True
+            w.name = "w0"
+            opt = cls(learning_rate=0.01, parameters=[w])
+
+            def step():
+                loss = (w * w).sum()
+                loss.backward()
+                opt.step()
+                opt.clear_grad()
+            for _ in range(3):
+                step()
+            if restart:
+                sd = opt.state_dict()
+                opt = cls(learning_rate=0.01, parameters=[w])
+                opt.set_state_dict(sd)
+
+                def step():  # noqa: F811 — rebind onto the new opt
+                    loss = (w * w).sum()
+                    loss.backward()
+                    opt.step()
+                    opt.clear_grad()
+            for _ in range(2):
+                step()
+            return np.asarray(w.numpy())
+
+        np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
