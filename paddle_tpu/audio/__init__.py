@@ -5,5 +5,9 @@ MelSpectrogram / LogMelSpectrogram / MFCC feature layers built on
 
 from . import functional
 from . import features
+from . import backends
+from . import datasets
+from .backends import load, save, info
 
-__all__ = ["functional", "features"]
+__all__ = ["functional", "features", "backends", "datasets", "load",
+           "save", "info"]

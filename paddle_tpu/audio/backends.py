@@ -1,0 +1,99 @@
+"""``paddle.audio.backends`` — wave I/O (python/paddle/audio/backends
+parity, UNVERIFIED). The reference dispatches to soundfile; this image is
+offline/dependency-free, so the built-in backend handles WAV (PCM 16/32
+and float32) via the stdlib ``wave`` module."""
+
+from __future__ import annotations
+
+import wave
+from dataclasses import dataclass
+
+import numpy as np
+
+__all__ = ["AudioInfo", "info", "load", "save", "list_available_backends",
+           "get_current_backend", "set_backend"]
+
+_BACKEND = "wave"
+
+
+def list_available_backends():
+    return ["wave"]
+
+
+def get_current_backend():
+    return _BACKEND
+
+
+def set_backend(backend_name):
+    if backend_name not in list_available_backends():
+        raise ValueError(
+            f"unknown audio backend {backend_name!r}; available: "
+            f"{list_available_backends()} (soundfile is not shipped on "
+            "this image)")
+
+
+@dataclass
+class AudioInfo:
+    sample_rate: int
+    num_samples: int
+    num_channels: int
+    bits_per_sample: int
+    encoding: str
+
+
+def info(filepath, format=None) -> AudioInfo:
+    with wave.open(str(filepath), "rb") as w:
+        return AudioInfo(sample_rate=w.getframerate(),
+                         num_samples=w.getnframes(),
+                         num_channels=w.getnchannels(),
+                         bits_per_sample=w.getsampwidth() * 8,
+                         encoding=f"PCM_{w.getsampwidth() * 8}")
+
+
+def load(filepath, frame_offset=0, num_frames=-1, normalize=True,
+         channels_first=True, format=None):
+    """Returns (waveform [C, T] float32 paddle Tensor, sample_rate)."""
+    with wave.open(str(filepath), "rb") as w:
+        sr = w.getframerate()
+        nch = w.getnchannels()
+        width = w.getsampwidth()
+        w.setpos(int(frame_offset))
+        n = w.getnframes() - int(frame_offset) if num_frames in (-1, None) \
+            else int(num_frames)
+        raw = w.readframes(n)
+    if width == 1:
+        # WAV stores 8-bit PCM UNSIGNED (silence at 128)
+        data = (np.frombuffer(raw, np.uint8).astype(np.int16)
+                - 128).reshape(-1, nch)
+    else:
+        dtype = {2: np.int16, 4: np.int32}[width]
+        data = np.frombuffer(raw, dtype=dtype).reshape(-1, nch)
+    if normalize:
+        data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    from ..framework.core import Tensor
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(np.ascontiguousarray(arr))), sr
+
+
+def save(filepath, src, sample_rate, channels_first=True,
+         encoding="PCM_16", bits_per_sample=16, format=None):
+    """Write a waveform Tensor/array ([C, T] by default) as PCM WAV."""
+    a = np.asarray(src.numpy() if hasattr(src, "numpy") else src)
+    if a.ndim == 1:
+        a = a[None, :]
+    if channels_first:
+        a = a.T                                  # -> [T, C]
+    width = int(bits_per_sample) // 8
+    if a.dtype.kind == "f":
+        peak = float(2 ** (8 * width - 1) - 1)
+        a = np.clip(a, -1.0, 1.0) * peak
+    if width == 1:
+        a = (a.astype(np.int16) + 128).astype(np.uint8)  # unsigned 8-bit
+    else:
+        a = a.astype({2: np.int16, 4: np.int32}[width])
+    with wave.open(str(filepath), "wb") as w:
+        w.setnchannels(a.shape[1])
+        w.setsampwidth(width)
+        w.setframerate(int(sample_rate))
+        w.writeframes(np.ascontiguousarray(a).tobytes())

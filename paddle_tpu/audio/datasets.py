@@ -1,0 +1,103 @@
+"""``paddle.audio.datasets`` — TESS / ESC50 (python/paddle/audio/datasets
+parity, UNVERIFIED). Offline-gated like the text datasets: point at a
+local extracted archive, or ``backend='generate'`` for a synthetic split
+with the real item shape (waveform [T] float32, label int)."""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..io import Dataset
+from ..utils.download import WEIGHTS_HOME
+
+__all__ = ["TESS", "ESC50"]
+
+
+def _missing(name, path):
+    raise RuntimeError(
+        f"{name}: dataset archive not found at {path}. This environment "
+        "has no network access — place the extracted dataset there, "
+        "or pass backend='generate' for a synthetic offline split.")
+
+
+class _SynthAudio(Dataset):
+    n_classes = 2
+
+    def __init__(self, mode, n, sample_rate=16000, seconds=1):
+        rng = np.random.RandomState(0 if mode == "train" else 1)
+        t = int(sample_rate * seconds)
+        self.data = []
+        for i in range(n):
+            label = i % self.n_classes
+            freq = 220.0 * (label + 1)
+            x = np.sin(2 * np.pi * freq * np.arange(t) / sample_rate)
+            x = (x + 0.05 * rng.randn(t)).astype("float32")
+            self.data.append((x, np.int64(label)))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class TESS(_SynthAudio):
+    """Toronto Emotional Speech Set (7 emotion classes)."""
+
+    n_classes = 7
+
+    def __init__(self, mode="train", n_shards=3, shard_id=0,
+                 sample_rate=16000, archive=None, backend=None,
+                 **kwargs):
+        if backend == "generate":
+            super().__init__(mode, 70 if mode == "train" else 21,
+                             sample_rate)
+            return
+        path = archive or os.path.join(WEIGHTS_HOME, "TESS")
+        if not os.path.isdir(path):
+            _missing("TESS", path)
+        from .backends import load as _load
+        self.data = []
+        # directories only: a stray README/.DS_Store must not consume a
+        # class id
+        emotions = sorted(e for e in os.listdir(path)
+                          if os.path.isdir(os.path.join(path, e)))
+        for li, emo in enumerate(emotions):
+            d = os.path.join(path, emo)
+            for fn in sorted(os.listdir(d)):
+                if fn.endswith(".wav"):
+                    wav, _sr = _load(os.path.join(d, fn))
+                    self.data.append((np.asarray(wav.numpy())[0],
+                                      np.int64(li)))
+
+
+class ESC50(_SynthAudio):
+    """Environmental Sound Classification (50 classes, 5 folds)."""
+
+    n_classes = 50
+
+    def __init__(self, mode="train", split=1, sample_rate=16000,
+                 archive=None, backend=None, **kwargs):
+        if backend == "generate":
+            super().__init__(mode, 100 if mode == "train" else 50,
+                             sample_rate)
+            return
+        path = archive or os.path.join(WEIGHTS_HOME, "ESC-50")
+        if not os.path.isdir(path):
+            _missing("ESC50", path)
+        import csv
+        from .backends import load as _load
+        meta = os.path.join(path, "meta", "esc50.csv")
+        audio_dir = os.path.join(path, "audio")
+        self.data = []
+        with open(meta) as f:
+            for row in csv.DictReader(f):
+                fold = int(row["fold"])
+                is_test = fold == int(split)
+                if (mode == "train") == (not is_test):
+                    wav, _sr = _load(os.path.join(audio_dir,
+                                                  row["filename"]))
+                    self.data.append((np.asarray(wav.numpy())[0],
+                                      np.int64(row["target"])))

@@ -184,3 +184,186 @@ def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
     if not pre_layer_norm:
         h = F.layer_norm(h, [h.shape[-1]], ln2_scale, ln2_bias, ln2_epsilon)
     return h
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True, mode=None,
+        name=None):
+    """incubate parity: LN(residual + dropout(x + bias)). On TPU this is
+    one XLA fusion; the API exists so reference model code runs
+    unchanged."""
+    h = x if bias is None else x + bias
+    h = F.dropout(h, dropout_rate, training=training,
+                  mode=mode or "upscale_in_train")
+    h = residual + h
+    d = h.shape[-1]
+    return F.layer_norm(h, [d], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None,
+                               src_mask=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               num_heads=None, head_dim=None,
+                               compute_dtype="default", name=None,
+                               **kwargs):
+    """Decode-step MHA over a dense cache with optional additive mask —
+    the reference's fused decoder-attention op (UNVERIFIED; mount empty).
+    x: [B, 3*H*D] packed qkv for ONE step; cache_kv: [2, B, H, T, D]."""
+    xt = as_tensor(x)
+    b = xt.shape[0]
+    if num_heads is None or head_dim is None:
+        raise ValueError("masked_multihead_attention needs num_heads and "
+                         "head_dim (packed-qkv layout is ambiguous)")
+    if sequence_lengths is None:
+        raise ValueError(
+            "masked_multihead_attention needs sequence_lengths (tokens "
+            "already cached per row): the write position of this step's "
+            "k/v cannot be inferred from a fixed-capacity cache")
+    if rotary_tensor is not None or beam_cache_offset is not None:
+        raise NotImplementedError(
+            "masked_multihead_attention: rotary_tensor/beam_cache_offset "
+            "are not supported — apply "
+            "fused_rotary_position_embedding to q/k before packing")
+    H, D = int(num_heads), int(head_dim)
+    ckv = as_tensor(cache_kv)
+    sl = as_tensor(sequence_lengths)
+    mask = as_tensor(src_mask) if src_mask is not None else None
+
+    def fn(packed, cache, *rest):
+        ri = 0
+        lens = rest[ri]; ri += 1
+        m = None
+        if mask is not None:
+            m = rest[ri]; ri += 1
+        q, k, v = [packed.reshape(b, 3, H, D)[:, i] for i in range(3)]
+        T = cache.shape[3]
+        # append this step's k/v at position lens
+        bidx = jnp.arange(b)
+        kc = cache[0].at[bidx, :, lens, :].set(k)
+        vc = cache[1].at[bidx, :, lens, :].set(v)
+        logits = jnp.einsum("bhd,bhtd->bht", q, kc,
+                            preferred_element_type=jnp.float32)
+        logits = logits / jnp.sqrt(jnp.asarray(D, jnp.float32))
+        valid = jnp.arange(T)[None, :] <= lens[:, None]
+        logits = jnp.where(valid[:, None, :], logits, -1e30)
+        if m is not None:
+            logits = logits + m.reshape(b, 1, -1)[:, :, :T]
+        p = jax.nn.softmax(logits, -1).astype(vc.dtype)
+        out = jnp.einsum("bht,bhtd->bhd", p, vc).reshape(b, H * D)
+        return out, jnp.stack([kc, vc])
+
+    args = [xt, ckv, sl]
+    if mask is not None:
+        args.append(mask)
+    return apply(fn, *args, n_outputs=2,
+                 name="masked_multihead_attention")
+
+
+def variable_length_memory_efficient_attention(
+        query, key, value, seq_lens, kv_seq_lens, mask=None, scale=None,
+        causal=False, pre_cache_length=0, name=None):
+    """Ragged-batch attention: per-sequence valid lengths mask the
+    attention matrix (the memory-efficient kernel's contract; XLA fuses
+    the masked softmax). q/k/v: [B, H, S, D]; seq_lens/kv_seq_lens: [B]."""
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    ql, kl = as_tensor(seq_lens), as_tensor(kv_seq_lens)
+
+    def fn(qq, kk, vv, qlen, klen, *rest):
+        import math as _math
+        d = qq.shape[-1]
+        s = scale if scale is not None else 1.0 / _math.sqrt(d)
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qq, kk,
+                            preferred_element_type=jnp.float32) * s
+        sq, sk = logits.shape[-2], logits.shape[-1]
+        okq = jnp.arange(sq)[None, :] < qlen.reshape(-1, 1)
+        okk = jnp.arange(sk)[None, :] < klen.reshape(-1, 1)
+        ok = okq[:, None, :, None] & okk[:, None, None, :]
+        if causal:
+            # align the diagonal to the LAST query: with a cached prefix
+            # (sk > sq, e.g. decode/extend) query row i may see keys up
+            # to (sk - sq) + i
+            ok = ok & jnp.tril(jnp.ones((sq, sk), bool),
+                               k=sk - sq)[None, None]
+        if rest:
+            logits = logits + rest[0].astype(logits.dtype)
+        logits = jnp.where(ok, logits, -1e30)
+        p = jax.nn.softmax(logits, -1).astype(vv.dtype)
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, vv)
+        # zero out padded query rows (softmax over all -1e30 is uniform)
+        return out * okq[:, None, :, None].astype(out.dtype)
+
+    args = [q, k, v, ql, kl]
+    if mask is not None:
+        args.append(as_tensor(mask))
+    return apply(fn, *args,
+                 name="variable_length_memory_efficient_attention")
+
+
+def block_multihead_attention(q, key_pages, value_pages, block_tables,
+                              context_lens, scale=None, name=None,
+                              **kwargs):
+    """Block/paged decode attention — alias surface of the reference's
+    block_multihead_attention over the paged-KV pool (see
+    ops/paged_attention.py for layouts)."""
+    return paged_attention(q, key_pages, value_pages, block_tables,
+                           context_lens, scale=scale)
+
+
+def fused_moe(x, gate_weight, expert_weights_up, expert_weights_down,
+              top_k=2, norm_topk_prob=True, name=None):
+    """Dense-compute MoE forward (incubate fused_moe parity): softmax
+    gate -> top-k routing -> SwiGLU-less expert FFNs, computed as
+    grouped einsum over ALL experts then combined by routing weight —
+    the TPU-friendly dense formulation (no scatter)."""
+    xt = as_tensor(x)
+    gw = as_tensor(gate_weight)
+    wu = as_tensor(expert_weights_up)
+    wd = as_tensor(expert_weights_down)
+
+    def fn(a, g, up, down):
+        b = a.reshape(-1, a.shape[-1])               # [N, d]
+        logits = b @ g                                # [N, E]
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+        topv, topi = jax.lax.top_k(probs, top_k)      # [N, K]
+        if norm_topk_prob:
+            topv = topv / jnp.sum(topv, -1, keepdims=True)
+        h = jnp.einsum("nd,edf->nef", b, up)          # [N, E, f]
+        h = jax.nn.gelu(h, approximate=False)
+        o = jnp.einsum("nef,efd->ned", h, down)       # [N, E, d]
+        sel = jnp.take_along_axis(
+            o, topi[:, :, None].astype(jnp.int32), 1)  # [N, K, d]
+        out = jnp.sum(sel * topv[:, :, None].astype(sel.dtype), 1)
+        return out.reshape(a.shape)
+
+    return apply(fn, xt, gw, wu, wd, name="fused_moe")
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """incubate fused_ec_moe parity: expert-choice style fused MoE FFN
+    with biases; dense-compute formulation (every expert computes, the
+    gate combines)."""
+    xt = as_tensor(x)
+
+    def fn(a, g, w0, b0, w1, b1):
+        b = a.reshape(-1, a.shape[-1])
+        probs = jax.nn.softmax((b @ g).astype(jnp.float32), -1)  # [N, E]
+        h = jnp.einsum("nd,edf->nef", b, w0) + b0[None]
+        h = jax.nn.gelu(h, approximate=False) if act_type == "gelu" \
+            else jnp.maximum(h, 0)
+        o = jnp.einsum("nef,efd->ned", h, w1) + b1[None]
+        out = jnp.einsum("ne,ned->nd", probs.astype(o.dtype), o)
+        return out.reshape(a.shape)
+
+    return apply(fn, xt, as_tensor(gate), as_tensor(bmm0_weight),
+                 as_tensor(bmm0_bias), as_tensor(bmm1_weight),
+                 as_tensor(bmm1_bias), name="fused_ec_moe")
+
+
+__all__ += ["fused_bias_dropout_residual_layer_norm",
+            "masked_multihead_attention",
+            "variable_length_memory_efficient_attention",
+            "block_multihead_attention", "fused_moe", "fused_ec_moe"]

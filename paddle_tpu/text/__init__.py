@@ -247,3 +247,140 @@ class Imikolov(Dataset):
     def __getitem__(self, idx):
         g = self.grams[idx]
         return tuple(g[:-1]), g[-1]
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 semantic-role-labeling dataset. Offline-gated like the
+    other text datasets: point ``data_file`` at the extracted corpus, or
+    pass ``backend='generate'`` for a synthetic split (same item shape:
+    token-id sequence + predicate index + SRL tag ids)."""
+
+    def __init__(self, data_file=None, mode="train", download=True,
+                 backend=None, vocab_size=800, n_tags=20):
+        assert mode in ("train", "test")
+        if backend == "generate":
+            rng = np.random.RandomState(0 if mode == "train" else 1)
+            n = 120 if mode == "train" else 30
+            self.data = []
+            for _ in range(n):
+                ln = int(rng.randint(5, 25))
+                toks = rng.randint(0, vocab_size, (ln,)).astype("int64")
+                pred = int(rng.randint(0, ln))
+                tags = rng.randint(0, n_tags, (ln,)).astype("int64")
+                self.data.append((toks, pred, tags))
+            return
+        data_file = data_file or os.path.join(WEIGHTS_HOME, "conll05st")
+        if not os.path.exists(data_file):
+            _missing("Conll05st", data_file)
+        raise NotImplementedError(
+            "Conll05st: parsing a local corpus dump is not implemented; "
+            "use backend='generate' for the synthetic split")
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class Movielens(Dataset):
+    """MovieLens ratings (user, movie, rating). Offline-gated; the
+    ``ml-1m`` ratings.dat format is parsed when present."""
+
+    def __init__(self, data_file=None, mode="train", download=True,
+                 backend=None, test_ratio=0.1, rand_seed=0):
+        assert mode in ("train", "test")
+        if backend == "generate":
+            rng = np.random.RandomState(0)
+            n = 500
+            users = rng.randint(0, 100, n).astype("int64")
+            movies = rng.randint(0, 200, n).astype("int64")
+            ratings = rng.randint(1, 6, n).astype("float32")
+            split = int(n * (1 - test_ratio))
+            sl = slice(0, split) if mode == "train" else slice(split, n)
+            self.data = list(zip(users[sl], movies[sl], ratings[sl]))
+            return
+        data_file = data_file or os.path.join(WEIGHTS_HOME,
+                                              "ml-1m/ratings.dat")
+        if not os.path.exists(data_file):
+            _missing("Movielens", data_file)
+        rows = []
+        with open(data_file) as f:
+            for line in f:
+                parts = line.strip().split("::")
+                if len(parts) >= 3:
+                    rows.append((np.int64(parts[0]), np.int64(parts[1]),
+                                 np.float32(parts[2])))
+        rng = np.random.RandomState(rand_seed)
+        rng.shuffle(rows)
+        split = int(len(rows) * (1 - test_ratio))
+        self.data = rows[:split] if mode == "train" else rows[split:]
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class _WMTBase(Dataset):
+    """Shared WMT14/WMT16 shape: (src ids, tgt ids, tgt_next ids)."""
+
+    def __init__(self, name, data_file, mode, backend, src_vocab,
+                 tgt_vocab):
+        assert mode in ("train", "test", "dev")
+        if backend == "generate":
+            rng = np.random.RandomState({"train": 0, "dev": 1,
+                                         "test": 2}[mode])
+            n = {"train": 200, "dev": 40, "test": 40}[mode]
+            self.data = []
+            for _ in range(n):
+                sl = int(rng.randint(4, 20))
+                tl = int(rng.randint(4, 20))
+                src = rng.randint(2, src_vocab, (sl,)).astype("int64")
+                tgt = rng.randint(2, tgt_vocab, (tl,)).astype("int64")
+                self.data.append((src, np.concatenate([[0], tgt]),
+                                  np.concatenate([tgt, [1]])))
+            return
+        if data_file is None or not os.path.exists(data_file):
+            _missing(name, data_file or os.path.join(WEIGHTS_HOME, name))
+        self.data = []
+        with open(data_file) as f:
+            for line in f:
+                cols = line.rstrip("\n").split("\t")
+                if len(cols) != 2:
+                    continue
+                src = np.asarray([int(t) for t in cols[0].split()],
+                                 "int64")
+                tgt = np.asarray([int(t) for t in cols[1].split()],
+                                 "int64")
+                self.data.append((src, np.concatenate([[0], tgt]),
+                                  np.concatenate([tgt, [1]])))
+
+    def __len__(self):
+        return len(self.data)
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+
+class WMT14(_WMTBase):
+    """WMT'14 EN-DE translation pairs (pre-tokenized id TSV when local)."""
+
+    def __init__(self, data_file=None, mode="train", dict_size=30000,
+                 download=True, backend=None):
+        super().__init__("WMT14", data_file, mode, backend, dict_size,
+                         dict_size)
+
+
+class WMT16(_WMTBase):
+    """WMT'16 EN-DE translation pairs (pre-tokenized id TSV when local)."""
+
+    def __init__(self, data_file=None, mode="train", src_dict_size=30000,
+                 trg_dict_size=30000, lang="en", download=True,
+                 backend=None):
+        super().__init__("WMT16", data_file, mode, backend, src_dict_size,
+                         trg_dict_size)
+
+
+__all__ += ["Conll05st", "Movielens", "WMT14", "WMT16"]

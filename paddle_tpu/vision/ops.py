@@ -483,3 +483,221 @@ class RoIPool:
                                 spatial_scale)
 
         return _R()
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (SOLOv2): soft decay of each box's score by its IoU with
+    higher-scored same-class boxes — one dense IoU matrix instead of a
+    sequential suppression loop (the TPU-friendly formulation).
+
+    bboxes: [N, M, 4]; scores: [N, C, M]. Returns (out [K, 6] rows of
+    (label, score, x1, y1, x2, y2), [index], rois_num)."""
+    bt, st = as_tensor(bboxes), as_tensor(scores)
+    n, c, m = st.shape
+    top = min(int(nms_top_k), int(m)) if nms_top_k > 0 else int(m)
+
+    def one_image(bx, sc):
+        # per class: take top-k by score, decay by the SOLOv2 rule
+        # decay_j = min_{i<j} f(iou_ij) / f(comp_i),
+        # comp_i = max_{k<i} iou_ki, f linear (1-x) or gaussian
+        def one_class(cls_scores):
+            v, idx = jax.lax.top_k(cls_scores, top)
+            bsel = bx[idx]
+            iou = _iou_matrix(bsel, bsel)
+            upper = jnp.triu(iou, k=1)           # iou_ij for i < j
+            comp = jnp.max(upper, axis=0)        # comp[i]
+            valid = jnp.triu(jnp.ones_like(upper, bool), k=1)
+            if use_gaussian:
+                dm = jnp.exp(-(upper ** 2 - comp[:, None] ** 2)
+                             / gaussian_sigma)
+            else:
+                dm = (1 - upper) / jnp.maximum(1 - comp[:, None], 1e-9)
+            d = jnp.min(jnp.where(valid, dm, 1.0), axis=0)
+            return v * d, idx
+
+        dec, idxs = jax.vmap(one_class)(sc)       # [C, top]
+        return dec, idxs
+
+    dec_t, idx_t = apply(lambda b, s: jax.vmap(one_image)(b, s),
+                         bt, st, n_outputs=2, name="matrix_nms",
+                         differentiable=False)
+    import numpy as np
+    dec = np.asarray(dec_t._data)                 # [N, C, top]
+    idxs = np.asarray(idx_t._data)
+    bx_np = np.asarray(bt._data)
+    rows, flat_index, rois_num = [], [], []
+    for i in range(n):
+        cand = []
+        for cls in range(c):
+            if cls == background_label and c > 1:
+                continue
+            for j in range(dec.shape[2]):
+                s = float(dec[i, cls, j])
+                if s >= float(post_threshold) and s >= float(
+                        score_threshold):
+                    bi = int(idxs[i, cls, j])
+                    cand.append((s, cls, bi))
+        cand.sort(reverse=True)
+        if keep_top_k > 0:
+            cand = cand[:int(keep_top_k)]
+        rois_num.append(len(cand))
+        for s, cls, bi in cand:
+            rows.append([cls, s] + bx_np[i, bi].tolist())
+            flat_index.append(i * m + bi)
+    out = Tensor(jnp.asarray(np.asarray(rows, np.float32).reshape(-1, 6)))
+    num = Tensor(jnp.asarray(np.asarray(rois_num, np.int32)))
+    if return_index:
+        idx_out = Tensor(jnp.asarray(np.asarray(flat_index, np.int64)))
+        return (out, idx_out, num) if return_rois_num else (out, idx_out)
+    return (out, num) if return_rois_num else out
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (R-FCN): channel block (i, j) is
+    average-pooled over spatial bin (i, j) of each RoI."""
+    xt, bt = as_tensor(x), as_tensor(boxes)
+    if isinstance(output_size, int):
+        ph = pw = int(output_size)
+    else:
+        ph, pw = output_size
+    c = xt.shape[1]
+    assert c % (ph * pw) == 0, (
+        f"psroi_pool: channels {c} not divisible by output bins "
+        f"{ph * pw}")
+    co = c // (ph * pw)
+    # RoI -> image mapping from boxes_num (host-concrete, like the
+    # reference's rois_num contract)
+    import numpy as _np
+    bn = _np.asarray(as_tensor(boxes_num)._data).astype(_np.int64)
+    roi_img = _np.repeat(_np.arange(len(bn)), bn).astype(_np.int32)
+    roi_img_t = as_tensor(roi_img)
+
+    def fn(feat, rois, img_idx):
+        hh, ww = feat.shape[2], feat.shape[3]
+
+        def one(roi, bi):
+            fimg = feat[bi]                       # [C, H, W]
+            x1, y1, x2, y2 = [roi[k] * spatial_scale for k in range(4)]
+            rw = jnp.maximum(x2 - x1, 1e-3)
+            rh = jnp.maximum(y2 - y1, 1e-3)
+            ys = jnp.linspace(0.0, 1.0, ph + 1) * rh + y1
+            xs = jnp.linspace(0.0, 1.0, pw + 1) * rw + x1
+            out = jnp.zeros((co, ph, pw), feat.dtype)
+            # average over each bin via a weighted mask (dense, static)
+            gy = jnp.arange(hh, dtype=jnp.float32)
+            gx = jnp.arange(ww, dtype=jnp.float32)
+            for i in range(ph):
+                my = ((gy >= ys[i]) & (gy < jnp.maximum(
+                    ys[i + 1], ys[i] + 1))).astype(feat.dtype)
+                for j in range(pw):
+                    mx_ = ((gx >= xs[j]) & (gx < jnp.maximum(
+                        xs[j + 1], xs[j] + 1))).astype(feat.dtype)
+                    mask = my[:, None] * mx_[None, :]
+                    cnt = jnp.maximum(mask.sum(), 1.0)
+                    blk = fimg[(i * pw + j) * co:(i * pw + j + 1) * co]
+                    val = (blk * mask[None]).sum((-2, -1)) / cnt
+                    out = out.at[:, i, j].set(val)
+            return out
+
+        return jax.vmap(one)(rois, img_idx)
+
+    return apply(fn, xt, bt, roi_img_t, name="psroi_pool")
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=True,
+                       name=None):
+    """RPN proposal generation: decode anchor deltas -> clip -> filter by
+    size -> top-k by score -> NMS (host-composed from the dense ops)."""
+    import numpy as np
+    sc = np.asarray(as_tensor(scores)._data)        # [N, A, H, W]
+    bd = np.asarray(as_tensor(bbox_deltas)._data)   # [N, 4A, H, W]
+    an = np.asarray(as_tensor(anchors)._data).reshape(-1, 4)
+    va = np.asarray(as_tensor(variances)._data).reshape(-1, 4)
+    im = np.asarray(as_tensor(img_size)._data)
+    n = sc.shape[0]
+    off = 1.0 if pixel_offset else 0.0   # paddle-1.x box convention
+    out_rois, out_num, out_scores = [], [], []
+    for i in range(n):
+        s = sc[i].transpose(1, 2, 0).reshape(-1)
+        d = bd[i].reshape(-1, 4, sc.shape[2], sc.shape[3]) \
+            .transpose(2, 3, 0, 1).reshape(-1, 4)
+        aw = an[:, 2] - an[:, 0] + off
+        ah = an[:, 3] - an[:, 1] + off
+        acx = an[:, 0] + aw / 2
+        acy = an[:, 1] + ah / 2
+        cx = va[:, 0] * d[:, 0] * aw + acx
+        cy = va[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(va[:, 2] * d[:, 2], 10.0)) * aw
+        h = np.exp(np.minimum(va[:, 3] * d[:, 3], 10.0)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2, cx + w / 2 - off,
+                          cy + h / 2 - off], axis=1)
+        hmax, wmax = float(im[i, 0]), float(im[i, 1])
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, wmax - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, hmax - off)
+        keep = ((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                & (boxes[:, 3] - boxes[:, 1] + off >= min_size))
+        boxes, s = boxes[keep], s[keep]
+        order = np.argsort(-s)[:int(pre_nms_top_n)]
+        boxes, s = boxes[order], s[order]
+        if len(boxes):
+            kept = np.asarray(nms(
+                Tensor(jnp.asarray(boxes.astype(np.float32))),
+                iou_threshold=float(nms_thresh),
+                scores=Tensor(jnp.asarray(s.astype(np.float32))),
+                top_k=int(post_nms_top_n)).numpy())
+        else:
+            kept = np.zeros((0,), np.int64)
+        sel = boxes[kept] if len(kept) else np.zeros((0, 4), np.float32)
+        out_rois.append(sel.astype(np.float32))
+        out_scores.append(s[kept].astype(np.float32) if len(kept)
+                          else np.zeros((0,), np.float32))
+        out_num.append(len(sel))
+    rois = Tensor(jnp.asarray(np.concatenate(out_rois, 0)
+                              if out_rois else np.zeros((0, 4),
+                                                        np.float32)))
+    rscores = Tensor(jnp.asarray(np.concatenate(out_scores, 0)))
+    num = Tensor(jnp.asarray(np.asarray(out_num, np.int32)))
+    if return_rois_num:
+        return rois, rscores, num
+    return rois, rscores
+
+
+def read_file(filename, name=None):
+    """Read raw bytes as a uint8 tensor (paddle.vision.ops.read_file)."""
+    import numpy as np
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to [C, H, W] uint8 (via PIL — the
+    reference uses nvjpeg; host decode is the TPU-side equivalent)."""
+    import io
+
+    import numpy as np
+    from PIL import Image
+
+    raw = bytes(np.asarray(as_tensor(x)._data).astype(np.uint8))
+    img = Image.open(io.BytesIO(raw))
+    if mode in ("rgb", "RGB"):
+        img = img.convert("RGB")
+    elif mode in ("gray", "grayscale", "L"):
+        img = img.convert("L")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None, :, :]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+__all__ += ["matrix_nms", "psroi_pool", "generate_proposals", "read_file",
+            "decode_jpeg"]

@@ -1,0 +1,146 @@
+"""Round-3 breadth batch 2: vision.ops (matrix_nms/psroi_pool/
+generate_proposals/read_file/decode_jpeg), text datasets, audio
+backends + datasets."""
+
+import os
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+class TestVisionOpsLongTail:
+    def test_matrix_nms_decays_overlaps(self):
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11],
+                           [50, 50, 60, 60]]], np.float32)
+        scores = np.array([[[0.9, 0.8, 0.7]]], np.float32)
+        out, num = V.matrix_nms(
+            paddle.to_tensor(boxes), paddle.to_tensor(scores),
+            score_threshold=0.05, post_threshold=0.1, nms_top_k=3,
+            keep_top_k=3, background_label=-1)
+        o = np.asarray(out.numpy())
+        assert o.shape[1] == 6 and int(num.numpy()[0]) >= 2
+        # the heavy overlap decayed below the isolated box's score
+        by_score = sorted(o[:, 1], reverse=True)
+        assert by_score == list(o[:, 1])
+        overlap_row = o[np.isclose(o[:, 2], 1.0)]
+        if len(overlap_row):
+            assert overlap_row[0, 1] < 0.8  # decayed from its raw 0.8
+
+    def test_psroi_pool_position_sensitive(self):
+        x = np.zeros((1, 8, 6, 6), np.float32)
+        for ch in range(8):
+            x[0, ch] = ch
+        rois = np.array([[0, 0, 6, 6]], np.float32)
+        out = V.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                           paddle.to_tensor(np.array([1], np.int32)), 2)
+        # bin (i, j) reads channel block (i*pw + j): constants 0,2,4,6
+        np.testing.assert_allclose(np.asarray(out.numpy())[0, 0],
+                                   [[0, 2], [4, 6]], atol=1e-5)
+
+    def test_psroi_pool_batched_rois_read_their_image(self):
+        x = np.zeros((2, 4, 4, 4), np.float32)
+        x[1] = 10.0                    # image 1 is constant 10
+        rois = np.array([[0, 0, 4, 4], [0, 0, 4, 4]], np.float32)
+        out = V.psroi_pool(paddle.to_tensor(x), paddle.to_tensor(rois),
+                           paddle.to_tensor(np.array([1, 1], np.int32)),
+                           2)
+        o = np.asarray(out.numpy())
+        assert np.allclose(o[0], 0.0) and np.allclose(o[1], 10.0)
+
+    def test_generate_proposals_shapes(self):
+        rng = np.random.RandomState(0)
+        sc = rng.rand(1, 3, 4, 4).astype(np.float32)
+        bd = (rng.randn(1, 12, 4, 4) * 0.1).astype(np.float32)
+        anchors = rng.rand(48, 4).astype(np.float32) * 20
+        anchors[:, 2:] += anchors[:, :2] + 5
+        var = np.ones((48, 4), np.float32)
+        rois, rsc, num = V.generate_proposals(
+            paddle.to_tensor(sc), paddle.to_tensor(bd),
+            paddle.to_tensor(np.array([[64., 64.]], np.float32)),
+            paddle.to_tensor(anchors), paddle.to_tensor(var),
+            pre_nms_top_n=20, post_nms_top_n=5, nms_thresh=0.7)
+        assert rois.shape[0] == int(num.numpy()[0]) == rsc.shape[0]
+        assert rois.shape[0] <= 5
+        r = np.asarray(rois.numpy())
+        assert (r[:, 2] >= r[:, 0]).all() and (r[:, 3] >= r[:, 1]).all()
+
+    def test_read_decode_jpeg(self):
+        import io
+        from PIL import Image
+        rng = np.random.RandomState(1)
+        arr = (rng.rand(8, 9, 3) * 255).astype(np.uint8)
+        buf = io.BytesIO()
+        Image.fromarray(arr).save(buf, format="JPEG")
+        p = os.path.join(tempfile.mkdtemp(), "x.jpg")
+        with open(p, "wb") as f:
+            f.write(buf.getvalue())
+        dec = V.decode_jpeg(V.read_file(p), mode="rgb")
+        assert list(dec.shape) == [3, 8, 9]
+
+
+class TestTextDatasets:
+    def test_generate_splits(self):
+        from paddle_tpu.text import Conll05st, Movielens, WMT14, WMT16
+        c = Conll05st(backend="generate")
+        toks, pred, tags = c[0]
+        assert toks.dtype == np.int64 and 0 <= pred < len(toks)
+        m = Movielens(backend="generate", mode="test")
+        u, mv, r = m[0]
+        assert 1.0 <= float(r) <= 5.0
+        for cls in (WMT14, WMT16):
+            d = cls(backend="generate", mode="dev")
+            src, tin, tout = d[0]
+            assert tin[0] == 0 and tout[-1] == 1
+            np.testing.assert_array_equal(tin[1:], tout[:-1])
+
+    def test_movielens_parses_local_file(self):
+        p = os.path.join(tempfile.mkdtemp(), "ratings.dat")
+        with open(p, "w") as f:
+            for i in range(20):
+                f.write(f"{i % 5}::{i % 7}::{1 + i % 5}::0\n")
+        from paddle_tpu.text import Movielens
+        d = Movielens(data_file=p, mode="train", test_ratio=0.25)
+        assert len(d) == 15
+
+
+class TestAudioBackends:
+    def test_wav_round_trip(self):
+        d = tempfile.mkdtemp()
+        p = os.path.join(d, "t.wav")
+        x = np.sin(np.linspace(0, 20, 1600)).astype(np.float32)[None, :]
+        paddle.audio.save(p, x, 16000)
+        ai = paddle.audio.info(p)
+        assert (ai.sample_rate, ai.num_channels,
+                ai.num_samples) == (16000, 1, 1600)
+        wav, sr = paddle.audio.load(p)
+        assert sr == 16000
+        np.testing.assert_allclose(np.asarray(wav.numpy()), x, atol=2e-4)
+        # stereo + offset window
+        x2 = np.stack([x[0], -x[0]])
+        paddle.audio.save(p, x2, 8000)
+        w2, _ = paddle.audio.load(p, frame_offset=100, num_frames=50)
+        assert list(w2.shape) == [2, 50]
+
+    def test_wav_8bit_unsigned(self):
+        d = tempfile.mkdtemp()
+        p = os.path.join(d, "t8.wav")
+        silence = np.zeros((1, 64), np.float32)
+        paddle.audio.save(p, silence, 8000, bits_per_sample=8)
+        import wave
+        with wave.open(p, "rb") as w:     # spec: 8-bit silence is 0x80
+            frames = np.frombuffer(w.readframes(64), np.uint8)
+        assert (frames == 128).all()
+        wav, _ = paddle.audio.load(p)
+        np.testing.assert_allclose(np.asarray(wav.numpy()), silence,
+                                   atol=1 / 127)
+
+    def test_datasets_generate(self):
+        t = paddle.audio.datasets.TESS(backend="generate")
+        e = paddle.audio.datasets.ESC50(backend="generate", mode="test")
+        wav, label = t[0]
+        assert wav.dtype == np.float32 and wav.ndim == 1
+        assert len({int(t[i][1]) for i in range(14)}) == 7
+        assert len(e) == 50
