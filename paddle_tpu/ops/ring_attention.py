@@ -184,12 +184,26 @@ def ulysses_attention(q, k, v, axis_name, causal=False, scale=None,
     ks = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vs = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
     if attn_fn is None:
-        out = _exact_attention(qs, ks, vs, causal, scale)
-    else:
-        out = attn_fn(qs, ks, vs, causal, scale)
+        attn_fn = _default_attn_fn()
+    out = attn_fn(qs, ks, vs, causal, scale)
     # [B, S, H/n, D] -> [B, S/n, H, D]
     return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2,
                           tiled=True)
+
+
+def _default_attn_fn():
+    """Ulysses local-attention default: the Pallas flash kernel on TPU
+    (O(S) memory — the whole point of SEP long-context), exact fp32
+    softmax elsewhere (CPU tests / oracle)."""
+    import jax as _jax
+
+    if _jax.devices()[0].platform != "tpu":
+        return _exact_attention
+
+    def flash(qs, ks, vs, causal, scale):
+        from .pallas.flash_attention import flash_attention
+        return flash_attention(qs, ks, vs, causal=causal, scale=scale)
+    return flash
 
 
 def _exact_attention(q, k, v, causal, scale):
