@@ -5,9 +5,12 @@ MoE layers."""
 from . import nn
 from . import autograd
 from . import asp
+from . import optimizer
 from .nn import functional
+from .optimizer import LookAhead, ModelAverage
 
-__all__ = ["nn", "autograd", "functional", "softmax_mask_fuse",
+__all__ = ["nn", "autograd", "functional", "optimizer", "LookAhead",
+           "ModelAverage", "softmax_mask_fuse",
            "graph_send_recv", "segment_sum", "segment_mean", "segment_max",
            "segment_min"]
 

@@ -98,3 +98,26 @@ def to_list(value):
 __all__ = ["deprecated", "try_import", "run_check", "unique_name", "dlpack",
            "download", "cpp_extension", "flatten", "pack_sequence_as",
            "to_list"]
+
+
+def require_version(min_version, max_version=None):
+    """paddle.utils.require_version — assert the installed framework
+    version falls in [min_version, max_version]."""
+    from .. import version as _v
+
+    def parse(s):
+        return tuple(int(x) for x in str(s).split(".")[:3] if x.isdigit())
+
+    cur = parse(_v.full_version)
+    if parse(min_version) > cur:
+        raise Exception(
+            f"paddle_tpu version {_v.full_version} < required "
+            f"{min_version}")
+    if max_version is not None and parse(max_version) < cur:
+        raise Exception(
+            f"paddle_tpu version {_v.full_version} > allowed "
+            f"{max_version}")
+    return True
+
+
+__all__ += ["require_version"]

@@ -235,3 +235,37 @@ class TestNewOptimizers:
             return np.asarray(w.numpy())
 
         np.testing.assert_allclose(run(True), run(False), rtol=1e-6)
+
+
+class TestIncubateOptimizers:
+    def test_lookahead_pulls_toward_slow(self):
+        paddle.seed(0)
+        w = paddle.to_tensor(np.zeros(4, np.float32), stop_gradient=False)
+        w.persistable = True
+        target = np.ones(4, np.float32) * 3
+        inner = paddle.optimizer.SGD(0.2, parameters=[w])
+        opt = paddle.incubate.LookAhead(inner, alpha=0.5, k=2)
+        losses = []
+        for _ in range(20):
+            diff = w - paddle.to_tensor(target)
+            loss = (diff * diff).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss.item()))
+        assert losses[-1] < losses[0] * 0.05
+        sd = opt.state_dict()
+        assert "@lookahead_step" in sd
+
+    def test_model_average_apply_restore(self):
+        w = paddle.to_tensor(np.zeros(2, np.float32), stop_gradient=False)
+        w.persistable = True
+        ma = paddle.incubate.ModelAverage(parameters=[w])
+        for v in (1.0, 2.0, 3.0):
+            w.set_data(np.full(2, v, np.float32))
+            ma.step()
+        live = np.asarray(w.numpy()).copy()
+        with ma.apply():
+            np.testing.assert_allclose(np.asarray(w.numpy()),
+                                       [2.0, 2.0])  # mean of 1,2,3
+        np.testing.assert_allclose(np.asarray(w.numpy()), live)  # restored

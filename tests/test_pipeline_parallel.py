@@ -293,12 +293,15 @@ def test_fleet_schedule_mode_parity(schedule_mode):
 # config 4's workload shape) — the pp axis no longer runs in isolation
 # --------------------------------------------------------------------------
 
-def test_hybrid_4d_pipeline_llama_parity():
+@pytest.mark.parametrize("schedule", ["FThenB", "1F1B", "ZB-H1"])
+def test_hybrid_4d_pipeline_llama_parity(schedule):
     """dp1 x sharding2 x pp2 x mp2 over 8 devices in ONE compiled pipeline
-    program: stage weights stacked over 'pipe' while each stage's TP
-    linears stay 'model'-sharded and optimizer state is ZeRO-sharded over
-    'sharding'. Oracle: multi-step loss parity vs the single-device eager
-    model (SURVEY.md §4's key parallelism oracle)."""
+    program — under EVERY schedule (compiled FThenB scan AND the
+    explicit-table 1F1B / ZB-H1 engines): stage weights stacked over
+    'pipe' while each stage's TP linears stay 'model'-sharded and
+    optimizer state is ZeRO-sharded over 'sharding'. Oracle: multi-step
+    loss parity vs the single-device eager model (SURVEY.md §4's key
+    parallelism oracle)."""
     from jax.sharding import NamedSharding, PartitionSpec
     from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
                                    LlamaForCausalLMPipe)
@@ -332,7 +335,7 @@ def test_hybrid_4d_pipeline_llama_parity():
                                "pp_degree": 2, "sharding_degree": 2,
                                "sep_degree": 1, "ep_degree": 1}
     strategy.pipeline_configs = {"accumulate_steps": 2,
-                                 "schedule_mode": "FThenB"}
+                                 "schedule_mode": schedule}
     fleet.init(is_collective=True, strategy=strategy)
     try:
         hcg = fleet.get_hybrid_communicate_group()
