@@ -75,6 +75,7 @@ def test_mixed_length_streams_more_requests_than_slots():
     assert not eng.active.any()
 
 
+@pytest.mark.slow
 def test_eos_stops_stream_early():
     model, cfg = _model()
     rng = np.random.RandomState(2)
@@ -91,6 +92,7 @@ def test_eos_stops_stream_early():
     assert req.tokens == ref[:4], (req.tokens, ref)
 
 
+@pytest.mark.slow
 def test_oversized_prompt_uses_exact_bucket():
     """A prompt longer than every configured bucket must still serve
     (its own exact-length prefill signature), not crash at admission."""
