@@ -144,3 +144,29 @@ class TestAudioBackends:
         assert wav.dtype == np.float32 and wav.ndim == 1
         assert len({int(t[i][1]) for i in range(14)}) == 7
         assert len(e) == 50
+
+
+class TestYoloLoss:
+    def test_yolo_loss_trains_head_toward_targets(self):
+        rng = np.random.RandomState(0)
+        N, H, W, C, m = 1, 4, 4, 3, 3
+        anchors = [10, 13, 16, 30, 33, 23]
+        x = paddle.to_tensor((rng.randn(N, m * (5 + C), H, W) * 0.1)
+                             .astype(np.float32))
+        x.stop_gradient = False
+        gt_box = np.array([[[0.5, 0.5, 0.25, 0.4]]], np.float32)
+        gt_label = np.array([[1]], np.int64)
+        gb, gl = paddle.to_tensor(gt_box), paddle.to_tensor(gt_label)
+        from paddle_tpu.vision import ops as V
+
+        losses = []
+        for _ in range(60):
+            loss = V.yolo_loss(x, gb, gl, anchors, [0, 1, 2], C,
+                               ignore_thresh=0.7, downsample_ratio=8)
+            s = loss.sum()
+            s.backward()
+            x.set_data(x._data - 0.05 * x.grad._data)
+            x.clear_grad()
+            losses.append(float(s.item()))
+        assert losses[-1] < losses[0] * 0.5, losses[::12]
+        assert all(np.isfinite(v) for v in losses)
