@@ -336,3 +336,263 @@ class RandomErasing:
                 j = np.random.randint(0, w - ew + 1)
                 return F.erase(img, i, j, eh, ew, self.value, self.inplace)
         return img
+
+
+class RandomAffine:
+    """Random rotation/translation/scale/shear (transform parity)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        self.degrees = (-degrees, degrees) if isinstance(
+            degrees, (int, float)) else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def __call__(self, img):
+        arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        h, w = arr.shape[:2]
+        angle = np.random.uniform(*self.degrees)
+        tx = ty = 0
+        if self.translate is not None:
+            tx = int(np.random.uniform(-self.translate[0],
+                                       self.translate[0]) * w)
+            ty = int(np.random.uniform(-self.translate[1],
+                                       self.translate[1]) * h)
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        # shear accepts scalar s (x in [-s, s]), [lo, hi] (x range), or
+        # [xlo, xhi, ylo, yhi] (paddle/torchvision forms)
+        if self.shear is None:
+            sh = 0.0
+        elif isinstance(self.shear, (int, float)):
+            sh = np.random.uniform(-self.shear, self.shear)
+        elif len(self.shear) == 2:
+            sh = np.random.uniform(self.shear[0], self.shear[1])
+        else:
+            sh = (np.random.uniform(self.shear[0], self.shear[1]),
+                  np.random.uniform(self.shear[2], self.shear[3]))
+        return F.affine(img, angle, (tx, ty), sc, sh,
+                        self.interpolation, self.fill, self.center)
+
+
+class RandomPerspective:
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        dx = int(d * w / 2)
+        dy = int(d * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                np.random.randint(0, dy + 1)),
+               (w - 1 - np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1)),
+               (np.random.randint(0, dx + 1),
+                h - 1 - np.random.randint(0, dy + 1))]
+        return F.perspective(img, start, end, self.interpolation,
+                             self.fill)
+
+
+class GaussianBlur:
+    def __init__(self, kernel_size=3, sigma=(0.1, 2.0), keys=None):
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+
+    def __call__(self, img):
+        s = np.random.uniform(*self.sigma) if isinstance(
+            self.sigma, (list, tuple)) else self.sigma
+        return F.gaussian_blur(img, self.kernel_size, s)
+
+
+class _RandomPhotometric:
+    op = None
+
+    def __init__(self, prob=0.5, keys=None, **kw):
+        self.prob = prob
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        return self._apply(img)
+
+
+class RandomInvert(_RandomPhotometric):
+    def _apply(self, img):
+        return F.invert(img)
+
+
+class RandomPosterize(_RandomPhotometric):
+    def __init__(self, bits=4, prob=0.5, keys=None):
+        super().__init__(prob)
+        self.bits = bits
+
+    def _apply(self, img):
+        return F.posterize(img, self.bits)
+
+
+class RandomSolarize(_RandomPhotometric):
+    def __init__(self, threshold=128, prob=0.5, keys=None):
+        super().__init__(prob)
+        self.threshold = threshold
+
+    def _apply(self, img):
+        return F.solarize(img, self.threshold)
+
+
+class RandomAdjustSharpness(_RandomPhotometric):
+    def __init__(self, sharpness_factor=2.0, prob=0.5, keys=None):
+        super().__init__(prob)
+        self.factor = sharpness_factor
+
+    def _apply(self, img):
+        return F.adjust_sharpness(img, self.factor)
+
+
+def _aug_op(name, img, mag):
+    """One augmentation primitive at signed magnitude ``mag``."""
+    if name == "identity":
+        return img
+    if name == "shear_x":
+        return F.affine(img, 0, (0, 0), 1.0, np.degrees(np.arctan(mag)))
+    if name == "shear_y":
+        return F.affine(img, 0, (0, 0), 1.0, (0.0, np.degrees(
+            np.arctan(mag))))
+    if name == "translate_x":
+        w = np.asarray(img._data if isinstance(img, Tensor)
+                       else img).shape[1]
+        return F.affine(img, 0, (int(mag * w), 0), 1.0, 0.0)
+    if name == "translate_y":
+        h = np.asarray(img._data if isinstance(img, Tensor)
+                       else img).shape[0]
+        return F.affine(img, 0, (0, int(mag * h)), 1.0, 0.0)
+    if name == "rotate":
+        return F.affine(img, mag, (0, 0), 1.0, 0.0)
+    if name == "brightness":
+        return F.adjust_brightness(img, 1.0 + mag)
+    if name == "contrast":
+        return F.adjust_contrast(img, 1.0 + mag)
+    if name == "color":
+        return F.adjust_saturation(img, 1.0 + mag)
+    if name == "sharpness":
+        return F.adjust_sharpness(img, 1.0 + mag)
+    if name == "posterize":
+        return F.posterize(img, max(1, int(8 - abs(mag))))
+    if name == "solarize":
+        return F.solarize(img, int(256 - abs(mag)))
+    if name == "equalize":
+        arr = np.asarray(img._data if isinstance(img, Tensor) else img)
+        if arr.dtype != np.uint8:
+            return img
+        return F.equalize(img)
+    if name == "invert":
+        return F.invert(img)
+    return img
+
+
+_RANDAUG_SPACE = [
+    ("identity", 0.0), ("shear_x", 0.3), ("shear_y", 0.3),
+    ("translate_x", 0.45), ("translate_y", 0.45), ("rotate", 30.0),
+    ("brightness", 0.9), ("contrast", 0.9), ("color", 0.9),
+    ("sharpness", 0.9), ("posterize", 4.0), ("solarize", 256.0),
+    ("equalize", 0.0),
+]
+
+
+class RandAugment:
+    """RandAugment (Cubuk et al.): ``num_ops`` random ops at shared
+    ``magnitude`` out of ``num_magnitude_bins`` (paddle parity)."""
+
+    def __init__(self, num_ops=2, magnitude=9, num_magnitude_bins=31,
+                 interpolation="nearest", fill=0, keys=None):
+        self.num_ops = int(num_ops)
+        self.magnitude = int(magnitude)
+        self.bins = int(num_magnitude_bins)
+
+    def __call__(self, img):
+        for _ in range(self.num_ops):
+            name, max_mag = _RANDAUG_SPACE[
+                np.random.randint(len(_RANDAUG_SPACE))]
+            frac = self.magnitude / max(self.bins - 1, 1)
+            mag = max_mag * frac
+            if name in ("shear_x", "shear_y", "translate_x",
+                        "translate_y", "rotate", "brightness",
+                        "contrast", "color", "sharpness"):
+                if np.random.rand() < 0.5:
+                    mag = -mag
+            img = _aug_op(name, img, mag)
+        return img
+
+
+# (op, probability, magnitude) triples — the ImageNet AutoAugment policy
+_AA_IMAGENET = [
+    (("posterize", 0.4, 8), ("rotate", 0.6, 9)),
+    (("solarize", 0.6, 5), ("equalize", 0.6, 0)),
+    (("equalize", 0.8, 0), ("equalize", 0.6, 0)),
+    (("posterize", 0.6, 7), ("posterize", 0.6, 6)),
+    (("equalize", 0.4, 0), ("solarize", 0.2, 4)),
+    (("equalize", 0.4, 0), ("rotate", 0.8, 8)),
+    (("solarize", 0.6, 3), ("equalize", 0.6, 0)),
+    (("posterize", 0.8, 5), ("equalize", 1.0, 0)),
+    (("rotate", 0.2, 3), ("solarize", 0.6, 8)),
+    (("equalize", 0.6, 0), ("posterize", 0.4, 6)),
+    (("rotate", 0.8, 8), ("color", 0.4, 0)),
+    (("rotate", 0.4, 9), ("equalize", 0.6, 0)),
+    (("equalize", 0.0, 0), ("equalize", 0.8, 0)),
+    (("invert", 0.6, 0), ("equalize", 1.0, 0)),
+    (("color", 0.6, 4), ("contrast", 1.0, 8)),
+]
+
+
+class AutoAugment:
+    """AutoAugment with the ImageNet policy (paddle parity: policy
+    subpolicies of two (op, prob, magnitude) steps)."""
+
+    def __init__(self, policy="imagenet", interpolation="nearest",
+                 fill=0, keys=None):
+        if policy != "imagenet":
+            import warnings
+            warnings.warn(f"AutoAugment policy {policy!r} not available; "
+                          "using the imagenet policy")
+        self.policy = _AA_IMAGENET
+
+    def __call__(self, img):
+        sub = self.policy[np.random.randint(len(self.policy))]
+        for name, prob, mag_bin in sub:
+            if np.random.rand() > prob:
+                continue
+            max_mag = dict(_RANDAUG_SPACE).get(name, 0.0)
+            mag = max_mag * mag_bin / 10.0
+            # signed magnitude for every geometric AND enhance op
+            # (torchvision/paddle convention: factor = 1 ± 0.9*m/10 —
+            # the weakening side must be reachable)
+            if name in ("rotate", "shear_x", "shear_y", "translate_x",
+                        "translate_y", "brightness", "contrast",
+                        "color", "sharpness") and np.random.rand() < 0.5:
+                mag = -mag
+            # _aug_op's posterize/solarize take the REDUCTION amount
+            # (bits = 8-|mag|, threshold = 256-|mag|)
+            if name == "posterize":
+                mag = mag_bin * 4 / 10.0
+            if name == "solarize":
+                mag = mag_bin * 256 / 10.0
+            img = _aug_op(name, img, mag)
+        return img
+
+
+__all__ += ["RandomAffine", "RandomPerspective", "GaussianBlur",
+            "RandomInvert", "RandomPosterize", "RandomSolarize",
+            "RandomAdjustSharpness", "RandAugment", "AutoAugment"]

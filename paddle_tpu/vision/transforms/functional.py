@@ -226,3 +226,200 @@ def erase(img, i, j, h, w, v, inplace=False):
     else:  # HWC or 2-D
         arr[i:i + h, j:j + w] = v
     return _like(arr, img)
+
+
+def _ensure_hwc(arr):
+    """uint8/float HWC with an explicit channel dim; returns (a3, had_c)."""
+    if arr.ndim == 2:
+        return arr[:, :, None], False
+    return arr, True
+
+
+def _restore(out, arr, had_c, img):
+    """Exit twin of _ensure_hwc: restore dtype (rounding uint8) and the
+    original channel layout, rewrap in the caller's container."""
+    if arr.dtype == np.uint8:
+        out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+    else:
+        out = out.astype(arr.dtype)
+    if not had_c:
+        out = out[..., 0]
+    return _like(out, img)
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine-warp an HWC image (paddle.vision.transforms.functional
+    parity): rotate by ``angle`` deg about ``center``, then shear,
+    scale, translate. Inverse-warp via scipy.ndimage."""
+    import math
+
+    from scipy import ndimage
+
+    arr = _np(img)
+    a3, had_c = _ensure_hwc(arr)
+    h, w = a3.shape[:2]
+    cy, cx = ((h - 1) * 0.5, (w - 1) * 0.5) if center is None else \
+        (center[1], center[0])
+    rot = math.radians(angle)
+    sx = math.radians(shear[0] if isinstance(shear, (list, tuple))
+                      else shear)
+    sy = math.radians(shear[1] if isinstance(shear, (list, tuple))
+                      and len(shear) > 1 else 0.0)
+    # forward matrix in (x, y): R @ Shear @ Scale
+    a = scale * (math.cos(rot + sy) / math.cos(sy))
+    b = scale * (math.cos(rot + sy) * math.tan(sx) / math.cos(sy)
+                 - math.sin(rot))
+    c = scale * (math.sin(rot + sy) / math.cos(sy))
+    d = scale * (math.sin(rot + sy) * math.tan(sx) / math.cos(sy)
+                 + math.cos(rot))
+    fwd = np.array([[a, b], [c, d]], np.float64)
+    inv = np.linalg.inv(fwd)
+    tx, ty = (translate if translate is not None else (0, 0))
+    # output (x,y) -> input: inv @ (p - center - t) + center
+    offset_xy = np.array([cx + tx, cy + ty])
+    order = 1 if interpolation in ("bilinear", "linear") else 0
+    # scipy works in (row, col) = (y, x): build the matching matrix
+    inv_rc = inv[::-1, ::-1]
+    off_rc = np.array([cy, cx]) - inv_rc @ np.array([offset_xy[1],
+                                                     offset_xy[0]])
+    out = np.stack([
+        ndimage.affine_transform(a3[..., ch].astype(np.float32), inv_rc,
+                                 offset=off_rc, order=order,
+                                 mode="constant", cval=float(
+                                     fill[ch] if isinstance(
+                                         fill, (list, tuple)) else fill))
+        for ch in range(a3.shape[2])], axis=-1)
+    return _restore(out, arr, had_c, img)
+
+
+def _perspective_coeffs(startpoints, endpoints):
+    """8 homography coefficients mapping endpoints -> startpoints."""
+    mat = []
+    for (ex, ey), (sx_, sy_) in zip(endpoints, startpoints):
+        mat.append([ex, ey, 1, 0, 0, 0, -sx_ * ex, -sx_ * ey])
+        mat.append([0, 0, 0, ex, ey, 1, -sy_ * ex, -sy_ * ey])
+    a_mat = np.asarray(mat, np.float64)
+    b_vec = np.asarray([c for p in startpoints for c in p], np.float64)
+    return np.linalg.lstsq(a_mat, b_vec, rcond=None)[0]
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective-warp: the quad ``startpoints`` maps to ``endpoints``."""
+    from scipy import ndimage
+
+    arr = _np(img)
+    a3, had_c = _ensure_hwc(arr)
+    h, w = a3.shape[:2]
+    co = _perspective_coeffs(startpoints, endpoints)
+    yy, xx = np.mgrid[0:h, 0:w].astype(np.float64)
+    denom = co[6] * xx + co[7] * yy + 1.0
+    src_x = (co[0] * xx + co[1] * yy + co[2]) / denom
+    src_y = (co[3] * xx + co[4] * yy + co[5]) / denom
+
+    def _snap(v, hi):
+        # lstsq noise can push border coordinates epsilon outside the
+        # domain, which scipy's constant mode would blank to cval
+        v = np.where((v > -1e-6) & (v < 0), 0.0, v)
+        return np.where((v > hi) & (v < hi + 1e-6), hi, v)
+    src_x = _snap(src_x, w - 1.0)
+    src_y = _snap(src_y, h - 1.0)
+    order = 1 if interpolation in ("bilinear", "linear") else 0
+    out = np.stack([
+        ndimage.map_coordinates(a3[..., ch].astype(np.float32),
+                                [src_y, src_x], order=order,
+                                mode="constant", cval=float(
+                                    fill[ch] if isinstance(
+                                        fill, (list, tuple)) else fill))
+        for ch in range(a3.shape[2])], axis=-1)
+    return _restore(out, arr, had_c, img)
+
+
+def _peak(arr):
+    return 255.0 if arr.dtype == np.uint8 else 1.0
+
+
+def invert(img):
+    arr = _np(img)
+    return _like((_peak(arr) - arr).astype(arr.dtype), img)
+
+
+def posterize(img, bits):
+    arr = _np(img)
+    if arr.dtype != np.uint8:
+        raise ValueError("posterize expects a uint8 image")
+    mask = 255 - (2 ** (8 - int(bits)) - 1)
+    return _like((arr & mask).astype(np.uint8), img)
+
+
+def solarize(img, threshold):
+    arr = _np(img)
+    peak = _peak(arr)
+    return _like(np.where(arr >= threshold, peak - arr,
+                          arr).astype(arr.dtype), img)
+
+
+def adjust_sharpness(img, sharpness_factor):
+    """PIL-convention sharpness: blend with a 3x3 smoothed copy;
+    factor 0 = smoothed, 1 = original, >1 = sharpened."""
+    from scipy import ndimage
+
+    arr = _np(img)
+    a3, had_c = _ensure_hwc(arr)
+    kernel = np.array([[1, 1, 1], [1, 5, 1], [1, 1, 1]], np.float32) / 13
+    smooth = np.stack([
+        ndimage.convolve(a3[..., ch].astype(np.float32), kernel,
+                         mode="nearest")
+        for ch in range(a3.shape[2])], axis=-1)
+    # PIL keeps the 1px border of the original
+    sm = a3.astype(np.float32).copy()
+    sm[1:-1, 1:-1] = smooth[1:-1, 1:-1]
+    out = sm + float(sharpness_factor) * (a3.astype(np.float32) - sm)
+    return _restore(out, arr, had_c, img)
+
+
+def gaussian_blur(img, kernel_size, sigma=None):
+    from scipy import ndimage
+
+    arr = _np(img)
+    a3, had_c = _ensure_hwc(arr)
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    if sigma is None:
+        sigma = tuple(0.3 * ((k - 1) * 0.5 - 1) + 0.8
+                      for k in kernel_size)
+    elif isinstance(sigma, (int, float)):
+        sigma = (float(sigma), float(sigma))
+    out = np.stack([
+        ndimage.gaussian_filter(a3[..., ch].astype(np.float32),
+                                sigma=sigma[::-1], mode="nearest")
+        for ch in range(a3.shape[2])], axis=-1)
+    return _restore(out, arr, had_c, img)
+
+
+def equalize(img):
+    """Per-channel histogram equalization (PIL convention; uint8 only)."""
+    arr = _np(img)
+    if arr.dtype != np.uint8:
+        raise ValueError("equalize expects a uint8 image")
+    a3, had_c = _ensure_hwc(arr)
+    out = a3.copy()
+    flat = out.reshape(-1, out.shape[-1])
+    for ch in range(flat.shape[1]):
+        hist = np.bincount(flat[:, ch], minlength=256)
+        cdf = hist.cumsum()
+        nz = cdf[cdf > 0]
+        if nz.size == 0:
+            continue
+        lut = np.clip((cdf - nz[0]) * 255.0 / max(cdf[-1] - nz[0], 1),
+                      0, 255).astype(np.uint8)
+        flat[:, ch] = lut[flat[:, ch]]
+    out = flat.reshape(a3.shape)
+    if not had_c:
+        out = out[..., 0]
+    return _like(out, img)
+
+
+__all__ += ["affine", "perspective", "invert", "posterize", "solarize",
+            "adjust_sharpness", "gaussian_blur", "equalize"]

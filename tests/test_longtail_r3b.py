@@ -170,3 +170,47 @@ class TestYoloLoss:
             losses.append(float(s.item()))
         assert losses[-1] < losses[0] * 0.5, losses[::12]
         assert all(np.isfinite(v) for v in losses)
+
+
+class TestAugmentTransforms:
+    def test_functional_identities_and_oracles(self):
+        from paddle_tpu.vision.transforms import functional as TF
+        rng = np.random.RandomState(0)
+        img = (rng.rand(16, 20, 3) * 255).astype(np.uint8)
+        np.testing.assert_array_equal(
+            TF.affine(img, 0, (0, 0), 1.0, 0.0), img)
+        out = TF.affine(img.astype(np.float32), 0, (2, 3), 1.0, 0.0,
+                        "bilinear")
+        np.testing.assert_allclose(out[4, 5],
+                                   img[1, 3].astype(np.float32),
+                                   atol=1e-3)
+        sq = (rng.rand(9, 9) * 255).astype(np.uint8)
+        r90 = TF.affine(sq, 90, (0, 0), 1.0, 0.0)
+        assert (np.array_equal(r90, np.rot90(sq, 1))
+                or np.array_equal(r90, np.rot90(sq, -1)))
+        start = [(0, 0), (19, 0), (19, 15), (0, 15)]
+        np.testing.assert_array_equal(
+            TF.perspective(img, start, start), img)
+        np.testing.assert_array_equal(TF.invert(img), 255 - img)
+        np.testing.assert_array_equal(TF.posterize(img, 4), img & 0xF0)
+        sol = TF.solarize(img, 128)
+        np.testing.assert_array_equal(sol[img >= 128],
+                                      (255 - img)[img >= 128])
+        np.testing.assert_allclose(TF.adjust_sharpness(img, 1.0), img,
+                                   atol=1)
+        assert TF.gaussian_blur(img, 5, 2.0).std() < img.std()
+
+    def test_augment_classes_preserve_shape(self):
+        import paddle_tpu.vision.transforms as T
+        rng = np.random.RandomState(1)
+        img = (rng.rand(12, 14, 3) * 255).astype(np.uint8)
+        np.random.seed(7)
+        for t in [T.RandomAffine(10, translate=(0.1, 0.1)),
+                  T.RandomPerspective(1.0, 0.3), T.GaussianBlur(3),
+                  T.RandomInvert(1.0), T.RandomPosterize(4, 1.0),
+                  T.RandomSolarize(128, 1.0),
+                  T.RandomAdjustSharpness(2.0, 1.0),
+                  T.RandAugment(), T.AutoAugment()]:
+            o = t(img)
+            assert np.asarray(o).shape == img.shape, type(t).__name__
+            assert np.asarray(o).dtype == np.uint8, type(t).__name__
