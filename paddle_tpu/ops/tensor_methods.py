@@ -163,11 +163,37 @@ def install_tensor_methods() -> None:
     T.uniform_ = random_ops.uniform_
     T.normal_ = random_ops.normal_
     T.exponential_ = random_ops.exponential_
+    T.log_normal_ = _log_normal_
+    T.apply_ = _apply_
+    T.apply = lambda self, func: func(self)
+    T.nbytes = property(lambda self: int(
+        self._data.size * self._data.dtype.itemsize))
+    # jax arrays are always dense row-major (XLA owns layout)
+    T.is_contiguous = lambda self: True
+    T.contiguous = lambda self: self
+    T.coalesce = lambda self, name=None: self  # dense tensors: identity
 
 
 def _inplace_nograd(t: Tensor, data) -> Tensor:
     t.set_data(data)
     return t
+
+
+def _log_normal_(self, mean=1.0, std=2.0, name=None):
+    """In-place log-normal fill: exp(N(mean, std)) (paddle parity)."""
+    from ..framework import random as fr
+    key = fr.default_generator.next_key()
+    import jax
+    draw = jax.random.normal(key, self._data.shape) * std + mean
+    return _inplace_nograd(self, jnp.exp(draw).astype(self._data.dtype))
+
+
+def _apply_(self, func):
+    """In-place elementwise python-function map (paddle Tensor.apply_):
+    func receives and returns a Tensor; the result overwrites self."""
+    out = func(self)
+    data = out._data if isinstance(out, Tensor) else jnp.asarray(out)
+    return _inplace_nograd(self, data.astype(self._data.dtype))
 
 
 def _copy_(self, other, blocking=True):
