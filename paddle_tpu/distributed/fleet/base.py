@@ -140,6 +140,10 @@ class Fleet:
                     "accumulate_steps", 1)
             return PipelineParallel(model, self._hcg, accum,
                                     strategy=self._strategy)
+        if self._strategy is not None and self._strategy.amp:
+            # the reference's AMP meta-optimizer rewrites the program;
+            # here the same contract is an auto_cast-wrapped forward
+            return _AmpModelWrapper(model, self._strategy.amp_configs)
         return model
 
     def distributed_optimizer(self, optimizer, strategy=None):
@@ -160,6 +164,32 @@ class Fleet:
 
     def stop_worker(self):
         pass
+
+
+class _AmpModelWrapper:
+    """fleet AMP meta-optimizer role: run the wrapped model's forward
+    under ``amp.auto_cast`` with the strategy's amp_configs."""
+
+    def __init__(self, model, amp_configs):
+        self._model = model
+        cfg = dict(amp_configs or {})
+        self._kw = {
+            "level": cfg.get("level", "O1"),
+            "dtype": cfg.get("dtype", "bfloat16"),
+            "custom_white_list": cfg.get("custom_white_list"),
+            "custom_black_list": cfg.get("custom_black_list"),
+        }
+
+    def __getattr__(self, name):
+        return getattr(self._model, name)
+
+    def __call__(self, *args, **kwargs):
+        from ...amp import auto_cast
+        with auto_cast(True, **self._kw):
+            return self._model(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        return self(*args, **kwargs)
 
 
 fleet = Fleet()

@@ -129,3 +129,29 @@ class TestParallelize:
                         "nonexistent_layer": dist.ColWiseParallel()}}})
         finally:
             self._reset()
+
+
+class TestFleetAmpMetaOptimizer:
+    def test_distributed_model_wraps_forward_in_auto_cast(self):
+        import numpy as np
+        from paddle_tpu import nn
+        from paddle_tpu.distributed import fleet
+        strategy = fleet.DistributedStrategy()
+        strategy.amp = True
+        strategy.amp_configs = {"level": "O1", "dtype": "bfloat16"}
+        fleet.init(strategy=strategy)
+        try:
+            paddle.seed(0)
+            m = nn.Linear(8, 8)
+            dm = fleet.fleet.distributed_model(m)
+            x = paddle.to_tensor(np.ones((2, 8), np.float32))
+            y = dm(x)
+            assert "bfloat16" in str(y._data.dtype)
+            # outside the wrapper the model still computes fp32
+            y2 = m(x)
+            assert "float32" in str(y2._data.dtype)
+            assert dm.parameters() == m.parameters()
+        finally:
+            fleet.fleet._hcg = None
+            fleet.fleet._topology = None
+            fleet.fleet._is_initialized = False
