@@ -957,3 +957,14 @@ def broadcast_shape(x_shape, y_shape):
 
 
 __all__ += ["diagonal_scatter", "broadcast_shape"]
+
+
+def shape(input, name=None):
+    """paddle.shape — the runtime shape as an int32 tensor (static under
+    XLA, so this is a constant in compiled programs)."""
+    x = as_tensor(input)
+    from .creation import to_tensor
+    return to_tensor(np.asarray(x._data.shape, np.int32))
+
+
+__all__ += ["shape"]
