@@ -1,6 +1,8 @@
 from . import functional
 from .layers import (FusedMultiHeadAttention, FusedFeedForward,
-                     FusedTransformerEncoderLayer)
+                     FusedTransformerEncoderLayer, FusedLinear,
+                     FusedBiasDropoutResidualLayerNorm)
 
 __all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
-           "FusedTransformerEncoderLayer"]
+           "FusedTransformerEncoderLayer", "FusedLinear",
+           "FusedBiasDropoutResidualLayerNorm"]

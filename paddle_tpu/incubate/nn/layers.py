@@ -92,3 +92,50 @@ class FusedTransformerEncoderLayer(Layer):
     def forward(self, src, src_mask=None, cache=None):
         out = self.fused_attn(src, attn_mask=src_mask, cache=cache)
         return self.ffn(out)
+
+
+class FusedLinear(Layer):
+    """incubate.nn.FusedLinear parity — one matmul+bias op (XLA fuses)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 bias_attr=None, transpose_weight=False, name=None):
+        super().__init__()
+        self._inner = Linear(in_features, out_features,
+                             weight_attr=weight_attr, bias_attr=bias_attr)
+        self.weight = self._inner.weight
+        self.bias = self._inner.bias
+        self._transpose = transpose_weight
+
+    def forward(self, x):
+        from .functional import fused_linear
+        return fused_linear(x, self.weight, self.bias,
+                            transpose_weight=self._transpose)
+
+
+class FusedBiasDropoutResidualLayerNorm(Layer):
+    """incubate.nn parity: LN(residual + dropout(x + bias))."""
+
+    def __init__(self, embed_dim, dropout_rate=0.5, weight_attr=None,
+                 bias_attr=None, epsilon=1e-5, name=None):
+        super().__init__()
+        from ...nn import initializer as I
+        from ...nn.param_attr import ParamAttr
+        self.embed_dim = embed_dim
+        self.linear_bias = self.create_parameter(
+            [embed_dim], is_bias=True, default_initializer=I.Constant(0.0))
+        self.ln_scale = self.create_parameter(
+            [embed_dim], default_initializer=I.Constant(1.0))
+        self.ln_bias = self.create_parameter(
+            [embed_dim], is_bias=True, default_initializer=I.Constant(0.0))
+        self.dropout_rate = dropout_rate
+        self.epsilon = epsilon
+
+    def forward(self, x, residual):
+        from .functional import fused_bias_dropout_residual_layer_norm
+        return fused_bias_dropout_residual_layer_norm(
+            x, residual, self.linear_bias, self.ln_scale, self.ln_bias,
+            dropout_rate=self.dropout_rate, ln_epsilon=self.epsilon,
+            training=self.training)
+
+
+__all__ += ["FusedLinear", "FusedBiasDropoutResidualLayerNorm"]
