@@ -10,9 +10,12 @@ axes. This module therefore has two modes:
   name — this is the hot path used by the parallel layers and pipeline
   schedules.
 - **Eager mode** (plain dygraph): with one participant they are identity
-  ops (matching single-process paddle); true multi-process *eager*
-  collectives are intentionally not the TPU way (data-plane comm belongs
-  inside the compiled program) and raise with guidance.
+  ops (matching single-process paddle). In a multi-PROCESS job
+  (launcher-spawned ranks / multi-host) eager collectives run
+  host-mediated through the jax.distributed coordination service — the
+  role Gloo plays in the reference's no-GPU path. Eager multi-DEVICE
+  collectives within one process still raise with guidance (data-plane
+  comm belongs inside the compiled program on TPU).
 
 Groups carry a mesh-axis name instead of an NCCL communicator."""
 
@@ -137,12 +140,31 @@ def _single(group) -> bool:
     return not _traced_axis_active(g) and g.nranks <= 1
 
 
+def _multiprocess() -> bool:
+    """True in the N-process world (launcher-spawned CPU simulation or a
+    multi-host pod): each process is one rank, and eager collectives can
+    run host-mediated through the coordination service — the Gloo role."""
+    try:
+        return jax.process_count() > 1
+    except Exception:
+        return False
+
+
+def _process_gather_np(data):
+    """All-gather a process-local array to every process: [P, ...]."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(data), tiled=False))
+
+
 def _raise_eager(op: str):
     raise RuntimeError(
-        f"{op}: eager multi-process collectives are not the TPU data "
+        f"{op}: eager multi-device collectives are not the TPU data "
         "plane. Run this op inside a compiled region over a mesh axis "
         "(shard_map / fleet.distributed_model / to_static), or use "
-        "*_object collectives for host-side control data.")
+        "*_object collectives for host-side control data. (In a "
+        "multi-PROCESS job these ops do run eagerly, host-mediated.)")
 
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -161,6 +183,15 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         tensor._node, tensor._out_idx = out._node, out._out_idx
         return tensor
     if _single(group):
+        return tensor
+    if _multiprocess():
+        import numpy as np
+        gathered = _process_gather_np(tensor._data)   # [P, ...]
+        red = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max,
+               ReduceOp.MIN: np.min, ReduceOp.PROD: np.prod,
+               ReduceOp.AVG: np.mean}[op]
+        tensor.set_data(jnp.asarray(red(gathered, axis=0))
+                        .astype(tensor._data.dtype))
         return tensor
     _raise_eager("all_reduce")
 
@@ -182,6 +213,14 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
             tensor_list.append(tensor)
             return tensor_list
         return [tensor]
+    if _multiprocess():
+        gathered = _process_gather_np(tensor._data)   # [P, ...]
+        parts = [Tensor(jnp.asarray(gathered[i]))
+                 for i in range(gathered.shape[0])]
+        if isinstance(tensor_list, list):
+            tensor_list.extend(parts)
+            return tensor_list
+        return parts
     _raise_eager("all_gather")
 
 
@@ -250,6 +289,17 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
             out_tensor_list.extend(in_tensor_list)
             return out_tensor_list
         return list(in_tensor_list)
+    if _multiprocess():
+        import numpy as np
+        mine = np.stack([np.asarray(t._data) for t in in_tensor_list])
+        gathered = _process_gather_np(mine)       # [P, P, ...]
+        r = get_rank()
+        parts = [Tensor(jnp.asarray(gathered[p, r]))
+                 for p in range(gathered.shape[0])]
+        if isinstance(out_tensor_list, list):
+            out_tensor_list.extend(parts)
+            return out_tensor_list
+        return parts
     _raise_eager("alltoall")
 
 
@@ -286,6 +336,12 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         tensor._node, tensor._out_idx = out._node, out._out_idx
         return tensor
     if _single(group):
+        return tensor
+    if _multiprocess():
+        from jax.experimental import multihost_utils
+        out = multihost_utils.broadcast_one_to_all(
+            tensor._data, is_source=get_rank() == src)
+        tensor.set_data(jnp.asarray(out))
         return tensor
     _raise_eager("broadcast")
 
@@ -339,6 +395,15 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         src_t = tensor_list[0]
         tensor.set_data(src_t._data, _clear_tape=False)
         tensor._node, tensor._out_idx = src_t._node, src_t._out_idx
+        return tensor
+    if _multiprocess():
+        payload = [None]
+        if get_rank() == src:
+            import numpy as np
+            payload = [np.stack([np.asarray(t._data)
+                                 for t in tensor_list])]
+        broadcast_object_list(payload, src=src, group=group)
+        tensor.set_data(jnp.asarray(payload[0][get_rank()]))
         return tensor
     _raise_eager("scatter")
 

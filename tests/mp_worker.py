@@ -48,6 +48,26 @@ def main():
     # barrier: all ranks must pass together
     dist.barrier()
 
+    # eager TENSOR collectives, host-mediated (the Gloo role): each op
+    # must see every rank's contribution
+    import paddle_tpu as _p
+    x = _p.to_tensor(np.full((3,), float(rank + 1), np.float32))
+    dist.all_reduce(x)
+    np.testing.assert_allclose(
+        np.asarray(x.numpy()),
+        np.full((3,), sum(range(1, world + 1)), np.float32))
+    parts = []
+    dist.all_gather(parts, _p.to_tensor(
+        np.full((2,), float(rank), np.float32)))
+    assert len(parts) == world
+    for r, t in enumerate(parts):
+        np.testing.assert_allclose(np.asarray(t.numpy()),
+                                   np.full((2,), float(r), np.float32))
+    b = _p.to_tensor(np.full((2,), float(rank * 10 + 5), np.float32))
+    dist.broadcast(b, src=0)
+    np.testing.assert_allclose(np.asarray(b.numpy()),
+                               np.full((2,), 5.0, np.float32))
+
     # coordinated distributed checkpoint: every rank saves its (replicated)
     # state, rank 0's metadata wins; then all reload and verify
     t = paddle.to_tensor(
