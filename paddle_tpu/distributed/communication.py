@@ -140,14 +140,27 @@ def _single(group) -> bool:
     return not _traced_axis_active(g) and g.nranks <= 1
 
 
-def _multiprocess() -> bool:
-    """True in the N-process world (launcher-spawned CPU simulation or a
-    multi-host pod): each process is one rank, and eager collectives can
-    run host-mediated through the coordination service — the Gloo role."""
+def _multiprocess(group=None) -> bool:
+    """True when the eager host-mediated path applies: an N-process world
+    (launcher-spawned CPU simulation or a multi-host pod, one rank per
+    process) AND the collective spans the WHOLE world. The coordination-
+    service primitives are global, so a subgroup call must not enter them
+    — members would hang waiting for non-members (and sums would include
+    outsiders)."""
     try:
-        return jax.process_count() > 1
+        n = jax.process_count()
     except Exception:
         return False
+    if n <= 1:
+        return False
+    g = group or _default_group
+    if g.ranks and len(g.ranks) not in (0, n):
+        raise RuntimeError(
+            "eager host-mediated collectives only support the WORLD "
+            f"group ({n} processes); got a subgroup of {len(g.ranks)}. "
+            "Run subgroup collectives inside a compiled region over the "
+            "group's mesh axis.")
+    return True
 
 
 def _process_gather_np(data):
@@ -158,13 +171,16 @@ def _process_gather_np(data):
         jnp.asarray(data), tiled=False))
 
 
-def _raise_eager(op: str):
+def _raise_eager(op: str, multiprocess_supported: bool = True):
+    extra = (" (In a multi-PROCESS job this op does run eagerly, "
+             "host-mediated.)" if multiprocess_supported else
+             " For host-side point-to-point control traffic use "
+             "paddle.distributed.rpc or the *_object collectives.")
     raise RuntimeError(
         f"{op}: eager multi-device collectives are not the TPU data "
         "plane. Run this op inside a compiled region over a mesh axis "
         "(shard_map / fleet.distributed_model / to_static), or use "
-        "*_object collectives for host-side control data. (In a "
-        "multi-PROCESS job these ops do run eagerly, host-mediated.)")
+        "*_object collectives for host-side control data." + extra)
 
 
 def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
@@ -184,7 +200,7 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None, sync_op=True):
         return tensor
     if _single(group):
         return tensor
-    if _multiprocess():
+    if _multiprocess(group):
         import numpy as np
         gathered = _process_gather_np(tensor._data)   # [P, ...]
         red = {ReduceOp.SUM: np.sum, ReduceOp.MAX: np.max,
@@ -213,7 +229,7 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True, axis=0):
             tensor_list.append(tensor)
             return tensor_list
         return [tensor]
-    if _multiprocess():
+    if _multiprocess(group):
         gathered = _process_gather_np(tensor._data)   # [P, ...]
         parts = [Tensor(jnp.asarray(gathered[i]))
                  for i in range(gathered.shape[0])]
@@ -268,6 +284,16 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
         tensor.set_data(src._data, _clear_tape=False)
         tensor._node, tensor._out_idx = src._node, src._out_idx
         return tensor
+    if _multiprocess(group):
+        import numpy as np
+        parts = tensor_list if isinstance(tensor_list, (list, tuple)) \
+            else [tensor_list]
+        mine = np.stack([np.asarray(t._data) for t in parts])  # [P, ...]
+        gathered = _process_gather_np(mine)                    # [P, P, ..]
+        tensor.set_data(jnp.asarray(
+            gathered[:, get_rank()].sum(axis=0))
+            .astype(tensor._data.dtype))
+        return tensor
     _raise_eager("reduce_scatter")
 
 
@@ -289,7 +315,7 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
             out_tensor_list.extend(in_tensor_list)
             return out_tensor_list
         return list(in_tensor_list)
-    if _multiprocess():
+    if _multiprocess(group):
         import numpy as np
         mine = np.stack([np.asarray(t._data) for t in in_tensor_list])
         gathered = _process_gather_np(mine)       # [P, P, ...]
@@ -319,6 +345,21 @@ def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
         out_tensor._node = in_tensor._node
         out_tensor._out_idx = in_tensor._out_idx
         return out_tensor
+    if _multiprocess(group):
+        import numpy as np
+        n = jax.process_count()
+        a = np.asarray(in_tensor._data)
+        if a.shape[0] % n:
+            raise ValueError(
+                f"alltoall_single: dim 0 ({a.shape[0]}) not divisible by "
+                f"world size {n}")
+        mine = a.reshape((n, a.shape[0] // n) + a.shape[1:])
+        gathered = _process_gather_np(mine)        # [P, P, k, ...]
+        out = np.concatenate(
+            [gathered[p, get_rank()] for p in range(n)], axis=0)
+        out_tensor.set_data(jnp.asarray(out).astype(
+            out_tensor._data.dtype))
+        return out_tensor
     _raise_eager("alltoall_single")
 
 
@@ -337,7 +378,7 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         return tensor
     if _single(group):
         return tensor
-    if _multiprocess():
+    if _multiprocess(group):
         from jax.experimental import multihost_utils
         out = multihost_utils.broadcast_one_to_all(
             tensor._data, is_source=get_rank() == src)
@@ -396,7 +437,7 @@ def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
         tensor.set_data(src_t._data, _clear_tape=False)
         tensor._node, tensor._out_idx = src_t._node, src_t._out_idx
         return tensor
-    if _multiprocess():
+    if _multiprocess(group):
         payload = [None]
         if get_rank() == src:
             import numpy as np
@@ -416,7 +457,7 @@ def send(tensor, dst=0, group=None, sync_op=True):
     if _single(group):
         _p2p_buf.append(tensor)
         return
-    _raise_eager("send")
+    _raise_eager("send", multiprocess_supported=False)
 
 
 _p2p_buf: list = []
@@ -428,7 +469,7 @@ def recv(tensor, src=0, group=None, sync_op=True):
             src_t = _p2p_buf.pop(0)
             tensor.set_data(src_t._data, _clear_tape=False)
         return tensor
-    _raise_eager("recv")
+    _raise_eager("recv", multiprocess_supported=False)
 
 
 class _Work:

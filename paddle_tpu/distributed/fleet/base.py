@@ -134,6 +134,13 @@ class Fleet:
         from .meta_parallel import PipelineLayer, PipelineParallel
         if isinstance(model, PipelineLayer) and \
                 self._hcg.get_pipe_parallel_world_size() > 1:
+            if self._strategy is not None and self._strategy.amp:
+                import warnings
+                warnings.warn(
+                    "strategy.amp is not applied to pipeline models: the "
+                    "compiled pipeline engine owns the program. Cast the "
+                    "model (model.to(dtype='bfloat16')) or use auto_cast "
+                    "inside the loss/layers instead.", UserWarning)
             accum = 1
             if self._strategy is not None:
                 accum = self._strategy.pipeline_configs.get(
@@ -166,30 +173,44 @@ class Fleet:
         pass
 
 
-class _AmpModelWrapper:
-    """fleet AMP meta-optimizer role: run the wrapped model's forward
-    under ``amp.auto_cast`` with the strategy's amp_configs."""
+def _make_amp_wrapper_cls():
+    from ...nn.layer.layers import Layer
 
-    def __init__(self, model, amp_configs):
-        self._model = model
-        cfg = dict(amp_configs or {})
-        self._kw = {
-            "level": cfg.get("level", "O1"),
-            "dtype": cfg.get("dtype", "bfloat16"),
-            "custom_white_list": cfg.get("custom_white_list"),
-            "custom_black_list": cfg.get("custom_black_list"),
-        }
+    class _AmpModelWrapper(Layer):
+        """fleet AMP meta-optimizer role: run the wrapped model's forward
+        under ``amp.auto_cast`` with the strategy's amp_configs. A real
+        Layer (the model registers as a sublayer) so isinstance-gated
+        paths — jit.save parameters, to_static Layer handling,
+        state_dict — all see through it."""
 
-    def __getattr__(self, name):
-        return getattr(self._model, name)
+        def __init__(self, model, amp_configs):
+            super().__init__()
+            self.model = model        # registered sublayer
+            cfg = dict(amp_configs or {})
+            self._amp_kw = {
+                "level": cfg.get("level", "O1"),
+                "dtype": cfg.get("dtype", "bfloat16"),
+                "custom_white_list": cfg.get("custom_white_list"),
+                "custom_black_list": cfg.get("custom_black_list"),
+            }
 
-    def __call__(self, *args, **kwargs):
-        from ...amp import auto_cast
-        with auto_cast(True, **self._kw):
-            return self._model(*args, **kwargs)
+        def forward(self, *args, **kwargs):
+            from ...amp import auto_cast
+            with auto_cast(True, **self._amp_kw):
+                return self.model(*args, **kwargs)
 
-    def forward(self, *args, **kwargs):
-        return self(*args, **kwargs)
+        def __getattr__(self, name):
+            try:
+                return super().__getattr__(name)
+            except AttributeError:
+                return getattr(self.__dict__["_sub_layers"]["model"],
+                               name)
+
+    return _AmpModelWrapper
+
+
+def _AmpModelWrapper(model, amp_configs):
+    return _make_amp_wrapper_cls()(model, amp_configs)
 
 
 fleet = Fleet()

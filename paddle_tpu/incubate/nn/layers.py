@@ -95,16 +95,29 @@ class FusedTransformerEncoderLayer(Layer):
 
 
 class FusedLinear(Layer):
-    """incubate.nn.FusedLinear parity — one matmul+bias op (XLA fuses)."""
+    """incubate.nn.FusedLinear parity — one matmul+bias op (XLA fuses).
+    With transpose_weight=True the weight is stored [out, in] and the
+    matmul contracts its second dim (the reference's layout option)."""
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  bias_attr=None, transpose_weight=False, name=None):
         super().__init__()
-        self._inner = Linear(in_features, out_features,
-                             weight_attr=weight_attr, bias_attr=bias_attr)
-        self.weight = self._inner.weight
-        self.bias = self._inner.bias
-        self._transpose = transpose_weight
+        self._transpose = bool(transpose_weight)
+        if self._transpose:
+            from ...nn import initializer as I
+            self.weight = self.create_parameter(
+                [out_features, in_features], attr=weight_attr,
+                default_initializer=I.XavierNormal())
+            self.bias = None if bias_attr is False else \
+                self.create_parameter([out_features], attr=bias_attr,
+                                      is_bias=True,
+                                      default_initializer=I.Constant(0.0))
+        else:
+            self._inner = Linear(in_features, out_features,
+                                 weight_attr=weight_attr,
+                                 bias_attr=bias_attr)
+            self.weight = self._inner.weight
+            self.bias = self._inner.bias
 
     def forward(self, x):
         from .functional import fused_linear
