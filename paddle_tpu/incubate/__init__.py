@@ -81,3 +81,74 @@ def graph_send_recv(x, src_index, dst_index, reduce_op="sum",
             return jax.ops.segment_max(gathered, d, n)
         return jax.ops.segment_min(gathered, d, n)
     return apply(fn, x, src, dst, name="graph_send_recv")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    from .nn.functional import fused_softmax_mask_upper_triangle
+    return fused_softmax_mask_upper_triangle(x)
+
+
+def identity_loss(x, reduction="none", name=None):
+    """paddle.incubate.identity_loss — mark a value as the loss for the
+    graph builder; numerically a (reduced) identity."""
+    from ..ops import math as M
+    if reduction in ("mean", 1):
+        return M.mean(x)
+    if reduction in ("sum", 2):
+        return M.sum(x)
+    return x
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """K-hop neighbor sampling: repeated 1-hop sampling + reindex
+    (paddle.incubate.graph_khop_sampler parity; host-side like the
+    geometric samplers)."""
+    from ..geometric import reindex_graph, sample_neighbors
+    import numpy as np
+    from ..framework.core import Tensor
+    import jax.numpy as jnp
+
+    cur = input_nodes
+    all_rows, all_cols = [], []
+    for size in list(sample_sizes):
+        neigh, count = sample_neighbors(row, colptr, cur,
+                                        sample_size=int(size))
+        cur_np = np.asarray(cur._data if isinstance(cur, Tensor) else cur)
+        cnt_np = np.asarray(count._data)
+        src = np.repeat(cur_np, cnt_np)
+        dst = np.asarray(neigh._data)
+        all_rows.append(dst)
+        all_cols.append(src)
+        cur = Tensor(jnp.asarray(np.unique(dst)))
+    rows = np.concatenate(all_rows) if all_rows else np.zeros(0, np.int64)
+    cols = np.concatenate(all_cols) if all_cols else np.zeros(0, np.int64)
+    nodes = np.unique(np.concatenate(
+        [np.asarray(input_nodes._data if isinstance(input_nodes, Tensor)
+                    else input_nodes), rows, cols]))
+    remap = {int(n): i for i, n in enumerate(nodes)}
+    r2 = np.asarray([remap[int(v)] for v in rows], np.int64)
+    c2 = np.asarray([remap[int(v)] for v in cols], np.int64)
+    return (Tensor(jnp.asarray(r2)), Tensor(jnp.asarray(c2)),
+            Tensor(jnp.asarray(nodes)),
+            Tensor(jnp.asarray(np.zeros(0, np.int64))))
+
+
+def graph_sample_neighbors(row, colptr, input_nodes, eids=None,
+                           perm_buffer=None, sample_size=-1,
+                           return_eids=False, flag_perm_buffer=False,
+                           name=None):
+    from ..geometric import sample_neighbors as _sn
+    return _sn(row, colptr, input_nodes, sample_size=sample_size)
+
+
+def graph_reindex(x, neighbors, count, value_buffer=None,
+                  index_buffer=None, flag_buffer_hashtable=False,
+                  name=None):
+    from ..geometric import reindex_graph as _rg
+    return _rg(x, neighbors, count)
+
+
+__all__ += ["softmax_mask_fuse_upper_triangle", "identity_loss",
+            "graph_khop_sampler", "graph_sample_neighbors",
+            "graph_reindex"]
