@@ -112,6 +112,32 @@ def rope_with_offset(t, pos, max_pos, theta):
     return apply(fn, t, pos, name="rope_cached")
 
 
+def _paged_attention_step(attn, q, k, v, cache, pos, tables):
+    """Continuous-batching decode step over the PAGED pool, shared by the
+    Llama/Qwen2 attention layers: per-slot positions (mixed-length
+    streams), trash-page routing for drained slots (serving engine
+    path). ``attn`` supplies cfg/head geometry/o_proj."""
+    b, s = q.shape[0], q.shape[1]
+    tbl, active = tables
+    q = rope_with_offset(q, pos, attn.cfg.max_position_embeddings,
+                         attn.cfg.rope_theta)
+    k = rope_with_offset(k, pos, attn.cfg.max_position_embeddings,
+                         attn.cfg.rope_theta)
+
+    def fn(qa, ka, va, kpa, vpa, tba, acta, cta):
+        from ..ops import paged_attention as PA
+        ct = cta[:, 0]
+        kpa, vpa = PA.paged_decode_write(kpa, vpa, ka, va, tba, ct, acta)
+        out = PA.paged_attention(qa[:, 0], kpa, vpa, tba, ct + 1)
+        return out[:, None], kpa, vpa
+
+    ctx_out, kp2, vp2 = apply(
+        fn, q, k, v, cache[0], cache[1], tbl, active, pos,
+        n_outputs=3, name="paged_decode_attention", differentiable=False)
+    ctx_out = M.reshape(ctx_out, [b, s, attn.num_heads * attn.head_dim])
+    return attn.o_proj(ctx_out), (kp2, vp2)
+
+
 def _alloc_kv_caches(cfg, batch_size, max_length, dtype):
     """Zero KV caches: per layer (k, v) of [B, max_len, KV, D]."""
     caches = []
@@ -162,30 +188,8 @@ class LlamaAttention(nn.Layer):
         v = M.reshape(self.v_proj(x),
                       [b, s, self.num_kv_heads, self.head_dim])
         if cache is not None and tables is not None:
-            # continuous-batching decode step over the PAGED pool:
-            # per-slot positions (mixed-length streams), trash-page
-            # routing for drained slots (serving engine path)
-            tbl, active = tables
-            q = rope_with_offset(q, pos, self.cfg.max_position_embeddings,
-                                 self.cfg.rope_theta)
-            k = rope_with_offset(k, pos, self.cfg.max_position_embeddings,
-                                 self.cfg.rope_theta)
-
-            def fn(qa, ka, va, kpa, vpa, tba, acta, cta):
-                from ..ops import paged_attention as PA
-                ct = cta[:, 0]
-                kpa, vpa = PA.paged_decode_write(kpa, vpa, ka, va, tba,
-                                                 ct, acta)
-                out = PA.paged_attention(qa[:, 0], kpa, vpa, tba, ct + 1)
-                return out[:, None], kpa, vpa
-
-            ctx_out, kp2, vp2 = apply(
-                fn, q, k, v, cache[0], cache[1], tbl, active, pos,
-                n_outputs=3, name="paged_decode_attention",
-                differentiable=False)
-            ctx_out = M.reshape(ctx_out,
-                                [b, s, self.num_heads * self.head_dim])
-            return self.o_proj(ctx_out), (kp2, vp2)
+            return _paged_attention_step(self, q, k, v, cache, pos,
+                                         tables)
         if cache is not None:
             q = rope_with_offset(q, pos, self.cfg.max_position_embeddings,
                                  self.cfg.rope_theta)

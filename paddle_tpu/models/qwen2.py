@@ -21,7 +21,8 @@ from ..distributed.parallel_layers import (ColumnParallelLinear,
                                            VocabParallelEmbedding)
 from ..incubate.distributed.models.moe import MoELayer
 from ..generation import GenerationMixin
-from .llama import rope_with_offset, _alloc_kv_caches
+from .llama import (rope_with_offset, _alloc_kv_caches,
+                    _paged_attention_step)
 
 __all__ = ["Qwen2Config", "Qwen2MoeConfig", "Qwen2ForCausalLM",
            "Qwen2MoeForCausalLM"]
@@ -122,13 +123,16 @@ class Qwen2Attention(nn.Layer):
         self.o_proj = _lin(cfg, self.num_heads * self.head_dim,
                            cfg.hidden_size, column=False)
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, tables=None):
         b, s, _ = x.shape
         q = M.reshape(self.q_proj(x), [b, s, self.num_heads, self.head_dim])
         k = M.reshape(self.k_proj(x),
                       [b, s, self.num_kv_heads, self.head_dim])
         v = M.reshape(self.v_proj(x),
                       [b, s, self.num_kv_heads, self.head_dim])
+        if cache is not None and tables is not None:
+            return _paged_attention_step(self, q, k, v, cache, pos,
+                                         tables)
         if cache is not None:
             q = rope_with_offset(q, pos, self.cfg.max_position_embeddings,
                                  self.cfg.rope_theta)
@@ -200,10 +204,11 @@ class Qwen2DecoderLayer(nn.Layer):
                                                    cfg.rms_norm_eps)
         self.mlp = Qwen2MoeBlock(cfg) if moe else Qwen2MLP(cfg)
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, tables=None):
         if cache is not None:
             attn, new_cache = self.self_attn(self.input_layernorm(x),
-                                             cache=cache, pos=pos)
+                                             cache=cache, pos=pos,
+                                             tables=tables)
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, new_cache
@@ -238,13 +243,15 @@ class _Qwen2Base(nn.Layer, GenerationMixin):
             dtype = next(iter(self.parameters())).dtype
         return _alloc_kv_caches(self.config, batch_size, max_length, dtype)
 
-    def forward(self, input_ids, labels=None, caches=None, pos=None):
+    def forward(self, input_ids, labels=None, caches=None, pos=None,
+                tables=None):
         x = self.embed_tokens(input_ids)
         if caches is not None:
             new_caches = []
             for i, layer in enumerate(self.layers):
                 x, (kc, vc) = layer(x, cache=(caches[2 * i],
-                                              caches[2 * i + 1]), pos=pos)
+                                              caches[2 * i + 1]), pos=pos,
+                                    tables=tables)
                 new_caches.extend((kc, vc))
             hidden = self.norm(x)
             logits = self.lm_head(hidden) if self.lm_head is not None else \
