@@ -89,7 +89,11 @@ class ContinuousBatchingEngine:
         self.temperature = float(temperature)
 
         dtype = next(iter(model.parameters()))._data.dtype
-        kvh, d = cfg.num_key_value_heads, cfg.head_dim
+        # MHA models (e.g. GPT2) carry no kv-head/head-dim fields
+        kvh = getattr(cfg, "num_key_value_heads",
+                      cfg.num_attention_heads)
+        d = getattr(cfg, "head_dim",
+                    cfg.hidden_size // cfg.num_attention_heads)
         # per layer: (key_pages, value_pages) — flat list like dense caches
         self.pools = []
         for _ in range(cfg.num_hidden_layers):

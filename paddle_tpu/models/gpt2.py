@@ -57,13 +57,18 @@ class GPT2Attention(nn.Layer):
                                 weight_attr=attr)
         self.attn_dropout = cfg.attention_dropout_prob
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, tables=None):
         b, s, e = x.shape
         qkv = self.c_attn(x)
         qkv = M.reshape(qkv, [b, s, 3, self.num_heads, self.head_dim])
         q = qkv[:, :, 0]
         k = qkv[:, :, 1]
         v = qkv[:, :, 2]
+        if cache is not None and tables is not None:
+            from .llama import _paged_attention_step
+            return _paged_attention_step(self, q, k, v, cache, pos,
+                                         tables, rope=False,
+                                         proj=self.c_proj)
         if cache is not None:
             ctx, k_cache, v_cache = F.sdpa_with_cache(
                 q, k, v, cache[0], cache[1], pos)
@@ -99,9 +104,10 @@ class GPT2Block(nn.Layer):
         self.mlp = GPT2MLP(cfg)
         self.dropout = nn.Dropout(cfg.hidden_dropout_prob)
 
-    def forward(self, x, cache=None, pos=None):
+    def forward(self, x, cache=None, pos=None, tables=None):
         if cache is not None:
-            attn, new_cache = self.attn(self.ln_1(x), cache=cache, pos=pos)
+            attn, new_cache = self.attn(self.ln_1(x), cache=cache, pos=pos,
+                                        tables=tables)
             x = x + attn
             x = x + self.mlp(self.ln_2(x))
             return x, new_cache
@@ -126,7 +132,7 @@ class GPT2Model(nn.Layer):
         self.ln_f = nn.LayerNorm(config.hidden_size,
                                  config.layer_norm_epsilon)
 
-    def forward(self, input_ids, caches=None, pos=None):
+    def forward(self, input_ids, caches=None, pos=None, tables=None):
         s = input_ids.shape[1]
         positions = creation.arange(0, s, dtype="int64")
         if pos is not None:
@@ -136,7 +142,8 @@ class GPT2Model(nn.Layer):
             new_caches = []
             for i, block in enumerate(self.h):
                 x, (kc, vc) = block(x, cache=(caches[2 * i],
-                                              caches[2 * i + 1]), pos=pos)
+                                              caches[2 * i + 1]), pos=pos,
+                                    tables=tables)
                 new_caches.extend((kc, vc))
             return self.ln_f(x), new_caches
         x = self.drop(x)
@@ -171,10 +178,12 @@ class GPT2ForCausalLM(nn.Layer, GenerationMixin):
                                dtype=dtype)
                 for _ in range(2 * cfg.num_hidden_layers)]
 
-    def forward(self, input_ids, labels=None, caches=None, pos=None):
+    def forward(self, input_ids, labels=None, caches=None, pos=None,
+                tables=None):
         from ..ops.linalg import matmul
         if caches is not None:
-            hidden, caches = self.gpt2(input_ids, caches=caches, pos=pos)
+            hidden, caches = self.gpt2(input_ids, caches=caches, pos=pos,
+                                       tables=tables)
             logits = matmul(hidden, self.gpt2.wte.weight, transpose_y=True)
             return logits, caches
         hidden = self.gpt2(input_ids)

@@ -112,17 +112,21 @@ def rope_with_offset(t, pos, max_pos, theta):
     return apply(fn, t, pos, name="rope_cached")
 
 
-def _paged_attention_step(attn, q, k, v, cache, pos, tables):
+def _paged_attention_step(attn, q, k, v, cache, pos, tables, rope=True,
+                          proj=None):
     """Continuous-batching decode step over the PAGED pool, shared by the
-    Llama/Qwen2 attention layers: per-slot positions (mixed-length
+    Llama/Qwen2/GPT2 attention layers: per-slot positions (mixed-length
     streams), trash-page routing for drained slots (serving engine
-    path). ``attn`` supplies cfg/head geometry/o_proj."""
+    path). ``attn`` supplies head geometry; rope=False for learned-
+    position models; ``proj`` overrides the output projection
+    (defaults to attn.o_proj)."""
     b, s = q.shape[0], q.shape[1]
     tbl, active = tables
-    q = rope_with_offset(q, pos, attn.cfg.max_position_embeddings,
-                         attn.cfg.rope_theta)
-    k = rope_with_offset(k, pos, attn.cfg.max_position_embeddings,
-                         attn.cfg.rope_theta)
+    if rope:
+        q = rope_with_offset(q, pos, attn.cfg.max_position_embeddings,
+                             attn.cfg.rope_theta)
+        k = rope_with_offset(k, pos, attn.cfg.max_position_embeddings,
+                             attn.cfg.rope_theta)
 
     def fn(qa, ka, va, kpa, vpa, tba, acta, cta):
         from ..ops import paged_attention as PA
@@ -135,7 +139,8 @@ def _paged_attention_step(attn, q, k, v, cache, pos, tables):
         fn, q, k, v, cache[0], cache[1], tbl, active, pos,
         n_outputs=3, name="paged_decode_attention", differentiable=False)
     ctx_out = M.reshape(ctx_out, [b, s, attn.num_heads * attn.head_dim])
-    return attn.o_proj(ctx_out), (kp2, vp2)
+    out_proj = proj if proj is not None else attn.o_proj
+    return out_proj(ctx_out), (kp2, vp2)
 
 
 def _alloc_kv_caches(cfg, batch_size, max_length, dtype):

@@ -167,3 +167,27 @@ def test_qwen2_moe_through_engine():
     eng.add_request(prompt, 6)
     (req,) = eng.run()
     assert req.tokens == ref, (req.tokens, ref)
+
+
+@pytest.mark.slow
+def test_gpt2_through_engine():
+    """Learned-position model serving: GPT2 (no rope; per-slot position
+    embeddings broadcast) — greedy parity vs dense generate."""
+    from paddle_tpu.models import GPT2Config, GPT2ForCausalLM
+    cfg = GPT2Config.tiny()
+    paddle.seed(0)
+    model = GPT2ForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(7)
+    prompt = rng.randint(0, cfg.vocab_size, (10,)).astype(np.int32)
+    ids = paddle.to_tensor(prompt.reshape(1, -1).astype(np.int64))
+    ref_out, _ = model.generate(ids, max_new_tokens=8,
+                                decode_strategy="greedy_search",
+                                eos_token_id=None, pad_token_id=0)
+    ref = np.asarray(ref_out.numpy())[0].tolist()
+    eng = ContinuousBatchingEngine(model, num_slots=2, page_size=8,
+                                   max_len=48, decode_chunk=4,
+                                   prompt_buckets=(16,), greedy=True)
+    eng.add_request(prompt, 8)
+    (req,) = eng.run()
+    assert req.tokens == ref, (req.tokens, ref)
