@@ -10,3 +10,4 @@ from .attention import *  # noqa: F401,F403
 
 from ...ops.creation import one_hot  # noqa: F401
 from ...ops.search import where  # noqa: F401
+from ...ops.manipulation import diag_embed  # noqa: F401

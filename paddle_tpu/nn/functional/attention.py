@@ -139,3 +139,59 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
     if return_softmax:
         return out, None
     return out, None
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention with a CSR sparsity pattern
+    (paddle.nn.functional.sparse_attention parity). q/k/v:
+    [B, H, S, D]; offset [B, H, S+1], columns [B, H, nnz] — row i of the
+    attention matrix only attends to the listed columns.
+
+    TPU formulation: a dense masked softmax built FROM the CSR pattern
+    (scatter of the column lists into a [S, S] mask) — on TPU the MXU
+    prefers the dense masked matmul over gather-based sparsity at these
+    block sizes; the CSR arguments keep the reference's contract."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...framework.core import apply
+    from ...ops.common import as_tensor
+
+    q, k, v = as_tensor(query), as_tensor(key), as_tensor(value)
+    off, cols = as_tensor(sparse_csr_offset), as_tensor(sparse_csr_columns)
+
+    def fn(qq, kk, vv, offsets, columns, *rest):
+        import math as _math
+        b, h, s, d = qq.shape
+        nnz = columns.shape[-1]
+
+        def one_mask(off1, col1):
+            # row id of every nnz entry from the CSR offsets
+            counts = off1[1:] - off1[:-1]               # [S]
+            rows = jnp.repeat(jnp.arange(s), counts.astype(jnp.int32),
+                              total_repeat_length=nnz)
+            m = jnp.zeros((s, s), jnp.bool_)
+            return m.at[rows, col1.astype(jnp.int32)].set(True)
+
+        mask = jax.vmap(jax.vmap(one_mask))(offsets, columns)  # [B,H,S,S]
+        logits = jnp.einsum("bhqd,bhkd->bhqk", qq, kk,
+                            preferred_element_type=jnp.float32)
+        logits = logits / _math.sqrt(d)
+        if rest:
+            logits = logits + rest[0].astype(logits.dtype)
+        logits = jnp.where(mask, logits, -1e30)
+        p = jax.nn.softmax(logits, -1).astype(vv.dtype)
+        # rows with an empty pattern must output zeros, not uniform noise
+        any_row = mask.any(-1, keepdims=True)
+        p = p * any_row.astype(p.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, vv)
+
+    args = [q, k, v, off, cols]
+    if attn_mask is not None:
+        args.append(as_tensor(attn_mask))
+    return apply(fn, *args, name="sparse_attention")
+
+
+__all__ += ["sparse_attention"]

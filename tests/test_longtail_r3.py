@@ -269,3 +269,38 @@ class TestIncubateOptimizers:
             np.testing.assert_allclose(np.asarray(w.numpy()),
                                        [2.0, 2.0])  # mean of 1,2,3
         np.testing.assert_allclose(np.asarray(w.numpy()), live)  # restored
+
+
+class TestSparseAttention:
+    def test_full_pattern_matches_dense(self):
+        rng = np.random.RandomState(0)
+        B, H, S, D = 1, 2, 4, 8
+        q = rng.randn(B, H, S, D).astype(np.float32)
+        k = rng.randn(B, H, S, D).astype(np.float32)
+        v = rng.randn(B, H, S, D).astype(np.float32)
+        offset = np.tile(np.arange(0, (S + 1) * S, S,
+                                   dtype=np.int32)[:S + 1], (B, H, 1))
+        cols = np.tile(np.tile(np.arange(S, dtype=np.int32), S),
+                       (B, H, 1))
+        out = np.asarray(F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(offset), paddle.to_tensor(cols)).numpy())
+        lg = np.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(D)
+        p = np.exp(lg - lg.max(-1, keepdims=True))
+        p /= p.sum(-1, keepdims=True)
+        np.testing.assert_allclose(
+            out, np.einsum("bhqk,bhkd->bhqd", p, v), rtol=1e-4,
+            atol=1e-5)
+
+    def test_diagonal_pattern_is_identity_on_v(self):
+        rng = np.random.RandomState(1)
+        B, H, S, D = 1, 1, 5, 4
+        q = rng.randn(B, H, S, D).astype(np.float32)
+        k = rng.randn(B, H, S, D).astype(np.float32)
+        v = rng.randn(B, H, S, D).astype(np.float32)
+        offset = np.tile(np.arange(S + 1, dtype=np.int32), (B, H, 1))
+        cols = np.tile(np.arange(S, dtype=np.int32), (B, H, 1))
+        out = np.asarray(F.sparse_attention(
+            paddle.to_tensor(q), paddle.to_tensor(k), paddle.to_tensor(v),
+            paddle.to_tensor(offset), paddle.to_tensor(cols)).numpy())
+        np.testing.assert_allclose(out, v, rtol=1e-5)
