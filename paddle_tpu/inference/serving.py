@@ -63,10 +63,10 @@ class ContinuousBatchingEngine:
     """Schedules mixed-length generation streams through one compiled
     decode program. Greedy or temperature sampling.
 
-    model: a ``LlamaForCausalLM``-shaped Layer (``forward(ids, caches=,
-    pos=, tables=)`` + ``init_kv_cache``). num_slots is the decode batch
-    size; total pool memory = num_pages * page_size tokens of KV per
-    layer."""
+    model: any CausalLM Layer implementing ``forward(ids, caches=, pos=,
+    tables=)`` + ``init_kv_cache`` — Llama, Qwen2 (incl. MoE), and GPT2
+    all qualify. num_slots is the decode batch size; total pool memory =
+    num_pages * page_size tokens of KV per layer."""
 
     def __init__(self, model, num_slots=4, page_size=16, num_pages=None,
                  max_len=512, decode_chunk=16, prompt_buckets=(32, 64, 128),
