@@ -8,9 +8,9 @@ keep Paddle's shape for source familiarity."""
 
 from .env import (init_parallel_env, get_rank, get_world_size,
                   is_initialized, ParallelEnv)
-from .mesh import (ProcessMesh, Shard, Replicate, Partial, shard_tensor,
-                   reshard, dtensor_from_fn, shard_layer, get_mesh,
-                   set_mesh, auto_mesh)
+from .mesh import (ProcessMesh, Shard, Replicate, Partial, Placement,
+                   shard_tensor, reshard, dtensor_from_fn, shard_layer,
+                   get_mesh, set_mesh, auto_mesh, shard_optimizer)
 from .communication import (all_reduce, all_gather, all_gather_object,
                             reduce_scatter, alltoall, alltoall_single,
                             broadcast, broadcast_object_list, reduce, scatter,
@@ -24,7 +24,8 @@ from . import fleet
 from . import checkpoint
 from .checkpoint.save_load import (save_state_dict, load_state_dict)
 from .parallel_layers import (ColumnParallelLinear, RowParallelLinear,
-                              VocabParallelEmbedding, ParallelCrossEntropy)
+                              VocabParallelEmbedding, ParallelCrossEntropy,
+                              split)
 from .auto_parallel_api import (to_static, Strategy,
                                 DistAttr, DistModel, unshard_dtensor)
 from . import launch  # noqa: F401
@@ -36,6 +37,13 @@ from . import utils  # noqa: F401
 from .spawn_api import spawn
 from .parallelize import (parallelize, ColWiseParallel, RowWiseParallel,
                           PrepareLayerInput, PrepareLayerOutput)
+from .ps_dataset import QueueDataset, InMemoryDataset
+
+
+def gloo_barrier():
+    """Host-side barrier (the Gloo-role control-plane sync)."""
+    barrier()
+
 
 __all__ = [
     "spawn", "gather", "scatter_object_list",
@@ -52,5 +60,6 @@ __all__ = [
     "save_state_dict", "load_state_dict", "ColumnParallelLinear",
     "RowParallelLinear", "VocabParallelEmbedding", "ParallelCrossEntropy",
     "Strategy", "DistAttr", "DistModel", "unshard_dtensor", "stream",
-    "run_pipeline_train", "make_schedule",
+    "run_pipeline_train", "make_schedule", "Placement", "shard_optimizer",
+    "split", "QueueDataset", "InMemoryDataset", "gloo_barrier",
 ]

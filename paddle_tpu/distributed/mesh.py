@@ -236,3 +236,74 @@ def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,
             return out
         layer.forward = wrapped
     return layer
+
+
+class _ShardOptimizer:
+    """``dist.shard_optimizer`` wrapper: every accumulator / master
+    weight the inner optimizer creates inherits its parameter's sharding
+    (or whatever ``shard_fn(acc_name, param, acc)`` returns) — the
+    auto-parallel ZeRO entry point (upstream
+    python/paddle/distributed/auto_parallel/api.py shard_optimizer,
+    UNVERIFIED; reference mount empty)."""
+
+    def __init__(self, optimizer, shard_fn=None):
+        self._inner = optimizer
+        self._shard_fn = shard_fn
+        self._placed: set[int] = set()
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+    def _place_new_state(self):
+        params = {id(p): p for p in self._inner._parameter_list}
+        stores = list(self._inner._accumulators.items())
+        for acc_name, store in stores:
+            for pid, t in store.items():
+                if id(t) in self._placed:
+                    continue
+                p = params.get(pid)
+                if p is None:
+                    continue
+                if self._shard_fn is not None:
+                    out = self._shard_fn(acc_name, p, t)
+                    if out is not None and out is not t:
+                        t.set_data(out._data if isinstance(out, Tensor)
+                                   else jax.numpy.asarray(out))
+                elif t._data.shape == p._data.shape:
+                    t.set_data(jax.device_put(t._data, p._data.sharding))
+                self._placed.add(id(t))
+        for pid, t in self._inner._master_weights.items():
+            if id(t) in self._placed:
+                continue
+            p = params.get(pid)
+            if p is not None and t._data.shape == p._data.shape:
+                t.set_data(jax.device_put(t._data, p._data.sharding))
+            self._placed.add(id(t))
+
+    def step(self, *a, **k):
+        self._inner.step(*a, **k)  # LBFGS-style step(closure) passthrough
+        self._place_new_state()
+
+    def minimize(self, loss, *a, **k):
+        out = self._inner.minimize(loss, *a, **k)
+        self._place_new_state()
+        return out
+
+    def clear_grad(self, set_to_zero=False):
+        self._inner.clear_grad(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def state_dict(self):
+        return self._inner.state_dict()
+
+    def set_state_dict(self, state):
+        self._inner.set_state_dict(state)
+        # restore overwrites existing accumulator tensors in place with
+        # replicated host arrays — force a full re-place
+        self._placed.clear()
+        self._place_new_state()
+
+
+def shard_optimizer(optimizer, shard_fn=None):
+    return _ShardOptimizer(optimizer, shard_fn)

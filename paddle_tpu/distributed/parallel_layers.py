@@ -253,3 +253,48 @@ class ParallelCrossEntropy(Layer):
         return F.softmax_with_cross_entropy(input, label,
                                             ignore_index=self.ignore_index
                                             or -100)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """``paddle.distributed.split`` parity — model-parallel linear /
+    embedding created in place. Desugars to the parallel layers; the
+    GSPMD sharding does the actual split, so ``num_partitions`` must
+    equal the mp world size when a model-parallel group exists
+    (validated below; with no mp group any value is accepted and the
+    layer runs unsharded).
+
+    Inside a captured ``static.Program`` the created layer persists on
+    the Program slot (re-runs reuse weights); in plain eager each call
+    creates a fresh layer, as upstream's dygraph split does."""
+    from ..static.program import default_main_program
+
+    _, _, mp_world = _mp_axis_and_mesh()
+    if mp_world > 1 and num_partitions != mp_world:
+        raise ValueError(
+            f"dist.split: num_partitions ({num_partitions}) must equal "
+            f"the model-parallel world size ({mp_world})")
+
+    def make():
+        if operation == "linear":
+            in_f, out_f = int(size[0]), int(size[1])
+            if axis == 1:
+                return ColumnParallelLinear(
+                    in_f, out_f, weight_attr=weight_attr,
+                    has_bias=bias_attr is not False,
+                    gather_output=gather_out)
+            return RowParallelLinear(
+                in_f, out_f, weight_attr=weight_attr,
+                has_bias=bias_attr is not False,
+                input_is_parallel=not gather_out)
+        if operation == "embedding":
+            return VocabParallelEmbedding(
+                int(size[0]), int(size[1]), weight_attr=weight_attr)
+        raise ValueError(
+            f"dist.split: unknown operation {operation!r} "
+            "(expected 'linear' or 'embedding')")
+
+    prog = default_main_program()
+    layer = prog._next_layer(make) if getattr(prog, "_building", False) \
+        else make()
+    return layer(x)

@@ -16,7 +16,7 @@ from ..ops.common import as_tensor
 __all__ = ["Transform", "AffineTransform", "ExpTransform", "PowerTransform",
            "SigmoidTransform", "TanhTransform", "AbsTransform",
            "SoftmaxTransform", "ChainTransform", "IndependentTransform",
-           "ReshapeTransform", "StickBreakingTransform"]
+           "ReshapeTransform", "StickBreakingTransform", "StackTransform"]
 
 
 def _arr(x):
@@ -244,6 +244,37 @@ class ChainTransform(Transform):
         for t in reversed(self.transforms):
             shape = t.inverse_shape(shape)
         return shape
+
+
+class StackTransform(Transform):
+    """Apply ``transforms[i]`` to slice ``i`` of the input along ``axis``
+    (upstream ``paddle.distribution.StackTransform``)."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+        self._event_rank = max((t._event_rank for t in self.transforms),
+                               default=0)
+
+    def _map(self, hook, x):
+        n = x.shape[self.axis]
+        if n != len(self.transforms):
+            raise ValueError(
+                f"StackTransform: input has {n} slices along axis "
+                f"{self.axis} but {len(self.transforms)} transforms")
+        parts = [hook(t)(jnp.squeeze(s, self.axis))
+                 for t, s in zip(self.transforms,
+                                 jnp.split(x, n, axis=self.axis))]
+        return jnp.stack(parts, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map(lambda t: t._forward, x)
+
+    def _inverse(self, y):
+        return self._map(lambda t: t._inverse, y)
+
+    def _fldj(self, x):
+        return self._map(lambda t: t._fldj, x)
 
 
 class IndependentTransform(Transform):

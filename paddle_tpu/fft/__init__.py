@@ -11,8 +11,8 @@ from ..ops.common import as_tensor
 
 __all__ = ["fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
            "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
-           "hfft", "ihfft", "fftfreq", "rfftfreq", "fftshift",
-           "ifftshift"]
+           "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+           "fftfreq", "rfftfreq", "fftshift", "ifftshift"]
 
 
 def _norm(norm):
@@ -63,6 +63,38 @@ def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
 
 def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
     return irfftn(x, s=s, axes=axes, norm=norm)
+
+
+def _swap_norm(norm):
+    # Hermitian transforms are the real transforms with time/frequency
+    # domains swapped, so 'backward' and 'forward' normalization swap too
+    # (ortho is self-dual) — the numpy/scipy hfft identity.
+    return {"backward": "forward", "forward": "backward"}[_norm(norm)] \
+        if _norm(norm) != "ortho" else "ortho"
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    """FFT of a signal with Hermitian symmetry over ``axes`` → real
+    output. hfftn(x) == irfftn(conj(x)) under the swapped norm."""
+    def fn(a):
+        return jnp.fft.irfftn(jnp.conj(a), s=s, axes=axes,
+                              norm=_swap_norm(norm))
+    return apply(fn, as_tensor(x), name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    def fn(a):
+        return jnp.conj(jnp.fft.rfftn(a, s=s, axes=axes,
+                                      norm=_swap_norm(norm)))
+    return apply(fn, as_tensor(x), name="ihfftn")
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return hfftn(x, s=s, axes=axes, norm=norm)
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return ihfftn(x, s=s, axes=axes, norm=norm)
 
 
 def fftfreq(n, d=1.0, dtype=None, name=None):

@@ -19,7 +19,8 @@ from ..ops.common import as_tensor
 
 __all__ = ["send_u_recv", "send_ue_recv", "send_uv", "segment_sum",
            "segment_mean", "segment_max", "segment_min", "sample_neighbors",
-           "reindex_graph"]
+           "reindex_graph", "weighted_sample_neighbors",
+           "reindex_heter_graph"]
 
 _SEG = {
     "sum": jax.ops.segment_sum,
@@ -174,6 +175,78 @@ def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
     reindex_src = np.asarray([mapping[int(v)] for v in neigh], "int64")
     # edges are (neighbor -> center); centers repeat per their count
     reindex_dst = np.repeat(np.arange(len(x_np), dtype="int64"), cnt)
+    nodes = np.asarray(sorted(mapping, key=mapping.get), "int64")
+    return (Tensor(jnp.asarray(reindex_src)),
+            Tensor(jnp.asarray(reindex_dst)),
+            Tensor(jnp.asarray(nodes)))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None, return_eids=False,
+                              name=None):
+    """Weighted neighbor sampling from a CSC graph: each neighbor is drawn
+    without replacement with probability proportional to its edge weight
+    (host-side eager op like ``sample_neighbors``; paddle.geometric
+    parity, reference mount empty)."""
+    from ..framework import random as framework_random
+    sub = np.asarray(framework_random.next_key())
+    rng = np.random.RandomState(int(sub[-1]) & 0x7FFFFFFF)
+    row_np = np.asarray(as_tensor(row).numpy())
+    colptr_np = np.asarray(as_tensor(colptr).numpy())
+    w_np = np.asarray(as_tensor(edge_weight).numpy(), dtype="float64")
+    nodes = np.asarray(as_tensor(input_nodes).numpy())
+    eids_np = np.asarray(as_tensor(eids).numpy()) if eids is not None \
+        else None
+    out_neigh, out_cnt, out_eids = [], [], []
+    for v in nodes:
+        beg, end = int(colptr_np[v]), int(colptr_np[v + 1])
+        neigh = row_np[beg:end]
+        ids = np.arange(beg, end)
+        if 0 <= sample_size < len(neigh):
+            w = np.clip(w_np[beg:end], 0.0, None)
+            tot = w.sum()
+            if tot > 0:
+                # zero-weight edges are never picked; if fewer positive
+                # edges than sample_size, take just those (no crash)
+                pos = np.flatnonzero(w)
+                k = min(sample_size, len(pos))
+                pick = rng.choice(pos, k, replace=False, p=w[pos] / tot)
+            else:
+                pick = rng.choice(len(neigh), sample_size, replace=False)
+            neigh, ids = neigh[pick], ids[pick]
+        out_neigh.append(neigh)
+        out_cnt.append(len(neigh))
+        if eids_np is not None:
+            out_eids.append(eids_np[ids])
+    neigh = np.concatenate(out_neigh) if out_neigh else np.zeros(0, "int64")
+    cnt = np.asarray(out_cnt, "int32")
+    res = (Tensor(jnp.asarray(neigh)), Tensor(jnp.asarray(cnt)))
+    if return_eids:
+        ei = np.concatenate(out_eids) if out_eids else np.zeros(0, "int64")
+        res += (Tensor(jnp.asarray(ei)),)
+    return res
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Relabel sampled subgraphs of a heterogeneous graph: ``neighbors``/
+    ``count`` are per-edge-type lists sharing ONE node-id space; the
+    mapping (x first, then first-seen order ACROSS types) is shared so the
+    per-type edge lists stay consistent."""
+    x_np = np.asarray(as_tensor(x).numpy())
+    neighs = [np.asarray(as_tensor(n).numpy()) for n in neighbors]
+    cnts = [np.asarray(as_tensor(c).numpy()) for c in count]
+    mapping: dict[int, int] = {int(v): i for i, v in enumerate(x_np)}
+    for neigh in neighs:
+        for v in neigh:
+            if int(v) not in mapping:
+                mapping[int(v)] = len(mapping)
+    srcs, dsts = [], []
+    for neigh, cnt in zip(neighs, cnts):
+        srcs.append(np.asarray([mapping[int(v)] for v in neigh], "int64"))
+        dsts.append(np.repeat(np.arange(len(x_np), dtype="int64"), cnt))
+    reindex_src = np.concatenate(srcs) if srcs else np.zeros(0, "int64")
+    reindex_dst = np.concatenate(dsts) if dsts else np.zeros(0, "int64")
     nodes = np.asarray(sorted(mapping, key=mapping.get), "int64")
     return (Tensor(jnp.asarray(reindex_src)),
             Tensor(jnp.asarray(reindex_dst)),

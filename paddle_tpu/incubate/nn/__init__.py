@@ -1,8 +1,10 @@
 from . import functional
 from .layers import (FusedMultiHeadAttention, FusedFeedForward,
                      FusedTransformerEncoderLayer, FusedLinear,
-                     FusedBiasDropoutResidualLayerNorm)
+                     FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd,
+                     FusedEcMoe)
 
 __all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
            "FusedTransformerEncoderLayer", "FusedLinear",
-           "FusedBiasDropoutResidualLayerNorm"]
+           "FusedBiasDropoutResidualLayerNorm", "FusedDropoutAdd",
+           "FusedEcMoe"]

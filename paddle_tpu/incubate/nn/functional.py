@@ -345,12 +345,25 @@ def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
                  act_type="gelu", name=None):
     """incubate fused_ec_moe parity: expert-choice style fused MoE FFN
     with biases; dense-compute formulation (every expert computes, the
-    gate combines)."""
+    gate combines). ``gate`` is the per-token expert logits
+    [..., num_experts] (the reference signature); a 2-D [hidden, E]
+    projection weight is also accepted (logits computed in-op)."""
     xt = as_tensor(x)
 
     def fn(a, g, w0, b0, w1, b1):
         b = a.reshape(-1, a.shape[-1])
-        probs = jax.nn.softmax((b @ g).astype(jnp.float32), -1)  # [N, E]
+        # per-token logits share x's leading dims (the documented
+        # signature) — that takes priority over the weight reading when
+        # a square x makes both interpretations shape-check
+        if g.shape[:-1] == a.shape[:-1]:
+            logits = g.reshape(-1, g.shape[-1])      # [N, E]
+        elif g.ndim == 2 and g.shape[0] == b.shape[-1]:
+            logits = b @ g                           # [hidden, E] weight
+        else:
+            logits = g.reshape(-1, g.shape[-1])
+        probs = jax.nn.softmax(logits.astype(jnp.float32), -1)
+        b0 = b0.reshape(b0.shape[0], -1)             # [E,1,F] and [E,F]
+        b1 = b1.reshape(b1.shape[0], -1)
         h = jnp.einsum("nd,edf->nef", b, w0) + b0[None]
         h = jax.nn.gelu(h, approximate=False) if act_type == "gelu" \
             else jnp.maximum(h, 0)
@@ -367,3 +380,22 @@ __all__ += ["fused_bias_dropout_residual_layer_norm",
             "masked_multihead_attention",
             "variable_length_memory_efficient_attention",
             "block_multihead_attention", "fused_moe", "fused_ec_moe"]
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False, transpose_y=False,
+                      name=None):
+    """incubate fused_matmul_bias parity — matmul with optional transposes
+    and epilogue bias add, one XLA-fused op."""
+    def fn(a, b, *rest):
+        a = jnp.swapaxes(a, -1, -2) if transpose_x else a
+        b = jnp.swapaxes(b, -1, -2) if transpose_y else b
+        out = a @ b
+        return out + rest[0] if rest else out
+
+    args = [as_tensor(x), as_tensor(y)]
+    if bias is not None:
+        args.append(as_tensor(bias))
+    return apply(fn, *args, name="fused_matmul_bias")
+
+
+__all__ += ["fused_matmul_bias"]

@@ -152,3 +152,56 @@ class FusedBiasDropoutResidualLayerNorm(Layer):
 
 
 __all__ += ["FusedLinear", "FusedBiasDropoutResidualLayerNorm"]
+
+
+class FusedDropoutAdd(Layer):
+    """incubate.nn.FusedDropoutAdd parity: y = dropout(x) + residual in
+    one fused op (XLA fuses the mask-scale-add chain)."""
+
+    def __init__(self, p=0.5, mode="upscale_in_train", name=None):
+        super().__init__()
+        self.p = p
+        self.mode = mode
+
+    def forward(self, x, y):
+        from .functional import fused_dropout_add
+        return fused_dropout_add(x, y, p=self.p, training=self.training,
+                                 mode=self.mode)
+
+    def extra_repr(self):
+        return f"p={self.p}, mode={self.mode}"
+
+
+class FusedEcMoe(Layer):
+    """incubate.nn.FusedEcMoe parity: fused expert-choice MoE FFN. Owns
+    the per-expert up/down projections; the gate logits come in as an
+    argument (the reference's signature: forward(x, gate))."""
+
+    def __init__(self, hidden_size, inter_size, num_experts,
+                 act_type="gelu", weight_attr=None, bias_attr=None):
+        super().__init__()
+        if act_type not in ("gelu", "relu"):
+            raise ValueError(f"unsupported act_type {act_type!r}")
+        from ...nn import initializer as I
+        self.act_type = act_type
+        self.bmm0_weight = self.create_parameter(
+            [num_experts, hidden_size, inter_size], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bmm0_bias = self.create_parameter(
+            [num_experts, 1, inter_size], attr=bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.bmm1_weight = self.create_parameter(
+            [num_experts, inter_size, hidden_size], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.bmm1_bias = self.create_parameter(
+            [num_experts, 1, hidden_size], attr=bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, x, gate):
+        from .functional import fused_ec_moe
+        return fused_ec_moe(x, gate, self.bmm0_weight,
+                            self.bmm0_bias, self.bmm1_weight,
+                            self.bmm1_bias, act_type=self.act_type)
+
+
+__all__ += ["FusedDropoutAdd", "FusedEcMoe"]

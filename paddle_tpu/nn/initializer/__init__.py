@@ -15,7 +15,7 @@ from ...framework import random as framework_random
 
 __all__ = ["Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
            "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
-           "Assign", "Orthogonal", "Dirac", "calculate_gain",
+           "Assign", "Orthogonal", "Dirac", "Bilinear", "calculate_gain",
            "set_global_initializer"]
 
 
@@ -180,6 +180,31 @@ class Dirac(Initializer):
             for i in range(min(per, ic)):
                 idx = (g * per + i, i) + tuple(s // 2 for s in shape[2:])
                 w[idx] = 1.0
+        return jnp.asarray(w, dtype=to_jax_dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel for transposed-conv weights
+    (paddle.nn.initializer.Bilinear): every (out, in) channel pair gets
+    the same separable triangle kernel, so the layer starts as bilinear
+    interpolation."""
+
+    def __init__(self, name=None):
+        pass
+
+    def __call__(self, shape, dtype):
+        shape = tuple(int(s) for s in shape)
+        if len(shape) != 4:
+            raise ValueError(
+                f"Bilinear initializer needs a 4-D conv weight, got "
+                f"{shape}")
+        kh, kw = shape[2], shape[3]
+        f = math.ceil(kw / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        xs = np.arange(kw, dtype=np.float64)
+        ys = np.arange(kh, dtype=np.float64)
+        kern = np.outer(1 - np.abs(ys / f - c), 1 - np.abs(xs / f - c))
+        w = np.broadcast_to(kern, shape)
         return jnp.asarray(w, dtype=to_jax_dtype(dtype))
 
 

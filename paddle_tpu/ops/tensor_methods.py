@@ -156,6 +156,15 @@ def install_tensor_methods() -> None:
     T.exp_ = lambda self: tape_rebind(self, math.exp(tape_alias(self)))
     T.sqrt_ = lambda self: tape_rebind(self, math.sqrt(tape_alias(self)))
     T.rsqrt_ = lambda self: tape_rebind(self, math.rsqrt(tape_alias(self)))
+    T.index_add_ = lambda self, index, axis, value: tape_rebind(
+        self, manipulation.index_add(tape_alias(self), index, axis, value))
+    T.index_put_ = lambda self, indices, value, accumulate=False: \
+        tape_rebind(self, manipulation.index_put(
+            tape_alias(self), indices, value, accumulate))
+    T.scatter_ = lambda self, index, updates, overwrite=True: tape_rebind(
+        self, manipulation.scatter(tape_alias(self), index, updates,
+                                   overwrite))
+    T.gradient = _gradient
     T.copy_ = _copy_
     T.set_value = _set_value
     T.get_tensor = lambda self: self
@@ -194,6 +203,14 @@ def _apply_(self, func):
     out = func(self)
     data = out._data if isinstance(out, Tensor) else jnp.asarray(out)
     return _inplace_nograd(self, data.astype(self._data.dtype))
+
+
+def _gradient(self):
+    """Legacy ``Tensor.gradient()``: the accumulated grad as a numpy
+    array (None when no grad), paddle 1.x-era API kept for parity."""
+    import numpy as np
+    g = self.grad
+    return None if g is None else np.asarray(g.numpy())
 
 
 def _copy_(self, other, blocking=True):

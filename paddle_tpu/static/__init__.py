@@ -13,7 +13,10 @@ from .passes import (PassManager, register_pass, apply_build_strategy,
 from .extras import (Variable, Scope, global_scope, scope_guard,
                      cpu_places, cuda_places, device_guard, py_func,
                      gradients, append_backward, normalize_program,
-                     save_inference_model, load_inference_model)
+                     save_inference_model, load_inference_model,
+                     ipu_places, npu_places, xpu_places,
+                     WeightNormParamAttr, load_program_state,
+                     set_program_state, save, load)
 from . import nn  # noqa: F401
 
 __all__ = ["enable_static", "disable_static", "in_dynamic_mode", "Program",
@@ -23,4 +26,6 @@ __all__ = ["enable_static", "disable_static", "in_dynamic_mode", "Program",
            "XLA_DELEGATED_PASSES", "Variable", "Scope", "global_scope",
            "scope_guard", "cpu_places", "cuda_places", "device_guard",
            "py_func", "gradients", "append_backward", "normalize_program",
-           "save_inference_model", "load_inference_model"]
+           "save_inference_model", "load_inference_model", "ipu_places",
+           "npu_places", "xpu_places", "WeightNormParamAttr",
+           "load_program_state", "set_program_state", "save", "load"]

@@ -208,3 +208,92 @@ def load_inference_model(path_prefix, executor, **kwargs):
 
     prog.build_fn = build
     return [prog, names["feed"], names["fetch"]]
+
+
+def ipu_places(device_count=None):
+    """API-parity stub: there are no IPUs in a TPU build."""
+    return []
+
+
+def npu_places(device_ids=None):
+    return []
+
+
+def xpu_places(device_ids=None):
+    return []
+
+
+class WeightNormParamAttr:
+    """paddle.static.WeightNormParamAttr parity: a ParamAttr that asks for
+    weight normalization along ``dim``. The dygraph surface applies WN via
+    ``nn.utils.weight_norm``; static layers consume this attr by wrapping
+    their created layer the same way."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        from ..nn.param_attr import ParamAttr
+        self.dim = dim
+        self._attr = ParamAttr(name=name, initializer=initializer,
+                               learning_rate=learning_rate,
+                               regularizer=regularizer, trainable=trainable,
+                               do_model_average=do_model_average,
+                               need_clip=need_clip)
+
+    def __getattr__(self, item):
+        return getattr(self._attr, item)
+
+
+def load_program_state(model_path, var_list=None):
+    """Read a ``static.save`` checkpoint into a name→ndarray dict
+    (upstream load_program_state parity)."""
+    import pickle
+
+    with open(model_path + ".pdparams", "rb") as fh:
+        state = pickle.load(fh)
+    if var_list is not None:
+        names = {getattr(v, "name", v) for v in var_list}
+        state = {k: v for k, v in state.items() if k in names}
+    return state
+
+
+def _program_params(program):
+    params = program.parameters()
+    # stable unique names: layer-slot order, parameter name de-duped
+    out, seen = {}, {}
+    for p in params:
+        name = getattr(p, "name", None) or "param"
+        n = seen.get(name, 0)
+        seen[name] = n + 1
+        out[f"{name}.{n}" if n else name] = p
+    return out
+
+
+def set_program_state(program, state_dict):
+    """Assign a name→ndarray dict onto a Program's parameters
+    (upstream set_program_state parity)."""
+    params = _program_params(program)
+    for name, value in state_dict.items():
+        p = params.get(name)
+        if p is None:
+            continue
+        p._inplace_update(jnp.asarray(np.asarray(value),
+                                      p._data.dtype))
+
+
+def save(program, model_path, protocol=4, **configs):
+    """paddle.static.save parity: parameters → ``model_path.pdparams``
+    (pickle of name→ndarray, same container as paddle.save)."""
+    import pickle
+
+    state = {name: np.asarray(p.numpy())
+             for name, p in _program_params(program).items()}
+    with open(model_path + ".pdparams", "wb") as fh:
+        pickle.dump(state, fh, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """paddle.static.load parity: restore ``static.save`` output into the
+    program's parameters."""
+    set_program_state(program, load_program_state(model_path,
+                                                  var_list=var_list))

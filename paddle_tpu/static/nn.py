@@ -16,7 +16,10 @@ from ..framework.core import Tensor
 from .program import default_main_program
 
 __all__ = ["fc", "conv2d", "conv3d", "batch_norm", "embedding",
-           "layer_norm", "conv2d_transpose", "sequence_expand", "prelu"]
+           "layer_norm", "conv2d_transpose", "sequence_expand", "prelu",
+           "group_norm", "instance_norm", "data_norm", "spectral_norm",
+           "deform_conv2d", "sparse_embedding", "row_conv",
+           "sequence_concat", "nce", "static_pylayer"]
 
 
 def _register(layer_factory):
@@ -26,14 +29,32 @@ def _register(layer_factory):
     return default_main_program()._next_layer(layer_factory)
 
 
+def _unwrap_wn(attr):
+    """Split a possible WeightNormParamAttr into (plain attr, wn dim):
+    static layers consume it by wrapping their created layer with
+    nn.utils.weight_norm."""
+    from .extras import WeightNormParamAttr
+    if isinstance(attr, WeightNormParamAttr):
+        return attr._attr, (attr.dim if attr.dim is not None else 0)
+    return attr, None
+
+
+def _maybe_weight_norm(layer, wn_dim):
+    if wn_dim is not None:
+        from ..nn.utils import weight_norm
+        weight_norm(layer, name="weight", dim=wn_dim)
+    return layer
+
+
 def fc(x, size, num_flatten_dims=1, weight_attr=None, bias_attr=None,
        activation=None, name=None):
     in_features = 1
     for s in x.shape[num_flatten_dims:]:
         in_features *= int(s)
-    layer = _register(lambda: dynn.Linear(in_features, size,
-                                  weight_attr=weight_attr,
-                                  bias_attr=bias_attr))
+    weight_attr, wn_dim = _unwrap_wn(weight_attr)
+    layer = _register(lambda: _maybe_weight_norm(
+        dynn.Linear(in_features, size, weight_attr=weight_attr,
+                    bias_attr=bias_attr), wn_dim))
     from ..ops.manipulation import flatten
     out = layer(flatten(x, num_flatten_dims) if len(x.shape) >
                 num_flatten_dims + 1 else x)
@@ -46,12 +67,14 @@ def conv2d(input, num_filters, filter_size, stride=1, padding=0,
            dilation=1, groups=1, param_attr=None, bias_attr=None,
            act=None, name=None, data_format="NCHW"):
     in_ch = int(input.shape[1 if data_format == "NCHW" else -1])
-    layer = _register(lambda: dynn.Conv2D(in_ch, num_filters, filter_size,
-                                  stride=stride, padding=padding,
-                                  dilation=dilation, groups=groups,
-                                  weight_attr=param_attr,
-                                  bias_attr=bias_attr,
-                                  data_format=data_format))
+    param_attr, wn_dim = _unwrap_wn(param_attr)
+    layer = _register(lambda: _maybe_weight_norm(
+        dynn.Conv2D(in_ch, num_filters, filter_size,
+                    stride=stride, padding=padding,
+                    dilation=dilation, groups=groups,
+                    weight_attr=param_attr,
+                    bias_attr=bias_attr,
+                    data_format=data_format), wn_dim))
     out = layer(input)
     if act:
         out = getattr(dynn.functional, act)(out)
@@ -191,3 +214,230 @@ def sequence_expand(x, y, ref_level=-1, name=None):
     raise NotImplementedError(
         "LoD sequence ops are a parameter-server/CPU-era feature and out "
         "of TPU scope (see PARITY.md known gaps)")
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None, bias_attr=None,
+               act=None, data_layout="NCHW", name=None):
+    ch = int(input.shape[1 if data_layout == "NCHW" else -1])
+    layer = _register(lambda: dynn.GroupNorm(groups, ch, epsilon=epsilon,
+                                             weight_attr=param_attr,
+                                             bias_attr=bias_attr,
+                                             data_format=data_layout))
+    out = layer(input)
+    if act:
+        out = getattr(dynn.functional, act)(out)
+    return out
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    rank = len(input.shape)
+    cls = {5: dynn.InstanceNorm3D, 4: dynn.InstanceNorm2D}.get(
+        rank, dynn.InstanceNorm1D)
+    ch = int(input.shape[1])
+    layer = _register(lambda: cls(ch, epsilon=epsilon,
+                                  weight_attr=param_attr,
+                                  bias_attr=bias_attr))
+    return layer(input)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """CTR-style data normalization: normalize by ACCUMULATED batch
+    statistics (batch_size / batch_sum / batch_square_sum), which are
+    updated per train-mode call — the reference's PS-era op, TPU-side."""
+    ch = int(input.shape[-1])
+    layer = _register(lambda: _DataNorm(ch, epsilon,
+                                        enable_scale_and_shift,
+                                        param_attr))
+    out = layer(input)
+    if act:
+        out = getattr(dynn.functional, act)(out)
+    return out
+
+
+class _DataNorm(dynn.Layer):
+    def __init__(self, ch, epsilon, scale_shift, param_attr):
+        super().__init__()
+        import jax.numpy as jnp
+        self.epsilon = epsilon
+        self.register_buffer("batch_size", Tensor(
+            jnp.full((ch,), 1e4, jnp.float32)))
+        self.register_buffer("batch_sum", Tensor(
+            jnp.zeros((ch,), jnp.float32)))
+        self.register_buffer("batch_square_sum", Tensor(
+            jnp.full((ch,), 1e4, jnp.float32)))
+        self.scale_shift = scale_shift
+        if scale_shift:
+            from ..nn import initializer as I
+            self.scale_w = self.create_parameter(
+                [ch], attr=param_attr, default_initializer=I.Constant(1.0))
+            self.bias = self.create_parameter(
+                [ch], is_bias=True, default_initializer=I.Constant(0.0))
+
+    def forward(self, x):
+        import paddle_tpu as paddle
+        bs = self.batch_size
+        mean = self.batch_sum / bs
+        var = self.batch_square_sum / bs - mean * mean
+        scale = paddle.rsqrt(var + self.epsilon)
+        out = (x - mean) * scale
+        if self.scale_shift:
+            out = out * self.scale_w + self.bias
+        if self.training:
+            n = float(x.shape[0])
+            self.batch_size._inplace_update(
+                (bs + n)._data)
+            self.batch_sum._inplace_update(
+                (self.batch_sum + x.sum(axis=0))._data)
+            self.batch_square_sum._inplace_update(
+                (self.batch_square_sum + (x * x).sum(axis=0))._data)
+        return out
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Returns the spectrally-normalized weight (σ-max estimated by power
+    iteration; the u/v state persists on the Program slot layer)."""
+    shape = [int(s) for s in weight.shape]
+    layer = _register(lambda: dynn.SpectralNorm(shape, axis=dim,
+                                                power_iters=power_iters,
+                                                epsilon=eps))
+    return layer(weight)
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..vision.ops import DeformConv2D
+    in_ch = int(x.shape[1])
+    layer = _register(lambda: DeformConv2D(
+        in_ch, num_filters, filter_size, stride=stride, padding=padding,
+        dilation=dilation, deformable_groups=deformable_groups,
+        groups=groups, weight_attr=param_attr, bias_attr=bias_attr))
+    return layer(x, offset, mask)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None,
+                     name=None):
+    """Parameter-server sparse embedding → dense Embedding on TPU (the
+    distributed sparse table is PS-scope; see PARITY.md known gaps)."""
+    return embedding(input, size, padding_idx=padding_idx,
+                     param_attr=param_attr, dtype=dtype)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None,
+             name=None):
+    """Lookahead row convolution (DeepSpeech2): out[t] = sum_{i<=fc}
+    w[i] * x[t+i] over a [B, T, D] input."""
+    d = int(input.shape[-1])
+    layer = _register(lambda: _RowConv(d, future_context_size, param_attr))
+    out = layer(input)
+    if act:
+        out = getattr(dynn.functional, act)(out)
+    return out
+
+
+class _RowConv(dynn.Layer):
+    def __init__(self, d, future_context_size, param_attr):
+        super().__init__()
+        from ..nn import initializer as I
+        self.fc = int(future_context_size)
+        self.weight = self.create_parameter(
+            [self.fc + 1, d], attr=param_attr,
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, x):
+        from ..framework.core import apply
+        import jax.numpy as jnp
+        fc = self.fc
+
+        def fn(a, w):
+            t = a.shape[1]
+            out = jnp.zeros_like(a)
+            for i in range(fc + 1):
+                seg = a[:, i:t, :] * w[i]
+                out = out.at[:, :t - i, :].add(seg)
+            return out
+        return apply(fn, x, self.weight, name="row_conv")
+
+
+def sequence_concat(input, name=None):
+    raise NotImplementedError(
+        "LoD sequence ops are a parameter-server/CPU-era feature and out "
+        "of TPU scope (see PARITY.md known gaps)")
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=10, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss with uniform negative sampling:
+    -log σ(s_y) - Σ_neg log σ(-s_k) per example."""
+    d = int(input.shape[-1])
+    layer = _register(lambda: _NCE(d, num_total_classes, num_neg_samples,
+                                   param_attr, bias_attr))
+    return layer(input, label)
+
+
+class _NCE(dynn.Layer):
+    def __init__(self, d, num_classes, num_neg, param_attr, bias_attr):
+        super().__init__()
+        from ..nn import initializer as I
+        self.num_classes = num_classes
+        self.num_neg = num_neg
+        self.weight = self.create_parameter(
+            [num_classes, d], attr=param_attr,
+            default_initializer=I.XavierNormal())
+        self.bias = self.create_parameter(
+            [num_classes], attr=bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+
+    def forward(self, x, label):
+        from ..framework.core import apply
+        from ..framework import random as framework_random
+        import jax
+        import jax.numpy as jnp
+        key = framework_random.default_generator.next_key()
+        num_neg, num_classes = self.num_neg, self.num_classes
+
+        def fn(a, lab, w, b):
+            lab = lab.reshape(-1)
+            pos = jnp.sum(a * w[lab], -1) + b[lab]
+            neg_ids = jax.random.randint(
+                key, (a.shape[0], num_neg), 0, num_classes)
+            neg = jnp.einsum("bd,bkd->bk", a, w[neg_ids]) + b[neg_ids]
+            loss = -jax.nn.log_sigmoid(pos) \
+                - jnp.sum(jax.nn.log_sigmoid(-neg), -1)
+            return loss[:, None]
+        return apply(fn, x, label, self.weight, self.bias, name="nce")
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """paddle.static.nn.static_pylayer parity: run ``forward_fn`` with a
+    custom backward. Desugars to autograd.PyLayer (the dygraph custom-vjp
+    machinery IS the static one here — programs are captured replays)."""
+    from ..autograd import PyLayer
+
+    if backward_fn is None:
+        outs = forward_fn(*inputs)
+        outs_t = outs if isinstance(outs, (list, tuple)) else (outs,)
+        detached = [o.detach() if hasattr(o, "detach") else o
+                    for o in outs_t]
+        return detached if isinstance(outs, (list, tuple)) else detached[0]
+
+    class _StaticPyLayer(PyLayer):
+        @staticmethod
+        def forward(ctx, *args):
+            return forward_fn(*args)
+
+        @staticmethod
+        def backward(ctx, *grads):
+            return backward_fn(*grads)
+
+    return _StaticPyLayer.apply(*inputs)

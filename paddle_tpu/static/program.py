@@ -67,11 +67,15 @@ class Program:
 
         def build(feed):
             self._slot_idx = 0
+            self._building = True
             tensors = {k: (v if isinstance(v, Tensor)
                            else Tensor(jnp.asarray(v)))
                        for k, v in feed.items()}
-            with program_guard(self):
-                out = fn(tensors)
+            try:
+                with program_guard(self):
+                    out = fn(tensors)
+            finally:
+                self._building = False
             self._has_run = True
             return out
         self.build_fn = build

@@ -877,3 +877,21 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
 
 
 __all__ += ["yolo_loss"]
+
+
+class PSRoIPool:
+    """Position-sensitive RoI pooling layer over ``psroi_pool``
+    (paddle.vision.ops.PSRoIPool parity)."""
+
+    def __new__(cls, output_size, spatial_scale=1.0):
+        from ..nn.layer.layers import Layer
+
+        class _P(Layer):
+            def forward(self, x, boxes, boxes_num):
+                return psroi_pool(x, boxes, boxes_num, output_size,
+                                  spatial_scale)
+
+        return _P()
+
+
+__all__ += ["PSRoIPool"]

@@ -1,0 +1,475 @@
+"""Round-3 long-tail tranche C: hermitian FFTs, LKJCholesky /
+StackTransform / ExponentialFamily, geometric heter-graph ops,
+PSRoIPool, Bilinear init, incubate fused layers, static save/load +
+static.nn legacy layers, dist.split / shard_optimizer / PS datasets,
+Tensor inplace long tail."""
+
+import numpy as np
+import pytest
+import scipy.fft as sfft
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+class TestHermitianFFT:
+    def test_hfft2_matches_scipy(self):
+        rng = np.random.RandomState(0)
+        a = (rng.randn(4, 6) + 1j * rng.randn(4, 6)).astype(np.complex64)
+        for norm in ("backward", "ortho", "forward"):
+            out = paddle.fft.hfft2(paddle.to_tensor(a), norm=norm)
+            np.testing.assert_allclose(
+                np.asarray(out.numpy()), sfft.hfft2(a, norm=norm),
+                rtol=2e-3, atol=2e-3)
+
+    def test_ihfft2_matches_scipy(self):
+        r = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+        for norm in ("backward", "ortho", "forward"):
+            out = paddle.fft.ihfft2(paddle.to_tensor(r), norm=norm)
+            np.testing.assert_allclose(
+                np.asarray(out.numpy()), sfft.ihfft2(r, norm=norm),
+                rtol=1e-4, atol=1e-5)
+
+    def test_hfftn_ihfftn_roundtrip_shapes(self):
+        rng = np.random.RandomState(2)
+        a = (rng.randn(3, 4, 5) + 1j * rng.randn(3, 4, 5)).astype(
+            np.complex64)
+        out = paddle.fft.hfftn(paddle.to_tensor(a))
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   sfft.hfftn(a), rtol=2e-3, atol=2e-3)
+        back = paddle.fft.ihfftn(out)
+        assert back.shape == list(sfft.ihfftn(np.asarray(out.numpy())).shape)
+
+
+class TestDistributionLongTail:
+    def test_lkj_cholesky_samples_valid(self):
+        paddle.seed(0)
+        d = paddle.distribution.LKJCholesky(4, concentration=2.0)
+        L = np.asarray(d.sample((8,)).numpy())
+        corr = L @ np.swapaxes(L, -1, -2)
+        np.testing.assert_allclose(
+            np.diagonal(corr, axis1=-2, axis2=-1), 1.0, atol=1e-5)
+        # lower-triangular with positive diagonal
+        assert np.allclose(np.triu(L, 1), 0.0, atol=1e-6)
+        assert (np.diagonal(L, axis1=-2, axis2=-1) > 0).all()
+
+    def test_lkj_log_prob_uniform_at_concentration_one(self):
+        # at concentration 1 the density over correlation matrices is
+        # uniform → log_prob depends only on the jacobian diag terms
+        d = paddle.distribution.LKJCholesky(3, concentration=1.0)
+        paddle.seed(1)
+        s = d.sample((2,))
+        lp = np.asarray(d.log_prob(s).numpy())
+        assert lp.shape == (2,) and np.isfinite(lp).all()
+
+    def test_lkj_dim2_concentration1_marginal_uniform(self):
+        # at dim=2, c=1 the correlation r is uniform on [-1, 1]:
+        # r = L[1,0], and r² ~ Beta(1/2, 1)  →  E[r²] = 1/3
+        paddle.seed(7)
+        d = paddle.distribution.LKJCholesky(2, concentration=1.0)
+        L = np.asarray(d.sample((4000,)).numpy())
+        r = L[:, 1, 0]
+        assert abs(r.mean()) < 0.05
+        np.testing.assert_allclose((r ** 2).mean(), 1.0 / 3.0, atol=0.03)
+
+    def test_stack_transform(self):
+        st = paddle.distribution.StackTransform(
+            [paddle.distribution.ExpTransform(),
+             paddle.distribution.AffineTransform(0.0, 3.0)], axis=0)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        y = np.asarray(st.forward(x).numpy())
+        np.testing.assert_allclose(y[0], np.e, rtol=1e-5)
+        np.testing.assert_allclose(y[1], 3.0, rtol=1e-6)
+        back = np.asarray(st.inverse(st.forward(x)).numpy())
+        np.testing.assert_allclose(back, 1.0, rtol=1e-5)
+
+    def test_exponential_family_entropy_via_bregman(self):
+        import jax.numpy as jnp
+
+        class _NormalEF(paddle.distribution.ExponentialFamily):
+            # N(μ, σ²) with η = (μ/σ², −1/(2σ²)), t(x) = (x, x²),
+            # h(x) = 1/√(2π) so E[log h] is a constant
+            def __init__(self, loc, scale):
+                self.loc = paddle.to_tensor(loc)
+                self.scale = paddle.to_tensor(scale)
+                self._mean_carrier_measure = -0.5 * np.log(2 * np.pi)
+
+            @property
+            def _natural_parameters(self):
+                var = self.scale * self.scale
+                return (self.loc / var, -0.5 / var)
+
+            def _log_normalizer(self, e1, e2):
+                return (-e1 * e1 / (4 * e2)
+                        + 0.5 * jnp.log(jnp.pi / (-e2))
+                        - 0.5 * jnp.log(2 * jnp.pi))
+
+        ent = np.asarray(
+            _NormalEF(np.float32(1.7), np.float32(1.3)).entropy().numpy())
+        expect = 0.5 * np.log(2 * np.pi * np.e * 1.3 ** 2)
+        np.testing.assert_allclose(ent, expect, rtol=1e-5)
+
+
+class TestGeometricLongTail:
+    def _csc(self):
+        # graph: 0<-1, 0<-2, 1<-2 (rows = sources per dst column)
+        row = paddle.to_tensor(np.array([1, 2, 2], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 2, 3, 3], np.int64))
+        return row, colptr
+
+    def test_weighted_sample_neighbors(self):
+        row, colptr = self._csc()
+        w = paddle.to_tensor(np.array([1.0, 100.0, 1.0], np.float32))
+        paddle.seed(0)
+        neigh, cnt = paddle.geometric.weighted_sample_neighbors(
+            row, colptr, w, paddle.to_tensor(np.array([0], np.int64)),
+            sample_size=1)
+        assert int(cnt.numpy()[0]) == 1
+        # heavily-weighted neighbor 2 dominates
+        assert int(neigh.numpy()[0]) in (1, 2)
+
+    def test_weighted_sample_zero_weight_edges_skipped(self):
+        # node 0 has neighbors [0, 1, 2] but only neighbor 1 has positive
+        # weight — sampling 2 must return just that one, not crash on
+        # 'fewer non-zero entries in p than size'
+        row = paddle.to_tensor(np.array([0, 1, 2], np.int64))
+        colptr = paddle.to_tensor(np.array([0, 3, 3, 3], np.int64))
+        w = paddle.to_tensor(np.array([0.0, 5.0, 0.0], np.float32))
+        paddle.seed(3)
+        neigh, cnt = paddle.geometric.weighted_sample_neighbors(
+            row, colptr, w, paddle.to_tensor(np.array([0], np.int64)),
+            sample_size=2)
+        assert int(cnt.numpy()[0]) == 1
+        assert int(neigh.numpy()[0]) == 1
+
+    def test_reindex_heter_graph(self):
+        x = paddle.to_tensor(np.array([10, 11], np.int64))
+        n1 = paddle.to_tensor(np.array([20, 10], np.int64))
+        c1 = paddle.to_tensor(np.array([1, 1], np.int32))
+        n2 = paddle.to_tensor(np.array([30], np.int64))
+        c2 = paddle.to_tensor(np.array([1, 0], np.int32))
+        src, dst, nodes = paddle.geometric.reindex_heter_graph(
+            x, [n1, n2], [c1, c2])
+        assert list(nodes.numpy()) == [10, 11, 20, 30]
+        assert list(src.numpy()) == [2, 0, 3]
+        assert list(dst.numpy()) == [0, 1, 0]
+
+
+class TestVisionInitIncubate:
+    def test_psroi_pool_layer(self):
+        layer = paddle.vision.ops.PSRoIPool(2, 1.0)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 4 * 3, 6, 6).astype(
+                np.float32))
+        boxes = paddle.to_tensor(np.array([[0, 0, 5, 5]], np.float32))
+        num = paddle.to_tensor(np.array([1], np.int32))
+        out = layer(x, boxes, num)
+        assert list(out.shape) == [1, 3, 2, 2]
+
+    def test_bilinear_initializer(self):
+        w = np.asarray(paddle.nn.initializer.Bilinear()((1, 1, 4, 4),
+                                                        "float32"))
+        # separable triangle kernel, symmetric, peak in the middle
+        np.testing.assert_allclose(w[0, 0], w[0, 0].T, rtol=1e-6)
+        assert w[0, 0, 1:3, 1:3].min() > w[0, 0, 0, 0]
+
+    def test_fused_ec_moe_layer_gate_logits(self):
+        paddle.seed(0)
+        m = paddle.incubate.nn.FusedEcMoe(8, 16, 4, act_type="gelu")
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, 8).astype(np.float32))
+        gate = paddle.to_tensor(
+            np.random.RandomState(1).randn(2, 3, 4).astype(np.float32))
+        out = m(x, gate)
+        assert list(out.shape) == [2, 3, 8]
+        assert np.isfinite(np.asarray(out.numpy())).all()
+
+    def test_fused_ec_moe_square_x_prefers_logits(self):
+        # x has as many tokens as hidden dims: the per-token logits
+        # reading (documented signature) must win over the weight one
+        paddle.seed(0)
+        E, d = 4, 6
+        m = paddle.incubate.nn.FusedEcMoe(d, 8, E)
+        x = paddle.to_tensor(
+            np.random.RandomState(5).randn(d, d).astype(np.float32))
+        one_hot = np.full((d, E), -1e9, np.float32)
+        one_hot[:, 1] = 0.0  # route everything to expert 1
+        out = np.asarray(m(x, paddle.to_tensor(one_hot)).numpy())
+        w0 = np.asarray(m.bmm0_weight.numpy())[1]
+        b0 = np.asarray(m.bmm0_bias.numpy())[1].reshape(-1)
+        w1 = np.asarray(m.bmm1_weight.numpy())[1]
+        b1 = np.asarray(m.bmm1_bias.numpy())[1].reshape(-1)
+        from scipy.special import erf
+        h = np.asarray(x.numpy()) @ w0 + b0
+        h = 0.5 * h * (1 + erf(h / np.sqrt(2)))
+        expect = h @ w1 + b1
+        np.testing.assert_allclose(out, expect, rtol=2e-4, atol=2e-5)
+
+    def test_fused_dropout_add_eval_identity(self):
+        m = paddle.incubate.nn.FusedDropoutAdd(p=0.9)
+        m.eval()
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        np.testing.assert_allclose(np.asarray(m(x, x).numpy()), 2.0)
+
+    def test_fused_matmul_bias_transposes(self):
+        rng = np.random.RandomState(2)
+        a = rng.randn(3, 4).astype(np.float32)
+        b = rng.randn(5, 4).astype(np.float32)
+        bias = rng.randn(5).astype(np.float32)
+        out = paddle.incubate.nn.functional.fused_matmul_bias(
+            paddle.to_tensor(a), paddle.to_tensor(b),
+            paddle.to_tensor(bias), transpose_y=True)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   a @ b.T + bias, rtol=1e-5, atol=1e-5)
+
+
+class TestStaticLongTail:
+    def test_places_and_weightnorm_attr(self):
+        assert static.ipu_places() == []
+        assert static.npu_places() == []
+        assert static.xpu_places() == []
+        attr = static.WeightNormParamAttr(dim=0, name="w")
+        assert attr.dim == 0 and attr.name == "w"
+
+    def test_weight_norm_param_attr_applied(self):
+        prog = static.Program()
+
+        @prog.capture
+        def build(feed):
+            return {"o": static.nn.fc(
+                feed["x"], 4,
+                weight_attr=static.WeightNormParamAttr(dim=1))}
+
+        exe = static.Executor()
+        exe.run(prog, feed={"x": np.ones((2, 3), np.float32)},
+                fetch_list=["o"])
+        layer = prog._layer_slots[0]
+        names = [n for n, _ in layer.named_parameters()]
+        assert any("weight_g" in n for n in names), names
+
+    def test_save_load_roundtrip(self, tmp_path):
+        prog = static.Program()
+
+        @prog.capture
+        def build(feed):
+            return {"out": static.nn.fc(feed["x"], 3)}
+
+        exe = static.Executor()
+        x = np.ones((2, 4), np.float32)
+        out0 = exe.run(prog, feed={"x": x}, fetch_list=["out"])[0]
+        path = str(tmp_path / "ckpt")
+        static.save(prog, path)
+        state = static.load_program_state(path)
+        # perturb, then restore
+        static.set_program_state(
+            prog, {k: np.zeros_like(v) for k, v in state.items()})
+        zeroed = exe.run(prog, feed={"x": x}, fetch_list=["out"])[0]
+        np.testing.assert_allclose(zeroed, 0.0)
+        static.load(prog, path)
+        out1 = exe.run(prog, feed={"x": x}, fetch_list=["out"])[0]
+        np.testing.assert_allclose(out1, out0, rtol=1e-6)
+
+    def test_static_nn_norm_layers(self):
+        prog = static.Program()
+
+        @prog.capture
+        def build(feed):
+            h = static.nn.group_norm(feed["x"], 2)
+            h = static.nn.instance_norm(h)
+            h = static.nn.data_norm(h.reshape([2, -1]))
+            return {"out": h}
+
+        exe = static.Executor()
+        x = np.random.RandomState(0).randn(2, 4, 5, 5).astype(np.float32)
+        out = exe.run(prog, feed={"x": x}, fetch_list=["out"])[0]
+        assert out.shape == (2, 100) and np.isfinite(out).all()
+
+    def test_static_nn_nce_and_row_conv(self):
+        prog = static.Program()
+
+        @prog.capture
+        def build(feed):
+            loss = static.nn.nce(feed["h"], feed["y"], 12,
+                                 num_neg_samples=3)
+            rc = static.nn.row_conv(feed["t"], 2)
+            return {"loss": loss, "rc": rc}
+
+        exe = static.Executor()
+        h = np.random.RandomState(1).randn(4, 6).astype(np.float32)
+        y = np.random.RandomState(2).randint(0, 12, (4, 1)).astype(
+            np.int64)
+        t = np.ones((2, 5, 3), np.float32)
+        loss, rc = exe.run(prog, feed={"h": h, "y": y, "t": t},
+                           fetch_list=["loss", "rc"])
+        assert loss.shape == (4, 1) and np.isfinite(loss).all()
+        np.testing.assert_allclose(rc, 0.0)  # zero-init lookahead weight
+
+    def test_static_nn_spectral_norm_unit_sigma(self):
+        prog = static.Program()
+
+        @prog.capture
+        def build(feed):
+            return {"o": static.nn.spectral_norm(feed["w"], dim=0,
+                                                 power_iters=20)}
+
+        exe = static.Executor()
+        w = np.random.RandomState(3).randn(6, 4).astype(np.float32) * 5
+        o = exe.run(prog, feed={"w": w}, fetch_list=["o"])[0]
+        sigma = np.linalg.svd(o, compute_uv=False)[0]
+        np.testing.assert_allclose(sigma, 1.0, atol=0.1)
+
+    def test_static_pylayer_custom_backward(self):
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        x.stop_gradient = False
+        out = static.nn.static_pylayer(
+            lambda a: a * 2, [x], backward_fn=lambda g: g * 10)
+        out.sum().backward()
+        np.testing.assert_allclose(np.asarray(x.grad.numpy()), 10.0)
+
+    def test_sparse_embedding_desugars(self):
+        prog = static.Program()
+
+        @prog.capture
+        def build(feed):
+            return {"e": static.nn.sparse_embedding(feed["ids"], [16, 4])}
+
+        exe = static.Executor()
+        ids = np.array([[1, 2]], np.int64)
+        e = exe.run(prog, feed={"ids": ids}, fetch_list=["e"])[0]
+        assert e.shape == (1, 2, 4)
+
+
+class TestDistributedLongTail:
+    def test_split_linear_and_embedding_eager(self):
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        out = paddle.distributed.split(x, (4, 6), operation="linear",
+                                       axis=1)
+        assert list(out.shape) == [2, 6]
+        ids = paddle.to_tensor(np.array([[0, 3]], np.int64))
+        emb = paddle.distributed.split(ids, (10, 3),
+                                       operation="embedding")
+        assert list(emb.shape) == [1, 2, 3]
+
+    def test_split_reuses_weights_inside_program(self):
+        prog = static.Program()
+
+        @prog.capture
+        def build(feed):
+            return {"o": paddle.distributed.split(
+                feed["x"], (4, 5), operation="linear", axis=1)}
+
+        exe = static.Executor()
+        x = np.ones((2, 4), np.float32)
+        a = exe.run(prog, feed={"x": x}, fetch_list=["o"])[0]
+        b = exe.run(prog, feed={"x": x}, fetch_list=["o"])[0]
+        np.testing.assert_allclose(a, b)
+
+    def test_shard_optimizer_wraps_and_steps(self):
+        m = paddle.nn.Linear(4, 4)
+        calls = []
+
+        def shard_fn(name, param, acc):
+            calls.append(name)
+            return acc
+
+        opt = paddle.distributed.shard_optimizer(
+            paddle.optimizer.AdamW(1e-3, parameters=m.parameters()),
+            shard_fn)
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        m(x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+        assert calls, "shard_fn never invoked on new accumulators"
+
+    def test_shard_optimizer_replaces_after_state_restore(self):
+        m = paddle.nn.Linear(3, 3)
+        placed = []
+        opt = paddle.distributed.shard_optimizer(
+            paddle.optimizer.AdamW(1e-3, parameters=m.parameters()),
+            lambda name, p, acc: placed.append(name) or acc)
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        m(x).sum().backward()
+        opt.step()
+        n_first = len(placed)
+        assert n_first > 0
+        # restoring state overwrites accumulator tensors in place — the
+        # wrapper must re-place them all, not skip via the stale cache
+        opt.set_state_dict(opt.state_dict())
+        assert len(placed) >= 2 * n_first
+
+    def test_split_validates_num_partitions(self):
+        from paddle_tpu.distributed import fleet
+        strategy = fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": -1, "mp_degree": 2,
+                                   "pp_degree": 1, "sharding_degree": 1,
+                                   "sep_degree": 1, "ep_degree": 1}
+        fleet.init(is_collective=True, strategy=strategy)
+        try:
+            x = paddle.to_tensor(np.ones((2, 4), np.float32))
+            with pytest.raises(ValueError):
+                paddle.distributed.split(x, (4, 6), operation="linear",
+                                         axis=1, num_partitions=3)
+            out = paddle.distributed.split(x, (4, 6), operation="linear",
+                                           axis=1, num_partitions=2)
+            assert list(out.shape) == [2, 6]
+        finally:
+            fleet.fleet._hcg = None
+            fleet.fleet._topology = None
+            fleet.fleet._is_initialized = False
+
+    def test_placement_export(self):
+        assert issubclass(paddle.distributed.Shard,
+                          paddle.distributed.Placement)
+
+    def test_inmemory_dataset(self, tmp_path):
+        f = tmp_path / "slots.txt"
+        f.write_text("1 2.5 3\n4 5 6\n7 8 9\n")
+        ds = paddle.distributed.InMemoryDataset()
+        ds.init(batch_size=2, use_var=["a", "b", "c"])
+        ds.set_filelist([str(f)])
+        ds.load_into_memory()
+        assert ds.get_memory_data_size() == 3
+        ds.local_shuffle()
+        total = sum(len(b) for b in ds)
+        assert total == 3
+        ds.release_memory()
+
+    def test_queue_dataset_pipe_command(self, tmp_path):
+        f = tmp_path / "slots.txt"
+        f.write_text("1 2\n3 4\n5 6\n")
+        ds = paddle.distributed.QueueDataset()
+        ds.init(batch_size=2, pipe_command="head -2")
+        ds.set_filelist([str(f)])
+        assert sum(len(b) for b in ds) == 2
+
+    def test_gloo_barrier_single_process(self):
+        paddle.distributed.gloo_barrier()  # no-op at world size 1
+
+
+class TestTensorInplaceLongTail:
+    def test_index_add_(self):
+        t = paddle.to_tensor(np.zeros((3, 4), np.float32))
+        t.index_add_(paddle.to_tensor(np.array([0, 2])), 0,
+                     paddle.to_tensor(np.ones((2, 4), np.float32)))
+        assert np.asarray(t.numpy()).sum() == 8
+
+    def test_index_put_(self):
+        t = paddle.to_tensor(np.zeros(5, np.float32))
+        t.index_put_([paddle.to_tensor(np.array([1, 3]))],
+                     paddle.to_tensor(np.array([7.0, 8.0], np.float32)))
+        assert np.asarray(t.numpy())[3] == 8
+
+    def test_scatter_(self):
+        t = paddle.to_tensor(np.zeros((4, 2), np.float32))
+        t.scatter_(paddle.to_tensor(np.array([2, 1])),
+                   paddle.to_tensor(np.ones((2, 2), np.float32)))
+        got = np.asarray(t.numpy())
+        assert got[2, 0] == 1 and got[1, 1] == 1 and got[0, 0] == 0
+
+    def test_gradient_legacy(self):
+        x = paddle.to_tensor(np.ones(3, np.float32))
+        x.stop_gradient = False
+        (x * x).sum().backward()
+        np.testing.assert_allclose(x.gradient(), 2.0)
+        y = paddle.to_tensor(np.ones(2, np.float32))
+        assert y.gradient() is None
