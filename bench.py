@@ -78,6 +78,9 @@ def _train_bench(on_tpu, dev):
             cfg = LlamaConfig.llama_2_4b()
             batch, seq = 2, 2048
         cfg.scan_layers = False  # unrolled beats lax.scan on-chip today
+        # (scan also OOMs at full depth: stacking weights into [L, ...]
+        # transiently doubles parameter memory). Flash block sizes come
+        # from the FLAGS defaults (256/512, tuned for this config).
         steps, warmup = 10, 3
     else:
         cfg = LlamaConfig.tiny()

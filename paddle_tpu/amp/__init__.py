@@ -75,5 +75,64 @@ class debugging:
         import jax
         jax.config.update("jax_debug_nans", False)
 
+    class DebugMode:
+        """amp.debugging.DebugMode enum parity (the TensorChecker
+        granularity knobs; CHECK_ALL is the only behavior here — jax
+        debug_nans checks every op)."""
+        CHECK_NAN_INF_AND_ABORT = 0
+        CHECK_NAN_INF = 1
+        CHECK_ALL_FOR_OVERFLOW = 2
+        CHECK_ALL = 3
+        CHECK_ALL_AND_ABORT = 4
+        DUMP_ALL = 5
+
+    @staticmethod
+    def check_layer_numerics(layer):
+        """Decorates a Layer so every forward output is numerics-checked
+        (amp.debugging.check_layer_numerics parity)."""
+        orig = layer.forward
+
+        def wrapped(*a, **k):
+            out = orig(*a, **k)
+            from ..framework.core import Tensor
+            outs = out if isinstance(out, (tuple, list)) else (out,)
+            for t in outs:
+                if isinstance(t, Tensor):
+                    debugging.check_numerics(
+                        t, op_type=type(layer).__name__)
+            return out
+        layer.forward = wrapped
+        return layer
+
+    @staticmethod
+    def compare_accuracy(dump_path, another_dump_path, output_filename,
+                         loss_scale=1.0, dump_all_module=False):
+        """amp.debugging.compare_accuracy parity: diff two op-stats JSONL
+        dumps (from collect_operator_stats runs) and write a report of
+        ops whose counts/dtypes diverge."""
+        import json
+        import os
+
+        def load(path):
+            rows = {}
+            with open(path) as fh:
+                for line in fh:
+                    if line.strip():
+                        rec = json.loads(line)
+                        rows[rec.get("op", repr(rec))] = rec
+            return rows
+
+        a, b = load(dump_path), load(another_dump_path)
+        report = []
+        for op in sorted(set(a) | set(b)):
+            ra, rb = a.get(op), b.get(op)
+            if ra != rb:
+                report.append({"op": op, "run1": ra, "run2": rb})
+        os.makedirs(os.path.dirname(output_filename) or ".",
+                    exist_ok=True)
+        with open(output_filename, "w") as fh:
+            json.dump(report, fh, indent=1)
+        return report
+
 
 __all__ += ["is_float16_supported", "is_bfloat16_supported", "debugging"]

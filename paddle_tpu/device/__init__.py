@@ -211,3 +211,27 @@ class xpu:
 __all__ += ["Stream", "Event", "current_stream", "set_stream",
             "stream_guard", "is_compiled_with_rocm",
             "get_available_custom_device", "xpu"]
+
+
+def get_cudnn_version():
+    """No CUDA in a TPU build (upstream returns None when not compiled
+    with CUDA)."""
+    return None
+
+
+def get_all_device_type():
+    import jax
+    kinds = {"cpu"}
+    try:
+        kinds.add(jax.default_backend())
+    except Exception:
+        pass
+    return sorted(kinds)
+
+
+def get_all_custom_device_type():
+    return []
+
+
+__all__ += ["get_cudnn_version", "get_all_device_type",
+            "get_all_custom_device_type"]

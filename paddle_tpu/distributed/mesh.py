@@ -252,6 +252,8 @@ class _ShardOptimizer:
         self._placed: set[int] = set()
 
     def __getattr__(self, name):
+        if name == "_inner":  # deepcopy/pickle probe before __init__
+            raise AttributeError(name)
         return getattr(self._inner, name)
 
     def _place_new_state(self):
@@ -281,8 +283,9 @@ class _ShardOptimizer:
             self._placed.add(id(t))
 
     def step(self, *a, **k):
-        self._inner.step(*a, **k)  # LBFGS-style step(closure) passthrough
+        out = self._inner.step(*a, **k)  # LBFGS step(closure) → loss
         self._place_new_state()
+        return out
 
     def minimize(self, loss, *a, **k):
         out = self._inner.minimize(loss, *a, **k)

@@ -94,9 +94,11 @@ define_flag("FLAGS_tpu_matmul_precision", "default",
             "Matmul precision: default|high|highest (maps to jax precision).")
 define_flag("FLAGS_enable_pallas_kernels", True,
             "Use Pallas kernels (flash-attn, rms_norm, rope) when on TPU.")
-# 512/512 measured best on v5e for the Llama bench shapes (69.9% MFU vs
-# 54.2% at 128/128); both kernels clamp to the padded sequence length
-define_flag("FLAGS_flash_attn_block_q", 512, "Pallas flash-attn q block.")
+# 256/512 measured best on v5e at hidden 2560 under remat (59.3% vs
+# 57.4% MFU at 512/512 on the 4-layer tuning slice, 2026-07-31; the
+# earlier 512/512 pick was tuned on the no-remat 0.89B config). Both
+# kernels clamp to the padded sequence length.
+define_flag("FLAGS_flash_attn_block_q", 256, "Pallas flash-attn q block.")
 define_flag("FLAGS_flash_attn_block_kv", 512, "Pallas flash-attn kv block.")
 define_flag("FLAGS_recompute_policy", "dots_saveable",
             "jax.checkpoint policy for recompute()/use_recompute: "

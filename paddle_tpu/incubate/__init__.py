@@ -6,11 +6,13 @@ from . import nn
 from . import autograd
 from . import asp
 from . import optimizer
+from . import autotune
 from .nn import functional
-from .optimizer import LookAhead, ModelAverage
+from .optimizer import LookAhead, ModelAverage, DistributedFusedLamb
 
 __all__ = ["nn", "autograd", "functional", "optimizer", "LookAhead",
-           "ModelAverage", "softmax_mask_fuse",
+           "ModelAverage", "softmax_mask_fuse", "autotune",
+           "DistributedFusedLamb",
            "graph_send_recv", "segment_sum", "segment_mean", "segment_max",
            "segment_min"]
 

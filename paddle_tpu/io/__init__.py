@@ -704,3 +704,22 @@ class DataLoader:
                         futures.append(pool.submit(load, next(it)))
                     except StopIteration:
                         it = None
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample the given indices in random order (paddle.io parity)."""
+
+    def __init__(self, indices):
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import random
+        order = list(self.indices)
+        random.shuffle(order)
+        return iter(order)
+
+    def __len__(self):
+        return len(self.indices)
+
+
+__all__.append("SubsetRandomSampler")

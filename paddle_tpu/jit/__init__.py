@@ -20,4 +20,11 @@ def set_verbosity(level=0, also_to_stdout=False):
         logging.DEBUG if level and int(level) > 0 else logging.WARNING)
 
 
-__all__ += ["enable_to_static", "set_verbosity"]
+def set_code_level(level=100, also_to_stdout=False):
+    """dy2static transformed-code logging (upstream prints the rewritten
+    source at each transform stage). Captured programs here have no
+    rewritten source; this maps to the same transform logger."""
+    set_verbosity(1 if level else 0, also_to_stdout)
+
+
+__all__ += ["enable_to_static", "set_verbosity", "set_code_level"]

@@ -75,10 +75,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     use_pallas = (_use_pallas() and attn_mask is None and dropout_p == 0.0
                   and q.shape[1] == k.shape[1])
     if use_pallas:
+        from jax import ad_checkpoint
+
         from ...ops.pallas import flash_attention as fa
 
         def fn(qq, kk, vv):
-            return fa.flash_attention(qq, kk, vv, causal=is_causal)
+            out = fa.flash_attention(qq, kk, vv, causal=is_causal)
+            # name the kernel output so the opt-in remat policy
+            # FLAGS_recompute_policy='dots_and_flash_saveable' can save
+            # it (under dots_saveable a checkpointed layer re-runs the
+            # flash forward in backward — it is not a dot)
+            return ad_checkpoint.checkpoint_name(out, "flash_out")
         return apply(fn, q, k, v, name="flash_attention")
 
     key_rng = None

@@ -280,3 +280,93 @@ class HSigmoidLoss(Layer):
 
 __all__ += ["SoftMarginLoss", "MultiMarginLoss",
             "TripletMarginWithDistanceLoss", "HSigmoidLoss"]
+
+
+class RNNTLoss(Layer):
+    """RNN-T transducer loss layer over ``F.rnnt_loss``
+    (paddle.nn.RNNTLoss parity)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.001, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           blank=self.blank,
+                           fastemit_lambda=self.fastemit_lambda,
+                           reduction=self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax layer (paddle.nn.AdaptiveLogSoftmaxWithLoss):
+    owns the head + per-cluster down-projected tail weights, forwards to
+    ``F.adaptive_log_softmax_with_loss``. Returns (output, loss)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, weight_attr=None, bias_attr=None,
+                 name=None):
+        super().__init__()
+        from .. import initializer as I
+        cutoffs = list(cutoffs)
+        if not cutoffs or cutoffs != sorted(set(cutoffs)) \
+                or cutoffs[-1] >= n_classes:
+            raise ValueError(
+                f"cutoffs must be unique, increasing, < n_classes "
+                f"({n_classes}); got {cutoffs}")
+        self.in_features = in_features
+        self.n_classes = n_classes
+        self.cutoffs = cutoffs + [n_classes]
+        self.div_value = div_value
+        head_size = cutoffs[0] + len(self.cutoffs) - 1
+        self.head_weight = self.create_parameter(
+            [in_features, head_size], attr=weight_attr,
+            default_initializer=I.XavierNormal())
+        self.head_bias = self.create_parameter(
+            [head_size], attr=bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0)) if head_bias else None
+        self.tail_weights = []
+        for i in range(len(self.cutoffs) - 1):
+            hsz = max(int(in_features // (div_value ** (i + 1))), 1)
+            osz = self.cutoffs[i + 1] - self.cutoffs[i]
+            proj = self.create_parameter(
+                [in_features, hsz], attr=weight_attr,
+                default_initializer=I.XavierNormal())
+            out = self.create_parameter(
+                [hsz, osz], attr=weight_attr,
+                default_initializer=I.XavierNormal())
+            # register under stable names so state_dict round-trips
+            setattr(self, f"tail_proj_{i}", proj)
+            setattr(self, f"tail_out_{i}", out)
+            self.tail_weights.append([proj, out])
+
+    def forward(self, input, label):
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, self.tail_weights,
+            self.cutoffs[:-1], head_bias=self.head_bias)
+
+    def log_prob(self, input):
+        """Full [N, n_classes] log-probabilities (head + tails)."""
+        import paddle_tpu as paddle
+        head = paddle.matmul(input, self.head_weight)
+        if self.head_bias is not None:
+            head = head + self.head_bias
+        head_lp = F.log_softmax(head, axis=-1)
+        shortlist = head_lp[:, :self.cutoffs[0]]
+        parts = [shortlist]
+        n_tail = len(self.cutoffs) - 1
+        for i in range(n_tail):
+            cluster_lp = head_lp[:, self.cutoffs[0] + i]
+            h = paddle.matmul(paddle.matmul(input, self.tail_weights[i][0]),
+                              self.tail_weights[i][1])
+            parts.append(F.log_softmax(h, axis=-1)
+                         + cluster_lp.unsqueeze(-1))
+        return paddle.concat(parts, axis=-1)
+
+    def predict(self, input):
+        return self.log_prob(input).argmax(axis=-1)
+
+
+__all__ += ["RNNTLoss", "AdaptiveLogSoftmaxWithLoss"]

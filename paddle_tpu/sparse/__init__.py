@@ -355,31 +355,158 @@ def pow(x, factor, name=None):
     return apply(lambda v: v ** factor, as_tensor(x), name="pow")
 
 
-class _SparseNN:
-    """``paddle.sparse.nn`` namespace (ReLU / Softmax on values)."""
-
-    class ReLU:
-        def __call__(self, x):
-            return relu(x)
-
-    class Softmax:
-        """Row-wise softmax over a 2-D sparse pattern."""
-
-        def __init__(self, axis=-1):
-            if axis != -1:
-                raise NotImplementedError("sparse softmax: axis=-1 only")
-
-        def __call__(self, x):
-            xc = _as_coo(x)
-            rows = xc.indices_.jax()[0]
-            n_rows = xc.shape[0]
-
-            def fn(v):
-                rmax = jax.ops.segment_max(v, rows, num_segments=n_rows)
-                e = jnp.exp(v - rmax[rows])
-                rsum = jax.ops.segment_sum(e, rows, num_segments=n_rows)
-                return e / rsum[rows]
-            return xc._apply_values(fn, "sparse_softmax")
+from . import nn  # noqa: E402 — layers need the ops above
 
 
-nn = _SparseNN()
+# --------------------------------------------------------------------------
+# round-3 long tail: cast / isnan / sum / reshape / slice / mask_as
+# --------------------------------------------------------------------------
+
+def cast(x, index_dtype=None, value_dtype=None, name=None):
+    """paddle.sparse.cast parity: cast indices and/or values."""
+    from ..framework.core import to_jax_dtype
+    xc = _as_coo(x)
+    idx = xc.indices_
+    if index_dtype is not None:
+        idx = Tensor(idx.jax().astype(to_jax_dtype(index_dtype)))
+    vals = xc.values_
+    if value_dtype is not None:
+        vals = apply(lambda v: v.astype(to_jax_dtype(value_dtype)), vals,
+                     name="sparse_cast")
+    out = SparseCooTensor(idx, vals, xc.shape)
+    if isinstance(x, SparseCsrTensor) and len(xc.shape) == 2:
+        out = out.to_sparse_csr()
+        if index_dtype is not None:
+            # the round-trip rebuilds crows as int64 — apply the
+            # requested index dtype to BOTH compressed arrays
+            jdt = to_jax_dtype(index_dtype)
+            out.crows_ = Tensor(out.crows_.jax().astype(jdt))
+            out.cols_ = Tensor(out.cols_.jax().astype(jdt))
+    return out
+
+
+def isnan(x, name=None):
+    """Elementwise isnan on the stored values (pattern unchanged)."""
+    xc = _as_coo(x)
+    return xc._apply_values(jnp.isnan, "sparse_isnan")
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    """paddle.sparse.sum: reduce over all dims → dense scalar; over one
+    axis → sparse result with that dim dropped (or kept size-1)."""
+    from ..framework.core import to_jax_dtype
+    xc = _as_coo(x)
+    cast_to = None if dtype is None else to_jax_dtype(dtype)
+    if axis is None:
+        return apply(lambda v: jnp.sum(
+            v if cast_to is None else v.astype(cast_to)), xc.values_,
+            name="sparse_sum")
+    ax = int(axis) % len(xc.shape)
+    idx = np.asarray(xc.indices_.jax())
+    rest = [i for i in range(len(xc.shape)) if i != ax]
+    if not rest:  # 1-D: scalar-per-pattern → dense 0-d / size-1
+        return apply(lambda v: jnp.sum(
+            v if cast_to is None else v.astype(cast_to),
+            keepdims=keepdim), xc.values_, name="sparse_sum")
+    rest_shape = [xc.shape[i] for i in rest]
+    keys = np.ravel_multi_index(tuple(idx[rest]), tuple(rest_shape))
+    uniq, inv = np.unique(keys, return_inverse=True)
+    new_idx = np.stack(np.unravel_index(uniq, tuple(rest_shape)))
+
+    def fn(v):
+        if cast_to is not None:
+            v = v.astype(cast_to)
+        return jax.ops.segment_sum(v, jnp.asarray(inv),
+                                   num_segments=len(uniq))
+    vals = apply(fn, xc.values_, name="sparse_sum")
+    shape = rest_shape
+    if keepdim:
+        new_idx = np.insert(new_idx, ax, 0, axis=0)
+        shape = list(xc.shape)
+        shape[ax] = 1
+    out = SparseCooTensor(Tensor(jnp.asarray(new_idx)), vals, shape)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) and \
+        len(shape) == 2 else out
+
+
+def reshape(x, shape, name=None):
+    """Reshape a sparse COO tensor: indices re-derived through the flat
+    ravel order (values untouched — autograd flows)."""
+    xc = _as_coo(x)
+    shape = [int(s) for s in shape]
+    size = int(np.prod(xc.shape))
+    neg = [i for i, s in enumerate(shape) if s == -1]
+    if neg:
+        known = -int(np.prod(shape))
+        shape[neg[0]] = size // known
+    if int(np.prod(shape)) != size:
+        raise ValueError(f"sparse.reshape: cannot reshape {xc.shape} "
+                         f"into {shape}")
+    idx = np.asarray(xc.indices_.jax())
+    flat = np.ravel_multi_index(tuple(idx), tuple(xc.shape))
+    new_idx = np.stack(np.unravel_index(flat, tuple(shape)))
+    out = SparseCooTensor(Tensor(jnp.asarray(new_idx)), xc.values_,
+                          shape)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) and \
+        len(shape) == 2 else out
+
+
+def slice(x, axes, starts, ends, name=None):
+    """paddle.sparse.slice parity: keep entries inside [start, end) per
+    sliced axis, shift indices (host-side pattern op; values keep
+    autograd via a gather)."""
+    xc = _as_coo(x)
+    idx = np.asarray(xc.indices_.jax())
+    shape = list(xc.shape)
+    def _resolve(st, dim):
+        st = int(st) if st >= 0 else int(st) + dim
+        return min(max(st, 0), dim)  # clamp like dense paddle.slice
+
+    keep = np.ones(idx.shape[1], bool)
+    for ax, st, en in zip(axes, starts, ends):
+        ax = int(ax) % len(shape)
+        st = _resolve(st, shape[ax])
+        en = _resolve(en, shape[ax])
+        keep &= (idx[ax] >= st) & (idx[ax] < en)
+        shape[ax] = max(en - st, 0)
+    sel = np.flatnonzero(keep)
+    new_idx = idx[:, sel].copy()
+    for ax, st, _ in zip(axes, starts, ends):
+        ax = int(ax) % len(xc.shape)
+        new_idx[ax] -= _resolve(st, xc.shape[ax])
+    vals = apply(lambda v: v[jnp.asarray(sel)], xc.values_,
+                 name="sparse_slice")
+    out = SparseCooTensor(Tensor(jnp.asarray(new_idx)), vals, shape)
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) and \
+        len(shape) == 2 else out
+
+
+def mask_as(x, mask, name=None):
+    """paddle.sparse.mask_as: take dense ``x``'s entries at ``mask``'s
+    sparsity pattern."""
+    m = _as_coo(mask)
+    idx = m.indices_.jax()
+
+    def fn(d):
+        return d[tuple(idx[i] for i in range(idx.shape[0]))]
+    vals = apply(fn, as_tensor(x), name="sparse_mask_as")
+    out = SparseCooTensor(m.indices_, vals, m.shape)
+    return out.to_sparse_csr() if isinstance(mask, SparseCsrTensor) and \
+        len(m.shape) == 2 else out
+
+
+def relu6(x, name=None):
+    xc = _as_coo(x)
+    return xc._apply_values(lambda v: jnp.clip(v, 0.0, 6.0),
+                            "sparse_relu6")
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    xc = _as_coo(x)
+    return xc._apply_values(
+        lambda v: jnp.where(v >= 0, v, negative_slope * v),
+        "sparse_leaky_relu")
+
+
+__all__ += ["cast", "isnan", "sum", "reshape", "slice", "mask_as",
+            "relu6", "leaky_relu"]

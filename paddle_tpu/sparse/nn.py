@@ -1,0 +1,376 @@
+"""``paddle.sparse.nn`` — layers over sparse COO tensors (upstream
+python/paddle/sparse/nn/, UNVERIFIED; SURVEY.md §2.2 paddle.sparse row;
+PHI sparse conv kernels in §2.1).
+
+TPU-native stance: XLA has no sparse-conv HLO, and on TPU the MXU wants
+dense tiles — so the convolutions here are DENSE-COMPUTE with a
+structural occupancy pattern: densify the active sites, run
+``lax.conv_general_dilated`` (channels-last, the sparse-world layout),
+and re-sparsify at the structurally-reachable output sites (Conv*) or
+the input's own sites (SubmConv*, the submanifold contract). Pattern
+bookkeeping is host-side eager (patterns are data prep); the value
+compute path is jax-differentiable end to end."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply
+from ..nn.layer.layers import Layer
+from ..nn import initializer as I
+from . import SparseCooTensor, _as_coo
+
+__all__ = ["ReLU", "ReLU6", "LeakyReLU", "Softmax", "BatchNorm",
+           "SyncBatchNorm", "Conv2D", "Conv3D", "SubmConv2D",
+           "SubmConv3D", "MaxPool3D", "functional"]
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        from . import relu
+        return relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        from . import relu6
+        return relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01):
+        super().__init__()
+        self.negative_slope = negative_slope
+
+    def forward(self, x):
+        from . import leaky_relu
+        return leaky_relu(x, self.negative_slope)
+
+
+class Softmax(Layer):
+    """Row-wise softmax over a 2-D sparse pattern."""
+
+    def __init__(self, axis=-1):
+        super().__init__()
+        if axis != -1:
+            raise NotImplementedError("sparse softmax: axis=-1 only")
+
+    def forward(self, x):
+        xc = _as_coo(x)
+        rows = xc.indices_.jax()[0]
+        n_rows = xc.shape[0]
+
+        def fn(v):
+            rmax = jax.ops.segment_max(v, rows, num_segments=n_rows)
+            e = jnp.exp(v - rmax[rows])
+            rsum = jax.ops.segment_sum(e, rows, num_segments=n_rows)
+            return e / rsum[rows]
+        return xc._apply_values(fn, "sparse_softmax")
+
+
+class BatchNorm(Layer):
+    """BatchNorm over sparse values [nnz, C]: per-channel statistics of
+    the STORED entries (the sparse-conv convention — implicit zeros do
+    not contribute), running stats for eval."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 use_global_stats=None, name=None):
+        super().__init__()
+        self.momentum = momentum
+        self.epsilon = epsilon
+        self.use_global_stats = use_global_stats
+        self.weight = self.create_parameter(
+            [num_features], attr=weight_attr,
+            default_initializer=I.Constant(1.0))
+        self.bias = self.create_parameter(
+            [num_features], attr=bias_attr, is_bias=True,
+            default_initializer=I.Constant(0.0))
+        self.register_buffer("_mean", Tensor(
+            jnp.zeros((num_features,), jnp.float32)))
+        self.register_buffer("_variance", Tensor(
+            jnp.ones((num_features,), jnp.float32)))
+
+    def forward(self, x):
+        xc = _as_coo(x)
+        training = self.training and not self.use_global_stats
+        w, b = self.weight, self.bias
+        eps, mom = self.epsilon, self.momentum
+        rm, rv = self._mean, self._variance
+        n_ch = int(w.shape[0])
+        # fully-sparse layout: the channel is the LAST index row and
+        # values are flat [nnz] — per-channel stats are segment reduces.
+        # The NORMALIZATION stats must be computed inside the traced fn
+        # so backward carries the d(mean)/dv and d(var)/dv terms (real
+        # train-mode BN semantics); the RUNNING buffers are a host-side
+        # bincount over the same values — a cheap O(nnz) numpy pass
+        # (these pattern layers are eager ops; patterns are host data).
+        ch = xc.indices_.jax()[-1]
+
+        def fn(v, wj, bj):
+            if training:
+                cnt = jnp.clip(jax.ops.segment_sum(
+                    jnp.ones_like(v), ch, num_segments=n_ch), 1.0, None)
+                mean = jax.ops.segment_sum(
+                    v, ch, num_segments=n_ch) / cnt
+                varb = jax.ops.segment_sum(
+                    (v - mean[ch]) ** 2, ch, num_segments=n_ch) / cnt
+            else:
+                mean, varb = rm._data, rv._data
+            return (v - mean[ch]) / jnp.sqrt(varb[ch] + eps) * wj[ch] \
+                + bj[ch]
+        out = apply(fn, xc.values_, w, b, name="sparse_batch_norm")
+        if training:
+            v = np.asarray(xc.values_.jax(), np.float32)
+            chn = np.asarray(ch)
+            raw_cnt = np.bincount(chn, minlength=n_ch)
+            cnt = np.maximum(raw_cnt, 1)
+            mean = np.bincount(chn, weights=v, minlength=n_ch) / cnt
+            varb = np.bincount(chn, weights=(v - mean[chn]) ** 2,
+                               minlength=n_ch) / cnt
+            varb = np.where(raw_cnt > 0, varb, 1.0)
+            rm._inplace_update(
+                (mom * rm._data
+                 + (1 - mom) * jnp.asarray(mean, jnp.float32)))
+            rv._inplace_update(
+                (mom * rv._data
+                 + (1 - mom) * jnp.asarray(varb, jnp.float32)))
+        return SparseCooTensor(xc.indices_, out, xc.shape)
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-replica BatchNorm: under GSPMD the value statistics are
+    computed on the global (unsharded) nnz axis, so plain BatchNorm IS
+    sync — kept as a distinct class for API parity."""
+
+
+def _occupancy(idx, shape):
+    dense = np.zeros(shape, np.float32)
+    dense[tuple(idx)] = 1.0
+    return dense
+
+
+class _SparseConvND(Layer):
+    """Shared dense-compute sparse conv (see module docstring)."""
+
+    def __init__(self, nd, in_channels, out_channels, kernel_size,
+                 stride, padding, dilation, groups, subm,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        if groups != 1:
+            raise NotImplementedError("sparse conv: groups=1 only")
+        to_tup = (lambda v: (v,) * nd if isinstance(v, int)
+                  else tuple(v))
+        self.nd = nd
+        self.kernel_size = to_tup(kernel_size)
+        self.stride = to_tup(1) if subm else to_tup(stride)
+        self.padding = to_tup(padding)
+        self.dilation = to_tup(dilation)
+        self.subm = subm
+        fan_in = in_channels * int(np.prod(self.kernel_size))
+        bound = 1.0 / fan_in ** 0.5
+        # channels-last kernel [*k, in, out] — the sparse-world layout
+        self.weight = self.create_parameter(
+            [*self.kernel_size, in_channels, out_channels],
+            attr=weight_attr, default_initializer=I.Uniform(-bound, bound))
+        self.bias = None if bias_attr is False else self.create_parameter(
+            [out_channels], attr=bias_attr, is_bias=True,
+            default_initializer=I.Uniform(-bound, bound))
+
+    def _dims(self):
+        if self.nd == 2:
+            return ("NHWC", "HWIO", "NHWC")
+        return ("NDHWC", "DHWIO", "NDHWC")
+
+    def forward(self, x):
+        xc = _as_coo(x)
+        idx = np.asarray(xc.indices_.jax())
+        shape = tuple(xc.shape)          # [N, *spatial, C]
+        pad = [(p, p) for p in self.padding]
+        dims = jax.lax.conv_dimension_numbers(
+            (1,) + shape[1:], tuple(self.weight.shape), self._dims())
+
+        def fn(v, w, *rest):
+            dense = jnp.zeros(shape, v.dtype)
+            dense = dense.at[tuple(idx)].set(v)
+            out = jax.lax.conv_general_dilated(
+                dense, w, window_strides=self.stride, padding=pad,
+                rhs_dilation=self.dilation, dimension_numbers=dims)
+            if rest:
+                out = out + rest[0]
+            return out
+
+        args = [xc.values_, self.weight]
+        if self.bias is not None:
+            args.append(self.bias)
+        dense_out = apply(fn, *args, name="sparse_conv")
+
+        out_ch = int(self.weight.shape[-1])
+        if self.subm:
+            # submanifold: output SPATIAL pattern == input spatial
+            # pattern (dedup the per-channel rows), all out channels
+            sites = np.unique(idx[:-1].T, axis=0)
+            out_shape = shape[:-1] + (out_ch,)
+        else:
+            # structural occupancy: which output sites see any input site
+            occ = _occupancy(idx[:-1], shape[:-1])[..., None]
+            ones = np.ones(tuple(self.kernel_size) + (1, 1), np.float32)
+            reach = jax.lax.conv_general_dilated(
+                jnp.asarray(occ), jnp.asarray(ones),
+                window_strides=self.stride, padding=pad,
+                rhs_dilation=self.dilation,
+                dimension_numbers=jax.lax.conv_dimension_numbers(
+                    occ.shape, ones.shape, self._dims()))
+            sites = np.argwhere(np.asarray(reach)[..., 0] > 0)
+            out_shape = tuple(int(s) for s in reach.shape[:-1]) \
+                + (out_ch,)
+        # fully-sparse output: channel is an index row, values are flat
+        out_idx = np.concatenate(
+            [np.repeat(sites, out_ch, 0),
+             np.tile(np.arange(out_ch), len(sites))[:, None]], axis=1).T
+        vals = apply(
+            lambda d: d[tuple(jnp.asarray(out_idx[i])
+                              for i in range(out_idx.shape[0]))],
+            dense_out, name="sparse_conv_gather")
+        return SparseCooTensor(Tensor(jnp.asarray(out_idx)), vals,
+                               list(out_shape))
+
+
+class Conv2D(_SparseConvND):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NHWC"):
+        super().__init__(2, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, subm=False,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class SubmConv2D(_SparseConvND):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NHWC"):
+        super().__init__(2, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, subm=True,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class Conv3D(_SparseConvND):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format="NDHWC"):
+        super().__init__(3, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, subm=False,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class SubmConv3D(_SparseConvND):
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 key=None, weight_attr=None, bias_attr=None,
+                 data_format="NDHWC"):
+        super().__init__(3, in_channels, out_channels, kernel_size,
+                         stride, padding, dilation, groups, subm=True,
+                         weight_attr=weight_attr, bias_attr=bias_attr)
+
+
+class MaxPool3D(Layer):
+    """Sparse max pooling on [N, D, H, W, C]: dense window reduce over
+    the active sites (implicit zeros excluded via -inf fill), output at
+    the structurally-occupied pooled sites."""
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        to_tup = (lambda v: (v,) * 3 if isinstance(v, int) else tuple(v))
+        self.kernel = to_tup(kernel_size)
+        self.stride = to_tup(stride if stride is not None else kernel_size)
+        self.padding = to_tup(padding)
+
+    def forward(self, x):
+        xc = _as_coo(x)
+        idx = np.asarray(xc.indices_.jax())
+        shape = tuple(xc.shape)
+        window = (1,) + self.kernel + (1,)
+        strides = (1,) + self.stride + (1,)
+        pads = ((0, 0),) + tuple((p, p) for p in self.padding) + ((0, 0),)
+
+        def fn(v):
+            dense = jnp.full(shape, -jnp.inf, v.dtype)
+            dense = dense.at[tuple(idx)].set(v)
+            return jax.lax.reduce_window(
+                dense, jnp.asarray(-jnp.inf, v.dtype), jax.lax.max,
+                window, strides, pads)
+        dense_out = apply(fn, xc.values_, name="sparse_maxpool")
+
+        # PER-CHANNEL occupancy (channel window is 1): a channel with no
+        # stored entry in a window gets NO output entry — enumerating
+        # every channel at each reachable spatial site would gather the
+        # -inf fill
+        occ = _occupancy(idx, shape)
+        reach = jax.lax.reduce_window(
+            jnp.asarray(occ), np.float32(0), jax.lax.max,
+            window, strides, pads)
+        out_idx = np.argwhere(np.asarray(reach) > 0).T
+        vals = apply(
+            lambda d: d[tuple(jnp.asarray(out_idx[i])
+                              for i in range(out_idx.shape[0]))],
+            dense_out, name="sparse_maxpool_gather")
+        out_shape = [int(s) for s in np.asarray(reach).shape]
+        return SparseCooTensor(Tensor(jnp.asarray(out_idx)), vals,
+                               out_shape)
+
+
+class _Functional:
+    """``paddle.sparse.nn.functional`` — functional mirrors."""
+
+    @staticmethod
+    def relu(x, name=None):
+        from . import relu as _relu
+        return _relu(x)
+
+    @staticmethod
+    def relu6(x, name=None):
+        from . import relu6 as _relu6
+        return _relu6(x)
+
+    @staticmethod
+    def leaky_relu(x, negative_slope=0.01, name=None):
+        from . import leaky_relu as _lr
+        return _lr(x, negative_slope)
+
+    @staticmethod
+    def softmax(x, axis=-1, name=None):
+        return Softmax(axis)(x)
+
+    @staticmethod
+    def attention(query, key, value, sparse_mask, key_padding_mask=None,
+                  attn_mask=None, name=None):
+        """paddle.sparse.nn.functional.attention parity: q/k/v
+        [B, H, S, D], ``sparse_mask`` a CSR tensor giving the attention
+        pattern — one shared [S, S] pattern, or [B*H, S, S] batched
+        (crows [B*H, S+1] / cols flattened). Bridges to the dense-masked
+        ``nn.functional.sparse_attention`` kernel (MXU-friendly)."""
+        from ..nn.functional import sparse_attention
+        from . import SparseCsrTensor
+
+        if not isinstance(sparse_mask, SparseCsrTensor):
+            raise TypeError("sparse_mask must be a SparseCsrTensor")
+        b, h = int(query.shape[0]), int(query.shape[1])
+        crows = jnp.asarray(sparse_mask.crows_.jax())
+        cols = jnp.asarray(sparse_mask.cols_.jax())
+        if crows.ndim == 1:  # one shared pattern → broadcast over B, H
+            off = jnp.broadcast_to(crows, (b, h) + crows.shape)
+            col = jnp.broadcast_to(cols, (b, h) + cols.shape)
+        else:  # [B*H, S+1] batched pattern (uniform nnz per head)
+            off = crows.reshape(b, h, -1)
+            col = cols.reshape(b, h, -1)
+        return sparse_attention(query, key, value, Tensor(off),
+                                Tensor(col), key_padding_mask, attn_mask)
+
+
+functional = _Functional()

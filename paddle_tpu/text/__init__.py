@@ -384,3 +384,7 @@ class WMT16(_WMTBase):
 
 
 __all__ += ["Conll05st", "Movielens", "WMT14", "WMT16"]
+
+
+from . import datasets  # noqa: E402,F401 — upstream import-path parity
+__all__ += ["datasets"]

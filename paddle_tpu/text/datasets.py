@@ -1,0 +1,10 @@
+"""``paddle.text.datasets`` — dataset classes namespace (upstream keeps
+the dataset classes in a submodule; they live in ``paddle_tpu.text``
+directly, re-exported here for import-path parity)."""
+
+from . import __all__ as _text_all  # noqa: F401
+from . import (UCIHousing, Imdb, Imikolov, Movielens, Conll05st,  # noqa
+               WMT14, WMT16)
+
+__all__ = ["UCIHousing", "Imdb", "Imikolov", "Movielens", "Conll05st",
+           "WMT14", "WMT16"]

@@ -236,3 +236,116 @@ class ImageFolder(Dataset):
         if self.transform is not None:
             sample = self.transform(sample)
         return [sample]
+
+
+class Flowers(Dataset):
+    """Oxford 102 Flowers (upstream paddle.vision.datasets.Flowers).
+    Cache-only like the rest of this module: reads the upstream
+    ``102flowers.tgz``-extracted jpg directory + ``imagelabels.mat`` /
+    ``setid.mat`` if present, else ``backend='generate'``."""
+
+    NUM_CLASSES = 102
+    IMAGE_SHAPE = (64, 64, 3)
+
+    def __init__(self, data_file=None, label_file=None, setid_file=None,
+                 mode="train", transform=None, download=True,
+                 backend=None):
+        assert mode in ("train", "valid", "test")
+        self.mode = mode
+        self.transform = transform
+        if backend == "generate":
+            n = {"train": 1000, "valid": 200, "test": 400}[mode]
+            g = _GeneratedSplit(n, self.IMAGE_SHAPE, self.NUM_CLASSES,
+                                seed={"train": 0, "valid": 1,
+                                      "test": 2}[mode])
+            self.images, self.labels = g.images, g.labels
+            return
+        import scipy.io as sio
+        root = data_file or os.path.join(WEIGHTS_HOME, "flowers")
+        label_file = label_file or os.path.join(root, "imagelabels.mat")
+        setid_file = setid_file or os.path.join(root, "setid.mat")
+        for path in (label_file, setid_file):
+            if not os.path.exists(path):
+                _missing("Flowers", path)
+        labels = sio.loadmat(label_file)["labels"].ravel()
+        setid = sio.loadmat(setid_file)
+        ids = {"train": setid["trnid"], "valid": setid["valid"],
+               "test": setid["tstid"]}[mode].ravel()
+        self.ids = ids
+        self.root = root
+        self.labels = (labels[ids - 1] - 1).astype("int64")
+        self.images = None  # lazy jpg loads
+
+    def __len__(self):
+        if self.images is not None:
+            return len(self.images)
+        return len(self.ids)
+
+    def __getitem__(self, idx):
+        if self.images is not None:
+            img = self.images[idx]
+        else:
+            from .ops import read_file, decode_jpeg
+            path = os.path.join(self.root, "jpg",
+                                f"image_{self.ids[idx]:05d}.jpg")
+            img = np.asarray(decode_jpeg(read_file(path)).numpy())
+        label = self.labels[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+
+class VOC2012(Dataset):
+    """Pascal VOC 2012 segmentation pairs (upstream
+    paddle.vision.datasets.VOC2012): (image, segmentation-mask). Cache-
+    only; ``backend='generate'`` yields synthetic pairs offline."""
+
+    IMAGE_SHAPE = (64, 64, 3)
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        assert mode in ("train", "valid", "test")
+        self.mode = mode
+        self.transform = transform
+        if backend == "generate":
+            n = {"train": 200, "valid": 50, "test": 50}[mode]
+            g = _GeneratedSplit(n, self.IMAGE_SHAPE, 21,
+                                seed={"train": 3, "valid": 4,
+                                      "test": 5}[mode])
+            self.images = g.images
+            # synthetic masks: threshold the image mean into 21 classes
+            self.masks = (g.images.mean(-1) / 255.0 * 20).astype("int64")
+            return
+        root = data_file or os.path.join(WEIGHTS_HOME, "voc2012")
+        split_file = os.path.join(
+            root, "ImageSets", "Segmentation",
+            {"train": "train.txt", "valid": "val.txt",
+             "test": "val.txt"}[mode])
+        if not os.path.exists(split_file):
+            _missing("VOC2012", split_file)
+        with open(split_file) as fh:
+            self.names = [ln.strip() for ln in fh if ln.strip()]
+        self.root = root
+        self.images = None
+
+    def __len__(self):
+        return len(self.images) if self.images is not None \
+            else len(self.names)
+
+    def __getitem__(self, idx):
+        if self.images is not None:
+            img, mask = self.images[idx], self.masks[idx]
+        else:
+            from .ops import read_file, decode_jpeg
+            name = self.names[idx]
+            img = np.asarray(decode_jpeg(read_file(os.path.join(
+                self.root, "JPEGImages", name + ".jpg"))).numpy())
+            from PIL import Image as _Image
+            mask = np.asarray(_Image.open(os.path.join(
+                self.root, "SegmentationClass", name + ".png")))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, mask
+
+
+__all__ += ["Flowers", "VOC2012"]

@@ -562,7 +562,7 @@ def _channel_shuffle(x, groups):
 
 
 class _ShuffleUnit(Layer):
-    def __init__(self, c_in, c_out, stride):
+    def __init__(self, c_in, c_out, stride, act=ReLU):
         super().__init__()
         self.stride = stride
         branch = c_out // 2
@@ -570,16 +570,16 @@ class _ShuffleUnit(Layer):
             self.branch1 = Sequential(
                 _conv_bn(c_in, c_in, 3, stride=2, padding=1, groups=c_in,
                          act=None),
-                _conv_bn(c_in, branch, 1))
+                _conv_bn(c_in, branch, 1, act=act))
             c_in2 = c_in
         else:
             self.branch1 = None
             c_in2 = c_in // 2
         self.branch2 = Sequential(
-            _conv_bn(c_in2, branch, 1),
+            _conv_bn(c_in2, branch, 1, act=act),
             _conv_bn(branch, branch, 3, stride=stride, padding=1,
                      groups=branch, act=None),
-            _conv_bn(branch, branch, 1))
+            _conv_bn(branch, branch, 1, act=act))
 
     def forward(self, x):
         from ..ops.manipulation import concat, split
@@ -592,24 +592,28 @@ class _ShuffleUnit(Layer):
 
 
 class ShuffleNetV2(Layer):
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
         super().__init__()
-        stage_out = {0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
+        stage_out = {0.25: [24, 48, 96, 512], 0.33: [32, 64, 128, 512],
+                     0.5: [48, 96, 192, 1024], 1.0: [116, 232, 464, 1024],
                      1.5: [176, 352, 704, 1024],
                      2.0: [244, 488, 976, 2048]}[scale]
         repeats = [4, 8, 4]
-        self.conv1 = _conv_bn(3, 24, 3, stride=2, padding=1)
+        from ..nn.layer.activation import Swish
+        act_cls = {"relu": ReLU, "swish": Swish}[act]
+        self.conv1 = _conv_bn(3, 24, 3, stride=2, padding=1, act=act_cls)
         self.maxpool = MaxPool2D(3, stride=2, padding=1)
         c_in = 24
         stages = []
         for r, c_out in zip(repeats, stage_out[:3]):
-            units = [_ShuffleUnit(c_in, c_out, 2)]
+            units = [_ShuffleUnit(c_in, c_out, 2, act=act_cls)]
             for _ in range(r - 1):
-                units.append(_ShuffleUnit(c_out, c_out, 1))
+                units.append(_ShuffleUnit(c_out, c_out, 1, act=act_cls))
             stages.append(Sequential(*units))
             c_in = c_out
         self.stages = Sequential(*stages)
-        self.conv_last = _conv_bn(c_in, stage_out[3], 1)
+        self.conv_last = _conv_bn(c_in, stage_out[3], 1, act=act_cls)
         self.with_pool = with_pool
         if with_pool:
             self.pool = AdaptiveAvgPool2D(1)
@@ -752,3 +756,209 @@ class GoogLeNet(Layer):
 
 def googlenet(pretrained=False, **kwargs):
     return GoogLeNet(**kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 50, width=4, groups=64, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 101, width=4, groups=64, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 152, width=4, groups=32, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    return ResNet(BottleneckBlock, 152, width=4, groups=64, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    kwargs.setdefault("growth_rate", 48)
+    return DenseNet(layers=161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return DenseNet(layers=169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return DenseNet(layers=201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return DenseNet(layers=264, **kwargs)
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)
+
+
+class _InceptionA(Layer):
+    def __init__(self, c_in, pool_feat):
+        super().__init__()
+        self.b1 = _conv_bn(c_in, 64, 1)
+        self.b5 = Sequential(_conv_bn(c_in, 48, 1),
+                             _conv_bn(48, 64, 5, padding=2))
+        self.b3 = Sequential(_conv_bn(c_in, 64, 1),
+                             _conv_bn(64, 96, 3, padding=1),
+                             _conv_bn(96, 96, 3, padding=1))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             _conv_bn(c_in, pool_feat, 1))
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)], 1)
+
+
+class _InceptionB(Layer):
+    """Grid reduction 35->17."""
+
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3 = _conv_bn(c_in, 384, 3, stride=2)
+        self.b3d = Sequential(_conv_bn(c_in, 64, 1),
+                              _conv_bn(64, 96, 3, padding=1),
+                              _conv_bn(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b3(x), self.b3d(x), self.pool(x)], 1)
+
+
+class _InceptionC(Layer):
+    def __init__(self, c_in, c7):
+        super().__init__()
+        self.b1 = _conv_bn(c_in, 192, 1)
+        self.b7 = Sequential(
+            _conv_bn(c_in, c7, 1),
+            _conv_bn(c7, c7, (1, 7), padding=(0, 3)),
+            _conv_bn(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(
+            _conv_bn(c_in, c7, 1),
+            _conv_bn(c7, c7, (7, 1), padding=(3, 0)),
+            _conv_bn(c7, c7, (1, 7), padding=(0, 3)),
+            _conv_bn(c7, c7, (7, 1), padding=(3, 0)),
+            _conv_bn(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             _conv_bn(c_in, 192, 1))
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)], 1)
+
+
+class _InceptionD(Layer):
+    """Grid reduction 17->8."""
+
+    def __init__(self, c_in):
+        super().__init__()
+        self.b3 = Sequential(_conv_bn(c_in, 192, 1),
+                             _conv_bn(192, 320, 3, stride=2))
+        self.b7 = Sequential(
+            _conv_bn(c_in, 192, 1),
+            _conv_bn(192, 192, (1, 7), padding=(0, 3)),
+            _conv_bn(192, 192, (7, 1), padding=(3, 0)),
+            _conv_bn(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        return concat([self.b3(x), self.b7(x), self.pool(x)], 1)
+
+
+class _InceptionE(Layer):
+    def __init__(self, c_in):
+        super().__init__()
+        self.b1 = _conv_bn(c_in, 320, 1)
+        self.b3_stem = _conv_bn(c_in, 384, 1)
+        self.b3_a = _conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = _conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = Sequential(_conv_bn(c_in, 448, 1),
+                                   _conv_bn(448, 384, 3, padding=1))
+        self.b3d_a = _conv_bn(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = _conv_bn(384, 384, (3, 1), padding=(1, 0))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             _conv_bn(c_in, 192, 1))
+
+    def forward(self, x):
+        from ..ops.manipulation import concat
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return concat([self.b1(x),
+                       concat([self.b3_a(s), self.b3_b(s)], 1),
+                       concat([self.b3d_a(d), self.b3d_b(d)], 1),
+                       self.bp(x)], 1)
+
+
+class InceptionV3(Layer):
+    """Inception-v3 (Szegedy et al. 2015), 299x299 input — role of
+    paddle.vision.models.InceptionV3 (reference mount empty)."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            _conv_bn(3, 32, 3, stride=2),
+            _conv_bn(32, 32, 3),
+            _conv_bn(32, 64, 3, padding=1),
+            MaxPool2D(3, stride=2),
+            _conv_bn(64, 80, 1),
+            _conv_bn(80, 192, 3),
+            MaxPool2D(3, stride=2))
+        self.blocks = Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64),
+            _InceptionA(288, 64),
+            _InceptionB(288),
+            _InceptionC(768, 128), _InceptionC(768, 160),
+            _InceptionC(768, 160), _InceptionC(768, 192),
+            _InceptionD(768),
+            _InceptionE(1280), _InceptionE(2048))
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.2)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(_flatten1(x)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)
+
+
+__all__ += ["resnext50_64x4d", "resnext101_64x4d", "resnext152_32x4d",
+            "resnext152_64x4d", "densenet161", "densenet169",
+            "densenet201", "densenet264", "shufflenet_v2_x0_25",
+            "shufflenet_v2_x0_33", "shufflenet_v2_x0_5",
+            "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+            "shufflenet_v2_swish", "InceptionV3", "inception_v3"]

@@ -596,3 +596,45 @@ class AutoAugment:
 __all__ += ["RandomAffine", "RandomPerspective", "GaussianBlur",
             "RandomInvert", "RandomPosterize", "RandomSolarize",
             "RandomAdjustSharpness", "RandAugment", "AutoAugment"]
+
+
+class BaseTransform:
+    """paddle.vision.transforms.BaseTransform parity: keys-aware
+    transform base. Subclasses implement ``_apply_image`` (and
+    optionally ``_apply_boxes`` / ``_apply_mask``); __call__ maps the
+    right _apply_* over the inputs per ``keys``."""
+
+    def __init__(self, keys=None):
+        self.keys = keys or ("image",)
+
+    def _get_params(self, inputs):
+        return None
+
+    def __call__(self, inputs):
+        single = not isinstance(inputs, (tuple, list))
+        items = (inputs,) if single else tuple(inputs)
+        self.params = self._get_params(items)
+        out = []
+        for key, item in zip(self.keys, items):
+            fn = getattr(self, f"_apply_{key}", None)
+            out.append(fn(item) if fn is not None else item)
+        # inputs beyond len(keys) (e.g. the label in (img, label)) pass
+        # through untouched — upstream contract
+        out.extend(items[len(self.keys):])
+        return out[0] if single else tuple(out)
+
+    def _apply_image(self, image):
+        raise NotImplementedError
+
+
+# functional names at the transforms level (upstream import-path parity:
+# paddle.vision.transforms.resize IS transforms.functional.resize)
+from .functional import (resize, pad, crop, center_crop, hflip,  # noqa
+                         vflip, rotate, adjust_brightness,
+                         adjust_contrast, adjust_hue, to_grayscale,
+                         erase, affine, perspective)
+
+__all__ += ["BaseTransform", "resize", "pad", "crop", "center_crop",
+            "hflip", "vflip", "rotate", "adjust_brightness",
+            "adjust_contrast", "adjust_hue", "to_grayscale", "erase",
+            "affine", "perspective"]

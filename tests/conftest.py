@@ -20,3 +20,11 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+
+# The suite tests framework semantics (shapes, parity, autograd), not
+# XLA's optimizer — and this container has ONE cpu core, so XLA:CPU
+# compile time dominates suite wall-time (measured 27% faster with
+# optimizations off, all tests green). Set PADDLE_TPU_TEST_FULL_OPT=1
+# to run against fully-optimized XLA output instead.
+if not os.environ.get("PADDLE_TPU_TEST_FULL_OPT"):
+    jax.config.update("jax_disable_most_optimizations", True)

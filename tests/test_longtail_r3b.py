@@ -160,15 +160,17 @@ class TestYoloLoss:
         from paddle_tpu.vision import ops as V
 
         losses = []
-        for _ in range(60):
+        # few steps, bigger lr: the oracle is "gradient descends the
+        # loss", not a convergence curve — keeps the fast gate fast
+        for _ in range(15):
             loss = V.yolo_loss(x, gb, gl, anchors, [0, 1, 2], C,
                                ignore_thresh=0.7, downsample_ratio=8)
             s = loss.sum()
             s.backward()
-            x.set_data(x._data - 0.05 * x.grad._data)
+            x.set_data(x._data - 0.1 * x.grad._data)
             x.clear_grad()
             losses.append(float(s.item()))
-        assert losses[-1] < losses[0] * 0.5, losses[::12]
+        assert losses[-1] < losses[0] * 0.8, losses[::3]
         assert all(np.isfinite(v) for v in losses)
 
 

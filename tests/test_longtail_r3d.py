@@ -1,0 +1,391 @@
+"""Round-3 long-tail tranche D: sparse breadth (cast/isnan/sum/reshape/
+slice/mask_as + nn layers incl. dense-compute sparse convs), incubate
+autograd objects + optimizers + autotune, nn transducer/adaptive-softmax
+layers, jit/device/text small parity fills."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _coo_2d():
+    idx = np.array([[0, 0, 1], [0, 2, 1]])
+    vals = np.array([1.0, 2.0, 3.0], np.float32)
+    return sparse.sparse_coo_tensor(idx, vals, [2, 3])
+
+
+class TestSparseFunctions:
+    def test_sum_all_and_axis(self):
+        sp = _coo_2d()
+        assert float(sparse.sum(sp).item()) == 6.0
+        np.testing.assert_allclose(
+            np.asarray(sparse.sum(sp, axis=0).to_dense().numpy()),
+            [1, 3, 2])
+        np.testing.assert_allclose(
+            np.asarray(sparse.sum(sp, axis=1).to_dense().numpy()),
+            [3, 3])
+
+    def test_reshape_preserves_flat_order(self):
+        sp = _coo_2d()
+        r = sparse.reshape(sp, [3, 2])
+        np.testing.assert_allclose(
+            np.asarray(r.to_dense().numpy()).ravel(),
+            np.asarray(sp.to_dense().numpy()).ravel())
+
+    def test_slice(self):
+        sp = _coo_2d()  # dense [[1,0,2],[0,3,0]]
+        sl = sparse.slice(sp, [1], [1], [3])
+        np.testing.assert_allclose(
+            np.asarray(sl.to_dense().numpy()), [[0, 2], [3, 0]])
+
+    def test_slice_clamps_out_of_range_starts(self):
+        sp = _coo_2d()
+        out = sparse.slice(sp, [0], [-10], [3])
+        assert out.shape == [2, 3]
+        np.testing.assert_allclose(np.asarray(out.to_dense().numpy()),
+                                   np.asarray(sp.to_dense().numpy()))
+
+    def test_mask_as(self):
+        sp = _coo_2d()
+        dense = paddle.to_tensor(
+            np.arange(6, dtype=np.float32).reshape(2, 3))
+        m = sparse.mask_as(dense, sp)
+        np.testing.assert_allclose(np.asarray(m.values().numpy()),
+                                   [0, 2, 4])
+
+    def test_cast_isnan_relu6(self):
+        sp = _coo_2d()
+        c = sparse.cast(sp, value_dtype="float64")
+        assert "float64" in str(c.values().dtype)
+        assert not np.asarray(sparse.isnan(sp).values().numpy()).any()
+        big = sparse.sparse_coo_tensor(
+            np.array([[0], [0]]), np.array([9.0], np.float32), [1, 1])
+        np.testing.assert_allclose(
+            np.asarray(sparse.relu6(big).values().numpy()), [6.0])
+
+    def test_csr_roundtrips_through_ops(self):
+        csr = _coo_2d().to_sparse_csr()
+        out = sparse.slice(csr, [0], [0], [2])
+        assert out.is_sparse_csr()
+        assert sparse.reshape(csr, [3, 2]).is_sparse_csr()
+
+    def test_shard_optimizer_deepcopy_no_recursion(self):
+        import copy
+        import paddle_tpu.distributed as dist
+        m = paddle.nn.Linear(2, 2)
+        opt = dist.shard_optimizer(
+            paddle.optimizer.SGD(0.1, parameters=m.parameters()))
+        copy.deepcopy(opt)  # must not RecursionError
+
+
+class TestSparseNN:
+    def _voxels(self, ch=2):
+        pts = np.array([[0, 0, 0], [0, 2, 3], [0, 1, 1]]).T
+        idx = np.concatenate(
+            [np.repeat(pts, ch, 1),
+             np.tile(np.arange(ch), pts.shape[1])[None, :]], 0)
+        v = np.random.RandomState(0).randn(idx.shape[1]).astype(
+            np.float32)
+        return sparse.sparse_coo_tensor(idx, v, [1, 4, 5, ch]), idx
+
+    def test_subm_conv2d_preserves_pattern(self):
+        paddle.seed(0)
+        x, idx = self._voxels()
+        conv = sparse.nn.SubmConv2D(2, 3, 3, padding=1)
+        out = conv(x)
+        assert out.shape == [1, 4, 5, 3]
+        # output spatial sites == input spatial sites
+        out_sites = set(map(tuple,
+                            np.asarray(out.indices().numpy())[:3].T))
+        in_sites = set(map(tuple, idx[:3].T))
+        assert out_sites == in_sites
+
+    def test_conv2d_matches_dense_conv_at_active_sites(self):
+        paddle.seed(1)
+        x, idx = self._voxels()
+        conv = sparse.nn.Conv2D(2, 3, 3, padding=1)
+        out = conv(x)
+        dense_in = np.asarray(x.to_dense().numpy())  # [1,4,5,2]
+        w = np.asarray(conv.weight.numpy())          # [3,3,2,3]
+        b = np.asarray(conv.bias.numpy())
+        # brute-force dense conv (padding 1, stride 1)
+        padded = np.pad(dense_in, ((0, 0), (1, 1), (1, 1), (0, 0)))
+        expect = np.zeros((1, 4, 5, 3), np.float32)
+        for i in range(4):
+            for j in range(5):
+                patch = padded[0, i:i + 3, j:j + 3]  # [3,3,2]
+                expect[0, i, j] = np.tensordot(patch, w, 3) + b
+        got = np.asarray(out.to_dense().numpy())
+        active = got != 0
+        np.testing.assert_allclose(got[active],
+                                   expect[active], rtol=1e-4, atol=1e-5)
+
+    def test_subm_conv_grows_channels_with_bias_everywhere(self):
+        # out_channels > in_channels: every output channel (incl. the
+        # new ones) must carry the bias at the active sites
+        paddle.seed(3)
+        x, idx = self._voxels(ch=2)
+        conv = sparse.nn.SubmConv2D(2, 3, 1)
+        out = conv(x)
+        b = np.asarray(conv.bias.numpy())
+        dense = np.asarray(out.to_dense().numpy())
+        assert np.all(b != 0)  # random-init bias: all channels carry it
+        for site in {tuple(s) for s in idx[:3].T}:
+            got = dense[site]  # [3] channels at an active site
+            assert np.all(got != 0), (site, got, b)
+        # inactive site stays empty
+        assert np.allclose(dense[0, 3, 0], 0.0)
+
+    def test_conv_then_batch_norm_chains(self):
+        paddle.seed(4)
+        x, _ = self._voxels(ch=2)
+        conv = sparse.nn.Conv2D(2, 5, 3, padding=1)
+        bn = sparse.nn.BatchNorm(5)
+        out = bn(conv(x))
+        assert out.shape[-1] == 5
+        ov = np.asarray(out.values().numpy())
+        chn = np.asarray(out.indices().numpy())[-1]
+        for c in range(5):
+            assert abs(ov[chn == c].mean()) < 1e-4
+
+    def test_batch_norm_normalizes_per_channel(self):
+        paddle.seed(2)
+        x, idx = self._voxels()
+        bn = sparse.nn.BatchNorm(2)
+        out = bn(x)
+        ov = np.asarray(out.values().numpy())
+        chn = idx[-1]
+        for c in range(2):
+            assert abs(ov[chn == c].mean()) < 1e-5
+        bn.eval()
+        out2 = bn(x)  # running-stats path must run
+        assert out2.shape == x.shape
+
+    def test_maxpool3d_channel_without_entries_gets_no_output(self):
+        # entry only in channel 0 of a 2-channel tensor: channel 1 must
+        # have NO output entry (not a gathered -inf)
+        x = sparse.sparse_coo_tensor(
+            np.array([[0], [0], [0], [0], [0]]),
+            np.array([1.0], np.float32), [1, 2, 2, 2, 2])
+        out = sparse.nn.MaxPool3D(2)(x)
+        vals = np.asarray(out.values().numpy())
+        assert np.isfinite(vals).all(), vals
+        np.testing.assert_allclose(vals, [1.0])
+        dense = np.asarray(out.to_dense().numpy())
+        assert np.isfinite(dense).all()
+
+    def test_maxpool3d(self):
+        x = sparse.sparse_coo_tensor(
+            np.array([[0, 0], [0, 1], [0, 1], [0, 1], [0, 1]]),
+            np.array([1.0, 2.0], np.float32), [1, 2, 2, 2, 2])
+        out = sparse.nn.MaxPool3D(2)(x)
+        assert out.shape == [1, 1, 1, 1, 2]
+        got = np.asarray(out.to_dense().numpy()).ravel()
+        np.testing.assert_allclose(sorted(got), [1.0, 2.0])
+
+    def test_functional_attention_full_pattern_matches_dense(self):
+        S, D, B, H = 4, 8, 1, 2
+        rng = np.random.RandomState(1)
+        q = paddle.to_tensor(rng.randn(B, H, S, D).astype(np.float32))
+        k = paddle.to_tensor(rng.randn(B, H, S, D).astype(np.float32))
+        v = paddle.to_tensor(rng.randn(B, H, S, D).astype(np.float32))
+        mask = sparse.sparse_csr_tensor(
+            np.arange(0, S * S + 1, S), np.tile(np.arange(S), S),
+            np.ones(S * S, np.float32), [S, S])
+        out = sparse.nn.functional.attention(q, k, v, mask)
+        import paddle_tpu.nn.functional as F
+        ref = F.scaled_dot_product_attention(
+            q.transpose([0, 2, 1, 3]), k.transpose([0, 2, 1, 3]),
+            v.transpose([0, 2, 1, 3]))
+        np.testing.assert_allclose(
+            np.asarray(out.numpy()),
+            np.asarray(ref.transpose([0, 2, 1, 3]).numpy()),
+            rtol=2e-4, atol=2e-5)
+
+    def test_activation_layers(self):
+        x, _ = self._voxels()
+        for layer in (sparse.nn.ReLU(), sparse.nn.ReLU6(),
+                      sparse.nn.LeakyReLU(0.1)):
+            out = layer(x)
+            assert out.shape == x.shape
+
+
+class TestIncubateLongTail:
+    def test_jacobian_hessian_objects(self):
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        J = paddle.incubate.autograd.Jacobian(lambda a: a * a, x)
+        np.testing.assert_allclose(np.asarray(J[1, 1].numpy()), 4.0)
+        H = paddle.incubate.autograd.Hessian(
+            lambda a: (a * a * a).sum(), x)
+        np.testing.assert_allclose(np.asarray(H[2, 2].numpy()), 18.0)
+
+    def test_prim_toggle(self):
+        ag = paddle.incubate.autograd
+        ag.enable_prim()
+        assert ag.prim_enabled()
+        ag.disable_prim()
+        assert not ag.prim_enabled()
+
+    def test_lbfgs_reexport_and_fused_lamb(self):
+        assert paddle.incubate.optimizer.LBFGS is paddle.optimizer.LBFGS
+        m = paddle.nn.Linear(3, 3)
+        opt = paddle.incubate.DistributedFusedLamb(
+            0.01, parameters=m.parameters())
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        m(x).sum().backward()
+        opt.step()
+        opt.clear_grad()
+
+    def test_autotune_config(self):
+        paddle.incubate.autotune.set_config(
+            {"kernel": {"enable": True},
+             "dataloader": {"enable": True, "tuning_steps": 5}})
+        cfg = paddle.incubate.autotune.get_config()
+        assert cfg["kernel"]["enable"]
+        with pytest.raises(TypeError):
+            paddle.incubate.autotune.set_config(42)
+
+
+class TestNNLongTailLayers:
+    def test_adaptive_log_softmax_layer(self):
+        paddle.seed(0)
+        m = paddle.nn.AdaptiveLogSoftmaxWithLoss(16, 20, [4, 10])
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(6, 16).astype(np.float32))
+        y = paddle.to_tensor(
+            np.random.RandomState(1).randint(0, 20, (6,)).astype(
+                np.int64))
+        m(x, y)  # loss path runs
+        lp = m.log_prob(x)
+        total = np.asarray(paddle.exp(lp).sum(axis=-1).numpy())
+        np.testing.assert_allclose(total, 1.0, rtol=1e-4)
+        pred = m.predict(x)
+        assert list(pred.shape) == [6]
+
+    def test_adaptive_log_softmax_validates_cutoffs(self):
+        with pytest.raises(ValueError):
+            paddle.nn.AdaptiveLogSoftmaxWithLoss(8, 10, [4, 12])
+
+    def test_rnnt_loss_layer(self):
+        paddle.seed(1)
+        B, T, U, V = 2, 4, 3, 5
+        logits = paddle.to_tensor(np.random.RandomState(2).randn(
+            B, T, U + 1, V).astype(np.float32))
+        labels = paddle.to_tensor(np.random.RandomState(3).randint(
+            1, V, (B, U)).astype(np.int32))
+        tl = paddle.to_tensor(np.array([T, T], np.int32))
+        ul = paddle.to_tensor(np.array([U, U], np.int32))
+        layer = paddle.nn.RNNTLoss(blank=0, fastemit_lambda=0.0)
+        loss = layer(logits, labels, tl, ul)
+        fn = paddle.nn.functional.rnnt_loss(
+            logits, labels, tl, ul, blank=0)
+        np.testing.assert_allclose(float(loss.item()), float(fn.item()),
+                                   rtol=1e-6)
+
+
+class TestSmallParityFills:
+    def test_jit_set_code_level(self):
+        paddle.jit.set_code_level(100)
+        paddle.jit.set_code_level(0)
+
+    def test_device_fills(self):
+        assert paddle.device.get_cudnn_version() is None
+        assert "cpu" in paddle.device.get_all_device_type()
+        assert paddle.device.get_all_custom_device_type() == []
+
+    def test_text_datasets_namespace(self):
+        from paddle_tpu.text import datasets
+        assert datasets.Imdb is paddle.text.Imdb
+
+
+class TestVisionModelBreadth:
+    def test_new_factories_construct(self):
+        M = paddle.vision.models
+        for f in (M.resnext50_64x4d, M.resnext152_32x4d,
+                  M.shufflenet_v2_x0_25, M.shufflenet_v2_x1_5):
+            m = f(num_classes=3)
+            assert len(list(m.parameters())) > 0
+
+    def test_shufflenet_scales_and_swish_forward(self):
+        paddle.seed(0)
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(1, 3, 64, 64).astype(
+                np.float32))
+        for f in (paddle.vision.models.shufflenet_v2_x0_25,
+                  paddle.vision.models.shufflenet_v2_swish):
+            m = f(num_classes=5)
+            m.eval()
+            out = m(x)
+            assert list(out.shape) == [1, 5]
+
+    def test_densenet161_uses_growth_48(self):
+        m = paddle.vision.models.densenet161(num_classes=2)
+        # stem width = 2 * growth_rate
+        assert m.stem[0].weight.shape[0] == 96
+
+    @pytest.mark.slow
+    def test_inception_v3_forward(self):
+        paddle.seed(1)
+        m = paddle.vision.models.inception_v3(num_classes=4)
+        m.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(1).randn(1, 3, 299, 299).astype(
+                np.float32))
+        out = m(x)
+        assert list(out.shape) == [1, 4]
+
+
+class TestVisionDataTransforms:
+    def test_generated_flowers_and_voc(self):
+        ds = paddle.vision.datasets.Flowers(mode="test",
+                                            backend="generate")
+        img, label = ds[0]
+        assert img.shape == (64, 64, 3) and 0 <= int(label) < 102
+        voc = paddle.vision.datasets.VOC2012(mode="train",
+                                             backend="generate")
+        img, mask = voc[0]
+        assert mask.shape == (64, 64) and mask.max() <= 20
+
+    def test_base_transform_keys(self):
+        T = paddle.vision.transforms
+
+        class Zero(T.BaseTransform):
+            def __init__(self):
+                super().__init__(keys=("image", "mask"))
+
+            def _apply_image(self, im):
+                return im * 0
+
+        img = np.ones((4, 4, 3), np.float32)
+        mask = np.ones((4, 4), np.int64)
+        out_img, out_mask = Zero()((img, mask))
+        assert out_img.sum() == 0
+        assert out_mask.sum() == 16  # no _apply_mask → untouched
+
+    def test_functional_reexports(self):
+        T = paddle.vision.transforms
+        img = (np.random.RandomState(0).rand(8, 8, 3) * 255).astype(
+            np.uint8)
+        assert tuple(T.resize(img, (4, 4)).shape[:2]) == (4, 4)
+        assert tuple(T.hflip(img).shape) == img.shape
+
+    def test_subset_random_sampler(self):
+        from paddle_tpu.io import SubsetRandomSampler
+        s = SubsetRandomSampler([5, 2, 9])
+        assert sorted(s) == [2, 5, 9]
+        assert len(s) == 3
+
+    def test_amp_debugging_fills(self, tmp_path):
+        import json
+        d = paddle.amp.debugging
+        assert d.DebugMode.CHECK_NAN_INF == 1
+        layer = paddle.nn.Linear(2, 2)
+        d.check_layer_numerics(layer)
+        layer(paddle.to_tensor(np.ones((1, 2), np.float32)))
+        a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+        a.write_text(json.dumps({"op": "matmul", "count": 3}) + "\n")
+        b.write_text(json.dumps({"op": "matmul", "count": 5}) + "\n")
+        rep = d.compare_accuracy(str(a), str(b), str(tmp_path / "r.json"))
+        assert rep[0]["op"] == "matmul"
