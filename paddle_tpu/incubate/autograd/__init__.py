@@ -98,6 +98,11 @@ class Jacobian:
     (block-diagonal) cross-batch matrix."""
 
     def __init__(self, func, xs, is_batched=False):
+        if isinstance(xs, (list, tuple)) and len(xs) > 1:
+            raise NotImplementedError(
+                "Jacobian/Hessian objects support a single input tensor; "
+                "for multiple inputs use incubate.autograd.jacobian / "
+                "hessian (returns one block per input)")
         self._func, self._xs = func, xs
         self._batched = bool(is_batched)
         self._mat = None

@@ -707,16 +707,18 @@ class DataLoader:
 
 
 class SubsetRandomSampler(Sampler):
-    """Sample the given indices in random order (paddle.io parity)."""
+    """Sample the given indices in random order (paddle.io parity).
+    Reproducible per epoch via the same seeded-RandomState convention as
+    RandomSampler above."""
 
     def __init__(self, indices):
         self.indices = list(indices)
+        self._epoch_seed = itertools.count()
 
     def __iter__(self):
-        import random
-        order = list(self.indices)
-        random.shuffle(order)
-        return iter(order)
+        rng = np.random.RandomState(next(self._epoch_seed) + 12345)
+        return iter([self.indices[i]
+                     for i in rng.permutation(len(self.indices))])
 
     def __len__(self):
         return len(self.indices)

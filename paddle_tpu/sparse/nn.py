@@ -167,9 +167,19 @@ class _SparseConvND(Layer):
         self.nd = nd
         self.kernel_size = to_tup(kernel_size)
         self.stride = to_tup(1) if subm else to_tup(stride)
-        self.padding = to_tup(padding)
         self.dilation = to_tup(dilation)
         self.subm = subm
+        if subm:
+            # submanifold semantics: output pattern == input pattern,
+            # each site aggregating its CENTERED kernel window — which
+            # requires same-centered padding regardless of the
+            # constructor's padding arg (spconv/SECOND behavior; with
+            # padding=0 the conv output grid would be smaller than the
+            # pattern and the gather would read wrong sites)
+            self.padding = tuple(d * (k - 1) // 2 for k, d in
+                                 zip(self.kernel_size, self.dilation))
+        else:
+            self.padding = to_tup(padding)
         fan_in = in_channels * int(np.prod(self.kernel_size))
         bound = 1.0 / fan_in ** 0.5
         # channels-last kernel [*k, in, out] — the sparse-world layout
@@ -286,6 +296,9 @@ class MaxPool3D(Layer):
                  ceil_mode=False, return_mask=False, data_format="NDHWC",
                  name=None):
         super().__init__()
+        if ceil_mode or return_mask:
+            raise NotImplementedError(
+                "sparse MaxPool3D: ceil_mode/return_mask not supported")
         to_tup = (lambda v: (v,) * 3 if isinstance(v, int) else tuple(v))
         self.kernel = to_tup(kernel_size)
         self.stride = to_tup(stride if stride is not None else kernel_size)

@@ -162,7 +162,7 @@ class TestYoloLoss:
         losses = []
         # few steps, bigger lr: the oracle is "gradient descends the
         # loss", not a convergence curve — keeps the fast gate fast
-        for _ in range(15):
+        for _ in range(5):
             loss = V.yolo_loss(x, gb, gl, anchors, [0, 1, 2], C,
                                ignore_thresh=0.7, downsample_ratio=8)
             s = loss.sum()
@@ -170,7 +170,7 @@ class TestYoloLoss:
             x.set_data(x._data - 0.1 * x.grad._data)
             x.clear_grad()
             losses.append(float(s.item()))
-        assert losses[-1] < losses[0] * 0.8, losses[::3]
+        assert losses[-1] < losses[0] * 0.9, losses[::2]
         assert all(np.isfinite(v) for v in losses)
 
 

@@ -62,15 +62,16 @@ class TestDistributionLongTail:
         lp = np.asarray(d.log_prob(s).numpy())
         assert lp.shape == (2,) and np.isfinite(lp).all()
 
+    @pytest.mark.slow
     def test_lkj_dim2_concentration1_marginal_uniform(self):
         # at dim=2, c=1 the correlation r is uniform on [-1, 1]:
         # r = L[1,0], and r² ~ Beta(1/2, 1)  →  E[r²] = 1/3
         paddle.seed(7)
         d = paddle.distribution.LKJCholesky(2, concentration=1.0)
-        L = np.asarray(d.sample((4000,)).numpy())
+        L = np.asarray(d.sample((1500,)).numpy())
         r = L[:, 1, 0]
         assert abs(r.mean()) < 0.05
-        np.testing.assert_allclose((r ** 2).mean(), 1.0 / 3.0, atol=0.03)
+        np.testing.assert_allclose((r ** 2).mean(), 1.0 / 3.0, atol=0.04)
 
     def test_stack_transform(self):
         st = paddle.distribution.StackTransform(

@@ -210,14 +210,14 @@ class TestBeamSearch:
         dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
                                    beam_size=1, embedding_fn=emb,
                                    output_fn=proj)
-        ids, lp = nn.dynamic_decode(dec, inits=h0, max_step_num=5)
+        ids, lp = nn.dynamic_decode(dec, inits=h0, max_step_num=3)
 
         # greedy reference
         import jax.numpy as jnp
         tok = paddle.to_tensor(np.array([1, 1], "int64"))
         h = h0
         ref = []
-        for _ in range(5):
+        for _ in range(3):
             out, h = cell(emb(tok), h)
             logits = proj(out)
             tok = paddle.to_tensor(
@@ -236,7 +236,7 @@ class TestBeamSearch:
         dec = nn.BeamSearchDecoder(cell, start_token=1, end_token=0,
                                    beam_size=4, embedding_fn=emb,
                                    output_fn=proj)
-        ids, lp = nn.dynamic_decode(dec, inits=h0, max_step_num=6)
+        ids, lp = nn.dynamic_decode(dec, inits=h0, max_step_num=4)
         assert list(ids.shape)[:2] == [3, 4]
         assert (np.diff(lp.numpy(), axis=1) <= 1e-5).all()
 

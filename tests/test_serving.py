@@ -29,6 +29,7 @@ def _ref_greedy(model, prompt, n_new):
     return np.asarray(out.numpy())[0].tolist()
 
 
+@pytest.mark.slow
 def test_paged_pool_matches_dense_generate():
     """Single stream sanity: paged prefill + chunked paged decode must
     reproduce the dense-cache greedy tokens exactly."""
