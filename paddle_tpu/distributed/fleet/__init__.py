@@ -10,7 +10,7 @@ communicators (SURVEY.md §2.3 hybrid row)."""
 
 from .base import (fleet, init, DistributedStrategy, Fleet, worker_num,
                    worker_index, is_first_worker, PaddleCloudRoleMaker,
-                   UserDefinedRoleMaker)
+                   UserDefinedRoleMaker, UtilBase)
 from .topology import CommunicateTopology, HybridCommunicateGroup
 from . import meta_parallel
 from ..parallel_layers import (ColumnParallelLinear, RowParallelLinear,
@@ -21,7 +21,7 @@ from .sharding import (DygraphShardingOptimizer, group_sharded_parallel,
 from . import utils
 from . import elastic
 
-__all__ = ["fleet", "init", "DistributedStrategy", "Fleet",
+__all__ = ["fleet", "init", "DistributedStrategy", "Fleet", "UtilBase",
            "CommunicateTopology", "HybridCommunicateGroup", "meta_parallel",
            "ColumnParallelLinear", "RowParallelLinear",
            "VocabParallelEmbedding", "ParallelCrossEntropy",

@@ -165,6 +165,10 @@ class Fleet:
                                        self._strategy)
 
     # parity helpers used by trainers
+    @property
+    def util(self):
+        return _util_singleton
+
     def barrier_worker(self):
         from ..communication import barrier
         barrier()
@@ -230,3 +234,49 @@ def worker_index():
 
 def is_first_worker():
     return fleet.is_first_worker()
+
+
+class UtilBase:
+    """``fleet.util`` — host-side collective/file utilities (upstream
+    fleet/base/util_factory.py, UNVERIFIED). Collectives are the
+    control-plane object collectives (Gloo role); file helpers shard a
+    file list across workers the way PS data loaders do."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from ..communication import all_gather_object
+        parts: list = []
+        all_gather_object(parts, input)
+        arr = np.asarray(parts)
+        if mode not in ("sum", "min", "max"):
+            raise ValueError(f"util.all_reduce: unknown mode {mode!r}")
+        return getattr(arr, mode)(0)
+
+    def barrier(self, comm_world="worker"):
+        from ..communication import barrier as _barrier
+        _barrier()
+
+    def all_gather(self, input, comm_world="worker"):
+        from ..communication import all_gather_object
+        out: list = []
+        all_gather_object(out, input)
+        return out
+
+    def get_file_shard(self, files):
+        """Split a file list contiguously across workers (upstream
+        contract: earlier workers get the remainder)."""
+        from ..env import get_rank, get_world_size
+        n, rank = get_world_size(), get_rank()
+        total = len(files)
+        base, rem = divmod(total, n)
+        start = rank * base + min(rank, rem)
+        return list(files[start:start + base + (1 if rank < rem else 0)])
+
+    def print_on_rank(self, message, rank_id=0):
+        from ..env import get_rank
+        if get_rank() == rank_id:
+            print(message)
+
+
+_util_singleton = UtilBase()

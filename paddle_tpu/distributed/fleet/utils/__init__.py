@@ -3,13 +3,17 @@
 
 from ....incubate.recompute import recompute
 from . import sequence_parallel_utils
+from .fs import (LocalFS, HDFSClient, FSFileExistsError,
+                 FSFileNotExistsError)
 from .sequence_parallel_utils import (
     ScatterOp, GatherOp, AllGatherOp, ReduceScatterOp,
     ColumnSequenceParallelLinear, RowSequenceParallelLinear,
     mark_as_sequence_parallel_parameter,
     register_sequence_parallel_allreduce_hooks)
 
-__all__ = ["recompute", "sequence_parallel_utils", "ScatterOp", "GatherOp",
+__all__ = ["recompute", "sequence_parallel_utils", "LocalFS",
+           "HDFSClient", "FSFileExistsError", "FSFileNotExistsError",
+           "ScatterOp", "GatherOp",
            "AllGatherOp", "ReduceScatterOp", "ColumnSequenceParallelLinear",
            "RowSequenceParallelLinear",
            "mark_as_sequence_parallel_parameter",

@@ -10,8 +10,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from ..framework.core import Tensor, no_grad
-from ..optimizer.optimizer import Optimizer
+from ...framework.core import Tensor, no_grad
+from ...optimizer.optimizer import Optimizer
 
 __all__ = ["LookAhead", "ModelAverage"]
 
@@ -122,9 +122,9 @@ class ModelAverage(Optimizer):
         self._backup = None
 
 
-from ..optimizer.optimizer import LBFGS  # noqa: E402 — re-export (upstream
+from ...optimizer.optimizer import LBFGS  # noqa: E402 — re-export (upstream
 # incubate.optimizer.LBFGS graduated to paddle.optimizer; both paths work)
-from ..optimizer import Lamb as _Lamb  # noqa: E402
+from ...optimizer import Lamb as _Lamb  # noqa: E402
 
 
 class DistributedFusedLamb(_Lamb):
@@ -151,3 +151,8 @@ class DistributedFusedLamb(_Lamb):
 
 
 __all__ += ["LBFGS", "DistributedFusedLamb"]
+
+
+from . import functional  # noqa: E402,F401
+
+__all__ += ["functional"]

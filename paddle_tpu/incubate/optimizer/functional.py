@@ -1,0 +1,60 @@
+"""``paddle.incubate.optimizer.functional`` — functional minimizers
+(upstream python/paddle/incubate/optimizer/functional/{bfgs,lbfgs}.py,
+UNVERIFIED; reference mount empty).
+
+TPU-native: both lower to ``jax.scipy.optimize.minimize`` — the whole
+minimization loop (line search included) is one compiled XLA program
+with ``lax.while_loop`` control flow, instead of the reference's python
+loop of per-op kernel launches."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor
+from ...ops.common import as_tensor
+
+__all__ = ["minimize_bfgs", "minimize_lbfgs"]
+
+
+def _run(method, objective_func, initial_position, max_iters, tol,
+         dtype):
+    x0 = as_tensor(initial_position)._data
+    if dtype is not None:
+        from ...framework.core import to_jax_dtype
+        x0 = x0.astype(to_jax_dtype(dtype))
+
+    def fn(x):
+        out = objective_func(Tensor(x))
+        return out._data if isinstance(out, Tensor) else jnp.asarray(out)
+
+    import jax
+    from jax.scipy.optimize import minimize as jax_minimize
+
+    res = jax_minimize(fn, x0, method=method, tol=tol,
+                       options={"maxiter": int(max_iters)})
+    grad = jax.grad(fn)(res.x)
+    # upstream return contract:
+    # (is_converge, num_func_calls, position, objective_value,
+    #  objective_gradient)
+    return (Tensor(res.success), Tensor(res.nfev),
+            Tensor(res.x), Tensor(res.fun), Tensor(grad))
+
+
+def minimize_bfgs(objective_func, initial_position, max_iters=50,
+                  tolerance_grad=1e-7, tolerance_change=1e-9,
+                  initial_inverse_hessian_estimate=None,
+                  line_search_fn="strong_wolfe", max_line_search_iters=50,
+                  initial_step_length=1.0, dtype="float32", name=None):
+    return _run("BFGS", objective_func, initial_position, max_iters,
+                tolerance_grad, dtype)
+
+
+def minimize_lbfgs(objective_func, initial_position, history_size=100,
+                   max_iters=50, tolerance_grad=1e-8,
+                   tolerance_change=1e-8, line_search_fn="strong_wolfe",
+                   max_line_search_iters=50, initial_step_length=1.0,
+                   dtype="float32", name=None):
+    return _run("l-bfgs-experimental-do-not-rely-on-this",
+                objective_func, initial_position, max_iters,
+                tolerance_grad, dtype)

@@ -18,6 +18,7 @@ from .extras import (Variable, Scope, global_scope, scope_guard,
                      WeightNormParamAttr, load_program_state,
                      set_program_state, save, load)
 from . import nn  # noqa: F401
+from . import amp  # noqa: F401
 
 __all__ = ["enable_static", "disable_static", "in_dynamic_mode", "Program",
            "default_main_program", "default_startup_program",
@@ -28,4 +29,5 @@ __all__ = ["enable_static", "disable_static", "in_dynamic_mode", "Program",
            "py_func", "gradients", "append_backward", "normalize_program",
            "save_inference_model", "load_inference_model", "ipu_places",
            "npu_places", "xpu_places", "WeightNormParamAttr",
-           "load_program_state", "set_program_state", "save", "load"]
+           "load_program_state", "set_program_state", "save", "load",
+           "amp"]

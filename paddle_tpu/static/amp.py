@@ -1,0 +1,57 @@
+"""``paddle.static.amp`` — static-graph AMP surface (upstream
+python/paddle/static/amp/, UNVERIFIED; reference mount empty).
+
+Static programs here are captured replays of dygraph code, so static
+AMP IS dygraph AMP: ``decorate`` delegates to ``paddle.amp.decorate``'s
+optimizer/model casting and ``fp16_guard`` scopes an ``auto_cast``
+region (the role of the reference's fp16_guard program annotation)."""
+
+from __future__ import annotations
+
+import contextlib
+
+from ..amp import auto_cast as _auto_cast_mod
+from ..amp import decorate as _decorate
+
+__all__ = ["decorate", "fp16_guard", "CustomOpLists", "amp_guard",
+           "amp_decorate"]
+
+
+class CustomOpLists:
+    """White/black op lists for AMP (AutoMixedPrecisionLists parity)."""
+
+    def __init__(self, custom_white_list=None, custom_black_list=None,
+                 custom_black_varnames=None, dtype="float16"):
+        self.white_list = set(custom_white_list or [])
+        self.black_list = set(custom_black_list or [])
+        self.black_varnames = set(custom_black_varnames or [])
+        self.dtype = dtype
+
+
+def decorate(optimizer=None, amp_lists=None, init_loss_scaling=2.0 ** 15,
+             incr_every_n_steps=1000, decr_every_n_nan_or_inf=2,
+             incr_ratio=2.0, decr_ratio=0.8, use_dynamic_loss_scaling=True,
+             use_amp_guard=False, use_master_grad=False,
+             use_promote=False, models=None, level="O1",
+             dtype="float16", **kwargs):
+    """Returns the decorated optimizer (and models when given) — the
+    upstream static decorate returns an OptimizerWithMixedPrecision; the
+    dygraph decorate plays that role here. With no model the optimizer
+    passes through: under auto_cast/GradScaler the step already runs the
+    mixed-precision path (TPU bf16-first; fp16 scaling via GradScaler)."""
+    if models is None:
+        return optimizer
+    out = _decorate(models=models, optimizers=optimizer, level=level,
+                    dtype=dtype)
+    return out
+
+
+@contextlib.contextmanager
+def fp16_guard():
+    """Region whose ops run under the fp16 auto_cast policy."""
+    with _auto_cast_mod(True, dtype="float16"):
+        yield
+
+
+amp_decorate = decorate
+amp_guard = fp16_guard

@@ -421,3 +421,58 @@ class TestVisionDataTransforms:
         b.write_text(json.dumps({"op": "matmul", "count": 5}) + "\n")
         rep = d.compare_accuracy(str(a), str(b), str(tmp_path / "r.json"))
         assert rep[0]["op"] == "matmul"
+
+
+class TestTrancheE:
+    def test_minimize_bfgs_and_lbfgs(self):
+        F = paddle.incubate.optimizer.functional
+        for m in (F.minimize_bfgs, F.minimize_lbfgs):
+            ok, nfev, x, f, g = m(
+                lambda t: ((t - 3.0) ** 2).sum(),
+                paddle.to_tensor(np.zeros(4, np.float32)))
+            np.testing.assert_allclose(np.asarray(x.numpy()), 3.0,
+                                       atol=1e-4)
+            assert np.asarray(g.numpy()).shape == (4,)
+
+    def test_local_fs_roundtrip(self, tmp_path):
+        from paddle_tpu.distributed.fleet.utils import (LocalFS,
+                                                        FSFileExistsError)
+        fs = LocalFS()
+        d = str(tmp_path / "root")
+        fs.mkdirs(d)
+        fs.touch(f"{d}/a.txt")
+        fs.mkdirs(f"{d}/sub")
+        dirs, files = fs.ls_dir(d)
+        assert dirs == ["sub"] and files == ["a.txt"]
+        fs.mv(f"{d}/a.txt", f"{d}/b.txt")
+        assert fs.is_file(f"{d}/b.txt") and not fs.is_exist(f"{d}/a.txt")
+        with pytest.raises(FSFileExistsError):
+            fs.touch(f"{d}/b.txt", exist_ok=False)
+        assert fs.cat(f"{d}/b.txt") == b""
+        fs.delete(d)
+        assert not fs.is_exist(d)
+
+    def test_hdfs_client_requires_hadoop(self):
+        from paddle_tpu.distributed.fleet.utils import HDFSClient
+        with pytest.raises(RuntimeError):
+            HDFSClient("/nonexistent-hadoop-home")
+
+    def test_fleet_util(self):
+        from paddle_tpu.distributed import fleet
+        u = fleet.fleet.util
+        assert u.all_reduce(5) == 5
+        assert u.all_gather("x") == ["x"]
+        # single worker takes the whole shard
+        assert u.get_file_shard(["a", "b", "c"]) == ["a", "b", "c"]
+        u.barrier()
+
+    def test_static_amp(self):
+        from paddle_tpu import static
+        m = paddle.nn.Linear(2, 2)
+        opt = paddle.optimizer.SGD(0.1, parameters=m.parameters())
+        assert static.amp.decorate(optimizer=opt) is opt
+        lists = static.amp.CustomOpLists(custom_white_list=["matmul"])
+        assert "matmul" in lists.white_list
+        with static.amp.fp16_guard():
+            out = m(paddle.to_tensor(np.ones((1, 2), np.float32)))
+        assert "float16" in str(out.dtype)
