@@ -72,6 +72,7 @@ def _train_bench(on_tpu, dev):
             cfg = LlamaConfig.llama3_8b()
             batch, seq = 4, 2048
             cfg.use_recompute = True
+            cfg.recompute_granularity = "core_attn"
         else:
             # v5e 16GB: largest-fit ~2.4B with remat (dots_saveable);
             # shows the deep-config MFU, not just the 1B sweet spot

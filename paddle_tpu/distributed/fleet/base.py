@@ -246,12 +246,11 @@ class UtilBase:
         import numpy as np
 
         from ..communication import all_gather_object
+        if mode not in ("sum", "min", "max"):  # before the collective
+            raise ValueError(f"util.all_reduce: unknown mode {mode!r}")
         parts: list = []
         all_gather_object(parts, input)
-        arr = np.asarray(parts)
-        if mode not in ("sum", "min", "max"):
-            raise ValueError(f"util.all_reduce: unknown mode {mode!r}")
-        return getattr(arr, mode)(0)
+        return getattr(np.asarray(parts), mode)(0)
 
     def barrier(self, comm_world="worker"):
         from ..communication import barrier as _barrier

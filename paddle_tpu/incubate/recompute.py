@@ -15,15 +15,21 @@ from ..ops.common import as_tensor
 __all__ = ["recompute"]
 
 
-def recompute(function, *args, **kwargs):
+def recompute(function, *args, params_from=None, n_outputs=1, **kwargs):
     """Run ``function(*args)`` under rematerialization. ``function`` may be
     a Layer (its parameters/buffers are captured as differentiable inputs)
-    or a pure function of tensors."""
+    or a pure function of tensors. For a closure/bound method touching a
+    Layer's parameters, pass that Layer as ``params_from`` so its
+    parameters are captured as differentiable inputs (otherwise they'd be
+    baked in as constants and receive no gradient)."""
     from ..nn.layer.layers import Layer
     params: list[Tensor] = []
-    if isinstance(function, Layer):
-        params = [p for p in function.parameters()] + \
-            [b for b in function.buffers()]
+    source = function if isinstance(function, Layer) else params_from
+    if isinstance(source, Layer):
+        source = [source]
+    for lay in source or []:
+        params.extend(lay.parameters())
+        params.extend(lay.buffers())
     tensor_args = [as_tensor(a) if not isinstance(a, Tensor) else a
                    for a in args]
     n_args = len(tensor_args)
@@ -47,7 +53,8 @@ def recompute(function, *args, **kwargs):
                 p._data = d
 
     ckpt = checkpoint_with_policy(pure)
-    return apply(ckpt, *tensor_args, *params, name="recompute")
+    return apply(ckpt, *tensor_args, *params, name="recompute",
+                 n_outputs=n_outputs)
 
 
 _POLICY_NAMES = ("dots_saveable", "nothing_saveable",

@@ -54,4 +54,5 @@ def fp16_guard():
 
 
 amp_decorate = decorate
-amp_guard = fp16_guard
+# the argument-taking legacy guard IS the dygraph auto_cast
+from ..amp.auto_cast import amp_guard  # noqa: E402,F401

@@ -15,7 +15,13 @@ from .models import (ResNet, resnet18, resnet34, resnet50, resnet101,
                      shufflenet_v2_x1_0, DenseNet, densenet121, GoogLeNet,
                      googlenet, resnext50_32x4d, resnext101_32x4d,
                      wide_resnet50_2, wide_resnet101_2, BasicBlock,
-                     BottleneckBlock)
+                     BottleneckBlock, resnext50_64x4d, resnext101_64x4d,
+                     resnext152_32x4d, resnext152_64x4d, densenet161,
+                     densenet169, densenet201, densenet264,
+                     shufflenet_v2_x0_25, shufflenet_v2_x0_33,
+                     shufflenet_v2_x0_5, shufflenet_v2_x1_5,
+                     shufflenet_v2_x2_0, shufflenet_v2_swish,
+                     InceptionV3, inception_v3)
 
 
 def set_image_backend(backend):

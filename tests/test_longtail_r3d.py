@@ -475,4 +475,62 @@ class TestTrancheE:
         assert "matmul" in lists.white_list
         with static.amp.fp16_guard():
             out = m(paddle.to_tensor(np.ones((1, 2), np.float32)))
-        assert "float16" in str(out.dtype)
+        assert "float16" in str(out.dtype) and \
+            "bfloat16" not in str(out.dtype)
+
+
+class TestCoreAttnRemat:
+    def _losses(self, granularity, remat):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig(vocab_size=128, hidden_size=32,
+                          num_hidden_layers=2, num_attention_heads=4,
+                          num_key_value_heads=2, intermediate_size=64,
+                          max_position_embeddings=32, rope_theta=10000.0,
+                          tensor_parallel=False, use_recompute=remat,
+                          recompute_granularity=granularity,
+                          scan_layers=False)
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, 128, (2, 16)).astype(np.int64))
+        out = []
+        for _ in range(3):
+            _, loss = m(ids, labels=ids)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            out.append(float(loss.item()))
+        return out
+
+    def test_core_attn_matches_no_remat(self):
+        ref = self._losses("full", remat=False)
+        core = self._losses("core_attn", remat=True)
+        np.testing.assert_allclose(core, ref, rtol=1e-5)
+
+    def test_core_attn_interval_mixes_granularities(self):
+        from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+        cfg = LlamaConfig(vocab_size=128, hidden_size=32,
+                          num_hidden_layers=4, num_attention_heads=4,
+                          num_key_value_heads=2, intermediate_size=64,
+                          max_position_embeddings=32, rope_theta=10000.0,
+                          tensor_parallel=False, use_recompute=True,
+                          recompute_granularity="core_attn",
+                          core_attn_interval=2, scan_layers=False)
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, 128, (2, 16)).astype(np.int64))
+        _, loss = m(ids, labels=ids)
+        loss.backward()
+        mixed = float(loss.item())
+        cfg2 = LlamaConfig(vocab_size=128, hidden_size=32,
+                           num_hidden_layers=4, num_attention_heads=4,
+                           num_key_value_heads=2, intermediate_size=64,
+                           max_position_embeddings=32,
+                           rope_theta=10000.0, tensor_parallel=False,
+                           scan_layers=False)
+        paddle.seed(0)
+        m2 = LlamaForCausalLM(cfg2)
+        _, loss2 = m2(ids, labels=ids)
+        np.testing.assert_allclose(mixed, float(loss2.item()), rtol=1e-5)
