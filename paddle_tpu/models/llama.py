@@ -95,9 +95,11 @@ class LlamaConfig:
                    use_recompute=True,
                    # keep the flash core out of remat: 99.4 vs 103.0 ms
                    # on the L4 tuning slice (v5e); +21MB/layer saved ctx,
-                   # dosed to every 2nd layer to fit 16GB HBM
+                   # dosed to every 2nd layer to fit 16GB HBM. Requires
+                   # the unrolled stack (also the faster one on-chip).
                    recompute_granularity="core_attn",
-                   core_attn_interval=2)
+                   core_attn_interval=2,
+                   scan_layers=False)
 
     @classmethod
     def tiny(cls):
@@ -279,9 +281,15 @@ class LlamaDecoderLayer(nn.Layer):
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, new_cache
-        x = x + self.self_attn(self._sp(self.input_layernorm(x)))
-        x = x + self.mlp(self._sp(self.post_attention_layernorm(x)))
-        return x
+        if self.cfg.sequence_parallel or self.cfg.sep_parallel is not None:
+            x = x + self.self_attn(self._sp(self.input_layernorm(x)))
+            x = x + self.mlp(self._sp(self.post_attention_layernorm(x)))
+            return x
+        # plain path: composed from the SAME stages core_attn remat uses,
+        # so there is exactly one copy of the qkv/rope/residual wiring
+        q, k, v = self._qkv_stage(x)
+        ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self._post_stage(x, ctx)
 
     # ---- core_attn selective remat (see LlamaConfig.recompute_granularity)
     def _qkv_stage(self, x):
@@ -369,9 +377,15 @@ class LlamaModel(nn.Layer):
                             remat=self.config.use_recompute
                             and self.training)
         else:
+            gran = getattr(self.config, "recompute_granularity", "full")
+            if gran not in ("full", "core_attn", "full_attn"):
+                raise ValueError(
+                    f"recompute_granularity={gran!r} is not one of "
+                    "'full' | 'core_attn' | 'full_attn'")
+            # PaddleNLP's 'full_attn' (save the attention, recompute the
+            # rest) maps to the same TPU structure as core_attn
             selective = (
-                getattr(self.config, "recompute_granularity", "full")
-                == "core_attn"
+                gran in ("core_attn", "full_attn")
                 and self.config.sep_parallel is None
                 and not self.config.sequence_parallel)
             interval = max(
