@@ -63,6 +63,16 @@ class LlamaConfig:
     # see nn/scan.py) — turn off to unroll (e.g. heterogeneous stacks)
     scan_layers: bool = True
 
+    def __post_init__(self):
+        # validate at construction so a typo'd granularity fails where
+        # it was written, not only when the unrolled remat path runs
+        if self.recompute_granularity not in ("full", "core_attn",
+                                              "full_attn"):
+            raise ValueError(
+                f"recompute_granularity="
+                f"{self.recompute_granularity!r} is not one of "
+                "'full' | 'core_attn' | 'full_attn'")
+
     @classmethod
     def llama3_8b(cls):
         return cls()

@@ -81,10 +81,19 @@ def paged_attention(q, key_pages, value_pages, block_tables, context_lens,
             pages_per_seq = block_tables.shape[1]
             ppcb = next(c for c in (8, 4, 2, 1)
                         if pages_per_seq % c == 0)
-            # the kernel applies no softmax scale — fold it into q
-            return _kernel(q * jnp.asarray(s, q.dtype), key_pages,
-                           value_pages, context_lens, block_tables,
-                           pages_per_compute_block=ppcb)
+            # the kernel applies no softmax scale — fold it into q; it
+            # also indexes with int32 internally, so int64 tables/lens
+            # (the paddle default int dtype) must be cast AND the trace
+            # must run with x64 promotion off (kernel-internal python
+            # ints otherwise promote to i64 and its lax.div mixes
+            # dtypes) — same contract as the other pallas kernels
+            from .pallas._utils import no_x64
+            with no_x64():
+                return _kernel(q * jnp.asarray(s, q.dtype), key_pages,
+                               value_pages,
+                               context_lens.astype(jnp.int32),
+                               block_tables.astype(jnp.int32),
+                               pages_per_compute_block=ppcb)
         except Exception as e:
             warnings.warn(
                 f"Pallas paged-attention kernel unavailable "

@@ -424,15 +424,23 @@ class TestVisionDataTransforms:
 
 
 class TestTrancheE:
-    def test_minimize_bfgs_and_lbfgs(self):
+    def test_minimize_bfgs(self):
         F = paddle.incubate.optimizer.functional
-        for m in (F.minimize_bfgs, F.minimize_lbfgs):
+        for m in (F.minimize_bfgs,):
             ok, nfev, x, f, g = m(
                 lambda t: ((t - 3.0) ** 2).sum(),
                 paddle.to_tensor(np.zeros(4, np.float32)))
             np.testing.assert_allclose(np.asarray(x.numpy()), 3.0,
                                        atol=1e-4)
             assert np.asarray(g.numpy()).shape == (4,)
+
+    @pytest.mark.slow
+    def test_minimize_lbfgs(self):
+        F = paddle.incubate.optimizer.functional
+        ok, nfev, x, f, g = F.minimize_lbfgs(
+            lambda t: ((t - 3.0) ** 2).sum(),
+            paddle.to_tensor(np.zeros(4, np.float32)))
+        np.testing.assert_allclose(np.asarray(x.numpy()), 3.0, atol=1e-4)
 
     def test_local_fs_roundtrip(self, tmp_path):
         from paddle_tpu.distributed.fleet.utils import (LocalFS,
@@ -495,7 +503,7 @@ class TestCoreAttnRemat:
         ids = paddle.to_tensor(np.random.RandomState(0).randint(
             0, 128, (2, 16)).astype(np.int64))
         out = []
-        for _ in range(3):
+        for _ in range(2):
             _, loss = m(ids, labels=ids)
             loss.backward()
             opt.step()
@@ -508,6 +516,7 @@ class TestCoreAttnRemat:
         core = self._losses("core_attn", remat=True)
         np.testing.assert_allclose(core, ref, rtol=1e-5)
 
+    @pytest.mark.slow
     def test_core_attn_interval_mixes_granularities(self):
         from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
         cfg = LlamaConfig(vocab_size=128, hidden_size=32,

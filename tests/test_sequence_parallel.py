@@ -102,6 +102,7 @@ def test_llama_sequence_parallel_parity(mp_fleet):
     assert abs(l1 - ref) < 1e-4 and abs(l2 - ref) < 1e-4
 
 
+@pytest.mark.slow
 def test_sp_train_grads(mp_fleet):
     from paddle_tpu.distributed.fleet.utils import (
         ColumnSequenceParallelLinear, RowSequenceParallelLinear, ScatterOp,
