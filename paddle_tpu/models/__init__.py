@@ -10,10 +10,11 @@ from .qwen2 import (Qwen2Config, Qwen2MoeConfig, Qwen2ForCausalLM,
                     Qwen2MoeForCausalLM)
 from .ernie import (ErnieConfig, ErnieModel, ErnieForPretraining,
                     ErnieForMaskedLM, ErnieForSequenceClassification)
+from .deepseek import DeepseekV2Config, DeepseekV2ForCausalLM
 
 __all__ = ["GPT2Config", "GPT2Model", "GPT2ForCausalLM", "LlamaConfig",
            "LlamaModel", "LlamaForCausalLM", "LlamaForCausalLMPipe",
            "LlamaPretrainingCriterion", "Qwen2Config",
            "Qwen2MoeConfig", "Qwen2ForCausalLM", "Qwen2MoeForCausalLM",
            "ErnieConfig", "ErnieModel", "ErnieForPretraining",
-           "ErnieForMaskedLM", "ErnieForSequenceClassification"]
+           "ErnieForMaskedLM", "ErnieForSequenceClassification", "DeepseekV2Config", "DeepseekV2ForCausalLM"]

@@ -34,11 +34,11 @@ if not os.environ.get("PADDLE_TPU_TEST_FULL_OPT"):
 # HLO-keyed cache dedupes them even within one cold run (~15% suite
 # wall; repeat runs ~30%). Honors an externally-set cache dir.
 if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
-    import getpass
     import tempfile
+    _user = os.environ.get("USER") or os.environ.get("LOGNAME") \
+        or str(os.getuid() if hasattr(os, "getuid") else "anon")
     _cache_dir = os.path.join(
-        tempfile.gettempdir(),
-        f"paddle_tpu_test_xla_cache_{getpass.getuser()}")
+        tempfile.gettempdir(), f"paddle_tpu_test_xla_cache_{_user}")
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
