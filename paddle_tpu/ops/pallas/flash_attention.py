@@ -8,8 +8,10 @@ forward. Backward is HAND-WRITTEN Pallas too (``_dkv_kernel`` /
 ``_dq_kernel`` below): bf16 operands with fp32 accumulation, recomputing
 per-block logits from the saved log-sum-exp so memory stays O(S·D) (no
 S×S materialization). Block sizes come from
-``FLAGS_flash_attn_block_q/kv`` (512/512 measured best on v5e — see
-BASELINE.md).
+``FLAGS_flash_attn_block_q/kv``; the best setting is config-dependent —
+on v5e, 256/512 beats 512/512 by ~2 MFU points under remat at hidden
+2560, while 512/512 won at the 0.89B sweet spot (see BASELINE.md for
+the current tuning record).
 
 GQA/MQA (fewer kv heads than q heads) is handled by repeating kv heads."""
 
