@@ -19,7 +19,8 @@ from ....nn.functional.attention import sdpa_reference
 from ...communication import in_traced_collective
 
 __all__ = ["RingFlashAttention", "ring_flash_attention", "ulysses_attention",
-           "sep_attention", "split_inputs_sequence_dim",
+           "sep_attention", "sep_attention_manual", "sep_axis_is_manual",
+           "split_inputs_sequence_dim",
            "gather_outputs_sequence_dim", "sep_positions"]
 
 
@@ -109,6 +110,65 @@ def sep_attention(q, k, v, causal=True, scale=None, impl="ring",
         return f(qq, kk, vv)
 
     return apply(fn, q, k, v, name=f"sep_attention_{impl}")
+
+
+def sep_axis_is_manual() -> bool:
+    """True when the 'sep' mesh axis is already MANUALLY bound in the
+    current trace — i.e. we are inside a shard_map region that includes
+    'sep' in its axis_names (the compiled pipeline engine running a 5D
+    pp x sep hybrid). Attention layers branch on this: in a manual
+    region the K/V ring is issued directly on the bound axis with
+    globally-offset RoPE, instead of opening a (GSPMD-composed)
+    partial-manual shard_map of their own."""
+    from ...communication import axis_in_traced_region
+    axis, degree = _sep_axis()
+    return axis is not None and degree > 1 and axis_in_traced_region(axis)
+
+
+def sep_attention_manual(q, k, v, rope_theta, causal=True,
+                         scale=None, impl="ring"):
+    """Context-parallel attention for MANUAL regions (the 5D hybrid).
+
+    Called on *pre-RoPE* local chunks [B, S_local, H, D] inside a
+    shard_map whose axis_names include BOTH 'pipe' and 'sep' (the
+    compiled pipeline engine, ``distributed/pipeline.py``). The sequence
+    dim is physically local here, so RoPE must use global token
+    positions: this wrapper computes ``idx*S_local + arange(S_local)``
+    from ``lax.axis_index('sep')``, applies RoPE to q/k, then runs the
+    K/V ring (or Ulysses all-to-all) directly on the already-bound axis
+    — ring-CP activations thereby cross pipeline-stage boundaries inside
+    ONE compiled program.
+
+    Why rope lives in here and not in the model: the offset is only
+    known from the bound axis index; in the GSPMD path the model applies
+    rope itself on the full logical sequence."""
+    from jax import lax
+
+    axis, degree = _sep_axis()
+
+    def fn(qq, kk, vv):
+        from ....ops.pallas import rope as rope_mod
+        idx = lax.axis_index(axis)
+        sl = qq.shape[1]
+        pid = (idx.astype(jnp.int32) * sl
+               + jnp.arange(sl, dtype=jnp.int32))[None, :]
+        pid = jnp.broadcast_to(pid, (qq.shape[0], sl))
+        # table length = the static GLOBAL sequence length (degree
+        # local chunks), matching the GSPMD path's build_sin_cos(S_full)
+        # exactly — never clamp positions to max_position_embeddings
+        s_tab, c_tab = rope_mod.build_sin_cos(degree * sl, qq.shape[-1],
+                                              rope_theta, qq.dtype)
+        qq = rope_mod.apply_rope(qq, s_tab, c_tab, pid)
+        kk = rope_mod.apply_rope(kk, s_tab, c_tab, pid)
+        if impl == "ring":
+            return ra.ring_attention(qq, kk, vv, axis, causal=causal,
+                                     scale=scale, placement="contiguous")
+        if impl == "ulysses":
+            return ra.ulysses_attention(qq, kk, vv, axis, causal=causal,
+                                        scale=scale)
+        raise ValueError(f"unknown sep impl {impl!r}")
+
+    return apply(fn, q, k, v, name=f"sep_attention_manual_{impl}")
 
 
 def split_inputs_sequence_dim(inputs, rank=None, degree=None, axis=1,

@@ -32,15 +32,26 @@ __all__ = ["PipelineParallel"]
 
 #: strategy.pipeline_configs["schedule_mode"] -> engine kind.
 #: 'FThenB' (default) = the compiled lax.scan pipeline with jax
-#: reverse-mode backward (supports interleaved virtual stages);
+#: reverse-mode backward; 'interleaved' (a.k.a. 'vpp') = the same scan
+#: engine with V > 1 virtual chunks per device (Megatron virtual-pp:
+#: round-robin chunk placement, 1/V bubble shrink — pipeline.py's
+#: _pipeline_interleaved), V from
+#: strategy.pipeline_configs["num_virtual_pipeline_stages"] or the
+#: PipelineLayer's own num_virtual_pipeline_stages;
 #: '1F1B' / 'ZB-H1' = the explicit-schedule tick engine in
 #: distributed/zero_bubble.py (true warmup/steady/cooldown order, W-unit
 #: bubble filling for ZB-H1).
 _SCHEDULES = {
     "fthenb": "fthenb", "f-then-b": "fthenb", "f_then_b": "fthenb",
-    "gpipe": "fthenb", "interleaved": "fthenb", "vpp": "fthenb",
+    "gpipe": "fthenb",
+    "interleaved": "interleaved", "vpp": "interleaved",
+    "interleaved-1f1b": "interleaved", "interleaved_1f1b": "interleaved",
     "1f1b": "1f1b", "zb_h1": "zb_h1", "zb-h1": "zb_h1", "zbh1": "zb_h1",
 }
+
+#: schedule kinds served by the compiled lax.scan engine (pipeline.py);
+#: the others run the explicit tick machine (zero_bubble.py).
+_SCAN_SCHEDULES = ("fthenb", "interleaved")
 
 
 def _make_stage_fn(template, template_params):
@@ -74,15 +85,19 @@ def _param_sig(layer):
 
 class PipelineParallel:
     def __init__(self, layers, hcg, accumulate_steps=1, strategy=None,
-                 schedule_mode=None):
+                 schedule_mode=None, num_virtual_pipeline_stages=None):
         self._layers = layers
         self._hcg = hcg
         self.accumulate_steps = max(int(accumulate_steps), 1)
         self._pp_degree = (hcg.get_pipe_parallel_world_size()
                            if hcg is not None else 1)
-        if schedule_mode is None and strategy is not None:
-            schedule_mode = strategy.pipeline_configs.get(
-                "schedule_mode", "FThenB")
+        if strategy is not None:
+            if schedule_mode is None:
+                schedule_mode = strategy.pipeline_configs.get(
+                    "schedule_mode", "FThenB")
+            if num_virtual_pipeline_stages is None:
+                num_virtual_pipeline_stages = strategy.pipeline_configs.get(
+                    "num_virtual_pipeline_stages")
         raw = str(schedule_mode or "FThenB")
         try:
             self._schedule = _SCHEDULES[raw.lower().strip()]
@@ -90,15 +105,59 @@ class PipelineParallel:
             raise ValueError(
                 f"unknown pipeline schedule_mode {raw!r}; one of "
                 f"{sorted(set(_SCHEDULES))}") from None
+        # virtual-stage count: explicit arg / strategy override beats the
+        # PipelineLayer's own construction-time value
+        v_layer = max(int(getattr(layers, "_num_virtual", 1) or 1), 1)
+        v_cfg = (max(int(num_virtual_pipeline_stages), 1)
+                 if num_virtual_pipeline_stages is not None else None)
+        if v_cfg is not None and v_cfg > 1 and v_layer > 1 and \
+                v_cfg != v_layer:
+            raise ValueError(
+                f"num_virtual_pipeline_stages={v_cfg} conflicts with the "
+                f"PipelineLayer's num_virtual_pipeline_stages={v_layer}")
+        # an explicit config value wins (v_cfg=1 deliberately flattens a
+        # V>1 layer back to S plain stages — the escape hatch the
+        # explicit-schedule error below recommends)
+        self._num_virtual = v_cfg if v_cfg is not None else v_layer
+        if self._schedule == "interleaved" and self._num_virtual <= 1:
+            raise ValueError(
+                "schedule_mode='interleaved' needs virtual pipeline "
+                "stages: set pipeline_configs['num_virtual_pipeline_"
+                "stages'] > 1 (or build the PipelineLayer with "
+                "num_virtual_pipeline_stages > 1)")
         self._compiled_plan = None
         if self._pp_degree > 1:
             self._compiled_plan = self._build_plan()
-            if self._schedule != "fthenb" and \
+            if self._schedule not in _SCAN_SCHEDULES and \
                     self._compiled_plan["n_virtual"] > 1:
                 raise ValueError(
                     "explicit schedules (1F1B/ZB-H1) do not support "
-                    "virtual pipeline stages; use schedule_mode='FThenB' "
-                    "(interleaved) or num_virtual_pipeline_stages=1")
+                    "virtual pipeline stages; use schedule_mode="
+                    "'interleaved' or num_virtual_pipeline_stages=1")
+            if self._schedule not in _SCAN_SCHEDULES and \
+                    self._sep_axes():
+                raise ValueError(
+                    "the 5D pp x sep composition currently runs under "
+                    "the compiled scan schedules; use schedule_mode="
+                    "'FThenB' or 'interleaved' (the explicit 1F1B/ZB-H1 "
+                    "tick engines compute the loss inside the manual "
+                    "region, which needs a sep-aware epilogue — "
+                    "not yet implemented)")
+
+    def _sep_axes(self):
+        """('sep',) when this pipeline composes with an active context-
+        parallel axis — i.e. the mesh's sep degree > 1 AND the stage
+        layers actually run sep attention (their config carries
+        sep_parallel). Empty tuple otherwise."""
+        if self._hcg is None or \
+                self._hcg.get_sep_parallel_world_size() <= 1:
+            return ()
+        for l in self._layers.run_function:
+            cfg = getattr(l, "cfg", None) or getattr(l, "config", None)
+            if cfg is not None and \
+                    getattr(cfg, "sep_parallel", None) is not None:
+                return (self._hcg.sep_axis_name,)
+        return ()
 
     def __getattr__(self, name):
         return getattr(self._layers, name)
@@ -113,7 +172,7 @@ class PipelineParallel:
         group the body into S*V chunks of equal layer count (V > 1 =
         interleaved virtual stages; chunk c lives on device c % S)."""
         S = self._pp_degree
-        V = max(int(getattr(self._layers, "_num_virtual", 1) or 1), 1)
+        V = self._num_virtual
         n_chunks = S * V
         layer_list = list(self._layers.run_function)
         sigs = [_param_sig(l) for l in layer_list]
@@ -192,10 +251,18 @@ class PipelineParallel:
                         for v in range(V)])
                     for i in range(n_leaves))
 
+            extra = self._sep_axes()
+            x_spec = None
+            if extra:
+                from jax.sharding import PartitionSpec as P
+                # h_micro is [M, b//M, S, H] — sequence dim 2 rides the
+                # context axis through the manual region
+                x_spec = P(None, None, extra[0])
             return run_pipeline(_make_stage_fn(template, template_params),
                                 stacked, hm, mesh,
                                 axis_name=self._hcg.pp_axis_name,
-                                n_virtual=V, remat=remat)
+                                n_virtual=V, remat=remat,
+                                extra_axes=extra, x_spec=x_spec)
 
         return apply(fn, h_micro, *flat, name="pipeline_body")
 
@@ -359,7 +426,8 @@ class PipelineParallel:
         """Microbatch-accumulated step; one optimizer step. Returns the
         mean loss (paddle semantics)."""
         inputs, labels = data
-        if self._compiled_plan is not None and self._schedule != "fthenb":
+        if self._compiled_plan is not None and \
+                self._schedule not in _SCAN_SCHEDULES:
             return self._train_batch_explicit(inputs, labels, optimizer,
                                               lr_scheduler, scaler)
         if self._compiled_plan is not None:

@@ -56,10 +56,13 @@ from jax import lax
 __all__ = ["pipeline_spmd", "run_pipeline"]
 
 
-def _vary(x, axis_name):
-    if hasattr(lax, "pcast"):
-        return lax.pcast(x, (axis_name,), to="varying")
-    return lax.pvary(x, (axis_name,))
+def _vary(x, axis_name, like=None):
+    """Mark ``x`` device-varying over ``axis_name`` plus every axis that
+    ``like`` already varies on (e.g. 'sep' when the microbatch stream is
+    context-sharded inside a 5D pp x sep region) — scan carries must
+    type-match their ppermute'd outputs."""
+    from ..framework._vma import pvary_missing
+    return pvary_missing(x, (axis_name,), like=like)
 
 
 def pipeline_spmd(stage_fn, stage_params, x_micro, axis_name,
@@ -113,8 +116,10 @@ def pipeline_spmd(stage_fn, stage_params, x_micro, axis_name,
         act = lax.ppermute(out, axis_name, perm)
         return (act, outbuf), None
 
-    act0 = _vary(jnp.zeros(mb_shape, x_micro.dtype), axis_name)
-    outbuf0 = _vary(jnp.zeros((M,) + mb_shape, x_micro.dtype), axis_name)
+    act0 = _vary(jnp.zeros(mb_shape, x_micro.dtype), axis_name,
+                 like=x_micro)
+    outbuf0 = _vary(jnp.zeros((M,) + mb_shape, x_micro.dtype), axis_name,
+                    like=x_micro)
     (act, outbuf), _ = lax.scan(tick, (act0, outbuf0), jnp.arange(T))
     # only the last stage's buffer is real; replicate it over the axis
     mask = (idx == S - 1).astype(outbuf.dtype)
@@ -192,20 +197,33 @@ def _pipeline_interleaved(stage_fn, stage_params, x_micro, axis_name,
         act = lax.ppermute(out, axis_name, perm)
         return (act, outbuf), None
 
-    act0 = _vary(jnp.zeros(mb_shape, x_micro.dtype), axis_name)
-    outbuf0 = _vary(jnp.zeros((M,) + mb_shape, x_micro.dtype), axis_name)
+    act0 = _vary(jnp.zeros(mb_shape, x_micro.dtype), axis_name,
+                 like=x_micro)
+    outbuf0 = _vary(jnp.zeros((M,) + mb_shape, x_micro.dtype), axis_name,
+                    like=x_micro)
     (act, outbuf), _ = lax.scan(tick, (act0, outbuf0), jnp.arange(T))
     mask = (d == 0).astype(outbuf.dtype)
     return lax.psum(outbuf * mask, axis_name)
 
 
 def run_pipeline(stage_fn, stacked_params, x_micro, mesh, axis_name="pipe",
-                 n_virtual=1, remat=None):
-    """Global-view entry: partial-manual shard_map over the pipe axis only
+                 n_virtual=1, remat=None, extra_axes=(), x_spec=None):
+    """Global-view entry: partial-manual shard_map over the pipe axis
     (other mesh axes stay under GSPMD). ``stacked_params`` leaves are
     [S, ...] arrays sharded on dim 0 over 'pipe' (n_virtual == 1), or
     [V, S, ...] sharded on dim 1 (interleaved: global chunk v*S + d is
-    device d's local chunk v)."""
+    device d's local chunk v).
+
+    extra_axes/x_spec — the 5D pp x sep composition: ``extra_axes``
+    names additional mesh axes to bind manually alongside 'pipe'
+    (e.g. ('sep',)), and ``x_spec`` shards the microbatch stream over
+    them (e.g. P(None, None, 'sep') — sequence dim context-sharded).
+    Inside the region, stage_fn's attention issues the K/V ring directly
+    on the bound 'sep' axis (``sep_attention_manual``); the same spec
+    reassembles the output, so the epilogue/loss still see the full
+    logical sequence under GSPMD. Parameter cotangents are psum'd over
+    the extra axes automatically by shard_map's reverse-mode (their
+    in_specs don't mention 'sep', so the transpose inserts the sum)."""
     from jax.sharding import PartitionSpec as P
 
     if n_virtual == 1:
@@ -213,13 +231,15 @@ def run_pipeline(stage_fn, stacked_params, x_micro, mesh, axis_name="pipe",
     else:
         pspecs = jax.tree.map(lambda _: P(None, axis_name),
                               stacked_params)
+    if x_spec is None:
+        x_spec = P()
 
     f = jax.shard_map(
         functools.partial(pipeline_spmd, stage_fn, axis_name=axis_name,
                           n_virtual=n_virtual, remat=remat),
         mesh=mesh,
-        in_specs=(pspecs, P()),
-        out_specs=P(),
-        axis_names={axis_name},
+        in_specs=(pspecs, x_spec),
+        out_specs=x_spec,
+        axis_names={axis_name, *extra_axes},
     )
     return f(stacked_params, x_micro)

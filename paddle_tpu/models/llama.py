@@ -231,13 +231,24 @@ class LlamaAttention(nn.Layer):
                 q, k, v, cache[0], cache[1], pos)
             ctx = M.reshape(ctx, [b, s, self.num_heads * self.head_dim])
             return self.o_proj(ctx), (k_cache, v_cache)
-        from ..incubate.nn.functional import \
-            fused_rotary_position_embedding
-        q, k, _ = fused_rotary_position_embedding(
-            q, k, None, rotary_emb_base=self.cfg.rope_theta)
-        if self.cfg.sep_parallel is not None:
-            from ..distributed.fleet.meta_parallel.context_parallel import \
-                sep_attention
+        from ..distributed.fleet.meta_parallel.context_parallel import (
+            sep_attention, sep_attention_manual, sep_axis_is_manual)
+        sep_manual = (self.cfg.sep_parallel is not None
+                      and sep_axis_is_manual())
+        if not sep_manual:
+            from ..incubate.nn.functional import \
+                fused_rotary_position_embedding
+            q, k, _ = fused_rotary_position_embedding(
+                q, k, None, rotary_emb_base=self.cfg.rope_theta)
+        if sep_manual:
+            # 5D hybrid: inside the compiled pipeline's manual region
+            # the sequence is physically local — rope needs global
+            # positions, applied inside the wrapper from the bound
+            # 'sep' axis index
+            ctx = sep_attention_manual(
+                q, k, v, rope_theta=self.cfg.rope_theta,
+                causal=True, impl=self.cfg.sep_parallel)
+        elif self.cfg.sep_parallel is not None:
             ctx = sep_attention(q, k, v, causal=True,
                                 impl=self.cfg.sep_parallel)
         else:

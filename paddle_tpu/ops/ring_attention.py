@@ -140,14 +140,13 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
         return (acc, m_new, l, k_c, v_c, kp), None
 
     def _vary(x):
-        # mark freshly-created carry state as device-varying over the ring
-        # axis so the scan carry type matches its ppermute'd outputs
-        if hasattr(lax, "pcast"):
-            return lax.pcast(x, (axis_name,), to="varying")
-        try:
-            return lax.pvary(x, (axis_name,))
-        except (AttributeError, TypeError):
-            return x
+        # Mark freshly-created carry state as device-varying so the scan
+        # carry type matches its outputs. The outputs vary over the ring
+        # axis AND over every axis the q/k/v inputs already vary on —
+        # e.g. 'pipe' when this ring runs inside the compiled pipeline
+        # engine's manual region (the 5D hybrid).
+        from ..framework._vma import pvary_missing
+        return pvary_missing(x, (axis_name,), like=qf)
 
     carry0 = (
         _vary(jnp.zeros((b, h, sq, d), jnp.float32)),

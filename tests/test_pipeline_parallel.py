@@ -237,7 +237,7 @@ def test_pipeline_interleaved_train_parity(pipe_fleet):
 # explicit schedules through the fleet API (strategy.pipeline_configs)
 # --------------------------------------------------------------------------
 
-def _fleet_schedule_losses(schedule_mode, steps=3):
+def _fleet_schedule_losses(schedule_mode, steps=3, num_virtual=None):
     """Drive PipelineParallel the way a user does: fleet.init with
     strategy.pipeline_configs, fleet.distributed_model, train_batch."""
     strategy = fleet.DistributedStrategy()
@@ -246,6 +246,9 @@ def _fleet_schedule_losses(schedule_mode, steps=3):
                                "sep_degree": 1}
     strategy.pipeline_configs = {"accumulate_steps": 2,
                                  "schedule_mode": schedule_mode}
+    if num_virtual is not None:
+        strategy.pipeline_configs["num_virtual_pipeline_stages"] = \
+            num_virtual
     fleet.init(strategy=strategy)
     try:
         paddle.seed(42)
@@ -278,14 +281,37 @@ def _sequential_reference_losses(steps=3):
             for _ in range(steps)]
 
 
-@pytest.mark.parametrize("schedule_mode", ["FThenB", "1F1B", "ZB-H1"])
+@pytest.mark.parametrize("schedule_mode", ["FThenB", "1F1B", "ZB-H1",
+                                           "interleaved"])
 def test_fleet_schedule_mode_parity(schedule_mode):
     """Every selectable schedule trains to the same losses as the eager
-    sequential loop on an identically-initialized model."""
-    losses = _fleet_schedule_losses(schedule_mode)
+    sequential loop on an identically-initialized model. 'interleaved'
+    gets its virtual-stage count purely from pipeline_configs (8 blocks
+    over pp4 x V2 = 8 chunks of 1 block)."""
+    nv = 2 if schedule_mode == "interleaved" else None
+    losses = _fleet_schedule_losses(schedule_mode, num_virtual=nv)
     ref = _sequential_reference_losses()
     np.testing.assert_allclose(losses, ref, rtol=2e-4, atol=2e-4)
     assert losses[-1] < losses[0]
+
+
+def test_interleaved_needs_virtual_stages():
+    """schedule_mode='interleaved' without a virtual-stage count is a
+    configuration error, not a silent FThenB fallback."""
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 4, "sharding_degree": 1,
+                               "sep_degree": 1}
+    strategy.pipeline_configs = {"schedule_mode": "interleaved"}
+    fleet.init(strategy=strategy)
+    try:
+        model = _make_pipe_model()
+        with pytest.raises(ValueError, match="virtual"):
+            fleet.fleet.distributed_model(model)
+    finally:
+        fleet.fleet._hcg = None
+        fleet.fleet._topology = None
+        fleet.fleet._is_initialized = False
 
 
 # --------------------------------------------------------------------------
@@ -293,7 +319,8 @@ def test_fleet_schedule_mode_parity(schedule_mode):
 # config 4's workload shape) — the pp axis no longer runs in isolation
 # --------------------------------------------------------------------------
 
-@pytest.mark.parametrize("schedule", ["FThenB", "1F1B", "ZB-H1"])
+@pytest.mark.parametrize("schedule", ["FThenB", "1F1B", "ZB-H1",
+                                      "interleaved"])
 def test_hybrid_4d_pipeline_llama_parity(schedule):
     """dp1 x sharding2 x pp2 x mp2 over 8 devices in ONE compiled pipeline
     program — under EVERY schedule (compiled FThenB scan AND the
@@ -336,6 +363,10 @@ def test_hybrid_4d_pipeline_llama_parity(schedule):
                                "sep_degree": 1, "ep_degree": 1}
     strategy.pipeline_configs = {"accumulate_steps": 2,
                                  "schedule_mode": schedule}
+    if schedule == "interleaved":
+        # 4 decoder layers over pp2 x V2 = 4 chunks of 1 layer each,
+        # selected purely through the fleet strategy (first-class VPP)
+        strategy.pipeline_configs["num_virtual_pipeline_stages"] = 2
     fleet.init(is_collective=True, strategy=strategy)
     try:
         hcg = fleet.get_hybrid_communicate_group()
@@ -360,6 +391,110 @@ def test_hybrid_4d_pipeline_llama_parity(schedule):
         accs = opt._inner._inner._accumulators
         assert any("sharding" in str(t._data.sharding.spec)
                    for store in accs.values() for t in store.values())
+    finally:
+        fleet.fleet._hcg = None
+        fleet.fleet._topology = None
+        fleet.fleet._is_initialized = False
+
+
+# --------------------------------------------------------------------------
+# 5D: pipeline COMPOSED with ring context parallelism (+ TP/SP) — the sep
+# axis's K/V ring runs INSIDE the compiled pipeline program, so ring-CP
+# activations cross pipeline-stage boundaries (SURVEY.md §2.3 hybrid row)
+# --------------------------------------------------------------------------
+
+@pytest.mark.parametrize("schedule", ["FThenB", "interleaved"])
+def test_hybrid_5d_pipeline_sep_llama_parity(schedule):
+    """pp2 x mp2 x sep2 over 8 devices in ONE compiled program: the
+    pipeline's shard_map binds BOTH 'pipe' and 'sep', the decoder
+    stack's ring attention issues its ppermute K/V ring directly on the
+    bound 'sep' axis (with globally-offset RoPE), and TP/SP stay under
+    GSPMD — ring-CP activations cross pipeline-stage boundaries. Oracle:
+    multi-step loss parity vs the single-device eager model."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaForCausalLMPipe)
+
+    def cfg(par):
+        return LlamaConfig(vocab_size=256, hidden_size=64,
+                           num_hidden_layers=4, num_attention_heads=4,
+                           num_key_value_heads=2, intermediate_size=128,
+                           max_position_embeddings=32, rope_theta=10000.0,
+                           tensor_parallel=par,
+                           sequence_parallel=par,
+                           sep_parallel="ring" if par else None)
+
+    ids_np = np.random.RandomState(0).randint(
+        0, 256, (4, 32)).astype(np.int64)
+    steps = 2
+
+    paddle.seed(0)
+    ref_model = LlamaForCausalLM(cfg(False))
+    ref_opt = paddle.optimizer.AdamW(1e-3,
+                                     parameters=ref_model.parameters())
+    ids_t = paddle.to_tensor(ids_np)
+    ref = []
+    for _ in range(steps):
+        _, loss = ref_model(ids_t, labels=ids_t)
+        loss.backward()
+        ref_opt.step()
+        ref_opt.clear_grad()
+        ref.append(float(loss.item()))
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 2, "ep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": schedule}
+    if schedule == "interleaved":
+        strategy.pipeline_configs["num_virtual_pipeline_stages"] = 2
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        hcg = fleet.get_hybrid_communicate_group()
+        mesh = hcg.global_mesh
+        paddle.seed(0)
+        model = LlamaForCausalLMPipe(cfg(True))
+        engine = fleet.fleet.distributed_model(model)
+        assert isinstance(engine, PipelineParallel)
+        opt = fleet.fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(1e-3, parameters=model.parameters()))
+        ids = jax.device_put(
+            jnp.asarray(ids_np),
+            NamedSharding(mesh, PartitionSpec(("data", "sharding"),
+                                              "sep")))
+        ids_p = paddle.Tensor(ids)
+        losses = [float(engine.train_batch((ids_p, ids_p), opt).item())
+                  for _ in range(steps)]
+        np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-5)
+    finally:
+        fleet.fleet._hcg = None
+        fleet.fleet._topology = None
+        fleet.fleet._is_initialized = False
+
+
+def test_hybrid_5d_explicit_schedule_rejected():
+    """1F1B/ZB-H1 + an active sep axis is a documented configuration
+    error (the explicit tick engines would need a sep-aware epilogue),
+    not a silently-wrong run."""
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLMPipe
+    c = LlamaConfig(vocab_size=256, hidden_size=64, num_hidden_layers=4,
+                    num_attention_heads=4, num_key_value_heads=2,
+                    intermediate_size=128, max_position_embeddings=32,
+                    rope_theta=10000.0, tensor_parallel=True,
+                    sep_parallel="ring")
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 2, "ep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": "1F1B"}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(0)
+        model = LlamaForCausalLMPipe(c)
+        with pytest.raises(ValueError, match="sep"):
+            fleet.fleet.distributed_model(model)
     finally:
         fleet.fleet._hcg = None
         fleet.fleet._topology = None
