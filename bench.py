@@ -278,6 +278,146 @@ def _cb_bench(on_tpu):
     return best
 
 
+def _moe_train_bench(on_tpu, dev):
+    """MoE train MFU (BASELINE config 5: Qwen2-MoE shape, chip-sized).
+
+    MFU counts ACTIVATED FLOPs: 6·N_active·tokens + the S² attention
+    term, where N_active replaces each layer's E-expert bank with the
+    k experts a token actually visits (router + shared expert + attn
+    params all included). Dispatch runs the index gather/scatter path
+    (ops/moe.py), so expert matmuls dominate the step, not routing."""
+    import dataclasses
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    if on_tpu:
+        cfg = Qwen2MoeConfig(
+            vocab_size=32000, hidden_size=1024, num_hidden_layers=12,
+            num_attention_heads=8, num_key_value_heads=4,
+            intermediate_size=2816, max_position_embeddings=4096,
+            rope_theta=10000.0, num_experts=16, num_experts_per_tok=2,
+            moe_intermediate_size=1408,
+            shared_expert_intermediate_size=2816,
+            capacity_factor=2.0, scan_layers=False)
+        batch, seq = 8, 2048
+        steps, warmup = 8, 3
+    else:
+        cfg = dataclasses.replace(Qwen2MoeConfig.tiny(), scan_layers=False)
+        batch, seq = 2, 64
+        steps, warmup = 3, 1
+
+    paddle.seed(0)
+    model = Qwen2MoeForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    ids = paddle.to_tensor(
+        np.random.RandomState(0).randint(0, cfg.vocab_size,
+                                         (batch, seq + 1)).astype(np.int64))
+
+    @paddle.jit.to_static
+    def fwd_bwd(ids):
+        _, loss = model(ids, labels=ids)
+        loss.backward()
+        gsum = None
+        for p in model.parameters():
+            if p.grad is not None:
+                s = p.grad.astype("float32").sum()
+                gsum = s if gsum is None else gsum + s
+        for p in model.parameters():
+            p.clear_grad()
+        return loss, gsum
+
+    step_ids = [paddle.to_tensor(np.roll(np.asarray(ids.numpy()), i,
+                                         axis=1))
+                for i in range(steps)]
+    for _ in range(warmup):
+        loss, gsum = fwd_bwd(ids)
+    float(loss.item())
+
+    t0 = time.perf_counter()
+    acc = None
+    for i in range(steps):
+        loss, gsum = fwd_bwd(step_ids[i])
+        acc = loss if acc is None else acc + loss
+    float(acc.item())
+    dt = (time.perf_counter() - t0) / steps
+
+    tokens = batch * seq
+    n_total = sum(p.size for p in model.parameters())
+    L, d = cfg.num_hidden_layers, cfg.hidden_size
+    per_expert = 3 * d * cfg.moe_intermediate_size
+    n_active = n_total - L * (cfg.num_experts
+                              - cfg.num_experts_per_tok) * per_expert
+    flops_per_step = 6.0 * n_active * tokens \
+        + 12.0 * L * batch * seq * seq * d
+    mfu = flops_per_step / dt / _peak_flops(dev)
+    tok_per_s = tokens / dt
+    print(f"# moe train: step {dt*1000:.1f} ms, params {n_total/1e9:.3f}B "
+          f"({n_active/1e9:.3f}B active), MFU {mfu*100:.1f}%, "
+          f"loss {float(loss.item()):.3f}", file=sys.stderr)
+    return n_total, tok_per_s, mfu
+
+
+def _moe_decode_bench(on_tpu):
+    """DeepSeek-V2 greedy decode through the MLA LATENT KV cache
+    (the memory-side point of MLA: the cache holds [B, T, R] latents
+    + rope keys instead of full per-head K/V)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import DeepseekV2Config, DeepseekV2ForCausalLM
+
+    if on_tpu:
+        cfg = DeepseekV2Config(
+            vocab_size=32000, hidden_size=1024, num_hidden_layers=12,
+            num_attention_heads=16, q_lora_rank=384, kv_lora_rank=256,
+            qk_nope_head_dim=64, qk_rope_head_dim=32, v_head_dim=64,
+            intermediate_size=2816, moe_intermediate_size=704,
+            n_routed_experts=16, n_shared_experts=2,
+            num_experts_per_tok=2, first_k_dense_replace=1,
+            routed_scaling_factor=1.0, norm_topk_prob=True,
+            max_position_embeddings=2048)
+        batch, prompt, n_new = 8, 128, 256
+    else:
+        cfg = DeepseekV2Config.tiny()
+        batch, prompt, n_new = 2, 8, 8
+
+    paddle.seed(0)
+    model = DeepseekV2ForCausalLM(cfg)
+    model.to(dtype="bfloat16")
+    model.eval()
+    ids = paddle.to_tensor(np.random.RandomState(1).randint(
+        0, cfg.vocab_size, (batch, prompt)).astype(np.int64))
+
+    def run(n, prompt_t):
+        out, _ = model.generate(prompt_t, max_new_tokens=n,
+                                decode_strategy="greedy_search",
+                                eos_token_id=None, pad_token_id=0)
+        return int(out[0, -1].item())
+
+    base = np.asarray(ids.numpy())
+    prompts = [paddle.to_tensor(np.roll(base, i + 1, axis=1))
+               for i in range(5)]
+    run(n_new, ids)
+    run(4, prompts[0])
+
+    def timed(n, prompt_t):
+        t0 = time.perf_counter()
+        run(n, prompt_t)
+        return time.perf_counter() - t0
+
+    dt_long = min(timed(n_new, prompts[1]), timed(n_new, prompts[2]))
+    dt_short = min(timed(4, prompts[3]), timed(4, prompts[4]))
+    per_tok = max(dt_long - dt_short, 1e-9) / (n_new - 4)
+    tok_per_s = batch / per_tok
+    print(f"# moe decode (MLA latent cache): {per_tok*1000:.2f} "
+          f"ms/token/batch, {tok_per_s:.0f} tokens/s (batch {batch})",
+          file=sys.stderr)
+    return tok_per_s
+
+
 def main():
     import jax
 
@@ -297,6 +437,18 @@ def main():
     except Exception as e:
         print(f"# continuous-batching bench failed: {e!r}", file=sys.stderr)
         cb_tok_s = None
+    try:
+        moe_params, moe_tok_s, moe_mfu = _retry_transient(
+            lambda: _moe_train_bench(on_tpu, dev), "moe train bench")
+    except Exception as e:
+        print(f"# moe train bench failed: {e!r}", file=sys.stderr)
+        moe_params = moe_tok_s = moe_mfu = None
+    try:
+        moe_decode_tok_s = _retry_transient(
+            lambda: _moe_decode_bench(on_tpu), "moe decode bench")
+    except Exception as e:
+        print(f"# moe decode bench failed: {e!r}", file=sys.stderr)
+        moe_decode_tok_s = None
 
     suffix = "" if on_tpu else "_cpu_smoke"
     record = {
@@ -315,6 +467,18 @@ def main():
                                + suffix)
         record["cb_value"] = round(cb_tok_s, 2)
         record["cb_unit"] = "tokens/s/chip"
+    if moe_tok_s is not None:
+        record["moe_metric"] = (
+            f"qwen2_moe_{moe_params/1e9:.2f}B_fwd_bwd_bf16_tokens_per_sec"
+            + suffix)
+        record["moe_value"] = round(moe_tok_s, 2)
+        record["moe_unit"] = "tokens/s/chip"
+        record["moe_mfu"] = round(moe_mfu, 4)
+    if moe_decode_tok_s is not None:
+        record["moe_decode_metric"] = (
+            "deepseek_v2_mla_latent_cache_greedy_decode" + suffix)
+        record["moe_decode_value"] = round(moe_decode_tok_s, 2)
+        record["moe_decode_unit"] = "tokens/s/chip"
     print(json.dumps(record))
 
 

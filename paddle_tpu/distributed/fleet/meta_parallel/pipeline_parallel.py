@@ -135,13 +135,14 @@ class PipelineParallel:
                     "virtual pipeline stages; use schedule_mode="
                     "'interleaved' or num_virtual_pipeline_stages=1")
             if self._schedule not in _SCAN_SCHEDULES and \
-                    self._sep_axes():
+                    (self._sep_axes() or self._expert_axes()):
                 raise ValueError(
-                    "the 5D pp x sep composition currently runs under "
-                    "the compiled scan schedules; use schedule_mode="
-                    "'FThenB' or 'interleaved' (the explicit 1F1B/ZB-H1 "
-                    "tick engines compute the loss inside the manual "
-                    "region, which needs a sep-aware epilogue — "
+                    "pp composed with sep/expert axes currently runs "
+                    "under the compiled scan schedules; use "
+                    "schedule_mode='FThenB' or 'interleaved' (the "
+                    "explicit 1F1B/ZB-H1 tick engines compute loss and "
+                    "grads inside the manual region, which needs sep/"
+                    "ep-aware epilogue and gradient reduction — "
                     "not yet implemented)")
 
     def _sep_axes(self):
@@ -157,6 +158,21 @@ class PipelineParallel:
             if cfg is not None and \
                     getattr(cfg, "sep_parallel", None) is not None:
                 return (self._hcg.sep_axis_name,)
+        return ()
+
+    def _expert_axes(self):
+        """('expert',) when the mesh's ep degree > 1 AND the stage
+        layers contain MoE blocks — the pipeline region then binds the
+        expert axis manually so MoELayer's all-to-all dispatch runs
+        inside the compiled pipeline program (ep x pp)."""
+        if self._hcg is None or \
+                self._hcg.get_expert_parallel_world_size() <= 1:
+            return ()
+        from ....incubate.distributed.models.moe import MoELayer
+        for l in self._layers.run_function:
+            for m in l.sublayers(include_self=True):
+                if isinstance(m, MoELayer):
+                    return (self._hcg.ep_axis_name,)
         return ()
 
     def __getattr__(self, name):
@@ -251,13 +267,16 @@ class PipelineParallel:
                         for v in range(V)])
                     for i in range(n_leaves))
 
-            extra = self._sep_axes()
+            sep = self._sep_axes()
+            extra = sep + self._expert_axes()
             x_spec = None
-            if extra:
+            if sep:
                 from jax.sharding import PartitionSpec as P
                 # h_micro is [M, b//M, S, H] — sequence dim 2 rides the
-                # context axis through the manual region
-                x_spec = P(None, None, extra[0])
+                # context axis through the manual region (activations
+                # stay REPLICATED over 'expert'; MoELayer slices its
+                # token shard internally)
+                x_spec = P(None, None, sep[0])
             return run_pipeline(_make_stage_fn(template, template_params),
                                 stacked, hm, mesh,
                                 axis_name=self._hcg.pp_axis_name,

@@ -402,6 +402,16 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
             xbuf, ybuf, gbuf, dxbuf, dp, depi, loss)
 
         fmsg_n = lax.ppermute(fout, axis_name, fwd_perm)
+        # ORDER the two per-tick hops: without a data dependency the
+        # forward-hop and backward-hop ppermutes are independent, and a
+        # runtime with no global collective ordering (XLA:CPU thunks;
+        # 16-device virtual meshes) can have half the devices enter one
+        # and half the other — a rendezvous deadlock. The barrier ties
+        # the backward hop's input to the forward hop's completion, so
+        # every device issues them in the same order. On TPU this costs
+        # nothing (the transfers still overlap compute; they ride
+        # opposite ICI directions).
+        bout, _ = lax.optimization_barrier((bout, fmsg_n))
         bmsg_n = lax.ppermute(bout, axis_name, bwd_perm)
         return (xbuf, ybuf, gbuf, dxbuf, dp, depi, loss,
                 fmsg_n, bmsg_n), None

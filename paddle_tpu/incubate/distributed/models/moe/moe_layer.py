@@ -92,6 +92,11 @@ class MoELayer(Layer):
                 p.set_data(jax.device_put(
                     p._data, NamedSharding(mesh, P(axis, None, None))))
 
+    def _ep_axis_is_manual(self) -> bool:
+        from .....distributed.communication import axis_in_traced_region
+        return self._ep_axis is not None and \
+            axis_in_traced_region(self._ep_axis)
+
     def forward(self, x):
         orig_shape = x.shape
         d = orig_shape[-1]
@@ -100,7 +105,47 @@ class MoELayer(Layer):
         ntp = self.gate.norm_topk_prob
         axis, mesh, ep = self._ep_axis, self._mesh, self._ep
 
-        if mesh is not None and ep > 1:
+        if mesh is not None and ep > 1 and self._ep_axis_is_manual():
+            # Inside a manual region that already binds the 'expert'
+            # axis — the compiled pipeline engine running an ep x pp
+            # hybrid. Activations arrive REPLICATED over 'expert': each
+            # rank slices its token shard and its expert-bank shard by
+            # axis index, runs the same all-to-all dispatch core, and
+            # the full token set is reassembled with a masked psum
+            # (which also restores expert-invariance for the carry
+            # types). Weight cotangents psum over 'expert'
+            # automatically at the region boundary (their specs don't
+            # mention the axis).
+            from jax import lax
+
+            def fn(xx, rw, wg, wu, wd):
+                flat = xx.reshape(-1, d)
+                T = flat.shape[0]
+                ep_n = self._ep
+                if T % ep_n:
+                    raise ValueError(
+                        f"token count {T} not divisible by ep {ep_n}")
+                E = self.num_experts
+                idx = lax.axis_index(axis)
+                tl, el = T // ep_n, E // ep_n
+                xf = lax.dynamic_slice_in_dim(flat, idx * tl, tl, 0)
+                wgl = lax.dynamic_slice_in_dim(wg, idx * el, el, 0)
+                wul = lax.dynamic_slice_in_dim(wu, idx * el, el, 0)
+                wdl = lax.dynamic_slice_in_dim(wd, idx * el, el, 0)
+                y, aux, z = moe_ops.moe_forward_ep(
+                    xf, rw,
+                    lambda t: moe_ops.moe_ffn_grouped(t, wgl, wul, wdl),
+                    axis, k=k, capacity_factor=cf, norm_topk_prob=ntp)
+                buf = jnp.zeros_like(flat)
+                buf = lax.dynamic_update_slice_in_dim(
+                    buf, y.astype(buf.dtype), idx * tl, 0)
+                full = lax.psum(buf, axis)
+                return full.reshape(xx.shape), aux, z
+
+            out, aux, z = apply(fn, x, self.router_weight, self.w_gate,
+                                self.w_up, self.w_down, n_outputs=3,
+                                name="moe_layer_ep_manual")
+        elif mesh is not None and ep > 1:
             from jax.sharding import PartitionSpec as P
 
             def fn(xx, rw, wg, wu, wd):

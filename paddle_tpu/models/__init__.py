@@ -7,7 +7,8 @@ from .gpt2 import GPT2Config, GPT2Model, GPT2ForCausalLM
 from .llama import (LlamaConfig, LlamaModel, LlamaForCausalLM,
                     LlamaForCausalLMPipe, LlamaPretrainingCriterion)
 from .qwen2 import (Qwen2Config, Qwen2MoeConfig, Qwen2ForCausalLM,
-                    Qwen2MoeForCausalLM)
+                    Qwen2MoeForCausalLM, Qwen2MoeForCausalLMPipe,
+                    Qwen2MoePretrainingCriterion)
 from .ernie import (ErnieConfig, ErnieModel, ErnieForPretraining,
                     ErnieForMaskedLM, ErnieForSequenceClassification)
 from .deepseek import DeepseekV2Config, DeepseekV2ForCausalLM
@@ -16,5 +17,6 @@ __all__ = ["GPT2Config", "GPT2Model", "GPT2ForCausalLM", "LlamaConfig",
            "LlamaModel", "LlamaForCausalLM", "LlamaForCausalLMPipe",
            "LlamaPretrainingCriterion", "Qwen2Config",
            "Qwen2MoeConfig", "Qwen2ForCausalLM", "Qwen2MoeForCausalLM",
+           "Qwen2MoeForCausalLMPipe", "Qwen2MoePretrainingCriterion",
            "ErnieConfig", "ErnieModel", "ErnieForPretraining",
            "ErnieForMaskedLM", "ErnieForSequenceClassification", "DeepseekV2Config", "DeepseekV2ForCausalLM"]

@@ -25,6 +25,7 @@ from .llama import (rope_with_offset, _alloc_kv_caches,
                     _paged_attention_step)
 
 __all__ = ["Qwen2Config", "Qwen2MoeConfig", "Qwen2ForCausalLM",
+           "Qwen2MoeForCausalLMPipe", "Qwen2MoePretrainingCriterion",
            "Qwen2MoeForCausalLM"]
 
 
@@ -301,3 +302,61 @@ class Qwen2ForCausalLM(_Qwen2Base):
 class Qwen2MoeForCausalLM(_Qwen2Base):
     def __init__(self, config: Qwen2MoeConfig):
         super().__init__(config, moe=True)
+
+
+# ---------------------------------------------------------------------------
+# Pipeline-parallel Qwen2-MoE: the ep x pp composition workload (SURVEY.md
+# §2.3 EP row — expert all-to-all dispatch inside the compiled pipeline
+# program). Construction order matches _Qwen2Base exactly so same-seed
+# models draw identical initial weights (the parity-test basis).
+# ---------------------------------------------------------------------------
+
+
+# The prologue/epilogue/criterion are duck-typed on config fields that
+# Qwen2MoeConfig shares with LlamaConfig (vocab_size, hidden_size,
+# initializer_range, rms_norm_eps, tensor_parallel) — reuse the llama
+# pipe classes rather than duplicating them.
+from .llama import (LlamaEmbeddingPipe as Qwen2EmbeddingPipe,
+                    LlamaHeadPipe as Qwen2HeadPipe)
+
+
+class Qwen2MoeDecoderLayerPipe(Qwen2DecoderLayer):
+    """Decoder stage for the pipeline body; carries ``config`` so the
+    engine can detect MoE/sep participation."""
+
+    def __init__(self, cfg):
+        super().__init__(cfg, moe=True)
+        self.config = cfg
+
+
+class Qwen2MoePretrainingCriterion(nn.Layer):
+    """Shifted next-token CE — the PLAIN language-model loss. The router
+    aux loss is an eager per-layer attribute in the monolithic model and
+    cannot cross the compiled pipeline boundary; pipelined MoE training
+    therefore runs with aux folded out (router_aux_loss_coef=0 parity —
+    load balance still trains through the dispatch gradient)."""
+
+    def __init__(self, cfg):
+        super().__init__()
+        self.vocab_size = cfg.vocab_size
+
+    def forward(self, logits, labels):
+        shift_logits = logits[:, :-1, :]
+        shift_labels = labels[:, 1:]
+        return F.cross_entropy(
+            M.reshape(shift_logits, [-1, self.vocab_size]),
+            M.reshape(shift_labels, [-1]))
+
+
+def Qwen2MoeForCausalLMPipe(config, **pipeline_kwargs):
+    """Build the pipelined Qwen2-MoE as a ``PipelineLayer`` (embedding
+    prologue / uniform MoE decoder body / norm+head epilogue)."""
+    from ..distributed.fleet.meta_parallel import LayerDesc, PipelineLayer
+
+    descs = [LayerDesc(Qwen2EmbeddingPipe, config)] + \
+        [LayerDesc(Qwen2MoeDecoderLayerPipe, config)
+         for _ in range(config.num_hidden_layers)] + \
+        [LayerDesc(Qwen2HeadPipe, config)]
+    pipeline_kwargs.setdefault("loss_fn",
+                               Qwen2MoePretrainingCriterion(config))
+    return PipelineLayer(descs, **pipeline_kwargs)

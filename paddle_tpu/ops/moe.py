@@ -27,8 +27,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-__all__ = ["top_k_gating", "moe_dispatch_combine", "moe_ffn_grouped",
-           "moe_forward", "moe_forward_ep"]
+__all__ = ["top_k_gating", "top_k_gating_idx", "moe_dispatch_combine",
+           "moe_ffn_grouped", "moe_forward", "moe_forward_ep"]
 
 
 def top_k_gating(logits, k, capacity, norm_topk_prob=True):
@@ -72,8 +72,79 @@ def top_k_gating(logits, k, capacity, norm_topk_prob=True):
     return dispatch, combine, aux_loss, z_loss
 
 
+def top_k_gating_idx(logits, k, capacity, norm_topk_prob=True):
+    """Index-form top-k gating — identical routing/drop semantics to
+    :func:`top_k_gating` (same row-major (t, k) queue priority) but
+    returns per-assignment INDICES instead of one-hot [T, E, C]
+    dispatch/combine tensors. At chip scale the one-hot form is the
+    bottleneck: the tensors are O(T·E·C) memory and the dispatch
+    einsums cost 2·cf·k·T²·d FLOPs — several times the expert matmuls
+    themselves. The index form moves O(T·k·d) bytes with a
+    scatter/gather pair instead (the TPU-idiomatic dispatch).
+
+    Returns (gate_idx [T,k] int32, gate_vals [T,k] fp32,
+    pos [T,k] int32 queue position, keep [T,k] bool, aux, z).
+    """
+    T, E = logits.shape
+    logits = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = lax.top_k(probs, k)          # [T, k]
+    if norm_topk_prob:
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+
+    assign = jax.nn.one_hot(gate_idx, E, dtype=jnp.float32)  # [T, k, E]
+    flat = assign.reshape(T * k, E)
+    pos_e = jnp.cumsum(flat, axis=0) - flat             # [T*k, E]
+    pos = jnp.sum(pos_e.reshape(T, k, E) * assign, axis=-1)  # [T, k]
+    pos = pos.astype(jnp.int32)
+    keep = pos < capacity
+
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.sum(assign, axis=(0, 1)) / (T * k)
+    aux_loss = E * jnp.sum(me * ce)
+    z_loss = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+    return gate_idx.astype(jnp.int32), gate_vals, pos, keep, aux_loss, \
+        z_loss
+
+
+def _dispatch_gather(x, gate_idx, pos, keep, E, C):
+    """Build the [E, C, d] expert input bank by scatter+gather.
+
+    Each kept assignment (t, i) owns the unique slot e*C + pos; a
+    scatter writes its token index there (sentinel T elsewhere), and a
+    gather from zero-padded x fills the bank. Returns (xd [E,C,d],
+    slot [T,k] int32 clamped to a trash slot for drops)."""
+    T, k = gate_idx.shape
+    d = x.shape[-1]
+    slot = gate_idx * C + jnp.minimum(pos, C - 1)       # [T, k]
+    slot = jnp.where(keep, slot, E * C)                 # trash slot
+    token_of = jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[:, None], (T, k))
+    token_idx = jnp.full((E * C + 1,), T, dtype=jnp.int32)
+    token_idx = token_idx.at[slot.reshape(-1)].set(
+        token_of.reshape(-1), mode="drop")
+    x_pad = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)], axis=0)
+    xd = x_pad[token_idx[:E * C]].reshape(E, C, d)
+    return xd, slot
+
+
+def _combine_gather(out, slot, gate_vals, keep, x_dtype):
+    """Inverse of :func:`_dispatch_gather`: gather each assignment's
+    expert output by slot and weight by its gate value."""
+    E_C, d = out.shape[0] * out.shape[1], out.shape[-1]
+    out_pad = jnp.concatenate(
+        [out.reshape(E_C, d),
+         jnp.zeros((1, d), out.dtype)], axis=0)
+    y_k = out_pad[slot]                                  # [T, k, d]
+    w = (gate_vals * keep).astype(y_k.dtype)[..., None]
+    return jnp.sum(y_k * w, axis=1).astype(x_dtype)
+
+
 def moe_dispatch_combine(x, dispatch, combine, expert_fn):
-    """Dense (single-device) capacity dispatch: x [T, d] -> [T, d]."""
+    """Dense (single-device) capacity dispatch: x [T, d] -> [T, d].
+    One-hot tensor form (kept for the public OpTest surface; the
+    forward paths below use the index form)."""
     xd = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)
     out = expert_fn(xd)                                 # [E, C, d]
     return jnp.einsum("tec,ecd->td", combine.astype(out.dtype), out)
@@ -96,10 +167,12 @@ def moe_forward(x, router_w, expert_fn, k=2, capacity_factor=1.25,
     E = router_w.shape[1]
     capacity = max(int(capacity_factor * k * T / E), 1)
     logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    dispatch, combine, aux, z = top_k_gating(logits, k, capacity,
-                                             norm_topk_prob)
-    out = moe_dispatch_combine(x, dispatch, combine, expert_fn)
-    return out.astype(x.dtype), aux, z
+    gate_idx, gate_vals, pos, keep, aux, z = top_k_gating_idx(
+        logits, k, capacity, norm_topk_prob)
+    xd, slot = _dispatch_gather(x, gate_idx, pos, keep, E, capacity)
+    out = expert_fn(xd)                                 # [E, C, d]
+    y = _combine_gather(out, slot, gate_vals, keep, x.dtype)
+    return y, aux, z
 
 
 def moe_forward_ep(x, router_w, expert_fn_local, axis_name, k=2,
@@ -119,9 +192,9 @@ def moe_forward_ep(x, router_w, expert_fn_local, axis_name, k=2,
         raise ValueError(f"num_experts {E} not divisible by ep degree {ep}")
     capacity = max(int(capacity_factor * k * T / E), 1)
     logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    dispatch, combine, aux, z = top_k_gating(logits, k, capacity,
-                                             norm_topk_prob)
-    xd = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), x)  # [E,C,d]
+    gate_idx, gate_vals, pos, keep, aux, z = top_k_gating_idx(
+        logits, k, capacity, norm_topk_prob)
+    xd, slot = _dispatch_gather(x, gate_idx, pos, keep, E, capacity)
     # send each expert-slice to its owner; receive every device's slots
     # for the local experts: [E, C, d] -> [E/ep, ep*C, d]
     xd = lax.all_to_all(xd, axis_name, split_axis=0, concat_axis=1,
@@ -129,8 +202,8 @@ def moe_forward_ep(x, router_w, expert_fn_local, axis_name, k=2,
     out = expert_fn_local(xd)                           # [E/ep, ep*C, d]
     out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
                          tiled=True)                    # [E, C, d]
-    y = jnp.einsum("tec,ecd->td", combine.astype(out.dtype), out)
+    y = _combine_gather(out, slot, gate_vals, keep, x.dtype)
     # aux losses are per-device estimates; average over the ep group
     aux = lax.pmean(aux, axis_name)
     z = lax.pmean(z, axis_name)
-    return y.astype(x.dtype), aux, z
+    return y, aux, z

@@ -1,0 +1,163 @@
+"""16-virtual-device hybrid-parallelism worker (SURVEY.md §2.3 hybrid
+row): run by ``test_hybrid16.py`` in a fresh subprocess so the device
+count can exceed the suite's 8-device mesh.
+
+Families (argv[1]):
+  4d — dp2 x sharding2 x mp2 x pp2, NON-degenerate data parallelism,
+       loss parity vs the single-device eager oracle under the compiled
+       scan schedules (FThenB / interleaved-V2). This is the
+       interaction an 8-device mesh cannot express with mp>1: the dp
+       gradient MEAN composed with microbatch accumulation.
+  5d — pp2 x mp2 x sep2 x sharding2: ring context parallelism crossing
+       pipeline-stage boundaries WITH ZeRO-sharded optimizer state and
+       a live batch-sharding axis, under both compiled scan schedules.
+
+The explicit 1F1B/ZB-H1 tick engines are NOT in the 16-device families:
+this jaxlib's XLA:CPU hard-codes a 40s collective-rendezvous
+kill-switch (the newer warn_stuck/terminate_timeout debug flags are
+not registered), and 16 single-core-time-sliced device threads cannot
+reliably clear it through the tick machine's per-tick permute pairs.
+The dp-mean x microbatch-accumulation interaction under 1F1B/ZB-H1 is
+instead certified on the suite's 8-device mesh at dp2 x sharding2 x
+pp2 (``test_pipeline_parallel.py::test_hybrid_dp2_explicit_schedules``
+— exact parity), where the same engines run comfortably.
+"""
+
+import os
+import re
+import sys
+
+N_DEV = 16
+
+flags = os.environ.get("XLA_FLAGS", "")
+flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags)
+os.environ["XLA_FLAGS"] = (
+    flags + f" --xla_force_host_platform_device_count={N_DEV}"
+    # 16 device threads time-slice this box's single core: XLA:CPU's
+    # default 40s collective-rendezvous kill-switch fires spuriously
+    " --xla_cpu_collective_timeout_seconds=1200").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_disable_most_optimizations", True)
+# Serialize program dispatch: with 16 virtual devices on few cores,
+# XLA:CPU's async dispatch can interleave two in-flight programs'
+# collectives across the shared thread pool — half the devices enter
+# program A's ppermute while the rest sit in program B's, and the 40s
+# rendezvous kill-switch aborts the process. One program at a time
+# cannot deadlock.
+jax.config.update("jax_cpu_enable_async_dispatch", False)
+
+import numpy as np
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                               LlamaForCausalLMPipe)
+
+
+def _cfg(par, sep=False):
+    return LlamaConfig(vocab_size=256, hidden_size=64, num_hidden_layers=4,
+                       num_attention_heads=4, num_key_value_heads=2,
+                       intermediate_size=128, max_position_embeddings=32,
+                       rope_theta=10000.0, tensor_parallel=par,
+                       sequence_parallel=par,
+                       sep_parallel="ring" if (par and sep) else None)
+
+
+def _ref_losses(cfg, ids_np, steps=2):
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+    ids = paddle.to_tensor(ids_np)
+    out = []
+    for _ in range(steps):
+        _, loss = model(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        out.append(float(loss.item()))
+    return out
+
+
+def _reset():
+    fleet.fleet._hcg = None
+    fleet.fleet._topology = None
+    fleet.fleet._is_initialized = False
+
+
+def _run_hybrid(hybrid, schedule, ids_np, sep=False, num_virtual=None,
+                steps=2):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = hybrid
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": schedule}
+    if num_virtual is not None:
+        strategy.pipeline_configs["num_virtual_pipeline_stages"] = \
+            num_virtual
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        hcg = fleet.get_hybrid_communicate_group()
+        paddle.seed(0)
+        model = LlamaForCausalLMPipe(_cfg(True, sep=sep))
+        engine = fleet.fleet.distributed_model(model)
+        opt = fleet.fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(1e-3, parameters=model.parameters()))
+        batch_spec = PartitionSpec(("data", "sharding"),
+                                   "sep" if sep else None)
+        ids = jax.device_put(jnp.asarray(ids_np),
+                             NamedSharding(hcg.global_mesh, batch_spec))
+        ids_p = paddle.Tensor(ids)
+        return [float(engine.train_batch((ids_p, ids_p), opt).item())
+                for _ in range(steps)]
+    finally:
+        _reset()
+
+
+def family_4d():
+    """dp2 x sharding2 x mp2 x pp2 — dp is LIVE (the 8-device mesh forces
+    dp=1 whenever mp>1), so the dp gradient mean is exercised against
+    microbatch accumulation with every axis >1."""
+    hybrid = {"dp_degree": 2, "mp_degree": 2, "pp_degree": 2,
+              "sharding_degree": 2, "sep_degree": 1, "ep_degree": 1}
+    # batch divisible by dp*sharding=4 and accumulate_steps=2
+    ids_np = np.random.RandomState(0).randint(
+        0, 256, (8, 16)).astype(np.int64)
+    ref = _ref_losses(_cfg(False), ids_np)
+    for schedule, nv in (("FThenB", None), ("interleaved", 2)):
+        losses = _run_hybrid(hybrid, schedule, ids_np, num_virtual=nv)
+        np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-5,
+                                   err_msg=f"4d {schedule}")
+        print(f"4d dp2xsharding2xmp2xpp2 {schedule}: "
+              f"losses={losses[0]:.4f},{losses[1]:.4f} == ref OK",
+              flush=True)
+
+
+def family_5d():
+    """pp2 x mp2 x sep2 x sharding2 — ring-CP activations cross stage
+    boundaries while optimizer state is ZeRO-sharded and the batch is
+    sharded over a live axis."""
+    hybrid = {"dp_degree": 1, "mp_degree": 2, "pp_degree": 2,
+              "sharding_degree": 2, "sep_degree": 2, "ep_degree": 1}
+    ids_np = np.random.RandomState(0).randint(
+        0, 256, (4, 32)).astype(np.int64)
+    ref = _ref_losses(_cfg(False), ids_np)
+    for schedule, nv in (("FThenB", None), ("interleaved", 2)):
+        losses = _run_hybrid(hybrid, schedule, ids_np, sep=True,
+                             num_virtual=nv)
+        np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-5,
+                                   err_msg=f"5d {schedule}")
+        print(f"5d pp2xmp2xsep2xsharding2 {schedule}: "
+              f"losses={losses[0]:.4f},{losses[1]:.4f} == ref OK",
+              flush=True)
+
+
+if __name__ == "__main__":
+    assert jax.device_count() >= N_DEV, jax.device_count()
+    fam = sys.argv[1] if len(sys.argv) > 1 else "4d"
+    {"4d": family_4d, "5d": family_5d}[fam]()
+    print(f"hybrid16 {fam} OK", flush=True)

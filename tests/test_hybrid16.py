@@ -1,0 +1,31 @@
+"""Hybrid parallelism beyond the suite's 8-device mesh (SURVEY.md §2.3
+hybrid row, §3.4): a fresh subprocess pins a 16-virtual-device CPU mesh
+and runs loss-parity families the 8-device suite cannot express —
+non-degenerate dp composed with pp (4d) and ring-CP composed with pp,
+sharding, and TP at once (5d). See ``hybrid16_worker.py``."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_REPO, "tests", "hybrid16_worker.py")
+
+
+@pytest.mark.parametrize("family", ["4d", "5d"])
+def test_hybrid16(family):
+    env = dict(os.environ)
+    # the worker lives in tests/, so the repo root is not on its
+    # sys.path automatically
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, _WORKER, family],
+        env=env, cwd=_REPO, capture_output=True, text=True, timeout=1500)
+    assert proc.returncode == 0, (
+        f"hybrid16 {family} rc={proc.returncode}\n"
+        f"stdout={proc.stdout}\nstderr={proc.stderr[-4000:]}")
+    assert f"hybrid16 {family} OK" in proc.stdout

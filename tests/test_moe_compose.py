@@ -1,0 +1,154 @@
+"""EP composed with other parallelism axes (SURVEY.md §2.3 EP row):
+real MoE deployments run expert parallelism TOGETHER with tensor and
+pipeline parallelism — all-to-all dispatch under a 'model'-sharded
+hidden dim, and (for pp) inside the compiled pipeline program. Each
+test's oracle is the dense single-device run with identical seeds; EP
+applies the capacity quota per device rather than globally, so the loss
+tolerance mirrors ``test_qwen2.py::test_qwen2_moe_expert_parallel``."""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import fleet
+from paddle_tpu.models import (DeepseekV2Config, DeepseekV2ForCausalLM,
+                               Qwen2MoeConfig, Qwen2MoeForCausalLM)
+
+
+def _reset():
+    fleet.fleet._hcg = None
+    fleet.fleet._topology = None
+    fleet.fleet._is_initialized = False
+
+
+def _fleet(ep, mp=1, pp=1, sharding=1):
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": -1, "mp_degree": mp,
+                               "pp_degree": pp,
+                               "sharding_degree": sharding,
+                               "sep_degree": 1, "ep_degree": ep}
+    fleet.init(is_collective=True, strategy=strategy)
+    return fleet.get_hybrid_communicate_group()
+
+
+def _train_losses(model_cls, cfg, ids, steps=3):
+    paddle.seed(0)
+    model = model_cls(cfg)
+    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
+
+    @paddle.jit.to_static
+    def step(t):
+        _, l = model(t, labels=t)
+        l.backward()
+        opt.step()
+        opt.clear_grad()
+        return l
+
+    return [float(step(ids).item()) for _ in range(steps)]
+
+
+def test_qwen2_moe_ep2_mp2():
+    """ep2 x mp2 (+ dp fill): the expert all-to-all composes with
+    'model'-sharded attention/shared-expert linears in one compiled
+    step; multi-step loss stays within the per-rank-capacity envelope of
+    the dense oracle and decreases."""
+    cfg_dense = Qwen2MoeConfig.tiny()
+    ids_np = np.random.RandomState(0).randint(
+        0, cfg_dense.vocab_size, (4, 16)).astype(np.int64)
+    ids = paddle.to_tensor(ids_np)
+    ref = _train_losses(Qwen2MoeForCausalLM, cfg_dense, ids)
+
+    _fleet(ep=2, mp=2)
+    try:
+        import dataclasses
+        cfg = dataclasses.replace(cfg_dense, tensor_parallel=True)
+        losses = _train_losses(Qwen2MoeForCausalLM, cfg, ids)
+        assert all(np.isfinite(l) for l in losses)
+        np.testing.assert_allclose(losses, ref, rtol=0, atol=5e-3)
+        assert losses[-1] < losses[0]
+    finally:
+        _reset()
+
+
+def test_qwen2_moe_ep2_mp2_pp2():
+    """ep2 x mp2 x pp2: the expert all-to-all dispatch runs INSIDE the
+    compiled pipeline program (the pipeline's shard_map binds 'expert'
+    alongside 'pipe'; MoELayer slices its token/expert-bank shards by
+    axis index and reassembles with a masked psum). Oracle: the same
+    Pipe model run by the sequential eager microbatch loop — identical
+    weights, microbatches, and loss; capacity_factor is generous so no
+    tokens drop and parity is tight."""
+    import dataclasses
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
+    from paddle_tpu.models import Qwen2MoeForCausalLMPipe
+
+    def cfg(par):
+        return dataclasses.replace(
+            Qwen2MoeConfig.tiny(), num_hidden_layers=4,
+            capacity_factor=4.0, tensor_parallel=par,
+            router_aux_loss_coef=0.0)
+
+    ids_np = np.random.RandomState(0).randint(
+        0, 256, (4, 16)).astype(np.int64)
+    steps = 2
+
+    paddle.seed(0)
+    ref_model = Qwen2MoeForCausalLMPipe(cfg(False))
+    ref_engine = PipelineParallel(ref_model, None, accumulate_steps=2)
+    ref_opt = paddle.optimizer.AdamW(
+        1e-3, parameters=ref_model.parameters())
+    ids_t = paddle.to_tensor(ids_np)
+    ref = [float(ref_engine.train_batch((ids_t, ids_t), ref_opt).item())
+           for _ in range(steps)]
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 1, "ep_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": "FThenB"}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        hcg = fleet.get_hybrid_communicate_group()
+        paddle.seed(0)
+        model = Qwen2MoeForCausalLMPipe(cfg(True))
+        engine = fleet.fleet.distributed_model(model)
+        assert isinstance(engine, PipelineParallel)
+        opt = fleet.fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(1e-3, parameters=model.parameters()))
+        ids = jax.device_put(
+            jnp.asarray(ids_np),
+            NamedSharding(hcg.global_mesh,
+                          PartitionSpec(("data", "sharding"))))
+        ids_p = paddle.Tensor(ids)
+        losses = [float(engine.train_batch((ids_p, ids_p), opt).item())
+                  for _ in range(steps)]
+        np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-4)
+    finally:
+        _reset()
+
+
+def test_deepseek_ep2_mp2():
+    """DeepSeek-V2 fine-grained MoE under ep2 x mp2: MLA attention
+    TP-sharded while routed+shared experts dispatch over 'expert'."""
+    cfg_dense = DeepseekV2Config.tiny()
+    ids_np = np.random.RandomState(0).randint(
+        0, cfg_dense.vocab_size, (4, 16)).astype(np.int64)
+    ids = paddle.to_tensor(ids_np)
+    ref = _train_losses(DeepseekV2ForCausalLM, cfg_dense, ids)
+
+    _fleet(ep=2, mp=2)
+    try:
+        import dataclasses
+        cfg = dataclasses.replace(cfg_dense, tensor_parallel=True)
+        losses = _train_losses(DeepseekV2ForCausalLM, cfg, ids)
+        assert all(np.isfinite(l) for l in losses)
+        np.testing.assert_allclose(losses, ref, rtol=0, atol=5e-3)
+        assert losses[-1] < losses[0]
+    finally:
+        _reset()

@@ -314,6 +314,24 @@ def test_interleaved_needs_virtual_stages():
         fleet.fleet._is_initialized = False
 
 
+
+def _llama_ref_losses(make_cfg, ids_np, steps=2, lr=1e-3):
+    """Single-device eager oracle (SURVEY.md §4): seed-0 model, AdamW,
+    backward/step/clear per step — shared by every hybrid parity test."""
+    from paddle_tpu.models import LlamaForCausalLM
+    paddle.seed(0)
+    model = LlamaForCausalLM(make_cfg())
+    opt = paddle.optimizer.AdamW(lr, parameters=model.parameters())
+    ids = paddle.to_tensor(ids_np)
+    out = []
+    for _ in range(steps):
+        _, loss = model(ids, labels=ids)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        out.append(float(loss.item()))
+    return out
+
 # --------------------------------------------------------------------------
 # 4D hybrid: pipeline COMPOSED with TP + ZeRO sharding + DP (BASELINE
 # config 4's workload shape) — the pp axis no longer runs in isolation
@@ -344,18 +362,7 @@ def test_hybrid_4d_pipeline_llama_parity(schedule):
         0, 256, (4, 16)).astype(np.int64)
     steps = 2
 
-    paddle.seed(0)
-    ref_model = LlamaForCausalLM(cfg(False))
-    ref_opt = paddle.optimizer.AdamW(1e-3,
-                                     parameters=ref_model.parameters())
-    ids_t = paddle.to_tensor(ids_np)
-    ref = []
-    for _ in range(steps):
-        _, loss = ref_model(ids_t, labels=ids_t)
-        loss.backward()
-        ref_opt.step()
-        ref_opt.clear_grad()
-        ref.append(float(loss.item()))
+    ref = _llama_ref_losses(lambda: cfg(False), ids_np, steps)
 
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
@@ -397,6 +404,58 @@ def test_hybrid_4d_pipeline_llama_parity(schedule):
         fleet.fleet._is_initialized = False
 
 
+@pytest.mark.parametrize("schedule", ["1F1B", "ZB-H1"])
+def test_hybrid_dp2_explicit_schedules(schedule):
+    """NON-degenerate data parallelism under the explicit tick engines:
+    dp2 x sharding2 x pp2 over 8 devices — the dp gradient MEAN composed
+    with microbatch accumulation is exactly the interaction dp=1 runs
+    cannot catch (the 16-device worker covers dp2 with mp2 under the
+    scan schedules; this certifies the explicit engines)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaForCausalLMPipe)
+
+    def cfg():
+        return LlamaConfig(vocab_size=256, hidden_size=64,
+                           num_hidden_layers=4, num_attention_heads=4,
+                           num_key_value_heads=2, intermediate_size=128,
+                           max_position_embeddings=32, rope_theta=10000.0,
+                           tensor_parallel=False)
+
+    ids_np = np.random.RandomState(0).randint(
+        0, 256, (8, 16)).astype(np.int64)
+    steps = 2
+
+    ref = _llama_ref_losses(cfg, ids_np, steps)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 2,
+                               "sep_degree": 1, "ep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": schedule}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        hcg = fleet.get_hybrid_communicate_group()
+        paddle.seed(0)
+        model = LlamaForCausalLMPipe(cfg())
+        engine = fleet.fleet.distributed_model(model)
+        opt = fleet.fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(1e-3, parameters=model.parameters()))
+        ids = jax.device_put(
+            jnp.asarray(ids_np),
+            NamedSharding(hcg.global_mesh,
+                          PartitionSpec(("data", "sharding"))))
+        ids_p = paddle.Tensor(ids)
+        losses = [float(engine.train_batch((ids_p, ids_p), opt).item())
+                  for _ in range(steps)]
+        np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-5)
+    finally:
+        fleet.fleet._hcg = None
+        fleet.fleet._topology = None
+        fleet.fleet._is_initialized = False
+
+
 # --------------------------------------------------------------------------
 # 5D: pipeline COMPOSED with ring context parallelism (+ TP/SP) — the sep
 # axis's K/V ring runs INSIDE the compiled pipeline program, so ring-CP
@@ -428,18 +487,7 @@ def test_hybrid_5d_pipeline_sep_llama_parity(schedule):
         0, 256, (4, 32)).astype(np.int64)
     steps = 2
 
-    paddle.seed(0)
-    ref_model = LlamaForCausalLM(cfg(False))
-    ref_opt = paddle.optimizer.AdamW(1e-3,
-                                     parameters=ref_model.parameters())
-    ids_t = paddle.to_tensor(ids_np)
-    ref = []
-    for _ in range(steps):
-        _, loss = ref_model(ids_t, labels=ids_t)
-        loss.backward()
-        ref_opt.step()
-        ref_opt.clear_grad()
-        ref.append(float(loss.item()))
+    ref = _llama_ref_losses(lambda: cfg(False), ids_np, steps)
 
     strategy = fleet.DistributedStrategy()
     strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
