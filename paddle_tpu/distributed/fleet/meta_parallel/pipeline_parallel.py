@@ -268,20 +268,38 @@ class PipelineParallel:
                     for i in range(n_leaves))
 
             sep = self._sep_axes()
-            extra = sep + self._expert_axes()
+            expert = self._expert_axes()
+            extra = sep + expert
             x_spec = None
+            param_specs = None
+            from jax.sharding import PartitionSpec as P
             if sep:
-                from jax.sharding import PartitionSpec as P
                 # h_micro is [M, b//M, S, H] — sequence dim 2 rides the
                 # context axis through the manual region (activations
                 # stay REPLICATED over 'expert'; MoELayer slices its
                 # token shard internally)
                 x_spec = P(None, None, sep[0])
+            if expert:
+                # keep expert-weight banks SHARDED over 'expert' through
+                # the region (template leaves tagged by MoELayer) —
+                # otherwise the boundary all-gathers every bank and
+                # per-device weight memory scales with E instead of E/ep
+                pipe_ax = self._hcg.pp_axis_name
+
+                def leaf_spec(p):
+                    shard = getattr(p, "_ep_shard_dim", None)
+                    base = (pipe_ax,) if V == 1 else (None, pipe_ax)
+                    if shard == 0:
+                        return P(*base, expert[0])
+                    return P(*base)
+
+                param_specs = tuple(leaf_spec(p) for p in template_params)
             return run_pipeline(_make_stage_fn(template, template_params),
                                 stacked, hm, mesh,
                                 axis_name=self._hcg.pp_axis_name,
                                 n_virtual=V, remat=remat,
-                                extra_axes=extra, x_spec=x_spec)
+                                extra_axes=extra, x_spec=x_spec,
+                                param_specs=param_specs)
 
         return apply(fn, h_micro, *flat, name="pipeline_body")
 

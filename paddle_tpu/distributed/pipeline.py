@@ -207,7 +207,8 @@ def _pipeline_interleaved(stage_fn, stage_params, x_micro, axis_name,
 
 
 def run_pipeline(stage_fn, stacked_params, x_micro, mesh, axis_name="pipe",
-                 n_virtual=1, remat=None, extra_axes=(), x_spec=None):
+                 n_virtual=1, remat=None, extra_axes=(), x_spec=None,
+                 param_specs=None):
     """Global-view entry: partial-manual shard_map over the pipe axis
     (other mesh axes stay under GSPMD). ``stacked_params`` leaves are
     [S, ...] arrays sharded on dim 0 over 'pipe' (n_virtual == 1), or
@@ -226,7 +227,12 @@ def run_pipeline(stage_fn, stacked_params, x_micro, mesh, axis_name="pipe",
     in_specs don't mention 'sep', so the transpose inserts the sum)."""
     from jax.sharding import PartitionSpec as P
 
-    if n_virtual == 1:
+    if param_specs is not None:
+        # caller-supplied per-leaf specs (same pytree structure as
+        # stacked_params) — e.g. keeping an expert-weight bank's expert
+        # dim sharded over its own mesh axis through the manual region
+        pspecs = param_specs
+    elif n_virtual == 1:
         pspecs = jax.tree.map(lambda _: P(axis_name), stacked_params)
     else:
         pspecs = jax.tree.map(lambda _: P(None, axis_name),

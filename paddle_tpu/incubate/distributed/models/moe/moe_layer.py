@@ -91,6 +91,10 @@ class MoELayer(Layer):
             for p in (self.w_gate, self.w_up, self.w_down):
                 p.set_data(jax.device_put(
                     p._data, NamedSharding(mesh, P(axis, None, None))))
+                # the pipeline engine reads this to keep the bank's
+                # expert dim sharded through its manual region (so
+                # per-device weight memory stays E/ep, not E)
+                p._ep_shard_dim = 0
 
     def _ep_axis_is_manual(self) -> bool:
         from .....distributed.communication import axis_in_traced_region
@@ -129,9 +133,14 @@ class MoELayer(Layer):
                 idx = lax.axis_index(axis)
                 tl, el = T // ep_n, E // ep_n
                 xf = lax.dynamic_slice_in_dim(flat, idx * tl, tl, 0)
-                wgl = lax.dynamic_slice_in_dim(wg, idx * el, el, 0)
-                wul = lax.dynamic_slice_in_dim(wu, idx * el, el, 0)
-                wdl = lax.dynamic_slice_in_dim(wd, idx * el, el, 0)
+                if wg.shape[0] == el:
+                    # the enclosing region kept the bank's expert dim
+                    # sharded (pipeline param_specs): already local
+                    wgl, wul, wdl = wg, wu, wd
+                else:
+                    wgl = lax.dynamic_slice_in_dim(wg, idx * el, el, 0)
+                    wul = lax.dynamic_slice_in_dim(wu, idx * el, el, 0)
+                    wdl = lax.dynamic_slice_in_dim(wd, idx * el, el, 0)
                 y, aux, z = moe_ops.moe_forward_ep(
                     xf, rw,
                     lambda t: moe_ops.moe_ffn_grouped(t, wgl, wul, wdl),

@@ -25,6 +25,17 @@ world:
   budget), frees their pages, and admits queued requests into the freed
   slots — mixed-length streams flow through without ever reshaping the
   compiled program.
+- Hot state (last token / context length / active mask / RNG key / page
+  pools) is DEVICE-RESIDENT between chunks: each chunk call uploads one
+  packed int32 array (tables+limits+eos) and fetches one packed int32
+  array (emitted tokens + first-token echoes + ctx/active mirrors), and
+  prefill never fetches — its first token lands in device state and is
+  echoed through the next chunk's packed fetch. Measured on the tunnel
+  (v5e): per-call overhead was ~0.5s with per-array
+  uploads + a blocking scalar fetch per admission; the chunk's marginal
+  per-token cost is identical to the fused dense decode (4.2 ms/step at
+  batch 8 on the 1B config), so round-trips, not kernels, set the
+  serving throughput.
 """
 
 from __future__ import annotations
@@ -102,16 +113,27 @@ class ContinuousBatchingEngine:
                     (kvh, self.num_pages, self.page_size, d), dtype)))
 
         self._free_pages = deque(range(1, self.num_pages))
-        # host-side slot state
+        # host-side slot bookkeeping (admission decisions, drain)
         B, MP = self.num_slots, self.pages_per_slot
         self.tables = np.zeros((B, MP), np.int32)
-        self.ctx = np.zeros((B,), np.int32)
-        self.active = np.zeros((B,), bool)
-        self.last_tok = np.zeros((B,), np.int32)
+        self.ctx = np.zeros((B,), np.int32)       # mirror (packed fetch)
+        self.active = np.zeros((B,), bool)        # mirror (packed fetch)
         self.limits = np.zeros((B,), np.int32)    # ctx budget per slot
         self.slot_eos = np.full((B,), -1, np.int32)  # per-request eos
         self.slot_req: list[ServedRequest | None] = [None] * B
         self.slot_pages: list[list] = [[] for _ in range(B)]
+        # pending first-token echo: slots admitted since the last chunk
+        # whose prefill token has not been appended host-side yet
+        self._pending_first = np.zeros((B,), bool)
+
+        # device-resident hot state (never round-trips between chunks);
+        # admission mutates it with tiny async .at[slot].set dispatches
+        self._dev_tok = jnp.zeros((B,), jnp.int32)
+        self._dev_ctx = jnp.zeros((B,), jnp.int32)
+        self._dev_act = jnp.zeros((B,), bool)
+        self._dev_tbl = jnp.zeros((B, MP), jnp.int32)
+        self._dev_lim = jnp.zeros((B,), jnp.int32)
+        self._dev_eos = jnp.full((B,), -1, jnp.int32)
 
         self.queue: deque[ServedRequest] = deque()
         self.completed: list[ServedRequest] = []
@@ -157,13 +179,38 @@ class ContinuousBatchingEngine:
 
     def run(self):
         """Drive until every queued request completes; returns them in
-        completion order."""
+        completion order.
+
+        Pipelined: when no admission decision depends on fresh host
+        state (nothing queued, or no slot free), the NEXT chunk is
+        dispatched before the previous chunk's packed output is fetched
+        — device state chains asynchronously, so the host round-trip
+        hides behind on-device decode. A slot that finished inside the
+        previous chunk is simply inactive in the speculative successor
+        (its device active flag is already False), so the overlap never
+        decodes garbage."""
         done = []
-        while self.has_work():
+        inflight = None
+        while True:
+            if inflight is not None:
+                nxt = None
+                if self.active.any() and not (
+                        self.queue
+                        and any(r is None for r in self.slot_req)):
+                    nxt = self._dispatch_chunk()
+                self._harvest_chunk(inflight)
+                done.extend(self._drain())
+                inflight = nxt
+                continue
             n_before = len(done)
-            done.extend(self.step())
-            if (len(done) == n_before and not self.active.any()
-                    and self.queue
+            self._admit()
+            done.extend(self._drain())
+            if self.active.any():
+                inflight = self._dispatch_chunk()
+                continue
+            if not self.queue:
+                break
+            if (len(done) == n_before
                     and all(r is None for r in self.slot_req)):
                 # nothing running, nothing finished, head request still
                 # unadmittable — spinning would never terminate
@@ -203,6 +250,8 @@ class ContinuousBatchingEngine:
             row = np.zeros((self.pages_per_slot,), np.int32)
             row[:len(pages)] = pages
             self.tables[slot] = row
+            self._dev_tbl = self._dev_tbl.at[slot].set(
+                jnp.asarray(row))
             self._prefill(slot, req, bucket)
 
     def _prefill_fn(self, bucket):
@@ -263,10 +312,15 @@ class ContinuousBatchingEngine:
         tok, key = res[0], res[1]
         self.pools = list(res[2:])
         self._key = key._data if isinstance(key, Tensor) else key
-        first = int(np.asarray(tok._data)[0])
-        req.tokens.append(first)
+        # NO host fetch here: the first token stays on device and is
+        # echoed back through the next chunk's packed fetch (a blocking
+        # scalar read per admission would serialize the whole admission
+        # wave on tunnel round-trips)
+        tok_dev = tok._data if isinstance(tok, Tensor) else tok
+        self._dev_tok = self._dev_tok.at[slot].set(tok_dev[0])
+        self._dev_ctx = self._dev_ctx.at[slot].set(tl)
         self.slot_req[slot] = req
-        self.last_tok[slot] = first
+        self._pending_first[slot] = True
         self.ctx[slot] = tl
         self.slot_eos[slot] = -1 if req.eos_token_id is None \
             else int(req.eos_token_id)
@@ -274,15 +328,15 @@ class ContinuousBatchingEngine:
         # outside the cache, so the n-th token lands when ctx hits
         # tl + n - 1 (not tl + n)
         self.limits[slot] = tl + req.max_new_tokens - 1
-        eos = req.eos_token_id
-        if (eos is not None and first == eos) or req.max_new_tokens <= 1:
-            # one-token request or instant eos: slot never becomes active
-            self.active[slot] = False
-            req.finished = True
-            req.finish_reason = "eos" if (eos is not None and first == eos) \
-                else "length"
-        else:
-            self.active[slot] = True
+        self._dev_lim = self._dev_lim.at[slot].set(int(self.limits[slot]))
+        self._dev_eos = self._dev_eos.at[slot].set(
+            int(self.slot_eos[slot]))
+        one_shot = req.max_new_tokens <= 1
+        # instant-eos (first token == stop token) is detected ON DEVICE
+        # at the next chunk's entry; only the structural one-token case
+        # is known host-side now
+        self._dev_act = self._dev_act.at[slot].set(not one_shot)
+        self.active[slot] = not one_shot
 
     # ---- chunked decode --------------------------------------------------
 
@@ -294,13 +348,19 @@ class ContinuousBatchingEngine:
         greedy = self.greedy
         temperature = self.temperature
         n_steps = self.decode_chunk
+        MP = self.pages_per_slot
 
-        def chunk(tok_t, ctx_t, act_t, lim_t, eos_t, tables_t, key_t,
+        def chunk(tok_t, ctx_t, act_t, tbl_t, lim_t, eos_t, key_t,
                   *pools):
             fwd = model.forward
 
-            def fn(tok, ctx, act, lim, eos_arr, tbl, key, *pool_leaves):
+            def fn(tok, ctx, act, tbl, lim, eos_arr, key, *pool_leaves):
                 b = tok.shape[0]
+                # a freshly admitted slot whose prefill token already hit
+                # its stop token must not decode (the host never saw the
+                # token — instant-eos is detected here, on device)
+                act = act & ((eos_arr < 0) | (tok != eos_arr))
+                init_tok = tok
 
                 def body(carry, _):
                     tok_c, ctx_c, act_c, key_c, leaves = carry
@@ -332,40 +392,77 @@ class ContinuousBatchingEngine:
                 carry, (toks, emitted) = jax.lax.scan(
                     body, carry0, jnp.arange(n_steps))
                 tok_f, ctx_f, act_f, key_f, leaves_f = carry
-                return (toks.T, emitted.T, tok_f, ctx_f, act_f, key_f) \
+                # ONE packed int32 fetch carries everything the host
+                # scheduler needs: emitted tokens, emission mask, the
+                # first-token echo for freshly admitted slots, and the
+                # ctx/active mirrors
+                packed_out = jnp.concatenate(
+                    [toks.T.astype(jnp.int32),
+                     emitted.T.astype(jnp.int32),
+                     init_tok[:, None].astype(jnp.int32),
+                     ctx_f[:, None].astype(jnp.int32),
+                     act_f[:, None].astype(jnp.int32)], axis=1)
+                return (packed_out, tok_f, ctx_f, act_f, key_f) \
                     + tuple(leaves_f)
 
-            return _apply_multi(
-                fn, [tok_t, ctx_t, act_t, lim_t, eos_t, tables_t, key_t]
-                + list(pools), n_out=6 + len(pools))
+            return _apply_multi(fn, [tok_t, ctx_t, act_t, tbl_t, lim_t,
+                                     eos_t, key_t]
+                                + list(pools), n_out=5 + len(pools))
 
         self._chunk_fn = to_static(chunk)
         return self._chunk_fn
 
-    def _decode_chunk(self):
+    def _dispatch_chunk(self):
+        """Launch one chunk program (async) and chain the device state.
+        Returns an in-flight record for :meth:`_harvest_chunk` — the
+        packed output is NOT fetched here, so a caller may overlap the
+        fetch with the next chunk's on-device compute."""
         fn = self._chunk_static()
-        res = fn(Tensor(jnp.asarray(self.last_tok)),
-                 Tensor(jnp.asarray(self.ctx)),
-                 Tensor(jnp.asarray(self.active)),
-                 Tensor(jnp.asarray(self.limits)),
-                 Tensor(jnp.asarray(self.slot_eos)),
-                 Tensor(jnp.asarray(self.tables)),
+        res = fn(Tensor(self._dev_tok), Tensor(self._dev_ctx),
+                 Tensor(self._dev_act), Tensor(self._dev_tbl),
+                 Tensor(self._dev_lim), Tensor(self._dev_eos),
                  Tensor(self._key), *self.pools)
-        toks, emitted, tok_f, ctx_f, act_f, key_f = res[:6]
-        self.pools = list(res[6:])
-        toks_np = np.asarray(toks._data)          # [B, n_steps]
-        emitted_np = np.asarray(emitted._data)    # [B, n_steps] bool
-        self.last_tok = np.asarray(tok_f._data).copy()
-        self.ctx = np.asarray(ctx_f._data).copy()
-        self.active = np.asarray(act_f._data).copy()
+        packed, tok_f, ctx_f, act_f, key_f = res[:5]
+        self.pools = list(res[5:])
+        self._dev_tok = tok_f._data
+        self._dev_ctx = ctx_f._data
+        self._dev_act = act_f._data
         self._key = key_f._data
+        # snapshot the slot->request mapping and the pending-first mask:
+        # by harvest time a drained slot may have been re-admitted to a
+        # NEW request whose tokens belong to a later chunk
+        rec = (packed, list(self.slot_req), self._pending_first.copy())
+        self._pending_first[:] = False
+        return rec
+
+    def _harvest_chunk(self, rec):
+        """Fetch one in-flight chunk's packed output and apply it."""
+        packed, snap_req, pending = rec
+        arr = np.asarray(packed._data)            # the ONE fetch
+        n = self.decode_chunk
+        toks_np = arr[:, :n]
+        emitted_np = arr[:, n:2 * n].astype(bool)
+        init_tok = arr[:, 2 * n]
+        ctx_m = arr[:, 2 * n + 1].astype(np.int32)
+        act_m = arr[:, 2 * n + 2].astype(bool)
         for slot in range(self.num_slots):
-            req = self.slot_req[slot]
-            if req is None or req.finished:
+            req = snap_req[slot]
+            if req is not self.slot_req[slot]:
+                continue      # slot re-admitted since this dispatch
+            self.ctx[slot] = ctx_m[slot]
+            self.active[slot] = act_m[slot]
+            if req is None:
                 continue
-            for j in range(toks_np.shape[1]):
+            if pending[slot]:
+                req.tokens.append(int(init_tok[slot]))
+            if req.finished:
+                continue
+            for j in range(n):
                 if emitted_np[slot, j]:
                     req.tokens.append(int(toks_np[slot, j]))
+
+    def _decode_chunk(self):
+        self._harvest_chunk(self._dispatch_chunk())
 
     # ---- completion ------------------------------------------------------
 
@@ -376,6 +473,13 @@ class ContinuousBatchingEngine:
             if req is None:
                 continue
             if not self.active[slot]:
+                if self._pending_first[slot]:
+                    # finished without any chunk running after admission
+                    # (one-token request at the tail of the workload):
+                    # the first token never got echoed — fetch it now
+                    req.tokens.append(int(np.asarray(
+                        self._dev_tok[slot])))
+                    self._pending_first[slot] = False
                 if not req.finished:
                     req.finished = True
                     eos = req.eos_token_id

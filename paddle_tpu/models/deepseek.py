@@ -102,12 +102,34 @@ def _lin(cfg, in_f, out_f, *, column, gather_output=False):
     return nn.Linear(in_f, out_f, weight_attr=attr, bias_attr=False)
 
 
+#: KV-chunk size of the blockwise MLA path; the exact einsum is kept
+#: below 2 chunks of sequence where its one-shot matmul is cheaper.
+_MLA_CHUNK = 256
+
+
 def _mla_core(q, k, v, causal_offset=None, valid_len=None):
-    """Einsum attention with fp32 softmax. q/k: [B, Sq, H, Dqk],
-    v: [B, Sk, H, Dv]; ``causal_offset`` is the absolute position of
-    q's first row (decode: pos; train: 0); ``valid_len`` masks the
-    padded cache tail (decode)."""
+    """MLA attention. q/k: [B, Sq, H, Dqk], v: [B, Sk, H, Dv] — the
+    q/k vs v head-dim asymmetry breaks the flash kernel's contract, so
+    this core is hand-rolled. ``causal_offset`` is the absolute
+    position of q's first row (decode: pos; train: 0); ``valid_len``
+    masks the padded cache tail (decode).
+
+    Two regimes: short sequences (and the cached decode step) use the
+    exact einsum with fp32 softmax; the TRAIN path at
+    Sq >= 2*_MLA_CHUNK switches to ``ops.ring_attention.
+    chunked_attention`` — blockwise online-softmax, O(Sq*chunk) score
+    memory instead of the S x S logits matrix, which is what makes
+    MLA's latent-cache memory win real at long context."""
     dqk = q.shape[-1]
+
+    if causal_offset is None and q.shape[1] >= 2 * _MLA_CHUNK:
+        from ..ops.ring_attention import chunked_attention
+
+        def fn_chunked(qq, kk, vv):
+            return chunked_attention(qq, kk, vv, causal=True,
+                                     chunk=_MLA_CHUNK)
+
+        return apply(fn_chunked, q, k, v, name="mla_attention_chunked")
 
     def fn(qq, kk, vv, *rest):
         import math

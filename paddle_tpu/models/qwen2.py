@@ -329,23 +329,13 @@ class Qwen2MoeDecoderLayerPipe(Qwen2DecoderLayer):
         self.config = cfg
 
 
-class Qwen2MoePretrainingCriterion(nn.Layer):
-    """Shifted next-token CE — the PLAIN language-model loss. The router
-    aux loss is an eager per-layer attribute in the monolithic model and
-    cannot cross the compiled pipeline boundary; pipelined MoE training
-    therefore runs with aux folded out (router_aux_loss_coef=0 parity —
-    load balance still trains through the dispatch gradient)."""
-
-    def __init__(self, cfg):
-        super().__init__()
-        self.vocab_size = cfg.vocab_size
-
-    def forward(self, logits, labels):
-        shift_logits = logits[:, :-1, :]
-        shift_labels = labels[:, 1:]
-        return F.cross_entropy(
-            M.reshape(shift_logits, [-1, self.vocab_size]),
-            M.reshape(shift_labels, [-1]))
+# Shifted next-token CE — the PLAIN language-model loss (the llama
+# criterion is duck-typed on vocab_size only). The router aux loss is an
+# eager per-layer attribute in the monolithic model and cannot cross the
+# compiled pipeline boundary; pipelined MoE training therefore runs with
+# aux folded out (router_aux_loss_coef=0 parity — load balance still
+# trains through the dispatch gradient).
+from .llama import LlamaPretrainingCriterion as Qwen2MoePretrainingCriterion
 
 
 def Qwen2MoeForCausalLMPipe(config, **pipeline_kwargs):

@@ -39,6 +39,7 @@ from jax import lax
 __all__ = [
     "ring_attention",
     "ulysses_attention",
+    "chunked_attention",
     "zigzag_reorder",
     "zigzag_restore",
     "zigzag_positions",
@@ -56,22 +57,29 @@ def _repeat_kv(q, k, v):
     return k, v
 
 
-def _chunk_partials(qf, k_c, v_c, q_pos, k_pos, scale, causal):
+def _chunk_partials(qf, k_c, v_c, q_pos, k_pos, scale, causal,
+                    k_valid=None):
     """Partial attention of local queries against one K/V chunk.
 
     qf: [B, Sq, H, D] fp32; k_c/v_c: [B, Sk, H, D] fp32;
-    q_pos: [Sq] int32 global positions; k_pos: [Sk].
+    q_pos: [Sq] int32 global positions; k_pos: [Sk];
+    k_valid: optional [Sk] bool — False marks padded key columns.
     Returns (m, l, acc): row max [B,H,Sq], row sumexp [B,H,Sq],
     unnormalized accumulator [B,H,Sq,D].
     """
     logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k_c,
                         preferred_element_type=jnp.float32) * scale
+    mask = None
     if causal:
         mask = (q_pos[:, None] >= k_pos[None, :])[None, None]
+    if k_valid is not None:
+        vm = k_valid[None, None, None, :]
+        mask = vm if mask is None else (mask & vm)
+    if mask is not None:
         logits = jnp.where(mask, logits, _NEG_INF)
     m = jnp.max(logits, axis=-1)
     p = jnp.exp(logits - m[..., None])
-    if causal:
+    if mask is not None:
         # fully-masked rows have m == _NEG_INF and p == 1 everywhere;
         # zero them so they contribute nothing to l/acc
         p = jnp.where(mask, p, 0.0)
@@ -157,6 +165,61 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
         k_pos0,
     )
     (acc, m, l, *_), _ = lax.scan(step, carry0, None, length=n)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(orig_dtype)
+
+
+def chunked_attention(q, k, v, causal=True, scale=None, chunk=256):
+    """Single-device blockwise attention with ONLINE softmax — exact
+    attention in O(Sq·chunk) score memory instead of the O(Sq·Sk)
+    matrix an einsum+softmax materializes.
+
+    Built for MLA-shaped heads (DeepSeek-V2): q/k share a head dim that
+    differs from v's (`models/deepseek.py` — the q/k vs v asymmetry that
+    breaks the flash kernel's equal-head-dim contract). q/k:
+    [B, Sq, H, Dqk] / [B, Sk, H, Dqk]; v: [B, Sk, H, Dv]; GQA kv heads
+    are repeated. The KV chunk loop is the same online-merge math as
+    the ppermute ring above (shared ``_chunk_partials``) — a "ring" of
+    local chunks instead of devices, run as one ``lax.scan`` so jax
+    reverse-mode gives the blockwise backward automatically."""
+    orig_dtype = q.dtype
+    b, sq, h, dqk = q.shape
+    k, v = _repeat_kv(q, k, v)
+    sk = k.shape[1]
+    dv = v.shape[-1]
+    s = scale if scale is not None else 1.0 / math.sqrt(dqk)
+    c = min(int(chunk), sk)
+    n = -(-sk // c)
+    pad = n * c - sk
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # [n, B, c, H, D] chunk-major so the scan consumes leading dim
+    kc = jnp.moveaxis(k.reshape(b, n, c, h, dqk), 1, 0)
+    vc = jnp.moveaxis(v.reshape(b, n, c, h, dv), 1, 0)
+    q_pos = jnp.arange(sq, dtype=jnp.int32)
+    qf = q.astype(jnp.float32)
+
+    def step(carry, inp):
+        acc, m, l = carry
+        k_c, v_c, j = inp
+        k_pos = j * c + jnp.arange(c, dtype=jnp.int32)
+        # padded tail columns (k_pos >= sk) are masked in both modes
+        m_j, l_j, acc_j = _chunk_partials(qf, k_c, v_c, q_pos, k_pos, s,
+                                          causal=causal,
+                                          k_valid=k_pos < sk)
+        m_new = jnp.maximum(m, m_j)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_j - m_new)
+        acc = acc * alpha[..., None] + acc_j * beta[..., None]
+        l = l * alpha + l_j * beta
+        return (acc, m_new, l), None
+
+    carry0 = (jnp.zeros((b, h, sq, dv), jnp.float32),
+              jnp.full((b, h, sq), _NEG_INF, jnp.float32),
+              jnp.zeros((b, h, sq), jnp.float32))
+    (acc, m, l), _ = lax.scan(
+        step, carry0, (kc, vc, jnp.arange(n, dtype=jnp.int32)))
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.swapaxes(out, 1, 2).astype(orig_dtype)
 
