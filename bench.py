@@ -301,8 +301,11 @@ def _moe_train_bench(on_tpu, dev):
             rope_theta=10000.0, num_experts=16, num_experts_per_tok=2,
             moe_intermediate_size=1408,
             shared_expert_intermediate_size=2816,
-            capacity_factor=2.0, scan_layers=False)
-        batch, seq = 8, 2048
+            capacity_factor=2.0, scan_layers=False,
+            use_recompute=True)
+        # batch 8 OOMs 16GB: the un-rematerialized expert intermediates
+        # ([E, C, moe_inter] per layer) dominate activation memory
+        batch, seq = 4, 2048
         steps, warmup = 8, 3
     else:
         cfg = dataclasses.replace(Qwen2MoeConfig.tiny(), scan_layers=False)
@@ -424,31 +427,39 @@ def main():
     dev = jax.devices()[0]
     on_tpu = dev.platform.lower() in ("tpu", "axon")
 
+    import gc
     n_params, train_tok_s, mfu = _retry_transient(
         lambda: _train_bench(on_tpu, dev), "train bench")
+    gc.collect()
     try:
         decode_tok_s = _retry_transient(
             lambda: _decode_bench(on_tpu), "decode bench")
     except Exception as e:  # decode is secondary: never sink the headline
         print(f"# decode bench failed: {e!r}", file=sys.stderr)
         decode_tok_s = None
+    gc.collect()
     try:
         cb_tok_s = _retry_transient(lambda: _cb_bench(on_tpu), "cb bench")
     except Exception as e:
         print(f"# continuous-batching bench failed: {e!r}", file=sys.stderr)
         cb_tok_s = None
+    gc.collect()
     try:
         moe_params, moe_tok_s, moe_mfu = _retry_transient(
             lambda: _moe_train_bench(on_tpu, dev), "moe train bench")
     except Exception as e:
         print(f"# moe train bench failed: {e!r}", file=sys.stderr)
         moe_params = moe_tok_s = moe_mfu = None
+    # a failed section's exception traceback pins its model (frames hold
+    # locals) — without this collect, one OOM sinks every later section
+    gc.collect()
     try:
         moe_decode_tok_s = _retry_transient(
             lambda: _moe_decode_bench(on_tpu), "moe decode bench")
     except Exception as e:
         print(f"# moe decode bench failed: {e!r}", file=sys.stderr)
         moe_decode_tok_s = None
+    gc.collect()
 
     suffix = "" if on_tpu else "_cpu_smoke"
     record = {

@@ -459,6 +459,12 @@ def _is_obs_float_kind(kind, value):
 
 def _concretize(data, kind: str, cast):
     """Single funnel for Tensor scalar conversions (bool/int/float/item)."""
+    from .segment import SegValue as _SegValue
+    if isinstance(data, _SegValue):
+        # lazy-segment placeholder: a scalar read IS the graph break —
+        # flush the recorded segment (one compiled program), then hand
+        # Python the concrete value and keep going into the next segment
+        data = data.force()
     st = _concretize_state
     if st.mode == "replay":
         if st.cursor >= len(st.log):
@@ -561,7 +567,11 @@ class Tensor:
                  name: str = ""):
         if isinstance(data, Tensor):
             data = data._data
-        if not isinstance(data, jax.Array) and not isinstance(data, jax.core.Tracer):
+        from .segment import SegValue as _SegValue
+        if isinstance(data, _SegValue):
+            pass        # lazy-segment placeholder: keep as-is
+        elif not isinstance(data, jax.Array) and \
+                not isinstance(data, jax.core.Tracer):
             data = jnp.asarray(_coerce_host_data(data, dtype),
                                dtype=to_jax_dtype(dtype))
         elif dtype is not None and data.dtype != to_jax_dtype(dtype):
@@ -810,12 +820,20 @@ def apply(fn: Callable, *tensors, n_outputs: int = 1, name: str = "",
         _op_stat_hook(name, str(getattr(
             next((t._data for t in tensors if isinstance(t, Tensor)),
                  None), "dtype", "-")))
+    from . import segment as _segment
+    rec = _segment.current_recorder()
     tr = _track_state.current
     datas = []
     for t in tensors:
         if isinstance(t, Tensor):
             if tr is not None and t.persistable:
                 tr.record_read(t)
+            if rec is None and \
+                    isinstance(t._data, _segment.SegValue) and \
+                    t._data.concrete is not None:
+                # normalize a flushed placeholder back to its array the
+                # first time it is touched outside segment mode
+                t._data = t._data.concrete
             datas.append(t._data)
         else:
             if isinstance(t, ObservedFloat):
@@ -830,6 +848,10 @@ def apply(fn: Callable, *tensors, n_outputs: int = 1, name: str = "",
         and _grad_state.enabled
         and any(isinstance(t, Tensor) and not t._stop_gradient for t in tensors)
     )
+
+    if rec is not None:
+        return _apply_segment(rec, fn, tensors, datas, n_outputs, name,
+                              static_kwargs, needs_grad)
 
     if not needs_grad:
         out = fn(*datas, **static_kwargs)
@@ -861,11 +883,73 @@ def apply(fn: Callable, *tensors, n_outputs: int = 1, name: str = "",
     return outs
 
 
+def _apply_segment(rec, fn, tensors, datas, n_outputs, name,
+                   static_kwargs, needs_grad):
+    """apply() under segment mode: record the op instead of running it
+    (compile-around-break — see framework/segment.py). The GradNode's
+    vjp re-runs ``jax.vjp`` of the op inside a LATER segment, so the
+    backward pass is recorded-and-flushed compiled too (a
+    rematerializing tape with identical numerics)."""
+    from . import segment as _segment
+    opname = name or getattr(fn, "__name__", "op")
+    outs = rec.record_kw(fn, datas, static_kwargs, n_outputs, opname)
+    if not needs_grad:
+        if n_outputs == 1:
+            return _wrap_out(outs[0])
+        return tuple(_wrap_out(o) for o in outs)
+
+    diff_idx = [i for i, t in enumerate(tensors)
+                if isinstance(t, Tensor) and not t._stop_gradient]
+    diff_parents = [tensors[i] for i in diff_idx]
+
+    def lazy_vjp(cts):
+        ct_list = [cts] if n_outputs == 1 else list(cts)
+        n_ct = len(ct_list)
+
+        def grad_fn(*args):
+            cta = args[:n_ct]
+            full = list(args[n_ct:])
+
+            def pure(*diff_args):
+                f2 = list(full)
+                for i, a in zip(diff_idx, diff_args):
+                    f2[i] = a
+                return fn(*f2, **static_kwargs)
+
+            _, vjp = jax.vjp(pure, *(full[i] for i in diff_idx))
+            gr = tuple(vjp(cta[0] if n_outputs == 1 else tuple(cta)))
+            # the recorder's single-output contract is an unwrapped
+            # value, not a 1-tuple
+            return gr[0] if len(diff_idx) == 1 else gr
+
+        rec2 = _segment.current_recorder()
+        if rec2 is not None:
+            return rec2.record(grad_fn, ct_list + list(datas),
+                               n_outputs=len(diff_idx),
+                               name=opname + "_bwd")
+        # backward pulled outside segment mode: run on concrete values
+        conc = [a.force() if isinstance(a, _segment.SegValue) else a
+                for a in ct_list + list(datas)]
+        gr = grad_fn(*conc)
+        return (gr,) if len(diff_idx) == 1 else gr
+
+    node = GradNode(lazy_vjp, diff_parents, n_outputs, name=opname,
+                    out_avals=[(o.shape, o.dtype) for o in outs])
+    if n_outputs == 1:
+        return _wrap_out(outs[0], node, 0, stop_gradient=False)
+    return tuple(_wrap_out(o, node, i, stop_gradient=False)
+                 for i, o in enumerate(outs))
+
+
 # --------------------------------------------------------------------------
 # backward engine
 # --------------------------------------------------------------------------
 
 def _ones_like(data):
+    from .segment import SegValue as _SegValue
+    if isinstance(data, _SegValue):
+        rec = data.recorder
+        return rec.record(jnp.ones_like, [data], 1, "ones_like")[0]
     return jnp.ones_like(data)
 
 

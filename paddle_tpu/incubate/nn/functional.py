@@ -17,7 +17,8 @@ from ...nn import functional as F
 
 __all__ = ["fused_multi_head_attention", "fused_feedforward",
            "fused_linear", "fused_linear_activation", "fused_rms_norm",
-           "fused_layer_norm", "fused_dropout_add", "fused_rotary_position_embedding",
+           "fused_layer_norm", "fused_dropout_add", "fused_bias_act",
+           "fused_rotary_position_embedding",
            "fused_softmax_mask", "fused_softmax_mask_upper_triangle",
            "swiglu", "paged_attention"]
 
@@ -53,6 +54,38 @@ def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
     shape = x.shape[begin_norm_axis:] if begin_norm_axis >= 0 else \
         x.shape[begin_norm_axis:]
     return F.layer_norm(x, shape, norm_weight, norm_bias, epsilon)
+
+
+def fused_bias_act(x, bias=None, act_method="gelu",
+                   compute_dtype="default", quant_scale=-1.0,
+                   quant_round_type=0, quant_max_bound=0.0,
+                   quant_min_bound=0.0, name=None):
+    """act(x + bias) — the serving-path epilogue fusion (XLA fuses the
+    add into the activation; the quant_* arguments configure the
+    reference's int8 epilogue and are accepted for API parity, applied
+    only when quant_scale > 0)."""
+    x = as_tensor(x)
+    args = [x]
+    if bias is not None:
+        args.append(as_tensor(bias))
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
+           "silu": jax.nn.silu, "swish": jax.nn.silu,
+           "sigmoid": jax.nn.sigmoid,
+           "identity": lambda a: a}.get(act_method)
+    if act is None:
+        raise ValueError(f"unsupported act_method {act_method!r}")
+
+    def fn(a, *b):
+        h = a + b[0] if b else a
+        if compute_dtype not in ("default", None):
+            h = h.astype(compute_dtype)
+        out = act(h)
+        if quant_scale > 0:
+            out = jnp.clip(jnp.round(out * quant_scale),
+                           quant_min_bound, quant_max_bound)
+        return out.astype(a.dtype) if quant_scale <= 0 else out
+
+    return apply(fn, *args, name="fused_bias_act")
 
 
 def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",

@@ -200,7 +200,7 @@ class StaticFunction:
         spec = _tree_flatten((args, kwargs), leaves)
         sig = _signature_key(leaves)
         if sig in self._fallback_sigs:
-            return self._call_fn(*args, **kwargs)
+            return self._call_segmented(args, kwargs)
         entry = self._graphs.get(sig)
         if entry is None or entry.latest_key is None:
             return self._discover(sig, spec, leaves, args, kwargs)
@@ -232,6 +232,36 @@ class StaticFunction:
             self._fallback_sigs.add(sig)
             self._graphs.pop(sig, None)
             return self._call_fn(*args, **kwargs)
+
+    # ---- broken signatures: compile AROUND the break ---------------------
+
+    def _call_segmented(self, args, kwargs):
+        """SOT-style subgraph compilation for a signature with a genuine
+        graph break (SURVEY.md §3.5): the function runs ONCE, but op
+        dispatches are recorded lazily and flushed as jit-compiled
+        SEGMENTS at each point Python actually needs a value (the
+        ``float(loss)`` branch, a ``.numpy()`` read). Compiled prefix,
+        eager break, compiled suffix — instead of dropping the whole
+        signature to per-op eager dispatch. ``_segment_stats`` holds
+        (segments_executed, ops_recorded) from the last call (the
+        compile-around-break probe used by tests)."""
+        from ..framework import segment as _segment
+        rec = _segment.SegmentRecorder()
+        with _segment.segment_mode(rec):
+            out = self._call_fn(*args, **kwargs)
+        # normalize ESCAPED placeholders: the exit flush made every
+        # SegValue concrete, but tensors handed back to the caller must
+        # carry real arrays — jax 0.9 rejects __jax_array__ coercion, so
+        # a leftover SegValue would crash the first comparison op done
+        # on a returned tensor outside segment mode
+        leaves: list = []
+        _tree_flatten(out, leaves)
+        for t in leaves:
+            if isinstance(t, Tensor) and \
+                    isinstance(t._data, _segment.SegValue):
+                t._data = t._data.force()
+        self._segment_stats = (rec.flushes, rec.ops_recorded)
+        return out
 
     # ---- pass 1: eager run with state tracking --------------------------
 

@@ -176,5 +176,12 @@ __all__ = [
     "lu", "lu_unpack", "matmul", "matrix_exp", "matrix_norm", "matrix_power",
     "matrix_rank", "matrix_transpose", "multi_dot", "norm", "ormqr",
     "pca_lowrank", "pinv", "qr", "slogdet", "solve", "svd", "svd_lowrank",
-    "triangular_solve", "vector_norm", "vecdot", "cdist",
+    "svdvals", "triangular_solve", "vector_norm", "vecdot", "cdist",
 ]
+
+def svdvals(x, name=None):
+    """Singular values only (descending) — no U/V computation."""
+    def f(a):
+        return jnp.linalg.svd(a, compute_uv=False)
+
+    return apply(f, as_tensor(x), name="svdvals")

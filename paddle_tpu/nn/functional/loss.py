@@ -9,7 +9,8 @@ import jax.numpy as jnp
 from ...framework.core import Tensor, apply
 from ...ops.common import as_tensor
 
-__all__ = ["cross_entropy", "softmax_with_cross_entropy", "nll_loss",
+__all__ = ["cross_entropy", "huber_loss",
+           "softmax_with_cross_entropy", "nll_loss",
            "mse_loss", "l1_loss", "smooth_l1_loss", "binary_cross_entropy",
            "binary_cross_entropy_with_logits", "kl_div", "margin_ranking_loss",
            "hinge_embedding_loss", "cosine_embedding_loss", "ctc_loss",
@@ -150,6 +151,19 @@ def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
         return _reduce(val, reduction)
     return apply(fn, as_tensor(input), as_tensor(label),
                  name="smooth_l1_loss")
+
+
+def huber_loss(input, label, reduction="mean", delta=1.0, name=None):
+    """Huber loss (quadratic below ``delta``, linear above) — unlike
+    smooth_l1, the quadratic region is NOT rescaled by 1/delta."""
+    def fn(a, b):
+        d = a - b
+        ad = jnp.abs(d)
+        val = jnp.where(ad <= delta, 0.5 * d * d,
+                        delta * (ad - 0.5 * delta))
+        return _reduce(val, reduction)
+    return apply(fn, as_tensor(input), as_tensor(label),
+                 name="huber_loss")
 
 
 def binary_cross_entropy(input, label, weight=None, reduction="mean",

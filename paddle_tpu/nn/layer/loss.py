@@ -6,7 +6,7 @@ from .. import functional as F
 from .layers import Layer
 
 __all__ = ["CrossEntropyLoss", "MSELoss", "L1Loss", "NLLLoss", "BCELoss",
-           "BCEWithLogitsLoss", "KLDivLoss", "SmoothL1Loss",
+           "BCEWithLogitsLoss", "HuberLoss", "KLDivLoss", "SmoothL1Loss",
            "MarginRankingLoss", "HingeEmbeddingLoss", "CosineEmbeddingLoss",
            "TripletMarginLoss", "MultiLabelSoftMarginLoss", "CTCLoss",
            "PoissonNLLLoss", "GaussianNLLLoss", "SigmoidFocalLoss"]
@@ -94,6 +94,17 @@ class KLDivLoss(Layer):
 
     def forward(self, input, label):
         return F.kl_div(input, label, self.reduction, self.log_target)
+
+
+class HuberLoss(Layer):
+    def __init__(self, reduction="mean", delta=1.0, name=None):
+        super().__init__()
+        self.reduction = reduction
+        self.delta = delta
+
+    def forward(self, input, label):
+        return F.huber_loss(input, label, reduction=self.reduction,
+                            delta=self.delta)
 
 
 class SmoothL1Loss(Layer):

@@ -20,6 +20,19 @@ def _cmp(jfn, name):
     def op(x, y, name=None):
         xd = x._data if isinstance(x, Tensor) else x
         yd = y._data if isinstance(y, Tensor) else y
+        from ..framework.segment import current_recorder, SegValue
+        rec = current_recorder()
+        if isinstance(xd, SegValue) or isinstance(yd, SegValue):
+            if rec is not None:
+                # compile-around-break: record instead of calling jnp on
+                # a placeholder (jax rejects __jax_array__ coercion)
+                return Tensor(rec.record(jfn, [xd, yd], 1, name)[0])
+            # escaped placeholder outside segment mode (e.g. a param
+            # mutated by a segmented step): materialize first
+            if isinstance(xd, SegValue):
+                xd = xd.force()
+            if isinstance(yd, SegValue):
+                yd = yd.force()
         return Tensor(jfn(xd, yd))
     op.__name__ = name
     return op

@@ -11,7 +11,7 @@ from .common import as_tensor, unary, binary
 __all__ = [
     # binary
     "add", "subtract", "multiply", "divide", "floor_divide", "mod",
-    "remainder", "pow", "maximum", "minimum", "fmax", "fmin", "atan2",
+    "remainder", "pow", "float_power", "maximum", "minimum", "fmax", "fmin", "atan2",
     "logaddexp", "heaviside", "copysign", "nextafter", "ldexp", "hypot",
     "gcd", "lcm", "inner", "outer", "kron",
     # unary
@@ -55,6 +55,16 @@ nextafter = binary(jnp.nextafter, "nextafter")
 hypot = binary(jnp.hypot, "hypot")
 gcd = binary(jnp.gcd, "gcd")
 lcm = binary(jnp.lcm, "lcm")
+
+
+def float_power(x, y, name=None):
+    """x ** y computed in float64-free style: promote to the widest
+    float of the inputs (paddle float_power promotes to double; on TPU
+    we stay at f32 unless x64 is enabled)."""
+    def fn(a, b):
+        tgt = jnp.promote_types(jnp.result_type(a, b), jnp.float32)
+        return jnp.power(a.astype(tgt), jnp.asarray(b).astype(tgt))
+    return binary(fn, "float_power")(x, y)
 
 
 def pow(x, y, name=None):

@@ -6,11 +6,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..framework.core import Tensor, apply, to_jax_dtype
+from ..framework.core import (Tensor, apply, to_jax_dtype, tape_alias,
+                              tape_rebind)
 from .common import as_tensor
 
 __all__ = [
-    "argmax", "argmin", "argsort", "sort", "searchsorted", "topk", "where",
+    "argmax", "argmin", "argsort", "sort", "searchsorted", "topk", "where", "where_",
     "nonzero", "kthvalue", "mode", "index_sample", "masked_select", "bucketize",
 ]
 
@@ -103,6 +104,13 @@ def where(condition, x=None, y=None, name=None):
             yy = ts[i]
         return jnp.where(c, xx, yy)
     return apply(fn, condition, *args, name="where")
+
+
+def where_(condition, x, y=None, name=None):
+    """Inplace ``where``: writes the selection back into ``x`` (the
+    paddle inplace-API convention) and returns it. Tape-rebinding, not
+    set_data: gradients keep flowing through the in-place result."""
+    return tape_rebind(x, where(condition, tape_alias(x), y))
 
 
 def nonzero(x, as_tuple=False, name=None):

@@ -122,11 +122,19 @@ class Optimizer:
     # -- step --------------------------------------------------------------
 
     def _collect_params_grads(self):
+        from ..framework.segment import SegValue
         pgs = []
         for p in self._parameter_list:
             if not getattr(p, "trainable", True):
                 continue
-            pgs.append((p, p.grad))
+            g = p.grad
+            if g is not None and isinstance(g._data, SegValue):
+                # compile-around-break path: the backward tape was
+                # recorded lazily; materialize every pending grad in ONE
+                # flushed segment before the raw-jnp update math (which
+                # cannot consume placeholders)
+                g._data = g._data.force()
+            pgs.append((p, g))
         return pgs
 
     def _decay_grad(self, p, gd):

@@ -299,6 +299,43 @@ class _DataNorm(dynn.Layer):
         return out
 
 
+class _BilinearTP(dynn.Layer):
+    """Legacy fluid bilinear_tensor_product:
+    out[b, k] = x[b]^T W_k y[b] + bias_k."""
+
+    def __init__(self, dx, dy, size, param_attr=None, bias_attr=None):
+        super().__init__()
+        self.weight = self.create_parameter([size, dx, dy],
+                                            attr=param_attr)
+        self.bias = self.create_parameter([size], attr=bias_attr,
+                                          is_bias=True)
+
+    def forward(self, x, y):
+        from ..framework.core import apply as _apply
+        import jax.numpy as _jnp
+
+        def fn(xx, yy, ww, bb):
+            return _jnp.einsum("bi,kij,bj->bk", xx, ww, yy) + bb
+
+        return _apply(fn, x, y, self.weight, self.bias,
+                      name="bilinear_tensor_product")
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out[b, k] = x[b]^T W_k y[b] + bias_k (legacy fluid layer); the
+    per-call-site parameters live in the current Program's slot list
+    like every other static.nn layer."""
+    dx, dy = int(x.shape[-1]), int(y.shape[-1])
+    layer = _register(lambda: _BilinearTP(dx, dy, size, param_attr,
+                                          bias_attr))
+    out = layer(x, y)
+    if act is not None:
+        from ..nn import functional as _F
+        out = getattr(_F, act)(out)
+    return out
+
+
 def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
     """Returns the spectrally-normalized weight (σ-max estimated by power
     iteration; the u/v state persists on the Program slot layer)."""

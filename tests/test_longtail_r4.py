@@ -1,0 +1,125 @@
+"""Round-4 API long tail (SURVEY.md §2.2 row 1): each op tested against
+a NumPy/closed-form oracle per the OpTest strategy (§4)."""
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_huber_loss_oracle():
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 5).astype(np.float32) * 2
+    y = rng.randn(4, 5).astype(np.float32)
+    delta = 1.5
+    d = x - y
+    ad = np.abs(d)
+    ref = np.where(ad <= delta, 0.5 * d * d, delta * (ad - 0.5 * delta))
+    out = paddle.nn.functional.huber_loss(
+        paddle.to_tensor(x), paddle.to_tensor(y), reduction="none",
+        delta=delta)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-6)
+    m = paddle.nn.HuberLoss(delta=delta)
+    out_m = m(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(float(out_m.item()), ref.mean(), rtol=1e-6)
+
+
+def test_svdvals_oracle():
+    rng = np.random.RandomState(1)
+    a = rng.randn(5, 3).astype(np.float32)
+    out = paddle.linalg.svdvals(paddle.to_tensor(a))
+    ref = np.linalg.svd(a, compute_uv=False)
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5,
+                               atol=1e-5)
+
+
+def test_float_power_oracle():
+    rng = np.random.RandomState(2)
+    x = (rng.rand(6) * 3 + 0.5).astype(np.float32)
+    y = rng.randn(6).astype(np.float32)
+    out = paddle.float_power(paddle.to_tensor(x), paddle.to_tensor(y))
+    np.testing.assert_allclose(np.asarray(out._data),
+                               np.power(x, y), rtol=1e-5)
+
+
+def test_where_inplace():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+    y = paddle.to_tensor(np.array([-1.0, -2.0, -3.0], np.float32))
+    cond = paddle.to_tensor(np.array([True, False, True]))
+    r = paddle.where_(cond, x, y)
+    assert r is x
+    np.testing.assert_allclose(np.asarray(x._data), [1.0, -2.0, 3.0])
+
+
+def test_fused_bias_act_oracle():
+    from scipy.special import erf  # noqa: F401  (gelu oracle below)
+    rng = np.random.RandomState(3)
+    x = rng.randn(4, 8).astype(np.float32)
+    b = rng.randn(8).astype(np.float32)
+    out = paddle.incubate.nn.functional.fused_bias_act(
+        paddle.to_tensor(x), paddle.to_tensor(b), act_method="relu")
+    np.testing.assert_allclose(np.asarray(out._data),
+                               np.maximum(x + b, 0.0), rtol=1e-6)
+    out2 = paddle.incubate.nn.functional.fused_bias_act(
+        paddle.to_tensor(x), act_method="silu")
+    ref2 = x / (1 + np.exp(-x)) * 1.0
+    np.testing.assert_allclose(np.asarray(out2._data), x * ref2 / x
+                               if False else x / (1 + np.exp(-x)),
+                               rtol=1e-5)
+    with pytest.raises(ValueError, match="act_method"):
+        paddle.incubate.nn.functional.fused_bias_act(
+            paddle.to_tensor(x), act_method="bogus")
+
+
+def test_bilinear_tensor_product_oracle():
+    from paddle_tpu.static.nn import _BilinearTP
+
+    rng = np.random.RandomState(4)
+    x_np = rng.randn(3, 4).astype(np.float32)
+    y_np = rng.randn(3, 5).astype(np.float32)
+    paddle.seed(0)
+    layer = _BilinearTP(4, 5, 6)
+    out = layer(paddle.to_tensor(x_np), paddle.to_tensor(y_np))
+    w = np.asarray(layer.weight._data)
+    b = np.asarray(layer.bias._data)
+    ref = np.einsum("bi,kij,bj->bk", x_np, w, y_np) + b
+    np.testing.assert_allclose(np.asarray(out._data), ref, rtol=1e-5,
+                               atol=1e-5)
+
+    # the static.nn wrapper: shape + parameter reuse across replays
+    out1 = paddle.static.nn.bilinear_tensor_product(
+        paddle.to_tensor(x_np), paddle.to_tensor(y_np), size=6)
+    out2 = paddle.static.nn.bilinear_tensor_product(
+        paddle.to_tensor(x_np), paddle.to_tensor(y_np), size=6)
+    assert tuple(out1.shape) == (3, 6)
+    assert tuple(out2.shape) == (3, 6)
+
+
+def test_gather_tree_matches_backtrack():
+    """nn.functional.gather_tree vs a python beam-ancestry backtrack."""
+    T, B, W = 4, 2, 3
+    rng = np.random.RandomState(5)
+    ids = rng.randint(0, 9, (T, B, W)).astype(np.int64)
+    parents = rng.randint(0, W, (T, B, W)).astype(np.int64)
+    out = paddle.nn.functional.gather_tree(
+        paddle.to_tensor(ids), paddle.to_tensor(parents))
+    ref = np.zeros_like(ids)
+    for b in range(B):
+        for w in range(W):
+            beam = w
+            for t in range(T - 1, -1, -1):
+                ref[t, b, w] = ids[t, b, beam]
+                beam = parents[t, b, beam]
+    np.testing.assert_array_equal(np.asarray(out._data), ref)
+
+
+def test_where_inplace_keeps_gradients():
+    """where_ must tape-rebind, not clear the autograd node."""
+    w = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32),
+                         stop_gradient=False)
+    x = w * 2
+    cond = paddle.to_tensor(np.array([True, False, True]))
+    y = paddle.to_tensor(np.array([0.0, 0.0, 0.0], np.float32))
+    paddle.where_(cond, x, y)
+    x.sum().backward()
+    np.testing.assert_allclose(np.asarray(w.grad._data), [2.0, 0.0, 2.0])

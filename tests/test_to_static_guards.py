@@ -247,3 +247,103 @@ class TestGradStaleWarning:
         with warnings.catch_warnings():
             warnings.simplefilter("error")
             assert model.weight.grad is not None
+
+
+# --------------------------------------------------------------------------
+# compile-around-break: broken signatures run as compiled SEGMENTS
+# --------------------------------------------------------------------------
+
+def test_compile_around_break_segments():
+    """A genuine graph break (branching on float(loss)) no longer drops
+    the signature to per-op eager: the function runs as jit-compiled
+    segments split at the break — the matmul regions on BOTH sides
+    execute inside compiled programs (probe: segment stats)."""
+    rng = np.random.RandomState(0)
+    w1 = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+    w2 = paddle.to_tensor(rng.randn(8, 8).astype(np.float32))
+    x_np = rng.randn(4, 8).astype(np.float32)
+
+    def raw(x):
+        h = paddle.matmul(x, w1)
+        s = float(h.sum())            # unguardable: float() branched on
+        if s > 0:
+            y = paddle.matmul(h, w2)
+        else:
+            y = paddle.matmul(h, w2) * 2.0
+        return y.sum()
+
+    fn = paddle.jit.to_static(raw)
+    x = paddle.to_tensor(x_np)
+    with pytest.warns(UserWarning, match="graph break|concretization"):
+        out1 = float(fn(x).item())     # discovery: registers the break
+    out2 = float(fn(x).item())         # segmented execution
+    ref = float(raw(x).item())
+    assert abs(out1 - ref) < 1e-5 and abs(out2 - ref) < 1e-5
+    segs, ops = fn._segment_stats
+    # at least the prefix (matmul 1 + sum, flushed at float()) and the
+    # suffix (matmul 2 + sum, flushed at the output read)
+    assert segs >= 2, (segs, ops)
+    assert ops >= 3, (segs, ops)
+
+
+def test_compile_around_break_train_step():
+    """A full train step (backward + optimizer) with a float(loss)
+    branch mid-step still trains to the same losses as eager, running
+    as compiled segments (the backward tape is recorded and flushed
+    compiled too)."""
+    x_np = np.random.RandomState(0).randn(8, 6).astype(np.float32)
+    y_np = np.random.RandomState(1).randn(8, 1).astype(np.float32)
+
+    def make():
+        paddle.seed(3)
+        model = paddle.nn.Linear(6, 1)
+        opt = paddle.optimizer.AdamW(1e-2, parameters=model.parameters())
+        return model, opt
+
+    def body(model, opt, x, y):
+        pred = model(x)
+        loss = ((pred - y) ** 2).mean()
+        lv = float(loss)               # the break
+        scale = 1.0 if lv > 0 else 2.0
+        (loss * scale).backward()
+        opt.step()
+        opt.clear_grad()
+        return loss
+
+    # eager oracle
+    model_e, opt_e = make()
+    x, y = paddle.to_tensor(x_np), paddle.to_tensor(y_np)
+    ref = [float(body(model_e, opt_e, x, y).item()) for _ in range(3)]
+
+    model_s, opt_s = make()
+    step = paddle.jit.to_static(
+        lambda x, y: body(model_s, opt_s, x, y))
+    with pytest.warns(UserWarning):
+        losses = [float(step(x, y).item())]
+    losses += [float(step(x, y).item()) for _ in range(2)]
+    np.testing.assert_allclose(losses, ref, rtol=1e-5, atol=1e-6)
+    segs, ops = step._segment_stats
+    assert segs >= 2, (segs, ops)
+
+
+def test_segmented_outputs_are_plain_arrays():
+    """Tensors escaping a segmented call must carry real arrays — a
+    comparison on the returned loss (outside segment mode) must work."""
+    w = paddle.to_tensor(np.random.RandomState(0).randn(4, 4)
+                         .astype(np.float32))
+
+    def f(x):
+        h = paddle.matmul(x, w)
+        if float(h.sum()) > -1e30:
+            return (h * 2).sum()
+        return h.sum()
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 4)
+                         .astype(np.float32))
+    with pytest.warns(UserWarning):
+        sf(x)
+    out = sf(x)                      # segmented
+    cmp = out > 0                    # must not crash
+    assert cmp.dtype == paddle.bool if hasattr(paddle, "bool") \
+        else np.asarray(cmp._data).dtype == np.bool_
