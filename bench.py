@@ -302,7 +302,11 @@ def _moe_train_bench(on_tpu, dev):
             moe_intermediate_size=1408,
             shared_expert_intermediate_size=2816,
             capacity_factor=2.0, scan_layers=False,
-            use_recompute=True)
+            use_recompute=True,
+            # aux folded out: the per-layer aux attribute cannot cross
+            # the recompute boundary (see qwen2.py); router still trains
+            # through the dispatch gradient
+            router_aux_loss_coef=0.0)
         # batch 8 OOMs 16GB: the un-rematerialized expert intermediates
         # ([E, C, moe_inter] per layer) dominate activation memory
         batch, seq = 4, 2048

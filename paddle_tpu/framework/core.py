@@ -569,7 +569,11 @@ class Tensor:
             data = data._data
         from .segment import SegValue as _SegValue
         if isinstance(data, _SegValue):
-            pass        # lazy-segment placeholder: keep as-is
+            # lazy-segment placeholder: keep lazy, but honor a requested
+            # cast (recorded as a node — dropping it would silently
+            # diverge from the eager path's dtype)
+            if dtype is not None and data.dtype != to_jax_dtype(dtype):
+                data = data.astype(to_jax_dtype(dtype))
         elif not isinstance(data, jax.Array) and \
                 not isinstance(data, jax.core.Tracer):
             data = jnp.asarray(_coerce_host_data(data, dtype),
@@ -610,6 +614,12 @@ class Tensor:
         tr = _track_state.current
         if tr is not None and self.persistable:
             tr.record_write(self)
+        from .segment import current_recorder
+        rec = current_recorder()
+        if rec is not None:
+            # segment mode: log for rollback — a call that aborts before
+            # its final flush must not leave half-committed state
+            rec.log_mutation(self, self._data)
         self._data = new_data
         if _clear_tape:
             self._node = None
@@ -637,6 +647,12 @@ class Tensor:
 
     @grad.setter
     def grad(self, value) -> None:
+        from .segment import current_recorder
+        rec = current_recorder()
+        if rec is not None:
+            # abort-rollback must undo grad (re)binding too, or the
+            # eager retry's backward would double-accumulate
+            rec.log_grad_mutation(self, self._grad_value)
         self._grad_value = value
         self._grad_stale = False
 

@@ -39,10 +39,33 @@ __all__ = ["SegValue", "SegmentRecorder", "segment_mode",
            "current_recorder"]
 
 _current: list = [None]
+_cache_checked: list = [False]
 
 
 def current_recorder():
     return _current[0]
+
+
+def _ensure_compile_cache():
+    """Segmented flushes re-trace fresh closures every call; without the
+    persistent (HLO-keyed) compilation cache every flush would also pay
+    a full XLA compile. Configure it once if the app has not."""
+    if _cache_checked[0]:
+        return
+    _cache_checked[0] = True
+    if jax.config.jax_compilation_cache_dir:
+        return
+    import os
+    import tempfile
+    user = os.environ.get("USER") or os.environ.get("LOGNAME") or (
+        str(os.getuid()) if hasattr(os, "getuid") else "anon")
+    jax.config.update(
+        "jax_compilation_cache_dir",
+        os.path.join(tempfile.gettempdir(),
+                     f"paddle_tpu_segment_xla_cache_{user}"))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    # segment programs are often tiny and fast to compile — cache all
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
 class SegValue:
@@ -156,6 +179,28 @@ class SegmentRecorder:
         self.pending: list[_Node] = []
         self.flushes = 0        # segments executed (the "probe")
         self.ops_recorded = 0
+        # (tensor, original _data) undo log: segment-mode mutations must
+        # be revertible if the call aborts before its final flush (the
+        # eager retry must not see half-committed state)
+        self.mutations: list = []
+
+    def log_mutation(self, tensor, old_data):
+        self.mutations.append(("data", tensor, old_data))
+
+    def log_grad_mutation(self, tensor, old_grad):
+        self.mutations.append(("grad", tensor, old_grad))
+
+    def abort(self):
+        """Discard everything pending and restore every tensor mutated
+        during this recording (arrays AND grad bindings) to its
+        pre-call state."""
+        self.pending.clear()
+        for kind, t, old in reversed(self.mutations):
+            if kind == "data":
+                t._data = old
+            else:
+                t._grad_value = old
+        self.mutations.clear()
 
     # ---- recording --------------------------------------------------------
     def record(self, fn, args, n_outputs, name=""):
@@ -194,9 +239,17 @@ class SegmentRecorder:
 
     # ---- flushing ---------------------------------------------------------
     def flush(self):
-        """Execute every pending node inside one jit; bind results."""
+        """Execute every pending node inside one jit; bind results.
+
+        Each flush wraps a FRESH closure in jax.jit (op closures are
+        recreated per call, so executable reuse by structural key would
+        risk wrong cache hits on closed-over constants): segmented calls
+        re-TRACE per call, and the XLA compile — the expensive part —
+        is deduped by the persistent HLO-keyed compilation cache, which
+        ``_ensure_compile_cache`` turns on if the app has not."""
         if not self.pending:
             return
+        _ensure_compile_cache()
         nodes, self.pending = self.pending, []
         # gather external (concrete) inputs in first-use order
         ext = []
@@ -263,6 +316,10 @@ def segment_mode(recorder: SegmentRecorder):
     _current[0] = recorder
     try:
         yield recorder
-    finally:
+    except BaseException:
+        _current[0] = prev
+        recorder.abort()   # roll back half-committed state mutations
+        raise
+    else:
         _current[0] = prev
         recorder.flush()

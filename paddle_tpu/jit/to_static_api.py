@@ -200,7 +200,7 @@ class StaticFunction:
         spec = _tree_flatten((args, kwargs), leaves)
         sig = _signature_key(leaves)
         if sig in self._fallback_sigs:
-            return self._call_segmented(args, kwargs)
+            return self._call_segmented(sig, args, kwargs)
         entry = self._graphs.get(sig)
         if entry is None or entry.latest_key is None:
             return self._discover(sig, spec, leaves, args, kwargs)
@@ -235,7 +235,7 @@ class StaticFunction:
 
     # ---- broken signatures: compile AROUND the break ---------------------
 
-    def _call_segmented(self, args, kwargs):
+    def _call_segmented(self, sig, args, kwargs):
         """SOT-style subgraph compilation for a signature with a genuine
         graph break (SURVEY.md §3.5): the function runs ONCE, but op
         dispatches are recorded lazily and flushed as jit-compiled
@@ -246,9 +246,29 @@ class StaticFunction:
         (segments_executed, ops_recorded) from the last call (the
         compile-around-break probe used by tests)."""
         from ..framework import segment as _segment
+        if sig in getattr(self, "_eager_sigs", set()):
+            return self._call_fn(*args, **kwargs)
         rec = _segment.SegmentRecorder()
-        with _segment.segment_mode(rec):
-            out = self._call_fn(*args, **kwargs)
+        try:
+            with _segment.segment_mode(rec):
+                out = self._call_fn(*args, **kwargs)
+        except ValueError as e:
+            if "__jax_array__" not in str(e):
+                raise
+            # the function uses an op that consumes raw arrays outside
+            # the apply() funnel — placeholders cannot flow through it
+            # (jax 0.9 rejects coercion). segment_mode already rolled
+            # back every state mutation, so a plain-eager retry is safe;
+            # remember the signature so later calls skip segments
+            if not hasattr(self, "_eager_sigs"):
+                self._eager_sigs = set()
+            self._eager_sigs.add(sig)
+            warnings.warn(
+                f"to_static: {getattr(self._fn, '__name__', '?')} uses "
+                "an op that cannot carry lazy segments; running this "
+                "broken signature fully eagerly instead of "
+                "compile-around-break")
+            return self._call_fn(*args, **kwargs)
         # normalize ESCAPED placeholders: the exit flush made every
         # SegValue concrete, but tensors handed back to the caller must
         # carry real arrays — jax 0.9 rejects __jax_array__ coercion, so

@@ -402,7 +402,10 @@ class DeepseekV2ForCausalLM(nn.Layer, GenerationMixin):
             M.reshape(shift_logits, [-1, self.config.vocab_size]),
             M.reshape(shift_labels, [-1]))
         coef = self.config.router_aux_loss_coef
-        for layer in self.layers:
-            if layer.is_moe and layer.mlp.aux_loss is not None:
-                loss = loss + coef * layer.mlp.aux_loss
+        if coef:
+            # stored aux tracers cannot cross a jax.checkpoint boundary;
+            # with coef=0 (recompute runs) the read is skipped entirely
+            for layer in self.layers:
+                if layer.is_moe and layer.mlp.aux_loss is not None:
+                    loss = loss + coef * layer.mlp.aux_loss
         return logits, loss

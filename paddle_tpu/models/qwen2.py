@@ -285,7 +285,11 @@ class _Qwen2Base(nn.Layer, GenerationMixin):
         loss = F.cross_entropy(
             M.reshape(shift_logits, [-1, self.config.vocab_size]),
             M.reshape(shift_labels, [-1]))
-        if self._moe:
+        if self._moe and self.config.router_aux_loss_coef:
+            # NOTE: per-layer aux attributes cannot cross a jax.checkpoint
+            # boundary (use_recompute wraps each layer; the stored tracer
+            # would leak) — run aux-weighted training without recompute,
+            # or fold aux out (coef=0)
             coef = self.config.router_aux_loss_coef
             for layer in self.layers:
                 aux = layer.mlp.aux_loss

@@ -347,3 +347,31 @@ def test_segmented_outputs_are_plain_arrays():
     cmp = out > 0                    # must not crash
     assert cmp.dtype == paddle.bool if hasattr(paddle, "bool") \
         else np.asarray(cmp._data).dtype == np.bool_
+
+
+def test_segment_unsafe_op_retries_eager():
+    """A broken signature whose function uses an op that consumes raw
+    arrays outside the apply() funnel (paddle.any here) cannot carry
+    lazy segments — the call must roll back cleanly, retry fully eager
+    with CORRECT results, and remember the signature."""
+    w = paddle.to_tensor(np.random.RandomState(0).randn(4, 4)
+                         .astype(np.float32))
+
+    def f(x):
+        h = paddle.matmul(x, w)
+        if float(h.sum()) > -1e30:
+            flag = paddle.any(h > 0).astype("float32")
+            return h.sum() + flag
+        return h.sum()
+
+    sf = paddle.jit.to_static(f)
+    x = paddle.to_tensor(np.random.RandomState(1).randn(2, 4)
+                         .astype(np.float32))
+    ref = float(f(x).item())
+    with pytest.warns(UserWarning):
+        a = float(sf(x).item())        # discovery: registers the break
+    with pytest.warns(UserWarning, match="eagerly"):
+        b = float(sf(x).item())        # segment attempt -> eager retry
+    c = float(sf(x).item())            # remembered: straight eager
+    assert abs(a - ref) < 1e-5 and abs(b - ref) < 1e-5 \
+        and abs(c - ref) < 1e-5
