@@ -48,8 +48,13 @@ def current_recorder():
 
 def _ensure_compile_cache():
     """Segmented flushes re-trace fresh closures every call; without the
-    persistent (HLO-keyed) compilation cache every flush would also pay
-    a full XLA compile. Configure it once if the app has not."""
+    persistent (HLO-keyed) compilation cache, every flush of a LARGE
+    segment would also pay a full XLA compile. Configure the cache once
+    if — and only if — the app has not set one itself, and keep jax's
+    default entry-size/compile-time thresholds: only slow compiles are
+    persisted (the ones worth caching), so the directory stays small
+    even though the setting is process-global. Tiny segment programs
+    re-compile in milliseconds and don't need it."""
     if _cache_checked[0]:
         return
     _cache_checked[0] = True
@@ -63,9 +68,6 @@ def _ensure_compile_cache():
         "jax_compilation_cache_dir",
         os.path.join(tempfile.gettempdir(),
                      f"paddle_tpu_segment_xla_cache_{user}"))
-    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
-    # segment programs are often tiny and fast to compile — cache all
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 
 class SegValue:
@@ -179,15 +181,27 @@ class SegmentRecorder:
         self.pending: list[_Node] = []
         self.flushes = 0        # segments executed (the "probe")
         self.ops_recorded = 0
-        # (tensor, original _data) undo log: segment-mode mutations must
+        # (tensor, original value) undo log: segment-mode mutations must
         # be revertible if the call aborts before its final flush (the
-        # eager retry must not see half-committed state)
+        # eager retry must not see half-committed state). FIRST write
+        # per tensor only — rollback needs the oldest value, and keeping
+        # every intermediate would pin a previous copy of all state for
+        # the whole call (double HBM on a large train step).
         self.mutations: list = []
+        self._mutated: set = set()
 
     def log_mutation(self, tensor, old_data):
+        key = ("data", id(tensor))
+        if key in self._mutated:
+            return
+        self._mutated.add(key)
         self.mutations.append(("data", tensor, old_data))
 
     def log_grad_mutation(self, tensor, old_grad):
+        key = ("grad", id(tensor))
+        if key in self._mutated:
+            return
+        self._mutated.add(key)
         self.mutations.append(("grad", tensor, old_grad))
 
     def abort(self):
@@ -201,6 +215,7 @@ class SegmentRecorder:
             else:
                 t._grad_value = old
         self.mutations.clear()
+        self._mutated.clear()
 
     # ---- recording --------------------------------------------------------
     def record(self, fn, args, n_outputs, name=""):
@@ -322,4 +337,10 @@ def segment_mode(recorder: SegmentRecorder):
         raise
     else:
         _current[0] = prev
-        recorder.flush()
+        try:
+            recorder.flush()
+        except BaseException:
+            # the exit flush itself failed (compile OOM, a recorded op
+            # erroring under jit): same rollback guarantee applies
+            recorder.abort()
+            raise

@@ -53,6 +53,9 @@ class LlamaConfig:
     # context memory against HBM headroom — full-depth 2.4B at interval 1
     # OOMs a 16GB v5e by a few hundred MB, interval 2 fits
     core_attn_interval: int = 1
+    # every k-th layer skips remat entirely (activations saved whole);
+    # 0 = off — the remat-dose knob for spending leftover HBM on speed
+    full_save_interval: int = 0
     tensor_parallel: bool = True  # use TP layers (degenerate w/o mesh)
     # context parallelism over the 'sep' mesh axis: None | "ring" | "ulysses"
     sep_parallel: str | None = None
@@ -382,14 +385,17 @@ class LlamaModel(nn.Layer):
         from ..nn.scan import scan_layers, can_scan
         if getattr(self.config, "scan_layers", True) and \
                 can_scan(self.layers):
-            if (getattr(self.config, "recompute_granularity", "full")
-                    != "full" and self.config.use_recompute
+            if ((getattr(self.config, "recompute_granularity", "full")
+                    != "full"
+                    or getattr(self.config, "full_save_interval", 0))
+                    and self.config.use_recompute
                     and self.training):
                 import warnings
                 warnings.warn(
-                    "recompute_granularity is ignored under "
-                    "scan_layers=True (the scan body remats whole "
-                    "layers); set scan_layers=False for core_attn",
+                    "recompute_granularity / full_save_interval are "
+                    "ignored under scan_layers=True (the scan body "
+                    "remats whole layers); set scan_layers=False for "
+                    "selective remat",
                     stacklevel=2)
             # one lax.scan over stacked per-layer weights: code size (the
             # measured TPU bottleneck for unrolled stacks) stays that of
@@ -411,9 +417,16 @@ class LlamaModel(nn.Layer):
                 and not self.config.sequence_parallel)
             interval = max(
                 int(getattr(self.config, "core_attn_interval", 1)), 1)
+            # remat DOSE: every k-th layer keeps its activations whole
+            # (no recompute at all) — spends leftover HBM to cut the
+            # backward's re-forward time. 0 = off.
+            fs = max(int(getattr(self.config, "full_save_interval", 0)),
+                     0)
             for i, layer in enumerate(self.layers):
                 if self.config.use_recompute and self.training:
-                    if selective and i % interval == 0:
+                    if fs and i % fs == fs - 1:
+                        x = layer(x)
+                    elif selective and i % interval == 0:
                         x = layer.forward_core_attn_remat(x)
                     else:
                         from ..incubate.recompute import recompute

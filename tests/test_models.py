@@ -162,3 +162,49 @@ def test_gpt2_generate_shape():
     ids = paddle.to_tensor(np.ones((2, 10), np.int64))
     logits = model(ids)
     assert logits.shape == [2, 10, cfg.vocab_size]
+
+
+def test_llama_full_save_interval_parity_and_scan_warning():
+    """The remat-dose knob (every k-th layer saves activations whole)
+    must not change training numerics, and must WARN when silently
+    inapplicable (scan_layers=True remats whole layers)."""
+    import warnings as _warnings
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    def losses(fs):
+        cfg = LlamaConfig.tiny()
+        cfg.use_recompute = True
+        cfg.scan_layers = False
+        cfg.recompute_granularity = "core_attn"
+        cfg.core_attn_interval = 2
+        cfg.full_save_interval = fs
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        m.train()
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, 256, (2, 16)).astype(np.int64))
+        out = []
+        for _ in range(2):
+            _, l = m(ids, labels=ids)
+            l.backward()
+            opt.step()
+            opt.clear_grad()
+            out.append(float(l.item()))
+        return out
+
+    np.testing.assert_allclose(losses(0), losses(2), rtol=1e-5)
+
+    cfg = LlamaConfig.tiny()
+    cfg.use_recompute = True
+    cfg.scan_layers = True
+    cfg.full_save_interval = 2
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    m.train()
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, 256, (2, 16)).astype(np.int64))
+    with _warnings.catch_warnings(record=True) as rec:
+        _warnings.simplefilter("always")
+        m(ids, labels=ids)
+    assert any("full_save_interval" in str(r.message) for r in rec)

@@ -192,3 +192,33 @@ def test_gpt2_through_engine():
     eng.add_request(prompt, 8)
     (req,) = eng.run()
     assert req.tokens == ref, (req.tokens, ref)
+
+
+def test_one_token_and_instant_eos_requests():
+    """Refactor edge cases: a max_new_tokens=1 request never activates a
+    slot (its token arrives via the deferred first-token fetch at
+    drain), and a request whose FIRST generated token is its stop token
+    is detected on device at the next chunk's entry."""
+    model, cfg = _model()
+    eng = ContinuousBatchingEngine(model, num_slots=2, page_size=8,
+                                   max_len=48, decode_chunk=4,
+                                   prompt_buckets=(8, 16), greedy=True)
+    rng = np.random.RandomState(0)
+    p1 = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+    r1 = eng.add_request(p1, 1)                 # one-token request
+    # find what the model's first token for p2 would be, then use it as
+    # that request's eos -> instant-eos on the prefill token
+    p2 = rng.randint(0, cfg.vocab_size, (7,)).astype(np.int32)
+    probe = ContinuousBatchingEngine(model, num_slots=1, page_size=8,
+                                     max_len=48, decode_chunk=4,
+                                     prompt_buckets=(8, 16), greedy=True)
+    probe.add_request(p2, 2)
+    first_tok = probe.run()[0].tokens[0]
+    r2 = eng.add_request(p2, 5, eos_token_id=int(first_tok))
+    done = eng.run()
+    by_id = {r.request_id: r for r in done}
+    assert len(by_id[r1].tokens) == 1
+    assert by_id[r1].finish_reason == "length"
+    assert by_id[r2].tokens[0] == first_tok
+    assert len(by_id[r2].tokens) == 1
+    assert by_id[r2].finish_reason == "eos"
