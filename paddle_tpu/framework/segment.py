@@ -50,11 +50,10 @@ def _ensure_compile_cache():
     """Segmented flushes re-trace fresh closures every call; without the
     persistent (HLO-keyed) compilation cache, every flush of a LARGE
     segment would also pay a full XLA compile. Configure the cache once
-    if — and only if — the app has not set one itself, and keep jax's
-    default entry-size/compile-time thresholds: only slow compiles are
-    persisted (the ones worth caching), so the directory stays small
-    even though the setting is process-global. Tiny segment programs
-    re-compile in milliseconds and don't need it."""
+    if — and only if — the app has not set one itself. Entries need
+    >0.1s of compile time to persist, so the directory holds only
+    programs worth caching even though the setting is process-global;
+    genuinely tiny segments re-compile in milliseconds and stay out."""
     if _cache_checked[0]:
         return
     _cache_checked[0] = True
@@ -68,6 +67,11 @@ def _ensure_compile_cache():
         "jax_compilation_cache_dir",
         os.path.join(tempfile.gettempdir(),
                      f"paddle_tpu_segment_xla_cache_{user}"))
+    # jax's default persistence threshold is a full SECOND of compile
+    # time — a segment compiling in 0.9s would re-pay that every call.
+    # Persist anything over 0.1s; only genuinely tiny programs (which
+    # re-compile in milliseconds) stay out of the cache.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
 
 
 class SegValue:

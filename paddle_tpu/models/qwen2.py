@@ -47,6 +47,8 @@ class Qwen2Config:
     sep_parallel: str | None = None
     # roll the decoder stack into one lax.scan (see nn/scan.py)
     scan_layers: bool = True
+    # every k-th layer skips remat entirely (0 = off) — see llama.py
+    full_save_interval: int = 0
 
     @classmethod
     def qwen2_7b(cls):
@@ -263,11 +265,25 @@ class _Qwen2Base(nn.Layer, GenerationMixin):
         # eagerly after the stack (and experts route via shard_map)
         if getattr(self.config, "scan_layers", True) and \
                 not self._moe and can_scan(self.layers):
+            if getattr(self.config, "full_save_interval", 0) and \
+                    self.config.use_recompute and self.training:
+                import warnings
+                warnings.warn(
+                    "full_save_interval is ignored under "
+                    "scan_layers=True (the scan body remats whole "
+                    "layers); set scan_layers=False for the remat dose",
+                    stacklevel=2)
             x = _scan(self.layers, x,
                       remat=self.config.use_recompute and self.training)
         else:
-            for layer in self.layers:
-                if self.config.use_recompute and self.training:
+            # remat DOSE (same knob as LlamaConfig.full_save_interval):
+            # every k-th layer keeps activations whole instead of
+            # recomputing — spend leftover HBM on backward speed
+            fs = max(int(getattr(self.config, "full_save_interval", 0)),
+                     0)
+            for i, layer in enumerate(self.layers):
+                if self.config.use_recompute and self.training and \
+                        not (fs and i % fs == fs - 1):
                     from ..incubate.recompute import recompute
                     x = recompute(layer, x)
                 else:
