@@ -135,15 +135,37 @@ class PipelineParallel:
                     "virtual pipeline stages; use schedule_mode="
                     "'interleaved' or num_virtual_pipeline_stages=1")
             if self._schedule not in _SCAN_SCHEDULES and \
-                    (self._sep_axes() or self._expert_axes()):
+                    self._expert_axes():
                 raise ValueError(
-                    "pp composed with sep/expert axes currently runs "
+                    "pp composed with the expert axis currently runs "
                     "under the compiled scan schedules; use "
                     "schedule_mode='FThenB' or 'interleaved' (the "
-                    "explicit 1F1B/ZB-H1 tick engines compute loss and "
-                    "grads inside the manual region, which needs sep/"
-                    "ep-aware epilogue and gradient reduction — "
-                    "not yet implemented)")
+                    "explicit 1F1B/ZB-H1 tick engines compute grads "
+                    "inside the manual region, which needs an ep-aware "
+                    "reduction — not yet implemented)")
+            if self._schedule not in _SCAN_SCHEDULES and \
+                    self._sep_axes() and self._sep_impl() == "ring":
+                raise ValueError(
+                    "ring context parallelism under the explicit "
+                    "1F1B/ZB-H1 engines is not supported: the ring's "
+                    "ppermute scan sits inside the tick machine's "
+                    "pipe-varying lax.switch, whose all-branches-and-"
+                    "select lowering collapses the sep rotation "
+                    "(measured: one rank's chunk duplicated). Use "
+                    "sep_parallel='ulysses' (supported under every "
+                    "schedule) or the scan schedules "
+                    "(FThenB/interleaved) for ring")
+
+    def _sep_impl(self):
+        """The stage layers' sep attention impl ('ring' | 'ulysses'),
+        or None — the single config walk both _sep_axes and the
+        schedule validation derive from."""
+        for l in self._layers.run_function:
+            cfg = getattr(l, "cfg", None) or getattr(l, "config", None)
+            impl = getattr(cfg, "sep_parallel", None) if cfg else None
+            if impl is not None:
+                return impl
+        return None
 
     def _sep_axes(self):
         """('sep',) when this pipeline composes with an active context-
@@ -153,11 +175,8 @@ class PipelineParallel:
         if self._hcg is None or \
                 self._hcg.get_sep_parallel_world_size() <= 1:
             return ()
-        for l in self._layers.run_function:
-            cfg = getattr(l, "cfg", None) or getattr(l, "config", None)
-            if cfg is not None and \
-                    getattr(cfg, "sep_parallel", None) is not None:
-                return (self._hcg.sep_axis_name,)
+        if self._sep_impl() is not None:
+            return (self._hcg.sep_axis_name,)
         return ()
 
     def _expert_axes(self):
@@ -351,10 +370,33 @@ class PipelineParallel:
         schedule = self._schedule
         loss_layer = self._layers._loss_fn
         stage_fn = _make_stage_fn(template, template_params)
+        sep = self._sep_axes()
+        x_spec = None
+        if sep:
+            from jax.sharding import PartitionSpec as P
+            # per-microbatch activations inside the engine are
+            # [mb, S, H]; the stream is [M, mb, S, H] — seq dim 2
+            x_spec = P(None, None, sep[0])
 
         def epi_fn(y, tgt, epi_leaves):
             originals = [(p, p._data) for p in epi_refs]
             try:
+                if sep:
+                    from jax import lax as _lax
+                    # the epilogue + shifted loss need the FULL
+                    # sequence: gather the context-sharded hidden
+                    # states (seq dim 1 per microbatch); the loss then
+                    # computes identically on every sep rank, and the
+                    # engine tail normalizes it back to invariance.
+                    # COST: every sep rank runs the full epilogue
+                    # (norm + vocab projection + CE) over the gathered
+                    # sequence — sep_degree x redundant last-stage
+                    # FLOPs. Generic-correct for ANY loss_fn; a
+                    # loss-aware fast path (local-shard logits +
+                    # offset labels + psum of partials) would need the
+                    # shifted-CE structure, and the scan schedules
+                    # remain the throughput path for 5D runs
+                    y = _lax.all_gather(y, sep[0], axis=1, tiled=True)
                 for p, a in zip(epi_refs, epi_leaves):
                     p._data = a
                 t = Tensor(y)
@@ -377,7 +419,8 @@ class PipelineParallel:
             loss, dp, _y, dx_micro, depi = run_pipeline_train(
                 stage_fn, None, stacked, hm, tgt_micro, mesh,
                 axis_name=axis, schedule=schedule,
-                epi_fn=epi_fn, epi_params=epi_leaves)
+                epi_fn=epi_fn, epi_params=epi_leaves,
+                extra_axes=sep, x_spec=x_spec)
             body_grads = tuple(dp[i][g] for g in range(S)
                                for i in range(n_leaves))
             return loss, body_grads, dx_micro, depi

@@ -212,7 +212,8 @@ from .pipeline import _vary  # noqa: E402 — shared pcast/pvary shim
 
 def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
                         tgt_micro, axis_name, n_stages,
-                        schedule="zb_h1", epi_fn=None, epi_params=None):
+                        schedule="zb_h1", epi_fn=None, epi_params=None,
+                        extra_axes=()):
     """Run one pipelined train step inside a shard_map region.
 
     stage_fn(params_one_stage, x) -> y, shape/dtype preserving.
@@ -234,6 +235,18 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
     (loss, dparams, y_micro, dx_micro, depi). loss replicated after psum;
     dparams matches stage_params' local structure; y_micro [M, ...]
     last-stage outputs.
+
+    extra_axes — the 5D pp x sep composition: additional manual axes the
+    enclosing shard_map binds (the activations arrive sequence-sharded
+    over them, stage_fn's ring attention uses them directly, and epi_fn
+    is expected to all_gather before the loss so it returns the FULL
+    loss on every rank). The sep collectives inside the pipe-varying
+    lax.switch/cond branches are safe: the branch index depends only on
+    the pipe coordinate, so all sep-peers of a fiber enter each
+    collective together. At the end, stage grads are psum'd over the
+    extra axes (their token shards are partial sums) while loss/depi —
+    identical on every rank after the gather — are psum/size-normalized
+    back to invariance.
     """
     S = int(n_stages)
     d = lax.axis_index(axis_name)
@@ -259,7 +272,8 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
     # psum INSIDE the last-stage-only branch — a collective that only one
     # device reaches (deadlock). Cast epi params varying up front so
     # their grads stay local; the single psum at the end does the reduce.
-    epi_v = jax.tree.map(lambda q: _vary(q, axis_name), epi)
+    epi_v = jax.tree.map(
+        lambda q: _vary(q, axis_name, like=x_micro), epi)
 
     def apply_stage(p, x):
         return stage_fn(p, x)
@@ -268,30 +282,38 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
         y = apply_stage(pp, xx)
         return epi_fn(y, tgt, ee) if full_model else loss_fn(y, tgt)
 
-    xbuf0 = _vary(jnp.zeros((kx,) + mb_shape, x_micro.dtype), axis_name)
-    ybuf0 = _vary(jnp.zeros_like(x_micro), axis_name)
-    gbuf0 = _vary(jnp.zeros((kg,) + mb_shape, x_micro.dtype), axis_name)
+    xbuf0 = _vary(jnp.zeros((kx,) + mb_shape, x_micro.dtype), axis_name,
+                  like=x_micro)
+    ybuf0 = _vary(jnp.zeros_like(x_micro), axis_name, like=x_micro)
+    gbuf0 = _vary(jnp.zeros((kg,) + mb_shape, x_micro.dtype), axis_name,
+                  like=x_micro)
     # the [M, ...] input-gradient bank exists only in full-model mode —
     # plain callers keep the K-slot memory bound (None = empty pytree)
-    dxbuf0 = _vary(jnp.zeros_like(x_micro), axis_name) \
+    dxbuf0 = _vary(jnp.zeros_like(x_micro), axis_name, like=x_micro) \
         if full_model else None
-    dp0 = jax.tree.map(jnp.zeros_like, stage_params)
+    dp0 = jax.tree.map(
+        lambda q: _vary(jnp.zeros_like(q), axis_name, like=x_micro)
+        if extra_axes else jnp.zeros_like(q), stage_params)
     # epi_params arrive replicated (P()); the accumulator must be varying
     # over the pipe axis like every other carry buffer
     depi0 = jax.tree.map(
-        lambda q: _vary(jnp.zeros_like(q), axis_name), epi)
+        lambda q: _vary(jnp.zeros_like(q), axis_name, like=x_micro), epi)
     # branch outputs must agree on varying-axis type: every constant a
     # branch can return is pre-cast to varying over the pipe axis
-    zeros_mb = _vary(jnp.zeros(mb_shape, x_micro.dtype), axis_name)
-    zero_loss = _vary(jnp.zeros((), jnp.float32), axis_name)
+    zeros_mb = _vary(jnp.zeros(mb_shape, x_micro.dtype), axis_name,
+                     like=x_micro)
+    zero_loss = _vary(jnp.zeros((), jnp.float32), axis_name,
+                      like=x_micro)
     zero_dp = jax.tree.map(
-        lambda q: _vary(jnp.zeros(q.shape[1:], q.dtype), axis_name),
+        lambda q: _vary(jnp.zeros(q.shape[1:], q.dtype), axis_name,
+                        like=x_micro),
         stage_params)
     zero_depi = jax.tree.map(
-        lambda q: _vary(jnp.zeros_like(q), axis_name), epi)
+        lambda q: _vary(jnp.zeros_like(q), axis_name, like=x_micro), epi)
     fmsg0 = zeros_mb
     bmsg0 = zeros_mb
-    loss0 = _vary(jnp.zeros((), jnp.float32), axis_name)
+    loss0 = _vary(jnp.zeros((), jnp.float32), axis_name,
+                  like=x_micro)
 
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
     bwd_perm = [(i + 1, i) for i in range(S - 1)]
@@ -422,17 +444,28 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
     last_mask = d == S - 1
     loss = lax.psum(jnp.where(last_mask, loss, 0.0), axis_name)
     y_micro = lax.psum(ybuf * last_mask.astype(ybuf.dtype), axis_name)
+    for ax in extra_axes:
+        n_ax = lax.psum(1, ax)
+        # after epi_fn's all_gather the loss is the FULL loss on every
+        # sep rank: normalize back to invariance. Stage grads are
+        # per-token-shard partial sums: plain psum.
+        loss = lax.psum(loss, ax) / n_ax
+        dp = jax.tree.map(lambda q: lax.psum(q, ax), dp)
     if not full_model:
         return loss, dp, y_micro
     first_mask = (d == 0).astype(dxbuf.dtype)
     dx_micro = lax.psum(dxbuf * first_mask, axis_name)
     depi = jax.tree.map(lambda q: lax.psum(q, axis_name), depi)
+    for ax in extra_axes:
+        n_ax = lax.psum(1, ax)
+        depi = jax.tree.map(lambda q: lax.psum(q, ax) / n_ax, depi)
     return loss, dp, y_micro, dx_micro, depi
 
 
 def run_pipeline_train(stage_fn, loss_fn, stacked_params, x_micro,
                        tgt_micro, mesh, axis_name="pipe",
-                       schedule="zb_h1", epi_fn=None, epi_params=None):
+                       schedule="zb_h1", epi_fn=None, epi_params=None,
+                       extra_axes=(), x_spec=None):
     """Global-view entry: partial-manual shard_map over the pipe axis.
 
     stacked_params leaves: [S, ...] sharded on dim 0 over ``axis_name``.
@@ -444,6 +477,11 @@ def run_pipeline_train(stage_fn, loss_fn, stacked_params, x_micro,
     S = int(mesh.shape[axis_name])
     pspecs = jax.tree.map(lambda _: P(axis_name), stacked_params)
     if epi_fn is None:
+        if extra_axes or x_spec is not None:
+            raise ValueError(
+                "extra_axes/x_spec (the pp x sep composition) require "
+                "full-model mode: pass epi_fn so the loss can gather "
+                "the context-sharded sequence")
         f = jax.shard_map(
             functools.partial(pipeline_train_spmd, stage_fn, loss_fn,
                               axis_name=axis_name, n_stages=S,
@@ -455,18 +493,23 @@ def run_pipeline_train(stage_fn, loss_fn, stacked_params, x_micro,
         )
         return f(stacked_params, x_micro, tgt_micro)
     epi_specs = jax.tree.map(lambda _: P(), epi_params)
+    if x_spec is None:
+        x_spec = P()
 
     def wrapped(sp, xm, tm, ep):
         return pipeline_train_spmd(stage_fn, loss_fn, sp, xm, tm,
                                    axis_name=axis_name, n_stages=S,
                                    schedule=schedule, epi_fn=epi_fn,
-                                   epi_params=ep)
+                                   epi_params=ep, extra_axes=extra_axes)
 
     f = jax.shard_map(
         wrapped,
         mesh=mesh,
-        in_specs=(pspecs, P(), P(), epi_specs),
-        out_specs=(P(), pspecs, P(), P(), epi_specs),
-        axis_names={axis_name},
+        # targets stay replicated (epi_fn gathers hidden states before
+        # the loss, so it needs the full label sequence); activations
+        # and their gradients ride x_spec over the extra axes
+        in_specs=(pspecs, x_spec, P(), epi_specs),
+        out_specs=(P(), pspecs, x_spec, x_spec, epi_specs),
+        axis_names={axis_name, *extra_axes},
     )
     return f(stacked_params, x_micro, tgt_micro, epi_params)

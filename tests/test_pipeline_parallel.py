@@ -462,8 +462,14 @@ def test_hybrid_dp2_explicit_schedules(schedule):
 # activations cross pipeline-stage boundaries (SURVEY.md §2.3 hybrid row)
 # --------------------------------------------------------------------------
 
-@pytest.mark.parametrize("schedule", ["FThenB", "interleaved"])
-def test_hybrid_5d_pipeline_sep_llama_parity(schedule):
+# ring composes with the SCAN schedules; the explicit engines run sep
+# via ULYSSES (the ring's ppermute scan inside the tick machine's
+# pipe-varying lax.switch collapses under all-branches-and-select
+# lowering — rejected with a clear error, tested below)
+@pytest.mark.parametrize("schedule,impl",
+                         [("FThenB", "ring"), ("interleaved", "ring"),
+                          ("1F1B", "ulysses"), ("ZB-H1", "ulysses")])
+def test_hybrid_5d_pipeline_sep_llama_parity(schedule, impl):
     """pp2 x mp2 x sep2 over 8 devices in ONE compiled program: the
     pipeline's shard_map binds BOTH 'pipe' and 'sep', the decoder
     stack's ring attention issues its ppermute K/V ring directly on the
@@ -481,7 +487,7 @@ def test_hybrid_5d_pipeline_sep_llama_parity(schedule):
                            max_position_embeddings=32, rope_theta=10000.0,
                            tensor_parallel=par,
                            sequence_parallel=par,
-                           sep_parallel="ring" if par else None)
+                           sep_parallel=impl if par else None)
 
     ids_np = np.random.RandomState(0).randint(
         0, 256, (4, 32)).astype(np.int64)
@@ -521,18 +527,18 @@ def test_hybrid_5d_pipeline_sep_llama_parity(schedule):
         fleet.fleet._is_initialized = False
 
 
-def test_hybrid_5d_explicit_schedule_rejected():
-    """1F1B/ZB-H1 + an active sep axis is a documented configuration
-    error (the explicit tick engines would need a sep-aware epilogue),
-    not a silently-wrong run."""
+def test_hybrid_ring_explicit_schedule_rejected():
+    """ring + 1F1B/ZB-H1 is a documented configuration error (the
+    tick machine's branch-select lowering breaks the sep rotation);
+    ulysses is the supported sep impl under the explicit engines."""
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLMPipe
     c = LlamaConfig(vocab_size=256, hidden_size=64, num_hidden_layers=4,
                     num_attention_heads=4, num_key_value_heads=2,
                     intermediate_size=128, max_position_embeddings=32,
-                    rope_theta=10000.0, tensor_parallel=True,
+                    rope_theta=10000.0, tensor_parallel=False,
                     sep_parallel="ring")
     strategy = fleet.DistributedStrategy()
-    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 2,
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
                                "pp_degree": 2, "sharding_degree": 1,
                                "sep_degree": 2, "ep_degree": 1}
     strategy.pipeline_configs = {"accumulate_steps": 2,
@@ -541,7 +547,33 @@ def test_hybrid_5d_explicit_schedule_rejected():
     try:
         paddle.seed(0)
         model = LlamaForCausalLMPipe(c)
-        with pytest.raises(ValueError, match="sep"):
+        with pytest.raises(ValueError, match="ring"):
+            fleet.fleet.distributed_model(model)
+    finally:
+        fleet.fleet._hcg = None
+        fleet.fleet._topology = None
+        fleet.fleet._is_initialized = False
+
+
+def test_hybrid_ep_explicit_schedule_rejected():
+    """1F1B/ZB-H1 + an active expert axis is a documented configuration
+    error (the explicit tick engines would need an ep-aware gradient
+    reduction), not a silently-wrong run."""
+    import dataclasses
+    from paddle_tpu.models import Qwen2MoeConfig, Qwen2MoeForCausalLMPipe
+    c = dataclasses.replace(Qwen2MoeConfig.tiny(), num_hidden_layers=4,
+                            tensor_parallel=False)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 1, "ep_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": "1F1B"}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(0)
+        model = Qwen2MoeForCausalLMPipe(c)
+        with pytest.raises(ValueError, match="expert"):
             fleet.fleet.distributed_model(model)
     finally:
         fleet.fleet._hcg = None
