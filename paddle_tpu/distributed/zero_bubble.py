@@ -312,8 +312,7 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
         lambda q: _vary(jnp.zeros_like(q), axis_name, like=x_micro), epi)
     fmsg0 = zeros_mb
     bmsg0 = zeros_mb
-    loss0 = _vary(jnp.zeros((), jnp.float32), axis_name,
-                  like=x_micro)
+    loss0 = zero_loss
 
     fwd_perm = [(i, i + 1) for i in range(S - 1)]
     bwd_perm = [(i + 1, i) for i in range(S - 1)]

@@ -527,6 +527,69 @@ def test_hybrid_5d_pipeline_sep_llama_parity(schedule, impl):
         fleet.fleet._is_initialized = False
 
 
+def test_hybrid_5d_explicit_sgd_grad_sensitivity():
+    """Plain-SGD parity for the sep + explicit-engine path: unlike
+    AdamW (scale-invariant update direction), SGD exposes any uniform
+    gradient mis-scaling in the sep reductions (psum for token-shard
+    stage grads vs psum/n for the gathered epilogue grads)."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaForCausalLMPipe)
+
+    def cfg(par):
+        return LlamaConfig(vocab_size=256, hidden_size=64,
+                           num_hidden_layers=4, num_attention_heads=4,
+                           num_key_value_heads=2, intermediate_size=128,
+                           max_position_embeddings=32, rope_theta=10000.0,
+                           tensor_parallel=False,
+                           sep_parallel="ulysses" if par else None)
+
+    ids_np = np.random.RandomState(0).randint(
+        0, 256, (4, 32)).astype(np.int64)
+    steps = 2
+
+    paddle.seed(0)
+    ref_model = LlamaForCausalLM(cfg(False))
+    ref_opt = paddle.optimizer.SGD(0.1,
+                                   parameters=ref_model.parameters())
+    ids_t = paddle.to_tensor(ids_np)
+    ref = []
+    for _ in range(steps):
+        _, loss = ref_model(ids_t, labels=ids_t)
+        loss.backward()
+        ref_opt.step()
+        ref_opt.clear_grad()
+        ref.append(float(loss.item()))
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 2, "ep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": "1F1B"}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        hcg = fleet.get_hybrid_communicate_group()
+        paddle.seed(0)
+        model = LlamaForCausalLMPipe(cfg(True))
+        engine = fleet.fleet.distributed_model(model)
+        opt = fleet.fleet.distributed_optimizer(
+            paddle.optimizer.SGD(0.1, parameters=model.parameters()))
+        ids = jax.device_put(
+            jnp.asarray(ids_np),
+            NamedSharding(hcg.global_mesh, PartitionSpec(None, "sep")))
+        ids_p = paddle.Tensor(ids)
+        losses = [float(engine.train_batch((ids_p, ids_p), opt).item())
+                  for _ in range(steps)]
+        # step-2 loss moves by lr * |grad|^2-ish: a sep_degree-scaled
+        # gradient would shift it far outside this tolerance
+        np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-5)
+    finally:
+        fleet.fleet._hcg = None
+        fleet.fleet._topology = None
+        fleet.fleet._is_initialized = False
+
+
 def test_hybrid_ring_explicit_schedule_rejected():
     """ring + 1F1B/ZB-H1 is a documented configuration error (the
     tick machine's branch-select lowering breaks the sep rotation);
