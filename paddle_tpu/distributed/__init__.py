@@ -29,6 +29,7 @@ from .parallel_layers import (ColumnParallelLinear, RowParallelLinear,
 from .auto_parallel_api import (to_static, Strategy,
                                 DistAttr, DistModel, unshard_dtensor)
 from . import launch  # noqa: F401
+from . import passes  # noqa: F401
 from .zero_bubble import (run_pipeline_train, make_schedule)
 from ..native import TCPStore  # noqa: F401 — rendezvous control plane
 from . import rpc  # noqa: F401 — control-plane RPC (init_rpc/rpc_sync/...)

@@ -10,7 +10,9 @@ from .program import (Program, default_main_program, default_startup_program,
                       program_guard, data, Executor, InputSpec, name_scope)
 from .passes import (PassManager, register_pass, apply_build_strategy,
                      XLA_DELEGATED_PASSES)
-from .extras import (Variable, Scope, global_scope, scope_guard,
+from .extras import (create_global_var, ipu_shard_guard,
+                     accuracy, auc,
+                     Variable, Scope, global_scope, scope_guard,
                      cpu_places, cuda_places, device_guard, py_func,
                      gradients, append_backward, normalize_program,
                      save_inference_model, load_inference_model,
@@ -21,6 +23,7 @@ from . import nn  # noqa: F401
 from . import amp  # noqa: F401
 
 __all__ = ["enable_static", "disable_static", "in_dynamic_mode", "Program",
+           "create_global_var", "ipu_shard_guard", "accuracy", "auc",
            "default_main_program", "default_startup_program",
            "program_guard", "data", "Executor", "InputSpec", "name_scope",
            "nn", "PassManager", "register_pass", "apply_build_strategy",

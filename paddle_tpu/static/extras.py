@@ -297,3 +297,67 @@ def load(program, model_path, executor=None, var_list=None):
     program's parameters."""
     set_program_state(program, load_program_state(model_path,
                                                   var_list=var_list))
+
+# ---- legacy fluid static surface -----------------------------------------
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    """Legacy fluid global variable: a persistable Tensor in the global
+    scope, initialized to ``value``."""
+    import jax.numpy as _jnp
+    t = Tensor(_jnp.full(tuple(int(x) for x in shape), value,
+                         dtype=str(dtype)))
+    t.persistable = bool(persistable)
+    if name:
+        t.name = name
+        global_scope()._vars[name] = t
+    return t
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    """IPU-only sharding annotation in the reference; a no-op context
+    for API parity (no IPU backend on TPU builds)."""
+    import contextlib
+    return contextlib.nullcontext()
+
+
+# top-k accuracy: the dynamic metric op IS the static op's semantics
+from ..metric import accuracy  # noqa: F401,E402
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, stat_pos=None, stat_neg=None):
+    """Legacy static AUC op: returns (auc_out, batch_auc, states).
+
+    The reference accumulates in persistable state variables; here the
+    accumulation travels through the returned ``states`` — pass the
+    previous call's states back via ``stat_pos``/``stat_neg`` and
+    ``auc_out`` covers everything seen so far while ``batch_auc``
+    covers only this batch.
+    """
+    import numpy as _np
+    import jax.numpy as _jnp
+    from ..metric import Auc as _Auc
+    pred = _np.asarray(input.numpy() if hasattr(input, "numpy")
+                       else input)
+    lab = _np.asarray(label.numpy() if hasattr(label, "numpy")
+                      else label)
+    batch = _Auc(curve=curve, num_thresholds=num_thresholds)
+    batch.update(pred, lab)
+    cum = _Auc(curve=curve, num_thresholds=num_thresholds)
+    if stat_pos is not None:
+        cum._stat_pos = _np.asarray(
+            stat_pos.numpy() if hasattr(stat_pos, "numpy")
+            else stat_pos).astype(cum._stat_pos.dtype).copy()
+    if stat_neg is not None:
+        cum._stat_neg = _np.asarray(
+            stat_neg.numpy() if hasattr(stat_neg, "numpy")
+            else stat_neg).astype(cum._stat_neg.dtype).copy()
+    cum.update(pred, lab)
+    auc_out = Tensor(_jnp.asarray(float(cum.accumulate()), _jnp.float32))
+    batch_auc = Tensor(_jnp.asarray(float(batch.accumulate()),
+                                    _jnp.float32))
+    states = [Tensor(_jnp.asarray(cum._stat_pos)),
+              Tensor(_jnp.asarray(cum._stat_neg))]
+    return auc_out, batch_auc, states
+

@@ -64,16 +64,16 @@ class PassManager:
     """``paddle.incubate.pass_utils``-shaped driver: validates names,
     applies registered rewrites in order, records delegated ones."""
 
-    def __init__(self, passes):
+    def __init__(self, passes, extra_delegated=frozenset()):
         self.names = list(passes)
+        allowed = XLA_DELEGATED_PASSES | frozenset(extra_delegated)
         unknown = [n for n in self.names
-                   if n not in _PASS_REGISTRY and
-                   n not in XLA_DELEGATED_PASSES]
+                   if n not in _PASS_REGISTRY and n not in allowed]
         if unknown:
             raise ValueError(
                 f"unknown pass(es) {unknown}; registered: "
-                f"{sorted(_PASS_REGISTRY)}, XLA-delegated: "
-                f"{sorted(XLA_DELEGATED_PASSES)}")
+                f"{sorted(_PASS_REGISTRY)}, delegated: "
+                f"{sorted(allowed)}")
 
     def apply(self, program):
         applied = getattr(program, "_applied_passes", None)

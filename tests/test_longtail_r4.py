@@ -120,3 +120,47 @@ def test_where_inplace_keeps_gradients():
     paddle.where_(cond, x, y)
     x.sum().backward()
     np.testing.assert_allclose(np.asarray(w.grad._data), [2.0, 0.0, 2.0])
+
+
+def test_static_legacy_ops():
+    """create_global_var / ipu_shard_guard / accuracy / auc (legacy
+    static surface)."""
+    v = paddle.static.create_global_var([2, 3], 1.5, "float32",
+                                        persistable=True, name="gv_t")
+    assert v.shape == [2, 3] and v.persistable
+    assert paddle.static.global_scope().find_var("gv_t") is v
+    np.testing.assert_allclose(np.asarray(v._data), np.full((2, 3), 1.5))
+    with paddle.static.ipu_shard_guard(index=0, stage=1):
+        pass
+    logits = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]],
+                                       np.float32))
+    label = paddle.to_tensor(np.array([[1], [0]], np.int64))
+    acc = paddle.static.accuracy(logits, label)
+    assert float(np.asarray(acc._data).reshape(-1)[0]) == 1.0
+    a, b, states = paddle.static.auc(logits, label)
+    assert 0.0 <= float(a.item()) <= 1.0
+    assert len(states) == 2
+    # accumulation travels through the returned states: the cumulative
+    # auc over two batches differs from the second batch's own
+    logits2 = paddle.to_tensor(np.array([[0.6, 0.4], [0.3, 0.7]],
+                                        np.float32))
+    label2 = paddle.to_tensor(np.array([[0], [0]], np.int64))
+    a2, b2, _ = paddle.static.auc(logits2, label2, stat_pos=states[0],
+                                  stat_neg=states[1])
+    assert abs(float(a2.item()) - float(b2.item())) > 1e-6
+
+
+def test_distributed_passes_registry():
+    """paddle.distributed.passes: new_pass/PassManager/PassContext over
+    the shared program-pass registry; unknown names rejected."""
+    import pytest as _pytest
+    from paddle_tpu.distributed import passes as dp
+    ctx = dp.PassContext()
+    prog = paddle.static.Program()
+    p = dp.new_pass("auto_parallel_sharding", {"stage": 2})
+    p.apply([prog], context=ctx)
+    assert ctx.applied == ["auto_parallel_sharding"]
+    assert prog._applied_passes == ["auto_parallel_sharding"]
+    dp.PassManager(["fuse_all_reduce", dp.new_pass("auto_parallel_amp")])
+    with _pytest.raises(ValueError, match="unknown"):
+        dp.PassManager(["not_a_pass"])
