@@ -37,6 +37,14 @@ class _Pass:
         self.attrs = dict(attrs or {})
 
     def apply(self, main_programs, startup_programs=None, context=None):
+        if self.attrs:
+            import warnings
+            warnings.warn(
+                f"distributed pass {self.name!r}: attrs {sorted(self.attrs)} "
+                "are recorded but not consumed — on this runtime the "
+                "pass's work is owned by XLA/GSPMD, the fleet engines, "
+                "or model/strategy config knobs (configure those "
+                "directly)", stacklevel=2)
         mgr = PassManager([self])
         for prog in (main_programs if isinstance(main_programs,
                                                  (list, tuple))

@@ -9,6 +9,7 @@ from . import optimizer
 from . import autotune
 from .nn import functional
 from .optimizer import LookAhead, ModelAverage, DistributedFusedLamb
+from . import multiprocessing  # noqa: F401
 
 __all__ = ["nn", "autograd", "functional", "optimizer", "LookAhead",
            "ModelAverage", "softmax_mask_fuse", "autotune",

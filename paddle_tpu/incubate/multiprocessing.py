@@ -1,0 +1,78 @@
+"""``paddle.incubate.multiprocessing`` — tensor-aware multiprocessing
+(reference: ``python/paddle/incubate/multiprocessing``, UNVERIFIED —
+mount empty). The reference teaches the stdlib pickler to move GPU/CPU
+tensors through shared memory (cuda IPC handles / mmap'd files).
+
+TPU-native stance: device arrays are not shareable across host
+processes (each process owns its PJRT client), so a Tensor crossing a
+process boundary travels as its HOST value — pickled via
+``reduction``'s registered reducer as (dtype, numpy bytes) and rebuilt
+as a CPU-backed Tensor on the other side. That is exactly the behavior
+the DataLoader worker pool relies on; this module makes it available
+through the reference's module surface (``get_context``, ``Process``,
+``Queue``, ``Pool``, ``reductions``-style registration).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as _std
+
+import numpy as np
+
+__all__ = ["get_context", "Process", "Queue", "SimpleQueue", "Pool",
+           "Pipe", "init_reductions"]
+
+
+def _reduce_tensor(t):
+    from ..framework.core import Tensor
+    arr = np.asarray(t._data)
+    return (_rebuild_tensor, (arr, bool(t.stop_gradient),
+                              getattr(t, "name", "") or ""))
+
+
+def _rebuild_tensor(arr, stop_gradient, name):
+    import jax.numpy as jnp
+    from ..framework.core import Tensor
+    t = Tensor(jnp.asarray(arr), stop_gradient=stop_gradient)
+    if name:
+        t.name = name
+    return t
+
+
+def init_reductions():
+    """Register the Tensor reducer with the stdlib ForkingPickler
+    (idempotent). Called automatically on module import, matching the
+    reference's import-time hook."""
+    from multiprocessing.reduction import ForkingPickler
+    from ..framework.core import Tensor
+    ForkingPickler.register(Tensor, _reduce_tensor)
+
+
+init_reductions()
+
+
+def get_context(method=None):
+    """multiprocessing context with tensor pickling active. ``spawn``
+    is the default (fork inherits the parent's PJRT/TPU client state,
+    which is unsafe — same policy as io.DataLoader's worker pool)."""
+    return _std.get_context(method or "spawn")
+
+
+def Process(*args, **kwargs):
+    return get_context().Process(*args, **kwargs)
+
+
+def Queue(*args, **kwargs):
+    return get_context().Queue(*args, **kwargs)
+
+
+def SimpleQueue(*args, **kwargs):
+    return get_context().SimpleQueue(*args, **kwargs)
+
+
+def Pool(*args, **kwargs):
+    return get_context().Pool(*args, **kwargs)
+
+
+def Pipe(*args, **kwargs):
+    return get_context().Pipe(*args, **kwargs)

@@ -21,7 +21,8 @@ from ..framework.core import Tensor, apply
 from ..framework.device import CPUPlace, CUDAPlace
 from .program import Program, default_main_program
 
-__all__ = ["Variable", "Scope", "global_scope", "scope_guard",
+__all__ = [
+    "create_global_var", "ipu_shard_guard", "accuracy", "auc","Variable", "Scope", "global_scope", "scope_guard",
            "cpu_places", "cuda_places", "device_guard", "py_func",
            "gradients", "append_backward", "normalize_program",
            "save_inference_model", "load_inference_model"]
@@ -305,8 +306,9 @@ def create_global_var(shape, value, dtype, persistable=False,
     """Legacy fluid global variable: a persistable Tensor in the global
     scope, initialized to ``value``."""
     import jax.numpy as _jnp
+    from ..framework.core import to_jax_dtype
     t = Tensor(_jnp.full(tuple(int(x) for x in shape), value,
-                         dtype=str(dtype)))
+                         dtype=to_jax_dtype(dtype)))
     t.persistable = bool(persistable)
     if name:
         t.name = name
@@ -344,16 +346,19 @@ def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
                       else label)
     batch = _Auc(curve=curve, num_thresholds=num_thresholds)
     batch.update(pred, lab)
+    # cumulative stats = prior states + this batch's bins (numpy adds —
+    # the per-sample binning loop runs once, not twice)
     cum = _Auc(curve=curve, num_thresholds=num_thresholds)
+    cum._stat_pos = batch._stat_pos.copy()
+    cum._stat_neg = batch._stat_neg.copy()
     if stat_pos is not None:
-        cum._stat_pos = _np.asarray(
+        cum._stat_pos += _np.asarray(
             stat_pos.numpy() if hasattr(stat_pos, "numpy")
-            else stat_pos).astype(cum._stat_pos.dtype).copy()
+            else stat_pos).astype(cum._stat_pos.dtype)
     if stat_neg is not None:
-        cum._stat_neg = _np.asarray(
+        cum._stat_neg += _np.asarray(
             stat_neg.numpy() if hasattr(stat_neg, "numpy")
-            else stat_neg).astype(cum._stat_neg.dtype).copy()
-    cum.update(pred, lab)
+            else stat_neg).astype(cum._stat_neg.dtype)
     auc_out = Tensor(_jnp.asarray(float(cum.accumulate()), _jnp.float32))
     batch_auc = Tensor(_jnp.asarray(float(batch.accumulate()),
                                     _jnp.float32))

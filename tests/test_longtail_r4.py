@@ -164,3 +164,49 @@ def test_distributed_passes_registry():
     dp.PassManager(["fuse_all_reduce", dp.new_pass("auto_parallel_amp")])
     with _pytest.raises(ValueError, match="unknown"):
         dp.PassManager(["not_a_pass"])
+
+
+def _mp_double_worker(q_in, q_out):
+    # child re-imports fresh: registering the reducer here is what lets
+    # the CHILD pickle a Tensor back
+    import os
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import paddle_tpu as paddle  # noqa: F401
+    from paddle_tpu.incubate import multiprocessing  # noqa: F401
+    t = q_in.get()
+    q_out.put(t * 2)
+
+
+def test_incubate_multiprocessing_tensor_pickling():
+    """incubate.multiprocessing: Tensors cross REAL process boundaries
+    as host values via the registered reducer — a spawned child receives
+    a Tensor through a Queue, computes on it, and sends a Tensor back
+    (plus the in-process ForkingPickler round-trip)."""
+    import io
+    import pickle
+    from multiprocessing.reduction import ForkingPickler
+    from paddle_tpu.incubate import multiprocessing as mp
+
+    t = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    t.name = "mp_t"
+    buf = io.BytesIO()
+    ForkingPickler(buf, pickle.HIGHEST_PROTOCOL).dump(t)
+    t2 = pickle.loads(buf.getvalue())
+    np.testing.assert_allclose(np.asarray(t2._data),
+                               np.asarray(t._data))
+    assert t2.name == "mp_t" and t2.stop_gradient == t.stop_gradient
+
+    ctx = mp.get_context()
+    assert ctx.get_start_method() == "spawn"
+    q_in, q_out = mp.Queue(), mp.Queue()
+    proc = mp.Process(target=_mp_double_worker, args=(q_in, q_out))
+    proc.start()
+    try:
+        q_in.put(t)
+        back = q_out.get(timeout=120)
+    finally:
+        proc.join(timeout=120)
+    np.testing.assert_allclose(np.asarray(back._data),
+                               np.asarray(t._data) * 2)
