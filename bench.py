@@ -8,9 +8,13 @@
 2. DECODE (secondary, extra JSON keys): KV-cache greedy decode
    throughput on the 1B config — tokens/s across a batch of streams.
 
-Prints ONE JSON line:
+Prints the JSON record line INCREMENTALLY: once after the core
+(train/decode/cb) sections, then re-printed enriched after each MoE
+section. Every printed line is a complete, parseable record — whichever
+line is last when the driver's time limit hits carries everything
+measured so far:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N,
-   "decode_metric": ..., "decode_value": N, "decode_unit": ...}
+   "decode_*": ..., "cb_*": ..., "moe_*": ..., "moe_decode_*": ...}
 """
 
 from __future__ import annotations
@@ -137,6 +141,44 @@ def _train_bench(on_tpu, dev):
         acc = loss if acc is None else acc + loss
     float(acc.item())  # device-chained; one final scalar sync
     dt = (time.perf_counter() - t0) / steps
+
+    import os
+    if os.environ.get("BENCH_AB_GUARD"):
+        # A/B the keep-backward-alive trick: the one-element grad read
+        # relies on XLA NOT sinking the slice into the backward dots; if
+        # a future XLA applies slice-of-dot simplification it could DCE
+        # weight-grad compute and silently inflate MFU. Time the
+        # full-grad-sum variant and flag a divergence.
+        @paddle.jit.to_static
+        def fwd_bwd_full(ids):
+            _, loss = model(ids, labels=ids)
+            loss.backward()
+            gsum = None
+            for p in model.parameters():
+                if p.grad is not None:
+                    s = p.grad.astype("float32").sum()
+                    gsum = s if gsum is None else gsum + s
+                p.clear_grad()
+            return loss, gsum
+
+        for _ in range(2):
+            loss_f, gsum_f = fwd_bwd_full(ids)
+        float(loss_f.item())
+        t0 = time.perf_counter()
+        accf = None
+        for i in range(4):
+            loss_f, _ = fwd_bwd_full(step_ids[i])
+            accf = loss_f if accf is None else accf + loss_f
+        float(accf.item())
+        dt_full = (time.perf_counter() - t0) / 4
+        drift = (dt_full - dt) / dt_full
+        print(f"# A/B guard: one-elem {dt*1000:.1f} ms vs full-grad-sum "
+              f"{dt_full*1000:.1f} ms ({drift*100:+.1f}% incl. the "
+              f"full 4.7GB reduce)", file=sys.stderr)
+        if drift > 0.10:
+            print("# A/B GUARD FAILED: one-element variant >10% faster "
+                  "than full-grad-sum — XLA may be DCE'ing backward "
+                  "compute; headline MFU suspect", file=sys.stderr)
 
     tokens = batch * seq
     n_params = sum(p.size for p in model.parameters())
@@ -431,6 +473,18 @@ def _moe_decode_bench(on_tpu):
     return tok_per_s
 
 
+def _timed_section(what, fn):
+    """Run a bench section, logging wall time to stderr (budget telemetry:
+    round-4's record never printed because the sections overran the
+    driver's limit — per-section times make the budget auditable)."""
+    t0 = time.perf_counter()
+    try:
+        return fn()
+    finally:
+        print(f"# [{what}: {time.perf_counter() - t0:.0f}s]",
+              file=sys.stderr)
+
+
 def main():
     import jax
 
@@ -438,37 +492,25 @@ def main():
     on_tpu = dev.platform.lower() in ("tpu", "axon")
 
     import gc
-    n_params, train_tok_s, mfu = _retry_transient(
-        lambda: _train_bench(on_tpu, dev), "train bench")
+    n_params, train_tok_s, mfu = _timed_section(
+        "train", lambda: _retry_transient(
+            lambda: _train_bench(on_tpu, dev), "train bench"))
     gc.collect()
     try:
-        decode_tok_s = _retry_transient(
-            lambda: _decode_bench(on_tpu), "decode bench")
+        decode_tok_s = _timed_section(
+            "decode", lambda: _retry_transient(
+                lambda: _decode_bench(on_tpu), "decode bench"))
     except Exception as e:  # decode is secondary: never sink the headline
         print(f"# decode bench failed: {e!r}", file=sys.stderr)
         decode_tok_s = None
     gc.collect()
     try:
-        cb_tok_s = _retry_transient(lambda: _cb_bench(on_tpu), "cb bench")
+        cb_tok_s = _timed_section(
+            "cb", lambda: _retry_transient(
+                lambda: _cb_bench(on_tpu), "cb bench"))
     except Exception as e:
         print(f"# continuous-batching bench failed: {e!r}", file=sys.stderr)
         cb_tok_s = None
-    gc.collect()
-    try:
-        moe_params, moe_tok_s, moe_mfu = _retry_transient(
-            lambda: _moe_train_bench(on_tpu, dev), "moe train bench")
-    except Exception as e:
-        print(f"# moe train bench failed: {e!r}", file=sys.stderr)
-        moe_params = moe_tok_s = moe_mfu = None
-    # a failed section's exception traceback pins its model (frames hold
-    # locals) — without this collect, one OOM sinks every later section
-    gc.collect()
-    try:
-        moe_decode_tok_s = _retry_transient(
-            lambda: _moe_decode_bench(on_tpu), "moe decode bench")
-    except Exception as e:
-        print(f"# moe decode bench failed: {e!r}", file=sys.stderr)
-        moe_decode_tok_s = None
     gc.collect()
 
     suffix = "" if on_tpu else "_cpu_smoke"
@@ -488,6 +530,22 @@ def main():
                                + suffix)
         record["cb_value"] = round(cb_tok_s, 2)
         record["cb_unit"] = "tokens/s/chip"
+    # Print the core record NOW: if a later (MoE) section overruns the
+    # driver's time limit, this line is still on stdout and parseable.
+    # Round-4's record printed only at the very end — one slow section
+    # erased every completed metric (BENCH_r04.json parsed:null).
+    print(json.dumps(record), flush=True)
+
+    try:
+        moe_params, moe_tok_s, moe_mfu = _timed_section(
+            "moe train", lambda: _retry_transient(
+                lambda: _moe_train_bench(on_tpu, dev), "moe train bench"))
+    except Exception as e:
+        print(f"# moe train bench failed: {e!r}", file=sys.stderr)
+        moe_params = moe_tok_s = moe_mfu = None
+    # a failed section's exception traceback pins its model (frames hold
+    # locals) — without this collect, one OOM sinks every later section
+    gc.collect()
     if moe_tok_s is not None:
         record["moe_metric"] = (
             f"qwen2_moe_{moe_params/1e9:.2f}B_fwd_bwd_bf16_tokens_per_sec"
@@ -495,12 +553,24 @@ def main():
         record["moe_value"] = round(moe_tok_s, 2)
         record["moe_unit"] = "tokens/s/chip"
         record["moe_mfu"] = round(moe_mfu, 4)
+        # re-print enriched as soon as the MoE headline lands (same
+        # incremental contract as above: moe decode must not erase it)
+        print(json.dumps(record), flush=True)
+
+    try:
+        moe_decode_tok_s = _timed_section(
+            "moe decode", lambda: _retry_transient(
+                lambda: _moe_decode_bench(on_tpu), "moe decode bench"))
+    except Exception as e:
+        print(f"# moe decode bench failed: {e!r}", file=sys.stderr)
+        moe_decode_tok_s = None
+    gc.collect()
     if moe_decode_tok_s is not None:
         record["moe_decode_metric"] = (
             "deepseek_v2_mla_latent_cache_greedy_decode" + suffix)
         record["moe_decode_value"] = round(moe_decode_tok_s, 2)
         record["moe_decode_unit"] = "tokens/s/chip"
-    print(json.dumps(record))
+        print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":

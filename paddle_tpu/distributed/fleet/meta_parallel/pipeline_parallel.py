@@ -135,15 +135,6 @@ class PipelineParallel:
                     "virtual pipeline stages; use schedule_mode="
                     "'interleaved' or num_virtual_pipeline_stages=1")
             if self._schedule not in _SCAN_SCHEDULES and \
-                    self._expert_axes():
-                raise ValueError(
-                    "pp composed with the expert axis currently runs "
-                    "under the compiled scan schedules; use "
-                    "schedule_mode='FThenB' or 'interleaved' (the "
-                    "explicit 1F1B/ZB-H1 tick engines compute grads "
-                    "inside the manual region, which needs an ep-aware "
-                    "reduction — not yet implemented)")
-            if self._schedule not in _SCAN_SCHEDULES and \
                     self._sep_axes() and self._sep_impl() == "ring":
                 raise ValueError(
                     "ring context parallelism under the explicit "
@@ -371,12 +362,26 @@ class PipelineParallel:
         loss_layer = self._layers._loss_fn
         stage_fn = _make_stage_fn(template, template_params)
         sep = self._sep_axes()
+        expert = self._expert_axes()
+        from jax.sharding import PartitionSpec as P
         x_spec = None
         if sep:
-            from jax.sharding import PartitionSpec as P
             # per-microbatch activations inside the engine are
             # [mb, S, H]; the stream is [M, mb, S, H] — seq dim 2
             x_spec = P(None, None, sep[0])
+        param_specs = None
+        if expert:
+            # ep x pp under the tick engine: keep expert-weight banks
+            # sharded over 'expert' through the manual region (same
+            # leaf tagging as the scan engine's _body_apply); their
+            # grads come back as local shards — the ep-aware reduction
+            # (see zero_bubble.pipeline_train_spmd expert_axes note)
+            def _leaf_spec(p):
+                if getattr(p, "_ep_shard_dim", None) == 0:
+                    return P(axis, expert[0])
+                return P(axis)
+
+            param_specs = tuple(_leaf_spec(p) for p in template_params)
 
         def epi_fn(y, tgt, epi_leaves):
             originals = [(p, p._data) for p in epi_refs]
@@ -420,7 +425,8 @@ class PipelineParallel:
                 stage_fn, None, stacked, hm, tgt_micro, mesh,
                 axis_name=axis, schedule=schedule,
                 epi_fn=epi_fn, epi_params=epi_leaves,
-                extra_axes=sep, x_spec=x_spec)
+                extra_axes=sep, x_spec=x_spec,
+                param_specs=param_specs, expert_axes=expert)
             body_grads = tuple(dp[i][g] for g in range(S)
                                for i in range(n_leaves))
             return loss, body_grads, dx_micro, depi
@@ -429,9 +435,21 @@ class PipelineParallel:
         # replicated, so grads must come back replicated too — otherwise
         # each eager optimizer update op would trigger its own resharding
         # collective (deadlock-prone on XLA:CPU, serialized on TPU).
+        # Exception: expert-bank grads stay sharded over 'expert', same
+        # as the banks themselves (sharded param + sharded grad keep the
+        # optimizer update local to each ep rank).
         from jax.sharding import NamedSharding, PartitionSpec
         repl = NamedSharding(mesh, PartitionSpec())
-        out_sh = (repl, tuple(repl for _ in range(S * n_leaves)), repl,
+
+        def _grad_sh(p):
+            if expert and getattr(p, "_ep_shard_dim", None) == 0:
+                return NamedSharding(mesh, PartitionSpec(expert[0]))
+            return repl
+
+        out_sh = (repl,
+                  tuple(_grad_sh(template_params[i])
+                        for _g in range(S) for i in range(n_leaves)),
+                  repl,
                   tuple(repl for _ in range(len(epi_refs))))
         self._engine_fn = jax.jit(engine_call, out_shardings=out_sh)
         # fixed once the plan exists; cached so the hot loop doesn't walk

@@ -38,13 +38,27 @@ class _Pass:
 
     def apply(self, main_programs, startup_programs=None, context=None):
         if self.attrs:
+            # attrs are dropped in BOTH categories (registered rewrites
+            # are name-keyed and take no attrs either) — but say which
+            # is happening: delegated = the whole pass's work lives
+            # elsewhere; registered = the rewrite runs with defaults
             import warnings
-            warnings.warn(
-                f"distributed pass {self.name!r}: attrs {sorted(self.attrs)} "
-                "are recorded but not consumed — on this runtime the "
-                "pass's work is owned by XLA/GSPMD, the fleet engines, "
-                "or model/strategy config knobs (configure those "
-                "directly)", stacklevel=2)
+            delegated = self.name in _DELEGATED_DISTRIBUTED or \
+                self.name in XLA_DELEGATED_PASSES
+            if delegated:
+                warnings.warn(
+                    f"distributed pass {self.name!r}: attrs "
+                    f"{sorted(self.attrs)} are recorded but not consumed "
+                    "— on this runtime the pass's work is owned by "
+                    "XLA/GSPMD, the fleet engines, or model/strategy "
+                    "config knobs (configure those directly)",
+                    stacklevel=2)
+            else:
+                warnings.warn(
+                    f"distributed pass {self.name!r}: the registered "
+                    f"program rewrite runs, but attrs "
+                    f"{sorted(self.attrs)} are ignored (rewrites are "
+                    "name-keyed and take no attrs)", stacklevel=2)
         mgr = PassManager([self])
         for prog in (main_programs if isinstance(main_programs,
                                                  (list, tuple))

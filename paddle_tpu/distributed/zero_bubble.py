@@ -213,7 +213,7 @@ from .pipeline import _vary  # noqa: E402 — shared pcast/pvary shim
 def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
                         tgt_micro, axis_name, n_stages,
                         schedule="zb_h1", epi_fn=None, epi_params=None,
-                        extra_axes=()):
+                        extra_axes=(), expert_axes=()):
     """Run one pipelined train step inside a shard_map region.
 
     stage_fn(params_one_stage, x) -> y, shape/dtype preserving.
@@ -247,6 +247,23 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
     extra axes (their token shards are partial sums) while loss/depi —
     identical on every rank after the gather — are psum/size-normalized
     back to invariance.
+
+    expert_axes — the ep x pp composition (MoE under 1F1B/ZB-H1):
+    manual axes over which activations stay REPLICATED while some
+    stage-param leaves (the expert weight banks) arrive SHARDED.
+    MoELayer's manual-region path slices its token shard by axis index,
+    runs the all-to-all dispatch on the bound axis, and reassembles the
+    full token set with a masked psum — so every inter-tick value
+    (activations, gradients, loss) is expert-INVARIANT, and no
+    engine-side buffer plumbing changes. The gradient story rides jax's
+    typed-vma transpose: cotangents of expert-invariant primals (shared
+    params, dx) come back invariant (the pvary transpose inserts the
+    psum over 'expert' inside the vjp), while cotangents of the
+    expert-sharded bank leaves stay local shards — which is exactly the
+    ep-aware reduction: NO engine-side psum over expert_axes at all.
+    Per-leaf vma is inherited from the params themselves
+    (``zeros_like(p_local)``), so bank-grad accumulators are
+    expert-varying and shared-grad accumulators are not.
     """
     S = int(n_stages)
     d = lax.axis_index(axis_name)
@@ -267,6 +284,18 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
 
     p_local = jax.tree.map(lambda q: lax.index_in_dim(q, 0, 0, False),
                            stage_params)
+
+    def _zeros_vma_like(q):
+        """Zeros with q's vma (+ pipe + x_micro's axes) — bank leaves
+        sharded over an expert axis must have expert-varying grad
+        accumulators while shared-param leaves stay expert-invariant."""
+        from ..framework._vma import pvary_missing
+        try:
+            inherited = tuple(jax.typeof(q).vma)
+        except Exception:
+            inherited = ()
+        return pvary_missing(jnp.zeros_like(q),
+                             inherited + (axis_name,), like=x_micro)
     # Differentiating wrt an UNVARIED value under the device-varying
     # lax.cond(is_last, ...) would make jax insert the pvary-transpose
     # psum INSIDE the last-stage-only branch — a collective that only one
@@ -292,8 +321,8 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
     dxbuf0 = _vary(jnp.zeros_like(x_micro), axis_name, like=x_micro) \
         if full_model else None
     dp0 = jax.tree.map(
-        lambda q: _vary(jnp.zeros_like(q), axis_name, like=x_micro)
-        if extra_axes else jnp.zeros_like(q), stage_params)
+        _zeros_vma_like if (extra_axes or expert_axes)
+        else jnp.zeros_like, stage_params)
     # epi_params arrive replicated (P()); the accumulator must be varying
     # over the pipe axis like every other carry buffer
     depi0 = jax.tree.map(
@@ -304,10 +333,10 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
                      like=x_micro)
     zero_loss = _vary(jnp.zeros((), jnp.float32), axis_name,
                       like=x_micro)
-    zero_dp = jax.tree.map(
-        lambda q: _vary(jnp.zeros(q.shape[1:], q.dtype), axis_name,
-                        like=x_micro),
-        stage_params)
+    # branch-constant shapes follow p_local (the [1, ...]-indexed leaf),
+    # inheriting its vma: expert-sharded bank leaves yield expert-varying
+    # zeros, shared leaves expert-invariant ones
+    zero_dp = jax.tree.map(_zeros_vma_like, p_local)
     zero_depi = jax.tree.map(
         lambda q: _vary(jnp.zeros_like(q), axis_name, like=x_micro), epi)
     fmsg0 = zeros_mb
@@ -464,23 +493,28 @@ def pipeline_train_spmd(stage_fn, loss_fn, stage_params, x_micro,
 def run_pipeline_train(stage_fn, loss_fn, stacked_params, x_micro,
                        tgt_micro, mesh, axis_name="pipe",
                        schedule="zb_h1", epi_fn=None, epi_params=None,
-                       extra_axes=(), x_spec=None):
+                       extra_axes=(), x_spec=None, param_specs=None,
+                       expert_axes=()):
     """Global-view entry: partial-manual shard_map over the pipe axis.
 
-    stacked_params leaves: [S, ...] sharded on dim 0 over ``axis_name``.
+    stacked_params leaves: [S, ...] sharded on dim 0 over ``axis_name``
+    (``param_specs`` overrides per leaf — e.g. P('pipe', 'expert') keeps
+    an expert bank's expert dim sharded through the region; the same
+    specs shard the returned dparams, which for those leaves are local
+    shards, not psum'd — see pipeline_train_spmd's expert_axes note).
     Returns (loss_sum, dparams [S, ...] stacked, y_micro [M, ...]); with
     ``epi_fn`` (full-model mode, see pipeline_train_spmd) additionally
     (..., dx_micro [M, ...], depi)."""
     from jax.sharding import PartitionSpec as P
 
     S = int(mesh.shape[axis_name])
-    pspecs = jax.tree.map(lambda _: P(axis_name), stacked_params)
+    pspecs = param_specs if param_specs is not None else \
+        jax.tree.map(lambda _: P(axis_name), stacked_params)
     if epi_fn is None:
-        if extra_axes or x_spec is not None:
+        if extra_axes or expert_axes or x_spec is not None:
             raise ValueError(
-                "extra_axes/x_spec (the pp x sep composition) require "
-                "full-model mode: pass epi_fn so the loss can gather "
-                "the context-sharded sequence")
+                "extra_axes/expert_axes/x_spec (the pp x sep/ep "
+                "compositions) require full-model mode: pass epi_fn")
         f = jax.shard_map(
             functools.partial(pipeline_train_spmd, stage_fn, loss_fn,
                               axis_name=axis_name, n_stages=S,
@@ -499,7 +533,8 @@ def run_pipeline_train(stage_fn, loss_fn, stacked_params, x_micro,
         return pipeline_train_spmd(stage_fn, loss_fn, sp, xm, tm,
                                    axis_name=axis_name, n_stages=S,
                                    schedule=schedule, epi_fn=epi_fn,
-                                   epi_params=ep, extra_axes=extra_axes)
+                                   epi_params=ep, extra_axes=extra_axes,
+                                   expert_axes=expert_axes)
 
     f = jax.shard_map(
         wrapped,
@@ -509,6 +544,6 @@ def run_pipeline_train(stage_fn, loss_fn, stacked_params, x_micro,
         # and their gradients ride x_spec over the extra axes
         in_specs=(pspecs, x_spec, P(), epi_specs),
         out_specs=(P(), pspecs, x_spec, x_spec, epi_specs),
-        axis_names={axis_name, *extra_axes},
+        axis_names={axis_name, *extra_axes, *expert_axes},
     )
     return f(stacked_params, x_micro, tgt_micro, epi_params)

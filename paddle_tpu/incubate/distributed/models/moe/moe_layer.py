@@ -25,10 +25,12 @@ __all__ = ["MoELayer", "GShardGate", "SwitchGate"]
 
 
 class _GateSpec:
-    def __init__(self, top_k, capacity_factor, norm_topk_prob):
+    def __init__(self, top_k, capacity_factor, norm_topk_prob,
+                 dropless=False):
         self.top_k = top_k
         self.capacity_factor = capacity_factor
         self.norm_topk_prob = norm_topk_prob
+        self.dropless = dropless
 
 
 def GShardGate(top_k=2, capacity_factor=1.25):
@@ -67,7 +69,8 @@ class MoELayer(Layer):
         if isinstance(gate, dict):
             gate = _GateSpec(gate.get("top_k", 2),
                              gate.get("capacity_factor", 1.25),
-                             gate.get("norm_topk_prob", True))
+                             gate.get("norm_topk_prob", True),
+                             gate.get("dropless", False))
         self.gate = gate
         init = I.XavierNormal()
         self.router_weight = self.create_parameter(
@@ -180,6 +183,21 @@ class MoELayer(Layer):
             out, aux, z = apply(fn, x, self.router_weight, self.w_gate,
                                 self.w_up, self.w_down, n_outputs=3,
                                 name="moe_layer_ep")
+        elif getattr(self.gate, "dropless", False):
+            # MegaBlocks-style dropless dispatch over the Pallas grouped
+            # matmul: no capacity, no drops, <= E*bm padding rows (vs
+            # cf x T*k padded slots for the capacity path). Single-device
+            # / GSPMD path; EP keeps the capacity all-to-all (per-device
+            # quotas are what bound the a2a payload there).
+            def fn(xx, rw, wg, wu, wd):
+                flat = xx.reshape(-1, d)
+                y, aux, z = moe_ops.moe_forward_dropless(
+                    flat, rw, wg, wu, wd, k=k, norm_topk_prob=ntp)
+                return y.reshape(xx.shape), aux, z
+
+            out, aux, z = apply(fn, x, self.router_weight, self.w_gate,
+                                self.w_up, self.w_down, n_outputs=3,
+                                name="moe_layer_dropless")
         else:
             def fn(xx, rw, wg, wu, wd):
                 flat = xx.reshape(-1, d)

@@ -330,6 +330,13 @@ class DeepseekV2DecoderLayer(nn.Layer):
 class DeepseekV2ForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, config: DeepseekV2Config):
         super().__init__()
+        if config.use_recompute and config.router_aux_loss_coef:
+            raise ValueError(
+                "router_aux_loss_coef > 0 with use_recompute=True is "
+                "unsupported: the per-layer aux-loss attribute cannot "
+                "cross the jax.checkpoint boundary (the stored tracer "
+                "would leak). Set router_aux_loss_coef=0.0 or "
+                "use_recompute=False.")
         self.config = config
         init = nn.initializer.Normal(0.0, config.initializer_range)
         if config.tensor_parallel:

@@ -75,6 +75,12 @@ class Qwen2MoeConfig(Qwen2Config):
     norm_topk_prob: bool = False
     router_aux_loss_coef: float = 0.001
     capacity_factor: float = 2.0
+    #: MegaBlocks-style dropless dispatch (Pallas grouped matmul): no
+    #: capacity, no token drops, and only ~E*128 padding rows of extra
+    #: expert compute vs capacity_factor x T*k padded slots. Single
+    #: device / GSPMD; under ep_degree > 1 MoELayer keeps the capacity
+    #: all-to-all (per-device quotas bound the a2a payload).
+    moe_dropless: bool = False
 
     @classmethod
     def qwen2_moe_a14b(cls):
@@ -181,7 +187,8 @@ class Qwen2MoeBlock(nn.Layer):
             cfg.hidden_size, cfg.moe_intermediate_size, cfg.num_experts,
             gate={"top_k": cfg.num_experts_per_tok,
                   "capacity_factor": cfg.capacity_factor,
-                  "norm_topk_prob": cfg.norm_topk_prob})
+                  "norm_topk_prob": cfg.norm_topk_prob,
+                  "dropless": getattr(cfg, "moe_dropless", False)})
         self.shared_expert = Qwen2MLP(
             cfg, intermediate=cfg.shared_expert_intermediate_size)
         self.shared_expert_gate = nn.Linear(cfg.hidden_size, 1,
@@ -223,6 +230,14 @@ class Qwen2DecoderLayer(nn.Layer):
 class _Qwen2Base(nn.Layer, GenerationMixin):
     def __init__(self, cfg, moe: bool):
         super().__init__()
+        if moe and cfg.use_recompute and \
+                getattr(cfg, "router_aux_loss_coef", 0.0):
+            raise ValueError(
+                "router_aux_loss_coef > 0 with use_recompute=True is "
+                "unsupported: the per-layer aux-loss attribute cannot "
+                "cross the jax.checkpoint boundary (the stored tracer "
+                "would leak). Set router_aux_loss_coef=0.0 or "
+                "use_recompute=False.")
         self.config = cfg
         self._moe = moe
         init = nn.initializer.Normal(0.0, cfg.initializer_range)

@@ -28,7 +28,8 @@ import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["top_k_gating", "top_k_gating_idx", "moe_dispatch_combine",
-           "moe_ffn_grouped", "moe_forward", "moe_forward_ep"]
+           "moe_ffn_grouped", "moe_forward", "moe_forward_ep",
+           "sort_rows_by_expert", "moe_forward_dropless"]
 
 
 def top_k_gating(logits, k, capacity, norm_topk_prob=True):
@@ -173,6 +174,88 @@ def moe_forward(x, router_w, expert_fn, k=2, capacity_factor=1.25,
     out = expert_fn(xd)                                 # [E, C, d]
     y = _combine_gather(out, slot, gate_vals, keep, x.dtype)
     return y, aux, z
+
+
+def sort_rows_by_expert(gate_idx, n_experts, bm=128):
+    """Expert-sorted, group-padded row layout for the Pallas grouped
+    matmul (``ops.pallas.grouped_matmul`` — see its layout contract).
+
+    gate_idx: [T, k] int32 expert assignments. Returns
+    (perm [R] int32, tile_gid [nr] int32, P) where R = T*k,
+    P = (ceil(R/bm) + n_experts) * bm (static), nr = P // bm, and
+    ``perm[r]`` is the padded-layout position of unsorted assignment
+    row r (rows of expert e occupy a contiguous, bm-aligned span;
+    every expert owns >= 1 tile so empty groups still flush their dw).
+
+    All index arithmetic is 1-D int32 (two small scatters); the [*, d]
+    data movement stays gathers — TPU-friendly."""
+    T, k = gate_idx.shape
+    R = T * k
+    E = n_experts
+    e_flat = gate_idx.reshape(-1).astype(jnp.int32)
+    order = jnp.argsort(e_flat, stable=True)        # sorted row -> row
+    e_sorted = e_flat[order]
+    counts = jnp.zeros((E,), jnp.int32).at[e_flat].add(1)
+    padded = jnp.maximum(-(-counts // bm) * bm, bm)  # >= 1 tile each
+    offs = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(counts)[:-1]])
+    offs_p = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(padded)[:-1]])
+    # padded position of sorted row j: group start + rank within group
+    pos_p = offs_p[e_sorted] + (
+        jnp.arange(R, dtype=jnp.int32) - offs[e_sorted])
+    # perm[r] = padded position of unsorted row r (invert the sort by
+    # scattering: perm[order[j]] = pos_p[j])
+    perm = jnp.zeros((R,), jnp.int32).at[order].set(pos_p)
+    # static capacity: sum(padded) <= R + E*bm, rounded up to a whole
+    # number of tiles (R itself need not be bm-aligned)
+    P = (-(-R // bm) + E) * bm
+    nr = P // bm
+    ends = jnp.cumsum(padded)
+    tile_gid = jnp.searchsorted(
+        ends, jnp.arange(nr, dtype=jnp.int32) * bm, side="right")
+    tile_gid = jnp.minimum(tile_gid, E - 1).astype(jnp.int32)
+    return perm, tile_gid, P
+
+
+def moe_forward_dropless(x, router_w, w_gate, w_up, w_down, k=2,
+                         norm_topk_prob=True, bm=128, act=jax.nn.silu):
+    """Dropless MoE block over the Pallas grouped matmul: x [T, d].
+
+    No capacity, no token drops (the MegaBlocks formulation,
+    SURVEY.md §2.3 EP row): assignment rows are expert-sorted into the
+    group-padded layout and the three SwiGLU matmuls run as grouped
+    MXU matmuls whose weight blocks change only at group boundaries.
+    Executed FLOPs exceed activated by <= E*bm/(T*k) padding (~6-12% at
+    bench shapes) vs capacity_factor× for the capacity path.
+    Returns (out [T, d], aux_loss, z_loss) like :func:`moe_forward`."""
+    from .pallas.grouped_matmul import grouped_matmul
+
+    T, d = x.shape
+    E = router_w.shape[1]
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gate_vals, gate_idx = lax.top_k(probs, k)          # [T, k]
+    if norm_topk_prob:
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.sum(jax.nn.one_hot(gate_idx, E), axis=(0, 1)) / (T * k)
+    aux = E * jnp.sum(me * ce)
+    z = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+
+    perm, tile_gid, P = sort_rows_by_expert(gate_idx, E, bm=bm)
+    # inverse map padded position -> source token (sentinel T = zero row)
+    src = jnp.full((P,), T, jnp.int32).at[perm].set(
+        jnp.arange(T * k, dtype=jnp.int32) // k)
+    x_pad = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)], axis=0)
+    x_p = x_pad[src]                                    # [P, d] gather
+    g = grouped_matmul(x_p, w_gate, tile_gid)
+    u = grouped_matmul(x_p, w_up, tile_gid)
+    y_p = grouped_matmul((act(g) * u).astype(x.dtype), w_down, tile_gid)
+    y_k = y_p[perm].reshape(T, k, d)                    # gather back
+    w = gate_vals.astype(y_k.dtype)[..., None]
+    return jnp.sum(y_k * w, axis=1).astype(x.dtype), aux, z
 
 
 def moe_forward_ep(x, router_w, expert_fn_local, axis_name, k=2,

@@ -133,6 +133,75 @@ def test_qwen2_moe_ep2_mp2_pp2():
         _reset()
 
 
+@pytest.mark.parametrize("schedule", ["1F1B", "ZB-H1"])
+def test_qwen2_moe_ep2_pp2_explicit_schedule(schedule):
+    """ep2 x pp2 under the explicit tick engines (1F1B / ZB-H1) — the
+    reference's MoE flagships train under 1F1B (SURVEY.md §2.3 EP row,
+    §3.4), so the production schedule x MoE cell must hold, not just the
+    compiled scan schedules. The tick engine keeps expert banks sharded
+    through its manual region (param_specs) and performs the ep-aware
+    reduction: shared-param grads come back expert-invariant via the
+    typed-vma transpose, bank grads stay local shards (zero_bubble.py
+    expert_axes). Oracle: the sequential eager microbatch loop on the
+    same Pipe model."""
+    import dataclasses
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
+    from paddle_tpu.models import Qwen2MoeForCausalLMPipe
+
+    def cfg():
+        return dataclasses.replace(
+            Qwen2MoeConfig.tiny(), num_hidden_layers=4,
+            capacity_factor=4.0, router_aux_loss_coef=0.0)
+
+    ids_np = np.random.RandomState(0).randint(
+        0, 256, (4, 16)).astype(np.int64)
+    steps = 2
+
+    paddle.seed(0)
+    ref_model = Qwen2MoeForCausalLMPipe(cfg())
+    ref_engine = PipelineParallel(ref_model, None, accumulate_steps=2)
+    ref_opt = paddle.optimizer.AdamW(
+        1e-3, parameters=ref_model.parameters())
+    ids_t = paddle.to_tensor(ids_np)
+    ref = [float(ref_engine.train_batch((ids_t, ids_t), ref_opt).item())
+           for _ in range(steps)]
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 1, "ep_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": schedule}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(0)
+        model = Qwen2MoeForCausalLMPipe(cfg())
+        engine = fleet.fleet.distributed_model(model)
+        assert isinstance(engine, PipelineParallel)
+        opt = fleet.fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(1e-3, parameters=model.parameters()))
+        losses = [float(engine.train_batch((ids_t, ids_t), opt).item())
+                  for _ in range(steps)]
+        np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-4)
+        # the ep-aware reduction's memory contract: expert banks AND
+        # their optimizer moments stay sharded over 'expert' after the
+        # step (E/ep per device) — a wrong psum would have desharded
+        # (grads replicated -> moments created replicated)
+        from paddle_tpu.incubate.distributed.models.moe import MoELayer
+        banks = [m.w_gate for l in model.run_function
+                 for m in l.sublayers(include_self=True)
+                 if isinstance(m, MoELayer)]
+        assert banks, "pipe model lost its MoE layers"
+        for bank in banks:
+            assert "expert" in str(bank._data.sharding.spec), \
+                bank._data.sharding
+            m1 = opt._acc("moment1", bank)  # HybridParallelOptimizer
+            assert "expert" in str(m1._data.sharding.spec), \
+                m1._data.sharding             # delegates to the inner opt
+    finally:
+        _reset()
+
+
 def test_deepseek_ep2_mp2():
     """DeepSeek-V2 fine-grained MoE under ep2 x mp2: MLA attention
     TP-sharded while routed+shared experts dispatch over 'expert'."""

@@ -1,0 +1,156 @@
+"""Dropless grouped-matmul MoE (MegaBlocks formulation, SURVEY.md §2.3
+EP row "Megablocks-style Pallas grouped matmul"): numeric + gradient
+parity of the Pallas kernels (interpret mode on CPU) and of the dropless
+forward against the capacity path with generous capacity (same routing,
+no drops on either side => identical math)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from paddle_tpu.ops import moe as moe_ops
+from paddle_tpu.ops.pallas.grouped_matmul import (grouped_dw,
+                                                  grouped_matmul,
+                                                  grouped_matmul_t)
+
+
+def _layout(gate_idx, E, bm):
+    perm, tile_gid, P = moe_ops.sort_rows_by_expert(gate_idx, E, bm=bm)
+    return np.asarray(perm), np.asarray(tile_gid), P
+
+
+def test_sort_rows_layout():
+    """Every row lands in a tile owned by its expert; tiles are
+    bm-aligned, non-decreasing, and every expert owns >= 1 tile."""
+    rng = np.random.RandomState(0)
+    E, bm, T, k = 5, 8, 33, 2
+    gate_idx = jnp.asarray(rng.randint(0, E, (T, k)).astype(np.int32))
+    perm, tile_gid, P = _layout(gate_idx, E, bm)
+    assert P % bm == 0 and P >= T * k and len(tile_gid) == P // bm
+    assert (np.diff(tile_gid) >= 0).all()
+    assert set(range(E)) <= set(tile_gid.tolist())
+    e_flat = np.asarray(gate_idx).reshape(-1)
+    assert len(set(perm.tolist())) == len(perm)  # injective
+    for r, p in enumerate(perm):
+        assert tile_gid[p // bm] == e_flat[r], (r, p)
+
+
+def test_grouped_matmul_numeric_and_grad():
+    rng = np.random.RandomState(1)
+    E, bm, d, h = 4, 8, 16, 24
+    T, k = 20, 2
+    gate_idx = jnp.asarray(rng.randint(0, E, (T, k)).astype(np.int32))
+    perm, tile_gid, P = _layout(gate_idx, E, bm)
+    x = jnp.asarray(rng.randn(P, d).astype(np.float32))
+    w = jnp.asarray(rng.randn(E, d, h).astype(np.float32))
+    gid = jnp.asarray(tile_gid)
+
+    y = grouped_matmul(x, w, gid, bn=8)
+    # reference: per-row dense matmul with that row's expert
+    row_e = np.repeat(tile_gid, bm)
+    ref = np.einsum("td,tdh->th", np.asarray(x),
+                    np.asarray(w)[row_e])
+    np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-4)
+
+    # transpose form
+    dy = jnp.asarray(rng.randn(P, h).astype(np.float32))
+    dx = grouped_matmul_t(dy, w, gid, bn=8)
+    ref_dx = np.einsum("th,tdh->td", np.asarray(dy), np.asarray(w)[row_e])
+    np.testing.assert_allclose(np.asarray(dx), ref_dx, rtol=1e-5,
+                               atol=1e-4)
+
+    # dw kernel (incl. an expert with zero rows: E index 3 may be empty)
+    dw = grouped_dw(x, dy, gid, E, bd=8, bh=8)
+    ref_dw = np.zeros((E, d, h), np.float32)
+    for t in range(P):
+        ref_dw[row_e[t]] += np.outer(np.asarray(x)[t], np.asarray(dy)[t])
+    np.testing.assert_allclose(np.asarray(dw), ref_dw, rtol=1e-5,
+                               atol=1e-3)
+
+    # custom-vjp wiring end to end
+    def loss(x, w):
+        return jnp.sum(grouped_matmul(x, w, gid, bn=8) * dy)
+
+    gx, gw = jax.grad(loss, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx), ref_dx, rtol=1e-5,
+                               atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gw), ref_dw, rtol=1e-5,
+                               atol=1e-3)
+
+
+def test_dropless_matches_capacity_path():
+    """With capacity high enough that nothing drops, the capacity path
+    and the dropless grouped path compute the same function — outputs
+    AND router/weight grads."""
+    rng = np.random.RandomState(2)
+    T, d, h, E, k = 32, 16, 24, 4, 2
+    x = jnp.asarray(rng.randn(T, d).astype(np.float32))
+    rw = jnp.asarray(rng.randn(d, E).astype(np.float32) * 0.1)
+    wg = jnp.asarray(rng.randn(E, d, h).astype(np.float32) * 0.1)
+    wu = jnp.asarray(rng.randn(E, d, h).astype(np.float32) * 0.1)
+    wd = jnp.asarray(rng.randn(E, h, d).astype(np.float32) * 0.1)
+
+    def f_cap(x, rw, wg, wu, wd):
+        y, aux, z = moe_ops.moe_forward(
+            x, rw, lambda t: moe_ops.moe_ffn_grouped(t, wg, wu, wd),
+            k=k, capacity_factor=float(E), norm_topk_prob=True)
+        return y, aux, z
+
+    def f_drop(x, rw, wg, wu, wd):
+        return moe_ops.moe_forward_dropless(
+            x, rw, wg, wu, wd, k=k, norm_topk_prob=True, bm=8)
+
+    y1, aux1, z1 = f_cap(x, rw, wg, wu, wd)
+    y2, aux2, z2 = f_drop(x, rw, wg, wu, wd)
+    np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(float(aux1), float(aux2), rtol=1e-5)
+    np.testing.assert_allclose(float(z1), float(z2), rtol=1e-5)
+
+    def loss(fn, *args):
+        y, aux, z = fn(*args)
+        return jnp.sum(y * y) + aux + 0.1 * z
+
+    g1 = jax.grad(lambda *a: loss(f_cap, *a), argnums=(0, 1, 2, 3, 4))(
+        x, rw, wg, wu, wd)
+    g2 = jax.grad(lambda *a: loss(f_drop, *a), argnums=(0, 1, 2, 3, 4))(
+        x, rw, wg, wu, wd)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_dropless_no_drops_vs_tight_capacity():
+    """The point of dropless: a skewed routing that drops tokens under
+    cf=1 keeps them all under the grouped path (outputs differ from the
+    capacity path exactly on the dropped assignments)."""
+    rng = np.random.RandomState(3)
+    T, d, h, E, k = 16, 8, 12, 4, 1
+    x = jnp.asarray(rng.randn(T, d).astype(np.float32))
+    rw = jnp.asarray(rng.randn(d, E).astype(np.float32))  # skewed enough
+    wg = jnp.asarray(rng.randn(E, d, h).astype(np.float32) * 0.1)
+    wu = jnp.asarray(rng.randn(E, d, h).astype(np.float32) * 0.1)
+    wd = jnp.asarray(rng.randn(E, h, d).astype(np.float32) * 0.1)
+
+    y_cap, _, _ = moe_ops.moe_forward(
+        x, rw, lambda t: moe_ops.moe_ffn_grouped(t, wg, wu, wd),
+        k=k, capacity_factor=1.0, norm_topk_prob=False)
+    y_drop, _, _ = moe_ops.moe_forward_dropless(
+        x, rw, wg, wu, wd, k=k, norm_topk_prob=False, bm=8)
+    # expected drops from the actual routing: per-expert overflow past
+    # the cf=1 capacity (queue order = token order at k=1)
+    cap = max(int(1.0 * k * T / E), 1)
+    e_of = np.argmax(np.asarray(x @ rw), axis=1)
+    seen = {e: 0 for e in range(E)}
+    dropped = np.zeros(T, bool)
+    for t in range(T):
+        dropped[t] = seen[e_of[t]] >= cap
+        seen[e_of[t]] += 1
+    assert dropped.any(), "fixture not skewed enough to drop"
+    # capacity path zeroed the overflow tokens; dropless kept them
+    np.testing.assert_array_equal(
+        np.abs(np.asarray(y_cap)).sum(-1) == 0, dropped)
+    kept_out = np.abs(np.asarray(y_drop)).sum(-1)
+    assert (kept_out[dropped] > 0).all()
