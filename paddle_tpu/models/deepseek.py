@@ -330,13 +330,6 @@ class DeepseekV2DecoderLayer(nn.Layer):
 class DeepseekV2ForCausalLM(nn.Layer, GenerationMixin):
     def __init__(self, config: DeepseekV2Config):
         super().__init__()
-        if config.use_recompute and config.router_aux_loss_coef:
-            raise ValueError(
-                "router_aux_loss_coef > 0 with use_recompute=True is "
-                "unsupported: the per-layer aux-loss attribute cannot "
-                "cross the jax.checkpoint boundary (the stored tracer "
-                "would leak). Set router_aux_loss_coef=0.0 or "
-                "use_recompute=False.")
         self.config = config
         init = nn.initializer.Normal(0.0, config.initializer_range)
         if config.tensor_parallel:
@@ -389,6 +382,15 @@ class DeepseekV2ForCausalLM(nn.Layer, GenerationMixin):
                 else matmul(hidden, self.embed_tokens.weight,
                             transpose_y=True)
             return logits, new_caches
+        if self.training and self.config.use_recompute and \
+                self.config.router_aux_loss_coef:
+            # see qwen2.py: the per-layer aux attribute cannot cross the
+            # jax.checkpoint boundary; fail clearly, not as a leaked
+            # tracer (inference-only use of a training config is fine)
+            raise ValueError(
+                "router_aux_loss_coef > 0 with use_recompute=True is "
+                "unsupported for training: set router_aux_loss_coef=0.0 "
+                "or use_recompute=False.")
         for layer in self.layers:
             if self.config.use_recompute and self.training:
                 from ..incubate.recompute import recompute

@@ -230,14 +230,6 @@ class Qwen2DecoderLayer(nn.Layer):
 class _Qwen2Base(nn.Layer, GenerationMixin):
     def __init__(self, cfg, moe: bool):
         super().__init__()
-        if moe and cfg.use_recompute and \
-                getattr(cfg, "router_aux_loss_coef", 0.0):
-            raise ValueError(
-                "router_aux_loss_coef > 0 with use_recompute=True is "
-                "unsupported: the per-layer aux-loss attribute cannot "
-                "cross the jax.checkpoint boundary (the stored tracer "
-                "would leak). Set router_aux_loss_coef=0.0 or "
-                "use_recompute=False.")
         self.config = cfg
         self._moe = moe
         init = nn.initializer.Normal(0.0, cfg.initializer_range)
@@ -263,6 +255,18 @@ class _Qwen2Base(nn.Layer, GenerationMixin):
 
     def forward(self, input_ids, labels=None, caches=None, pos=None,
                 tables=None):
+        if self._moe and self.training and self.config.use_recompute \
+                and self.config.router_aux_loss_coef:
+            # raised here (where recompute actually wraps the layers),
+            # not at construction: inference-only use of a training
+            # config is fine. Without this check the failure is an
+            # opaque escaped-tracer error deep in tracing.
+            raise ValueError(
+                "router_aux_loss_coef > 0 with use_recompute=True is "
+                "unsupported for training: the per-layer aux-loss "
+                "attribute cannot cross the jax.checkpoint boundary "
+                "(the stored tracer would leak). Set "
+                "router_aux_loss_coef=0.0 or use_recompute=False.")
         x = self.embed_tokens(input_ids)
         if caches is not None:
             new_caches = []

@@ -234,15 +234,11 @@ def moe_forward_dropless(x, router_w, w_gate, w_up, w_down, k=2,
     T, d = x.shape
     E = router_w.shape[1]
     logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
-    gate_vals, gate_idx = lax.top_k(probs, k)          # [T, k]
-    if norm_topk_prob:
-        gate_vals = gate_vals / jnp.maximum(
-            jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
-    me = jnp.mean(probs, axis=0)
-    ce = jnp.sum(jax.nn.one_hot(gate_idx, E), axis=(0, 1)) / (T * k)
-    aux = E * jnp.sum(me * ce)
-    z = jnp.mean(jnp.square(jax.nn.logsumexp(logits, axis=-1)))
+    # capacity = T*k keeps every assignment (pos < T*k always): the
+    # SAME router math as the capacity paths by construction — the
+    # dropless-vs-capacity equivalence tests rest on this sharing
+    gate_idx, gate_vals, _pos, _keep, aux, z = top_k_gating_idx(
+        logits, k, capacity=T * k, norm_topk_prob=norm_topk_prob)
 
     perm, tile_gid, P = sort_rows_by_expert(gate_idx, E, bm=bm)
     # inverse map padded position -> source token (sentinel T = zero row)

@@ -39,6 +39,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
@@ -175,25 +176,32 @@ def grouped_dw(x, dy, tile_gid, n_experts, bd=512, bh=2048):
     return _dw_call(x, dy, tile_gid, n_experts, bd=bd, bh=bh)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _gmm_core(x, w, tile_gid, bn):
+    return _gmm_call(x, w, tile_gid, transpose_rhs=False, bn=bn)
+
+
+def _gmm_core_fwd(x, w, tile_gid, bn):
+    return _gmm_core(x, w, tile_gid, bn), (x, w, tile_gid)
+
+
+def _gmm_core_bwd(bn, res, dy):
+    x, w, tile_gid = res
+    dx = grouped_matmul_t(dy, w, tile_gid, bn=bn)
+    dw = grouped_dw(x, dy, tile_gid, w.shape[0])
+    # tile_gid is routing data: int32 primal -> float0 cotangent
+    return dx, dw.astype(w.dtype), np.zeros(tile_gid.shape,
+                                            jax.dtypes.float0)
+
+
+_gmm_core.defvjp(_gmm_core_fwd, _gmm_core_bwd)
+
+
 def grouped_matmul(x, w, tile_gid, bn=2048):
     """Differentiable grouped matmul: y[t] = x[t] @ w[tile_gid(t//bm)].
 
-    tile_gid is routing data (int32, non-differentiable); closing the
-    custom_vjp over it keeps the primal signature (x, w) so cotangents
-    line up without float0 bookkeeping."""
-
-    @jax.custom_vjp
-    def gmm(x, w):
-        return _gmm_call(x, w, tile_gid, transpose_rhs=False, bn=bn)
-
-    def fwd(x, w):
-        return gmm(x, w), (x, w)
-
-    def bwd(res, dy):
-        x, w = res
-        dx = grouped_matmul_t(dy, w, tile_gid, bn=bn)
-        dw = grouped_dw(x, dy, tile_gid, w.shape[0])
-        return dx, dw.astype(w.dtype)
-
-    gmm.defvjp(fwd, bwd)
-    return gmm(x, w)
+    tile_gid rides the custom_vjp as an explicit primal (saved in
+    residuals) — a closure over it would leak its tracer across
+    jax.checkpoint boundaries (use_recompute re-runs the bwd in a
+    fresh trace)."""
+    return _gmm_core(x, w, tile_gid, bn)
