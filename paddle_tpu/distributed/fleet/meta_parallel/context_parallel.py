@@ -101,8 +101,18 @@ def sep_attention(q, k, v, causal=True, scale=None, impl="ring",
     elif impl == "ulysses":
         core = lambda a, b, c: ra.ulysses_attention(
             a, b, c, axis, causal=causal, scale=scale)
+    elif impl == "allgather":
+        core = lambda a, b, c: ra.allgather_attention(
+            a, b, c, axis, causal=causal, scale=scale)
     else:
         raise ValueError(f"unknown sep impl {impl!r}")
+    if impl != "ring" and placement != "contiguous":
+        # zigzag is the ring's causal load-balancing layout; the other
+        # impls assume contiguous global positions — silently wrong
+        # masking otherwise
+        raise ValueError(
+            f"placement={placement!r} is only supported with "
+            f"impl='ring' (got impl={impl!r})")
 
     def fn(qq, kk, vv):
         f = _jax.shard_map(core, mesh=mesh, in_specs=(spec, spec, spec),
@@ -166,6 +176,9 @@ def sep_attention_manual(q, k, v, rope_theta, causal=True,
         if impl == "ulysses":
             return ra.ulysses_attention(qq, kk, vv, axis, causal=causal,
                                         scale=scale)
+        if impl == "allgather":
+            return ra.allgather_attention(qq, kk, vv, axis, causal=causal,
+                                          scale=scale)
         raise ValueError(f"unknown sep impl {impl!r}")
 
     return apply(fn, q, k, v, name=f"sep_attention_manual_{impl}")

@@ -139,18 +139,21 @@ class PipelineParallel:
                 raise ValueError(
                     "ring context parallelism under the explicit "
                     "1F1B/ZB-H1 engines is not supported: the ring's "
-                    "ppermute scan sits inside the tick machine's "
-                    "pipe-varying lax.switch, whose all-branches-and-"
-                    "select lowering collapses the sep rotation "
-                    "(measured: one rank's chunk duplicated). Use "
-                    "sep_parallel='ulysses' (supported under every "
-                    "schedule) or the scan schedules "
-                    "(FThenB/interleaved) for ring")
+                    "ppermute rotation scan sits inside the tick "
+                    "machine's pipe-varying lax.switch, which breaks "
+                    "the rotation (measured round 4: one rank's chunk "
+                    "duplicated; round 5 re-probe: NaN loss — see "
+                    "docs/ring_under_tick_engines.md). Use "
+                    "sep_parallel='allgather' (gathered-K/V CP, "
+                    "unbounded degree) or 'ulysses' (degree <= "
+                    "num_heads) — both supported under every schedule "
+                    "— or the scan schedules (FThenB/interleaved) "
+                    "for ring")
 
     def _sep_impl(self):
-        """The stage layers' sep attention impl ('ring' | 'ulysses'),
-        or None — the single config walk both _sep_axes and the
-        schedule validation derive from."""
+        """The stage layers' sep attention impl ('ring' | 'ulysses' |
+        'allgather'), or None — the single config walk both _sep_axes
+        and the schedule validation derive from."""
         for l in self._layers.run_function:
             cfg = getattr(l, "cfg", None) or getattr(l, "config", None)
             impl = getattr(cfg, "sep_parallel", None) if cfg else None

@@ -39,6 +39,7 @@ from jax import lax
 __all__ = [
     "ring_attention",
     "ulysses_attention",
+    "allgather_attention",
     "chunked_attention",
     "zigzag_reorder",
     "zigzag_restore",
@@ -165,6 +166,66 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
         k_pos0,
     )
     (acc, m, l, *_), _ = lax.scan(step, carry0, None, length=n)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.swapaxes(out, 1, 2).astype(orig_dtype)
+
+
+def allgather_attention(q, k, v, axis_name, causal=False, scale=None):
+    """Context parallelism via K/V all-gather (the Llama-3-style CP):
+    each rank attends its LOCAL query chunk against the FULL gathered
+    K/V with global positions.
+
+    vs ring: one ``lax.all_gather`` instead of a ppermute rotation scan —
+    no rotation state, so it is safe inside the explicit pipeline tick
+    engines' pipe-varying ``lax.switch`` branches where the ring's
+    rotation collapses (see docs/ring_under_tick_engines.md). Degree is
+    unbounded (Ulysses is capped at num_heads). COST: K/V memory is the
+    GLOBAL sequence per device (the ring keeps S_local) and the gather
+    is one S_global transfer instead of overlapped S_local hops.
+    """
+    orig_dtype = q.dtype
+    n = lax.psum(1, axis_name)
+    idx = lax.axis_index(axis_name)
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    q_pos = idx * sq + jnp.arange(sq, dtype=jnp.int32)
+    # gather the COMPACT kv heads, repeat GQA only on the local view —
+    # the gather is this impl's stated cost, don't inflate it H/H_kv x
+    k_full = lax.all_gather(k, axis_name, axis=1, tiled=True)
+    v_full = lax.all_gather(v, axis_name, axis=1, tiled=True)
+    k_full, v_full = _repeat_kv(q, k_full, v_full)
+    qf = q.astype(jnp.float32)
+
+    # online-softmax over S_local-sized chunks of the gathered K/V (the
+    # ring's merge math without rotation state): peak score memory is
+    # O(Sq_local x Sk_local), not O(Sq_local x S_global)
+    def step(carry, j):
+        acc, m, l = carry
+        k_c = lax.dynamic_slice_in_dim(k_full, j * sk, sk, 1)
+        v_c = lax.dynamic_slice_in_dim(v_full, j * sk, sk, 1)
+        kp = j * sk + jnp.arange(sk, dtype=jnp.int32)
+        m_j, l_j, acc_j = _chunk_partials(qf, k_c.astype(jnp.float32),
+                                          v_c.astype(jnp.float32),
+                                          q_pos, kp, s, causal)
+        m_new = jnp.maximum(m, m_j)
+        alpha = jnp.exp(m - m_new)
+        beta = jnp.exp(m_j - m_new)
+        acc = acc * alpha[..., None] + acc_j * beta[..., None]
+        l = l * alpha + l_j * beta
+        return (acc, m_new, l), None
+
+    from ..framework._vma import pvary_missing
+
+    def _vary(x):
+        return pvary_missing(x, (axis_name,), like=qf)
+
+    carry0 = (
+        _vary(jnp.zeros((b, h, sq, d), jnp.float32)),
+        _vary(jnp.full((b, h, sq), _NEG_INF, jnp.float32)),
+        _vary(jnp.zeros((b, h, sq), jnp.float32)),
+    )
+    (acc, m, l), _ = lax.scan(step, carry0, jnp.arange(n))
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return jnp.swapaxes(out, 1, 2).astype(orig_dtype)
 

@@ -463,12 +463,14 @@ def test_hybrid_dp2_explicit_schedules(schedule):
 # --------------------------------------------------------------------------
 
 # ring composes with the SCAN schedules; the explicit engines run sep
-# via ULYSSES (the ring's ppermute scan inside the tick machine's
-# pipe-varying lax.switch collapses under all-branches-and-select
-# lowering — rejected with a clear error, tested below)
+# via ULYSSES (head-bounded degree) or ALLGATHER (gathered-K/V CP,
+# unbounded degree) — the ring's ppermute rotation scan inside the tick
+# machine's pipe-varying lax.switch breaks (rejected with a clear
+# error, tested below; docs/ring_under_tick_engines.md)
 @pytest.mark.parametrize("schedule,impl",
                          [("FThenB", "ring"), ("interleaved", "ring"),
-                          ("1F1B", "ulysses"), ("ZB-H1", "ulysses")])
+                          ("1F1B", "ulysses"), ("ZB-H1", "ulysses"),
+                          ("1F1B", "allgather"), ("ZB-H1", "allgather")])
 def test_hybrid_5d_pipeline_sep_llama_parity(schedule, impl):
     """pp2 x mp2 x sep2 over 8 devices in ONE compiled program: the
     pipeline's shard_map binds BOTH 'pipe' and 'sep', the decoder
@@ -517,6 +519,57 @@ def test_hybrid_5d_pipeline_sep_llama_parity(schedule, impl):
             jnp.asarray(ids_np),
             NamedSharding(mesh, PartitionSpec(("data", "sharding"),
                                               "sep")))
+        ids_p = paddle.Tensor(ids)
+        losses = [float(engine.train_batch((ids_p, ids_p), opt).item())
+                  for _ in range(steps)]
+        np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-5)
+    finally:
+        fleet.fleet._hcg = None
+        fleet.fleet._topology = None
+        fleet.fleet._is_initialized = False
+
+
+@pytest.mark.parametrize("impl", ["ulysses", "allgather"])
+def test_sep4_explicit_1f1b_parity(impl):
+    """sep degree 4 under the explicit 1F1B engine (pp2 x sep4 over 8
+    devices): widens the sep evidence beyond degree 2 — ulysses at its
+    num_heads bound (4 heads / sep4), and allgather past where ulysses
+    could go if heads were fewer."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLMPipe
+
+    def cfg(par):
+        return LlamaConfig(vocab_size=128, hidden_size=64,
+                           num_hidden_layers=2, num_attention_heads=4,
+                           num_key_value_heads=2, intermediate_size=128,
+                           max_position_embeddings=32, rope_theta=10000.0,
+                           tensor_parallel=False,
+                           sep_parallel=impl if par else None)
+
+    ids_np = np.random.RandomState(0).randint(
+        0, 128, (4, 32)).astype(np.int64)
+    steps = 2
+    ref = _llama_ref_losses(lambda: cfg(False), ids_np, steps)
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 4, "ep_degree": 1}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": "1F1B"}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        hcg = fleet.get_hybrid_communicate_group()
+        paddle.seed(0)
+        model = LlamaForCausalLMPipe(cfg(True))
+        engine = fleet.fleet.distributed_model(model)
+        assert isinstance(engine, PipelineParallel)
+        opt = fleet.fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(1e-3, parameters=model.parameters()))
+        ids = jax.device_put(
+            jnp.asarray(ids_np),
+            NamedSharding(hcg.global_mesh,
+                          PartitionSpec(None, "sep")))
         ids_p = paddle.Tensor(ids)
         losses = [float(engine.train_batch((ids_p, ids_p), opt).item())
                   for _ in range(steps)]

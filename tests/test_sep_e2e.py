@@ -38,7 +38,7 @@ def _tiny_cfg():
                        rope_theta=10000.0, tensor_parallel=False)
 
 
-@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize("impl", ["ring", "ulysses", "allgather"])
 def test_llama_sep_loss_parity(sep_fleet, impl):
     cfg = _tiny_cfg()
     paddle.seed(0)
