@@ -346,10 +346,14 @@ def _moe_train_bench(on_tpu, dev):
             moe_intermediate_size=1408,
             shared_expert_intermediate_size=2816,
             capacity_factor=2.0, scan_layers=False,
+            # dropless grouped-matmul dispatch (Pallas): kills the
+            # cf=2.0 capacity padding (2x executed expert FLOPs) for
+            # ~12% tile padding. Measured round 5: 235 ms/step, 38.3%
+            # MFU vs 34.6-37.3 capacity
+            moe_dropless=True,
             use_recompute=True,
             # remat dose: every 2nd layer saves its activations whole —
-            # +1.9 to +4.6 MFU over full recompute (32.7 -> 34.6-37.3
-            # across tunnel variance); fs=1 (no remat) OOMs 16GB
+            # fs=1 / batch 6-8 still OOM 16GB even dropless (measured)
             full_save_interval=2,
             # aux folded out: the per-layer aux attribute cannot cross
             # the recompute boundary (see qwen2.py); router still trains
@@ -492,9 +496,23 @@ def main():
     on_tpu = dev.platform.lower() in ("tpu", "axon")
 
     import gc
+    suffix = "" if on_tpu else "_cpu_smoke"
+    # The running record is re-printed after EVERY completed section:
+    # whichever complete JSON line is last when the driver's time limit
+    # hits carries everything measured so far. Round-4's record printed
+    # only at the very end — one slow section erased every completed
+    # metric (BENCH_r04.json parsed:null).
     n_params, train_tok_s, mfu = _timed_section(
         "train", lambda: _retry_transient(
             lambda: _train_bench(on_tpu, dev), "train bench"))
+    record = {
+        "metric": f"llama_{n_params/1e9:.2f}B_fwd_bwd_bf16_tokens_per_sec"
+                  + suffix,
+        "value": round(train_tok_s, 2),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.40, 4),
+    }
+    print(json.dumps(record), flush=True)
     gc.collect()
     try:
         decode_tok_s = _timed_section(
@@ -503,6 +521,11 @@ def main():
     except Exception as e:  # decode is secondary: never sink the headline
         print(f"# decode bench failed: {e!r}", file=sys.stderr)
         decode_tok_s = None
+    if decode_tok_s is not None:
+        record["decode_metric"] = "llama_1B_kv_cache_greedy_decode" + suffix
+        record["decode_value"] = round(decode_tok_s, 2)
+        record["decode_unit"] = "tokens/s/chip"
+        print(json.dumps(record), flush=True)
     gc.collect()
     try:
         cb_tok_s = _timed_section(
@@ -511,30 +534,13 @@ def main():
     except Exception as e:
         print(f"# continuous-batching bench failed: {e!r}", file=sys.stderr)
         cb_tok_s = None
-    gc.collect()
-
-    suffix = "" if on_tpu else "_cpu_smoke"
-    record = {
-        "metric": f"llama_{n_params/1e9:.2f}B_fwd_bwd_bf16_tokens_per_sec"
-                  + suffix,
-        "value": round(train_tok_s, 2),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / 0.40, 4),
-    }
-    if decode_tok_s is not None:
-        record["decode_metric"] = "llama_1B_kv_cache_greedy_decode" + suffix
-        record["decode_value"] = round(decode_tok_s, 2)
-        record["decode_unit"] = "tokens/s/chip"
     if cb_tok_s is not None:
         record["cb_metric"] = ("llama_1B_continuous_batching_mixed_lengths"
                                + suffix)
         record["cb_value"] = round(cb_tok_s, 2)
         record["cb_unit"] = "tokens/s/chip"
-    # Print the core record NOW: if a later (MoE) section overruns the
-    # driver's time limit, this line is still on stdout and parseable.
-    # Round-4's record printed only at the very end — one slow section
-    # erased every completed metric (BENCH_r04.json parsed:null).
-    print(json.dumps(record), flush=True)
+        print(json.dumps(record), flush=True)
+    gc.collect()
 
     try:
         moe_params, moe_tok_s, moe_mfu = _timed_section(

@@ -57,7 +57,9 @@ class LlamaConfig:
     # 0 = off — the remat-dose knob for spending leftover HBM on speed
     full_save_interval: int = 0
     tensor_parallel: bool = True  # use TP layers (degenerate w/o mesh)
-    # context parallelism over the 'sep' mesh axis: None | "ring" | "ulysses"
+    # context parallelism over the 'sep' mesh axis:
+    # None | "ring" | "ulysses" | "allgather" (gathered-K/V CP — the
+    # impl that also runs under the explicit 1F1B/ZB-H1 engines)
     sep_parallel: str | None = None
     # Megatron-style SP: keep LN/residual activations sequence-sharded over
     # the 'model' axis (memory win; XLA inserts the gathers)
@@ -385,24 +387,26 @@ class LlamaModel(nn.Layer):
         from ..nn.scan import scan_layers, can_scan
         if getattr(self.config, "scan_layers", True) and \
                 can_scan(self.layers):
-            if ((getattr(self.config, "recompute_granularity", "full")
+            if (getattr(self.config, "recompute_granularity", "full")
                     != "full"
-                    or getattr(self.config, "full_save_interval", 0))
                     and self.config.use_recompute
                     and self.training):
                 import warnings
                 warnings.warn(
-                    "recompute_granularity / full_save_interval are "
-                    "ignored under scan_layers=True (the scan body "
-                    "remats whole layers); set scan_layers=False for "
-                    "selective remat",
+                    "recompute_granularity is ignored under "
+                    "scan_layers=True (the scan body remats whole "
+                    "layers); set scan_layers=False for selective remat",
                     stacklevel=2)
             # one lax.scan over stacked per-layer weights: code size (the
             # measured TPU bottleneck for unrolled stacks) stays that of
-            # a single layer; remat folds in as checkpointed scan body
+            # a single layer; remat folds in as checkpointed scan body,
+            # and the remat DOSE (full_save_interval) as fs-layer scan
+            # groups whose last layer saves whole (nn/scan.py)
             x = scan_layers(self.layers, x,
                             remat=self.config.use_recompute
-                            and self.training)
+                            and self.training,
+                            full_save_interval=getattr(
+                                self.config, "full_save_interval", 0))
         else:
             gran = getattr(self.config, "recompute_granularity", "full")
             if gran not in ("full", "core_attn", "full_attn"):

@@ -284,16 +284,10 @@ class _Qwen2Base(nn.Layer, GenerationMixin):
         # eagerly after the stack (and experts route via shard_map)
         if getattr(self.config, "scan_layers", True) and \
                 not self._moe and can_scan(self.layers):
-            if getattr(self.config, "full_save_interval", 0) and \
-                    self.config.use_recompute and self.training:
-                import warnings
-                warnings.warn(
-                    "full_save_interval is ignored under "
-                    "scan_layers=True (the scan body remats whole "
-                    "layers); set scan_layers=False for the remat dose",
-                    stacklevel=2)
             x = _scan(self.layers, x,
-                      remat=self.config.use_recompute and self.training)
+                      remat=self.config.use_recompute and self.training,
+                      full_save_interval=getattr(
+                          self.config, "full_save_interval", 0))
         else:
             # remat DOSE (same knob as LlamaConfig.full_save_interval):
             # every k-th layer keeps activations whole instead of

@@ -53,7 +53,8 @@ def can_scan(layers):
     return len(sig0[1]) > 0
 
 
-def scan_layers(layers, x, extra_inputs=(), remat=False):
+def scan_layers(layers, x, extra_inputs=(), remat=False,
+                full_save_interval=0):
     """Run ``x -> layers[L-1](...layers[0](x))`` as one lax.scan.
 
     layers: sequence of structurally identical Layers.
@@ -62,6 +63,14 @@ def scan_layers(layers, x, extra_inputs=(), remat=False):
       (e.g. an attention mask).
     remat: rematerialize each layer in backward (per-layer activation
       checkpointing).
+    full_save_interval (fs, with remat): the remat DOSE under the scan —
+      every fs-th layer keeps its activations whole instead of
+      recomputing, same knob as the unrolled path. Realized by scanning
+      over L/fs GROUPS of fs layers: the group body runs fs layers with
+      the first fs-1 under jax.checkpoint and the group-last saved
+      (per-iteration save structure must be static, so the dose is the
+      group shape, not a per-iteration predicate). Requires L % fs == 0;
+      otherwise falls back to fs=0 with a warning.
     """
     layers = list(layers)
     template = layers[0]
@@ -70,15 +79,26 @@ def scan_layers(layers, x, extra_inputs=(), remat=False):
     n_leaves = len(tmpl_params)
     L = len(layers)
     n_extra = len(extra_inputs)
+    fs = max(int(full_save_interval or 0), 0)  # same clamp as unrolled
+    if fs and not remat:
+        fs = 0
+    if fs == 1:
+        # same knob meaning as the unrolled path: every layer saves
+        # whole = no remat at all
+        remat, fs = False, 0
+    if fs and L % fs:
+        import warnings
+        warnings.warn(
+            f"scan_layers: full_save_interval={fs} must tile "
+            f"num_layers ({L}); running without the dose",
+            stacklevel=2)
+        fs = 0
 
     def fn(h, *rest):
         extras = rest[:n_extra]
         leaves = rest[n_extra:]
-        stacked = tuple(
-            jnp.stack([leaves[g * n_leaves + i] for g in range(L)])
-            for i in range(n_leaves))
 
-        def body(carry, slices):
+        def one_layer(carry, slices):
             originals = [(p, p._data) for p in tmpl_params]
             try:
                 for p, a in zip(tmpl_params, slices):
@@ -86,11 +106,39 @@ def scan_layers(layers, x, extra_inputs=(), remat=False):
                 ins = [Tensor(carry)] + [Tensor(e) for e in extras]
                 with no_grad():
                     out = template(*ins)
-                out = out.jax() if isinstance(out, Tensor) else out
-                return out, None
+                return out.jax() if isinstance(out, Tensor) else out
             finally:
                 for p, a in originals:
                     p._data = a
+
+        if fs:
+            # [G, fs, ...] stacks; group body: fs-1 rematted + 1 saved
+            G = L // fs
+            stacked = tuple(
+                jnp.stack([
+                    jnp.stack([leaves[(g * fs + j) * n_leaves + i]
+                               for j in range(fs)])
+                    for g in range(G)])
+                for i in range(n_leaves))
+            from ..incubate.recompute import checkpoint_with_policy
+            ck_layer = checkpoint_with_policy(one_layer)
+
+            def body(carry, slices):
+                h = carry
+                for j in range(fs):
+                    sl = tuple(s[j] for s in slices)
+                    h = (ck_layer if j < fs - 1 else one_layer)(h, sl)
+                return h, None
+
+            out, _ = lax.scan(body, h, stacked)
+            return out
+
+        stacked = tuple(
+            jnp.stack([leaves[g * n_leaves + i] for g in range(L)])
+            for i in range(n_leaves))
+
+        def body(carry, slices):
+            return one_layer(carry, slices), None
 
         if remat:
             from ..incubate.recompute import checkpoint_with_policy

@@ -90,6 +90,20 @@ def _chunk_partials(qf, k_c, v_c, q_pos, k_pos, scale, causal,
     return m, l, acc
 
 
+def _merge_partials(carry, partials):
+    """Online-softmax merge of one chunk's partials into the running
+    (acc, m, l) — the numerically delicate rescale, kept in ONE place
+    for the ring / chunked / allgather variants."""
+    acc, m, l = carry
+    m_j, l_j, acc_j = partials
+    m_new = jnp.maximum(m, m_j)
+    alpha = jnp.exp(m - m_new)
+    beta = jnp.exp(m_j - m_new)
+    acc = acc * alpha[..., None] + acc_j * beta[..., None]
+    l = l * alpha + l_j * beta
+    return acc, m_new, l
+
+
 def _zigzag_local_positions(idx, seq_local, degree):
     """Global positions of this rank's tokens under zigzag placement:
     rank r holds chunks r and 2n-1-r of 2n equal chunks."""
@@ -135,18 +149,15 @@ def ring_attention(q, k, v, axis_name, causal=False, scale=None,
 
     def step(carry, _):
         acc, m, l, k_c, v_c, kp = carry
-        m_j, l_j, acc_j = _chunk_partials(qf, k_c, v_c, q_pos, kp, s, causal)
-        m_new = jnp.maximum(m, m_j)
-        alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(m_j - m_new)
-        acc = acc * alpha[..., None] + acc_j * beta[..., None]
-        l = l * alpha + l_j * beta
+        acc, m, l = _merge_partials(
+            (acc, m, l),
+            _chunk_partials(qf, k_c, v_c, q_pos, kp, s, causal))
         # rotate the K/V chunk (and its positions) one step around the ring;
         # XLA's async collective-permute overlaps this with the merge math
         k_c = lax.ppermute(k_c, axis_name, perm)
         v_c = lax.ppermute(v_c, axis_name, perm)
         kp = lax.ppermute(kp, axis_name, perm)
-        return (acc, m_new, l, k_c, v_c, kp), None
+        return (acc, m, l, k_c, v_c, kp), None
 
     def _vary(x):
         # Mark freshly-created carry state as device-varying so the scan
@@ -190,30 +201,27 @@ def allgather_attention(q, k, v, axis_name, causal=False, scale=None):
     sk = k.shape[1]
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     q_pos = idx * sq + jnp.arange(sq, dtype=jnp.int32)
-    # gather the COMPACT kv heads, repeat GQA only on the local view —
-    # the gather is this impl's stated cost, don't inflate it H/H_kv x
+    # gather and KEEP the compact kv heads (S_global x kv_heads is the
+    # documented memory bound); GQA repeat happens per S_local chunk
+    # inside the scan, never on the full gathered arrays
     k_full = lax.all_gather(k, axis_name, axis=1, tiled=True)
     v_full = lax.all_gather(v, axis_name, axis=1, tiled=True)
-    k_full, v_full = _repeat_kv(q, k_full, v_full)
     qf = q.astype(jnp.float32)
 
     # online-softmax over S_local-sized chunks of the gathered K/V (the
     # ring's merge math without rotation state): peak score memory is
     # O(Sq_local x Sk_local), not O(Sq_local x S_global)
     def step(carry, j):
-        acc, m, l = carry
         k_c = lax.dynamic_slice_in_dim(k_full, j * sk, sk, 1)
         v_c = lax.dynamic_slice_in_dim(v_full, j * sk, sk, 1)
+        k_c, v_c = _repeat_kv(q, k_c, v_c)
         kp = j * sk + jnp.arange(sk, dtype=jnp.int32)
-        m_j, l_j, acc_j = _chunk_partials(qf, k_c.astype(jnp.float32),
-                                          v_c.astype(jnp.float32),
-                                          q_pos, kp, s, causal)
-        m_new = jnp.maximum(m, m_j)
-        alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(m_j - m_new)
-        acc = acc * alpha[..., None] + acc_j * beta[..., None]
-        l = l * alpha + l_j * beta
-        return (acc, m_new, l), None
+        carry = _merge_partials(
+            carry,
+            _chunk_partials(qf, k_c.astype(jnp.float32),
+                            v_c.astype(jnp.float32), q_pos, kp, s,
+                            causal))
+        return carry, None
 
     from ..framework._vma import pvary_missing
 
@@ -266,15 +274,11 @@ def chunked_attention(q, k, v, causal=True, scale=None, chunk=256):
         k_c, v_c, j = inp
         k_pos = j * c + jnp.arange(c, dtype=jnp.int32)
         # padded tail columns (k_pos >= sk) are masked in both modes
-        m_j, l_j, acc_j = _chunk_partials(qf, k_c, v_c, q_pos, k_pos, s,
-                                          causal=causal,
-                                          k_valid=k_pos < sk)
-        m_new = jnp.maximum(m, m_j)
-        alpha = jnp.exp(m - m_new)
-        beta = jnp.exp(m_j - m_new)
-        acc = acc * alpha[..., None] + acc_j * beta[..., None]
-        l = l * alpha + l_j * beta
-        return (acc, m_new, l), None
+        acc, m, l = _merge_partials(
+            (acc, m, l),
+            _chunk_partials(qf, k_c, v_c, q_pos, k_pos, s,
+                            causal=causal, k_valid=k_pos < sk))
+        return (acc, m, l), None
 
     carry0 = (jnp.zeros((b, h, sq, dv), jnp.float32),
               jnp.full((b, h, sq), _NEG_INF, jnp.float32),

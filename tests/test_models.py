@@ -195,10 +195,34 @@ def test_llama_full_save_interval_parity_and_scan_warning():
 
     np.testing.assert_allclose(losses(0), losses(2), rtol=1e-5)
 
-    cfg = LlamaConfig.tiny()
+    # fs now composes with scan_layers (grouped scan body, round 5):
+    # parity with the un-dosed scan, warning only when fs can't tile L
+    def scan_losses(fs):
+        cfg = LlamaConfig.tiny()
+        cfg.use_recompute = True
+        cfg.scan_layers = True
+        cfg.full_save_interval = fs
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        m.train()
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, 256, (2, 16)).astype(np.int64))
+        out = []
+        for _ in range(2):
+            _, l = m(ids, labels=ids)
+            l.backward()
+            opt.step()
+            opt.clear_grad()
+            out.append(float(l.item()))
+        return out
+
+    np.testing.assert_allclose(scan_losses(0), scan_losses(2), rtol=1e-5)
+
+    cfg = LlamaConfig.tiny()          # 2 layers: fs=3 cannot tile
     cfg.use_recompute = True
     cfg.scan_layers = True
-    cfg.full_save_interval = 2
+    cfg.full_save_interval = 3
     paddle.seed(0)
     m = LlamaForCausalLM(cfg)
     m.train()
