@@ -514,34 +514,12 @@ def main():
     }
     print(json.dumps(record), flush=True)
     gc.collect()
-    try:
-        decode_tok_s = _timed_section(
-            "decode", lambda: _retry_transient(
-                lambda: _decode_bench(on_tpu), "decode bench"))
-    except Exception as e:  # decode is secondary: never sink the headline
-        print(f"# decode bench failed: {e!r}", file=sys.stderr)
-        decode_tok_s = None
-    if decode_tok_s is not None:
-        record["decode_metric"] = "llama_1B_kv_cache_greedy_decode" + suffix
-        record["decode_value"] = round(decode_tok_s, 2)
-        record["decode_unit"] = "tokens/s/chip"
-        print(json.dumps(record), flush=True)
-    gc.collect()
-    try:
-        cb_tok_s = _timed_section(
-            "cb", lambda: _retry_transient(
-                lambda: _cb_bench(on_tpu), "cb bench"))
-    except Exception as e:
-        print(f"# continuous-batching bench failed: {e!r}", file=sys.stderr)
-        cb_tok_s = None
-    if cb_tok_s is not None:
-        record["cb_metric"] = ("llama_1B_continuous_batching_mixed_lengths"
-                               + suffix)
-        record["cb_value"] = round(cb_tok_s, 2)
-        record["cb_unit"] = "tokens/s/chip"
-        print(json.dumps(record), flush=True)
-    gc.collect()
 
+    # Section order = evidentiary priority under the driver's time
+    # limit (measured round 5: train 593s, decode 353s — mostly
+    # tunnel init/compile, not measurement): the MoE train MFU is the
+    # round's headline addition, then serving depth (cb), then the
+    # decode secondaries.
     try:
         moe_params, moe_tok_s, moe_mfu = _timed_section(
             "moe train", lambda: _retry_transient(
@@ -559,9 +537,36 @@ def main():
         record["moe_value"] = round(moe_tok_s, 2)
         record["moe_unit"] = "tokens/s/chip"
         record["moe_mfu"] = round(moe_mfu, 4)
-        # re-print enriched as soon as the MoE headline lands (same
-        # incremental contract as above: moe decode must not erase it)
         print(json.dumps(record), flush=True)
+
+    try:
+        cb_tok_s = _timed_section(
+            "cb", lambda: _retry_transient(
+                lambda: _cb_bench(on_tpu), "cb bench"))
+    except Exception as e:
+        print(f"# continuous-batching bench failed: {e!r}", file=sys.stderr)
+        cb_tok_s = None
+    if cb_tok_s is not None:
+        record["cb_metric"] = ("llama_1B_continuous_batching_mixed_lengths"
+                               + suffix)
+        record["cb_value"] = round(cb_tok_s, 2)
+        record["cb_unit"] = "tokens/s/chip"
+        print(json.dumps(record), flush=True)
+    gc.collect()
+
+    try:
+        decode_tok_s = _timed_section(
+            "decode", lambda: _retry_transient(
+                lambda: _decode_bench(on_tpu), "decode bench"))
+    except Exception as e:  # decode is secondary: never sink the headline
+        print(f"# decode bench failed: {e!r}", file=sys.stderr)
+        decode_tok_s = None
+    if decode_tok_s is not None:
+        record["decode_metric"] = "llama_1B_kv_cache_greedy_decode" + suffix
+        record["decode_value"] = round(decode_tok_s, 2)
+        record["decode_unit"] = "tokens/s/chip"
+        print(json.dumps(record), flush=True)
+    gc.collect()
 
     try:
         moe_decode_tok_s = _timed_section(

@@ -125,6 +125,12 @@ class ContinuousBatchingEngine:
         # pending first-token echo: slots admitted since the last chunk
         # whose prefill token has not been appended host-side yet
         self._pending_first = np.zeros((B,), bool)
+        # echo snapshotted into a dispatched-but-unharvested chunk: the
+        # slot must not drain until that harvest appends the token (a
+        # one-shot request admitted mid-stream would otherwise finish
+        # empty — its pending flag is cleared at dispatch, but the token
+        # only arrives with the chunk's packed fetch)
+        self._echo_inflight = np.zeros((B,), bool)
 
         # device-resident hot state (never round-trips between chunks);
         # admission mutates it with tiny async .at[slot].set dispatches
@@ -181,25 +187,32 @@ class ContinuousBatchingEngine:
         """Drive until every queued request completes; returns them in
         completion order.
 
-        Pipelined: when no admission decision depends on fresh host
-        state (nothing queued, or no slot free), the NEXT chunk is
-        dispatched before the previous chunk's packed output is fetched
-        — device state chains asynchronously, so the host round-trip
-        hides behind on-device decode. A slot that finished inside the
-        previous chunk is simply inactive in the speculative successor
-        (its device active flag is already False), so the overlap never
-        decodes garbage."""
+        Pipelined: the NEXT chunk is ALWAYS dispatched before the
+        previous chunk's packed output is fetched — device state chains
+        asynchronously, so the harvest round-trip AND the whole
+        admission wave (prefill programs, slot-state updates) execute
+        while the speculative successor decodes on device: a prefill
+        consumes the successor's output pools, so it simply joins the
+        device stream after it, and the admitted slot starts decoding
+        in the chunk after that. A slot that finished inside the
+        previous chunk is inactive in the speculative successor (its
+        device active flag is already False), so the overlap never
+        decodes garbage; the admitted-into slots idle for exactly one
+        in-flight chunk — measured cheaper than serializing admission
+        on the tunnel round-trip (round-4 breakdown, BASELINE.md).
+        Cost accepted (advisor round 4): when every slot finished
+        inside the in-flight chunk and the queue is empty, one wasted
+        chunk program is dispatched per drain wave."""
         done = []
         inflight = None
         while True:
             if inflight is not None:
-                nxt = None
-                if self.active.any() and not (
-                        self.queue
-                        and any(r is None for r in self.slot_req)):
-                    nxt = self._dispatch_chunk()
+                # speculative successor first: device never idles while
+                # the host harvests, drains, and admits
+                nxt = self._dispatch_chunk() if self.active.any() else None
                 self._harvest_chunk(inflight)
                 done.extend(self._drain())
+                self._admit()     # prefills overlap nxt's on-device run
                 inflight = nxt
                 continue
             n_before = len(done)
@@ -432,6 +445,7 @@ class ContinuousBatchingEngine:
         # by harvest time a drained slot may have been re-admitted to a
         # NEW request whose tokens belong to a later chunk
         rec = (packed, list(self.slot_req), self._pending_first.copy())
+        self._echo_inflight |= self._pending_first
         self._pending_first[:] = False
         return rec
 
@@ -446,6 +460,10 @@ class ContinuousBatchingEngine:
         ctx_m = arr[:, 2 * n + 1].astype(np.int32)
         act_m = arr[:, 2 * n + 2].astype(bool)
         for slot in range(self.num_slots):
+            if pending[slot]:
+                # this harvest delivers the slot's first-token echo;
+                # _drain may finish the slot again from here on
+                self._echo_inflight[slot] = False
             req = snap_req[slot]
             if req is not self.slot_req[slot]:
                 continue      # slot re-admitted since this dispatch
@@ -471,6 +489,10 @@ class ContinuousBatchingEngine:
         for slot in range(self.num_slots):
             req = self.slot_req[slot]
             if req is None:
+                continue
+            if self._echo_inflight[slot]:
+                # first-token echo rides a dispatched-but-unharvested
+                # chunk: finishing now would lose it (defer one loop)
                 continue
             if not self.active[slot]:
                 if self._pending_first[slot]:

@@ -111,15 +111,16 @@ def scan_layers(layers, x, extra_inputs=(), remat=False,
                 for p, a in originals:
                     p._data = a
 
+        stacked = tuple(
+            jnp.stack([leaves[g * n_leaves + i] for g in range(L)])
+            for i in range(n_leaves))
+
         if fs:
-            # [G, fs, ...] stacks; group body: fs-1 rematted + 1 saved
+            # scan over L/fs GROUPS ([G, fs, ...] = a reshape of the
+            # [L, ...] stack); group body: fs-1 rematted + 1 saved
             G = L // fs
-            stacked = tuple(
-                jnp.stack([
-                    jnp.stack([leaves[(g * fs + j) * n_leaves + i]
-                               for j in range(fs)])
-                    for g in range(G)])
-                for i in range(n_leaves))
+            stacked = tuple(s.reshape((G, fs) + s.shape[1:])
+                            for s in stacked)
             from ..incubate.recompute import checkpoint_with_policy
             ck_layer = checkpoint_with_policy(one_layer)
 
@@ -132,10 +133,6 @@ def scan_layers(layers, x, extra_inputs=(), remat=False,
 
             out, _ = lax.scan(body, h, stacked)
             return out
-
-        stacked = tuple(
-            jnp.stack([leaves[g * n_leaves + i] for g in range(L)])
-            for i in range(n_leaves))
 
         def body(carry, slices):
             return one_layer(carry, slices), None

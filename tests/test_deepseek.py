@@ -13,6 +13,7 @@ def _prompt(cfg, b=2, s=6, seed=1):
         0, cfg.vocab_size, (b, s)).astype(np.int64))
 
 
+@pytest.mark.slow
 def test_tiny_trains_and_aux_loss_engages():
     cfg = DeepseekV2Config.tiny()
     paddle.seed(0)

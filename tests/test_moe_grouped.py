@@ -80,6 +80,7 @@ def test_grouped_matmul_numeric_and_grad():
                                atol=1e-3)
 
 
+@pytest.mark.slow
 def test_dropless_matches_capacity_path():
     """With capacity high enough that nothing drops, the capacity path
     and the dropless grouped path compute the same function — outputs

@@ -193,7 +193,32 @@ def test_gpt2_through_engine():
     (req,) = eng.run()
     assert req.tokens == ref, (req.tokens, ref)
 
+def test_one_shot_admitted_mid_stream():
+    """Round-5 regression (caught in review): a max_new_tokens=1 request
+    admitted WHILE another slot is still decoding must not finish empty
+    — its first-token echo rides a speculative chunk that is dispatched
+    (clearing the pending flag) before the drain runs; the engine must
+    defer draining until that harvest lands. Fast-tier: this is the
+    pipelined-branch _admit path the slow one-token test (all requests
+    queued before run()) never reaches."""
+    model, cfg = _model()
+    eng = ContinuousBatchingEngine(model, num_slots=2, page_size=8,
+                                   max_len=48, decode_chunk=4,
+                                   prompt_buckets=(8, 16), greedy=True)
+    rng = np.random.RandomState(3)
+    long_p = rng.randint(0, cfg.vocab_size, (6,)).astype(np.int32)
+    mid_p = rng.randint(0, cfg.vocab_size, (7,)).astype(np.int32)
+    one_p = rng.randint(0, cfg.vocab_size, (5,)).astype(np.int32)
+    eng.add_request(long_p, 20)    # keeps slot 0 busy throughout
+    eng.add_request(mid_p, 3)      # frees slot 1 mid-stream
+    r_one = eng.add_request(one_p, 1)   # admitted into the freed slot
+    done = eng.run()
+    by_id = {r.request_id: r for r in done}
+    assert len(by_id[r_one].tokens) == 1, by_id[r_one].tokens
+    assert by_id[r_one].finish_reason == "length"
 
+
+@pytest.mark.slow
 def test_one_token_and_instant_eos_requests():
     """Refactor edge cases: a max_new_tokens=1 request never activates a
     slot (its token arrives via the deferred first-token fetch at
