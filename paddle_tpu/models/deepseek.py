@@ -63,6 +63,8 @@ class DeepseekV2Config:
     routed_scaling_factor: float = 16.0
     norm_topk_prob: bool = False
     router_aux_loss_coef: float = 0.001
+    #: MegaBlocks-style dropless dispatch (see Qwen2MoeConfig)
+    moe_dropless: bool = False
     # common
     max_position_embeddings: int = 4096
     rope_theta: float = 10000.0
@@ -290,7 +292,8 @@ class DeepseekV2MoE(nn.Layer):
             cfg.hidden_size, cfg.moe_intermediate_size,
             cfg.n_routed_experts,
             gate={"top_k": cfg.num_experts_per_tok,
-                  "norm_topk_prob": cfg.norm_topk_prob})
+                  "norm_topk_prob": cfg.norm_topk_prob,
+                  "dropless": getattr(cfg, "moe_dropless", False)})
         self.shared_experts = DeepseekV2MLP(
             cfg, intermediate=cfg.moe_intermediate_size
             * cfg.n_shared_experts)

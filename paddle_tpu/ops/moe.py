@@ -246,6 +246,12 @@ def moe_forward_dropless(x, router_w, w_gate, w_up, w_down, k=2,
         jnp.arange(T * k, dtype=jnp.int32) // k)
     x_pad = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)], axis=0)
     x_p = x_pad[src]                                    # [P, d] gather
+    # two grouped matmuls, not a fused [E, d, 2h] concat: the concat
+    # would materialize a full copy of every expert bank per forward
+    # (+ its remat re-forwards + the VJP residual) on a config that is
+    # already HBM-bound. A pre-fused gate|up PARAMETER would avoid the
+    # copy but breaks the w_gate/w_up state_dict layout; revisit only
+    # if an on-chip A/B shows the wider-N kernel paying for it.
     g = grouped_matmul(x_p, w_gate, tile_gid)
     u = grouped_matmul(x_p, w_up, tile_gid)
     y_p = grouped_matmul((act(g) * u).astype(x.dtype), w_down, tile_gid)

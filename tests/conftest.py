@@ -42,3 +42,17 @@ if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
     jax.config.update("jax_compilation_cache_dir", _cache_dir)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+
+
+import pytest
+
+
+@pytest.fixture
+def reset_fleet():
+    """Restore single-device fleet state after a test that calls
+    fleet.init (the one place that knows the private fields)."""
+    yield
+    from paddle_tpu.distributed import fleet
+    fleet.fleet._hcg = None
+    fleet.fleet._topology = None
+    fleet.fleet._is_initialized = False

@@ -133,6 +133,54 @@ def test_qwen2_moe_ep2_mp2_pp2():
         _reset()
 
 
+def test_qwen2_moe_ep2_pp2_interleaved_vpp():
+    """ep2 x pp2 under interleaved virtual-pp (V=2): the expert
+    all-to-all runs inside the interleaved scan engine's manual region
+    ([V, S, ...] chunk stacks, expert dim sharded via param_specs).
+    Completes the EP x schedule matrix alongside FThenB (above) and
+    1F1B/ZB-H1 (below)."""
+    import dataclasses
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
+    from paddle_tpu.models import Qwen2MoeForCausalLMPipe
+
+    def cfg():
+        return dataclasses.replace(
+            Qwen2MoeConfig.tiny(), num_hidden_layers=4,
+            capacity_factor=4.0, router_aux_loss_coef=0.0)
+
+    ids_np = np.random.RandomState(0).randint(
+        0, 256, (4, 16)).astype(np.int64)
+    steps = 2
+    paddle.seed(0)
+    ref_model = Qwen2MoeForCausalLMPipe(cfg())
+    ref_engine = PipelineParallel(ref_model, None, accumulate_steps=2)
+    ref_opt = paddle.optimizer.AdamW(
+        1e-3, parameters=ref_model.parameters())
+    ids_t = paddle.to_tensor(ids_np)
+    ref = [float(ref_engine.train_batch((ids_t, ids_t), ref_opt).item())
+           for _ in range(steps)]
+
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 2, "sharding_degree": 1,
+                               "sep_degree": 1, "ep_degree": 2}
+    strategy.pipeline_configs = {"accumulate_steps": 2,
+                                 "schedule_mode": "interleaved",
+                                 "num_virtual_pipeline_stages": 2}
+    fleet.init(is_collective=True, strategy=strategy)
+    try:
+        paddle.seed(0)
+        model = Qwen2MoeForCausalLMPipe(cfg())
+        engine = fleet.fleet.distributed_model(model)
+        opt = fleet.fleet.distributed_optimizer(
+            paddle.optimizer.AdamW(1e-3, parameters=model.parameters()))
+        losses = [float(engine.train_batch((ids_t, ids_t), opt).item())
+                  for _ in range(steps)]
+        np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-4)
+    finally:
+        _reset()
+
+
 @pytest.mark.parametrize("schedule", ["1F1B", "ZB-H1"])
 def test_qwen2_moe_ep2_pp2_explicit_schedule(schedule):
     """ep2 x pp2 under the explicit tick engines (1F1B / ZB-H1) — the

@@ -123,6 +123,47 @@ def test_dropless_matches_capacity_path():
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.slow
+def test_dropless_under_tensor_parallel(reset_fleet):
+    """Dropless grouped dispatch inside a GSPMD program with
+    'model'-sharded attention around it (mp2, ep1): exact loss parity
+    with the single-device dropless run — the Pallas grouped calls see
+    replicated token rows while TP shards the dense linears."""
+    import dataclasses
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import fleet
+    from paddle_tpu.models import Qwen2MoeConfig, Qwen2MoeForCausalLM
+
+    cfg_d = dataclasses.replace(Qwen2MoeConfig.tiny(), moe_dropless=True,
+                                scan_layers=False)
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, cfg_d.vocab_size, (4, 16)).astype(np.int64))
+
+    def train(cfg, steps=2):
+        paddle.seed(0)
+        m = Qwen2MoeForCausalLM(cfg)
+        opt = paddle.optimizer.AdamW(1e-3, parameters=m.parameters())
+
+        @paddle.jit.to_static
+        def step(t):
+            _, l = m(t, labels=t)
+            l.backward()
+            opt.step()
+            opt.clear_grad()
+            return l
+
+        return [float(step(ids).item()) for _ in range(steps)]
+
+    ref = train(cfg_d)
+    strategy = fleet.DistributedStrategy()
+    strategy.hybrid_configs = {"dp_degree": -1, "mp_degree": 2,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 1, "ep_degree": 1}
+    fleet.init(is_collective=True, strategy=strategy)
+    losses = train(dataclasses.replace(cfg_d, tensor_parallel=True))
+    np.testing.assert_allclose(losses, ref, rtol=1e-3, atol=1e-4)
+
+
 def test_dropless_no_drops_vs_tight_capacity():
     """The point of dropless: a skewed routing that drops tokens under
     cf=1 keeps them all under the grouped path (outputs differ from the
