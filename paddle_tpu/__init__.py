@@ -94,6 +94,49 @@ from . import text  # noqa: F401
 from .regularizer import L1Decay, L2Decay  # noqa: F401
 
 
+def disable_signal_handler():
+    """Reference parity (``paddle.disable_signal_handler``): upstream
+    uninstalls its C++ crash-dump signal handlers so other frameworks'
+    handlers win. This runtime installs none (Python exceptions + jax
+    debug callbacks play that role — SURVEY.md §2.1 enforce row), so
+    there is nothing to uninstall; provided for source compatibility."""
+
+
+class LazyGuard:
+    """Reference parity (``paddle.LazyGuard``): upstream defers
+    parameter initialization so huge models can be constructed without
+    eagerly allocating host memory, then materialized after placement.
+    Here parameter init already IS a lazy device computation — each
+    initializer is a jax program whose array materializes on the
+    accelerator (sharded, when constructed under a mesh) — so the
+    guard's memory-avoidance purpose is the default behavior. A plain
+    context manager for source compatibility."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Reference parity (``paddle.create_parameter``): a free-standing
+    Parameter with ParamAttr/initializer resolution (the same path
+    ``nn.Layer.create_parameter`` uses)."""
+    from .nn.layer.layers import Layer
+
+    class _Holder(Layer):
+        pass
+
+    p = _Holder().create_parameter(shape, attr=attr, dtype=dtype,
+                                   is_bias=is_bias,
+                                   default_initializer=default_initializer)
+    if name:
+        p.name = name
+    return p
+
+
 def iinfo(dtype):
     """paddle.iinfo — integer dtype machine limits."""
     import numpy as _np

@@ -2,9 +2,9 @@ from . import functional
 from .layers import (FusedMultiHeadAttention, FusedFeedForward,
                      FusedTransformerEncoderLayer, FusedLinear,
                      FusedBiasDropoutResidualLayerNorm, FusedDropoutAdd,
-                     FusedEcMoe)
+                     FusedEcMoe, FusedMatmulBias)
 
 __all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
            "FusedTransformerEncoderLayer", "FusedLinear",
            "FusedBiasDropoutResidualLayerNorm", "FusedDropoutAdd",
-           "FusedEcMoe"]
+           "FusedEcMoe", "FusedMatmulBias"]

@@ -205,3 +205,9 @@ class FusedEcMoe(Layer):
 
 
 __all__ += ["FusedDropoutAdd", "FusedEcMoe"]
+
+class FusedMatmulBias(FusedLinear):
+    """incubate.nn.FusedMatmulBias parity — same fused matmul+bias as
+    FusedLinear (the reference distinguishes them by the cuBLASLt
+    epilogue path; here XLA fuses both identically), so this is the
+    FusedLinear body under the reference's other name."""

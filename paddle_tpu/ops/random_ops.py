@@ -166,4 +166,12 @@ def geometric_(x, probs, name=None):
     return x
 
 
-__all__ += ["bernoulli_", "cauchy_", "geometric_"]
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """Namespace form of ``Tensor.log_normal_`` — delegates to the ONE
+    implementation in ops/tensor_methods.py (float32 draw cast to the
+    tensor dtype, integer dtypes included)."""
+    from .tensor_methods import _log_normal_
+    return _log_normal_(x, mean=mean, std=std, name=name)
+
+
+__all__ += ["bernoulli_", "cauchy_", "geometric_", "log_normal_"]

@@ -47,12 +47,16 @@ if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
 import pytest
 
 
-@pytest.fixture
-def reset_fleet():
-    """Restore single-device fleet state after a test that calls
-    fleet.init (the one place that knows the private fields)."""
-    yield
+def reset_fleet_state():
+    """Restore single-device fleet state after fleet.init — the ONE
+    place that knows the private fields."""
     from paddle_tpu.distributed import fleet
     fleet.fleet._hcg = None
     fleet.fleet._topology = None
     fleet.fleet._is_initialized = False
+
+
+@pytest.fixture
+def reset_fleet():
+    yield
+    reset_fleet_state()

@@ -18,9 +18,8 @@ from paddle_tpu.models import (DeepseekV2Config, DeepseekV2ForCausalLM,
 
 
 def _reset():
-    fleet.fleet._hcg = None
-    fleet.fleet._topology = None
-    fleet.fleet._is_initialized = False
+    from conftest import reset_fleet_state
+    reset_fleet_state()
 
 
 def _fleet(ep, mp=1, pp=1, sharding=1):

@@ -322,6 +322,16 @@ class TestRandomInplace:
         assert (g.numpy() >= 1).all()
         assert abs(g.numpy().mean() - 2.0) < 0.4  # E[geom(0.5)] = 2
 
+    def test_log_normal_(self):
+        t = paddle.to_tensor(np.zeros((4000,), "float32"))
+        t.log_normal_(mean=0.0, std=0.5)
+        vals = t.numpy()
+        assert (vals > 0).all()
+        # median of exp(N(mean, std)) = exp(mean)
+        assert abs(np.median(vals) - 1.0) < 0.1
+        # mean = exp(mean + std^2/2)
+        assert abs(vals.mean() - np.exp(0.125)) < 0.12
+
 
 class TestLogicLongTail:
     def test_dtype_predicates(self):
@@ -474,3 +484,28 @@ class TestFusedCEMultiChunk:
         np.testing.assert_allclose(np.asarray(g_out[1]),
                                    np.asarray(g_ref[1]), rtol=1e-4,
                                    atol=1e-6)
+
+
+class TestTopLevelApiFills:
+    def test_create_parameter_and_lazy_guard(self):
+        paddle.disable_signal_handler()    # source-compat no-op
+        with paddle.LazyGuard():
+            p = paddle.create_parameter([4, 8], dtype="float32")
+        assert list(p.shape) == [4, 8]
+        assert p.trainable
+
+    def test_fused_matmul_bias_layer(self):
+        from paddle_tpu.incubate.nn import FusedMatmulBias
+        paddle.seed(0)
+        l = FusedMatmulBias(8, 3)
+        x = paddle.to_tensor(np.random.RandomState(0).randn(5, 8)
+                             .astype("float32"))
+        ref = (np.asarray(x.numpy()) @ np.asarray(l.weight.numpy())
+               + np.asarray(l.bias.numpy()))
+        np.testing.assert_allclose(np.asarray(l(x).numpy()), ref,
+                                   rtol=1e-5, atol=1e-5)
+        lt = FusedMatmulBias(8, 3, transpose_weight=True)
+        reft = (np.asarray(x.numpy()) @ np.asarray(lt.weight.numpy()).T
+                + np.asarray(lt.bias.numpy()))
+        np.testing.assert_allclose(np.asarray(lt(x).numpy()), reft,
+                                   rtol=1e-5, atol=1e-5)
