@@ -1,7 +1,7 @@
 """Qwen2-MoE training — dropless dispatch, expert parallelism, and the
 MoE x pipeline composition, end to end.
 
-Three modes in one script (pick with MODE below or --mode):
+Three modes in one script (pick with --mode):
 
 - "single":  one device, DROPLESS routed experts over the Pallas
              grouped matmul (no capacity, no token drops) — the
@@ -18,6 +18,10 @@ Three modes in one script (pick with MODE below or --mode):
 
 import argparse
 import dataclasses
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 import numpy as np
 
@@ -34,8 +38,7 @@ def make_cfg(dropless):
         moe_dropless=dropless, scan_layers=False)
 
 
-def run_single(steps):
-    cfg = make_cfg(dropless=True)
+def _train_loop(cfg, steps, suffix=""):
     paddle.seed(0)
     model = Qwen2MoeForCausalLM(cfg)
     opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
@@ -51,7 +54,11 @@ def run_single(steps):
         return loss
 
     for i in range(steps):
-        print(f"step {i}: loss {float(step(ids).item()):.4f}")
+        print(f"step {i}: loss {float(step(ids).item()):.4f}{suffix}")
+
+
+def run_single(steps):
+    _train_loop(make_cfg(dropless=True), steps)
 
 
 def run_ep(steps):
@@ -60,24 +67,8 @@ def run_ep(steps):
                                "pp_degree": 1, "sharding_degree": 1,
                                "sep_degree": 1, "ep_degree": 4}
     fleet.init(is_collective=True, strategy=strategy)
-    cfg = make_cfg(dropless=False)   # EP runs the capacity all-to-all
-    paddle.seed(0)
-    model = Qwen2MoeForCausalLM(cfg)
-    opt = paddle.optimizer.AdamW(1e-3, parameters=model.parameters())
-    ids = paddle.to_tensor(np.random.RandomState(0).randint(
-        0, cfg.vocab_size, (4, 32)).astype(np.int64))
-
-    @paddle.jit.to_static
-    def step(t):
-        _, loss = model(t, labels=t)
-        loss.backward()
-        opt.step()
-        opt.clear_grad()
-        return loss
-
-    for i in range(steps):
-        print(f"step {i}: loss {float(step(ids).item()):.4f}  "
-              f"(ep4 all-to-all)")
+    # EP runs the capacity all-to-all (per-device quotas bound the a2a)
+    _train_loop(make_cfg(dropless=False), steps, "  (ep4 all-to-all)")
 
 
 def run_ep_pp(steps):

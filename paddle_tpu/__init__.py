@@ -89,6 +89,10 @@ from . import hub  # noqa: F401
 from . import sysconfig  # noqa: F401
 from . import onnx  # noqa: F401
 from .hapi import callbacks  # noqa: F401
+# make `import paddle_tpu.callbacks` (module-path form) resolve too —
+# upstream paddle.callbacks is a real submodule
+import sys as _sys
+_sys.modules[__name__ + ".callbacks"] = callbacks
 from . import geometric  # noqa: F401
 from . import text  # noqa: F401
 from .regularizer import L1Decay, L2Decay  # noqa: F401

@@ -361,3 +361,34 @@ class QuantedLinear(nn.Layer):
         if isinstance(x, Tensor):
             return apply(fn, x, name="quanted_linear")
         return fn(jnp.asarray(x))
+
+
+# --------------------------------------------------------------------------
+# quanter registration (reference ``paddle.quantization.quanter``:
+# @quanter("MyFakeQuanter") registers a quanter class for explicit
+# name-based lookup via get_quanter() — QuantConfig itself is
+# layer-type keyed here and does not consult the registry)
+# --------------------------------------------------------------------------
+
+_QUANTER_REGISTRY: dict = {}
+
+
+def quanter(name):
+    """Class decorator registering a custom quanter under ``name``
+    (resolvable via :func:`get_quanter`)."""
+    def deco(cls):
+        _QUANTER_REGISTRY[name] = cls
+        return cls
+    return deco
+
+
+def get_quanter(name):
+    try:
+        return _QUANTER_REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"no quanter registered under {name!r}; register with "
+            f"@quantization.quanter({name!r})") from None
+
+
+__all__ += ["quanter", "get_quanter"]
