@@ -164,6 +164,19 @@ def install_tensor_methods() -> None:
     T.scatter_ = lambda self, index, updates, overwrite=True: tape_rebind(
         self, manipulation.scatter(tape_alias(self), index, updates,
                                    overwrite))
+    T.erfinv_ = lambda self, name=None: tape_rebind(
+        self, math.erfinv(tape_alias(self)))
+    T.relu_ = lambda self, name=None: tape_rebind(
+        self, math.maximum(tape_alias(self), 0))
+    T.put_along_axis_ = lambda self, indices, values, axis, \
+        reduce="assign", include_self=True, broadcast=True: tape_rebind(
+        self, manipulation.put_along_axis(
+            tape_alias(self), indices, values, axis, reduce,
+            include_self, broadcast))
+    T.ndimension = lambda self: len(self.shape)
+    # jax arrays are immutable; every in-place Tensor op rebinds, so the
+    # version counter the reference exposes is structurally 0
+    T.inplace_version = property(lambda self: 0)
     T.gradient = _gradient
     T.copy_ = _copy_
     T.set_value = _set_value

@@ -509,3 +509,38 @@ class TestTopLevelApiFills:
                 + np.asarray(lt.bias.numpy()))
         np.testing.assert_allclose(np.asarray(lt(x).numpy()), reft,
                                    rtol=1e-5, atol=1e-5)
+
+
+class TestInplaceTensorMethodFills:
+    def test_erfinv_and_relu_(self):
+        t = paddle.to_tensor(np.array([0.1, -0.5, 0.9], "float32"))
+        t.erfinv_()
+        np.testing.assert_allclose(
+            t.numpy(), scipy.special.erfinv([0.1, -0.5, 0.9]), rtol=1e-5)
+        r = paddle.to_tensor(np.array([-1.0, 2.0], "float32"))
+        r.relu_()
+        np.testing.assert_array_equal(r.numpy(), [0.0, 2.0])
+        # grad flows through the in-place rebind
+        a = paddle.to_tensor(np.array([0.3], "float32"),
+                             stop_gradient=False)
+        b = a * 1.0
+        b.erfinv_()
+        b.backward()
+        np.testing.assert_allclose(
+            float(a.grad.numpy()[0]),
+            np.sqrt(np.pi) / 2 * np.exp(scipy.special.erfinv(0.3) ** 2),
+            rtol=1e-4)
+
+    def test_put_along_axis_(self):
+        x = paddle.to_tensor(np.zeros((2, 3), "float32"))
+        idx = paddle.to_tensor(np.array([[0, 1, 0]], "int64"))
+        v = paddle.to_tensor(np.ones((1, 3), "float32"))
+        x.put_along_axis_(idx, v, 0)
+        ref = np.zeros((2, 3), "float32")
+        np.put_along_axis(ref, np.array([[0, 1, 0]]), 1.0, axis=0)
+        np.testing.assert_array_equal(x.numpy(), ref)
+
+    def test_ndimension_and_inplace_version(self):
+        x = paddle.to_tensor(np.zeros((2, 3), "float32"))
+        assert x.ndimension() == 2
+        assert x.inplace_version == 0
