@@ -10,7 +10,8 @@ from .env import (init_parallel_env, get_rank, get_world_size,
                   is_initialized, ParallelEnv)
 from .mesh import (ProcessMesh, Shard, Replicate, Partial, Placement,
                    shard_tensor, reshard, dtensor_from_fn, shard_layer,
-                   get_mesh, set_mesh, auto_mesh, shard_optimizer)
+                   shard_op, get_mesh, set_mesh, auto_mesh,
+                   shard_optimizer)
 from .communication import (all_reduce, all_gather, all_gather_object,
                             reduce_scatter, alltoall, alltoall_single,
                             broadcast, broadcast_object_list, reduce, scatter,

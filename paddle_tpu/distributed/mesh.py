@@ -9,6 +9,8 @@ propagation + reshard — the things the reference implements by hand in
 
 from __future__ import annotations
 
+import weakref
+
 import numpy as np
 
 import jax
@@ -16,7 +18,38 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..framework.core import Tensor
 
+# DistTensor metadata rides a side table (Tensor has __slots__ — no
+# instance dict — and placements/process_mesh are distributed-surface
+# concepts that don't belong in core). Keyed by id() with
+# weakref.finalize cleanup, NOT a WeakKeyDictionary: weak-key lookups
+# compare colliding keys with ==, and Tensor.__eq__ is elementwise.
+# Exposed as Tensor class properties below; plain Tensors report None,
+# matching the reference's "dense tensor has no dist attr".
+_dist_attr: dict = {}
+
+
+def _mk_dist_prop(key):
+    def get(self):
+        rec = _dist_attr.get(id(self))
+        return rec.get(key) if rec else None
+
+    def set_(self, value):
+        k = id(self)
+        rec = _dist_attr.get(k)
+        if rec is None:
+            rec = _dist_attr[k] = {}
+            weakref.finalize(self, _dist_attr.pop, k, None)
+        rec[key] = value
+
+    return property(get, set_)
+
+
+Tensor.placements = _mk_dist_prop("placements")
+Tensor.process_mesh = _mk_dist_prop("process_mesh")
+Tensor.is_dist = lambda self: _dist_attr.get(id(self)) is not None
+
 __all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
+           "shard_op",
            "reshard", "dtensor_from_fn", "shard_layer", "get_mesh",
            "set_mesh", "auto_mesh"]
 
@@ -209,6 +242,44 @@ def reshard(x: Tensor, mesh: ProcessMesh, placements) -> Tensor:
 def dtensor_from_fn(fn, mesh, placements, *args, **kwargs):
     t = fn(*args, **kwargs)
     return shard_tensor(t, mesh, placements)
+
+
+def shard_op(op_fn, process_mesh, in_placements=None,
+             out_placements=None):
+    """``dist.shard_op`` — wrap a callable so its tensor inputs/outputs
+    are annotated with the given placements on ``process_mesh`` (the
+    reference marks the op for the SPMD planner; here the annotation IS
+    the plan — GSPMD propagates from it)."""
+    def _place(t, placements):
+        if placements is None or not isinstance(t, Tensor):
+            return t
+        return shard_tensor(t, process_mesh, placements)
+
+    def _per_item(placements_arg):
+        # accept [[Shard(0)], [Replicate()]] (per-arg lists) OR a bare
+        # placements list [Shard(0)] for the single-tensor case
+        if placements_arg and not isinstance(placements_arg[0],
+                                             (list, tuple)):
+            return [list(placements_arg)]
+        return list(placements_arg)
+
+    def wrapped(*args, **kwargs):
+        if in_placements is not None:
+            per_in = _per_item(in_placements)
+            args = tuple(
+                _place(a, per_in[i] if i < len(per_in) else None)
+                for i, a in enumerate(args))
+        out = op_fn(*args, **kwargs)
+        if out_placements is None:
+            return out
+        per_out = _per_item(out_placements)
+        if isinstance(out, (list, tuple)):
+            return type(out)(
+                _place(o, per_out[i] if i < len(per_out) else None)
+                for i, o in enumerate(out))
+        return _place(out, per_out[0] if per_out else None)
+
+    return wrapped
 
 
 def shard_layer(layer, process_mesh, shard_fn=None, input_fn=None,

@@ -35,7 +35,7 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "ConcatDataset", "Subset", "random_split",
            "Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
            "WeightedRandomSampler", "DistributedBatchSampler", "DataLoader",
-           "get_worker_info", "default_collate_fn"]
+           "get_worker_info", "default_collate_fn", "default_convert_fn"]
 
 
 class Dataset:
@@ -307,6 +307,25 @@ def _collate_impl(batch, stack, leaf):
 def default_collate_fn(batch):
     from ..native import parallel_stack
     return _collate_impl(batch, parallel_stack, Tensor)
+
+
+def default_convert_fn(batch):
+    """Reference ``paddle.io.dataloader.collate.default_convert_fn``
+    surface: convert array-likes to Tensors WITHOUT stacking a batch
+    dim (the collate used when ``DataLoader(batch_size=None)`` hands
+    samples through unbatched)."""
+    if isinstance(batch, (Tensor,)):
+        return batch
+    if isinstance(batch, np.ndarray):
+        import jax.numpy as jnp
+        return Tensor(jnp.asarray(batch))
+    if isinstance(batch, (int, float)):
+        return batch
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(default_convert_fn(b) for b in batch)
+    if isinstance(batch, dict):
+        return {k: default_convert_fn(v) for k, v in batch.items()}
+    return batch
 
 
 def _np_collate(batch):

@@ -12,6 +12,7 @@ from ...framework import flags
 from ...ops.common import as_tensor
 
 __all__ = ["layer_norm", "batch_norm", "instance_norm", "group_norm",
+           "spectral_norm",
            "local_response_norm", "rms_norm"]
 
 
@@ -204,3 +205,32 @@ def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
         denom = (k + alpha * acc) ** beta
         return a / jnp.moveaxis(denom, 0, ch_axis)
     return apply(fn, x, name="local_response_norm")
+
+
+def spectral_norm(x, weight_u, weight_v, dim=0, power_iters=1,
+                  eps=1e-12, name=None):
+    """Functional spectral norm (reference
+    ``paddle.nn.functional.spectral_norm``): normalize weight ``x`` by
+    its largest singular value, estimated by ``power_iters`` rounds of
+    power iteration from the CALLER-OWNED u/v vectors (the
+    ``nn.SpectralNorm`` layer holds them as buffers and delegates
+    here)."""
+    from ...framework.core import Tensor, apply
+
+    u0 = weight_u.jax() if isinstance(weight_u, Tensor) else \
+        jnp.asarray(weight_u)
+    v0 = weight_v.jax() if isinstance(weight_v, Tensor) else \
+        jnp.asarray(weight_v)
+
+    def fn(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u, v = u0, v0
+        for _ in range(power_iters):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v
+        return w / sigma
+
+    return apply(fn, x, name="spectral_norm")

@@ -239,20 +239,7 @@ class SpectralNorm(Layer):
             jnp.asarray(I.Normal(0, 1)((w,), dtype))))
 
     def forward(self, weight):
-        from ...framework.core import apply
-        import jax
-        u0, v0 = self.weight_u._data, self.weight_v._data
-        axis, eps, iters = self.axis, self.epsilon, self.power_iters
-
-        def fn(w):
-            wm = jnp.moveaxis(w, axis, 0).reshape(w.shape[axis], -1)
-            u, v = u0, v0
-            for _ in range(iters):
-                v = wm.T @ u
-                v = v / (jnp.linalg.norm(v) + eps)
-                u = wm @ v
-                u = u / (jnp.linalg.norm(u) + eps)
-            sigma = u @ wm @ v
-            return w / sigma
-        out = apply(fn, weight, name="spectral_norm")
-        return out
+        from ..functional.norm import spectral_norm
+        return spectral_norm(weight, self.weight_u, self.weight_v,
+                             dim=self.axis, power_iters=self.power_iters,
+                             eps=self.epsilon)

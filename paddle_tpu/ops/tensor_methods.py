@@ -166,8 +166,12 @@ def install_tensor_methods() -> None:
                                    overwrite))
     T.erfinv_ = lambda self, name=None: tape_rebind(
         self, math.erfinv(tape_alias(self)))
-    T.relu_ = lambda self, name=None: tape_rebind(
-        self, math.maximum(tape_alias(self), 0))
+    def _relu_(self, name=None):
+        # delegate to the ONE relu kernel (jax.nn.relu: grad 0 at x==0;
+        # jnp.maximum would split the tie and give 0.5)
+        from ..nn.functional.activation import relu_ as f_relu_
+        return f_relu_(self)
+    T.relu_ = _relu_
     T.put_along_axis_ = lambda self, indices, values, axis, \
         reduce="assign", include_self=True, broadcast=True: tape_rebind(
         self, manipulation.put_along_axis(

@@ -15,7 +15,8 @@ from .. import nn as dynn
 from ..framework.core import Tensor
 from .program import default_main_program
 
-__all__ = ["fc", "conv2d", "conv3d", "batch_norm", "embedding",
+__all__ = ["cond", "while_loop", "case", "switch_case",
+           "fc", "conv2d", "conv3d", "batch_norm", "embedding",
            "layer_norm", "conv2d_transpose", "sequence_expand", "prelu",
            "group_norm", "instance_norm", "data_norm", "spectral_norm",
            "deform_conv2d", "sparse_embedding", "row_conv",
@@ -478,3 +479,64 @@ def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
             return backward_fn(*grads)
 
     return _StaticPyLayer.apply(*inputs)
+
+
+# ---- control flow (reference ``paddle.static.nn.cond/while_loop/...``:
+# C++-executor ops building ProgramDesc sub-blocks; here the eager value
+# drives a Python branch, and under ``to_static`` the framework's
+# guarded branch specialization keeps the step compiled — SURVEY.md
+# §3.5's SOT role) -----------------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    """Run ``true_fn()`` when pred (a scalar bool Tensor or python
+    bool) is truthy, else ``false_fn()``."""
+    from ..framework.core import Tensor
+    p = bool(pred.item()) if isinstance(pred, Tensor) else bool(pred)
+    if p:
+        return true_fn() if true_fn is not None else None
+    return false_fn() if false_fn is not None else None
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """``static.nn.while_loop`` parity: iterate ``body(*vars)`` while
+    ``cond(*vars)`` holds; shapes/dtypes of loop_vars must be stable
+    (the same contract the reference's while op enforces)."""
+    from ..framework.core import Tensor
+    vars_ = list(loop_vars)
+    while True:
+        c = cond(*vars_)
+        if not (bool(c.item()) if isinstance(c, Tensor) else bool(c)):
+            return vars_
+        out = body(*vars_)
+        vars_ = list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First (pred, fn) pair whose pred is truthy wins; else default."""
+    from ..framework.core import Tensor
+    for pred, fn in pred_fn_pairs:
+        p = bool(pred.item()) if isinstance(pred, Tensor) else bool(pred)
+        if p:
+            return fn()
+    if default is not None:
+        return default()
+    # reference semantics: no default -> last branch's fn
+    return pred_fn_pairs[-1][1]()
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Dispatch on an integer index: ``branch_fns`` is a dict
+    {index: fn} or list of (index, fn) pairs."""
+    from ..framework.core import Tensor
+    idx = int(branch_index.item()) if isinstance(branch_index, Tensor) \
+        else int(branch_index)
+    fns = dict(branch_fns) if not isinstance(branch_fns, dict) \
+        else branch_fns
+    if idx in fns:
+        return fns[idx]()
+    if default is not None:
+        return default()
+    # reference semantics: with no default, an unmatched index
+    # dispatches to the max-index branch
+    return fns[max(fns)]()

@@ -520,6 +520,13 @@ class TestInplaceTensorMethodFills:
         r = paddle.to_tensor(np.array([-1.0, 2.0], "float32"))
         r.relu_()
         np.testing.assert_array_equal(r.numpy(), [0.0, 2.0])
+        # tie gradient at x==0 must match F.relu_ (0, not maximum's 0.5)
+        z = paddle.to_tensor(np.array([0.0], "float32"),
+                             stop_gradient=False)
+        z2 = z * 1.0
+        z2.relu_()
+        z2.backward()
+        assert float(z.grad.numpy()[0]) == 0.0
         # grad flows through the in-place rebind
         a = paddle.to_tensor(np.array([0.3], "float32"),
                              stop_gradient=False)
@@ -544,3 +551,64 @@ class TestInplaceTensorMethodFills:
         x = paddle.to_tensor(np.zeros((2, 3), "float32"))
         assert x.ndimension() == 2
         assert x.inplace_version == 0
+
+
+class TestStaticControlFlow:
+    def test_cond_while_case_switch(self):
+        from paddle_tpu.static import nn as snn
+        t = paddle.to_tensor(np.array(3.0, "float32"))
+        assert float(snn.cond(t > 2, lambda: t * 2,
+                              lambda: t - 1).item()) == 6.0
+        assert float(snn.cond(t > 10, lambda: t * 2,
+                              lambda: t - 1).item()) == 2.0
+        i = paddle.to_tensor(np.array(0, "int64"))
+        s = paddle.to_tensor(np.array(0.0, "float32"))
+        iv, sv = snn.while_loop(lambda i, s: i < 5,
+                                lambda i, s: [i + 1,
+                                              s + float(i.item())],
+                                [i, s])
+        assert int(iv.item()) == 5 and float(sv.item()) == 10.0
+        r = snn.case([(t > 10, lambda: t * 0), (t > 2, lambda: t + 1)])
+        assert float(r.item()) == 4.0
+        w = snn.switch_case(paddle.to_tensor(np.array(1, "int64")),
+                            {0: lambda: t, 1: lambda: t * 3})
+        assert float(w.item()) == 9.0
+        # reference semantics: unmatched index, no default -> the
+        # max-index branch
+        m = snn.switch_case(paddle.to_tensor(np.array(7, "int64")),
+                            {0: lambda: t, 2: lambda: t * 5})
+        assert float(m.item()) == 15.0
+
+    def test_functional_spectral_norm_delegation(self):
+        from paddle_tpu import nn as dynn
+        import paddle_tpu.nn.functional as F
+        paddle.seed(0)
+        sn = dynn.SpectralNorm([4, 5], power_iters=3)
+        wt = paddle.to_tensor(R.randn(4, 5).astype("float32"))
+        out1 = sn(wt)
+        out2 = F.spectral_norm(wt, sn.weight_u, sn.weight_v, dim=0,
+                               power_iters=3)
+        np.testing.assert_allclose(out1.numpy(), out2.numpy(), rtol=1e-6)
+        top_sv = np.linalg.svd(np.asarray(out1.numpy()),
+                               compute_uv=False)[0]
+        assert abs(top_sv - 1.0) < 0.15   # normalized to ~unit sigma
+
+    def test_shard_op_annotates(self):
+        import paddle_tpu.distributed as dist
+        mesh = dist.ProcessMesh(np.arange(8).reshape(8), ["x"])
+        f = dist.shard_op(lambda a: a + 1, mesh,
+                          in_placements=[dist.Shard(0)],
+                          out_placements=[dist.Shard(0)])
+        x = paddle.to_tensor(np.zeros((16, 4), "float32"))
+        y = f(x)
+        assert "x" in str(y.jax().sharding.spec)
+        assert y.placements == [dist.Shard(0)]
+        assert y.is_dist() and not x.is_dist()
+
+    def test_default_convert_fn(self):
+        from paddle_tpu.io import default_convert_fn
+        c = default_convert_fn({"a": np.ones((2, 2), "float32"),
+                                "b": 3, "c": [np.zeros(2)]})
+        assert isinstance(c["a"], paddle.Tensor)
+        assert list(c["a"].shape) == [2, 2]   # NOT batched/stacked
+        assert c["b"] == 3 and isinstance(c["c"][0], paddle.Tensor)
