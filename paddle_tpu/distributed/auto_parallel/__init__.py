@@ -1,0 +1,16 @@
+"""``paddle.distributed.auto_parallel`` package path parity (reference:
+``python/paddle/distributed/auto_parallel/``, UNVERIFIED — mount
+empty). The TPU-native implementation lives in ``distributed.mesh``
+(ProcessMesh/placements over jax.sharding + GSPMD) and
+``distributed.api_static`` (dist.to_static); this package re-exports
+the reference import paths."""
+
+from ..mesh import (Partial, Placement, ProcessMesh, Replicate, Shard,
+                    dtensor_from_fn, get_mesh, reshard, set_mesh,
+                    shard_layer, shard_op, shard_optimizer, shard_tensor)
+from ..auto_parallel_api import Strategy, to_static
+
+__all__ = ["ProcessMesh", "Placement", "Shard", "Replicate", "Partial",
+           "shard_tensor", "shard_layer", "shard_op", "shard_optimizer",
+           "reshard", "dtensor_from_fn", "get_mesh", "set_mesh",
+           "Strategy", "to_static"]

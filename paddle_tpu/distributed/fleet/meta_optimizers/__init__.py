@@ -1,0 +1,10 @@
+"""``fleet.meta_optimizers`` package path parity (reference:
+``python/paddle/distributed/fleet/meta_optimizers/``, UNVERIFIED —
+mount empty). The actual optimizers live in ``fleet.sharding`` /
+``fleet.hybrid_optimizer``; this package re-exports them under the
+reference import paths."""
+
+from ..sharding import DygraphShardingOptimizer
+from ..hybrid_optimizer import HybridParallelOptimizer
+
+__all__ = ["DygraphShardingOptimizer", "HybridParallelOptimizer"]

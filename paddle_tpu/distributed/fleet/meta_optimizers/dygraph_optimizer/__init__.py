@@ -1,0 +1,7 @@
+"""Reference path ``fleet.meta_optimizers.dygraph_optimizer`` — the
+dygraph sharding/hybrid optimizers under their upstream import path."""
+
+from ...sharding import DygraphShardingOptimizer
+from ...hybrid_optimizer import HybridParallelOptimizer
+
+__all__ = ["DygraphShardingOptimizer", "HybridParallelOptimizer"]

@@ -3,7 +3,8 @@
 
 from ...parallel_layers import (ColumnParallelLinear, RowParallelLinear,
                                 VocabParallelEmbedding, ParallelCrossEntropy)
-from .pp_layers import LayerDesc, SharedLayerDesc, PipelineLayer
+from .pp_layers import (LayerDesc, SharedLayerDesc,
+                        LocalSharedLayerDesc, PipelineLayer)
 from .pipeline_parallel import PipelineParallel
 from .context_parallel import (RingFlashAttention, ring_flash_attention,
                                ulysses_attention,

@@ -12,7 +12,8 @@ from __future__ import annotations
 from ....nn.layer.layers import Layer
 from ....nn.layer.container import LayerList
 
-__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer"]
+__all__ = ["LayerDesc", "SharedLayerDesc",
+           "LocalSharedLayerDesc", "PipelineLayer"]
 
 
 class LayerDesc:
@@ -133,3 +134,11 @@ class _SharedLayerRef(Layer):
         if self._forward_func is not None:
             return self._forward_func(target, x)
         return target(x)
+
+
+class LocalSharedLayerDesc(SharedLayerDesc):
+    """Reference ``LocalSharedLayerDesc``: a shared layer whose weight
+    sync group is the LOCAL pipeline-stage replica group. In the
+    compiled-pipeline design shared weights live once in the program
+    (stacked stage weights reference one logical array), so local vs
+    global sharing coincide; kept as a distinct type for parity."""

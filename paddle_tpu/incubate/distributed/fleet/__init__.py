@@ -1,0 +1,46 @@
+"""``paddle.incubate.distributed.fleet`` — pipeline-parallel recompute
+helpers (reference: ``incubate/distributed/fleet/recompute_hybrid.py``
+etc., UNVERIFIED — mount empty). Both desugar to the framework
+recompute (jax.checkpoint): the reference's hybrid variant additionally
+manages cross-rank RNG and comm groups, which the compiled pipeline
+engines own here."""
+
+from ...recompute import recompute as _recompute
+
+__all__ = ["recompute_sequential", "recompute_hybrid"]
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """Run a Sequential (or list of layers) in ``ctx['segments']``
+    rematerialized chunks (reference semantics: each segment's
+    activations recompute in backward)."""
+    segments = int((ctx or {}).get("segments", 1))
+    layers = list(functions)
+    if not layers:
+        return args[0] if len(args) == 1 else args
+    per = max(len(layers) // max(segments, 1), 1)
+    out = args[0]
+
+    def run_chunk(chunk, x):
+        def f(t):
+            for l in chunk:
+                t = l(t)
+            return t
+        # params_from: closure-captured weights must be DIFFERENTIATED
+        # THROUGH the checkpoint, not baked in as constants (without it
+        # every chunk layer's grad is silently None)
+        return _recompute(f, x, params_from=list(chunk))
+
+    for i in range(0, len(layers), per):
+        out = run_chunk(layers[i:i + per], out)
+    return out
+
+
+def recompute_hybrid(ctx, function, *args, params_from=None, **kwargs):
+    """Hybrid-parallel recompute: the reference threads mp/pp RNG
+    trackers and offload knobs through; here those live inside the
+    compiled engines, so this is the framework recompute with the ctx
+    accepted for parity. ``function`` closing over Layers must pass
+    ``params_from=[those layers]`` so their weights get gradients
+    through the checkpoint (same contract as incubate.recompute)."""
+    return _recompute(function, *args, params_from=params_from, **kwargs)

@@ -316,11 +316,13 @@ def default_convert_fn(batch):
     samples through unbatched)."""
     if isinstance(batch, (Tensor,)):
         return batch
-    if isinstance(batch, np.ndarray):
+    if isinstance(batch, (np.ndarray, np.integer, np.floating)):
         import jax.numpy as jnp
         return Tensor(jnp.asarray(batch))
     if isinstance(batch, (int, float)):
         return batch
+    if isinstance(batch, tuple) and hasattr(batch, "_fields"):
+        return type(batch)(*(default_convert_fn(b) for b in batch))
     if isinstance(batch, (list, tuple)):
         return type(batch)(default_convert_fn(b) for b in batch)
     if isinstance(batch, dict):
@@ -484,12 +486,20 @@ class DataLoader:
         self._mp_broken = False   # spawn failed once -> stay on threads
         self._epoch = 0
         self._iterable = isinstance(dataset, IterableDataset)
+        # batch_size=None = NO batching (reference semantics): samples
+        # pass through one by one, converted (not stacked) by
+        # default_convert_fn unless the caller supplied a collate_fn
+        self._unbatched = batch_size is None and batch_sampler is None
+        if self._unbatched and collate_fn is None:
+            self.collate_fn = default_convert_fn
         if self._iterable:
             self.batch_sampler = None
             self.batch_size = batch_size
             self.drop_last = drop_last
         elif batch_sampler is not None:
             self.batch_sampler = batch_sampler
+        elif self._unbatched:
+            self.batch_sampler = None
         else:
             self.batch_sampler = BatchSampler(
                 dataset, shuffle=shuffle, batch_size=batch_size,
@@ -498,10 +508,16 @@ class DataLoader:
     def __len__(self):
         if self._iterable:
             raise TypeError("IterableDataset has no length")
+        if self._unbatched:
+            return len(self.dataset)
         return len(self.batch_sampler)
 
     def _iter_batches(self) -> Iterator:
         if self._iterable:
+            if self._unbatched:
+                for item in self.dataset:
+                    yield self.collate_fn(item)
+                return
             batch = []
             for item in self.dataset:
                 batch.append(item)
@@ -511,11 +527,17 @@ class DataLoader:
             if batch and not self.drop_last:
                 yield self.collate_fn(batch)
             return
+        if self._unbatched:
+            for i in range(len(self.dataset)):
+                yield self.collate_fn(self.dataset[i])
+            return
         for indices in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
-        if self.num_workers <= 0:
+        if self.num_workers <= 0 or self._unbatched:
+            # unbatched pass-through is pure conversion — worker
+            # processes would only add transport cost
             return self._iter_batches()
         if self._iterable:
             return self._iter_prefetch_single()

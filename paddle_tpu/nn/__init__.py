@@ -14,6 +14,7 @@ from .layer.rnn import *  # noqa: F401,F403
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
 from . import utils  # noqa: F401
+from . import quant  # noqa: F401
 from .param_attr import ParamAttr  # noqa: F401
 from ..framework.core import Parameter  # noqa: F401
 

@@ -1,0 +1,120 @@
+"""``paddle.nn.quant`` — weight-only quantization for LLM serving
+(reference: ``python/paddle/nn/quant/quantized_linear.py`` —
+weight_quantize/weight_only_linear/llm_int8_linear; UNVERIFIED, mount
+empty).
+
+TPU-native notes: the reference packs weights into cutlass-friendly
+layouts and runs dedicated GPU kernels. Here the quantized weight is
+plain row-major int8 ([in, out], values in int8 or int4 range) and
+``weight_only_linear`` computes ``(x @ w_q) * scale`` — the dequant
+rides AFTER the matmul as a per-out-channel rescale, which XLA fuses
+into the matmul epilogue (the memory win — int8 weights in HBM — is
+what weight-only quantization is for; the MXU computes in bf16 either
+way). llm_int8's outlier decomposition (threshold-split mixed
+precision) is a GPU-kernel trick; on TPU the same epilogue form is
+used and the threshold is accepted for API parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+_INT_RANGE = {"weight_only_int8": 127.0, "llm.int8": 127.0,
+              "weight_only_int4": 7.0}
+
+
+def weight_quantize(x, algo="weight_only_int8", arch=None,
+                    group_size=-1):
+    """Per-out-channel absmax quantization: x [in, out] float ->
+    (w_q int8 [in, out], scale float32 [out]). int4 values live in
+    [-7, 7] stored one-per-int8 (the reference nibble-packs; the
+    layout is backend-private there too, so parity is (quant, scale)
+    semantics, not bytes)."""
+    if algo not in _INT_RANGE:
+        raise ValueError(f"unknown weight_quantize algo {algo!r}")
+    r = _INT_RANGE[algo]
+
+    def fn(w):
+        wf = w.astype(jnp.float32)
+        if group_size and group_size > 0:
+            k = wf.shape[0]
+            if k % group_size:
+                raise ValueError(
+                    f"in_features {k} not divisible by group_size "
+                    f"{group_size}")
+            g = wf.reshape(k // group_size, group_size, -1)
+            scale = jnp.max(jnp.abs(g), axis=1) / r   # [groups, out]
+            q = jnp.clip(jnp.round(g / jnp.maximum(scale, 1e-8)[:, None]),
+                         -r, r).astype(jnp.int8)
+            return q.reshape(wf.shape), scale
+        scale = jnp.max(jnp.abs(wf), axis=0) / r      # [out]
+        q = jnp.clip(jnp.round(wf / jnp.maximum(scale, 1e-8)),
+                     -r, r).astype(jnp.int8)
+        return q, scale
+
+    return apply(fn, x, n_outputs=2, differentiable=False,
+                 name="weight_quantize")
+
+
+def _dequant(q, s):
+    """Shared dequant math (per-channel [out] or group-wise
+    [groups, out] scales) — ONE home for the group reshape/rescale."""
+    if s.ndim == 2:
+        g = q.reshape(s.shape[0], -1, q.shape[-1])
+        return (g.astype(jnp.float32) * s[:, None, :]).reshape(q.shape)
+    return q.astype(jnp.float32) * s
+
+
+def weight_dequantize(x, scale, algo="weight_only_int8",
+                      out_dtype="float16", group_size=-1):
+    def fn(q, s):
+        return _dequant(q, s).astype(out_dtype)
+
+    return apply(fn, x, scale, differentiable=False,
+                 name="weight_dequantize")
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype="int8", arch=None, group_size=-1):
+    """y = x @ dequant(weight) (+ bias) with the dequant folded into
+    the matmul epilogue for per-channel scales."""
+    args = [x, weight] + ([weight_scale] if weight_scale is not None
+                          else []) + ([bias] if bias is not None else [])
+
+    def fn(xx, w, *rest):
+        i = 0
+        s = None
+        if weight_scale is not None:
+            s = rest[i]
+            i += 1
+        b = rest[i] if bias is not None else None
+        cd = xx.dtype
+        if s is not None and s.ndim == 2:
+            # group-wise scales can't ride the epilogue: dequantize
+            y = jnp.matmul(xx.astype(jnp.float32),
+                           _dequant(w, s)).astype(cd)
+        else:
+            y = jnp.matmul(xx, w.astype(cd))
+            if s is not None:
+                y = (y.astype(jnp.float32) * s).astype(cd)
+        if b is not None:
+            y = y + b.astype(y.dtype)
+        return y
+
+    return apply(fn, *args, name="weight_only_linear")
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold=6.0):
+    """API parity for the LLM.int8 path — on TPU the epilogue-scaled
+    int8 matmul serves both (threshold accepted, not needed: no
+    outlier-split kernels here)."""
+    return weight_only_linear(x, weight, bias=bias,
+                              weight_scale=weight_scale,
+                              weight_dtype="int8")

@@ -606,9 +606,111 @@ class TestStaticControlFlow:
         assert y.is_dist() and not x.is_dist()
 
     def test_default_convert_fn(self):
+        import collections
         from paddle_tpu.io import default_convert_fn
         c = default_convert_fn({"a": np.ones((2, 2), "float32"),
                                 "b": 3, "c": [np.zeros(2)]})
         assert isinstance(c["a"], paddle.Tensor)
         assert list(c["a"].shape) == [2, 2]   # NOT batched/stacked
         assert c["b"] == 3 and isinstance(c["c"][0], paddle.Tensor)
+        Pt = collections.namedtuple("Pt", ["a", "b"])
+        p = default_convert_fn(Pt(a=np.ones(2, "float32"),
+                                  b=np.int64(4)))
+        assert isinstance(p, Pt) and isinstance(p.a, paddle.Tensor)
+        assert isinstance(p.b, paddle.Tensor)  # np scalar converts
+
+    def test_dataloader_batch_size_none(self):
+        from paddle_tpu.io import DataLoader, Dataset
+
+        class DS(Dataset):
+            def __len__(self):
+                return 3
+
+            def __getitem__(self, i):
+                return np.full((2,), i, "float32"), i
+
+        dl = DataLoader(DS(), batch_size=None)
+        assert len(dl) == 3
+        items = list(dl)
+        assert len(items) == 3
+        a0, i0 = items[0]
+        assert isinstance(a0, paddle.Tensor)
+        assert list(a0.shape) == [2]     # unbatched: no stacking dim
+        assert i0 == 0
+
+
+class TestNnQuant:
+    def test_weight_quantize_roundtrip_and_linear(self):
+        from paddle_tpu.nn import quant as Q
+        rng = np.random.RandomState(0)
+        w = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+        x = paddle.to_tensor(rng.randn(4, 16).astype("float32"))
+        b = paddle.to_tensor(rng.randn(8).astype("float32"))
+        qw, scale = Q.weight_quantize(w, algo="weight_only_int8")
+        assert str(qw.dtype) == "int8" and list(scale.shape) == [8]
+        wd = Q.weight_dequantize(qw, scale, out_dtype="float32")
+        np.testing.assert_allclose(wd.numpy(), w.numpy(), atol=2e-2)
+        y = Q.weight_only_linear(x, qw, bias=b, weight_scale=scale)
+        ref = (np.asarray(x.numpy()) @ np.asarray(w.numpy())
+               + np.asarray(b.numpy()))
+        np.testing.assert_allclose(y.numpy(), ref, atol=0.15, rtol=0.05)
+        np.testing.assert_allclose(
+            Q.llm_int8_linear(x, qw, bias=b, weight_scale=scale).numpy(),
+            y.numpy())
+
+    def test_groupwise_and_int4(self):
+        from paddle_tpu.nn import quant as Q
+        rng = np.random.RandomState(1)
+        w = paddle.to_tensor(rng.randn(16, 8).astype("float32"))
+        x = paddle.to_tensor(rng.randn(4, 16).astype("float32"))
+        qg, sg = Q.weight_quantize(w, group_size=4)
+        assert list(sg.shape) == [4, 8]
+        yg = Q.weight_only_linear(x, qg, weight_scale=sg, group_size=4)
+        refg = np.asarray(x.numpy()) @ np.asarray(w.numpy())
+        np.testing.assert_allclose(yg.numpy(), refg, atol=0.15, rtol=0.05)
+        q4, _ = Q.weight_quantize(w, algo="weight_only_int4")
+        assert int(np.abs(np.asarray(q4.numpy())).max()) <= 7
+
+
+class TestIncubateFleetRecompute:
+    def test_recompute_sequential_and_hybrid_parity(self):
+        from paddle_tpu.incubate.distributed.fleet import (
+            recompute_hybrid, recompute_sequential)
+        from paddle_tpu import nn
+        paddle.seed(0)
+        seq = nn.Sequential(nn.Linear(8, 8), nn.ReLU(), nn.Linear(8, 8))
+        x = paddle.to_tensor(R.randn(2, 8).astype("float32"),
+                             stop_gradient=False)
+        y1 = recompute_sequential({"segments": 2}, seq, x)
+        y2 = seq(x)
+        np.testing.assert_allclose(y1.numpy(), y2.numpy(), rtol=1e-6)
+        y1.sum().backward()
+        g1 = np.asarray(x.grad.numpy())
+        # WEIGHT grads must flow through the checkpoint (review round
+        # 5: a closure without params_from silently dropped them)
+        wg1 = {id(p): np.asarray(p.grad.numpy())
+               for p in seq.parameters() if p.grad is not None}
+        assert len(wg1) == len(list(seq.parameters()))
+        x.clear_grad()
+        for p in seq.parameters():
+            p.clear_grad()
+        y2.sum().backward()
+        np.testing.assert_allclose(g1, np.asarray(x.grad.numpy()),
+                                   rtol=1e-6)
+        for p in seq.parameters():
+            np.testing.assert_allclose(wg1[id(p)],
+                                       np.asarray(p.grad.numpy()),
+                                       rtol=1e-5, atol=1e-6)
+        y3 = recompute_hybrid({}, lambda t: seq(t), x,
+                              params_from=[seq])
+        np.testing.assert_allclose(y3.numpy(), y2.numpy(), rtol=1e-6)
+
+    def test_reference_module_paths(self):
+        from paddle_tpu.distributed.auto_parallel import (  # noqa: F401
+            Strategy, shard_tensor)
+        from paddle_tpu.distributed.fleet.meta_optimizers import (  # noqa: F401,E501
+            DygraphShardingOptimizer)
+        from paddle_tpu.distributed.fleet.meta_optimizers.dygraph_optimizer import (  # noqa: F401,E501
+            HybridParallelOptimizer)
+        from paddle_tpu.distributed.fleet.meta_parallel import (  # noqa: F401,E501
+            LocalSharedLayerDesc)
