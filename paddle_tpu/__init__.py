@@ -71,6 +71,7 @@ from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
 from . import device  # noqa: F401
 from . import vision  # noqa: F401
+from . import base  # noqa: F401  (the reference's renamed fluid)
 from . import sparse  # noqa: F401
 from . import version  # noqa: F401
 from . import models  # noqa: F401

@@ -1,0 +1,20 @@
+"""``paddle.base`` — the reference's renamed ``fluid`` package (legacy
+import path used by downstream code: ``paddle.base.core``,
+``paddle.base.framework``, ``paddle.base.unique_name``; UNVERIFIED —
+mount empty). Thin aliases onto this framework's real homes; the C++
+``core`` module's surface maps to the Python framework core."""
+
+import sys as _sys
+
+from .. import framework as framework          # noqa: F401
+from ..framework import core as core           # noqa: F401
+from ..utils import unique_name as unique_name  # noqa: F401
+from ..static import Program, Executor          # noqa: F401
+
+# make `import paddle_tpu.base.core` / `from paddle_tpu.base import
+# core` both resolve like the reference's real submodules
+_sys.modules[__name__ + ".core"] = core
+_sys.modules[__name__ + ".framework"] = framework
+_sys.modules[__name__ + ".unique_name"] = unique_name
+
+__all__ = ["core", "framework", "unique_name", "Program", "Executor"]

@@ -714,3 +714,13 @@ class TestIncubateFleetRecompute:
             HybridParallelOptimizer)
         from paddle_tpu.distributed.fleet.meta_parallel import (  # noqa: F401,E501
             LocalSharedLayerDesc)
+
+
+def test_base_alias_paths():
+    """paddle.base (the renamed fluid) import paths resolve."""
+    import importlib
+    import paddle_tpu  # noqa: F401
+    core = importlib.import_module("paddle_tpu.base.core")
+    assert hasattr(core, "Tensor")
+    from paddle_tpu.base import Program, unique_name  # noqa: F401
+    assert unique_name.generate("x").startswith("x")
