@@ -159,6 +159,21 @@ class MoELayer(Layer):
                                 name="moe_layer_ep_manual")
         elif mesh is not None and ep > 1:
             from jax.sharding import PartitionSpec as P
+            from .....distributed.fleet.base import fleet as _fleet
+            hcg = _fleet._hcg
+            if hcg is not None and hcg.get_sep_parallel_world_size() > 1:
+                # composing the GSPMD-EP shard_map with a live 'sep'
+                # axis CHECK-crashes XLA's SPMD partitioner on this
+                # version (spmd_partitioner_util.h scalar check,
+                # jax 0.9; an explicit pre-reshard constraint does not
+                # avoid it) — reject with a clear error instead of a
+                # process abort. MoE long-context runs use sep via the
+                # compiled pipeline region (ep x pp x sep) or ep-only.
+                raise ValueError(
+                    "ep_degree > 1 with sep_degree > 1 under GSPMD is "
+                    "not supported on this XLA version (SPMD "
+                    "partitioner CHECK failure); drop one axis or "
+                    "compose ep with sep inside the pipeline engine")
 
             def fn(xx, rw, wg, wu, wd):
                 flat = xx.reshape(-1, d)
