@@ -1,5 +1,14 @@
-from .save_load import save_state_dict, load_state_dict, wait_async_save
+from .save_load import (
+    save_state_dict, load_state_dict, wait_async_save,
+    latest_valid_checkpoint, validate_checkpoint, is_committed,
+    gc_checkpoints, load_values, read_state_dict,
+    CheckpointCorruptError, CheckpointNotCommittedError,
+    COMMITTED_SENTINEL)
 from .metadata import Metadata, LocalTensorMetadata
 
 __all__ = ["save_state_dict", "load_state_dict", "wait_async_save",
+           "latest_valid_checkpoint", "validate_checkpoint",
+           "is_committed", "gc_checkpoints", "load_values",
+           "read_state_dict", "CheckpointCorruptError",
+           "CheckpointNotCommittedError", "COMMITTED_SENTINEL",
            "Metadata", "LocalTensorMetadata"]

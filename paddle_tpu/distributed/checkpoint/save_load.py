@@ -2,25 +2,81 @@
 ``load_state_dict`` parity (UNVERIFIED paths
 python/paddle/distributed/checkpoint/save_state_dict.py).
 
-Design (SURVEY.md §5 checkpoint tier 3): each process writes the shards it
-owns (addressable shards of each jax.Array) as .npy files plus a metadata
-json recording global shape + offsets; load reads whatever shards are
-needed and reassembles/re-shards for the target mesh — reshard-on-load
-across different parallelism comes free because we reassemble the global
-array then device_put with the new sharding."""
+Sharding design (SURVEY.md §5 checkpoint tier 3): each process writes
+the shards it owns (addressable shards of each jax.Array) as .npy
+files plus a metadata json recording global shape + offsets; load
+reads whatever shards are needed and reassembles/re-shards for the
+target mesh — reshard-on-load across different parallelism comes free
+because we reassemble the global array then device_put with the new
+sharding.
+
+Crash-safety design (atomic commit protocol): a preempted worker mid-
+save must never leave a directory that load will silently partially
+read. Every save therefore:
+
+1. writes into a ``<path>.tmp-<uid>`` staging directory, every file
+   through :func:`_atomic_write` (stage-to-``.part`` + fsync + size
+   check + rename — enforced by tools/check_atomic_writes.py);
+2. records a SHA-256 per shard file in the per-rank metadata json;
+3. barriers on all ranks' metadata landing in the staging dir
+   (shared-filesystem rendezvous — the same channel the shards use).
+   Multi-process saves share one deterministic staging dir, so a
+   retry after a crash could otherwise satisfy the barrier with a
+   *previous* attempt's leftover files; the coordinator therefore
+   wipes the stale staging dir and stamps a fresh ``ATTEMPT`` token
+   that every rank must echo in its ``ack.<rank>`` before the barrier
+   counts it — stale data can never be committed (worst case the
+   barrier times out and the save fails uncommitted, the safe
+   outcome);
+4. has the coordinator rank write a ``COMMITTED`` sentinel (which
+   checksums the metadata files themselves) and atomically rename the
+   staging dir to the final path.
+
+The rename is the commit point: a crash at ANY earlier instant leaves
+only a ``.tmp-`` dir that :func:`load_state_dict` refuses and
+``latest_valid_checkpoint`` skips. Load verifies the sentinel, the
+metadata checksums, and each shard's SHA-256 before a single byte
+reaches a parameter — a checkpoint either loads bit-exactly or raises
+:class:`CheckpointCorruptError`. Retention (``keep_last_n``)
+garbage-collects superseded committed steps and stale staging dirs
+after each successful commit. Validation/discovery/retention live in
+the jax-free sibling module :mod:`.validation`.
+"""
 
 from __future__ import annotations
 
+import io
 import json
 import os
+import shutil
+import threading
+import time
+import uuid
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ...framework.core import Tensor
+from ...utils.retry import retry_call
+from .validation import (
+    COMMITTED_SENTINEL, CheckpointCorruptError,
+    CheckpointNotCommittedError, _active_stages, _read_file,
+    _read_metas, _sha256, gc_checkpoints, is_committed,
+    latest_valid_checkpoint, validate_checkpoint)
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = [
+    "save_state_dict", "load_state_dict", "wait_async_save",
+    "latest_valid_checkpoint", "validate_checkpoint", "is_committed",
+    "gc_checkpoints", "load_values", "read_state_dict",
+    "CheckpointCorruptError", "CheckpointNotCommittedError",
+    "COMMITTED_SENTINEL",
+]
+
+_FORMAT_VERSION = 1
+
+#: multi-rank attempt token (see module docstring, step 3)
+ATTEMPT_FILE = "ATTEMPT"
 
 
 def _flat(state_dict, prefix=""):
@@ -34,111 +90,200 @@ def _flat(state_dict, prefix=""):
     return out
 
 
+def _unflatten(flatmap):
+    out = {}
+    for k, v in flatmap.items():
+        parts = k.split(".")
+        cur = out
+        for p in parts[:-1]:
+            cur = cur.setdefault(p, {})
+        cur[parts[-1]] = v
+    return out
 
-def _save_np(path, arr):
-    """np.save with non-native dtypes (bfloat16, fp8) stored as byte-width
-    integer views — numpy's npy format cannot round-trip ml_dtypes."""
+
+def _fsync_dir(path):
+    """Best-effort directory fsync so the commit rename survives power
+    loss, not just process death (no-op where unsupported)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def _atomic_write(path, data):
+    """THE write primitive for checkpoint files: serialize fully in
+    memory first (``data`` is bytes), stage to ``<path>.part``, flush +
+    fsync, verify the on-disk size, then atomically rename into place.
+    A short write (torn or silently truncated) either raises here or —
+    if the kernel lies — mismatches the returned SHA-256 at load.
+    Transient I/O errors (ENOSPC freed by GC, EIO blips) are retried
+    with bounded backoff. Returns the SHA-256 of ``data``."""
+    part = path + ".part"
+
+    def _write():
+        with open(part, "wb") as f:  # atomic-ok: the helper itself
+            f.write(data)
+            f.flush()
+            os.fsync(f.fileno())
+        size = os.stat(part).st_size
+        if size != len(data):
+            import errno as _e
+            raise OSError(_e.EIO,
+                          f"short write: {size} != {len(data)}", part)
+        os.replace(part, path)
+
+    retry_call(_write)
+    return _sha256(data)
+
+
+def _np_bytes(arr):
+    """npy-serialize to bytes; non-native dtypes (bfloat16, fp8) are
+    stored as byte-width integer views — numpy's npy format cannot
+    round-trip ml_dtypes."""
     arr = np.asarray(arr)
     if arr.dtype.kind == "V" or str(arr.dtype) in (
             "bfloat16", "float8_e4m3fn", "float8_e5m2"):
-        view = arr.view(np.uint16 if arr.dtype.itemsize == 2 else np.uint8)
-        np.save(path, view)
-    else:
-        np.save(path, arr)
+        arr = arr.view(np.uint16 if arr.dtype.itemsize == 2 else np.uint8)
+    buf = io.BytesIO()
+    np.save(buf, arr)
+    return buf.getvalue()
 
 
-def _load_np(path, dtype_str):
-    data = np.load(path)
+def _np_from_bytes(data, dtype_str):
+    arr = np.load(io.BytesIO(data))
     if dtype_str in ("bfloat16", "float8_e4m3fn", "float8_e5m2"):
         import ml_dtypes
-        data = data.view(np.dtype(getattr(ml_dtypes, dtype_str)))
-    return data
+        arr = arr.view(np.dtype(getattr(ml_dtypes, dtype_str)))
+    return arr
 
+
+# --------------------------------------------------------------------------
+# save: snapshot -> staged write -> barrier -> commit
+# --------------------------------------------------------------------------
 
 _async_threads = []
+_async_errors = []
+
+
+def _raise_pending_async_error():
+    if _async_errors:
+        err = _async_errors[0]
+        _async_errors.clear()
+        raise err
 
 
 def wait_async_save():
-    """Join all outstanding async checkpoint writers (called by tests and
-    before teardown; paddle's async save exposes the same barrier)."""
+    """Join all outstanding async checkpoint writers and re-raise the
+    first failure any of them hit — async saves must not fail
+    silently. (If the caller never waits, the error surfaces on the
+    next ``save_state_dict`` call instead.)"""
     while _async_threads:
         _async_threads.pop().join()
+    _raise_pending_async_error()
 
 
-def save_state_dict(state_dict, path, process_group=None,
-                    coordinator_rank=0, unique_id=None, async_save=False):
-    """Each rank writes the shards it owns + a metadata json (global shape
-    and per-shard offsets). async_save=True snapshots arrays to host, then
-    writes in a background thread (the PaddleNLP unified-checkpoint async
-    pattern)."""
-    if async_save:
-        flat = _flat(state_dict)
-        host = {}
-        for name, t in flat.items():
-            if isinstance(t, Tensor):
-                arr = t._data
-                if isinstance(arr, jax.Array) and \
-                        len(arr.sharding.device_set) > 1:
-                    shards = [(s.index, np.asarray(s.data))
-                              for s in arr.addressable_shards]
-                    host[name] = ("sharded", tuple(arr.shape),
-                                  str(arr.dtype), shards)
-                else:
-                    host[name] = ("full", tuple(arr.shape),
-                                  str(arr.dtype), np.asarray(arr))
-            else:
-                host[name] = ("value", None, None, t)
-        import threading
-        th = threading.Thread(
-            target=_write_snapshot, args=(host, path), daemon=False)
-        th.start()
-        _async_threads.append(th)
-        return
-    os.makedirs(path, exist_ok=True)
-    rank = jax.process_index()
-    meta = {}
-    flat = _flat(state_dict)
-    for name, t in flat.items():
+def _snapshot(state_dict):
+    """Snapshot device arrays to host numpy (shared by sync and async
+    save, so the writer never touches device state)."""
+    host = {}
+    for name, t in _flat(state_dict).items():
         if not isinstance(t, Tensor):
-            meta[name] = {"kind": "value", "value": t}
+            host[name] = ("value", None, None, t)
             continue
         arr = t._data
-        shards = []
-        safe = name.replace("/", "_")
         if isinstance(arr, jax.Array) and len(arr.sharding.device_set) > 1:
-            written = set()
-            for i, shard in enumerate(arr.addressable_shards):
-                idx = shard.index
-                offset = tuple(
-                    (0 if s.start is None else s.start) for s in idx)
-                if offset in written:
-                    continue  # replicated copy
-                written.add(offset)
-                fname = f"{safe}.r{rank}.s{i}.npy"
-                _save_np(os.path.join(path, fname),
-                         np.asarray(shard.data))
-                shards.append({"offset": offset,
-                               "local_shape": list(shard.data.shape),
-                               "file": fname})
+            shards = [(s.index, np.asarray(s.data))
+                      for s in arr.addressable_shards]
+            host[name] = ("sharded", tuple(arr.shape), str(arr.dtype),
+                          shards)
         else:
-            fname = f"{safe}.r{rank}.s0.npy"
-            _save_np(os.path.join(path, fname), np.asarray(arr))
-            shards.append({"offset": [0] * arr.ndim,
-                           "local_shape": list(arr.shape),
-                           "file": fname})
-        meta[name] = {"kind": "tensor",
-                      "global_shape": list(arr.shape),
-                      "dtype": str(arr.dtype),
-                      "shards": shards}
-    with open(os.path.join(path, f"meta.{rank}.json"), "w") as f:
-        json.dump(meta, f)
+            host[name] = ("full", tuple(arr.shape), str(arr.dtype),
+                          np.asarray(arr))
+    return host
 
 
-def _write_snapshot(host, path):
-    """Background writer for async_save: host holds already-snapshotted
-    numpy data, so device arrays are not touched off-thread."""
-    os.makedirs(path, exist_ok=True)
-    rank = jax.process_index()
+def _barrier_timeout():
+    return float(os.environ.get("PADDLE_CKPT_BARRIER_TIMEOUT", "300"))
+
+
+def _wait_for_attempt(stage, timeout):
+    """Non-coordinator entry: wait for the coordinator's ATTEMPT token
+    (which also guarantees any stale staging dir was already wiped —
+    modulo the double-crash race the ack echo closes)."""
+    path = os.path.join(stage, ATTEMPT_FILE)
+    deadline = time.time() + timeout
+    while True:
+        try:
+            return _read_file(path).decode()
+        except OSError:
+            pass
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"timed out after {timeout}s waiting for the "
+                f"coordinator's {ATTEMPT_FILE} token in {stage} — the "
+                f"coordinator likely died before staging began")
+        time.sleep(0.05)
+
+
+def _barrier_on_acks(stage, world, attempt, timeout):
+    """Commit barrier: the coordinator waits until every rank's ack —
+    echoing THIS attempt's token, so a previous crashed attempt's
+    leftovers can never satisfy it — has landed in the staging dir.
+    A dead peer means the barrier times out and the checkpoint stays
+    uncommitted — exactly the safe outcome."""
+    deadline = time.time() + timeout
+    while True:
+        missing = []
+        for r in range(world):
+            try:
+                ok = _read_file(os.path.join(
+                    stage, f"ack.{r}")).decode() == attempt
+            except OSError:
+                ok = False
+            if not ok:
+                missing.append(r)
+        if not missing:
+            return
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"checkpoint commit barrier timed out after {timeout}s "
+                f"waiting for ranks {missing} to acknowledge attempt "
+                f"{attempt}; a peer rank likely died mid-save — "
+                f"staging dir {stage} left uncommitted")
+        time.sleep(0.05)
+
+
+def _commit_rename(stage, final):
+    """Atomically promote the staging dir to the final path. An
+    existing non-empty final checkpoint is moved aside to
+    ``<final>.old`` first and deleted only after the rename lands; if
+    a crash hits between the two renames, the ``.old`` backup is still
+    a committed checkpoint that ``latest_valid_checkpoint`` considers,
+    so an overwrite can never lose the newest committed state."""
+    backup = final + ".old"
+
+    def _rename():
+        if os.path.isdir(final):
+            if os.listdir(final):
+                shutil.rmtree(backup, ignore_errors=True)
+                os.rename(final, backup)
+            else:
+                os.rmdir(final)
+        os.rename(stage, final)
+
+    retry_call(_rename)
+    shutil.rmtree(backup, ignore_errors=True)
+
+
+def _write_rank_files(host, stage, rank):
+    """Write this rank's shards + metadata into the staging dir;
+    returns the metadata file's path."""
     meta = {}
     for name, (kind, shape, dtype, payload) in host.items():
         safe = name.replace("/", "_")
@@ -152,30 +297,172 @@ def _write_snapshot(host, path):
                 offset = tuple(
                     (0 if s.start is None else s.start) for s in idx)
                 if offset in written:
-                    continue
+                    continue  # replicated copy
                 written.add(offset)
                 fname = f"{safe}.r{rank}.s{i}.npy"
-                _save_np(os.path.join(path, fname), data)
-                shards.append({"offset": offset,
+                blob = _np_bytes(data)
+                sha = _atomic_write(os.path.join(stage, fname), blob)
+                shards.append({"offset": list(offset),
                                "local_shape": list(data.shape),
-                               "file": fname})
+                               "file": fname, "sha256": sha,
+                               "nbytes": len(blob)})
         else:
             fname = f"{safe}.r{rank}.s0.npy"
-            _save_np(os.path.join(path, fname), payload)
+            blob = _np_bytes(payload)
+            sha = _atomic_write(os.path.join(stage, fname), blob)
             shards.append({"offset": [0] * len(shape),
-                           "local_shape": list(shape), "file": fname})
+                           "local_shape": list(shape),
+                           "file": fname, "sha256": sha,
+                           "nbytes": len(blob)})
         meta[name] = {"kind": "tensor", "global_shape": list(shape),
                       "dtype": dtype, "shards": shards}
-    with open(os.path.join(path, f"meta.{rank}.json"), "w") as f:
-        json.dump(meta, f)
+    mpath = os.path.join(stage, f"meta.{rank}.json")
+    _atomic_write(mpath, json.dumps(meta).encode())
+    return mpath
 
 
-def _assemble(entry, path):
+def _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n):
+    final = os.path.normpath(path)
+    stage = f"{final}.tmp-{uid}"
+    rank = jax.process_index()
+    world = jax.process_count()
+    timeout = _barrier_timeout()
+    _active_stages.add(stage)
+    try:
+        if world <= 1:
+            # single process: uid is fresh/random, no stale-staging or
+            # rendezvous concerns
+            os.makedirs(stage, exist_ok=True)
+            _write_rank_files(host, stage, rank)
+        elif rank == coordinator_rank:
+            # the shared staging dir may hold a crashed attempt's
+            # leftovers whose metadata would satisfy the barrier and
+            # commit mixed old/new rank data — wipe it and stamp a
+            # fresh token every rank must echo. (A stale shard file
+            # surviving the wipe is harmless: load only reads files
+            # referenced by the fresh metadata.)
+            if os.path.isdir(stage):
+                shutil.rmtree(stage, ignore_errors=True)
+            os.makedirs(stage, exist_ok=True)
+            attempt = uuid.uuid4().hex
+            _atomic_write(os.path.join(stage, ATTEMPT_FILE),
+                          attempt.encode())
+            _write_rank_files(host, stage, rank)
+            _atomic_write(os.path.join(stage, f"ack.{rank}"),
+                          attempt.encode())
+        else:
+            # re-stage if the coordinator wiped the dir under us (we
+            # entered before its cleanup): a mid-write ENOENT or the
+            # token changing is the signal; the coordinator wipes at
+            # most once per save, so one re-stage normally suffices
+            for restage in range(3):
+                attempt = _wait_for_attempt(stage, timeout)
+                try:
+                    _write_rank_files(host, stage, rank)
+                    _atomic_write(os.path.join(stage, f"ack.{rank}"),
+                                  attempt.encode())
+                    if _read_file(os.path.join(
+                            stage, ATTEMPT_FILE)).decode() == attempt:
+                        break
+                except OSError:
+                    if restage == 2:
+                        raise
+            return final
+        if world > 1:
+            _barrier_on_acks(stage, world, attempt, timeout)
+        meta_shas = {}
+        for r in range(world):
+            mname = f"meta.{r}.json"
+            meta_shas[mname] = _sha256(
+                _read_file(os.path.join(stage, mname)))
+        sentinel = {"format": _FORMAT_VERSION, "world_size": world,
+                    "metas": meta_shas}
+        _atomic_write(os.path.join(stage, COMMITTED_SENTINEL),
+                      json.dumps(sentinel).encode())
+        _fsync_dir(stage)
+        _commit_rename(stage, final)
+    finally:
+        _active_stages.discard(stage)
+    parent = os.path.dirname(final) or "."
+    _fsync_dir(parent)
+    # same-step staging leftovers from earlier crashed attempts
+    base = os.path.basename(final)
+    try:
+        for name in os.listdir(parent):
+            full = os.path.join(parent, name)
+            if name.startswith(base + ".tmp-") \
+                    and full not in _active_stages:
+                shutil.rmtree(full, ignore_errors=True)
+    except OSError:
+        pass
+    if keep_last_n is not None:
+        gc_checkpoints(parent, keep_last_n)
+    return final
+
+
+def _write_async(host, path, coordinator_rank, uid, keep_last_n):
+    try:
+        _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n)
+    except BaseException as e:  # noqa: BLE001 — re-raised at the join
+        _async_errors.append(e)
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0, unique_id=None, async_save=False,
+                    keep_last_n=None):
+    """Crash-safe sharded save (module docstring has the full
+    protocol). Each rank writes the shards it owns + a checksummed
+    metadata json into a staging dir; the coordinator rank barriers on
+    all ranks' attempt-stamped acknowledgements, writes the
+    ``COMMITTED`` sentinel, and atomically renames staging to
+    ``path``.
+
+    ``unique_id`` names the staging attempt; multi-process saves
+    without one use a shared deterministic id (all ranks must stage
+    into the same dir without communicating). ``async_save=True``
+    snapshots arrays to host, then stages+commits in a background
+    thread (the PaddleNLP unified-checkpoint async pattern) — failures
+    re-raise from ``wait_async_save`` or the next save call.
+    ``keep_last_n`` garbage-collects older committed ``step_N``
+    siblings (and stale staging dirs) after commit."""
+    _raise_pending_async_error()
+    host = _snapshot(state_dict)
+    if unique_id is not None:
+        uid = str(unique_id)
+    elif jax.process_count() > 1:
+        uid = "shared"
+    else:
+        uid = uuid.uuid4().hex[:8]
+    if async_save:
+        th = threading.Thread(
+            target=_write_async,
+            args=(host, path, coordinator_rank, uid, keep_last_n),
+            daemon=False)
+        th.start()
+        _async_threads.append(th)
+        return
+    _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n)
+
+
+# --------------------------------------------------------------------------
+# load: validate -> assemble -> reshard
+# --------------------------------------------------------------------------
+
+def _assemble(entry, path, name, validate=True):
     shape = tuple(entry["global_shape"])
     dtype = entry["dtype"]
     out = np.zeros(shape, dtype=np.dtype(dtype))
     for sh in entry["shards"]:
-        data = _load_np(os.path.join(path, sh["file"]), dtype)
+        blob = _read_file(os.path.join(path, sh["file"]))
+        expect = sh.get("sha256")
+        if validate and expect:
+            actual = _sha256(blob)
+            if actual != expect:
+                raise CheckpointCorruptError(
+                    f"{path}/{sh['file']} (tensor {name}): shard "
+                    f"checksum mismatch (expected sha256 {expect}, got "
+                    f"{actual}) — refusing to load corrupt data")
+        data = _np_from_bytes(blob, dtype)
         idx = tuple(slice(o, o + l) for o, l in
                     zip(sh["offset"], sh["local_shape"]))
         out[idx] = data
@@ -183,14 +470,16 @@ def _assemble(entry, path):
 
 
 def load_state_dict(state_dict, path, process_group=None,
-                    unique_id=None, offload=False):
-    """In-place load into `state_dict`'s tensors, resharding to each
-    target tensor's current sharding."""
-    metas = {}
-    for fn in sorted(os.listdir(path)):
-        if fn.startswith("meta.") and fn.endswith(".json"):
-            with open(os.path.join(path, fn)) as f:
-                metas.update(json.load(f))
+                    unique_id=None, offload=False, validate=True):
+    """In-place load into ``state_dict``'s tensors, resharding to each
+    target tensor's current sharding. With ``validate=True`` (default)
+    the checkpoint must be committed and every byte read is verified
+    against its recorded SHA-256: the result is bit-exact or an
+    exception — never a silent partial load. ``validate=False`` skips
+    both checks for legacy (pre-sentinel) checkpoint dirs."""
+    if validate:
+        validate_checkpoint(path)
+    metas = _read_metas(path)
     flat = _flat(state_dict)
     for name, t in flat.items():
         entry = metas.get(name)
@@ -198,7 +487,7 @@ def load_state_dict(state_dict, path, process_group=None,
             continue
         if entry["kind"] == "value":
             continue
-        arr = _assemble(entry, path)
+        arr = _assemble(entry, path, name, validate=validate)
         if isinstance(t, Tensor):
             if isinstance(t._data, jax.Array) and \
                     len(t._data.sharding.device_set) > 1:
@@ -211,3 +500,42 @@ def load_state_dict(state_dict, path, process_group=None,
                 arr = arr.astype(t.dtype)
             t.set_data(arr)
     return state_dict
+
+
+def load_values(path, validate=True):
+    """The non-tensor entries of a checkpoint (step counters, epoch,
+    LR-scheduler scalars) as a nested dict — ``load_state_dict`` only
+    fills tensors in place; this returns the rest."""
+    if validate:
+        validate_checkpoint(path)
+    vals = {k: e["value"] for k, e in _read_metas(path).items()
+            if e.get("kind") == "value"}
+    return _unflatten(vals)
+
+
+def read_state_dict(path, prefix=None, validate=True):
+    """Assemble a checkpoint (or the subtree under ``prefix``) into a
+    dict of numpy arrays + values, without needing a target
+    state_dict — the resume path for lazily-created state (optimizer
+    slots that do not exist yet on a fresh process). Keys are the
+    FLAT dotted names (prefix stripped): leaf names may themselves
+    contain dots (parameter names), so re-nesting them is ambiguous
+    and left to the caller."""
+    if validate:
+        validate_checkpoint(path)
+    metas = _read_metas(path)
+    out = {}
+    pre = None if prefix is None else prefix + "."
+    for name, entry in metas.items():
+        if pre is not None:
+            if not name.startswith(pre):
+                continue
+            key = name[len(pre):]
+        else:
+            key = name
+        if entry.get("kind") == "value":
+            out[key] = entry["value"]
+        else:
+            out[key] = np.asarray(
+                _assemble(entry, path, name, validate=validate))
+    return out

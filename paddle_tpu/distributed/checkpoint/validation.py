@@ -1,0 +1,206 @@
+"""Checkpoint validation, discovery and retention — the jax-free half
+of the crash-safe checkpoint layer (save_load.py has the writer).
+
+Everything here needs only os/json/hashlib, so launcher-side watchers
+(`fleet.elastic`, `distributed.launch`) can validate and discover
+checkpoints without touching device state. The protocol contract
+being checked: a committed checkpoint carries a ``COMMITTED`` sentinel
+recording the SHA-256 of every rank's metadata file, and each metadata
+entry records the SHA-256 of every shard file it references.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import shutil
+
+from ...utils.retry import retry_call
+
+__all__ = ["is_committed", "validate_checkpoint",
+           "latest_valid_checkpoint", "gc_checkpoints",
+           "CheckpointCorruptError", "CheckpointNotCommittedError",
+           "COMMITTED_SENTINEL"]
+
+#: sentinel file whose presence (written last, pre-rename) marks a
+#: fully-committed checkpoint directory
+COMMITTED_SENTINEL = "COMMITTED"
+
+#: staging dirs of saves currently in flight in THIS process (async
+#: writers register here) — retention GC must never sweep them, even
+#: when a newer step commits first
+_active_stages = set()
+
+
+class CheckpointCorruptError(RuntimeError):
+    """The checkpoint exists but fails validation (checksum mismatch,
+    missing metadata/shard, unreadable sentinel)."""
+
+
+class CheckpointNotCommittedError(CheckpointCorruptError):
+    """The directory never reached the commit point (no ``COMMITTED``
+    sentinel): a torn / in-progress save, not a loadable checkpoint."""
+
+
+def _sha256(data):
+    return hashlib.sha256(data).hexdigest()
+
+
+def _read_file(path):
+    def _read():
+        with open(path, "rb") as f:
+            return f.read()
+    return retry_call(_read)
+
+
+def _read_metas(path):
+    metas = {}
+    for fn in sorted(os.listdir(path)):
+        if fn.startswith("meta.") and fn.endswith(".json"):
+            metas.update(json.loads(_read_file(
+                os.path.join(path, fn)).decode()))
+    return metas
+
+
+def _step_of(name):
+    """Step number encoded in a ``step_N`` basename, else -1."""
+    if name.startswith("step_"):
+        try:
+            return int(name[len("step_"):])
+        except ValueError:
+            pass
+    return -1
+
+
+def is_committed(path):
+    """True iff ``path`` carries the ``COMMITTED`` sentinel."""
+    return os.path.isfile(os.path.join(path, COMMITTED_SENTINEL))
+
+
+def validate_checkpoint(path, deep=False):
+    """Raise unless ``path`` is a committed checkpoint whose metadata
+    files match the sentinel's checksums; with ``deep=True`` also
+    verify every shard file's SHA-256. Returns the parsed sentinel."""
+    if not os.path.isdir(path):
+        raise CheckpointNotCommittedError(
+            f"{path}: not a checkpoint directory")
+    spath = os.path.join(path, COMMITTED_SENTINEL)
+    if not os.path.isfile(spath):
+        raise CheckpointNotCommittedError(
+            f"{path}: no {COMMITTED_SENTINEL} sentinel — the save never "
+            f"reached its commit point (torn or in-progress checkpoint)")
+    try:
+        sentinel = json.loads(_read_file(spath).decode())
+    except ValueError as e:
+        raise CheckpointCorruptError(
+            f"{path}: unreadable {COMMITTED_SENTINEL} sentinel: {e}")
+    for mname, expect in (sentinel.get("metas") or {}).items():
+        mpath = os.path.join(path, mname)
+        if not os.path.isfile(mpath):
+            raise CheckpointCorruptError(
+                f"{path}: committed sentinel names {mname} but the "
+                f"file is missing")
+        actual = _sha256(_read_file(mpath))
+        if expect and actual != expect:
+            raise CheckpointCorruptError(
+                f"{path}/{mname}: metadata checksum mismatch "
+                f"(expected sha256 {expect}, got {actual})")
+    if deep:
+        for name, entry in _read_metas(path).items():
+            if entry.get("kind") != "tensor":
+                continue
+            for sh in entry["shards"]:
+                fpath = os.path.join(path, sh["file"])
+                if not os.path.isfile(fpath):
+                    raise CheckpointCorruptError(
+                        f"{path}: missing shard {sh['file']} of {name}")
+                expect = sh.get("sha256")
+                if expect:
+                    actual = _sha256(_read_file(fpath))
+                    if actual != expect:
+                        raise CheckpointCorruptError(
+                            f"{path}/{sh['file']}: shard checksum "
+                            f"mismatch (expected sha256 {expect}, got "
+                            f"{actual})")
+    return sentinel
+
+
+def latest_valid_checkpoint(root, deep=False):
+    """Newest ``step_N`` subdirectory of ``root`` that is committed and
+    passes validation — torn, in-progress, and corrupt checkpoints are
+    skipped, so elastic restart / ``Model.fit(resume=True)`` always
+    lands on the last *good* step. ``step_N.old`` move-aside backups
+    (an overwrite crashed between its two renames) are considered
+    after their plain sibling, so that crash window cannot lose the
+    newest committed state. Returns None when nothing valid exists."""
+    if not os.path.isdir(root):
+        return None
+    cands = []
+    for name in os.listdir(root):
+        full = os.path.join(root, name)
+        if not os.path.isdir(full):
+            continue
+        if name.endswith(".old"):
+            s = _step_of(name[:-len(".old")])
+            rank = 0  # backup: tried after the plain dir of the step
+        else:
+            s = _step_of(name)
+            rank = 1
+        if s >= 0:
+            cands.append((s, rank, full))
+    for _, _, full in sorted(cands, reverse=True):
+        try:
+            validate_checkpoint(full, deep=deep)
+            return full
+        except CheckpointCorruptError:
+            continue
+    return None
+
+
+def gc_checkpoints(root, keep_last_n, clean_stale=True):
+    """Retention: keep the newest ``keep_last_n`` *committed*
+    ``step_N`` checkpoints under ``root``; delete older committed
+    steps, plus (``clean_stale``) staging dirs, torn step dirs, and
+    ``.old`` move-aside backups that are older than the newest
+    committed step (never anything newer — that may be a save in
+    progress — and never a staging dir this process is still writing).
+    Returns the removed paths."""
+    if not os.path.isdir(root):
+        return []
+    committed = []
+    for name in os.listdir(root):
+        full = os.path.join(root, name)
+        s = _step_of(name)
+        if s >= 0 and os.path.isdir(full) and is_committed(full):
+            committed.append((s, full))
+    committed.sort(reverse=True)
+    removed = []
+    for _, full in committed[max(0, int(keep_last_n)):]:
+        shutil.rmtree(full, ignore_errors=True)
+        removed.append(full)
+    if clean_stale:
+        newest = committed[0][0] if committed else -1
+        for name in os.listdir(root):
+            full = os.path.join(root, name)
+            if not os.path.isdir(full) or full in removed:
+                continue
+            if full in _active_stages:
+                continue  # a live writer in this process owns it
+            if ".tmp-" in name:
+                s = _step_of(name.split(".tmp-")[0])
+                if 0 <= s <= newest:
+                    shutil.rmtree(full, ignore_errors=True)
+                    removed.append(full)
+            elif name.endswith(".old"):
+                s = _step_of(name[:-len(".old")])
+                plain = full[:-len(".old")]
+                if 0 <= s <= newest and is_committed(plain):
+                    shutil.rmtree(full, ignore_errors=True)
+                    removed.append(full)
+            else:
+                s = _step_of(name)
+                if 0 <= s < newest and not is_committed(full):
+                    shutil.rmtree(full, ignore_errors=True)
+                    removed.append(full)
+    return removed

@@ -16,7 +16,9 @@ SIGTERM → relaunch. Recovery stays checkpoint-restart: see
 """
 
 from .manager import (ElasticManager, ElasticStatus, start_heartbeat,
-                      stop_heartbeat, latest_checkpoint, checkpoint_step)
+                      stop_heartbeat, latest_checkpoint, checkpoint_step,
+                      latest_valid_checkpoint)
 
 __all__ = ["ElasticManager", "ElasticStatus", "start_heartbeat",
-           "stop_heartbeat", "latest_checkpoint", "checkpoint_step"]
+           "stop_heartbeat", "latest_checkpoint", "checkpoint_step",
+           "latest_valid_checkpoint"]

@@ -21,7 +21,8 @@ import threading
 import time
 
 __all__ = ["ElasticManager", "ElasticStatus", "start_heartbeat",
-           "stop_heartbeat", "latest_checkpoint", "checkpoint_step"]
+           "stop_heartbeat", "latest_checkpoint", "checkpoint_step",
+           "latest_valid_checkpoint"]
 
 
 class ElasticStatus(enum.Enum):
@@ -185,17 +186,31 @@ def checkpoint_step(path):
 
 
 def latest_checkpoint(root):
-    """Newest ``step_N`` subdirectory of root (the resume point after a
-    relaunch), or None. Ignores in-progress dirs marked with a
-    ``.tmp`` suffix (async-save convention)."""
+    """Newest ``step_N`` subdirectory of root by name only, or None.
+    Ignores in-progress staging dirs (``.tmp`` / ``.tmp-<uid>``). Does
+    NOT check the checkpoint is loadable — restart paths should prefer
+    :func:`latest_valid_checkpoint`, which skips torn saves."""
     if not os.path.isdir(root):
         return None
     best, best_step = None, -1
     for name in os.listdir(root):
         full = os.path.join(root, name)
-        if not os.path.isdir(full) or name.endswith(".tmp"):
+        if not os.path.isdir(full) or ".tmp" in name:
             continue
         s = checkpoint_step(full)
         if s > best_step:
             best, best_step = full, s
     return best
+
+
+def latest_valid_checkpoint(root, deep=False):
+    """Newest *committed* ``step_N`` checkpoint under root — validated
+    against the atomic-commit protocol (``COMMITTED`` sentinel +
+    metadata checksums), skipping torn/in-progress/corrupt saves, so a
+    relaunch always resumes from the last good step. Delegates to
+    ``distributed.checkpoint.validation`` — the jax-free half of the
+    checkpoint layer, so the launcher-side watcher validates
+    checkpoints without touching device state."""
+    from ...checkpoint.validation import \
+        latest_valid_checkpoint as _latest_valid
+    return _latest_valid(root, deep=deep)

@@ -12,6 +12,8 @@ from __future__ import annotations
 import os
 import shutil
 
+from ....utils.retry import retry_call
+
 __all__ = ["LocalFS", "HDFSClient", "FSFileExistsError",
            "FSFileNotExistsError"]
 
@@ -25,7 +27,10 @@ class FSFileNotExistsError(Exception):
 
 
 class LocalFS:
-    """Local filesystem with the upstream FS client API."""
+    """Local filesystem with the upstream FS client API. Data-moving
+    operations retry transient I/O errors (EIO/EAGAIN/ENOSPC...) with
+    bounded exponential backoff — checkpoint staging over a flaky
+    mount should not die on a single blip."""
 
     def ls_dir(self, fs_path):
         if not self.is_exist(fs_path):
@@ -37,7 +42,7 @@ class LocalFS:
         return dirs, files
 
     def mkdirs(self, fs_path):
-        os.makedirs(fs_path, exist_ok=True)
+        retry_call(os.makedirs, fs_path, exist_ok=True)
 
     def is_dir(self, fs_path):
         return os.path.isdir(fs_path)
@@ -53,8 +58,10 @@ class LocalFS:
             if not exist_ok:
                 raise FSFileExistsError(fs_path)
             return
-        with open(fs_path, "a"):
-            pass
+        def _touch():
+            with open(fs_path, "a"):
+                pass
+        retry_call(_touch)
 
     def delete(self, fs_path):
         if not self.is_exist(fs_path):
@@ -80,14 +87,16 @@ class LocalFS:
         os.rename(src_path, dst_path)
 
     def upload(self, local_path, fs_path):
-        shutil.copy(local_path, fs_path)
+        retry_call(shutil.copy, local_path, fs_path)
 
     def download(self, fs_path, local_path):
-        shutil.copy(fs_path, local_path)
+        retry_call(shutil.copy, fs_path, local_path)
 
     def cat(self, fs_path=None):
-        with open(fs_path, "rb") as fh:
-            return fh.read()
+        def _read():
+            with open(fs_path, "rb") as fh:
+                return fh.read()
+        return retry_call(_read)
 
     def list_dirs(self, fs_path):
         return self.ls_dir(fs_path)[0]

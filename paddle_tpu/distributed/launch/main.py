@@ -37,6 +37,13 @@ def _parse():
                    help="if > 0, watch worker heartbeats (workers call "
                         "fleet.elastic.start_heartbeat) and treat a "
                         "stale rank as a fault -> kill + relaunch")
+    p.add_argument("--checkpoint_dir",
+                   default=os.environ.get("PADDLE_CHECKPOINT_DIR"),
+                   help="checkpoint root holding step_N dirs; each "
+                        "(re)launch round resolves the newest COMMITTED "
+                        "checkpoint (torn saves skipped) and exports it "
+                        "to workers as PADDLE_RESUME_CHECKPOINT / "
+                        "PADDLE_RESUME_STEP")
     p.add_argument("script", help="training script")
     p.add_argument("script_args", nargs=argparse.REMAINDER)
     return p.parse_args()
@@ -155,9 +162,24 @@ def launch_main():
         base = args.rank * args.nproc_per_node
         if manager is not None:
             manager.reset()
+        resume_env = {}
+        if args.checkpoint_dir:
+            # validated auto-resume: point workers at the newest
+            # COMMITTED checkpoint; a save torn by the previous crash
+            # is skipped, so restart recovers the last good step
+            from ..fleet.elastic import (latest_valid_checkpoint,
+                                         checkpoint_step)
+            ck = latest_valid_checkpoint(args.checkpoint_dir)
+            if ck is not None:
+                resume_env = {
+                    "PADDLE_RESUME_CHECKPOINT": ck,
+                    "PADDLE_RESUME_STEP": str(checkpoint_step(ck)),
+                }
+                print(f"paddle_tpu.launch: resuming from {ck}")
         for local in range(args.nproc_per_node):
             rank = base + local
             extra = {"PADDLE_LOCAL_RANK": str(local)}
+            extra.update(resume_env)
             if hb_dir is not None:
                 extra["PADDLE_ELASTIC_HEARTBEAT_DIR"] = hb_dir
                 extra["PADDLE_ELASTIC_HEARTBEAT_RANK"] = str(local)

@@ -54,14 +54,29 @@ class ProgBarLogger(Callback):
 
 
 class ModelCheckpoint(Callback):
-    def __init__(self, save_freq=1, save_dir=None):
+    """Epoch-end checkpointing. By default saves a *committed*
+    ``step_{epoch}`` distributed checkpoint (atomic commit protocol:
+    model + optimizer + epoch; a crash mid-save never leaves a
+    loadable-but-wrong dir) that ``Model.fit(resume=True)`` can
+    auto-resume from, with ``keep_last_n`` retention. ``atomic=False``
+    restores the legacy ``model.save(f"{dir}/{epoch}")`` behavior."""
+
+    def __init__(self, save_freq=1, save_dir=None, keep_last_n=None,
+                 atomic=True):
         super().__init__()
         self.save_freq = save_freq
         self.save_dir = save_dir
+        self.keep_last_n = keep_last_n
+        self.atomic = atomic
 
     def on_epoch_end(self, epoch, logs=None):
         if self.model and self.save_dir and epoch % self.save_freq == 0:
-            self.model.save(f"{self.save_dir}/{epoch}")
+            if self.atomic and hasattr(self.model, "save_checkpoint"):
+                self.model.save_checkpoint(
+                    f"{self.save_dir}/step_{epoch}", epoch=epoch,
+                    keep_last_n=self.keep_last_n)
+            else:
+                self.model.save(f"{self.save_dir}/{epoch}")
 
 
 class EarlyStopping(Callback):

@@ -91,11 +91,35 @@ class Model:
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
-            callbacks=None):
+            callbacks=None, resume=None, keep_last_n=None,
+            legacy_save=True):
+        """Train. ``save_dir`` writes a committed ``step_N``
+        distributed checkpoint per epoch (``keep_last_n`` bounds its
+        retention) plus — unless ``legacy_save=False`` — the upstream
+        ``epoch_N.pdparams`` files. ``resume=True`` restarts from the
+        newest *committed* checkpoint — ``PADDLE_RESUME_CHECKPOINT``
+        if the elastic launcher exported one, else the newest valid
+        ``step_N`` under ``save_dir`` — skipping any save torn by a
+        crash; ``resume=<path>`` loads that checkpoint explicitly."""
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
-        for epoch in range(epochs):
+        start_epoch = 0
+        if resume:
+            ckpt_path = resume if isinstance(resume, str) else None
+            if ckpt_path is None:
+                import os
+                ckpt_path = os.environ.get("PADDLE_RESUME_CHECKPOINT")
+            if ckpt_path is None and save_dir is not None:
+                from ..distributed.checkpoint import \
+                    latest_valid_checkpoint
+                ckpt_path = latest_valid_checkpoint(save_dir)
+            if ckpt_path:
+                start_epoch = self.load_checkpoint(ckpt_path) + 1
+                if verbose:
+                    print(f"resuming from {ckpt_path} "
+                          f"(epoch {start_epoch})")
+        for epoch in range(start_epoch, epochs):
             losses = []
             for step, batch in enumerate(loader):
                 *xs, y = batch if isinstance(batch, (list, tuple)) \
@@ -108,7 +132,11 @@ class Model:
                     print(f"epoch {epoch} step {step}: "
                           f"loss {loss[0]:.5f}")
             if save_dir is not None and epoch % save_freq == 0:
-                self.save(f"{save_dir}/epoch_{epoch}")
+                if legacy_save:
+                    self.save(f"{save_dir}/epoch_{epoch}")
+                self.save_checkpoint(f"{save_dir}/step_{epoch}",
+                                     epoch=epoch,
+                                     keep_last_n=keep_last_n)
             if eval_data is not None and epoch % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size,
                               verbose=verbose)
@@ -140,6 +168,45 @@ class Model:
         save_obj(self.network.state_dict(), path + ".pdparams")
         if training and self._optimizer is not None:
             save_obj(self._optimizer.state_dict(), path + ".pdopt")
+
+    def save_checkpoint(self, path, epoch=None, keep_last_n=None):
+        """Atomic (commit-protocol) checkpoint of model + optimizer +
+        epoch: the directory either appears fully committed or not at
+        all, so a crash mid-save can never corrupt the resume point."""
+        from ..distributed import checkpoint as dckpt
+        state = {"model": self.network.state_dict()}
+        if self._optimizer is not None:
+            state["optimizer"] = self._optimizer.state_dict()
+        if epoch is not None:
+            state["epoch"] = int(epoch)
+        dckpt.save_state_dict(state, path, keep_last_n=keep_last_n)
+
+    def load_checkpoint(self, path):
+        """Validated load of a committed checkpoint (checksums verified;
+        torn/corrupt dirs raise). Returns the epoch recorded at save
+        time, or -1."""
+        from ..distributed import checkpoint as dckpt
+        target = {"model": self.network.state_dict()}
+        dckpt.load_state_dict(target, path)
+        if self._optimizer is not None:
+            # read (not in-place load): optimizer slots are created
+            # lazily, so a fresh process has no target tensors yet —
+            # set_state_dict stashes state until the slots materialize
+            flat = dckpt.read_state_dict(path, prefix="optimizer")
+            opt_state = {}
+            for k, v in flat.items():
+                # the optimizer state dict has exactly one nested
+                # level (LR_Scheduler); other keys are flat slot names
+                # that may themselves contain dots
+                if k.startswith("LR_Scheduler."):
+                    opt_state.setdefault("LR_Scheduler", {})[
+                        k[len("LR_Scheduler."):]] = v
+                else:
+                    opt_state[k] = v
+            if opt_state:
+                self._optimizer.set_state_dict(opt_state)
+        vals = dckpt.load_values(path)
+        return int(vals.get("epoch", -1))
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):
         self.network.set_state_dict(load_obj(path + ".pdparams"))

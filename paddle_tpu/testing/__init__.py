@@ -1,0 +1,11 @@
+"""paddle_tpu.testing — test-support utilities.
+
+``fault_injection`` deterministically injects filesystem faults
+(ENOSPC/EIO, partial writes, crash/pause at a chosen operation) so the
+checkpoint crash-safety guarantees are proven by tests instead of
+asserted in docstrings. See docs/checkpoint_fault_tolerance.md.
+"""
+
+from .fault_injection import FaultInjector, FaultPlan
+
+__all__ = ["FaultInjector", "FaultPlan"]

@@ -1,0 +1,249 @@
+"""Deterministic filesystem fault injection.
+
+Robustness claims ("a save killed mid-write never yields a loadable
+checkpoint") are only worth anything if a test can *produce* the fault
+on demand. ``FaultInjector`` patches ``builtins.open`` (which numpy's
+``np.save``/``np.load`` also route through) plus ``os.replace`` /
+``os.rename``, and fires registered :class:`FaultPlan`\\ s when an
+operation touches a matching path:
+
+- ``action="raise"`` — raise ``OSError(errno)`` (ENOSPC, EIO, ...),
+  optionally after ``after_bytes`` of a write landed (a partial write
+  followed by the error, the torn-write shape).
+- ``action="truncate"`` — write only ``after_bytes`` bytes but report
+  full success: the silent short write that only checksums catch.
+- ``action="crash"`` — ``os._exit(41)``: abrupt process death at an
+  exact operation, indistinguishable from SIGKILL to an observer (no
+  atexit, no buffer flush, no cleanup).
+- ``action="pause"`` — touch ``marker`` then sleep forever, so a
+  parent test process can deliver a *real* SIGKILL at a known point
+  (e.g. between shard write and commit).
+
+Plans match by substring of the path and fire deterministically: each
+plan fires at most ``times`` times, in registration order. Use as a
+context manager so ``builtins.open`` is always restored::
+
+    with FaultInjector() as fi:
+        fi.fail("w.r0.s0.npy", op="write", errno_=errno.ENOSPC)
+        save_state_dict(sd, path)     # first write ENOSPCs, retry wins
+        assert fi.fires() == 1
+"""
+
+from __future__ import annotations
+
+import builtins
+import errno as _errno
+import os
+import threading
+import time
+
+__all__ = ["FaultInjector", "FaultPlan"]
+
+
+class FaultPlan:
+    """One armed fault: fires when ``op`` touches a path containing
+    ``match``, at most ``times`` times."""
+
+    def __init__(self, match, op="write", errno_=_errno.EIO, times=1,
+                 after_bytes=0, action="raise", marker=None):
+        if op not in ("open", "write", "read", "rename"):
+            raise ValueError(f"unknown fault op {op!r}")
+        if action not in ("raise", "truncate", "crash", "pause"):
+            raise ValueError(f"unknown fault action {action!r}")
+        self.match = match
+        self.op = op
+        self.errno = errno_
+        self.times = int(times)
+        self.after_bytes = int(after_bytes)
+        self.action = action
+        self.marker = marker
+        self.fired = 0
+
+    def __repr__(self):
+        return (f"FaultPlan({self.match!r}, op={self.op}, "
+                f"action={self.action}, fired={self.fired}/{self.times})")
+
+
+class _FaultFile:
+    """File proxy that consults the injector on write()/read()."""
+
+    def __init__(self, f, path, injector):
+        self._f = f
+        self._path = path
+        self._inj = injector
+        self._written = 0
+        self._truncated = False
+
+    def write(self, data):
+        if self._truncated:
+            return len(data)  # silently dropped tail of a short write
+        plan = self._inj._take(self._path, "write",
+                               pending=self._written + len(data))
+        if plan is not None:
+            keep = max(0, plan.after_bytes - self._written)
+            if keep:
+                self._f.write(data[:keep])
+                self._written += keep
+            if plan.action == "truncate":
+                self._truncated = True
+                return len(data)  # lie: report full success
+            self._inj._act(plan, self._path)  # raise / crash / pause
+        n = self._f.write(data)
+        self._written += len(data)
+        return n
+
+    def read(self, *args):
+        plan = self._inj._take(self._path, "read")
+        if plan is not None:
+            self._inj._act(plan, self._path)
+        return self._f.read(*args)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self._f.close()
+        return False
+
+    def __iter__(self):
+        return iter(self._f)
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+class FaultInjector:
+    """Installable fault plan registry (see module docstring)."""
+
+    def __init__(self):
+        self.plans = []
+        self._lock = threading.Lock()
+        self._installed = False
+        self._real_open = None
+        self._real_replace = None
+        self._real_rename = None
+
+    # -- arming ------------------------------------------------------------
+
+    def fail(self, match, op="write", errno_=_errno.EIO, times=1,
+             after_bytes=0, action="raise", marker=None):
+        plan = FaultPlan(match, op=op, errno_=errno_, times=times,
+                         after_bytes=after_bytes, action=action,
+                         marker=marker)
+        self.plans.append(plan)
+        return plan
+
+    def fail_write(self, match, errno_=_errno.ENOSPC, times=1,
+                   after_bytes=0):
+        """Nth write to a matching path raises OSError(errno_) after
+        ``after_bytes`` bytes actually landed (partial write)."""
+        return self.fail(match, op="write", errno_=errno_, times=times,
+                         after_bytes=after_bytes)
+
+    def fail_read(self, match, errno_=_errno.EIO, times=1):
+        return self.fail(match, op="read", errno_=errno_, times=times)
+
+    def truncate_write(self, match, after_bytes):
+        """Silent short write: only ``after_bytes`` land, success is
+        reported — detectable only by size/checksum validation."""
+        return self.fail(match, op="write", after_bytes=after_bytes,
+                         action="truncate")
+
+    def crash(self, match, op="open", after_bytes=0):
+        """os._exit(41) when ``op`` touches a matching path."""
+        return self.fail(match, op=op, action="crash",
+                         after_bytes=after_bytes)
+
+    def pause(self, match, op="open", marker=None):
+        """Touch ``marker`` then sleep forever at the matching
+        operation so the test harness can SIGKILL this process at an
+        exact point."""
+        return self.fail(match, op=op, action="pause", marker=marker)
+
+    def fires(self):
+        """Total number of times any plan fired."""
+        return sum(p.fired for p in self.plans)
+
+    # -- plan matching / actions -------------------------------------------
+
+    def _take(self, path, op, pending=None):
+        """Claim the first live plan matching (path, op); for writes,
+        only once the byte threshold is actually reached."""
+        with self._lock:
+            for plan in self.plans:
+                if plan.fired >= plan.times or plan.op != op:
+                    continue
+                if plan.match not in path:
+                    continue
+                if (op == "write" and pending is not None
+                        and pending <= plan.after_bytes):
+                    continue  # threshold not reached yet this write
+                plan.fired += 1
+                return plan
+        return None
+
+    def _act(self, plan, path):
+        if plan.action == "crash":
+            os._exit(41)
+        if plan.action == "pause":
+            if plan.marker:
+                with self._real_open(plan.marker, "w") as m:
+                    m.write(path)
+            while True:
+                time.sleep(60)
+        raise OSError(plan.errno,
+                      f"fault injected ({plan.op} -> {plan.action})", path)
+
+    # -- patching ----------------------------------------------------------
+
+    def _open(self, file, mode="r", *args, **kwargs):
+        path = None
+        if isinstance(file, (str, bytes, os.PathLike)):
+            path = os.fsdecode(os.fspath(file))
+        if path is not None:
+            plan = self._take(path, "open")
+            if plan is not None:
+                self._act(plan, path)
+        f = self._real_open(file, mode, *args, **kwargs)
+        if path is not None and any(
+                p.op in ("write", "read") and p.fired < p.times
+                and p.match in path for p in self.plans):
+            return _FaultFile(f, path, self)
+        return f
+
+    def _rename_like(self, real):
+        def patched(src, dst, **kwargs):
+            for p in (os.fspath(src), os.fspath(dst)):
+                sp = os.fsdecode(p) if isinstance(p, bytes) else str(p)
+                plan = self._take(sp, "rename")
+                if plan is not None:
+                    self._act(plan, sp)
+            return real(src, dst, **kwargs)
+        return patched
+
+    def install(self):
+        if self._installed:
+            return self
+        self._real_open = builtins.open
+        self._real_replace = os.replace
+        self._real_rename = os.rename
+        builtins.open = self._open
+        os.replace = self._rename_like(self._real_replace)
+        os.rename = self._rename_like(self._real_rename)
+        self._installed = True
+        return self
+
+    def uninstall(self):
+        if not self._installed:
+            return
+        builtins.open = self._real_open
+        os.replace = self._real_replace
+        os.rename = self._real_rename
+        self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False

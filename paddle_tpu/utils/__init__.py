@@ -3,7 +3,8 @@ UNVERIFIED paths; see SURVEY.md provenance warning).
 
 Provides: ``deprecated``, ``try_import``, ``run_check``, ``unique_name``,
 ``dlpack`` (zero-copy jax interop), ``flatten``/``pack_sequence_as`` pytree
-helpers, and a ``download`` shim (offline environment — local cache only).
+helpers, a ``download`` shim (offline environment — local cache only),
+and ``retry`` (bounded exponential backoff for transient I/O faults).
 """
 
 from __future__ import annotations
@@ -17,6 +18,8 @@ from . import dlpack  # noqa: F401
 from . import download  # noqa: F401
 from . import cpp_extension  # noqa: F401
 from . import monitor  # noqa: F401
+from . import retry  # noqa: F401
+from .retry import retry_call, retryable  # noqa: F401
 
 
 def deprecated(update_to="", since="", reason="", level=0):

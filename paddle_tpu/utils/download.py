@@ -13,26 +13,54 @@ import hashlib
 import os
 import os.path as osp
 
+from .retry import retry_call
+
 WEIGHTS_HOME = os.environ.get(
     "PADDLE_TPU_HOME", osp.join(osp.expanduser("~"), ".cache", "paddle_tpu"))
+
+
+class CorruptCacheError(RuntimeError):
+    """A cached file exists but fails its md5 check — distinct from
+    "not found" so the user knows to delete the corrupt copy rather
+    than hunt for a missing one."""
+
+    def __init__(self, path, expected, actual):
+        self.path = path
+        self.expected = expected
+        self.actual = actual
+        super().__init__(
+            f"cached file '{path}' is corrupt: md5 mismatch (expected "
+            f"{expected}, got {actual}). Delete it and place a good "
+            f"copy there (this environment has no network access).")
+
+
+def _md5(path):
+    def _read():
+        h = hashlib.md5()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        return h.hexdigest()
+    return retry_call(_read)
 
 
 def _md5check(path, md5sum=None):
     if md5sum is None:
         return True
-    h = hashlib.md5()
-    with open(path, "rb") as f:
-        for chunk in iter(lambda: f.read(1 << 20), b""):
-            h.update(chunk)
-    return h.hexdigest() == md5sum
+    return _md5(path) == md5sum
 
 
 def get_path_from_url(url, root_dir=None, md5sum=None, check_exist=True):
     root_dir = root_dir or WEIGHTS_HOME
     fname = osp.split(url)[-1]
     path = osp.join(root_dir, fname)
-    if osp.exists(path) and (not check_exist or _md5check(path, md5sum)):
-        return path
+    if osp.exists(path):
+        if not check_exist or md5sum is None:
+            return path
+        actual = _md5(path)
+        if actual == md5sum:
+            return path
+        raise CorruptCacheError(path, md5sum, actual)
     raise RuntimeError(
         f"'{fname}' not found in local cache ({root_dir}) and this "
         f"environment has no network access. Place the file there manually "
@@ -43,4 +71,5 @@ def get_weights_path_from_url(url, md5sum=None):
     return get_path_from_url(url, WEIGHTS_HOME, md5sum)
 
 
-__all__ = ["get_path_from_url", "get_weights_path_from_url", "WEIGHTS_HOME"]
+__all__ = ["get_path_from_url", "get_weights_path_from_url",
+           "WEIGHTS_HOME", "CorruptCacheError"]

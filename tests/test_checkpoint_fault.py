@@ -1,0 +1,442 @@
+"""Crash-safe checkpointing: atomic commit protocol, validated load,
+torn-save discovery, retention GC — every guarantee proven by an
+injected fault (paddle_tpu.testing.fault_injection) or a real SIGKILL
+mid-save, per the acceptance bar: a save killed at an arbitrary point
+never yields a loadable-but-wrong checkpoint."""
+
+import errno
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.testing import FaultInjector
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+
+
+def _sd(value, shape=(4, 4)):
+    return {"w": paddle.to_tensor(np.full(shape, float(value),
+                                          np.float32)),
+            "step": int(value)}
+
+
+def _target(shape=(4, 4)):
+    return {"w": paddle.to_tensor(np.zeros(shape, np.float32)),
+            "step": 0}
+
+
+# --------------------------------------------------------------------------
+# commit protocol basics
+# --------------------------------------------------------------------------
+
+def test_save_commits_sentinel_and_cleans_staging(tmp_path):
+    path = tmp_path / "ck"
+    ckpt.save_state_dict(_sd(1), str(path))
+    assert ckpt.is_committed(str(path))
+    sentinel = json.loads((path / "COMMITTED").read_bytes())
+    assert sentinel["world_size"] == 1
+    assert "meta.0.json" in sentinel["metas"]
+    # no staging or partial files survive a successful commit
+    leftovers = [n for n in os.listdir(tmp_path) if ".tmp-" in n]
+    assert leftovers == []
+    assert not any(n.endswith(".part") for n in os.listdir(path))
+    target = _target()
+    ckpt.load_state_dict(target, str(path))
+    np.testing.assert_array_equal(target["w"].numpy(),
+                                  np.full((4, 4), 1.0, np.float32))
+
+
+def test_load_refuses_uncommitted_dir(tmp_path):
+    path = tmp_path / "ck"
+    ckpt.save_state_dict(_sd(1), str(path))
+    os.remove(path / "COMMITTED")
+    with pytest.raises(ckpt.CheckpointNotCommittedError,
+                       match="COMMITTED"):
+        ckpt.load_state_dict(_target(), str(path))
+    # escape hatch for legacy (pre-sentinel) checkpoint dirs
+    target = _target()
+    ckpt.load_state_dict(target, str(path), validate=False)
+    np.testing.assert_array_equal(target["w"].numpy(),
+                                  np.full((4, 4), 1.0, np.float32))
+
+
+def test_load_refuses_corrupt_shard(tmp_path):
+    path = tmp_path / "ck"
+    ckpt.save_state_dict(_sd(1), str(path))
+    shard = next(p for p in path.iterdir() if p.name.endswith(".npy"))
+    blob = bytearray(shard.read_bytes())
+    blob[-1] ^= 0xFF  # flip one payload byte
+    shard.write_bytes(bytes(blob))
+    with pytest.raises(ckpt.CheckpointCorruptError, match="sha256"):
+        ckpt.load_state_dict(_target(), str(path))
+
+
+def test_validate_refuses_tampered_metadata(tmp_path):
+    path = tmp_path / "ck"
+    ckpt.save_state_dict(_sd(1), str(path))
+    meta = path / "meta.0.json"
+    meta.write_bytes(meta.read_bytes() + b" ")
+    with pytest.raises(ckpt.CheckpointCorruptError,
+                       match="metadata checksum"):
+        ckpt.validate_checkpoint(str(path))
+
+
+def test_overwrite_existing_checkpoint(tmp_path):
+    path = tmp_path / "ck"
+    ckpt.save_state_dict(_sd(1), str(path))
+    ckpt.save_state_dict(_sd(2), str(path))
+    assert ckpt.is_committed(str(path))
+    target = _target()
+    ckpt.load_state_dict(target, str(path))
+    np.testing.assert_array_equal(target["w"].numpy(),
+                                  np.full((4, 4), 2.0, np.float32))
+    assert not os.path.isdir(str(path) + ".old")
+
+
+# --------------------------------------------------------------------------
+# discovery + retention
+# --------------------------------------------------------------------------
+
+def test_latest_valid_checkpoint_skips_torn(tmp_path):
+    ckpt.save_state_dict(_sd(1), str(tmp_path / "step_1"))
+    ckpt.save_state_dict(_sd(3), str(tmp_path / "step_3"))
+    # step_5: torn — committed then sentinel lost (bypassed protocol)
+    ckpt.save_state_dict(_sd(5), str(tmp_path / "step_5"))
+    os.remove(tmp_path / "step_5" / "COMMITTED")
+    # step_4: crash mid-save left only a staging dir
+    os.makedirs(tmp_path / "step_4.tmp-dead")
+    best = ckpt.latest_valid_checkpoint(str(tmp_path))
+    assert best is not None and os.path.basename(best) == "step_3"
+    # deep validation also skips a committed-but-bit-rotted checkpoint
+    shard = next(p for p in (tmp_path / "step_3").iterdir()
+                 if p.name.endswith(".npy"))
+    blob = bytearray(shard.read_bytes())
+    blob[-1] ^= 0xFF
+    shard.write_bytes(bytes(blob))
+    best = ckpt.latest_valid_checkpoint(str(tmp_path), deep=True)
+    assert best is not None and os.path.basename(best) == "step_1"
+    assert ckpt.latest_valid_checkpoint(str(tmp_path / "missing")) is None
+
+
+def test_retention_gc_keep_last_n(tmp_path):
+    for s in range(1, 6):
+        ckpt.save_state_dict(_sd(s), str(tmp_path / f"step_{s}"),
+                             keep_last_n=2)
+    assert sorted(os.listdir(tmp_path)) == ["step_4", "step_5"]
+    # stale staging dirs + older torn dirs are swept; newer ones
+    # (possibly in-progress) are preserved
+    os.makedirs(tmp_path / "step_3.tmp-dead")
+    os.makedirs(tmp_path / "step_2")
+    os.makedirs(tmp_path / "step_9.tmp-live")
+    removed = ckpt.gc_checkpoints(str(tmp_path), 2)
+    assert sorted(os.path.basename(r) for r in removed) == \
+        ["step_2", "step_3.tmp-dead"]
+    assert sorted(os.listdir(tmp_path)) == \
+        ["step_4", "step_5", "step_9.tmp-live"]
+
+
+def test_gc_spares_active_staging_dirs(tmp_path):
+    """Retention must never sweep a staging dir a live writer in this
+    process still owns — even one for an older step than the newest
+    committed checkpoint (async saves can complete out of order)."""
+    from paddle_tpu.distributed.checkpoint import validation
+    ckpt.save_state_dict(_sd(6), str(tmp_path / "step_6"))
+    live = str(tmp_path / "step_5.tmp-live")
+    os.makedirs(live)
+    validation._active_stages.add(live)
+    try:
+        removed = ckpt.gc_checkpoints(str(tmp_path), 2)
+        assert removed == []
+        assert os.path.isdir(live)
+    finally:
+        validation._active_stages.discard(live)
+    # once the writer is gone, the same dir is sweepable
+    assert ckpt.gc_checkpoints(str(tmp_path), 2) == [live]
+
+
+def test_crashed_overwrite_recovers_from_old_backup(tmp_path):
+    """Overwrite moves the existing committed checkpoint aside to
+    `<path>.old` before the commit rename; if a crash hits between
+    the two renames, discovery still finds the backup."""
+    path = tmp_path / "step_5"
+    ckpt.save_state_dict(_sd(5), str(path))
+    # simulate the crash window: final moved aside, new data stuck in
+    # staging, commit rename never happened
+    os.rename(path, str(path) + ".old")
+    os.makedirs(str(path) + ".tmp-dead")
+    best = ckpt.latest_valid_checkpoint(str(tmp_path))
+    assert best == str(path) + ".old"
+    target = _target()
+    ckpt.load_state_dict(target, best)
+    np.testing.assert_array_equal(target["w"].numpy(),
+                                  np.full((4, 4), 5.0, np.float32))
+    # a successful re-save supersedes and GC sweeps the backup
+    ckpt.save_state_dict(_sd(6), str(path), keep_last_n=2)
+    assert sorted(os.listdir(tmp_path)) == ["step_5"]
+
+
+def test_multirank_stale_staging_cannot_mix_attempts(tmp_path,
+                                                     monkeypatch):
+    """The loadable-but-wrong hole: a 2-rank save crashes after rank 1
+    staged its metadata, the job relaunches and re-saves the same step
+    — the commit barrier must NOT be satisfied by the stale rank-1
+    files. The coordinator wipes the staging dir and stamps a fresh
+    ATTEMPT token each rank must echo."""
+    import threading
+    from paddle_tpu.distributed.checkpoint import save_load
+
+    final = tmp_path / "step_2"
+    stage = str(final) + ".tmp-shared"
+    # crashed previous attempt: rank 1's stale shard + meta + ack
+    os.makedirs(stage)
+    stale_blob = save_load._np_bytes(
+        np.full((4, 4), -99.0, np.float32))
+    with open(os.path.join(stage, "stale.r1.s0.npy"), "wb") as f:
+        f.write(stale_blob)
+    stale_meta = {"stale": {"kind": "tensor", "global_shape": [4, 4],
+                            "dtype": "float32",
+                            "shards": [{"offset": [0, 0],
+                                        "local_shape": [4, 4],
+                                        "file": "stale.r1.s0.npy"}]}}
+    with open(os.path.join(stage, "meta.1.json"), "w") as f:
+        json.dump(stale_meta, f)
+    for name, content in (("ATTEMPT", "staletoken"),
+                          ("ack.1", "staletoken")):
+        with open(os.path.join(stage, name), "w") as f:
+            f.write(content)
+
+    monkeypatch.setattr(save_load.jax, "process_count", lambda: 2)
+    monkeypatch.setenv("PADDLE_CKPT_BARRIER_TIMEOUT", "30")
+    errors = []
+
+    def coordinator():
+        try:
+            ckpt.save_state_dict(_sd(2), str(final))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    th = threading.Thread(target=coordinator)
+    th.start()
+    try:
+        # play rank 1: wait for the coordinator's FRESH attempt token
+        # (proving the stale dir was wiped), then stage rank-1 files
+        deadline = time.time() + 20
+        attempt = None
+        while time.time() < deadline:
+            try:
+                tok = open(os.path.join(stage, "ATTEMPT")).read()
+            except OSError:
+                tok = None
+            if tok and tok != "staletoken":
+                attempt = tok
+                break
+            assert th.is_alive() or not errors, errors
+            time.sleep(0.02)
+        assert attempt, "coordinator never stamped a fresh attempt"
+        assert not os.path.exists(os.path.join(stage, "stale.r1.s0.npy"))
+        blob = save_load._np_bytes(np.full((2, 4), 2.0, np.float32))
+        sha = save_load._atomic_write(
+            os.path.join(stage, "w.r1.s0.npy"), blob)
+        meta = {"w": {"kind": "tensor", "global_shape": [4, 4],
+                      "dtype": "float32",
+                      "shards": [{"offset": [2, 0],
+                                  "local_shape": [2, 4],
+                                  "file": "w.r1.s0.npy",
+                                  "sha256": sha}]}}
+        save_load._atomic_write(os.path.join(stage, "meta.1.json"),
+                                json.dumps(meta).encode())
+        save_load._atomic_write(os.path.join(stage, "ack.1"),
+                                attempt.encode())
+    finally:
+        th.join(timeout=60)
+    assert not errors, errors
+    assert ckpt.is_committed(str(final))
+    sentinel = ckpt.validate_checkpoint(str(final))
+    assert sentinel["world_size"] == 2
+    # nothing from the stale attempt survived into the commit
+    assert "stale.r1.s0.npy" not in os.listdir(final)
+    assert "stale" not in ckpt.read_state_dict(str(final))
+
+
+# --------------------------------------------------------------------------
+# injected faults
+# --------------------------------------------------------------------------
+
+@pytest.mark.fault
+def test_enospc_then_retry(tmp_path):
+    """A transient ENOSPC partway through a shard write (e.g. freed by
+    a concurrent GC) is retried with backoff and the save commits."""
+    path = tmp_path / "ck"
+    with FaultInjector() as fi:
+        plan = fi.fail_write("w.r0.s0.npy", errno_=errno.ENOSPC,
+                             after_bytes=16)
+        ckpt.save_state_dict(_sd(7), str(path))
+    assert plan.fired == 1
+    assert ckpt.is_committed(str(path))
+    target = _target()
+    ckpt.load_state_dict(target, str(path))
+    np.testing.assert_array_equal(target["w"].numpy(),
+                                  np.full((4, 4), 7.0, np.float32))
+
+
+@pytest.mark.fault
+def test_persistent_enospc_fails_without_commit(tmp_path):
+    """When the fault does NOT clear, the save raises after bounded
+    retries and no committed checkpoint appears — never a torn one."""
+    path = tmp_path / "ck"
+    with FaultInjector() as fi:
+        fi.fail_write("w.r0.s0.npy", errno_=errno.ENOSPC, times=100)
+        with pytest.raises(OSError) as ei:
+            ckpt.save_state_dict(_sd(7), str(path))
+        assert ei.value.errno == errno.ENOSPC
+        assert fi.fires() == 4  # initial attempt + 3 retries
+    assert not os.path.exists(path)
+    assert ckpt.latest_valid_checkpoint(str(tmp_path)) is None
+
+
+@pytest.mark.fault
+def test_silent_short_write_caught_by_size_check(tmp_path):
+    """A write that silently drops its tail (reports success) is
+    caught by _atomic_write's size verification and retried."""
+    path = tmp_path / "ck"
+    with FaultInjector() as fi:
+        plan = fi.truncate_write("w.r0.s0.npy", after_bytes=32)
+        ckpt.save_state_dict(_sd(9), str(path))
+    assert plan.fired == 1
+    target = _target()
+    ckpt.load_state_dict(target, str(path))
+    np.testing.assert_array_equal(target["w"].numpy(),
+                                  np.full((4, 4), 9.0, np.float32))
+
+
+@pytest.mark.fault
+def test_transient_read_fault_retried_on_load(tmp_path):
+    path = tmp_path / "ck"
+    ckpt.save_state_dict(_sd(3), str(path))
+    with FaultInjector() as fi:
+        plan = fi.fail_read("w.r0.s0.npy", errno_=errno.EIO)
+        target = _target()
+        ckpt.load_state_dict(target, str(path))
+    assert plan.fired == 1
+    np.testing.assert_array_equal(target["w"].numpy(),
+                                  np.full((4, 4), 3.0, np.float32))
+
+
+# --------------------------------------------------------------------------
+# async save error propagation
+# --------------------------------------------------------------------------
+
+def test_async_save_failure_reraises_on_wait(tmp_path):
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    ckpt.save_state_dict(_sd(1), str(blocker / "ck"), async_save=True)
+    with pytest.raises(OSError):
+        ckpt.wait_async_save()
+    ckpt.wait_async_save()  # error consumed; barrier is clean again
+
+
+def test_async_save_failure_surfaces_on_next_save(tmp_path):
+    from paddle_tpu.distributed.checkpoint import save_load
+    blocker = tmp_path / "blocker"
+    blocker.write_text("not a directory")
+    ckpt.save_state_dict(_sd(1), str(blocker / "ck"), async_save=True)
+    for th in list(save_load._async_threads):
+        th.join()
+    with pytest.raises(OSError):
+        ckpt.save_state_dict(_sd(2), str(tmp_path / "ok"))
+    # error consumed: the save path works again
+    ckpt.save_state_dict(_sd(2), str(tmp_path / "ok"))
+    assert ckpt.is_committed(str(tmp_path / "ok"))
+    ckpt.wait_async_save()
+
+
+def test_async_save_commits_atomically(tmp_path):
+    path = tmp_path / "step_8"
+    ckpt.save_state_dict(_sd(8), str(path), async_save=True)
+    ckpt.wait_async_save()
+    assert ckpt.is_committed(str(path))
+    assert ckpt.latest_valid_checkpoint(str(tmp_path)) == str(path)
+
+
+# --------------------------------------------------------------------------
+# SIGKILL between shard write and commit (subprocess)
+# --------------------------------------------------------------------------
+
+CRASH_MID_SAVE = """
+import os, sys
+sys.path.insert(0, {repo!r})
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.testing import FaultInjector
+
+root, marker = sys.argv[1], sys.argv[2]
+sd = lambda v: {{"w": paddle.to_tensor(np.full((4, 4), float(v),
+                                               np.float32)),
+                 "step": v}}
+ckpt.save_state_dict(sd(1), os.path.join(root, "step_1"))
+fi = FaultInjector()
+# pause when the COMMITTED sentinel is about to be written: all shards
+# + metadata are on disk, the commit has not happened — the parent
+# SIGKILLs us exactly here
+fi.pause("COMMITTED", op="open", marker=marker)
+fi.install()
+ckpt.save_state_dict(sd(2), os.path.join(root, "step_2"))
+open(os.path.join(root, "UNREACHABLE"), "w").write("save returned")
+"""
+
+
+@pytest.mark.fault
+def test_sigkill_between_shard_write_and_commit(tmp_path):
+    script = tmp_path / "crash_mid_save.py"
+    script.write_text(CRASH_MID_SAVE.format(repo=REPO))
+    root = tmp_path / "ckpts"
+    root.mkdir()
+    marker = str(tmp_path / "paused")
+    log = open(tmp_path / "child.log", "w")
+    proc = subprocess.Popen(
+        [sys.executable, str(script), str(root), marker],
+        env=ENV, stdout=log, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.time() + 120
+        while not os.path.exists(marker):
+            assert proc.poll() is None, (
+                "child exited before reaching the commit point:\n"
+                + (tmp_path / "child.log").read_text())
+            assert time.time() < deadline, "child never reached commit"
+            time.sleep(0.05)
+        proc.kill()  # SIGKILL between shard write and commit
+        proc.wait(timeout=30)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        log.close()
+    assert not (root / "UNREACHABLE").exists()
+    # the final dir never appeared; only an uncommitted staging dir
+    assert not (root / "step_2").exists()
+    torn = [n for n in os.listdir(root) if n.startswith("step_2.tmp-")]
+    assert torn, f"expected a torn staging dir, got {os.listdir(root)}"
+    torn_dir = root / torn[0]
+    assert not ckpt.is_committed(str(torn_dir))
+    assert any(n.endswith(".npy") for n in os.listdir(torn_dir)), \
+        "shards should have been written before the pause point"
+    # load refuses the torn directory...
+    with pytest.raises(ckpt.CheckpointNotCommittedError):
+        ckpt.load_state_dict(_target(), str(torn_dir))
+    # ...and discovery resumes from the prior committed step
+    best = ckpt.latest_valid_checkpoint(str(root))
+    assert best is not None and os.path.basename(best) == "step_1"
+    target = _target()
+    ckpt.load_state_dict(target, best)
+    np.testing.assert_array_equal(target["w"].numpy(),
+                                  np.full((4, 4), 1.0, np.float32))

@@ -1,0 +1,58 @@
+"""Hygiene gate: every write in the checkpoint package goes through
+the ``_atomic_write`` helper (tools/check_atomic_writes.py wired as a
+tier-1 test), so the crash-safety invariant cannot silently regress."""
+
+import importlib.util
+import pathlib
+import subprocess
+import sys
+
+CHECKER = (pathlib.Path(__file__).resolve().parent.parent
+           / "tools" / "check_atomic_writes.py")
+
+
+def _load_checker():
+    spec = importlib.util.spec_from_file_location(
+        "check_atomic_writes", CHECKER)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_checkpoint_package_has_no_raw_writes():
+    assert _load_checker().main() == 0
+
+
+def test_checker_catches_raw_write(tmp_path):
+    mod = _load_checker()
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        'def save(p):\n'
+        '    with open(p, "w") as f:\n'
+        '        f.write("x")\n'
+        'def append(p):\n'
+        '    open(p, mode="ab").close()\n')
+    ok = tmp_path / "ok.py"
+    ok.write_text(
+        'def _atomic_write(p, b):\n'
+        '    with open(p, "wb") as f:\n'
+        '        f.write(b)\n'
+        'def audited(p):\n'
+        '    open(p, "w").close()  # atomic-ok: test fixture\n'
+        'def reader(p):\n'
+        '    return open(p, "rb").read()\n')
+    violations = mod.check(str(tmp_path))
+    assert len(violations) == 2
+    assert all(v[0].endswith("bad.py") for v in violations)
+
+
+def test_checker_cli_exit_codes(tmp_path):
+    r = subprocess.run([sys.executable, str(CHECKER)],
+                       capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    bad = tmp_path / "viol.py"
+    bad.write_text('open("f", "w").close()\n')
+    r = subprocess.run([sys.executable, str(CHECKER), str(tmp_path)],
+                       capture_output=True, text=True)
+    assert r.returncode == 1
+    assert "viol.py" in r.stdout and "_atomic_write" in r.stdout

@@ -11,7 +11,7 @@ import pytest
 
 from paddle_tpu.distributed.fleet.elastic import (
     ElasticManager, ElasticStatus, latest_checkpoint, checkpoint_step,
-    start_heartbeat, stop_heartbeat)
+    latest_valid_checkpoint, start_heartbeat, stop_heartbeat)
 
 LAUNCH = [sys.executable, "-m", "paddle_tpu.distributed.launch"]
 ENV = dict(os.environ, JAX_PLATFORMS="cpu",
@@ -89,11 +89,32 @@ def test_latest_checkpoint(tmp_path):
     for s in (10, 200, 30):
         os.makedirs(tmp_path / f"step_{s}")
     os.makedirs(tmp_path / "step_999.tmp")  # in-progress: ignored
+    os.makedirs(tmp_path / "step_998.tmp-abc12")  # staging: ignored
     os.makedirs(tmp_path / "unrelated")
     best = latest_checkpoint(str(tmp_path))
     assert os.path.basename(best) == "step_200"
     assert checkpoint_step(best) == 200
     assert checkpoint_step("/x/unrelated") == -1
+
+
+def test_latest_valid_checkpoint_skips_torn_saves(tmp_path):
+    """Elastic restart must resume from the last COMMITTED step:
+    name-based discovery would hand back the torn step_20, validated
+    discovery skips it."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    sd = {"w": paddle.to_tensor(np.ones(4, np.float32))}
+    ckpt.save_state_dict(sd, str(tmp_path / "step_10"))
+    ckpt.save_state_dict(sd, str(tmp_path / "step_20"))
+    os.remove(tmp_path / "step_20" / "COMMITTED")  # torn by a crash
+    os.makedirs(tmp_path / "step_30.tmp-dead")     # mid-save staging
+    assert os.path.basename(
+        latest_checkpoint(str(tmp_path))) == "step_20"
+    best = latest_valid_checkpoint(str(tmp_path))
+    assert os.path.basename(best) == "step_10"
+    assert latest_valid_checkpoint(str(tmp_path / "nope")) is None
 
 
 # --------------------------------------------------------------------------
@@ -166,6 +187,58 @@ def test_launcher_detects_hung_worker(tmp_path):
     assert r.returncode == 0, (r.stdout, r.stderr)
     assert os.path.exists(marker + ".done")
     assert "stale heartbeats" in r.stderr
+
+
+RESUME_PROBE = """
+import os, sys
+with open(sys.argv[1], "w") as f:
+    f.write(os.environ.get("PADDLE_RESUME_CHECKPOINT", "NONE") + "\\n")
+    f.write(os.environ.get("PADDLE_RESUME_STEP", "NONE"))
+"""
+
+
+def test_launcher_exports_validated_resume_env(tmp_path):
+    """--checkpoint_dir: each launch round points workers at the newest
+    COMMITTED checkpoint via PADDLE_RESUME_CHECKPOINT, skipping a save
+    torn by the previous crash."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed import checkpoint as ckpt
+
+    root = tmp_path / "ckpts"
+    sd = {"w": paddle.to_tensor(np.ones(4, np.float32))}
+    ckpt.save_state_dict(sd, str(root / "step_7"))
+    ckpt.save_state_dict(sd, str(root / "step_9"))
+    os.remove(root / "step_9" / "COMMITTED")  # torn: must be skipped
+
+    script = tmp_path / "probe.py"
+    script.write_text(RESUME_PROBE)
+    out = tmp_path / "probe.out"
+    r = subprocess.run(
+        LAUNCH + ["--max_restarts", "0", "--elastic_timeout", "0",
+                  "--checkpoint_dir", str(root),
+                  "--log_dir", str(tmp_path / "log"),
+                  str(script), str(out)],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "resuming from" in r.stdout
+    got_path, got_step = out.read_text().splitlines()
+    assert os.path.basename(got_path) == "step_7"
+    assert got_step == "7"
+
+
+def test_launcher_resume_env_absent_without_checkpoints(tmp_path):
+    script = tmp_path / "probe.py"
+    script.write_text(RESUME_PROBE)
+    out = tmp_path / "probe.out"
+    r = subprocess.run(
+        LAUNCH + ["--max_restarts", "0", "--elastic_timeout", "0",
+                  "--checkpoint_dir", str(tmp_path / "empty"),
+                  "--log_dir", str(tmp_path / "log"),
+                  str(script), str(out)],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert out.read_text().splitlines()[0] == "NONE"
 
 
 def test_launcher_dumps_failed_worker_log(tmp_path):

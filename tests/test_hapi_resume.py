@@ -1,0 +1,135 @@
+"""hapi auto-resume: Model.fit(resume=...) + ModelCheckpoint restart
+training from the newest *committed* checkpoint, skipping torn saves
+(the crash-restart contract of docs/checkpoint_fault_tolerance.md)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.distributed import checkpoint as ckpt
+from paddle_tpu.hapi import Model
+
+
+def _data():
+    x = np.random.RandomState(0).randn(8, 4).astype("float32")
+    y = np.random.RandomState(1).randn(8, 1).astype("float32")
+    return paddle.io.TensorDataset([paddle.to_tensor(x),
+                                    paddle.to_tensor(y)])
+
+
+def _model(seed):
+    paddle.seed(seed)
+    net = nn.Linear(4, 1)
+    m = Model(net)
+    m.prepare(paddle.optimizer.Adam(0.05, parameters=net.parameters()),
+              nn.MSELoss())
+    return m
+
+
+def test_fit_writes_committed_step_checkpoints(tmp_path):
+    m = _model(0)
+    m.fit(_data(), batch_size=4, epochs=2, verbose=0,
+          save_dir=str(tmp_path))
+    for e in (0, 1):
+        assert ckpt.is_committed(str(tmp_path / f"step_{e}"))
+        assert os.path.exists(tmp_path / f"epoch_{e}.pdparams")
+    best = ckpt.latest_valid_checkpoint(str(tmp_path))
+    assert os.path.basename(best) == "step_1"
+    assert ckpt.load_values(best)["epoch"] == 1
+
+
+def test_fit_resume_restores_state_and_skips_done_epochs(tmp_path):
+    m1 = _model(0)
+    m1.fit(_data(), batch_size=4, epochs=2, verbose=0,
+           save_dir=str(tmp_path))
+    w1 = m1.network.state_dict()["weight"].numpy()
+    step1 = m1._optimizer._step_count
+
+    # crash leaves a torn step_2 behind: resume must skip it
+    ckpt.save_state_dict({"model": m1.network.state_dict()},
+                         str(tmp_path / "step_2"))
+    os.remove(tmp_path / "step_2" / "COMMITTED")
+
+    m2 = _model(123)  # different init — must be overwritten by resume
+    assert not np.allclose(m2.network.state_dict()["weight"].numpy(), w1)
+    m2.fit(_data(), batch_size=4, epochs=2, verbose=0,
+           save_dir=str(tmp_path), resume=True)
+    # epochs 0..1 already done at the committed step_1: no retraining,
+    # weights + optimizer step land exactly where the crash left them
+    np.testing.assert_array_equal(
+        m2.network.state_dict()["weight"].numpy(), w1)
+    assert m2._optimizer._step_count == step1
+
+
+def test_fit_resume_continues_training(tmp_path):
+    m1 = _model(0)
+    m1.fit(_data(), batch_size=4, epochs=1, verbose=0,
+           save_dir=str(tmp_path))
+    m2 = _model(123)
+    m2.fit(_data(), batch_size=4, epochs=3, verbose=0,
+           save_dir=str(tmp_path), resume=True, keep_last_n=2)
+    # epochs 1..2 trained on top of the restored epoch-0 state
+    assert ckpt.is_committed(str(tmp_path / "step_2"))
+    # retention kept only the newest 2 step checkpoints
+    steps = sorted(n for n in os.listdir(tmp_path)
+                   if n.startswith("step_"))
+    assert steps == ["step_1", "step_2"]
+
+
+def test_fit_resume_explicit_path_and_env(tmp_path, monkeypatch):
+    m1 = _model(0)
+    m1.fit(_data(), batch_size=4, epochs=1, verbose=0,
+           save_dir=str(tmp_path / "a"))
+    w1 = m1.network.state_dict()["weight"].numpy()
+
+    m2 = _model(7)
+    m2.fit(_data(), batch_size=4, epochs=1, verbose=0,
+           resume=str(tmp_path / "a" / "step_0"))
+    np.testing.assert_array_equal(
+        m2.network.state_dict()["weight"].numpy(), w1)
+
+    # the elastic launcher exports PADDLE_RESUME_CHECKPOINT
+    monkeypatch.setenv("PADDLE_RESUME_CHECKPOINT",
+                       str(tmp_path / "a" / "step_0"))
+    m3 = _model(8)
+    m3.fit(_data(), batch_size=4, epochs=1, verbose=0, resume=True)
+    np.testing.assert_array_equal(
+        m3.network.state_dict()["weight"].numpy(), w1)
+
+
+def test_fit_resume_corrupt_checkpoint_raises(tmp_path):
+    m1 = _model(0)
+    m1.fit(_data(), batch_size=4, epochs=1, verbose=0,
+           save_dir=str(tmp_path))
+    shard = next(p for p in (tmp_path / "step_0").iterdir()
+                 if p.name.endswith(".npy") and "weight" in p.name)
+    blob = bytearray(shard.read_bytes())
+    blob[-1] ^= 0xFF
+    shard.write_bytes(bytes(blob))
+    m2 = _model(1)
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        m2.fit(_data(), batch_size=4, epochs=1, verbose=0,
+               resume=str(tmp_path / "step_0"))
+
+
+def test_model_checkpoint_callback_atomic(tmp_path):
+    m = _model(0)
+    from paddle_tpu.hapi.callbacks import ModelCheckpoint
+    cb = ModelCheckpoint(save_dir=str(tmp_path), keep_last_n=2)
+    cb.set_model(m)
+    for epoch in range(4):
+        cb.on_epoch_end(epoch)
+    steps = sorted(n for n in os.listdir(tmp_path)
+                   if n.startswith("step_"))
+    assert steps == ["step_2", "step_3"]
+    assert all(ckpt.is_committed(str(tmp_path / s)) for s in steps)
+    # legacy mode keeps the old model.save contract
+    legacy = ModelCheckpoint(save_dir=str(tmp_path / "legacy"),
+                             atomic=False)
+    legacy.set_model(m)
+    os.makedirs(tmp_path / "legacy")
+    legacy.on_epoch_end(0)
+    assert os.path.exists(tmp_path / "legacy" / "0.pdparams")

@@ -1,0 +1,157 @@
+"""Retry/backoff utility + its consumers: bounded exponential backoff,
+transient-only policy, LocalFS retry, and the download cache's
+distinct corrupt-vs-missing errors."""
+
+import errno
+import hashlib
+import os
+
+import pytest
+
+from paddle_tpu.utils.retry import (retry_call, retryable,
+                                    is_transient_oserror)
+
+
+class _Flaky:
+    def __init__(self, failures, exc_factory):
+        self.failures = failures
+        self.exc_factory = exc_factory
+        self.calls = 0
+
+    def __call__(self):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self.exc_factory()
+        return "ok"
+
+
+def _enospc():
+    return OSError(errno.ENOSPC, "no space")
+
+
+def test_retry_succeeds_after_transient_failures():
+    fn = _Flaky(2, _enospc)
+    sleeps = []
+    assert retry_call(fn, sleep=sleeps.append) == "ok"
+    assert fn.calls == 3
+    assert len(sleeps) == 2
+
+
+def test_retry_backoff_is_exponential_and_bounded():
+    fn = _Flaky(6, _enospc)
+    sleeps = []
+    with pytest.raises(OSError):
+        retry_call(fn, retries=5, base_delay=0.1, max_delay=0.25,
+                   jitter=0, sleep=sleeps.append)
+    assert fn.calls == 6  # initial + 5 retries
+    assert sleeps == [0.1, 0.2, 0.25, 0.25, 0.25]
+
+
+def test_retry_exhaustion_reraises_last_error():
+    fn = _Flaky(100, _enospc)
+    with pytest.raises(OSError) as ei:
+        retry_call(fn, retries=3, sleep=lambda s: None)
+    assert ei.value.errno == errno.ENOSPC
+    assert fn.calls == 4
+
+
+def test_non_transient_errors_fail_fast():
+    fn = _Flaky(100, lambda: FileNotFoundError(
+        errno.ENOENT, "missing"))
+    with pytest.raises(FileNotFoundError):
+        retry_call(fn, sleep=lambda s: None)
+    assert fn.calls == 1
+    fn = _Flaky(100, lambda: ValueError("not io"))
+    with pytest.raises(ValueError):
+        retry_call(fn, sleep=lambda s: None)
+    assert fn.calls == 1
+
+
+def test_is_transient_oserror():
+    assert is_transient_oserror(OSError(errno.EIO, "x"))
+    assert is_transient_oserror(OSError(errno.ENOSPC, "x"))
+    assert not is_transient_oserror(OSError(errno.ENOENT, "x"))
+    assert not is_transient_oserror(ValueError("x"))
+
+
+def test_retryable_decorator():
+    calls = []
+
+    @retryable(retries=2, sleep=lambda s: None)
+    def op(x):
+        calls.append(x)
+        if len(calls) < 2:
+            raise OSError(errno.EAGAIN, "busy")
+        return x * 2
+
+    assert op(21) == 42
+    assert calls == [21, 21]
+
+
+def test_on_retry_observer():
+    seen = []
+    fn = _Flaky(1, _enospc)
+    retry_call(fn, sleep=lambda s: None,
+               on_retry=lambda e, a, d: seen.append((e.errno, a)))
+    assert seen == [(errno.ENOSPC, 0)]
+
+
+# --------------------------------------------------------------------------
+# consumers
+# --------------------------------------------------------------------------
+
+@pytest.mark.fault
+def test_localfs_cat_retries_transient_eio(tmp_path):
+    from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+    from paddle_tpu.testing import FaultInjector
+    p = tmp_path / "payload.bin"
+    p.write_bytes(b"checkpoint bytes")
+    fs = LocalFS()
+    with FaultInjector() as fi:
+        plan = fi.fail_read("payload.bin", errno_=errno.EIO)
+        assert fs.cat(str(p)) == b"checkpoint bytes"
+    assert plan.fired == 1
+
+
+def test_localfs_roundtrip(tmp_path):
+    from paddle_tpu.distributed.fleet.utils.fs import LocalFS
+    fs = LocalFS()
+    fs.mkdirs(str(tmp_path / "a/b"))
+    assert fs.is_dir(str(tmp_path / "a/b"))
+    src = tmp_path / "src.txt"
+    src.write_text("data")
+    fs.upload(str(src), str(tmp_path / "a/b/dst.txt"))
+    assert fs.cat(str(tmp_path / "a/b/dst.txt")) == b"data"
+    fs.touch(str(tmp_path / "t"))
+    assert fs.is_file(str(tmp_path / "t"))
+
+
+def test_download_corrupt_cache_is_distinct_error(tmp_path):
+    from paddle_tpu.utils.download import (get_path_from_url,
+                                           CorruptCacheError)
+    cached = tmp_path / "weights.bin"
+    cached.write_bytes(b"corrupted payload")
+    actual = hashlib.md5(b"corrupted payload").hexdigest()
+    expected = "0" * 32
+    with pytest.raises(CorruptCacheError) as ei:
+        get_path_from_url("https://example.com/weights.bin",
+                          root_dir=str(tmp_path), md5sum=expected)
+    # the error names both checksums — not the misleading "not found"
+    assert expected in str(ei.value) and actual in str(ei.value)
+    assert "not found" not in str(ei.value)
+    # a matching checksum still resolves
+    path = get_path_from_url("https://example.com/weights.bin",
+                             root_dir=str(tmp_path), md5sum=actual)
+    assert path == str(cached)
+    # a genuinely absent file keeps the "not found" error
+    with pytest.raises(RuntimeError, match="not found"):
+        get_path_from_url("https://example.com/missing.bin",
+                          root_dir=str(tmp_path))
+
+
+def test_download_no_md5_returns_cached(tmp_path):
+    from paddle_tpu.utils.download import get_path_from_url
+    cached = tmp_path / "f.bin"
+    cached.write_bytes(b"x")
+    assert get_path_from_url("u/f.bin", root_dir=str(tmp_path)) == \
+        str(cached)

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Hygiene checker: no raw ``open(..., "w")`` writes inside
+``paddle_tpu/distributed/checkpoint/`` outside the ``_atomic_write``
+helper.
+
+The crash-safety guarantee rests on one invariant: every byte a
+checkpoint commits was staged, fsync'd, size-checked and checksummed
+by ``_atomic_write``. A raw write-mode ``open`` anywhere else in the
+checkpoint package silently re-opens the torn-write hole, so this
+script (wired as a tier-1 test, tests/test_checkpoint_hygiene.py)
+fails the build on any such call. Lines annotated ``# atomic-ok``
+are allowlisted for audited exceptions.
+
+Usage: python tools/check_atomic_writes.py [root_dir]
+Exit code 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+ALLOWED_FUNC = "_atomic_write"
+ALLOW_COMMENT = "atomic-ok"
+WRITE_MODE_CHARS = ("w", "a", "x", "+")
+
+
+def _mode_of(call):
+    """The literal mode argument of an open() call, if statically
+    knowable."""
+    mode = None
+    if len(call.args) >= 2 and isinstance(call.args[1], ast.Constant):
+        mode = call.args[1].value
+    for kw in call.keywords:
+        if kw.arg == "mode" and isinstance(kw.value, ast.Constant):
+            mode = kw.value.value
+    return mode if isinstance(mode, str) else None
+
+
+def violations_in_file(path):
+    src = open(path, encoding="utf-8").read()
+    lines = src.splitlines()
+    out = []
+
+    class Visitor(ast.NodeVisitor):
+        def __init__(self):
+            self.stack = []
+
+        def _visit_func(self, node):
+            self.stack.append(node.name)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_FunctionDef = _visit_func
+        visit_AsyncFunctionDef = _visit_func
+
+        def visit_Call(self, node):
+            func = node.func
+            is_open = (isinstance(func, ast.Name) and func.id == "open") \
+                or (isinstance(func, ast.Attribute)
+                    and func.attr == "open")
+            if is_open:
+                mode = _mode_of(node)
+                if mode is not None and any(
+                        c in mode for c in WRITE_MODE_CHARS):
+                    line = lines[node.lineno - 1]
+                    if (ALLOWED_FUNC not in self.stack
+                            and ALLOW_COMMENT not in line):
+                        out.append((path, node.lineno, line.strip()))
+            self.generic_visit(node)
+
+    Visitor().visit(ast.parse(src))
+    return out
+
+
+def check(root):
+    violations = []
+    for dirpath, _, files in os.walk(root):
+        for fname in sorted(files):
+            if fname.endswith(".py"):
+                violations += violations_in_file(
+                    os.path.join(dirpath, fname))
+    return violations
+
+
+def main(root=None):
+    if root is None:
+        root = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), os.pardir,
+            "paddle_tpu", "distributed", "checkpoint")
+    root = os.path.normpath(root)
+    violations = check(root)
+    for path, lineno, line in violations:
+        print(f"{path}:{lineno}: raw write-mode open() bypasses "
+              f"{ALLOWED_FUNC}: {line}")
+    if violations:
+        print(f"{len(violations)} violation(s) — every checkpoint write "
+              f"must go through {ALLOWED_FUNC} (or carry an audited "
+              f"'# {ALLOW_COMMENT}' annotation)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))
