@@ -102,6 +102,15 @@ class Fleet:
         mesh = Mesh(devices, names)
         self._hcg = HybridCommunicateGroup(self._topology, mesh)
         self._is_initialized = True
+        # observable topology decision (profiler trace layer): which
+        # hybrid mesh this process actually runs — the first thing to
+        # check when a parallel run is slower than expected
+        from ...profiler.trace import log_perf_event
+        log_perf_event(
+            "fleet/init",
+            f"hybrid mesh dp{dp} x sharding{sh} x pp{pp} x sep{sep} "
+            f"x mp{mp} x ep{ep} over {total}/{n} devices "
+            f"({devices.flat[0].platform})")
         return self
 
     def is_first_worker(self):

@@ -90,6 +90,26 @@ define_flag("FLAGS_allocator_strategy", "auto_growth",
 define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92,
             "Accepted for compatibility; PJRT flag controls TPU memory.")
 define_flag("FLAGS_log_level", 1, "Framework log verbosity.")
+
+
+def _toggle_host_trace(value):
+    # lazy import: flags load before the profiler package exists. The
+    # flag toggle never writes files; use profiler.disable() directly
+    # for an export on stop.
+    from ..profiler import disable, enable
+    enable() if value else disable(export=False)
+
+
+define_flag("FLAGS_enable_host_trace", False,
+            "Structured host trace layer (paddle_tpu.profiler.trace): "
+            "spans/gauges recorded process-wide, chrome-trace export on "
+            "disable. Same switch as PADDLE_PROFILER_TRACE=1.",
+            on_change=_toggle_host_trace)
+define_flag("FLAGS_host_trace_level", 1,
+            "Reserved verbosity knob for the host trace layer (parity "
+            "with the reference profiler's FLAGS_host_trace_level; the "
+            "structured tracer currently records all spans when "
+            "enabled).")
 define_flag("FLAGS_tpu_matmul_precision", "default",
             "Matmul precision: default|high|highest (maps to jax precision).")
 define_flag("FLAGS_enable_pallas_kernels", True,

@@ -119,18 +119,36 @@ class Model:
                 if verbose:
                     print(f"resuming from {ckpt_path} "
                           f"(epoch {start_epoch})")
+        import time as _time
+        from ..profiler import trace as _trace
         for epoch in range(start_epoch, epochs):
             losses = []
+            epoch_t0 = _time.perf_counter()
             for step, batch in enumerate(loader):
                 *xs, y = batch if isinstance(batch, (list, tuple)) \
                     else (batch,)
-                loss = self.train_batch(xs, y)
+                with _trace.trace_span("hapi/train_batch", cat="train",
+                                       epoch=epoch, step=step):
+                    loss = self.train_batch(xs, y)
                 losses.append(loss[0])
                 from ..utils import monitor
                 monitor.emit_step_metrics(epoch=epoch, loss=loss[0])
                 if verbose and step % log_freq == 0:
                     print(f"epoch {epoch} step {step}: "
                           f"loss {loss[0]:.5f}")
+            # per-epoch perf summary through the trace layer (INFO log +
+            # gauges; profiler subsystem) — avg step time is the number
+            # every perf regression shows up in first
+            summary = _trace.epoch_summary(
+                epoch, steps=len(losses),
+                seconds=_time.perf_counter() - epoch_t0,
+                mean_loss=round(float(np.mean(losses)), 6)
+                if losses else None)
+            self._last_epoch_summary = summary
+            if verbose:
+                print(f"epoch {epoch} done: {summary['steps']} steps in "
+                      f"{summary['epoch_s']:.2f}s "
+                      f"(avg {summary['avg_step_ms']:.1f} ms/step)")
             if save_dir is not None and epoch % save_freq == 0:
                 if legacy_save:
                     self.save(f"{save_dir}/epoch_{epoch}")

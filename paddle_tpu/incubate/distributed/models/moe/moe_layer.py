@@ -89,6 +89,27 @@ class MoELayer(Layer):
         self.z_loss: Tensor | None = None
         axis, mesh, ep = _ep_axis_and_mesh()
         self._ep_axis, self._mesh, self._ep = axis, mesh, ep
+        if mesh is not None and ep > 1 and \
+                getattr(self.gate, "dropless", False):
+            # dropless is a single-device/GSPMD path; every ep>1 forward
+            # (manual or GSPMD) takes the capacity all-to-all, which
+            # DROPS tokens past capacity_factor — a silent numerics
+            # downgrade without this warning (ADVICE.md round 5)
+            import warnings
+            warnings.warn(
+                f"MoELayer: gate dropless=True requested but expert "
+                f"parallelism is active (ep_degree={ep}); the EP "
+                f"capacity dispatch path is taken instead and tokens "
+                f"beyond capacity_factor={self.gate.capacity_factor} "
+                f"are dropped (numerics differ from dropless). Use "
+                f"ep_degree=1 for dropless, or raise capacity_factor.",
+                UserWarning, stacklevel=2)
+            from .....profiler.trace import log_perf_event
+            log_perf_event(
+                "moe/dropless_downgraded",
+                f"dropless=True ignored under ep_degree={ep}: capacity "
+                f"path (cf={self.gate.capacity_factor}) dispatches this "
+                "layer", once_key=("moe/dropless_downgraded", ep))
         if mesh is not None and ep > 1:
             from jax.sharding import NamedSharding, PartitionSpec as P
             for p in (self.w_gate, self.w_up, self.w_down):

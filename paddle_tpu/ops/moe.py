@@ -23,13 +23,67 @@ TPU-native design — NOT a port of the token-index scatter kernels:
 
 from __future__ import annotations
 
+import contextlib
+import threading
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
 __all__ = ["top_k_gating", "top_k_gating_idx", "moe_dispatch_combine",
            "moe_ffn_grouped", "moe_forward", "moe_forward_ep",
-           "sort_rows_by_expert", "moe_forward_dropless"]
+           "sort_rows_by_expert", "moe_forward_dropless", "moe_ablation"]
+
+
+# -- section ablation (profiler.breakdown step-attribution harness) --------
+#
+# The breakdown harness compiles one program variant per knocked-out
+# section; the knockout is a TRACE-TIME decision read from this
+# thread-local, so a variant's compiled program simply lacks the
+# section. Replacement subgraphs keep every shape/dtype and carry a
+# data dependence on the inputs (``_dep0``) so XLA cannot constant-fold
+# them away — numerics are garbage under ablation BY DESIGN; only
+# timing is meaningful.
+
+_ablation_tl = threading.local()
+
+
+def _ablated() -> frozenset:
+    return getattr(_ablation_tl, "sections", frozenset())
+
+
+@contextlib.contextmanager
+def moe_ablation(sections):
+    """Knock out named MoE sections ('gating' | 'sort' | 'a2a' |
+    'expert_matmul') for programs TRACED inside this context. Timing
+    harness use only (profiler.breakdown); outputs are not meaningful."""
+    prev = _ablated()
+    _ablation_tl.sections = frozenset(sections)
+    try:
+        yield
+    finally:
+        _ablation_tl.sections = prev
+
+
+def _dep0(x):
+    """int32 zero that DEPENDS on ``x``: added to the static replacement
+    arrays so the ablated subgraph stays in the compiled program."""
+    return (x.reshape(-1)[0] * 0).astype(jnp.int32)
+
+
+def _ablation_gating(x, T, E, k, capacity):
+    """Static round-robin routing standing in for the learned gate:
+    same shapes/dtypes as :func:`top_k_gating_idx`'s outputs."""
+    z0 = _dep0(x)
+    gate_idx = (jnp.arange(T * k, dtype=jnp.int32).reshape(T, k) + z0) % E
+    gate_vals = jnp.full((T, k), 1.0 / k, jnp.float32) \
+        + z0.astype(jnp.float32)
+    pos = (jnp.broadcast_to(
+        jnp.arange(T, dtype=jnp.int32)[:, None] % max(capacity, 1),
+        (T, k)) + z0)
+    keep = pos < capacity
+    zero = z0.astype(jnp.float32) * 0.0
+    return gate_idx, gate_vals, pos, keep, zero, zero
 
 
 def top_k_gating(logits, k, capacity, norm_topk_prob=True):
@@ -166,12 +220,27 @@ def moe_forward(x, router_w, expert_fn, k=2, capacity_factor=1.25,
     Returns (out [T, d], aux_loss, z_loss)."""
     T = x.shape[0]
     E = router_w.shape[1]
+    ab = _ablated()
     capacity = max(int(capacity_factor * k * T / E), 1)
-    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    gate_idx, gate_vals, pos, keep, aux, z = top_k_gating_idx(
-        logits, k, capacity, norm_topk_prob)
-    xd, slot = _dispatch_gather(x, gate_idx, pos, keep, E, capacity)
-    out = expert_fn(xd)                                 # [E, C, d]
+    if "gating" in ab:
+        gate_idx, gate_vals, pos, keep, aux, z = _ablation_gating(
+            x, T, E, k, capacity)
+    else:
+        logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+        gate_idx, gate_vals, pos, keep, aux, z = top_k_gating_idx(
+            logits, k, capacity, norm_topk_prob)
+    if "sort" in ab:
+        # skip the scatter/gather dispatch: a broadcast row bank + a
+        # static (in-range) slot map, data-dependent so it survives XLA
+        z0 = _dep0(x)
+        xd = jnp.broadcast_to(x[0][None, None, :], (E, capacity,
+                                                    x.shape[-1])) \
+            + z0.astype(x.dtype)
+        slot = (jnp.arange(T * k, dtype=jnp.int32).reshape(T, k)
+                % (E * capacity)) + z0
+    else:
+        xd, slot = _dispatch_gather(x, gate_idx, pos, keep, E, capacity)
+    out = xd if "expert_matmul" in ab else expert_fn(xd)   # [E, C, d]
     y = _combine_gather(out, slot, gate_vals, keep, x.dtype)
     return y, aux, z
 
@@ -233,14 +302,29 @@ def moe_forward_dropless(x, router_w, w_gate, w_up, w_down, k=2,
 
     T, d = x.shape
     E = router_w.shape[1]
-    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    # capacity = T*k keeps every assignment (pos < T*k always): the
-    # SAME router math as the capacity paths by construction — the
-    # dropless-vs-capacity equivalence tests rest on this sharing
-    gate_idx, gate_vals, _pos, _keep, aux, z = top_k_gating_idx(
-        logits, k, capacity=T * k, norm_topk_prob=norm_topk_prob)
+    ab = _ablated()
+    if "gating" in ab:
+        gate_idx, gate_vals, _pos, _keep, aux, z = _ablation_gating(
+            x, T, E, k, T * k)
+    else:
+        logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+        # capacity = T*k keeps every assignment (pos < T*k always): the
+        # SAME router math as the capacity paths by construction — the
+        # dropless-vs-capacity equivalence tests rest on this sharing
+        gate_idx, gate_vals, _pos, _keep, aux, z = top_k_gating_idx(
+            logits, k, capacity=T * k, norm_topk_prob=norm_topk_prob)
 
-    perm, tile_gid, P = sort_rows_by_expert(gate_idx, E, bm=bm)
+    if "sort" in ab:
+        # static identity-ish layout standing in for the argsort/cumsum
+        # index machinery (gathers stay — 'sort' measures index build)
+        z0 = _dep0(gate_idx)
+        R = T * k
+        P = (-(-R // bm) + E) * bm
+        nr = P // bm
+        perm = jnp.arange(R, dtype=jnp.int32) + z0
+        tile_gid = (jnp.arange(nr, dtype=jnp.int32) % E) + z0
+    else:
+        perm, tile_gid, P = sort_rows_by_expert(gate_idx, E, bm=bm)
     # inverse map padded position -> source token (sentinel T = zero row)
     src = jnp.full((P,), T, jnp.int32).at[perm].set(
         jnp.arange(T * k, dtype=jnp.int32) // k)
@@ -252,9 +336,17 @@ def moe_forward_dropless(x, router_w, w_gate, w_up, w_down, k=2,
     # already HBM-bound. A pre-fused gate|up PARAMETER would avoid the
     # copy but breaks the w_gate/w_up state_dict layout; revisit only
     # if an on-chip A/B shows the wider-N kernel paying for it.
-    g = grouped_matmul(x_p, w_gate, tile_gid)
-    u = grouped_matmul(x_p, w_up, tile_gid)
-    y_p = grouped_matmul((act(g) * u).astype(x.dtype), w_down, tile_gid)
+    if "expert_matmul" in ab:
+        # rank-1 stand-ins: keep [P, h]/[P, d] shapes and a grad path to
+        # x and the banks without the MXU work
+        g = x_p[:, :1] * w_gate[0, 0][None, :].astype(x.dtype)
+        u = x_p[:, :1] * w_up[0, 0][None, :].astype(x.dtype)
+        y_p = (act(g) * u)[:, :1] * w_down[0, 0][None, :].astype(x.dtype)
+    else:
+        g = grouped_matmul(x_p, w_gate, tile_gid)
+        u = grouped_matmul(x_p, w_up, tile_gid)
+        y_p = grouped_matmul((act(g) * u).astype(x.dtype), w_down,
+                             tile_gid)
     y_k = y_p[perm].reshape(T, k, d)                    # gather back
     w = gate_vals.astype(y_k.dtype)[..., None]
     return jnp.sum(y_k * w, axis=1).astype(x.dtype), aux, z
@@ -275,18 +367,31 @@ def moe_forward_ep(x, router_w, expert_fn_local, axis_name, k=2,
     E = router_w.shape[1]
     if E % ep:
         raise ValueError(f"num_experts {E} not divisible by ep degree {ep}")
+    ab = _ablated()
     capacity = max(int(capacity_factor * k * T / E), 1)
-    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
-    gate_idx, gate_vals, pos, keep, aux, z = top_k_gating_idx(
-        logits, k, capacity, norm_topk_prob)
+    if "gating" in ab:
+        gate_idx, gate_vals, pos, keep, aux, z = _ablation_gating(
+            x, T, E, k, capacity)
+    else:
+        logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
+        gate_idx, gate_vals, pos, keep, aux, z = top_k_gating_idx(
+            logits, k, capacity, norm_topk_prob)
     xd, slot = _dispatch_gather(x, gate_idx, pos, keep, E, capacity)
-    # send each expert-slice to its owner; receive every device's slots
-    # for the local experts: [E, C, d] -> [E/ep, ep*C, d]
-    xd = lax.all_to_all(xd, axis_name, split_axis=0, concat_axis=1,
-                        tiled=True)
-    out = expert_fn_local(xd)                           # [E/ep, ep*C, d]
-    out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
-                         tiled=True)                    # [E, C, d]
+    if "a2a" in ab:
+        # local reshape standing in for the token movement: identical
+        # [E/ep, ep*C, d] shape, zero ICI traffic
+        xd = xd.reshape(E // ep, ep * capacity, x.shape[-1])
+    else:
+        # send each expert-slice to its owner; receive every device's
+        # slots for the local experts: [E, C, d] -> [E/ep, ep*C, d]
+        xd = lax.all_to_all(xd, axis_name, split_axis=0, concat_axis=1,
+                            tiled=True)
+    out = xd if "expert_matmul" in ab else expert_fn_local(xd)
+    if "a2a" in ab:
+        out = out.reshape(E, capacity, x.shape[-1])
+    else:
+        out = lax.all_to_all(out, axis_name, split_axis=1, concat_axis=0,
+                             tiled=True)                # [E, C, d]
     y = _combine_gather(out, slot, gate_vals, keep, x.dtype)
     # aux losses are per-device estimates; average over the ep group
     aux = lax.pmean(aux, axis_name)

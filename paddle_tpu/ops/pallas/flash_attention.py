@@ -460,3 +460,16 @@ def _bwd_rule_scan(causal, scale, res, g):
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)
+
+
+def flash_attention_cost(q_shape, kv_seq=None, causal=False, train=False):
+    """Static FLOPs/bytes for one :func:`flash_attention` call (profiler
+    cost-accounting surface): q [B, Sq, H, D]. Flash never materializes
+    the [Sq, Sk] score matrix, so bytes count only q/k/v in + out —
+    exactly why the kernel moves attention to the compute-bound side of
+    the roofline. ``train=True`` multiplies by 3.5 (bwd recomputes the
+    logits once on top of the 2x grad matmuls)."""
+    from ...profiler.cost import attention_cost
+    b, sq, h, d = (int(s) for s in q_shape)
+    c = attention_cost(b, sq, h, d, kv_len=kv_seq, causal=causal)
+    return c * 3.5 if train else c

@@ -205,3 +205,18 @@ def grouped_matmul(x, w, tile_gid, bn=2048):
     jax.checkpoint boundaries (use_recompute re-runs the bwd in a
     fresh trace)."""
     return _gmm_core(x, w, tile_gid, bn)
+
+
+def grouped_matmul_cost(x_shape, w_shape, train=False):
+    """Static FLOPs/bytes for one :func:`grouped_matmul` call (profiler
+    cost-accounting surface): x [P, d] @ bank [E, d, h]. The weight
+    bank streams HBM once per call (the block-revisit guarantee in the
+    kernel design above), not once per row tile — the byte convention
+    lives in profiler/cost.py; this is the kernel-side entry point.
+    ``train=True`` adds the dx (grouped_matmul_t) + dw (grouped_dw)
+    backward calls."""
+    from ...profiler import cost as _cost
+    P, d = int(x_shape[0]), int(x_shape[1])
+    E, _, h = (int(s) for s in w_shape)
+    fwd = _cost.grouped_matmul_cost(P, d, h, E)
+    return fwd * 3 if train else fwd

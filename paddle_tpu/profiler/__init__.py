@@ -1,24 +1,119 @@
-"""``paddle.profiler`` (python/paddle/profiler/ parity, UNVERIFIED).
+"""``paddle.profiler`` (python/paddle/profiler/ parity, UNVERIFIED) —
+grown into the perf observability subsystem.
 
 Reference: host RecordEvent ranges + CUPTI device tracer → chrome trace
 (SURVEY.md §5). TPU-native: ``jax.profiler`` captures host + device (TPU)
 timelines into TensorBoard/Perfetto format; ``RecordEvent`` maps to
 ``jax.profiler.TraceAnnotation`` so user annotations appear in the same
 trace. Summary tables come from jax's own profile session where available;
-``profiler_result.save`` exports the trace dir."""
+``profiler_result.save`` exports the trace dir.
+
+On top of that capture surface, three structured layers (docs/
+profiling.md):
+
+- :mod:`.trace` — nestable ``trace_span()`` events with wall time,
+  device-sync points, gauges, chrome-trace + JSON export;
+- :mod:`.cost` — FLOPs/bytes accounting from static shapes, per-section
+  MFU and roofline (compute- vs memory-bound) classification;
+- :mod:`.breakdown` — the in-program section-ablation harness that
+  attributes step time inside one compiled program (MoE gating / sort /
+  a2a / expert-matmul; the evidence layer for every perf PR).
+
+Enable via ``Profiler``/``enable()``, the ``PADDLE_PROFILER_TRACE=1``
+env flag, or ``FLAGS_enable_host_trace``.
+"""
 
 from __future__ import annotations
 
+import dataclasses
 import os
 import time
 
 import jax
 
 from ..framework.core import Tensor
+from . import cost, trace  # noqa: F401 (public submodules)
+from .breakdown import (StepBreakdown, ablation_breakdown,  # noqa: F401
+                        moe_step_breakdown)
+from .trace import (Tracer, block_on, get_tracer,  # noqa: F401
+                    log_perf_event, trace_span)
 
 __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "SortedKeys", "SummaryView"]
+           "SortedKeys", "SummaryView", "ProfilerOptions", "enable",
+           "disable", "trace_span", "get_tracer", "Tracer", "block_on",
+           "log_perf_event", "StepBreakdown", "ablation_breakdown",
+           "moe_step_breakdown", "cost", "trace"]
+
+
+def _env_bool(name, default=False):
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class ProfilerOptions:
+    """Knob surface for the structured trace layer (the
+    ``paddle.utils.profiler.ProfilerOptions`` shape, TPU-native fields).
+    Every field has a ``PADDLE_PROFILER_*`` env twin so headless runs
+    (bench.py, the elastic launcher) can flip tracing without code."""
+
+    output_dir: str = "./profiler_log"          # PADDLE_PROFILER_LOG_DIR
+    trace_enabled: bool = False                 # PADDLE_PROFILER_TRACE
+    with_flops: bool = False                    # PADDLE_PROFILER_WITH_FLOPS
+    sync_spans: bool = False                    # PADDLE_PROFILER_SYNC
+    export_on_disable: bool = True
+
+    @classmethod
+    def from_env(cls) -> "ProfilerOptions":
+        return cls(
+            output_dir=os.environ.get("PADDLE_PROFILER_LOG_DIR",
+                                      "./profiler_log"),
+            trace_enabled=_env_bool("PADDLE_PROFILER_TRACE"),
+            with_flops=_env_bool("PADDLE_PROFILER_WITH_FLOPS"),
+            sync_spans=_env_bool("PADDLE_PROFILER_SYNC"))
+
+
+def enable(options: ProfilerOptions | None = None) -> Tracer:
+    """Turn the structured trace layer on process-wide."""
+    tr = get_tracer()
+    tr.options = options or ProfilerOptions.from_env()
+    tr.enabled = True
+    return tr
+
+
+def disable(export: bool | None = None) -> str | None:
+    """Turn tracing off; by default exports the chrome trace into
+    ``options.output_dir`` if any events were recorded. Returns the
+    export path (or None)."""
+    tr = get_tracer()
+    opts = tr.options or ProfilerOptions()
+    tr.enabled = False
+    path = None
+    if (opts.export_on_disable if export is None else export) \
+            and tr.events:
+        path = tr.export_chrome_trace(
+            os.path.join(opts.output_dir, "paddle_trace.json"))
+    return path
+
+
+def _env_trace_requested() -> bool:
+    if _env_bool("PADDLE_PROFILER_TRACE"):
+        return True
+    # FLAGS_enable_host_trace=1 in the environment: define_flag ingests
+    # the value but on_change only fires through set_flags, so honor
+    # the env form here (the flag's contract says it is the same switch)
+    try:
+        from ..framework.flags import flag
+        return bool(flag("FLAGS_enable_host_trace"))
+    except Exception:
+        return False
+
+
+if _env_trace_requested():
+    enable()  # env-flag surface: tracing from process start
 
 
 class ProfilerTarget:
@@ -87,19 +182,29 @@ def load_profiler_result(path):
 
 
 class RecordEvent:
-    """User range annotation; shows up in the jax/Perfetto trace."""
+    """User range annotation; shows up in the jax/Perfetto trace AND —
+    when the structured tracer is enabled — as a ``trace_span`` in the
+    chrome-trace/JSON export."""
 
     def __init__(self, name, event_type=None):
         self.name = name
         self._ann = None
+        self._span = None
         self.begin_ts = None
 
     def begin(self):
         self._ann = jax.profiler.TraceAnnotation(self.name)
         self._ann.__enter__()
+        tr = get_tracer()
+        if tr.enabled:
+            self._span = tr.span(self.name, cat="record_event")
+            self._span.__enter__()
         self.begin_ts = time.perf_counter()
 
     def end(self):
+        if self._span is not None:
+            self._span.__exit__(None, None, None)
+            self._span = None
         if self._ann is not None:
             self._ann.__exit__(None, None, None)
             self._ann = None
@@ -116,7 +221,8 @@ class RecordEvent:
 class Profiler:
     def __init__(self, targets=None, scheduler=None, on_trace_ready=None,
                  record_shapes=False, profile_memory=False, timer_only=False,
-                 emit_nvtx=False, custom_device_types=None, with_flops=False):
+                 emit_nvtx=False, custom_device_types=None, with_flops=False,
+                 options: ProfilerOptions | None = None):
         self._scheduler = scheduler if callable(scheduler) else None
         if isinstance(scheduler, (tuple, list)):
             lo, hi = scheduler
@@ -134,8 +240,23 @@ class Profiler:
         self._timer_only = timer_only
         self._step_times = []
         self._last = None
+        self._with_flops = with_flops
+        self._options = options
+        if options is not None and getattr(options, "output_dir", None):
+            self._log_dir = options.output_dir
 
     def start(self):
+        if self._with_flops or self._options is not None:
+            # structured trace layer rides along: spans/gauges recorded
+            # while this Profiler is live land in the chrome export.
+            # Save the global tracer's prior state — a sub-region
+            # Profiler must not stomp a whole-process tracing session
+            # (PADDLE_PROFILER_TRACE=1).
+            tr = get_tracer()
+            self._prev_trace_state = (tr.enabled, tr.options)
+            opts = self._options or ProfilerOptions(
+                output_dir=self._log_dir, with_flops=self._with_flops)
+            enable(opts)
         self._last = time.perf_counter()
         self._maybe_transition()
 
@@ -145,6 +266,16 @@ class Profiler:
             self._recording = False
             if self._on_trace_ready:
                 self._on_trace_ready(self)
+        if self._with_flops or self._options is not None:
+            prev_enabled, prev_options = getattr(
+                self, "_prev_trace_state", (False, None))
+            if prev_enabled:
+                # outer tracing session continues: restore its options,
+                # keep recording, export nothing early
+                tr = get_tracer()
+                tr.options = prev_options
+            else:
+                disable()
 
     def step(self, num_samples=None):
         now = time.perf_counter()
@@ -179,8 +310,21 @@ class Profiler:
         avg = sum(self._step_times) / n
         print(f"steps: {n}  avg step time: {avg * 1e3:.3f} ms  "
               f"throughput: {1.0 / avg:.2f} steps/s")
+        sections = get_tracer().section_summary(
+            peak_flops=cost.device_peaks().flops)
+        for name, a in sorted(sections.items(),
+                              key=lambda kv: -kv[1]["total_ms"]):
+            mfu_s = f"  MFU {a['mfu'] * 100:.1f}%" if "mfu" in a else ""
+            bound = a.get("roofline", {}).get("bound", "")
+            print(f"  {name}: {a['count']}x  total {a['total_ms']:.2f} ms"
+                  f"  mean {a['mean_ms']:.3f} ms{mfu_s}"
+                  f"{'  [' + bound + '-bound]' if bound else ''}")
 
     def export(self, path=None, format="json"):
+        tr = get_tracer()
+        if path is not None and tr.events:
+            tr.export_chrome_trace(path)
+            return path
         return self._log_dir
 
     def __enter__(self):

@@ -6,6 +6,7 @@ import os
 import tempfile
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu.vision import ops as V
@@ -147,6 +148,7 @@ class TestAudioBackends:
 
 
 class TestYoloLoss:
+    @pytest.mark.slow  # ~4s (compiled training loop): fast-gate budget
     def test_yolo_loss_trains_head_toward_targets(self):
         rng = np.random.RandomState(0)
         N, H, W, C, m = 1, 4, 4, 3, 3

@@ -511,6 +511,7 @@ class TestCoreAttnRemat:
             out.append(float(loss.item()))
         return out
 
+    @pytest.mark.slow  # ~5s (two 3-step compiled trainings): fast-gate
     def test_core_attn_matches_no_remat(self):
         ref = self._losses("full", remat=False)
         core = self._losses("core_attn", remat=True)

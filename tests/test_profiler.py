@@ -6,6 +6,7 @@ import glob
 import os
 
 import numpy as np
+import pytest
 
 import paddle_tpu as paddle
 from paddle_tpu import profiler
@@ -17,6 +18,7 @@ class TestProfiler:
             x = paddle.to_tensor(np.ones((8, 8), "float32"))
             (x @ x).numpy()
 
+    @pytest.mark.slow  # ~6s (jax profile session teardown): fast-gate
     def test_profiler_capture_writes_trace(self, tmp_path):
         p = profiler.Profiler(
             scheduler=(0, 2),

@@ -82,6 +82,7 @@ _WORKER = textwrap.dedent("""
 _HELPER = "def mul(a, b):\n    return a * b\n"
 
 
+@pytest.mark.slow  # ~4s (two real subprocesses): fast-gate budget
 def test_two_process_rpc(tmp_path):
     (tmp_path / "test_rpc_helper.py").write_text(_HELPER)
     script = tmp_path / "worker.py"

@@ -193,6 +193,7 @@ def test_gpt2_through_engine():
     (req,) = eng.run()
     assert req.tokens == ref, (req.tokens, ref)
 
+@pytest.mark.slow  # ~4.5s (engine + two compiled programs): fast-gate
 def test_one_shot_admitted_mid_stream():
     """Round-5 regression (caught in review): a max_new_tokens=1 request
     admitted WHILE another slot is still decoding must not finish empty

@@ -157,6 +157,7 @@ class TestGuardedSpecialization:
             assert step(b) == 15.0       # compiled, fresh value
         assert not step._fallback_sigs
 
+    @pytest.mark.slow  # ~7s (8 recompiles by design): fast-gate budget
     def test_unstable_branch_gives_up(self):
         @paddle.jit.to_static
         def f(x):
