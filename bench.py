@@ -303,7 +303,7 @@ def _cb_bench(on_tpu):
         done = eng.run()
         return sum(len(r.tokens) for r in done)
 
-    run(100)                       # warmup: compiles prefill buckets+chunk
+    run(100)                       # warmup: compiles prefill + chunk ladder
     eng.reset_gauges()             # drop compile-polluted warmup counters
     best = 0.0
     toks = 0
@@ -312,15 +312,20 @@ def _cb_bench(on_tpu):
         toks = run(101 + i)
         dt = time.perf_counter() - t0
         best = max(best, toks / dt)
-    # occupancy / admission-overlap gauges (profiler subsystem): the
-    # numbers BASELINE.md's CB-ceiling argument was previously deriving
-    # by hand (0.71 occupancy -> ~1,350 tok/s parity ceiling)
+    # occupancy / admission-overlap / latency gauges (profiler
+    # subsystem): the numbers BASELINE.md's CB-ceiling argument was
+    # previously deriving by hand, plus the ISSUE-3 TTFT/ITL
+    # percentiles and the compiled-signature count (1 batched prefill
+    # program + the adaptive decode-chunk ladder — the per-bucket
+    # baseline compiled one prefill per bucket AND per oversized length)
     gauges = eng.gauges()
     print(f"# continuous batching: {toks} tokens across "
           f"{len(specs)} mixed-length streams, {best:.0f} tokens/s "
           f"(occupancy {gauges['slot_occupancy'] * 100:.0f}%, prefill "
-          f"overlap {gauges['prefill_overlap_frac'] * 100:.0f}%)",
-          file=sys.stderr)
+          f"overlap {gauges['prefill_overlap_frac'] * 100:.0f}%, "
+          f"ttft p50 {gauges['ttft_ms_p50']:.1f}ms, itl p50 "
+          f"{gauges['itl_ms_p50']:.2f}ms, {gauges['compiled_programs']} "
+          f"compiled programs)", file=sys.stderr)
     return best, gauges
 
 
@@ -622,6 +627,13 @@ def main():
         record["cb_occupancy"] = round(cb_gauges["slot_occupancy"], 4)
         record["cb_prefill_overlap"] = round(
             cb_gauges["prefill_overlap_frac"], 4)
+        # ISSUE-3 latency + compile-budget keys (engine gauges ride the
+        # PR-2 tracer; these are the headline serving-latency numbers)
+        record["cb_ttft_ms_p50"] = round(cb_gauges["ttft_ms_p50"], 2)
+        record["cb_ttft_ms_p99"] = round(cb_gauges["ttft_ms_p99"], 2)
+        record["cb_itl_ms_p50"] = round(cb_gauges["itl_ms_p50"], 3)
+        record["cb_itl_ms_p99"] = round(cb_gauges["itl_ms_p99"], 3)
+        record["cb_compiles"] = cb_gauges["compiled_programs"]
         record["cb_gauges"] = {
             k: (round(v, 4) if isinstance(v, float) else v)
             for k, v in cb_gauges.items()}

@@ -112,10 +112,15 @@ t0 = time.time()
 done = engine.run()
 dt = time.time() - t0
 total_toks = sum(len(r.tokens) for r in done)
+g = engine.gauges()
 print(f"served {len(done)} mixed-length streams "
       f"({[s for s, _, _ in [(p.size, n, i) for p, n, i in reqs]]}-token "
       f"prompts) -> {total_toks} tokens in {dt:.2f}s "
       f"(compile included)")
+print(f"ttft p50 {g['ttft_ms_p50']:.1f}ms / p99 {g['ttft_ms_p99']:.1f}ms, "
+      f"itl p50 {g['itl_ms_p50']:.2f}ms, "
+      f"{g['prefill_waves']} batched prefill waves, "
+      f"{g['compiled_programs']} compiled programs")
 # spot-check one stream against the dense-cache generate path
 p0, n0, id0 = reqs[0]
 ref_ids, _ = model.generate(

@@ -18,20 +18,54 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..framework.core import Tensor
 
-# DistTensor metadata rides a side table (Tensor has __slots__ — no
-# instance dict — and placements/process_mesh are distributed-surface
-# concepts that don't belong in core). Keyed by id() with
-# weakref.finalize cleanup, NOT a WeakKeyDictionary: weak-key lookups
-# compare colliding keys with ==, and Tensor.__eq__ is elementwise.
-# Exposed as Tensor class properties below; plain Tensors report None,
-# matching the reference's "dense tensor has no dist attr".
+# DistTensor metadata: the SOURCE OF TRUTH is the underlying jax
+# array's NamedSharding — placements/process_mesh are RE-DERIVED lazily
+# in the property getter, so the metadata survives everything the array
+# survives: ``y = x + 0``, reshapes, state_dict round-trips, optimizer
+# rebinds (advisor r5; the id()-keyed side table lost it on any derived
+# tensor). A side table still exists for EXPLICIT annotations the
+# sharding cannot encode (e.g. ``Partial``) and takes precedence; it is
+# keyed by id() with weakref.finalize cleanup, NOT a WeakKeyDictionary:
+# weak-key lookups compare colliding keys with ==, and Tensor.__eq__ is
+# elementwise. Plain Tensors (no NamedSharding, no annotation) report
+# None, matching the reference's "dense tensor has no dist attr".
 _dist_attr: dict = {}
+
+
+def _named_sharding_of(t):
+    try:
+        sh = t._data.sharding     # tracers may refuse the attribute
+    except Exception:
+        return None
+    return sh if isinstance(sh, NamedSharding) else None
+
+
+def _derive_placements(ns: NamedSharding):
+    names = list(ns.mesh.axis_names)
+    placements = [Replicate()] * len(names)
+    for tdim, entry in enumerate(ns.spec):
+        if entry is None:
+            continue
+        for ax in (entry if isinstance(entry, tuple) else (entry,)):
+            placements[names.index(ax)] = Shard(tdim)
+    return placements
+
+
+def _derive_process_mesh(ns: NamedSharding):
+    return ProcessMesh(np.asarray(ns.mesh.device_ids),
+                       list(ns.mesh.axis_names))
 
 
 def _mk_dist_prop(key):
     def get(self):
         rec = _dist_attr.get(id(self))
-        return rec.get(key) if rec else None
+        if rec is not None and key in rec:
+            return rec[key]
+        ns = _named_sharding_of(self)
+        if ns is None:
+            return None
+        return _derive_placements(ns) if key == "placements" \
+            else _derive_process_mesh(ns)
 
     def set_(self, value):
         k = id(self)
@@ -46,7 +80,8 @@ def _mk_dist_prop(key):
 
 Tensor.placements = _mk_dist_prop("placements")
 Tensor.process_mesh = _mk_dist_prop("process_mesh")
-Tensor.is_dist = lambda self: _dist_attr.get(id(self)) is not None
+Tensor.is_dist = lambda self: (_dist_attr.get(id(self)) is not None
+                               or _named_sharding_of(self) is not None)
 
 __all__ = ["ProcessMesh", "Shard", "Replicate", "Partial", "shard_tensor",
            "shard_op",
@@ -265,10 +300,27 @@ def shard_op(op_fn, process_mesh, in_placements=None,
 
     def wrapped(*args, **kwargs):
         if in_placements is not None:
+            flat = bool(in_placements) and not isinstance(
+                in_placements[0], (list, tuple))
+            n_tensor_args = sum(isinstance(a, Tensor) for a in args)
+            if flat and n_tensor_args > 1:
+                raise ValueError(
+                    "shard_op: a flat in_placements list like "
+                    f"{in_placements!r} is ambiguous for a function "
+                    f"receiving {n_tensor_args} tensor arguments — pass "
+                    "the nested per-argument form, e.g. "
+                    "[[Shard(0)], [Replicate()]] (advisor r5)")
             per_in = _per_item(in_placements)
-            args = tuple(
-                _place(a, per_in[i] if i < len(per_in) else None)
-                for i, a in enumerate(args))
+            if flat:
+                # single-tensor case: the flat list means THE tensor
+                # argument, wherever it sits — not positionally args[0]
+                args = tuple(_place(a, per_in[0])
+                             if isinstance(a, Tensor) else a
+                             for a in args)
+            else:
+                args = tuple(
+                    _place(a, per_in[i] if i < len(per_in) else None)
+                    for i, a in enumerate(args))
         out = op_fn(*args, **kwargs)
         if out_placements is None:
             return out

@@ -11,35 +11,53 @@ world:
 - The KV cache is a global PAGE POOL per layer ([KVH, num_pages,
   page_size, D]); each admitted request owns a page list (its block
   table row). Page 0 is a reserved trash page for drained slots.
-- A fixed number of SLOTS (the decode batch dimension) keeps every
-  compiled shape static. Admission = host-side: allocate pages from the
-  free list, run a compiled PREFILL (dense-cache forward over the
-  bucket-padded prompt, then scatter into the slot's pages), seed the
-  slot's first token.
+- A fixed number of SLOTS (the batch dimension) keeps every compiled
+  shape static. Admission = host-side: allocate pages from the free
+  list and mark the slot PREFILLING.
+- Prefill is CHUNKED and BATCHED through the paged pool: ONE compiled
+  prefill signature ([num_slots, prefill_chunk] ids) advances every
+  prefilling slot ``prefill_chunk`` prompt tokens per program — k/v are
+  written into the slot's pages incrementally
+  (``ops.paged_attention.paged_prefill_write``) and the chunk's queries
+  attend causally over the paged history
+  (``paged_prefill_attention``). No per-bucket dense-cache forward, no
+  exact-length recompiles for prompts longer than every bucket: every
+  prompt length flows through the same program, and up to
+  ``admit_batch`` queued prompts ride one program together. Prefill
+  waves INTERLEAVE with decode chunks, so a long prompt no longer
+  stalls active decode streams.
 - Decoding runs in compiled CHUNKS: ONE program advances ALL active
-  slots ``decode_chunk`` tokens via a ``lax.scan`` (per-slot positions,
-  paged attention reads, trash-page-guarded writes). Chunked continuous
-  batching bounds host↔device round-trips — mandatory through the axon
-  tunnel where per-step dispatch costs 100s of ms.
+  slots ``n`` tokens via a ``lax.scan`` (per-slot positions, paged
+  attention reads, trash-page-guarded writes). The chunk length is
+  ADAPTIVE (``adaptive_chunk``): clamped to the minimum remaining token
+  budget across active slots (quantized to a power-of-two ladder under
+  ``decode_chunk`` to bound compiled signatures), so a drain wave ends
+  exactly at the chunk boundary — no overshoot slot-steps, and the
+  once-per-drain-wave wasted speculative chunk program is gone (the
+  host can prove the successor would do no work).
 - Between chunks the host scheduler drains finished slots (eos or token
   budget), frees their pages, and admits queued requests into the freed
   slots — mixed-length streams flow through without ever reshaping the
-  compiled program.
+  compiled programs.
 - Hot state (last token / context length / active mask / RNG key / page
-  pools) is DEVICE-RESIDENT between chunks: each chunk call uploads one
-  packed int32 array (tables+limits+eos) and fetches one packed int32
-  array (emitted tokens + first-token echoes + ctx/active mirrors), and
-  prefill never fetches — its first token lands in device state and is
-  echoed through the next chunk's packed fetch. Measured on the tunnel
-  (v5e): per-call overhead was ~0.5s with per-array
-  uploads + a blocking scalar fetch per admission; the chunk's marginal
-  per-token cost is identical to the fused dense decode (4.2 ms/step at
-  batch 8 on the 1B config), so round-trips, not kernels, set the
-  serving throughput.
+  pools) is DEVICE-RESIDENT between programs: prefill waves and decode
+  chunks chain device state asynchronously; each decode chunk fetches
+  one packed int32 array (emitted tokens + first-token echoes + ctx/
+  active mirrors), and prefill never fetches — a prompt's first token
+  lands in device state and is echoed through the next chunk's packed
+  fetch. Measured on the tunnel (v5e): per-call overhead was ~0.5s with
+  per-array uploads + a blocking scalar fetch per admission; round
+  trips, not kernels, set the serving throughput.
+- Per-request latency accounting rides the scheduler: TTFT (arrival →
+  first token on host) and smoothed inter-token latency, exposed as
+  p50/p99 gauges next to the occupancy/overlap counters from PR 2, plus
+  a compiled-signature counter (``compiled_programs``) that the
+  compile-budget CI gate asserts on.
 """
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -61,28 +79,31 @@ class ServedRequest:
     tokens: list = field(default_factory=list)   # generated ids
     finished: bool = False
     finish_reason: str | None = None   # "eos" | "length"
-
-
-def _next_bucket(n, buckets):
-    for b in buckets:
-        if n <= b:
-            return b
-    return n        # longer than every bucket: its own (exact) signature
+    # latency accounting (seconds, perf_counter clock)
+    t_arrive: float = 0.0              # add_request
+    t_first: float = 0.0               # first token visible host-side
+    t_done: float = 0.0                # finished
 
 
 class ContinuousBatchingEngine:
     """Schedules mixed-length generation streams through one compiled
-    decode program. Greedy or temperature sampling.
+    decode program and one compiled batched-prefill program. Greedy or
+    temperature sampling.
 
     model: any CausalLM Layer implementing ``forward(ids, caches=, pos=,
     tables=)`` + ``init_kv_cache`` — Llama, Qwen2 (incl. MoE), and GPT2
-    all qualify. num_slots is the decode batch size; total pool memory =
-    num_pages * page_size tokens of KV per layer."""
+    all qualify. num_slots is the batch size; total pool memory =
+    num_pages * page_size tokens of KV per layer.
+
+    ``prompt_buckets`` is kept for API compatibility: buckets no longer
+    select prefill signatures (there is exactly ONE), but the largest
+    bucket seeds the default ``prefill_chunk``."""
 
     def __init__(self, model, num_slots=4, page_size=16, num_pages=None,
                  max_len=512, decode_chunk=16, prompt_buckets=(32, 64, 128),
                  eos_token_id=None, greedy=True, temperature=1.0,
-                 seed=0):
+                 seed=0, prefill_chunk=None, admit_batch=None,
+                 adaptive_chunk=True):
         self.model = model
         cfg = model.config
         self.cfg = cfg
@@ -94,7 +115,15 @@ class ContinuousBatchingEngine:
         self.num_pages = int(num_pages) if num_pages is not None else \
             self.num_slots * self.pages_per_slot + 1
         self.decode_chunk = int(decode_chunk)
-        self.prompt_buckets = tuple(sorted(prompt_buckets))
+        self.adaptive_chunk = bool(adaptive_chunk)
+        self.prompt_buckets = tuple(sorted(prompt_buckets)) \
+            if prompt_buckets else ()
+        if prefill_chunk is None:
+            prefill_chunk = self.prompt_buckets[-1] \
+                if self.prompt_buckets else 32
+        self.prefill_chunk = max(1, min(int(prefill_chunk), self.max_len))
+        self.admit_batch = self.num_slots if admit_batch is None \
+            else max(1, min(int(admit_batch), self.num_slots))
         self.eos = -1 if eos_token_id is None else int(eos_token_id)
         self.greedy = bool(greedy)
         self.temperature = float(temperature)
@@ -122,8 +151,24 @@ class ContinuousBatchingEngine:
         self.slot_eos = np.full((B,), -1, np.int32)  # per-request eos
         self.slot_req: list[ServedRequest | None] = [None] * B
         self.slot_pages: list[list] = [[] for _ in range(B)]
-        # pending first-token echo: slots admitted since the last chunk
-        # whose prefill token has not been appended host-side yet
+        # chunked-prefill progress: a slot whose prompt is still being
+        # streamed into its pages is PREFILLING — inactive for decode,
+        # ineligible for drain
+        self._prefilling = np.zeros((B,), bool)
+        self._prefill_off = np.zeros((B,), np.int32)   # tokens dispatched
+        self._act_target = np.zeros((B,), bool)  # activate on completion
+        # host prediction of device ctx (exact for length-limited slots;
+        # an eos stop only ever makes it an overestimate) — drives the
+        # adaptive chunk length and the is-the-successor-worth-it test
+        self._pred_ctx = np.zeros((B,), np.int32)
+        # monotone program-dispatch counter + per-slot activation seq:
+        # a decode chunk dispatched BEFORE a slot's final prefill wave
+        # has a stale view of that slot, so its ctx/active mirrors must
+        # not be applied at harvest
+        self._seq = 0
+        self._act_since = np.zeros((B,), np.int64)
+        # pending first-token echo: slots whose prefill finished but
+        # whose first token has not been appended host-side yet
         self._pending_first = np.zeros((B,), bool)
         # echo snapshotted into a dispatched-but-unharvested chunk: the
         # slot must not drain until that harvest appends the token (a
@@ -145,17 +190,22 @@ class ContinuousBatchingEngine:
         self.completed: list[ServedRequest] = []
         self._next_id = 0
         self._key = jax.random.PRNGKey(seed)
-        self._prefill_fns = {}
-        self._chunk_fn = None
+        self._prefill_fn = None        # ONE signature, lazily built
+        self._chunk_fns = {}           # chunk length -> compiled program
+        self._compiled = set()         # distinct compiled signatures
 
         # perf observability (profiler subsystem): raw counters behind
         # the :meth:`gauges` surface — slot occupancy, admission/prefill
-        # overlap, tok/s. Maintained unconditionally (integer adds);
-        # mirrored into the trace layer only when tracing is enabled.
+        # overlap, tok/s, latency percentiles. Maintained
+        # unconditionally (integer adds); mirrored into the trace layer
+        # only when tracing is enabled.
         self._stats = {"chunks": 0, "chunk_slot_steps": 0,
                        "active_slot_steps": 0, "tokens_emitted": 0,
                        "prefills": 0, "prefills_overlapped": 0,
+                       "prefill_waves": 0, "chunks_empty": 0,
                        "requests_completed": 0, "run_seconds": 0.0}
+        self._ttft_ms: list[float] = []
+        self._itl_ms: list[float] = []
         self._overlap_admission = False
 
     # ---- public API ------------------------------------------------------
@@ -169,26 +219,29 @@ class ContinuousBatchingEngine:
                 f"({max_new_tokens}) exceeds engine max_len {self.max_len}")
         # reject what the pool can NEVER satisfy — otherwise run() would
         # spin forever waiting for pages that cannot exist
-        worst = max(self._bucket_for(prompt.size),
-                    prompt.size + int(max_new_tokens))
-        if -(-worst // self.page_size) > self.num_pages - 1:
+        need = -(-(prompt.size + int(max_new_tokens)) // self.page_size)
+        if need > self.num_pages - 1:
             raise ValueError(
-                f"request needs {-(-worst // self.page_size)} pages but "
-                f"the pool only has {self.num_pages - 1} allocatable")
+                f"request needs {need} pages but the pool only has "
+                f"{self.num_pages - 1} allocatable")
         req = ServedRequest(self._next_id, prompt, int(max_new_tokens),
                             eos_token_id if eos_token_id is not None
                             else (self.eos if self.eos >= 0 else None))
+        req.t_arrive = time.perf_counter()
         self._next_id += 1
         self.queue.append(req)
         return req.request_id
 
     def has_work(self) -> bool:
-        return bool(self.queue) or bool(self.active.any())
+        return bool(self.queue) or bool(self.active.any()) \
+            or bool(self._prefilling.any())
 
     def step(self):
-        """Admit what fits, decode one chunk, drain finished slots.
-        Returns the requests completed by this step."""
+        """Admit what fits, stream all pending prefill chunks, decode one
+        chunk, drain finished slots. Returns the requests completed by
+        this step."""
         self._admit()
+        self._pump_prefill()
         if self.active.any():
             self._decode_chunk()
         return self._drain()
@@ -200,30 +253,30 @@ class ContinuousBatchingEngine:
         Pipelined: the NEXT chunk is ALWAYS dispatched before the
         previous chunk's packed output is fetched — device state chains
         asynchronously, so the harvest round-trip AND the whole
-        admission wave (prefill programs, slot-state updates) execute
-        while the speculative successor decodes on device: a prefill
-        consumes the successor's output pools, so it simply joins the
-        device stream after it, and the admitted slot starts decoding
-        in the chunk after that. A slot that finished inside the
-        previous chunk is inactive in the speculative successor (its
-        device active flag is already False), so the overlap never
-        decodes garbage; the admitted-into slots idle for exactly one
-        in-flight chunk — measured cheaper than serializing admission
-        on the tunnel round-trip (round-4 breakdown, BASELINE.md).
-        Cost accepted (advisor round 4): when every slot finished
-        inside the in-flight chunk and the queue is empty, one wasted
-        chunk program is dispatched per drain wave."""
-        import time as _time
+        admission wave (prefill-chunk programs, slot-state updates)
+        execute while the speculative successor decodes on device: a
+        prefill wave consumes the successor's output pools, so it simply
+        joins the device stream after it, and an admitted slot starts
+        decoding in the chunk after its final prefill wave. A slot that
+        finished inside the previous chunk is inactive in the
+        speculative successor (its device active flag is already False),
+        so the overlap never decodes garbage. The successor is SKIPPED
+        when the host can prove it would do no work (every active slot's
+        predicted remaining budget is zero) — with adaptive chunk
+        lengths that proof fires exactly at each drain wave, so the
+        round-4 "one wasted chunk program per drain wave" cost is gone
+        (``chunks_empty`` measures any residue, e.g. eos stops the host
+        cannot predict)."""
         done = []
         inflight = None
-        t_run0 = _time.perf_counter()
+        t_run0 = time.perf_counter()
         try:
             while True:
                 if inflight is not None:
                     # speculative successor first: device never idles
                     # while the host harvests, drains, and admits
-                    nxt = self._dispatch_chunk() if self.active.any() \
-                        else None
+                    nxt = self._dispatch_chunk() \
+                        if self._worth_dispatching() else None
                     self._harvest_chunk(inflight)
                     done.extend(self._drain())
                     # prefills overlap nxt's on-device run — the gauge
@@ -231,6 +284,10 @@ class ContinuousBatchingEngine:
                     self._overlap_admission = nxt is not None
                     try:
                         self._admit()
+                        # ONE prefill wave per scheduler turn: prompt
+                        # streaming interleaves with decode chunks
+                        # instead of stalling them
+                        self._pump_prefill(max_waves=1)
                     finally:
                         self._overlap_admission = False
                     inflight = nxt
@@ -238,6 +295,9 @@ class ContinuousBatchingEngine:
                 n_before = len(done)
                 self._admit()
                 done.extend(self._drain())
+                if self._prefilling.any():
+                    self._pump_prefill(max_waves=1)
+                    continue
                 if self.active.any():
                     inflight = self._dispatch_chunk()
                     continue
@@ -251,25 +311,38 @@ class ContinuousBatchingEngine:
                         "serving engine stalled: queued request cannot "
                         "be admitted (page pool exhausted?)")
         finally:
-            self._stats["run_seconds"] += _time.perf_counter() - t_run0
+            self._stats["run_seconds"] += time.perf_counter() - t_run0
             self._emit_gauges()
         return done
 
     def gauges(self) -> dict:
         """Serving observability surface (profiler subsystem):
 
-        - ``slot_occupancy``: emitted tokens / (chunks x slots x
-          decode_chunk) — the fraction of compiled slot-steps that
-          produced a token (the ~0.71 in BASELINE.md's CB ceiling).
+        - ``slot_occupancy``: emitted tokens / dispatched slot-steps —
+          the fraction of compiled slot-steps that produced a token.
         - ``active_occupancy``: slots active at dispatch / all slots —
           the drain/re-admit idle share specifically.
-        - ``prefill_overlap_frac``: prefills dispatched while a decode
-          chunk was in flight (the round-5 admission-overlap claim,
-          now measured instead of asserted).
+        - ``prefill_overlap_frac``: admissions made while a decode chunk
+          was in flight (prefill waves then overlap its on-device run).
         - ``tokens_per_s``: emitted tokens / wall seconds inside run().
+        - ``ttft_ms_p50/p99``: request-arrival → first-token-on-host
+          percentiles (completed requests).
+        - ``itl_ms_p50/p99``: smoothed inter-token latency percentiles —
+          (t_done - t_first) / (tokens - 1) per request with ≥2 tokens.
+        - ``compiled_programs``: distinct compiled signatures this
+          engine built (1 prefill + the decode-chunk-length ladder) —
+          the compile-budget CI gate asserts on this.
+        - ``chunks_empty``: harvested decode chunks that delivered no
+          tokens (unpredictable eos stops; structurally-wasted drain
+          wave dispatches are eliminated).
+        - ``prefill_waves``: batched prefill-chunk programs dispatched.
         """
         s = self._stats
         steps = s["chunk_slot_steps"]
+
+        def pct(xs, q):
+            return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
+
         return {
             "slot_occupancy": s["tokens_emitted"] / steps if steps
             else 0.0,
@@ -280,7 +353,14 @@ class ContinuousBatchingEngine:
             else 0.0,
             "tokens_per_s": (s["tokens_emitted"] / s["run_seconds"])
             if s["run_seconds"] else 0.0,
+            "ttft_ms_p50": pct(self._ttft_ms, 50),
+            "ttft_ms_p99": pct(self._ttft_ms, 99),
+            "itl_ms_p50": pct(self._itl_ms, 50),
+            "itl_ms_p99": pct(self._itl_ms, 99),
+            "compiled_programs": len(self._compiled),
             "chunks_dispatched": s["chunks"],
+            "chunks_empty": s["chunks_empty"],
+            "prefill_waves": s["prefill_waves"],
             "tokens_emitted": s["tokens_emitted"],
             "prefills": s["prefills"],
             "requests_completed": s["requests_completed"],
@@ -288,9 +368,13 @@ class ContinuousBatchingEngine:
 
     def reset_gauges(self):
         """Zero the gauge counters (e.g. after a warmup run whose lazy
-        compiles would otherwise pollute tokens_per_s)."""
+        compiles would otherwise pollute tokens_per_s). The compiled-
+        signature set is NOT cleared — compiled programs persist on the
+        engine, so the compile-budget counter stays truthful."""
         for k in self._stats:
             self._stats[k] = 0.0 if k == "run_seconds" else 0
+        self._ttft_ms = []
+        self._itl_ms = []
 
     def _emit_gauges(self):
         from ..profiler.trace import get_tracer
@@ -301,13 +385,7 @@ class ContinuousBatchingEngine:
             tr.counter(f"serving/{name}",
                        round(val, 6) if isinstance(val, float) else val)
 
-    # ---- admission / prefill --------------------------------------------
-
-    def _bucket_for(self, prompt_len):
-        """Padded prefill length: the smallest bucket covering the prompt,
-        clamped to max_len, never below the prompt itself."""
-        return min(max(_next_bucket(prompt_len, self.prompt_buckets),
-                       prompt_len), self.max_len)
+    # ---- admission / chunked batched prefill -----------------------------
 
     def _alloc_pages(self, n):
         if len(self._free_pages) < n:
@@ -315,15 +393,18 @@ class ContinuousBatchingEngine:
         return [self._free_pages.popleft() for _ in range(n)]
 
     def _admit(self):
+        """Move queued requests into free slots: allocate pages, stage
+        per-slot state, and mark the slot PREFILLING — the prompt itself
+        streams through the batched prefill-chunk program in
+        :meth:`_pump_prefill`."""
         for slot in range(self.num_slots):
             if not self.queue:
                 return
             if self.active[slot] or self.slot_req[slot] is not None:
                 continue
             req = self.queue[0]
-            bucket = self._bucket_for(len(req.prompt))
-            need_tokens = max(bucket, len(req.prompt) + req.max_new_tokens)
-            need = -(-need_tokens // self.page_size)
+            tl = len(req.prompt)
+            need = -(-(tl + req.max_new_tokens) // self.page_size)
             pages = self._alloc_pages(need)
             if pages is None:
                 return        # pool exhausted; retry after a drain
@@ -332,113 +413,190 @@ class ContinuousBatchingEngine:
             row = np.zeros((self.pages_per_slot,), np.int32)
             row[:len(pages)] = pages
             self.tables[slot] = row
-            self._dev_tbl = self._dev_tbl.at[slot].set(
-                jnp.asarray(row))
-            self._prefill(slot, req, bucket)
+            self._dev_tbl = self._dev_tbl.at[slot].set(jnp.asarray(row))
+            self._stats["prefills"] += 1
+            if self._overlap_admission:
+                self._stats["prefills_overlapped"] += 1
+            from ..profiler.trace import get_tracer
+            _tr = get_tracer()
+            if _tr.enabled:
+                _tr.instant("serving/prefill", slot=slot, prompt_len=tl,
+                            chunk=self.prefill_chunk,
+                            overlapped=self._overlap_admission)
+            self.slot_req[slot] = req
+            self._prefilling[slot] = True
+            self._prefill_off[slot] = 0
+            self._act_target[slot] = req.max_new_tokens > 1
+            self.ctx[slot] = 0
+            self._pred_ctx[slot] = 0
+            self._dev_ctx = self._dev_ctx.at[slot].set(0)
+            self.slot_eos[slot] = -1 if req.eos_token_id is None \
+                else int(req.eos_token_id)
+            # ctx counts CACHE entries; one generated token is always
+            # pending outside the cache, so the n-th token lands when
+            # ctx hits tl + n - 1 (not tl + n)
+            self.limits[slot] = tl + req.max_new_tokens - 1
+            self._dev_lim = self._dev_lim.at[slot].set(
+                int(self.limits[slot]))
+            self._dev_eos = self._dev_eos.at[slot].set(
+                int(self.slot_eos[slot]))
 
-    def _prefill_fn(self, bucket):
-        fn = self._prefill_fns.get(bucket)
-        if fn is not None:
-            return fn
-        from ..jit import to_static
-        model = self.model
-
-        def prefill(ids, true_len_t, slot_tables, temperature, greedy,
-                    key_t, *pools):
-            """ids: [1, bucket]; returns (first_tok[1], new_pools...)."""
-            with no_grad():
-                dense = model.init_kv_cache(1, ids.shape[1])
-                logits, dense = model(ids, caches=dense,
-                                      pos=Tensor(jnp.zeros((), jnp.int32)))
-
-            def fn(lg, tl, tbl, key, *leaves):
-                from ..ops.paged_attention import pack_prompt_into_pages
-                last = jax.lax.dynamic_index_in_dim(
-                    lg[0], tl - 1, 0, False)          # [V]
-                lgf = last.astype(jnp.float32)
-                if greedy:
-                    tok = jnp.argmax(lgf).astype(jnp.int32)
-                else:
-                    key, sub = jax.random.split(key)
-                    tok = jax.random.categorical(
-                        sub, lgf / temperature).astype(jnp.int32)
-                n = len(leaves) // 2
-                pool_l, dense_l = leaves[:n], leaves[n:]
-                out = []
-                for i in range(0, n, 2):   # pairs: (k pages, v pages)
-                    kp, vp = pack_prompt_into_pages(
-                        pool_l[i], pool_l[i + 1],
-                        dense_l[i], dense_l[i + 1], tbl)
-                    out.extend((kp, vp))
-                return (tok.reshape(1), key) + tuple(out)
-
-            res = _apply_multi(fn, [logits, true_len_t, slot_tables, key_t]
-                               + list(pools) + list(dense),
-                               n_out=2 + len(pools))
-            return res
-
-        fn = to_static(prefill)
-        self._prefill_fns[bucket] = fn
-        return fn
-
-    def _prefill(self, slot, req, bucket):
-        self._stats["prefills"] += 1
-        if self._overlap_admission:
-            self._stats["prefills_overlapped"] += 1
-        from ..profiler.trace import get_tracer
-        _tr = get_tracer()
-        if _tr.enabled:
-            _tr.instant("serving/prefill", slot=slot, bucket=bucket,
-                        overlapped=self._overlap_admission)
-        ids = np.zeros((1, bucket), np.int32)
-        ids[0, :len(req.prompt)] = req.prompt
-        tl = len(req.prompt)
-        fn = self._prefill_fn(bucket)
-        res = fn(Tensor(jnp.asarray(ids)),
-                 Tensor(jnp.asarray(tl, jnp.int32)),
-                 Tensor(jnp.asarray(self.tables[slot])),
-                 self.temperature, self.greedy, Tensor(self._key),
-                 *self.pools)
-        tok, key = res[0], res[1]
-        self.pools = list(res[2:])
-        self._key = key._data if isinstance(key, Tensor) else key
-        # NO host fetch here: the first token stays on device and is
-        # echoed back through the next chunk's packed fetch (a blocking
-        # scalar read per admission would serialize the whole admission
-        # wave on tunnel round-trips)
-        tok_dev = tok._data if isinstance(tok, Tensor) else tok
-        self._dev_tok = self._dev_tok.at[slot].set(tok_dev[0])
-        self._dev_ctx = self._dev_ctx.at[slot].set(tl)
-        self.slot_req[slot] = req
-        self._pending_first[slot] = True
-        self.ctx[slot] = tl
-        self.slot_eos[slot] = -1 if req.eos_token_id is None \
-            else int(req.eos_token_id)
-        # ctx counts CACHE entries; one generated token is always pending
-        # outside the cache, so the n-th token lands when ctx hits
-        # tl + n - 1 (not tl + n)
-        self.limits[slot] = tl + req.max_new_tokens - 1
-        self._dev_lim = self._dev_lim.at[slot].set(int(self.limits[slot]))
-        self._dev_eos = self._dev_eos.at[slot].set(
-            int(self.slot_eos[slot]))
-        one_shot = req.max_new_tokens <= 1
-        # instant-eos (first token == stop token) is detected ON DEVICE
-        # at the next chunk's entry; only the structural one-token case
-        # is known host-side now
-        self._dev_act = self._dev_act.at[slot].set(not one_shot)
-        self.active[slot] = not one_shot
-
-    # ---- chunked decode --------------------------------------------------
-
-    def _chunk_static(self):
-        if self._chunk_fn is not None:
-            return self._chunk_fn
+    def _prefill_static(self):
+        """The ONE compiled prefill signature: every wave — any mix of
+        prompt lengths, any number of admitted prompts up to
+        ``admit_batch`` — runs through this [num_slots, prefill_chunk]
+        program. Writes pages incrementally, attends causally over the
+        paged history, and samples the first token for slots whose
+        prompt ends inside the chunk (it stays device-resident; the next
+        decode chunk echoes it through the packed fetch)."""
+        if self._prefill_fn is not None:
+            return self._prefill_fn
         from ..jit import to_static
         model = self.model
         greedy = self.greedy
         temperature = self.temperature
-        n_steps = self.decode_chunk
-        MP = self.pages_per_slot
+        C = self.prefill_chunk
+
+        def prefill(ids_t, pstart_t, valid_t, last_t, tgt_t, tok_t,
+                    ctx_t, act_t, tbl_t, key_t, *pools):
+
+            def fn(ids, pstart, valid, last, tgt, tok, ctx, act, tbl,
+                   key, *pool_leaves):
+                with no_grad():
+                    logits, npools = model(
+                        Tensor(ids),
+                        caches=[Tensor(a) for a in pool_leaves],
+                        pos=Tensor(pstart[:, None]),
+                        tables=(Tensor(tbl), Tensor(valid)))
+                lg = logits._data                        # [B, C, V]
+                idx = jnp.clip(valid - 1, 0, C - 1)
+                last_lg = jnp.take_along_axis(
+                    lg, idx[:, None, None], axis=1)[:, 0]
+                last_lg = last_lg.astype(jnp.float32)    # [B, V]
+                if greedy:
+                    sampled = jnp.argmax(last_lg, -1).astype(jnp.int32)
+                else:
+                    key, sub = jax.random.split(key)
+                    sampled = jax.random.categorical(
+                        sub, last_lg / temperature).astype(jnp.int32)
+                fire = last & (valid > 0)
+                tok2 = jnp.where(fire, sampled, tok)
+                ctx2 = ctx + valid
+                act2 = jnp.where(fire, tgt, act)
+                return (tok2, ctx2, act2, key) + tuple(
+                    t._data for t in npools)
+
+            return _apply_multi(
+                fn, [ids_t, pstart_t, valid_t, last_t, tgt_t, tok_t,
+                     ctx_t, act_t, tbl_t, key_t] + list(pools),
+                n_out=4 + len(pools))
+
+        self._prefill_fn = to_static(prefill)
+        self._compiled.add(("prefill", C))
+        return self._prefill_fn
+
+    def _pump_prefill(self, max_waves=None):
+        """Dispatch batched prefill-chunk programs until every
+        prefilling slot has streamed its whole prompt (or ``max_waves``
+        waves were dispatched — the interleaving throttle). Entirely
+        async: no host fetch; completion is host-predicted (prompt
+        lengths are known)."""
+        B, C = self.num_slots, self.prefill_chunk
+        waves = 0
+        while self._prefilling.any():
+            if max_waves is not None and waves >= max_waves:
+                return
+            ids = np.zeros((B, C), np.int32)
+            pstart = np.zeros((B,), np.int32)
+            valid = np.zeros((B,), np.int32)
+            last = np.zeros((B,), bool)
+            tgt = np.zeros((B,), bool)
+            batched = []
+            for slot in range(B):
+                if not self._prefilling[slot]:
+                    continue
+                if len(batched) >= self.admit_batch:
+                    continue      # next wave picks it up
+                req = self.slot_req[slot]
+                off = int(self._prefill_off[slot])
+                v = min(C, len(req.prompt) - off)
+                ids[slot, :v] = req.prompt[off:off + v]
+                pstart[slot] = off
+                valid[slot] = v
+                last[slot] = off + v == len(req.prompt)
+                tgt[slot] = self._act_target[slot]
+                batched.append(slot)
+            fn = self._prefill_static()
+            self._seq += 1
+            self._stats["prefill_waves"] += 1
+            res = fn(Tensor(jnp.asarray(ids)), Tensor(jnp.asarray(pstart)),
+                     Tensor(jnp.asarray(valid)), Tensor(jnp.asarray(last)),
+                     Tensor(jnp.asarray(tgt)), Tensor(self._dev_tok),
+                     Tensor(self._dev_ctx), Tensor(self._dev_act),
+                     Tensor(self._dev_tbl), Tensor(self._key),
+                     *self.pools)
+            tok2, ctx2, act2, key2 = res[:4]
+            self.pools = list(res[4:])
+            self._dev_tok = tok2._data
+            self._dev_ctx = ctx2._data
+            self._dev_act = act2._data
+            self._key = key2._data
+            for slot in batched:
+                self._prefill_off[slot] += valid[slot]
+                if not last[slot]:
+                    continue
+                # final wave for this prompt: host-side activation —
+                # the sampled first token stays on device and is echoed
+                # through the next decode chunk's packed fetch (or the
+                # drain-time fetch for one-shot tail requests)
+                req = self.slot_req[slot]
+                tl = len(req.prompt)
+                self._prefilling[slot] = False
+                self.ctx[slot] = tl
+                self._pred_ctx[slot] = tl
+                self._pending_first[slot] = True
+                self._act_since[slot] = self._seq
+                # instant-eos (first token == stop token) is detected ON
+                # DEVICE at the next chunk's entry; only the structural
+                # one-token case is known host-side now
+                self.active[slot] = bool(self._act_target[slot])
+            waves += 1
+
+    # ---- chunked decode --------------------------------------------------
+
+    def _worth_dispatching(self):
+        """Is there any slot a decode chunk could advance? With the
+        host's ctx prediction this is exact for length-limited slots, so
+        the structurally-wasted drain-wave dispatch never happens; an
+        eos stop the host cannot see may still yield an empty chunk
+        (counted in ``chunks_empty``)."""
+        return bool(np.any(self.active & (self.limits > self._pred_ctx)))
+
+    def _next_chunk_len(self):
+        """Adaptive chunk length: clamp to the minimum predicted
+        remaining budget across active slots so no slot oversteps its
+        limit inside a chunk, quantized to a power-of-two ladder ≤
+        ``decode_chunk`` to bound distinct compiled signatures."""
+        if not self.adaptive_chunk:
+            return self.decode_chunk
+        rem = (self.limits - self._pred_ctx)[self.active
+                                             & (self.limits
+                                                > self._pred_ctx)]
+        if rem.size == 0:
+            return self.decode_chunk
+        m = int(rem.min())
+        if m >= self.decode_chunk:
+            return self.decode_chunk
+        return 1 << (m.bit_length() - 1)
+
+    def _chunk_static(self, n_steps):
+        fn = self._chunk_fns.get(n_steps)
+        if fn is not None:
+            return fn
+        from ..jit import to_static
+        model = self.model
+        greedy = self.greedy
+        temperature = self.temperature
 
         def chunk(tok_t, ctx_t, act_t, tbl_t, lim_t, eos_t, key_t,
                   *pools):
@@ -499,25 +657,32 @@ class ContinuousBatchingEngine:
                                      eos_t, key_t]
                                 + list(pools), n_out=5 + len(pools))
 
-        self._chunk_fn = to_static(chunk)
-        return self._chunk_fn
+        fn = to_static(chunk)
+        self._chunk_fns[n_steps] = fn
+        self._compiled.add(("chunk", n_steps))
+        return fn
 
     def _dispatch_chunk(self):
         """Launch one chunk program (async) and chain the device state.
         Returns an in-flight record for :meth:`_harvest_chunk` — the
         packed output is NOT fetched here, so a caller may overlap the
         fetch with the next chunk's on-device compute."""
-        fn = self._chunk_static()
+        n = self._next_chunk_len()
+        fn = self._chunk_static(n)
+        self._seq += 1
         self._stats["chunks"] += 1
-        self._stats["chunk_slot_steps"] += self.num_slots \
-            * self.decode_chunk
-        n_active = int(self.active.sum())
-        self._stats["active_slot_steps"] += n_active * self.decode_chunk
+        self._stats["chunk_slot_steps"] += self.num_slots * n
+        # "active" for occupancy accounting = slots this chunk can
+        # actually advance (host-active AND budget remaining); a slot
+        # that exhausted its budget but has not drained yet is idle
+        n_active = int(np.sum(self.active
+                              & (self.limits > self._pred_ctx)))
+        self._stats["active_slot_steps"] += n_active * n
         from ..profiler.trace import get_tracer
         _tr = get_tracer()
         if _tr.enabled:
             _tr.counter("serving/active_slots", n_active,
-                        queued=len(self.queue))
+                        queued=len(self.queue), chunk_len=n)
         res = fn(Tensor(self._dev_tok), Tensor(self._dev_ctx),
                  Tensor(self._dev_act), Tensor(self._dev_tbl),
                  Tensor(self._dev_lim), Tensor(self._dev_eos),
@@ -528,24 +693,31 @@ class ContinuousBatchingEngine:
         self._dev_ctx = ctx_f._data
         self._dev_act = act_f._data
         self._key = key_f._data
-        # snapshot the slot->request mapping and the pending-first mask:
-        # by harvest time a drained slot may have been re-admitted to a
-        # NEW request whose tokens belong to a later chunk
-        rec = (packed, list(self.slot_req), self._pending_first.copy())
+        self._pred_ctx = np.where(
+            self.active,
+            np.minimum(self.limits, self._pred_ctx + n),
+            self._pred_ctx).astype(np.int32)
+        # snapshot the slot->request mapping, the pending-first mask and
+        # the dispatch seq: by harvest time a drained slot may have been
+        # re-admitted (or a prefilling slot activated) — stale views
+        # must not be applied
+        rec = (packed, list(self.slot_req), self._pending_first.copy(),
+               n, self._seq)
         self._echo_inflight |= self._pending_first
         self._pending_first[:] = False
         return rec
 
     def _harvest_chunk(self, rec):
         """Fetch one in-flight chunk's packed output and apply it."""
-        packed, snap_req, pending = rec
+        packed, snap_req, pending, n, seq = rec
         arr = np.asarray(packed._data)            # the ONE fetch
-        n = self.decode_chunk
         toks_np = arr[:, :n]
         emitted_np = arr[:, n:2 * n].astype(bool)
         init_tok = arr[:, 2 * n]
         ctx_m = arr[:, 2 * n + 1].astype(np.int32)
         act_m = arr[:, 2 * n + 2].astype(bool)
+        t_now = time.perf_counter()
+        appended = 0
         for slot in range(self.num_slots):
             if pending[slot]:
                 # this harvest delivers the slot's first-token echo;
@@ -554,19 +726,30 @@ class ContinuousBatchingEngine:
             req = snap_req[slot]
             if req is not self.slot_req[slot]:
                 continue      # slot re-admitted since this dispatch
-            self.ctx[slot] = ctx_m[slot]
-            self.active[slot] = act_m[slot]
+            if self._act_since[slot] <= seq:
+                # the chunk's view of this slot is current (it was not
+                # re-activated by a prefill wave after this dispatch)
+                self.ctx[slot] = ctx_m[slot]
+                self.active[slot] = act_m[slot]
             if req is None:
                 continue
             if pending[slot]:
+                if not req.tokens:
+                    req.t_first = t_now
                 req.tokens.append(int(init_tok[slot]))
                 self._stats["tokens_emitted"] += 1
+                appended += 1
             if req.finished:
                 continue
             for j in range(n):
                 if emitted_np[slot, j]:
+                    if not req.tokens:
+                        req.t_first = t_now
                     req.tokens.append(int(toks_np[slot, j]))
                     self._stats["tokens_emitted"] += 1
+                    appended += 1
+        if appended == 0:
+            self._stats["chunks_empty"] += 1
 
     def _decode_chunk(self):
         self._harvest_chunk(self._dispatch_chunk())
@@ -579,32 +762,49 @@ class ContinuousBatchingEngine:
             req = self.slot_req[slot]
             if req is None:
                 continue
+            if self._prefilling[slot]:
+                # prompt still streaming through prefill waves — the
+                # slot is inactive but very much occupied
+                continue
             if self._echo_inflight[slot]:
                 # first-token echo rides a dispatched-but-unharvested
                 # chunk: finishing now would lose it (defer one loop)
                 continue
             if not self.active[slot]:
                 if self._pending_first[slot]:
-                    # finished without any chunk running after admission
-                    # (one-token request at the tail of the workload):
-                    # the first token never got echoed — fetch it now
+                    # finished without any chunk running after prefill
+                    # completion (one-token request at the tail of the
+                    # workload): the first token never got echoed —
+                    # fetch it now
+                    req.t_first = time.perf_counter()
                     req.tokens.append(int(np.asarray(
                         self._dev_tok[slot])))
                     self._stats["tokens_emitted"] += 1
                     self._pending_first[slot] = False
                 if not req.finished:
                     req.finished = True
+                    req.t_done = time.perf_counter()
                     eos = req.eos_token_id
                     req.finish_reason = "eos" if (
                         eos is not None and req.tokens
                         and req.tokens[-1] == eos) else "length"
+                    if req.t_first:
+                        self._ttft_ms.append(
+                            (req.t_first - req.t_arrive) * 1e3)
+                        if len(req.tokens) > 1:
+                            self._itl_ms.append(
+                                (req.t_done - req.t_first) * 1e3
+                                / (len(req.tokens) - 1))
                 self._free_pages.extend(self.slot_pages[slot])
                 self.slot_pages[slot] = []
                 self.slot_req[slot] = None
                 self.tables[slot] = 0
                 self.ctx[slot] = 0
+                self._pred_ctx[slot] = 0
                 self.limits[slot] = 0
                 self.slot_eos[slot] = -1
+                self._prefill_off[slot] = 0
+                self._act_target[slot] = False
                 self.completed.append(req)
                 self._stats["requests_completed"] += 1
                 done.append(req)

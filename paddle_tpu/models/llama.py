@@ -146,29 +146,49 @@ def rope_with_offset(t, pos, max_pos, theta):
 
 def _paged_attention_step(attn, q, k, v, cache, pos, tables, rope=True,
                           proj=None):
-    """Continuous-batching decode step over the PAGED pool, shared by the
+    """Continuous-batching step over the PAGED pool, shared by the
     Llama/Qwen2/GPT2 attention layers: per-slot positions (mixed-length
     streams), trash-page routing for drained slots (serving engine
     path). ``attn`` supplies head geometry; rope=False for learned-
     position models; ``proj`` overrides the output projection
-    (defaults to attn.o_proj)."""
+    (defaults to attn.o_proj).
+
+    ``tables`` is ``(block_tables, gate)``. With a single query token
+    (decode) the gate is the boolean active mask and the step runs the
+    decode write + decode kernel. With a multi-token chunk (chunked
+    prefill, q [B, C, H, D]) the gate is an int32 per-slot VALID count
+    (tokens of the chunk that are real): the chunk's k/v are written
+    into the pages incrementally and the queries run causally over the
+    paged history (``paged_prefill_attention``)."""
     b, s = q.shape[0], q.shape[1]
-    tbl, active = tables
+    tbl, gate = tables
     if rope:
         q = rope_with_offset(q, pos, attn.cfg.max_position_embeddings,
                              attn.cfg.rope_theta)
         k = rope_with_offset(k, pos, attn.cfg.max_position_embeddings,
                              attn.cfg.rope_theta)
 
-    def fn(qa, ka, va, kpa, vpa, tba, acta, cta):
-        from ..ops import paged_attention as PA
-        ct = cta[:, 0]
-        kpa, vpa = PA.paged_decode_write(kpa, vpa, ka, va, tba, ct, acta)
-        out = PA.paged_attention(qa[:, 0], kpa, vpa, tba, ct + 1)
-        return out[:, None], kpa, vpa
+    if s == 1:
+        def fn(qa, ka, va, kpa, vpa, tba, gatea, cta):
+            from ..ops import paged_attention as PA
+            ct = cta[:, 0]
+            act = gatea if gatea.dtype == jnp.bool_ else gatea > 0
+            kpa, vpa = PA.paged_decode_write(kpa, vpa, ka, va, tba, ct,
+                                             act)
+            out = PA.paged_attention(qa[:, 0], kpa, vpa, tba, ct + 1)
+            return out[:, None], kpa, vpa
+    else:
+        def fn(qa, ka, va, kpa, vpa, tba, gatea, cta):
+            from ..ops import paged_attention as PA
+            ct = cta[:, 0]
+            valid = gatea.astype(jnp.int32)
+            kpa, vpa = PA.paged_prefill_write(kpa, vpa, ka, va, tba, ct,
+                                              valid)
+            out = PA.paged_prefill_attention(qa, kpa, vpa, tba, ct)
+            return out, kpa, vpa
 
     ctx_out, kp2, vp2 = apply(
-        fn, q, k, v, cache[0], cache[1], tbl, active, pos,
+        fn, q, k, v, cache[0], cache[1], tbl, gate, pos,
         n_outputs=3, name="paged_decode_attention", differentiable=False)
     ctx_out = M.reshape(ctx_out, [b, s, attn.num_heads * attn.head_dim])
     out_proj = proj if proj is not None else attn.o_proj

@@ -27,18 +27,27 @@ __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
 
 _INT_RANGE = {"weight_only_int8": 127.0, "llm.int8": 127.0,
               "weight_only_int4": 7.0}
+# clip bounds: int8 stays symmetric ([-127, 127], the reference skips
+# -128), int4 clips to the FULL asymmetric two's-complement range
+# [-8, 7] like the reference kernels (advisor r5) — absmax/7 scaling
+# never ROUNDS to -8, but pre-quantized checkpoints and group-wise
+# paths carry it, and re-clipping to -7 would corrupt those values
+_INT_CLIP = {"weight_only_int8": (-127.0, 127.0),
+             "llm.int8": (-127.0, 127.0),
+             "weight_only_int4": (-8.0, 7.0)}
 
 
 def weight_quantize(x, algo="weight_only_int8", arch=None,
                     group_size=-1):
     """Per-out-channel absmax quantization: x [in, out] float ->
-    (w_q int8 [in, out], scale float32 [out]). int4 values live in
-    [-7, 7] stored one-per-int8 (the reference nibble-packs; the
-    layout is backend-private there too, so parity is (quant, scale)
-    semantics, not bytes)."""
+    (w_q int8 [in, out], scale float32 [out]). int4 values live in the
+    full asymmetric range [-8, 7] stored one-per-int8 (the reference
+    nibble-packs; the layout is backend-private there too, so parity is
+    (quant, scale) semantics, not bytes)."""
     if algo not in _INT_RANGE:
         raise ValueError(f"unknown weight_quantize algo {algo!r}")
     r = _INT_RANGE[algo]
+    lo, hi = _INT_CLIP[algo]
 
     def fn(w):
         wf = w.astype(jnp.float32)
@@ -51,11 +60,11 @@ def weight_quantize(x, algo="weight_only_int8", arch=None,
             g = wf.reshape(k // group_size, group_size, -1)
             scale = jnp.max(jnp.abs(g), axis=1) / r   # [groups, out]
             q = jnp.clip(jnp.round(g / jnp.maximum(scale, 1e-8)[:, None]),
-                         -r, r).astype(jnp.int8)
+                         lo, hi).astype(jnp.int8)
             return q.reshape(wf.shape), scale
         scale = jnp.max(jnp.abs(wf), axis=0) / r      # [out]
         q = jnp.clip(jnp.round(wf / jnp.maximum(scale, 1e-8)),
-                     -r, r).astype(jnp.int8)
+                     lo, hi).astype(jnp.int8)
         return q, scale
 
     return apply(fn, x, n_outputs=2, differentiable=False,

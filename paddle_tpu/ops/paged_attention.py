@@ -31,7 +31,8 @@ import jax
 import jax.numpy as jnp
 
 __all__ = ["paged_attention", "paged_attention_reference",
-           "paged_decode_write", "pack_prompt_into_pages"]
+           "paged_prefill_attention", "paged_prefill_attention_reference",
+           "paged_decode_write", "paged_prefill_write"]
 
 _NEG_INF = -1e30
 
@@ -103,6 +104,62 @@ def paged_attention(q, key_pages, value_pages, block_tables, context_lens,
                                      block_tables, context_lens, scale)
 
 
+def paged_prefill_attention_reference(q, key_pages, value_pages,
+                                      block_tables, context_lens,
+                                      scale=None):
+    """Pure-jnp oracle for CHUNKED prefill over the page pool.
+
+    q: [B, C, H, D] — C query tokens per sequence whose k/v have already
+    been written into the pages at positions ``ctx .. ctx+C-1`` (see
+    :func:`paged_prefill_write`). ``context_lens`` [B] is the cache
+    length BEFORE the chunk; query token j attends every cache position
+    ``<= ctx + j`` — full paged history behind it, causal within the
+    chunk. With C == 1 this reduces exactly to the decode oracle called
+    as ``paged_attention(q[:, 0], ..., ctx + 1)``.
+
+    Per-query masking is over the SAME gathered [max_len] axis the
+    decode oracle uses, so chunked and whole-prompt prefill reduce in
+    the same order — the basis of the token-parity guarantee.
+    """
+    b, c, h, d = q.shape
+    kvh, _, page_size, _ = key_pages.shape
+    rep = h // kvh
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    max_len = block_tables.shape[1] * page_size
+
+    def one_seq(qi, table, ctx_len):
+        # [KVH, pages_per_seq, page, D] -> [KVH, max_len, D]
+        k = key_pages[:, table].reshape(kvh, max_len, d)
+        v = value_pages[:, table].reshape(kvh, max_len, d)
+        k = jnp.repeat(k, rep, axis=0)  # [H, max_len, D]
+        v = jnp.repeat(v, rep, axis=0)
+        logits = jnp.einsum("chd,hkd->chk", qi, k,
+                            preferred_element_type=jnp.float32) * s
+        allow = (jnp.arange(max_len)[None, :]
+                 <= (ctx_len + jnp.arange(c))[:, None])   # [C, max_len]
+        logits = jnp.where(allow[:, None, :], logits, _NEG_INF)
+        probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+        return jnp.einsum("chk,hkd->chd", probs, v)
+
+    return jax.vmap(one_seq)(q, block_tables, context_lens)
+
+
+def paged_prefill_attention(q, key_pages, value_pages, block_tables,
+                            context_lens, scale=None):
+    """Multi-token-query paged attention (chunked prefill).
+
+    Layout is Pallas-ready — q [B, C, H, D] with the page pool and
+    block-table/context-length operands in the exact shapes the jax
+    ragged-paged-attention TPU kernels take (PAPERS.md
+    ragged-paged-attention); when that kernel is wired in it slots into
+    this dispatcher the way the decode kernel does in
+    :func:`paged_attention`. Until then every platform runs the jnp
+    reference — on TPU the chunk is C·max_len work per slot, still far
+    cheaper than the per-bucket dense recompute it replaces."""
+    return paged_prefill_attention_reference(
+        q, key_pages, value_pages, block_tables, context_lens, scale)
+
+
 def paged_decode_write(kp, vp, k, v, block_tables, ctx, active=None):
     """Write one decode step's k/v into the page pools.
 
@@ -122,17 +179,24 @@ def paged_decode_write(kp, vp, k, v, block_tables, ctx, active=None):
     return kp, vp
 
 
-def pack_prompt_into_pages(kp, vp, k_dense, v_dense, slot_tables):
-    """Scatter a prefilled dense cache into the slot's pages.
+def paged_prefill_write(kp, vp, k, v, block_tables, ctx, valid):
+    """Write one prefill chunk's k/v into the page pools.
 
-    k_dense, v_dense: [1, S, KVH, D] (positions 0..S-1 of one sequence);
-    slot_tables: [pages_per_slot] int32 — must cover ceil(S/page) pages.
-    Positions beyond the true prompt length may hold pad garbage; the
-    per-slot context length masks them at attention time."""
-    s = k_dense.shape[1]
+    k, v: [B, C, KVH, D] (the chunk's projections, already rotated).
+    Token j of sequence b lands at global position ``ctx[b] + j`` in its
+    block-table row; tokens with ``j >= valid[b]`` (chunk padding, or a
+    slot not in this prefill wave) are routed to the reserved trash page
+    0 so a real page is never clobbered."""
+    c = k.shape[1]
     page = kp.shape[2]
-    pid = jnp.take(slot_tables, jnp.arange(s) // page)
-    off = jnp.arange(s) % page
-    kp = kp.at[:, pid, off, :].set(jnp.swapaxes(k_dense[0], 0, 1))
-    vp = vp.at[:, pid, off, :].set(jnp.swapaxes(v_dense[0], 0, 1))
+    pos = ctx[:, None] + jnp.arange(c, dtype=ctx.dtype)[None, :]  # [B, C]
+    # padded positions can run past the table row — clamp the page index
+    # (the write is trash-routed anyway) so the gather stays in bounds
+    pidx = jnp.minimum(pos // page, block_tables.shape[1] - 1)
+    pid = jnp.take_along_axis(block_tables, pidx, axis=1)         # [B, C]
+    ok = jnp.arange(c)[None, :] < valid[:, None]
+    pid = jnp.where(ok, pid, 0)
+    off = pos % page
+    kp = kp.at[:, pid, off, :].set(jnp.transpose(k, (2, 0, 1, 3)))
+    vp = vp.at[:, pid, off, :].set(jnp.transpose(v, (2, 0, 1, 3)))
     return kp, vp

@@ -187,6 +187,88 @@ def test_paged_attention_vs_dense(H, KVH):
                                    rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("H,KVH", [(4, 4), (8, 2)])
+def test_paged_prefill_attention_vs_dense_causal(H, KVH):
+    """Chunked-prefill oracle (ISSUE 3): C query tokens over paged
+    history + causal-within-chunk == dense causal attention over the
+    prefix, per query position."""
+    from paddle_tpu.ops.paged_attention import (
+        paged_prefill_attention, paged_prefill_attention_reference)
+    rng = np.random.RandomState(2)
+    B, D, page, npps, C = 3, 16, 8, 4, 5
+    total = B * npps + 2
+    # ctx BEFORE the chunk; chunk tokens live at ctx..ctx+C-1 and are
+    # already in the pages (the dense view holds them too)
+    ctx = np.array([0, 7, 19], "int32")
+    k_dense, v_dense, kp, vp, tables = _build_paged_case(
+        rng, B, H, KVH, D, page, npps, total, ctx + C)
+    q = rng.randn(B, C, H, D).astype("float32")
+
+    out = paged_prefill_attention(jnp.asarray(q), jnp.asarray(kp),
+                                  jnp.asarray(vp), jnp.asarray(tables),
+                                  jnp.asarray(ctx))
+    assert np.asarray(out).shape == (B, C, H, D)
+    rep = H // KVH
+    scale = 1.0 / np.sqrt(D)
+    for b in range(B):
+        for j in range(C):
+            L = int(ctx[b]) + j + 1       # causal: positions <= ctx+j
+            k = np.repeat(k_dense[b, :L], rep, axis=1)   # [L, H, D]
+            v = np.repeat(v_dense[b, :L], rep, axis=1)
+            logits = np.einsum("hd,lhd->hl", q[b, j], k) * scale
+            w = np.exp(logits - logits.max(-1, keepdims=True))
+            w = w / w.sum(-1, keepdims=True)
+            ref = np.einsum("hl,lhd->hd", w, v)
+            np.testing.assert_allclose(np.asarray(out[b, j]), ref,
+                                       rtol=2e-5, atol=2e-5)
+    # C == 1 reduces exactly to the decode oracle at ctx+1
+    out1 = paged_prefill_attention_reference(
+        jnp.asarray(q[:, :1]), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(ctx))
+    dec = paged_attention_reference(
+        jnp.asarray(q[:, 0]), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(ctx + 1))
+    np.testing.assert_allclose(np.asarray(out1[:, 0]), np.asarray(dec),
+                               rtol=1e-6, atol=1e-6)
+
+
+def test_paged_prefill_write_routes_and_trashes():
+    """Chunk writes land at ctx..ctx+valid-1 in the slot's pages; tokens
+    past the valid count (chunk padding / slots outside the wave) go to
+    the reserved trash page 0 and clobber nothing real."""
+    from paddle_tpu.ops.paged_attention import paged_prefill_write
+    rng = np.random.RandomState(3)
+    KVH, D, page, npps, B, C = 2, 4, 4, 3, 2, 5
+    total = 1 + B * npps                   # page 0 = trash
+    kp = np.zeros((KVH, total, page, D), "float32")
+    vp = np.zeros((KVH, total, page, D), "float32")
+    tables = np.arange(1, 1 + B * npps,
+                       dtype="int32").reshape(B, npps)
+    k = rng.randn(B, C, KVH, D).astype("float32")
+    v = rng.randn(B, C, KVH, D).astype("float32")
+    ctx = np.array([2, 6], "int32")
+    valid = np.array([5, 3], "int32")      # slot 1: 2 padding tokens
+    kp2, vp2 = paged_prefill_write(
+        jnp.asarray(kp), jnp.asarray(vp), jnp.asarray(k),
+        jnp.asarray(v), jnp.asarray(tables), jnp.asarray(ctx),
+        jnp.asarray(valid))
+    kp2, vp2 = np.asarray(kp2), np.asarray(vp2)
+    for b in range(B):
+        for j in range(int(valid[b])):
+            pos = int(ctx[b]) + j
+            pg, off = tables[b, pos // page], pos % page
+            np.testing.assert_array_equal(kp2[:, pg, off], k[b, j])
+            np.testing.assert_array_equal(vp2[:, pg, off], v[b, j])
+    # nothing outside the written positions changed (trash page aside)
+    mask = np.ones((total,), bool)
+    written = {int(tables[b, (int(ctx[b]) + j) // page])
+               for b in range(B) for j in range(int(valid[b]))}
+    for pg in range(1, total):
+        if pg not in written:
+            assert not kp2[:, pg].any() and not vp2[:, pg].any()
+    assert mask[0]                          # page 0 absorbed the padding
+
+
 def test_paged_attention_incubate_api():
     rng = np.random.RandomState(1)
     B, H, KVH, D, page, npps = 2, 4, 4, 8, 4, 2

@@ -605,6 +605,56 @@ class TestStaticControlFlow:
         assert y.placements == [dist.Shard(0)]
         assert y.is_dist() and not x.is_dist()
 
+    def test_dist_metadata_survives_derivation(self):
+        """Advisor r5: placements/process_mesh are re-derived from the
+        jax array's NamedSharding, so they survive arithmetic, reshape,
+        and state_dict-style round-trips that mint NEW Tensor objects
+        (the id()-keyed side table alone lost them)."""
+        import paddle_tpu.distributed as dist
+        mesh = dist.ProcessMesh(np.arange(8).reshape(8), ["x"])
+        x = dist.shard_tensor(
+            paddle.to_tensor(np.zeros((16, 4), "float32")), mesh,
+            [dist.Shard(0)])
+        y = x + 0                       # new Tensor, same sharding
+        assert y.placements == [dist.Shard(0)]
+        assert y.process_mesh == mesh
+        assert y.is_dist()
+        z = paddle.reshape(y, [16, 4])  # shape-preserving round trip
+        assert z.placements == [dist.Shard(0)]
+        # a rebuilt Tensor around the same jax array (state_dict-style)
+        w = paddle.Tensor(x.jax())
+        assert w.placements == [dist.Shard(0)]
+        assert w.process_mesh == mesh
+        # explicit annotations still take precedence over derivation
+        y.placements = [dist.Replicate()]
+        assert y.placements == [dist.Replicate()]
+
+    def test_shard_op_flat_placements_ambiguous(self):
+        """Advisor r5: a flat placement list with >1 tensor argument is
+        ambiguous — require the nested per-argument form."""
+        import pytest
+        import paddle_tpu.distributed as dist
+        mesh = dist.ProcessMesh(np.arange(8).reshape(8), ["x"])
+        f = dist.shard_op(lambda a, b: a + b, mesh,
+                          in_placements=[dist.Shard(0)])
+        x = paddle.to_tensor(np.zeros((16, 4), "float32"))
+        yv = paddle.to_tensor(np.zeros((16, 4), "float32"))
+        with pytest.raises(ValueError, match="ambiguous"):
+            f(x, yv)
+        # the nested form disambiguates the same call
+        g = dist.shard_op(lambda a, b: a + b, mesh,
+                          in_placements=[[dist.Shard(0)],
+                                         [dist.Shard(0)]])
+        out = g(x, yv)
+        assert out.shape[0] == 16
+        # flat form with ONE tensor arg applies to THE tensor, even
+        # when it is not the first argument (review: positional args[0]
+        # application silently skipped it)
+        h = dist.shard_op(lambda n, t: t * n, mesh,
+                          in_placements=[dist.Shard(0)])
+        out2 = h(2.0, x)
+        assert out2.placements == [dist.Shard(0)]
+
     def test_default_convert_fn(self):
         import collections
         from paddle_tpu.io import default_convert_fn
@@ -668,8 +718,24 @@ class TestNnQuant:
         yg = Q.weight_only_linear(x, qg, weight_scale=sg, group_size=4)
         refg = np.asarray(x.numpy()) @ np.asarray(w.numpy())
         np.testing.assert_allclose(yg.numpy(), refg, atol=0.15, rtol=0.05)
-        q4, _ = Q.weight_quantize(w, algo="weight_only_int4")
-        assert int(np.abs(np.asarray(q4.numpy())).max()) <= 7
+        q4, s4 = Q.weight_quantize(w, algo="weight_only_int4")
+        # full asymmetric int4 range (advisor r5): [-8, 7], not [-7, 7]
+        q4np = np.asarray(q4.numpy())
+        assert q4np.min() >= -8 and q4np.max() <= 7
+        # round trip: dequantized values within half a quant step
+        w4 = Q.weight_dequantize(q4, s4, algo="weight_only_int4",
+                                 out_dtype="float32")
+        step = np.asarray(s4.numpy())[None, :]
+        assert np.all(np.abs(np.asarray(w4.numpy()) - w.numpy())
+                      <= 0.5 * step + 1e-6)
+        # a pre-quantized -8 (full-range checkpoints) must dequantize
+        # LINEARLY — re-clipping it to -7 would corrupt the value
+        qm = paddle.to_tensor(np.full((1, 8), -8, "int8"))
+        wm = Q.weight_dequantize(qm, s4, algo="weight_only_int4",
+                                 out_dtype="float32")
+        np.testing.assert_allclose(wm.numpy(),
+                                   -8.0 * np.asarray(s4.numpy())[None, :],
+                                   rtol=1e-6)
 
 
 class TestIncubateFleetRecompute:
