@@ -252,11 +252,18 @@ def _decode_bench(on_tpu):
     return tok_per_s
 
 
-def _cb_bench(on_tpu):
+def _cb_bench(on_tpu, autotune=False):
     """Continuous batching over paged KV (the serving-depth metric):
     mixed-length prompt streams scheduled through fixed decode slots,
     aggregate generated tokens/s. More streams than slots, so the run
-    exercises drain + re-admit mid-flight."""
+    exercises drain + re-admit mid-flight.
+
+    autotune=True makes this section the serving_chunks sweep vehicle
+    (the surface needs a model + workload, so it cannot ride the
+    standalone CLI builders): a few candidate ladders from the
+    registered grid each get their own engine + timed run, the
+    fastest commits to the tuning cache, and the tuned_serving_chunks
+    record entry reports it."""
     import numpy as np
 
     import paddle_tpu as paddle
@@ -293,25 +300,89 @@ def _cb_bench(on_tpu):
                                    max_len=max_len, decode_chunk=chunk,
                                    prompt_buckets=buckets, greedy=True)
 
-    def run(seed):
-        rng = np.random.RandomState(seed)
-        for plen, n in specs:
-            # distinct prompts per run: the tunnel replay-caches whole
-            # executions keyed on inputs
-            eng.add_request(rng.randint(0, cfg.vocab_size,
-                                        (plen,)).astype(np.int32), n)
-        done = eng.run()
-        return sum(len(r.tokens) for r in done)
+    def timed_engine(e):
+        """warmup (compiles prefill + chunk ladder) then best timed
+        rep; returns (tokens/s, wall_s of the best rep, tokens)."""
+        def erun(seed):
+            rng = np.random.RandomState(seed)
+            for plen, n in specs:
+                # distinct prompts per run: the tunnel replay-caches
+                # whole executions keyed on inputs
+                e.add_request(rng.randint(0, cfg.vocab_size,
+                                          (plen,)).astype(np.int32), n)
+            done = e.run()
+            return sum(len(r.tokens) for r in done)
 
-    run(100)                       # warmup: compiles prefill + chunk ladder
-    eng.reset_gauges()             # drop compile-polluted warmup counters
-    best = 0.0
-    toks = 0
-    for i in range(reps):
-        t0 = time.perf_counter()
-        toks = run(101 + i)
-        dt = time.perf_counter() - t0
-        best = max(best, toks / dt)
+        erun(100)
+        e.reset_gauges()
+        b, t, w = 0.0, 0, None
+        for i in range(reps):
+            t0 = time.perf_counter()
+            t = erun(101 + i)
+            dt = time.perf_counter() - t0
+            if t / dt > b:
+                b, w = t / dt, dt
+        return b, w, t
+
+    best, best_wall, toks = timed_engine(eng)
+
+    tuned_cb = {}
+    if autotune:
+        # serving_chunks sweep: the bench ladder is the incumbent; a
+        # few grid alternates each get a fresh engine (own compiled
+        # programs) and the same workload. Winner commits to the cache
+        # so every ctor that leaves the knobs None inherits it.
+        from paddle_tpu import tuner
+        from paddle_tpu.tuner.surface import sig_from_dict
+        shape = {"slots": slots, "max_len": max_len, "page": page}
+        dtype = next(iter(model.parameters()))._data.dtype
+        backend = tuner.backend_signature()
+        key = tuner.make_key("serving_chunks", sig_from_dict(shape),
+                             str(dtype), backend)
+        cache = tuner.get_cache()
+        hit = cache.get(key)
+        incumbent = {"decode_chunk": chunk,
+                     "prefill_chunk": eng.prefill_chunk,
+                     "admit_batch": eng.admit_batch}
+        if hit is not None:
+            tuned_cb = {"config": hit["config"], "cached_hit": True,
+                        "shape_sig": sig_from_dict(shape)}
+        else:
+            surface = tuner.get_surface("serving_chunks")
+            # small diverse slice of the grid (compile cost per
+            # candidate is a whole engine); dropped breadth is implied
+            # by candidates_tried in the record — not a silent cap
+            cands = [c for c in surface.grid(shape)
+                     if c != incumbent][:2]
+            trials = [(incumbent, best_wall, best)]
+            for c in cands:
+                try:
+                    e = ContinuousBatchingEngine(
+                        model, num_slots=slots, page_size=page,
+                        max_len=max_len,
+                        decode_chunk=c["decode_chunk"],
+                        prefill_chunk=c["prefill_chunk"],
+                        admit_batch=c["admit_batch"],
+                        prompt_buckets=buckets, greedy=True)
+                    tps, wall, _ = timed_engine(e)
+                    trials.append((dict(c), wall, tps))
+                except Exception as exc:  # candidate-scoped, like the
+                    print(f"# cb autotune candidate {c} failed: "
+                          f"{exc!r}", file=sys.stderr)  # trial engine
+            win_cfg, win_wall, win_tps = min(trials, key=lambda t: t[1])
+            cache.put(key, win_cfg, median_ms=win_wall * 1e3,
+                      representative=on_tpu, source="search",
+                      extra={"trials": len(trials),
+                             "tok_s": round(win_tps, 2)})
+            tuned_cb = {"config": win_cfg, "cached_hit": False,
+                        "shape_sig": sig_from_dict(shape),
+                        "tok_s": round(win_tps, 2),
+                        "default_tok_s": round(best, 2),
+                        "candidates_tried": len(trials)}
+            print(f"# cb autotune: {win_cfg} {win_tps:.0f} tok/s vs "
+                  f"incumbent {best:.0f} tok/s "
+                  f"({len(trials)} candidates)", file=sys.stderr)
+            best = max(best, win_tps)
     # occupancy / admission-overlap / latency gauges (profiler
     # subsystem): the numbers BASELINE.md's CB-ceiling argument was
     # previously deriving by hand, plus the ISSUE-3 TTFT/ITL
@@ -326,7 +397,7 @@ def _cb_bench(on_tpu):
           f"ttft p50 {gauges['ttft_ms_p50']:.1f}ms, itl p50 "
           f"{gauges['itl_ms_p50']:.2f}ms, {gauges['compiled_programs']} "
           f"compiled programs)", file=sys.stderr)
-    return best, gauges
+    return best, gauges, tuned_cb
 
 
 def _moe_bench_config(on_tpu):
@@ -532,6 +603,82 @@ def _moe_decode_bench(on_tpu):
     return tok_per_s
 
 
+def _autotune_bench(on_tpu):
+    """--autotune mode: sweep the kernel tunable surfaces at THIS
+    bench's workload shapes through the trial engine and emit
+    ``tuned_*`` record keys (format reserved in BASELINE.md). Runs
+    BEFORE the train/moe sections so the committed winners feed them
+    (the kernels consult the cache at trace time). The default config
+    is always in the trial table (default-first grid order), so the
+    tuned pick matches or beats the static defaults by construction —
+    ``vs_default`` reports the ratio. Resumable: every finished
+    (surface, shape) key is already committed atomically; a re-run
+    skips it."""
+    from paddle_tpu import tuner
+    from paddle_tpu.tuner import sweeps
+
+    sweeps.ensure_builtin_surfaces()
+    engine = tuner.TrialEngine(warmup=2 if on_tpu else 1,
+                               repeats=5 if on_tpu else 2)
+    if on_tpu:
+        # the MoE bench bank (config 5: d 1024, moe_inter 1408, E 16,
+        # rows = batch*seq*k) and the llama train attention shape
+        jobs = [
+            ("grouped_matmul", {"d": 1024, "h": 1408, "E": 16},
+             sweeps.grouped_matmul_builder(rows=16384), 12),
+            ("grouped_matmul", {"d": 1408, "h": 1024, "E": 16},
+             sweeps.grouped_matmul_builder(rows=16384), 12),
+            ("flash_attention", {"sq": 2048, "sk": 2048, "d": 128},
+             sweeps.flash_attention_builder(batch=2, heads=20), 8),
+        ]
+    else:
+        jobs = [
+            ("grouped_matmul", {"d": 64, "h": 128, "E": 4},
+             sweeps.grouped_matmul_builder(rows=1024), 3),
+            ("flash_attention", {"sq": 128, "sk": 128, "d": 64},
+             sweeps.flash_attention_builder(batch=1, heads=2), 2),
+        ]
+
+    out = {"tuned_cache_path": engine.cache.path,
+           "tuned_backend": engine.backend}
+    for surface, shape, builder, max_trials in jobs:
+        res = engine.search(surface, shape, builder,
+                            max_trials=max_trials)
+        entry = {"config": res.best_config,
+                 "median_ms": None if res.best_ms is None
+                 else round(res.best_ms, 4),
+                 "shape_sig": res.shape_sig,
+                 "representative": res.representative,
+                 "cached_hit": res.cached_hit,
+                 # the static default can be INVALID at a shape (e.g.
+                 # flash 256/512 at sq=128 smoke shapes): the grid
+                 # drops it and no default trial exists — flagged, not
+                 # silently absent (BASELINE.md key reservation)
+                 "default_timed": False}
+        default = tuner.get_surface(surface).default
+        for cfg, ms in res.trials:
+            if cfg == default:
+                entry["default_timed"] = True
+                entry["default_ms"] = round(ms, 4)
+                if res.best_ms:
+                    entry["vs_default"] = round(ms / res.best_ms, 4)
+                break
+        key = f"tuned_{surface}_{res.shape_sig.replace(',', '_')}"
+        out[key] = entry
+        print(f"# autotune {surface} @ {res.shape_sig}: "
+              f"{entry['config']}"
+              + (f" {entry['median_ms']:.2f} ms" if entry["median_ms"]
+                 else "")
+              + (f" (default {entry['default_ms']:.2f} ms, "
+                 f"x{entry['vs_default']:.3f})"
+                 if "default_ms" in entry else "")
+              + (" [cached]" if res.cached_hit else "")
+              + ("" if res.representative
+                 else " [NON-REPRESENTATIVE backend]"),
+              file=sys.stderr)
+    return out
+
+
 def _timed_section(what, fn):
     """Run a bench section, logging wall time to stderr (budget telemetry:
     round-4's record never printed because the sections overran the
@@ -545,7 +692,17 @@ def _timed_section(what, fn):
 
 
 def main():
+    import argparse
+
     import jax
+
+    ap = argparse.ArgumentParser(description="paddle_tpu driver bench")
+    ap.add_argument("--autotune", action="store_true",
+                    help="sweep kernel tunable surfaces at the bench "
+                         "shapes first (paddle_tpu.tuner) and emit "
+                         "tuned_* record keys; winners persist to the "
+                         "tuning cache and feed the timed sections")
+    args, _unknown = ap.parse_known_args()
 
     # Backend init is retried with LONG backoff: the rounds-2/5 axon
     # tunnel outages were transient on the scale of hours, and an
@@ -570,6 +727,19 @@ def main():
 
     import gc
     suffix = "" if on_tpu else "_cpu_smoke"
+    tuned = {}
+    if args.autotune:
+        # before the timed sections: committed winners feed them (the
+        # kernels read the cache at trace time); a sweep failure must
+        # never sink the headline metrics
+        try:
+            tuned = _timed_section(
+                "autotune", lambda: _retry_transient(
+                    lambda: _autotune_bench(on_tpu),
+                    "autotune bench"))
+        except Exception as e:
+            print(f"# autotune bench failed: {e!r}", file=sys.stderr)
+            tuned = {}
     # The running record is re-printed after EVERY completed section:
     # whichever complete JSON line is last when the driver's time limit
     # hits carries everything measured so far. Round-4's record printed
@@ -585,6 +755,7 @@ def main():
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
     }
+    record.update(tuned)
     print(json.dumps(record), flush=True)
     gc.collect()
 
@@ -613,12 +784,13 @@ def main():
         print(json.dumps(record), flush=True)
 
     try:
-        cb_tok_s, cb_gauges = _timed_section(
+        cb_tok_s, cb_gauges, cb_tuned = _timed_section(
             "cb", lambda: _retry_transient(
-                lambda: _cb_bench(on_tpu), "cb bench"))
+                lambda: _cb_bench(on_tpu, autotune=args.autotune),
+                "cb bench"))
     except Exception as e:
         print(f"# continuous-batching bench failed: {e!r}", file=sys.stderr)
-        cb_tok_s = cb_gauges = None
+        cb_tok_s = cb_gauges = cb_tuned = None
     if cb_tok_s is not None:
         record["cb_metric"] = ("llama_1B_continuous_batching_mixed_lengths"
                                + suffix)
@@ -637,6 +809,8 @@ def main():
         record["cb_gauges"] = {
             k: (round(v, 4) if isinstance(v, float) else v)
             for k, v in cb_gauges.items()}
+        if cb_tuned:
+            record["tuned_serving_chunks"] = cb_tuned
         print(json.dumps(record), flush=True)
     gc.collect()
 

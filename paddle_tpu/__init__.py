@@ -69,6 +69,7 @@ from . import distribution  # noqa: F401
 from . import metric  # noqa: F401
 from . import incubate  # noqa: F401
 from . import profiler  # noqa: F401
+from . import tuner  # noqa: F401
 from . import device  # noqa: F401
 from . import vision  # noqa: F401
 from . import base  # noqa: F401  (the reference's renamed fluid)

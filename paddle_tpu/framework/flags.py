@@ -4,6 +4,18 @@
 Flags are defined in Python, ingested from ``FLAGS_*`` environment variables
 at import, readable/mutable at runtime via ``get_flags``/``set_flags``
 (mirroring ``paddle.get_flags``/``paddle.set_flags``).
+
+Tuner interplay (docs/autotune.md): every flag records its value's
+*source* — ``"default"`` (the define_flag literal), ``"env"`` (a
+``FLAGS_*`` environment variable at import) or ``"set"`` (a runtime
+``set_flags`` call). Knobs that are also tunable surfaces (e.g.
+``FLAGS_flash_attn_block_q/kv``) resolve with the precedence
+
+    explicit user value (env or set_flags)  >  tuner cache  >  default
+
+so an operator pinning a block size always wins over a searched
+config, and a searched config only ever replaces the built-in default
+(:func:`flag_source` is how call sites distinguish the cases).
 """
 
 from __future__ import annotations
@@ -12,7 +24,7 @@ import os
 import threading
 from typing import Any, Callable
 
-__all__ = ["define_flag", "get_flags", "set_flags", "flag"]
+__all__ = ["define_flag", "get_flags", "set_flags", "flag", "flag_source"]
 
 _lock = threading.Lock()
 _registry: dict[str, dict] = {}
@@ -32,21 +44,33 @@ def define_flag(name: str, default: Any, help: str = "",
         name = "FLAGS_" + name
     typ = typ if typ is not None else type(default)
     value = default
+    source = "default"
     env = os.environ.get(name)
     if env is not None:
         try:
             value = _parse_env(env, typ)
+            source = "env"
         except (TypeError, ValueError):
             pass
     with _lock:
         _registry[name] = {"value": value, "default": default, "help": help,
-                           "type": typ, "on_change": on_change}
+                           "type": typ, "on_change": on_change,
+                           "source": source}
 
 
 def flag(name: str) -> Any:
     if not name.startswith("FLAGS_"):
         name = "FLAGS_" + name
     return _registry[name]["value"]
+
+
+def flag_source(name: str) -> str:
+    """Where the flag's current value came from: ``"default"`` |
+    ``"env"`` | ``"set"``. Anything but ``"default"`` is an explicit
+    user choice, which beats tuner-cache values (module docstring)."""
+    if not name.startswith("FLAGS_"):
+        name = "FLAGS_" + name
+    return _registry[name].get("source", "default")
 
 
 def get_flags(flags: str | list[str] | None = None) -> dict[str, Any]:
@@ -68,10 +92,12 @@ def set_flags(flags: dict[str, Any]) -> None:
             if key not in _registry:
                 # Paddle tolerates unknown flags with a warning; we register.
                 _registry[key] = {"value": v, "default": v, "help": "",
-                                  "type": type(v), "on_change": None}
+                                  "type": type(v), "on_change": None,
+                                  "source": "set"}
                 continue
             ent = _registry[key]
             ent["value"] = ent["type"](v) if not isinstance(v, ent["type"]) else v
+            ent["source"] = "set"
             cb = ent["on_change"]
         if cb is not None:
             cb(v)
@@ -117,7 +143,10 @@ define_flag("FLAGS_enable_pallas_kernels", True,
 # 256/512 measured best on v5e at hidden 2560 under remat (59.3% vs
 # 57.4% MFU at 512/512 on the 4-layer tuning slice, 2026-07-31; the
 # earlier 512/512 pick was tuned on the no-remat 0.89B config). Both
-# kernels clamp to the padded sequence length.
+# kernels clamp to the padded sequence length. These are tunable
+# surfaces ("flash_attention", paddle_tpu.tuner): an explicit env /
+# set_flags value wins over a tuner-cache entry, which wins over the
+# defaults here (flag_source distinguishes them).
 define_flag("FLAGS_flash_attn_block_q", 256, "Pallas flash-attn q block.")
 define_flag("FLAGS_flash_attn_block_kv", 512, "Pallas flash-attn kv block.")
 define_flag("FLAGS_recompute_policy", "dots_saveable",

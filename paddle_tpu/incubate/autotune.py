@@ -1,10 +1,35 @@
 """``paddle.incubate.autotune`` — kernel/layout/dataloader auto-tuning
-config (upstream python/paddle/incubate/autotune.py, UNVERIFIED).
+(upstream python/paddle/incubate/autotune.py, UNVERIFIED).
 
-TPU-native: XLA autotunes kernel selection and layout during compilation
-(the role of the reference's kernel/layout autotune passes), so
-``set_config`` records the request, applies the pieces that have a jax
-knob, and reports the rest as XLA-delegated."""
+This is the user entry point of the real autotuner subsystem
+(``paddle_tpu.tuner``, docs/autotune.md). The ``kernel`` section now
+drives an empirical search over registered tunable surfaces (Pallas
+grouped-matmul tiles, flash-attention blocks, rms_norm row blocks, the
+serving chunk ladder, the scan remat dose) backed by a persistent,
+crash-safe tuning cache:
+
+- ``set_config()`` / ``set_config({"kernel": {"enable": True}})`` —
+  load-from-cache mode: kernels consult the cache (reloaded from the
+  configured path) and fall back to static defaults on a miss.
+- ``{"kernel": {"enable": True, "tune_on_first_call": True}}`` — a
+  cache miss for a surface with a standalone trial builder triggers
+  one synchronous search; the winner commits atomically and serves
+  every later call and process.
+- ``{"kernel": {"configs": {"flash_attention": {"block_q": 512,
+  "block_kv": 512}}}}`` — manual pins: user override beats cache beats
+  default (and for flash-attention, explicitly-set
+  ``FLAGS_flash_attn_block_q/kv`` rank above even these —
+  framework/flags.py documents the full precedence).
+- ``{"kernel": {"enable": False}}`` — cache consultation off; every
+  knob returns to its static default.
+- ``{"kernel": {"cache_path": ...}}`` — repoint the persistent cache.
+
+``layout`` stays XLA-delegated on TPU: operand layout assignment
+happens inside XLA compilation, where the role of the reference's
+layout-autotune pass already lives. ``dataloader`` is recorded for
+``get_config()`` readers (the dataloader sizes itself from its own
+config).
+"""
 
 from __future__ import annotations
 
@@ -16,26 +41,66 @@ __all__ = ["set_config"]
 _config: dict = {}
 
 
-def set_config(config=None):
+def _apply_kernel_section(section: dict):
+    from .. import tuner
+    from ..tuner.sweeps import ensure_builtin_surfaces
+
+    enable = bool(section.get("enable", True))
+    repointed = False
+    if "cache_path" in section and section["cache_path"]:
+        tuner.set_cache_path(section["cache_path"])   # loads on build
+        repointed = True
+    if enable:
+        ensure_builtin_surfaces()
+        tuner.enable()
+        if not repointed:
+            # load-from-cache mode: pick up entries written by offline
+            # sweeps since this process last looked (a just-repointed
+            # cache already loaded in its constructor)
+            tuner.get_cache().load()
+    else:
+        tuner.disable()
+    tuner.set_tune_on_first_call(
+        enable and bool(section.get("tune_on_first_call", False)))
+    configs = section.get("configs") or {}
+    if not isinstance(configs, dict):
+        raise TypeError("autotune: kernel.configs must map surface "
+                        "name -> config dict")
+    for surface, cfg in configs.items():
+        if cfg is not None and not isinstance(cfg, dict):
+            raise TypeError(f"autotune: kernel.configs[{surface!r}] "
+                            "must be a dict (or None to clear)")
+        tuner.set_override(surface, cfg)
+
+
+def set_config(config=None, **sections):
     """Accepts the upstream dict (or a JSON file path) with optional
-    'kernel' / 'layout' / 'dataloader' sections."""
+    'kernel' / 'layout' / 'dataloader' sections; sections may also be
+    passed as keywords (``set_config(kernel={...})``). See module
+    docstring for the kernel-section schema."""
     global _config
-    if config is None:
+    if config is None and not sections:
         _config = {"kernel": {"enable": True},
                    "layout": {"enable": True},
                    "dataloader": {"enable": True}}
+        _apply_kernel_section(_config["kernel"])
         return
     if isinstance(config, str):
         with open(config) as fh:
             config = json.load(fh)
-    if not isinstance(config, dict):
+    if config is not None and not isinstance(config, dict):
         raise TypeError("autotune config must be a dict or JSON path")
+    config = dict(config) if config else {}
+    config.update(sections)
     _config = dict(config)
     for key in config:
         if key not in ("kernel", "layout", "dataloader"):
             warnings.warn(f"autotune: unknown section {key!r} ignored")
-    # kernel/layout tuning is XLA's job on TPU (delegated at compile
-    # time); the dataloader section is recorded for get_config() readers
+    kernel = config.get("kernel")
+    if isinstance(kernel, dict):
+        _apply_kernel_section(kernel)
+    # layout tuning is XLA's job on TPU (delegated at compile time);
+    # the dataloader section is recorded for get_config() readers
 
 
 def get_config() -> dict:

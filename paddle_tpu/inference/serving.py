@@ -100,7 +100,7 @@ class ContinuousBatchingEngine:
     bucket seeds the default ``prefill_chunk``."""
 
     def __init__(self, model, num_slots=4, page_size=16, num_pages=None,
-                 max_len=512, decode_chunk=16, prompt_buckets=(32, 64, 128),
+                 max_len=512, decode_chunk=None, prompt_buckets=(32, 64, 128),
                  eos_token_id=None, greedy=True, temperature=1.0,
                  seed=0, prefill_chunk=None, admit_batch=None,
                  adaptive_chunk=True):
@@ -114,21 +114,38 @@ class ContinuousBatchingEngine:
         # +1: page 0 is the reserved trash page
         self.num_pages = int(num_pages) if num_pages is not None else \
             self.num_slots * self.pages_per_slot + 1
+        # also the KV-pool dtype below AND the tuner-cache key's dtype
+        # component — one probe so the two can never diverge
+        dtype = next(iter(model.parameters()))._data.dtype
+        # chunk-ladder knobs left as None resolve through the autotuner
+        # cache ("serving_chunks" surface, keyed by slots/max_len/page —
+        # registered at the bottom of this module), then fall back to
+        # the static derivations; an explicit argument always wins
+        tuned = {}
+        if decode_chunk is None or prefill_chunk is None \
+                or admit_batch is None:
+            from ..tuner import lookup
+            tuned = lookup("serving_chunks",
+                           {"slots": self.num_slots,
+                            "max_len": self.max_len,
+                            "page": self.page_size}, str(dtype)) or {}
+        if decode_chunk is None:
+            decode_chunk = int(tuned.get("decode_chunk", 0)) or 16
         self.decode_chunk = int(decode_chunk)
         self.adaptive_chunk = bool(adaptive_chunk)
         self.prompt_buckets = tuple(sorted(prompt_buckets)) \
             if prompt_buckets else ()
         if prefill_chunk is None:
-            prefill_chunk = self.prompt_buckets[-1] \
-                if self.prompt_buckets else 32
+            prefill_chunk = int(tuned.get("prefill_chunk", 0)) or \
+                (self.prompt_buckets[-1] if self.prompt_buckets else 32)
         self.prefill_chunk = max(1, min(int(prefill_chunk), self.max_len))
-        self.admit_batch = self.num_slots if admit_batch is None \
-            else max(1, min(int(admit_batch), self.num_slots))
+        if admit_batch is None:
+            admit_batch = int(tuned.get("admit_batch", 0)) or self.num_slots
+        self.admit_batch = max(1, min(int(admit_batch), self.num_slots))
         self.eos = -1 if eos_token_id is None else int(eos_token_id)
         self.greedy = bool(greedy)
         self.temperature = float(temperature)
 
-        dtype = next(iter(model.parameters()))._data.dtype
         # MHA models (e.g. GPT2) carry no kv-head/head-dim fields
         kvh = getattr(cfg, "num_key_value_heads",
                       cfg.num_attention_heads)
@@ -816,3 +833,53 @@ def _apply_multi(fn, tensors, n_out):
     from ..framework.core import apply
     return apply(fn, *tensors, n_outputs=n_out, differentiable=False,
                  name="serving_engine")
+
+
+# -- tunable surface ---------------------------------------------------------
+# The engine's chunk ladder is a tunable surface like the kernel tiles,
+# but its trial needs a whole engine + workload, so there is no
+# standalone builder: `bench.py --autotune`'s cb section is the sweep
+# vehicle (it times candidate ladders on the real workload and commits
+# the winner); a recorded winner then serves every ctor call that
+# leaves the knobs as None. Candidate values are powers of two — the
+# adaptive decode ladder and the compiled-signature budget both
+# assume pow2.
+
+def _register_serving_surface():
+    from ..tuner.surface import TunableSurface, register_surface
+
+    def _candidates(shape):
+        slots = int(shape.get("slots", 4))
+        max_len = int(shape.get("max_len", 512))
+        out = []
+        for dc in (8, 16, 32, 64):
+            if dc > max_len:
+                continue
+            for pc in (32, 64, 128, 256):
+                if pc > max_len:
+                    continue
+                for ab in sorted({1, max(slots // 2, 1), slots}):
+                    out.append({"decode_chunk": dc, "prefill_chunk": pc,
+                                "admit_batch": ab})
+        return out
+
+    def _is_valid(config, shape):
+        slots = int(shape.get("slots", 4))
+        max_len = int(shape.get("max_len", 512))
+        return (1 <= config["decode_chunk"] <= max_len
+                and 1 <= config["prefill_chunk"] <= max_len
+                and 1 <= config["admit_batch"] <= slots)
+
+    register_surface(TunableSurface(
+        name="serving_chunks",
+        params=("decode_chunk", "prefill_chunk", "admit_batch"),
+        default={"decode_chunk": 16, "prefill_chunk": 128,
+                 "admit_batch": 4},
+        candidates=_candidates,
+        is_valid=_is_valid,
+        describe="ContinuousBatchingEngine ladder: decode chunk length, "
+                 "batched-prefill chunk, prompts admitted per prefill "
+                 "wave. Shape key: slots/max_len/page."))
+
+
+_register_serving_surface()

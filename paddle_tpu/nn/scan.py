@@ -92,7 +92,9 @@ def scan_layers(layers, x, extra_inputs=(), remat=False,
       the first fs-1 under jax.checkpoint and the group-last saved
       (per-iteration save structure must be static, so the dose is the
       group shape, not a per-iteration predicate). Requires L % fs == 0;
-      otherwise falls back to fs=0 with a warning.
+      otherwise falls back to fs=0 with a warning. ``None`` (instead of
+      an int) consults the autotuner cache ("scan_remat" surface, keyed
+      by stack depth) and falls back to 0.
     """
     layers = list(layers)
     template = layers[0]
@@ -101,6 +103,10 @@ def scan_layers(layers, x, extra_inputs=(), remat=False,
     n_leaves = len(tmpl_params)
     L = len(layers)
     n_extra = len(extra_inputs)
+    if full_save_interval is None:
+        from ..tuner import lookup
+        cfg = lookup("scan_remat", {"L": L}) or {}
+        full_save_interval = int(cfg.get("full_save_interval", 0))
     fs = max(int(full_save_interval or 0), 0)  # same clamp as unrolled
     if fs and not remat:
         fs = 0
@@ -174,3 +180,43 @@ def scan_layers(layers, x, extra_inputs=(), remat=False,
 
     flat = [p for lp in per_layer for p in lp]
     return apply(fn, x, *extra_inputs, *flat, name="scan_layers")
+
+
+# -- tunable surface ---------------------------------------------------------
+# The remat dose is a memory/compute trade the roofline cannot rank
+# (no cost_fn — the winner depends on whether the config fits HBM at
+# all), so trials need a model-level vehicle and there is no automated
+# one yet: record a winner by pinning it
+# (incubate.autotune.set_config(kernel={'configs': {'scan_remat':
+# ...}})) or writing the cache entry from a manual A/B. Registered
+# anyway so the grid/validity rule, the consult path
+# (full_save_interval=None) and any recorded winner live in the same
+# registry as the kernel tiles.
+
+def _register_scan_surface():
+    from ..tuner.surface import TunableSurface, register_surface
+
+    def _candidates(shape):
+        L = int(shape.get("L", 0))
+        doses = [0] + [fs for fs in (1, 2, 3, 4, 6, 8)
+                       if L and L % fs == 0]
+        return [{"full_save_interval": fs} for fs in doses]
+
+    def _is_valid(config, shape):
+        fs = int(config["full_save_interval"])
+        L = int(shape.get("L", 0))
+        return fs == 0 or (L > 0 and L % fs == 0)
+
+    register_surface(TunableSurface(
+        name="scan_remat",
+        params=("full_save_interval",),
+        default={"full_save_interval": 0},
+        candidates=_candidates,
+        is_valid=_is_valid,
+        describe="Remat dose under scan_layers: every fs-th layer "
+                 "saves activations whole (0 = every layer recomputes, "
+                 "1 = no remat). Shape key: stack depth L; fs must "
+                 "tile L."))
+
+
+_register_scan_surface()

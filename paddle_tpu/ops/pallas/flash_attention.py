@@ -32,6 +32,60 @@ __all__ = ["flash_attention", "flash_attention_reference"]
 
 _NEG_INF = -1e30
 
+# sweep hook: the trial engine pins candidate blocks here (via
+# force_blocks) while it compiles fresh variants — candidates must not
+# ride set_flags, which would mark the flags user-explicit and defeat
+# the override>cache>default precedence afterwards. THREAD-LOCAL: a
+# tune-on-first-call search on one thread must not leak its trial
+# blocks into unrelated traces on another.
+import threading as _threading
+
+_forced_tls = _threading.local()
+
+
+class force_blocks:
+    """Context manager pinning (block_q, block_kv) for trials (this
+    thread only)."""
+
+    def __init__(self, block_q, block_kv):
+        self._val = (int(block_q), int(block_kv))
+
+    def __enter__(self):
+        self._prev = getattr(_forced_tls, "blocks", None)
+        _forced_tls.blocks = self._val
+        return self
+
+    def __exit__(self, *exc):
+        _forced_tls.blocks = self._prev
+        return False
+
+
+def _resolve_blocks(sq, sk, d, dtype):
+    """(block_q, block_kv) for this shape, precedence (documented in
+    framework/flags.py): forced trial candidate > explicit user flag
+    (env or set_flags) > tuner cache > flag default. Host-side at
+    trace time — blocks are static ints selecting the compiled grid."""
+    from ...framework import flags
+    forced = getattr(_forced_tls, "blocks", None)
+    if forced is not None:
+        return forced
+    bq = int(flags.flag("FLAGS_flash_attn_block_q"))
+    bkv = int(flags.flag("FLAGS_flash_attn_block_kv"))
+    bq_explicit = flags.flag_source("FLAGS_flash_attn_block_q") != "default"
+    bkv_explicit = flags.flag_source("FLAGS_flash_attn_block_kv") \
+        != "default"
+    if not (bq_explicit and bkv_explicit):
+        from ...tuner import lookup
+        cfg = lookup("flash_attention",
+                     {"sq": int(sq), "sk": int(sk), "d": int(d)},
+                     str(dtype))
+        if cfg:
+            if not bq_explicit:
+                bq = int(cfg.get("block_q", bq))
+            if not bkv_explicit:
+                bkv = int(cfg.get("block_kv", bkv))
+    return bq, bkv
+
 
 def flash_attention_reference(q, k, v, causal=False, scale=None):
     """[B, S, H, D] reference (fp32 softmax)."""
@@ -112,7 +166,6 @@ def flash_attention(q, k, v, causal=False, scale=None):
 
 
 def _flash_fwd(q, k, v, causal, scale):
-    from ...framework import flags
     b, sq, h, d = q.shape
     sk = k.shape[1]
     hk = k.shape[2]
@@ -121,10 +174,9 @@ def _flash_fwd(q, k, v, causal, scale):
         k = jnp.repeat(k, rep, axis=2)
         v = jnp.repeat(v, rep, axis=2)
     s = scale if scale is not None else 1.0 / math.sqrt(d)
-    block_q = min(int(flags.flag("FLAGS_flash_attn_block_q")),
-                  _round_up(sq, 8))
-    block_k = min(int(flags.flag("FLAGS_flash_attn_block_kv")),
-                  _round_up(sk, 128))
+    bq, bkv = _resolve_blocks(sq, sk, d, q.dtype)
+    block_q = min(bq, _round_up(sq, 8))
+    block_k = min(bkv, _round_up(sk, 128))
     # [B, S, H, D] -> [B*H, S, D], padded to block multiples (the kernel
     # masks padded key positions; padded query rows are sliced off)
     sq_p = _round_up(sq, block_q)
@@ -280,17 +332,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, dq_ref, *,
 def _flash_bwd_pallas(q, k_full, v_full, out, lse, g, causal, s):
     """Pallas backward: dkv kernel (grid over kv blocks) + dq kernel (grid
     over q blocks). All operands bf16 on the MXU, fp32 accumulators."""
-    from ...framework import flags
     b, sq, h, d = q.shape
     sk = k_full.shape[1]
+    bq, bkv = _resolve_blocks(sq, sk, d, q.dtype)
     # both block dims round up to 128 multiples: q blocks because the
     # lse/delta side inputs ride 128-lane tiles, kv blocks because the
     # dkv grid is sk_p/block_k programs and a non-divisor block would
     # leave trailing kv rows with no program (uninitialized dk/dv)
-    block_q = min(_round_up(int(flags.flag("FLAGS_flash_attn_block_q")),
-                            128), _round_up(sq, 128))
-    block_k = min(_round_up(int(flags.flag("FLAGS_flash_attn_block_kv")),
-                            128), _round_up(sk, 128))
+    block_q = min(_round_up(bq, 128), _round_up(sq, 128))
+    block_k = min(_round_up(bkv, 128), _round_up(sk, 128))
     sq_p = _round_up(sq, block_q)
     sk_p = _round_up(sk, block_k)
     bh = b * h
@@ -460,6 +510,45 @@ def _bwd_rule_scan(causal, scale, res, g):
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)
+
+
+# -- tunable surface ---------------------------------------------------------
+# block_q/block_kv candidate grid, registered next to the knob. No
+# cost_fn: flash byte traffic is block-invariant to first order (K/V
+# blocks revisit across q programs — the BlockSpec index map is
+# qi-independent), so the roofline cannot prove any candidate worse;
+# every valid candidate gets timed. Shape key: (sq, sk, d).
+
+def _register_flash_surface():
+    from ...tuner.surface import TunableSurface, register_surface
+
+    def _candidates(shape):
+        return [{"block_q": bq, "block_kv": bkv}
+                for bq in (128, 256, 512)
+                for bkv in (128, 256, 512, 1024)]
+
+    def _is_valid(config, shape):
+        # fwd needs q blocks sublane-aligned, kv blocks lane-aligned;
+        # the bwd kernels round both up to 128 so keep the grid there
+        return (config["block_q"] % 128 == 0
+                and config["block_kv"] % 128 == 0
+                and config["block_q"] <= max(shape.get("sq", 1 << 30), 128)
+                and config["block_kv"] <= max(shape.get("sk", 1 << 30),
+                                              128))
+
+    register_surface(TunableSurface(
+        name="flash_attention",
+        params=("block_q", "block_kv"),
+        default={"block_q": 256, "block_kv": 512},
+        candidates=_candidates,
+        is_valid=_is_valid,
+        describe="Flash-attention Pallas q/kv block sizes (fwd online-"
+                 "softmax grid + hand-written bwd). Shape key: sq/sk/"
+                 "head_dim. FLAGS_flash_attn_block_q/kv set explicitly "
+                 "override any cached value."))
+
+
+_register_flash_surface()
 
 
 def flash_attention_cost(q_shape, kv_seq=None, causal=False, train=False):

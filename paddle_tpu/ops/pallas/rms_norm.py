@@ -46,8 +46,45 @@ def _dx_kernel(x_ref, w_ref, g_ref, o_ref, *, eps):
     o_ref[:] = (inv * gw - x * (inv ** 3) * dot).astype(o_ref.dtype)
 
 
-def _rows_block(n_rows: int) -> int:
-    return min(256, -(-n_rows // 8) * 8)
+# sweep hook (same contract as flash_attention.force_blocks): trials
+# pin a candidate here instead of going through the tuner cache.
+# Thread-local so one thread's trial never leaks into another's trace.
+import threading as _threading
+
+_forced_tls = _threading.local()
+
+
+class force_rows_block:
+    """Context manager pinning the rows-per-program block for trials
+    (this thread only)."""
+
+    def __init__(self, block_rows):
+        self._val = int(block_rows)
+
+    def __enter__(self):
+        self._prev = getattr(_forced_tls, "rows_block", None)
+        _forced_tls.rows_block = self._val
+        return self
+
+    def __exit__(self, *exc):
+        _forced_tls.rows_block = self._prev
+        return False
+
+
+def _rows_block(n_rows: int, d: int | None = None, dtype=None) -> int:
+    """Rows per program, clamped to the (8-aligned) row count. The 256
+    default is the static pick; the tuner cache ("rms_norm" surface,
+    keyed by feature dim) overrides it when a sweep recorded a winner."""
+    want = 256
+    forced = getattr(_forced_tls, "rows_block", None)
+    if forced is not None:
+        want = forced
+    elif d is not None:
+        from ...tuner import lookup
+        cfg = lookup("rms_norm", {"d": int(d)}, str(dtype))
+        if cfg:
+            want = int(cfg.get("block_rows", want))
+    return min(want, -(-n_rows // 8) * 8)
 
 
 def _pad_rows(a, n_pad):
@@ -66,7 +103,7 @@ def _rms_fwd_impl(x, w, eps):
     d = orig_shape[-1]
     x2 = x.reshape(-1, d)
     n = x2.shape[0]
-    blk = _rows_block(n)
+    blk = _rows_block(n, d, x.dtype)
     n_p = -(-n // blk) * blk  # pad rows to the block multiple
     with _no_x64():
         out = pl.pallas_call(
@@ -92,7 +129,7 @@ def _rms_bwd(eps, res, g):
     x2 = x.reshape(-1, d)
     g2 = g.reshape(-1, d)
     n = x2.shape[0]
-    blk = _rows_block(n)
+    blk = _rows_block(n, d, x.dtype)
     n_p = -(-n // blk) * blk
     with _no_x64():
         dx = pl.pallas_call(
@@ -115,3 +152,23 @@ def _rms_bwd(eps, res, g):
 
 
 rms_norm.defvjp(_rms_fwd, _rms_bwd)
+
+
+# -- tunable surface ---------------------------------------------------------
+
+def _register_rms_surface():
+    from ...tuner.surface import TunableSurface, register_surface
+
+    register_surface(TunableSurface(
+        name="rms_norm",
+        params=("block_rows",),
+        default={"block_rows": 256},
+        candidates=lambda shape: [{"block_rows": b}
+                                  for b in (64, 128, 256, 512, 1024)],
+        is_valid=lambda config, shape: (config["block_rows"] % 8 == 0
+                                        and config["block_rows"] > 0),
+        describe="Rows per program of the fused RMSNorm fwd/dx kernels "
+                 "(bandwidth-bound VMEM pass). Shape key: feature dim."))
+
+
+_register_rms_surface()

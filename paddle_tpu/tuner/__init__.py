@@ -1,0 +1,156 @@
+"""``paddle_tpu.tuner`` — empirical autotuner subsystem.
+
+Three layers (docs/autotune.md has the full story):
+
+1. **Trial engine** (``engine.py``): times compiled candidate variants
+   with device-sync points, warmup discard, median-of-k repeats, and
+   roofline-based candidate pruning via ``profiler/cost.py``.
+2. **Tunable surfaces** (``surface.py``): each searchable knob —
+   Pallas grouped-matmul ``bn/bd/bh``, flash-attention
+   ``block_q/block_kv``, rms_norm row blocks, the scan remat dose,
+   the serving engine's chunk ladder — registers its candidate grid,
+   shape-signature key and validity predicate next to the knob itself.
+3. **Persistent cache** (``cache.py``): JSON keyed by kernel ×
+   shape-signature × dtype × backend:chip, written with the atomic
+   stage-then-rename protocol from ``distributed/checkpoint``;
+   corrupt/torn caches are detected and discarded, never crashed on.
+
+Kernel call sites read through :func:`lookup`, which resolves
+**user override → cache → default**: explicit ``incubate.autotune.
+set_config`` configs (and, for flash-attention, explicitly-set
+``FLAGS_*`` values — framework/flags.py) always beat cached search
+results, which beat static defaults. Sweeps run offline via
+``python -m paddle_tpu.tuner`` or ``bench.py --autotune``.
+
+This module is import-light (stdlib only at import time); jax loads
+lazily inside the engine when trials actually run.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .cache import (CACHE_VERSION, TuningCache, backend_signature,
+                    default_cache_path, get_cache, make_key,
+                    set_cache_path)
+from .engine import TrialEngine, TrialResult, measure_callable
+from .surface import (TunableSurface, get_surface, list_surfaces,
+                      register_surface, sig_from_dict)
+
+__all__ = ["TuningCache", "get_cache", "set_cache_path", "make_key",
+           "backend_signature", "default_cache_path", "CACHE_VERSION",
+           "TrialEngine", "TrialResult", "measure_callable",
+           "TunableSurface", "register_surface", "get_surface",
+           "list_surfaces", "sig_from_dict",
+           "lookup", "set_override", "clear_overrides", "get_override",
+           "enabled", "enable", "disable",
+           "set_tune_on_first_call", "tune_on_first_call"]
+
+_state_lock = threading.Lock()
+_enabled = True                     # cache consultation on by default
+_tune_on_first_call = False         # incubate.autotune.set_config switch
+_overrides: dict[str, dict] = {}    # surface -> pinned config
+_first_call_tls = threading.local()  # reentrancy guard for lookup()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable():
+    """Turn cache consultation on (the default). The real switch
+    upstream users reach is ``incubate.autotune.set_config``."""
+    global _enabled
+    with _state_lock:
+        _enabled = True
+
+
+def disable():
+    global _enabled
+    with _state_lock:
+        _enabled = False
+
+
+def set_override(surface: str, config: dict | None):
+    """Pin ``surface`` to ``config`` for every shape (None clears).
+    Overrides rank above cache entries in :func:`lookup` — this is how
+    ``incubate.autotune.set_config(kernel={'configs': ...})`` wins
+    over searched values."""
+    with _state_lock:
+        if config is None:
+            _overrides.pop(surface, None)
+        else:
+            _overrides[surface] = dict(config)
+
+
+def get_override(surface: str) -> dict | None:
+    with _state_lock:
+        cfg = _overrides.get(surface)
+        return dict(cfg) if cfg is not None else None
+
+
+def clear_overrides():
+    with _state_lock:
+        _overrides.clear()
+
+
+def set_tune_on_first_call(value: bool):
+    """When on (via ``incubate.autotune.set_config(kernel={'enable':
+    True, 'tune_on_first_call': True})``), a :func:`lookup` MISS for a
+    surface with a standalone trial builder (sweeps.py) runs one
+    synchronous search — the search cost lands on the first call, the
+    winner persists to the cache for every later process."""
+    global _tune_on_first_call
+    with _state_lock:
+        _tune_on_first_call = bool(value)
+
+
+def tune_on_first_call() -> bool:
+    return _tune_on_first_call
+
+
+def lookup(surface: str, shape: dict | str, dtype="bfloat16") -> dict | None:
+    """The hot-path read kernels call at trace time: the config to use
+    for ``surface`` at this shape, or None (= use the static default).
+
+    Resolution order: set_config override > persistent-cache entry for
+    this backend namespace > (tune-on-first-call search, when enabled
+    and the surface has a standalone builder) > None. A DISABLED tuner
+    (``set_config(kernel={'enable': False})``) returns None
+    unconditionally — every knob falls back to its static default;
+    pinned overrides are kept but dormant until re-enabled. Host-side
+    dict reads on the hot path — no jax work beyond one cached backend
+    probe. NOTE: changing the cache between calls does not retrigger
+    jit compilation for shapes jax already compiled; re-trace (fresh
+    jit) to pick up new winners.
+    """
+    if not _enabled:
+        return None
+    ov = get_override(surface)
+    if ov is not None:
+        return ov
+    sig = shape if isinstance(shape, str) else sig_from_dict(shape)
+    try:
+        hit = get_cache().lookup(surface, sig, dtype)
+    except Exception:
+        return None     # a broken cache must never break the kernel
+    if hit is not None:
+        return hit
+    if (_tune_on_first_call and isinstance(shape, dict)
+            and not getattr(_first_call_tls, "active", False)):
+        # trials themselves call the kernels with explicit configs (no
+        # lookup), but guard against any reentrant path anyway
+        from .sweeps import auto_builder
+        builder = auto_builder(surface, dtype)
+        if builder is None:
+            return None
+        _first_call_tls.active = True
+        try:
+            res = TrialEngine(warmup=1, repeats=3).search(
+                surface, shape, builder, dtype=dtype)
+            return dict(res.best_config)
+        except Exception:
+            return None  # first-call tuning is best-effort by contract
+        finally:
+            _first_call_tls.active = False
+    return None

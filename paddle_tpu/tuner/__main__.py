@@ -1,0 +1,137 @@
+"""Offline sweep CLI: ``python -m paddle_tpu.tuner``.
+
+The one-command, resumable sweep artifact: each (surface, shape) pair
+that finishes commits atomically to the tuning cache, so a sweep
+killed mid-way restarts and SKIPS everything already recorded (pass
+``--force`` to re-tune). Results print as JSON lines — one complete
+record per search — so a driver's time limit can never erase finished
+work.
+
+Examples::
+
+    python -m paddle_tpu.tuner --list
+    python -m paddle_tpu.tuner --preset moe_bench
+    python -m paddle_tpu.tuner --surface grouped_matmul \\
+        --shape d=1024,h=1408,E=16 --dtype bfloat16 --repeats 5
+    python -m paddle_tpu.tuner --surface flash_attention \\
+        --shape sq=2048,sk=2048,d=128 --cache /tmp/cache.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import TrialEngine, get_surface, list_surfaces, set_cache_path
+from .sweeps import BENCH_PRESETS, auto_builder, ensure_builtin_surfaces
+
+
+def _parse_shape(text: str) -> dict:
+    """``d=1024,h=1408,E=16`` -> {'d': 1024, 'h': 1408, 'E': 16}."""
+    shape = {}
+    for part in text.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, val = part.partition("=")
+        if not _ or not key:
+            raise SystemExit(f"bad --shape component {part!r} "
+                             "(want key=int,key=int,...)")
+        shape[key.strip()] = int(val)
+    if not shape:
+        raise SystemExit("--shape parsed to nothing")
+    return shape
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.tuner",
+        description="Offline kernel/runtime autotuning sweeps "
+                    "(docs/autotune.md)")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered tunable surfaces and exit")
+    ap.add_argument("--surface", action="append", default=[],
+                    help="surface to sweep (repeatable)")
+    ap.add_argument("--shape", action="append", default=[],
+                    help="shape per --surface, e.g. d=1024,h=1408,E=16 "
+                         "(repeatable, paired with --surface in order)")
+    ap.add_argument("--preset", choices=sorted(BENCH_PRESETS),
+                    help="named (surface, shape) list; "
+                         "moe_bench = the MoE tile sweep")
+    ap.add_argument("--dtype", default="bfloat16")
+    ap.add_argument("--cache", default=None,
+                    help="cache file (default: $PADDLE_TPU_TUNER_CACHE "
+                         "or ~/.cache/paddle_tpu/tuning_cache.json)")
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--repeats", type=int, default=5)
+    ap.add_argument("--max-candidates", type=int, default=None,
+                    help="cap candidates timed per search (dropped "
+                         "count is reported, never silent)")
+    ap.add_argument("--force", action="store_true",
+                    help="re-tune keys already in the cache (default "
+                         "resumes: cached keys are skipped)")
+    args = ap.parse_args(argv)
+
+    ensure_builtin_surfaces()
+
+    if args.list:
+        for name in list_surfaces():
+            s = get_surface(name)
+            runnable = auto_builder(name, args.dtype) is not None
+            print(f"{name}: params={list(s.params)} "
+                  f"default={s.default} "
+                  f"{'[CLI-sweepable]' if runnable else '[model-level: sweep via bench.py]'}")
+            if s.describe:
+                print(f"    {s.describe}")
+        return 0
+
+    work: list = []
+    if args.preset:
+        work += [(s, dict(shape)) for s, shape in BENCH_PRESETS[args.preset]]
+    if args.surface:
+        if len(args.shape) != len(args.surface):
+            raise SystemExit("need exactly one --shape per --surface")
+        work += [(s, _parse_shape(sh))
+                 for s, sh in zip(args.surface, args.shape)]
+    if not work:
+        ap.print_usage(sys.stderr)
+        raise SystemExit("nothing to do: pass --list, --preset or "
+                         "--surface/--shape")
+
+    cache = set_cache_path(args.cache) if args.cache else None
+    engine = TrialEngine(cache, warmup=args.warmup, repeats=args.repeats)
+    print(f"# cache: {engine.cache.path} (backend {engine.backend})",
+          file=sys.stderr)
+
+    rc = 0
+    for surface_name, shape in work:
+        builder = auto_builder(surface_name, args.dtype)
+        if builder is None:
+            print(f"# {surface_name}: no standalone trial builder "
+                  "(model-level surface) — serving_chunks is swept by "
+                  "`bench.py --autotune`'s cb section; scan_remat has "
+                  "no automated vehicle yet (pin a winner via "
+                  "incubate.autotune.set_config or a manual A/B)",
+                  file=sys.stderr)
+            rc = max(rc, 2)
+            continue
+        try:
+            res = engine.search(surface_name, shape, builder,
+                                dtype=args.dtype, force=args.force,
+                                max_trials=args.max_candidates)
+        except Exception as e:  # one failed search must not kill a sweep
+            print(f"# {surface_name} @ {shape}: search failed: {e!r}",
+                  file=sys.stderr)
+            rc = 1
+            continue
+        out = res.to_dict()
+        if res.cached_hit:
+            print(f"# {surface_name} @ {res.shape_sig}: cached, "
+                  "skipping (--force to re-tune)", file=sys.stderr)
+        print(json.dumps(out), flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,104 @@
+"""Tunable-surface registry — every searchable knob declares itself.
+
+A :class:`TunableSurface` is the contract between a knob (Pallas tile
+sizes, the remat dose, the serving chunk ladder) and the trial engine:
+it names the knob's parameters, its default config, the candidate grid
+for a given shape signature, a validity predicate, and an optional
+static cost model (FLOPs/bytes per candidate) the engine uses for
+roofline-based pruning before anything is timed.
+
+Registrations live NEXT TO the knob they tune (each kernel module
+registers its own surface at import), not in a central table — the
+grid and validity rules are kernel knowledge. This module is
+stdlib-only so hot-path modules can import it without weight.
+
+Shape signatures are short stable strings (``"d1024,h1408,E16"``)
+produced by each surface's :meth:`TunableSurface.signature`; they are
+the cache's per-shape key component (MPK's point: tuned per-shape
+schedules beat static defaults).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+__all__ = ["TunableSurface", "register_surface", "get_surface",
+           "list_surfaces", "sig_from_dict"]
+
+
+def sig_from_dict(shape: dict) -> str:
+    """Canonical shape-signature string: ``k1v1,k2v2`` sorted by key."""
+    return ",".join(f"{k}{shape[k]}" for k in sorted(shape))
+
+
+@dataclass
+class TunableSurface:
+    """One registered tunable surface (see module docstring).
+
+    candidates: ``fn(shape: dict) -> list[dict]`` — the search grid for
+      this shape (each dict maps param name -> value). The engine
+      always adds ``default`` if missing, so a search can only match
+      or beat the static config.
+    is_valid: ``fn(config: dict, shape: dict) -> bool`` — structural
+      feasibility (alignment, divisibility, VMEM fit); invalid
+      candidates are dropped before pruning.
+    cost_fn: optional ``fn(config: dict, shape: dict) -> (flops,
+      bytes)`` static cost of one trial under this config; feeds the
+      engine's roofline lower-bound pruning (engine.py).
+    """
+
+    name: str
+    params: tuple
+    default: dict
+    candidates: Callable[[dict], list]
+    is_valid: Callable[[dict, dict], bool] = field(
+        default=lambda config, shape: True)
+    cost_fn: Callable[[dict, dict], tuple] | None = None
+    describe: str = ""
+
+    def signature(self, **shape) -> str:
+        return sig_from_dict(shape)
+
+    def grid(self, shape: dict) -> list:
+        """Valid candidate list for ``shape``, default-first and
+        deduplicated (order is otherwise preserved — the engine's
+        tie-break prefers earlier candidates)."""
+        cands = [dict(c) for c in self.candidates(dict(shape))]
+        if self.default not in cands:
+            cands.insert(0, dict(self.default))
+        else:
+            cands.insert(0, cands.pop(cands.index(self.default)))
+        seen, out = [], []
+        for c in cands:
+            if c not in seen and self.is_valid(c, shape):
+                seen.append(c)
+                out.append(c)
+        return out
+
+
+_lock = threading.Lock()
+_registry: dict[str, TunableSurface] = {}
+
+
+def register_surface(surface: TunableSurface) -> TunableSurface:
+    """Register (idempotently replacing) a surface by name."""
+    with _lock:
+        _registry[surface.name] = surface
+    return surface
+
+
+def get_surface(name: str) -> TunableSurface:
+    with _lock:
+        try:
+            return _registry[name]
+        except KeyError:
+            raise KeyError(
+                f"unknown tunable surface {name!r}; registered: "
+                f"{sorted(_registry)}") from None
+
+
+def list_surfaces() -> list[str]:
+    with _lock:
+        return sorted(_registry)

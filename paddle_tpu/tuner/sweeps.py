@@ -1,0 +1,200 @@
+"""Standalone trial builders for the built-in kernel surfaces.
+
+A *builder* turns a candidate config into a zero-arg callable the
+trial engine can time: ``builder(config, shape) -> fn | None``. The
+builders here are self-contained (random operands at the requested
+shape, fresh ``jax.jit`` per candidate so every candidate compiles its
+own variant) and are shared by three consumers:
+
+- the offline CLI (``python -m paddle_tpu.tuner``),
+- ``bench.py --autotune`` (sweeps at the bench workload's shapes),
+- tune-on-first-call (``incubate.autotune.set_config`` — a cache miss
+  for a surface with a builder here triggers one synchronous search).
+
+Surfaces whose trial needs a whole model + workload (``scan_remat``,
+``serving_chunks``) have NO standalone builder — :func:`auto_builder`
+returns None and the CLI directs users at ``bench.py``, which owns a
+model. Their registered grids/validity still gate what those vehicles
+may try.
+
+Each trial times forward + backward where the surface has backward
+tiles (grouped matmul's ``bd/bh`` only exist in the dw kernel), since
+that is the configuration the train hot path runs.
+"""
+
+from __future__ import annotations
+
+__all__ = ["ensure_builtin_surfaces", "auto_builder",
+           "grouped_matmul_builder", "flash_attention_builder",
+           "rms_norm_builder", "BENCH_PRESETS"]
+
+
+def ensure_builtin_surfaces():
+    """Import every module that registers a built-in surface (imports
+    are the registration mechanism — registrations live next to their
+    knobs)."""
+    from ..ops.pallas import flash_attention  # noqa: F401
+    from ..ops.pallas import grouped_matmul  # noqa: F401
+    from ..ops.pallas import rms_norm  # noqa: F401
+    from ..nn import scan  # noqa: F401
+    from ..inference import serving  # noqa: F401
+
+
+def _trial(step, *operands):
+    """Run one trial step with x64 promotion OFF for the whole
+    trace+lower+execute: the kernels' internal no_x64 scope covers
+    their own trace, but interpret-mode lowering under an outer jit
+    happens later — outside it — and mixed i64/i32 loop bounds then
+    fail to legalize. Operands carry explicit dtypes, so this changes
+    nothing semantically (same argument as ops/pallas/_utils.no_x64)."""
+    from ..ops.pallas._utils import no_x64
+    with no_x64():
+        return step(*operands)
+
+
+def grouped_matmul_builder(rows=4096, dtype="bfloat16", train=True):
+    """Builder for the ``grouped_matmul`` surface: ``rows`` group-
+    padded assignment rows through an [E, d, h] bank (shape supplies
+    d/h/E), fwd + dx + dw when ``train``."""
+    import jax
+    import jax.numpy as jnp
+
+    def builder(config, shape):
+        from ..ops.pallas.grouped_matmul import grouped_matmul
+        d, h, E = int(shape["d"]), int(shape["h"]), int(shape["E"])
+        bm = 128
+        nr = max(int(rows) // bm, E)
+        P = nr * bm
+        dt = jnp.dtype(dtype)
+        key = jax.random.PRNGKey(0)
+        kx, kw = jax.random.split(key)
+        x = jax.random.normal(kx, (P, d), jnp.float32).astype(dt)
+        w = jax.random.normal(kw, (E, d, h), jnp.float32).astype(dt)
+        # contiguous non-decreasing groups, every expert >= 1 tile
+        tile_gid = jnp.minimum(
+            jnp.arange(nr, dtype=jnp.int32) * E // nr, E - 1)
+        bn, bd, bh = (int(config[k]) for k in ("bn", "bd", "bh"))
+
+        if train:
+            def loss(x, w):
+                return grouped_matmul(x, w, tile_gid, bn=bn, bd=bd,
+                                      bh=bh).astype(jnp.float32).sum()
+            step = jax.jit(jax.grad(loss, argnums=(0, 1)))
+        else:
+            step = jax.jit(lambda x, w: grouped_matmul(
+                x, w, tile_gid, bn=bn, bd=bd, bh=bh))
+        return lambda: _trial(step, x, w)
+
+    return builder
+
+
+def flash_attention_builder(batch=1, heads=8, dtype="bfloat16",
+                            causal=True, train=True):
+    """Builder for the ``flash_attention`` surface (shape supplies
+    sq/sk/d). Candidates are pinned through ``force_blocks`` — NOT
+    ``set_flags``, which would mark the flags user-explicit and defeat
+    the override>cache>default precedence afterwards — with a fresh
+    jit per candidate so each traces under its own blocks."""
+    import jax
+    import jax.numpy as jnp
+
+    def builder(config, shape):
+        from ..ops.pallas.flash_attention import (flash_attention,
+                                                  force_blocks)
+        sq, sk, d = int(shape["sq"]), int(shape["sk"]), int(shape["d"])
+        dt = jnp.dtype(dtype)
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (batch, sq, heads, d),
+                              jnp.float32).astype(dt)
+        k = jax.random.normal(kk, (batch, sk, heads, d),
+                              jnp.float32).astype(dt)
+        v = jax.random.normal(kv, (batch, sk, heads, d),
+                              jnp.float32).astype(dt)
+        bq, bkv = int(config["block_q"]), int(config["block_kv"])
+
+        if train:
+            def loss(q, k, v):
+                return flash_attention(
+                    q, k, v, causal).astype(jnp.float32).sum()
+            step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        else:
+            step = jax.jit(lambda q, k, v: flash_attention(q, k, v,
+                                                           causal))
+
+        def fn():
+            # the force context must cover the first (tracing) call;
+            # later calls hit this candidate's own jit cache
+            with force_blocks(bq, bkv):
+                return _trial(step, q, k, v)
+        return fn
+
+    return builder
+
+
+def rms_norm_builder(rows=4096, dtype="bfloat16", train=True):
+    """Builder for the ``rms_norm`` surface (shape supplies d)."""
+    import jax
+    import jax.numpy as jnp
+
+    def builder(config, shape):
+        from ..ops.pallas.rms_norm import force_rows_block, rms_norm
+        d = int(shape["d"])
+        dt = jnp.dtype(dtype)
+        key = jax.random.PRNGKey(0)
+        kx, kw = jax.random.split(key)
+        x = jax.random.normal(kx, (int(rows), d),
+                              jnp.float32).astype(dt)
+        w = jax.random.normal(kw, (d,), jnp.float32).astype(dt)
+        blk = int(config["block_rows"])
+
+        if train:
+            def loss(x, w):
+                return rms_norm(x, w).astype(jnp.float32).sum()
+            step = jax.jit(jax.grad(loss, argnums=(0, 1)))
+        else:
+            step = jax.jit(rms_norm)
+
+        def fn():
+            with force_rows_block(blk):
+                return _trial(step, x, w)
+        return fn
+
+    return builder
+
+
+#: surface -> builder factory taking (dtype) — the tune-on-first-call
+#: path and the CLI's default trial hyper-parameters
+_AUTO_BUILDERS = {
+    "grouped_matmul": lambda dtype: grouped_matmul_builder(dtype=dtype),
+    "flash_attention": lambda dtype: flash_attention_builder(dtype=dtype),
+    "rms_norm": lambda dtype: rms_norm_builder(dtype=dtype),
+}
+
+
+def auto_builder(surface_name, dtype="bfloat16"):
+    """Standalone builder for ``surface_name``, or None when the
+    surface needs a model-level vehicle (scan_remat, serving_chunks)."""
+    factory = _AUTO_BUILDERS.get(surface_name)
+    return factory(dtype) if factory else None
+
+
+#: named shape presets for the CLI: the sweep VERDICT r5 demands is
+#: one command — `python -m paddle_tpu.tuner --preset moe_bench`.
+#: grouped_matmul appears twice because the SwiGLU stack runs two bank
+#: orientations: gate/up [E, d, h] and down [E, h, d].
+BENCH_PRESETS = {
+    "moe_bench": [
+        ("grouped_matmul", {"d": 1024, "h": 1408, "E": 16}),
+        ("grouped_matmul", {"d": 1408, "h": 1024, "E": 16}),
+    ],
+    "llama_train": [
+        ("flash_attention", {"sq": 2048, "sk": 2048, "d": 128}),
+        ("rms_norm", {"d": 2560}),
+    ],
+    "cpu_smoke": [
+        ("grouped_matmul", {"d": 64, "h": 128, "E": 4}),
+        ("flash_attention", {"sq": 128, "sk": 128, "d": 64}),
+        ("rms_norm", {"d": 128}),
+    ],
+}

@@ -17,6 +17,17 @@ if "host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Hermetic tuner cache: kernels consult the persistent tuning cache at
+# trace time (paddle_tpu/tuner); tests must never read a developer's
+# ~/.cache winners nor write theirs back, so the suite gets a private
+# per-run cache file (tests that need a specific cache state point the
+# global cache elsewhere and restore this one).
+if "PADDLE_TPU_TUNER_CACHE" not in os.environ:
+    import tempfile
+    os.environ["PADDLE_TPU_TUNER_CACHE"] = os.path.join(
+        tempfile.mkdtemp(prefix="paddle_tpu_test_tuner_"),
+        "tuning_cache.json")
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")

@@ -1,17 +1,18 @@
 #!/usr/bin/env python
-"""Hygiene checker: no raw ``open(..., "w")`` writes inside
-``paddle_tpu/distributed/checkpoint/`` outside the ``_atomic_write``
-helper.
+"""Hygiene checker: no raw ``open(..., "w")`` writes inside the
+atomic-commit packages — ``paddle_tpu/distributed/checkpoint/`` AND
+``paddle_tpu/tuner/`` — outside their ``_atomic_write`` helpers.
 
 The crash-safety guarantee rests on one invariant: every byte a
-checkpoint commits was staged, fsync'd, size-checked and checksummed
-by ``_atomic_write``. A raw write-mode ``open`` anywhere else in the
-checkpoint package silently re-opens the torn-write hole, so this
-script (wired as a tier-1 test, tests/test_checkpoint_hygiene.py)
-fails the build on any such call. Lines annotated ``# atomic-ok``
-are allowlisted for audited exceptions.
+checkpoint (or tuning-cache) commit lands was staged, fsync'd,
+size-checked and — where applicable — checksummed by ``_atomic_write``.
+A raw write-mode ``open`` anywhere else in those packages silently
+re-opens the torn-write hole, so this script (wired as a tier-1 test,
+tests/test_checkpoint_hygiene.py) fails the build on any such call.
+Lines annotated ``# atomic-ok`` are allowlisted for audited
+exceptions.
 
-Usage: python tools/check_atomic_writes.py [root_dir]
+Usage: python tools/check_atomic_writes.py [root_dir ...]
 Exit code 0 = clean, 1 = violations (printed one per line).
 """
 
@@ -84,13 +85,24 @@ def check(root):
     return violations
 
 
+#: packages whose writes must all ride _atomic_write (repo-relative)
+DEFAULT_ROOTS = (
+    os.path.join("paddle_tpu", "distributed", "checkpoint"),
+    os.path.join("paddle_tpu", "tuner"),
+)
+
+
 def main(root=None):
     if root is None:
-        root = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), os.pardir,
-            "paddle_tpu", "distributed", "checkpoint")
-    root = os.path.normpath(root)
-    violations = check(root)
+        repo = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            os.pardir)
+        roots = [os.path.join(repo, r) for r in DEFAULT_ROOTS]
+    else:
+        roots = [root] if isinstance(root, (str, os.PathLike)) else \
+            list(root)
+    violations = []
+    for r in roots:
+        violations += check(os.path.normpath(r))
     for path, lineno, line in violations:
         print(f"{path}:{lineno}: raw write-mode open() bypasses "
               f"{ALLOWED_FUNC}: {line}")
@@ -103,4 +115,4 @@ def main(root=None):
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1] if len(sys.argv) > 1 else None))
+    sys.exit(main(sys.argv[1:] if len(sys.argv) > 1 else None))

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Fast-gate budget check: fail when the fast-tier (``-m 'not slow'``)
+suite outgrows the <5-minute solo-run contract.
+
+The tier-1 gate runs the fast tier under a hard driver timeout; every
+PR that adds fast-tier tests eats the remaining headroom silently
+until one day the whole gate times out and EVERY metric of that round
+is lost (the round-4 failure shape). This check makes the budget an
+explicit, failing gate: point it at the tier-1 pytest log (the
+``tee /tmp/_t1.log`` file the ROADMAP command writes) and it parses
+the wall-time from pytest's summary line, failing when the run
+exceeds ``--budget`` seconds (default 300) and warning once past
+``--warn-frac`` of it (default 0.8 — the "you are spending the
+headroom" tripwire). New broad/slow tests belong in the slow tier
+(``@pytest.mark.slow``), which this budget does not cover.
+
+Usage::
+
+    python tools/check_fast_tier_budget.py --log /tmp/_t1.log
+    python tools/check_fast_tier_budget.py --log /tmp/_t1.log \\
+        --budget 300 --warn-frac 0.8
+
+Exit codes: 0 within budget, 1 over budget, 2 log missing or no
+parsable pytest summary line (an unparseable gate is a failing gate —
+silence must never read as "within budget").
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+
+DEFAULT_BUDGET_S = 300.0
+DEFAULT_WARN_FRAC = 0.8
+
+# pytest's final summary: "... 606 passed, 8 failed in 115.60s (0:01:55)"
+# (ANSI/-q variants included; take the LAST match — reruns append)
+_SUMMARY_RE = re.compile(
+    r"\b(?:passed|failed|error|errors|no tests ran|deselected|"
+    r"skipped|xfailed|xpassed|warning[s]?)\b[^\n]*?\bin\s+"
+    r"([0-9]+(?:\.[0-9]+)?)s\b")
+
+
+def parse_duration_s(text: str):
+    """Wall seconds from the last pytest summary line in ``text``, or
+    None when no summary is present (crashed/killed run)."""
+    matches = _SUMMARY_RE.findall(text)
+    return float(matches[-1]) if matches else None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail when the fast-tier pytest run exceeds its "
+                    "wall-time budget")
+    ap.add_argument("--log", default="/tmp/_t1.log",
+                    help="tier-1 pytest log file (default /tmp/_t1.log)")
+    ap.add_argument("--budget", type=float, default=DEFAULT_BUDGET_S,
+                    help=f"budget in seconds (default "
+                         f"{DEFAULT_BUDGET_S:.0f} — the <5-min solo "
+                         "contract)")
+    ap.add_argument("--warn-frac", type=float, default=DEFAULT_WARN_FRAC,
+                    help="warn (still exit 0) past this fraction of "
+                         "the budget")
+    args = ap.parse_args(argv)
+
+    try:
+        with open(args.log, encoding="utf-8", errors="replace") as f:
+            text = f.read()
+    except OSError as e:
+        print(f"fast-tier budget: cannot read log {args.log!r}: {e}",
+              file=sys.stderr)
+        return 2
+    dur = parse_duration_s(text)
+    if dur is None:
+        print(f"fast-tier budget: no pytest summary line found in "
+              f"{args.log!r} (crashed or truncated run) — refusing to "
+              "call that within budget", file=sys.stderr)
+        return 2
+    frac = dur / args.budget if args.budget else float("inf")
+    headroom = args.budget - dur
+    msg = (f"fast tier ran {dur:.1f}s of the {args.budget:.0f}s budget "
+           f"({frac * 100:.0f}%, {headroom:+.1f}s headroom)")
+    if dur > args.budget:
+        print(f"fast-tier budget EXCEEDED: {msg} — move new breadth "
+              "tests to the slow tier (@pytest.mark.slow)",
+              file=sys.stderr)
+        return 1
+    if frac >= args.warn_frac:
+        print(f"fast-tier budget WARNING: {msg} — headroom is nearly "
+              "spent; new tests should default to the slow tier",
+              file=sys.stderr)
+    else:
+        print(msg)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
