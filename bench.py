@@ -192,6 +192,152 @@ def _train_bench(on_tpu, dev):
     return n_params, tok_per_s, mfu
 
 
+def _fit_e2e_bench(on_tpu, dev, autotune=False):
+    """End-to-end fit-loop efficiency (ISSUE-5 tentpole): hapi
+    ``Model.fit`` running the compiled step with device prefetch and
+    non-blocking loss, measured against (a) the raw compiled
+    fwd_bwd+update step over a pre-placed batch — the floor the fit
+    loop should approach — and (b) the eager tape loop (CPU smoke
+    only; eager per-op dispatch of the chip config through the tunnel
+    would dwarf the section budget). Emits ``train_e2e_*`` keys plus
+    ``input_*`` keys from the prefetch stage.
+
+    autotune=True additionally sweeps the ``fit_pipeline`` surface
+    (prefetch_depth × steps_in_flight) over short fits, committing the
+    winner to the tuning cache (the serving_chunks pattern: the
+    surface needs a live model + workload, so it cannot ride the
+    standalone CLI builders)."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.models import (LlamaConfig, LlamaForCausalLM,
+                                   LlamaPretrainingCriterion)
+
+    if on_tpu:
+        cfg = LlamaConfig.llama_1b()
+        batch, seq, n_batches = 8, 1024, 12
+    else:
+        cfg = LlamaConfig.tiny()
+        batch, seq, n_batches = 2, 64, 10
+    cfg.tensor_parallel = False
+    cfg.scan_layers = False
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    # SGD keeps optimizer-state HBM flat (the 1B + Adam moments would
+    # crowd a 16GB chip next to activations); the fit-loop overhead
+    # being measured is optimizer-agnostic
+    m = Model(model)
+    m.prepare(paddle.optimizer.SGD(1e-4, parameters=model.parameters()),
+              LlamaPretrainingCriterion(cfg))
+
+    ids_np = np.random.RandomState(0).randint(
+        0, cfg.vocab_size, (batch * n_batches, seq + 1)).astype(np.int64)
+    ids_t = paddle.to_tensor(ids_np)
+    ds = paddle.io.TensorDataset([ids_t, ids_t])
+
+    # (a) raw compiled step over one resident batch — no loader, no
+    # prefetch, no loss bookkeeping; scalar fetch only at the end
+    x0 = paddle.to_tensor(ids_np[:batch])
+    step_fn = m._static_train_step(donate=True)
+    loss = step_fn(x0, x0)            # discovery
+    loss = step_fn(x0, x0)            # compile+run
+    float(np.asarray(loss._data))
+    raw_steps = 2 * n_batches
+    t0 = time.perf_counter()
+    for _ in range(raw_steps):
+        loss = step_fn(x0, x0)
+    float(np.asarray(loss._data))
+    raw_ms = (time.perf_counter() - t0) / raw_steps * 1e3
+
+    tuned_fit = {}
+    if autotune:
+        from paddle_tpu import tuner
+        from paddle_tpu.tuner.surface import sig_from_dict
+        shape = {"bs": batch}
+        key = tuner.make_key("fit_pipeline", sig_from_dict(shape), "-",
+                             tuner.backend_signature())
+        cache = tuner.get_cache()
+        hit = cache.get(key)
+        if hit is not None:
+            tuned_fit = {"config": hit["config"], "cached_hit": True,
+                         "shape_sig": sig_from_dict(shape)}
+        else:
+            surface = tuner.get_surface("fit_pipeline")
+            # small DIVERSE slice (each candidate = one timed epoch):
+            # default first, then an even stride across the rest so
+            # both depth extremes get tried; candidates_tried reports
+            # the truncation — no silent cap
+            grid = surface.grid(shape)
+            rest = [c for c in grid if c != surface.default]
+            # default + both grid extremes + the middle: the corners
+            # are the configs a sweep exists for, so pick them
+            # literally instead of striding past them
+            picks = ([rest[0], rest[len(rest) // 2], rest[-1]]
+                     if rest else [])
+            cands = grid[:1] + [c for i, c in enumerate(picks)
+                                if c not in picks[:i]]
+            trials = []
+            for c in cands:
+                m.fit(ds, batch_size=batch, epochs=1, verbose=0,
+                      shuffle=False, log_freq=1_000_000,
+                      prefetch_depth=c["prefetch_depth"],
+                      steps_in_flight=c["steps_in_flight"])
+                trials.append(
+                    (dict(c), m._last_epoch_summary["avg_step_ms"]))
+            win_cfg, win_ms = min(trials, key=lambda t: t[1])
+            cache.put(key, win_cfg, median_ms=win_ms,
+                      representative=on_tpu, source="search",
+                      extra={"trials": len(trials)})
+            tuned_fit = {"config": win_cfg, "cached_hit": False,
+                         "shape_sig": sig_from_dict(shape),
+                         "step_ms": round(win_ms, 3),
+                         "candidates_tried": len(trials)}
+            print(f"# fit autotune: {win_cfg} {win_ms:.2f} ms/step "
+                  f"({len(trials)} candidates)", file=sys.stderr)
+
+    # (b) the compiled fit loop: epoch 0 warms (compile + prefetch
+    # spin-up), epoch 1 is the measurement — per-epoch stats ride the
+    # profiler's epoch summary
+    m.fit(ds, batch_size=batch, epochs=2, verbose=0, shuffle=False,
+          log_freq=1_000_000)
+    s = m._last_epoch_summary
+    fit_ms = s["avg_step_ms"]
+    tokens = batch * seq
+
+    # (c) eager oracle loop (CPU smoke only — see docstring)
+    eager_ms = None
+    if not on_tpu:
+        m.fit(ds, batch_size=batch, epochs=1, verbose=0, shuffle=False,
+              log_freq=1_000_000, compiled=False)
+        eager_ms = m._last_epoch_summary["avg_step_ms"]
+
+    out = {
+        "train_e2e_step_ms": round(fit_ms, 3),
+        "train_e2e_raw_step_ms": round(raw_ms, 3),
+        "train_e2e_overhead_ms": round(fit_ms - raw_ms, 3),
+        "train_e2e_tokens_per_sec": round(tokens / (fit_ms / 1e3), 2),
+        "input_wait_ms": s.get("input_wait_ms"),
+        "input_h2d_mb": s.get("h2d_mb"),
+        "input_prefetch_depth": m._fit_pipeline["prefetch_depth"],
+        "input_steps_in_flight": m._fit_pipeline["steps_in_flight"],
+    }
+    if eager_ms is not None:
+        out["train_e2e_eager_step_ms"] = round(eager_ms, 3)
+        out["train_e2e_vs_eager"] = round(eager_ms / fit_ms, 4)
+    if tuned_fit:
+        out["tuned_fit_pipeline"] = tuned_fit
+    print(f"# fit e2e: {fit_ms:.2f} ms/step (raw step {raw_ms:.2f} ms, "
+          f"overhead {fit_ms - raw_ms:+.2f} ms"
+          + (f", eager {eager_ms:.2f} ms" if eager_ms is not None else "")
+          + f"), input wait {s.get('input_wait_ms')} ms/epoch",
+          file=sys.stderr)
+    return out
+
+
 def _decode_bench(on_tpu):
     """Greedy KV-cache decode throughput (BASELINE config 5's serving
     shape, chip-sized): batch of streams, measure generated tokens/s in
@@ -758,6 +904,25 @@ def main():
     record.update(tuned)
     print(json.dumps(record), flush=True)
     gc.collect()
+
+    # fit-loop e2e (ISSUE 5): right after the headline train metric —
+    # the whole point is fit() reaching the raw step's rate
+    try:
+        fit_e2e = _timed_section(
+            "fit e2e", lambda: _retry_transient(
+                lambda: _fit_e2e_bench(on_tpu, dev,
+                                       autotune=args.autotune),
+                "fit e2e bench"))
+    except Exception as e:
+        print(f"# fit e2e bench failed: {e!r}", file=sys.stderr)
+        fit_e2e = None
+    gc.collect()
+    if fit_e2e is not None:
+        record["train_e2e_metric"] = ("llama_fit_loop_compiled_step"
+                                      + suffix)
+        record["train_e2e_unit"] = "tokens/s/chip"
+        record.update(fit_e2e)
+        print(json.dumps(record), flush=True)
 
     # Section order = evidentiary priority under the driver's time
     # limit (measured round 5: train 593s, decode 353s — mostly

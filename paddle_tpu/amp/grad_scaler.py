@@ -26,11 +26,40 @@ class GradScaler:
         self._incr_every_n_steps = incr_every_n_steps
         self._decr_every_n = decr_every_n_nan_or_inf
         self._dynamic = use_dynamic_loss_scaling
-        self._good_steps = 0
-        self._bad_steps = 0
         self._found_inf = False
+        self._found_inf_dev = None   # device bool from the last unscale_
         # guards the unscale_-then-step pattern against double unscaling
         self._unscaled_since_step = False
+        # The DEVICE owns the dynamic-scaling state (scale + good/bad
+        # step counters) as persistable scalars, the optimizer
+        # _lr_state/_step_state pattern: a to_static-compiled train
+        # step reads the CURRENT scale as state input (no baked
+        # trace-time constant) and update()'s grow/shrink runs as
+        # traced jnp math — so the scale keeps growing across compiled
+        # replays, where python counter increments would never execute.
+        self._scale_state = Tensor(jnp.asarray(self._scale, jnp.float32))
+        self._scale_state.persistable = True
+        self._scale_state.name = "loss_scaling"
+        self._good_state = Tensor(jnp.asarray(0, jnp.int32))
+        self._good_state.persistable = True
+        self._good_state.name = "loss_scaling_good_steps"
+        self._bad_state = Tensor(jnp.asarray(0, jnp.int32))
+        self._bad_state.persistable = True
+        self._bad_state.name = "loss_scaling_bad_steps"
+
+    def _sync_scale_state(self) -> None:
+        """Push the python-side scale into device state (explicit
+        setters only — per-step syncing would stomp device-side
+        growth)."""
+        from ..framework.core import trace_clean
+        if trace_clean():
+            self._scale_state.set_data(
+                jnp.asarray(self._scale, jnp.float32))
+
+    def _read_scalar(self, t, cast):
+        """Host read of a device state scalar (outside traces only)."""
+        import numpy as np
+        return cast(np.asarray(t._data))
 
     def is_enable(self) -> bool:
         return self._enable
@@ -39,15 +68,24 @@ class GradScaler:
         return self._dynamic
 
     def get_loss_scaling(self) -> float:
+        from ..framework.core import trace_clean
+        if trace_clean():
+            self._scale = self._read_scalar(self._scale_state, float)
         return self._scale
 
     def set_init_loss_scaling(self, v) -> None:
         self._scale = float(v)
+        self._sync_scale_state()
 
     def scale(self, var: Tensor) -> Tensor:
         if not self._enable:
             return var
-        return var * self._scale
+        # read the state tensor (not a python float) so a compiled
+        # step traces a state read — per-call live scale; cast to the
+        # loss dtype so an fp16/bf16 loss is not silently promoted to
+        # f32 (the old weakly-typed python float preserved it)
+        return var * Tensor(self._scale_state.jax().astype(
+            var._data.dtype))
 
     def unscale_(self, optimizer) -> None:
         if not self._enable:
@@ -58,16 +96,24 @@ class GradScaler:
                 "step()/update(); calling it twice would double-unscale "
                 "the gradients")
         self._unscaled_since_step = True
-        inv = 1.0 / self._scale
+        inv = 1.0 / self._scale_state.jax()
         found = jnp.asarray(False)
         with no_grad():
             for p in optimizer._parameter_list:
                 if p.grad is None:
                     continue
-                g = p.grad._data * inv
+                # keep the grad's own (possibly low-precision) dtype
+                g = p.grad._data * inv.astype(p.grad._data.dtype)
                 found = jnp.logical_or(found, ~jnp.all(jnp.isfinite(g)))
                 p.grad.set_data(g)
-        self._found_inf = bool(found)
+        # the raw device bool feeds update()'s traced counter math;
+        # bool() THROUGH the Tensor funnel is a GUARDED branch decision
+        # under to_static — an inf/nan flip discards the compiled run
+        # and re-runs eagerly (correct skip semantics) instead of
+        # committing a stale-branch update. bool() on the raw array
+        # would be an unguardable hard graph break.
+        self._found_inf_dev = found
+        self._found_inf = bool(Tensor(found))
 
     def step(self, optimizer) -> None:
         if not self._enable:
@@ -83,32 +129,58 @@ class GradScaler:
         self.step(optimizer)
 
     def update(self) -> None:
+        """Dynamic-scale adjustment as TRACED device math (no python
+        counters): exactly the reference algorithm — on overflow bump
+        bad_steps, zero good_steps, shrink after decr_every_n bad
+        steps; on a clean step bump good_steps, zero bad_steps, grow
+        after incr_every_n good steps. Because it is jnp math over
+        persistable state, compiled replays keep growing the scale —
+        python `+= 1` bodies would only ever run on the trace."""
         self._unscaled_since_step = False
         if not (self._enable and self._dynamic):
             return
-        if self._found_inf:
-            self._bad_steps += 1
-            self._good_steps = 0
-            if self._bad_steps >= self._decr_every_n:
-                self._scale = max(self._scale * self._decr_ratio, 1.0)
-                self._bad_steps = 0
-        else:
-            self._good_steps += 1
-            self._bad_steps = 0
-            if self._good_steps >= self._incr_every_n_steps:
-                self._scale *= self._incr_ratio
-                self._good_steps = 0
+        found = self._found_inf_dev
+        if found is None:
+            found = jnp.asarray(bool(self._found_inf))
+        scale = self._scale_state.jax()
+        good = self._good_state.jax()
+        bad = self._bad_state.jax()
+        bad_next = jnp.where(found, bad + 1, 0)
+        good_next = jnp.where(found, 0, good + 1)
+        shrink = bad_next >= self._decr_every_n       # only when found
+        grow = good_next >= self._incr_every_n_steps  # only when clean
+        self._scale_state.set_data(jnp.where(
+            shrink, jnp.maximum(scale * self._decr_ratio, 1.0),
+            jnp.where(grow, scale * self._incr_ratio, scale)))
+        self._bad_state.set_data(jnp.where(shrink, 0, bad_next))
+        self._good_state.set_data(jnp.where(grow, 0, good_next))
         self._found_inf = False
+        self._found_inf_dev = None
+
+    # host-facing views of the device counters (state_dict parity)
+
+    @property
+    def _good_steps(self) -> int:
+        return self._read_scalar(self._good_state, int)
+
+    @property
+    def _bad_steps(self) -> int:
+        return self._read_scalar(self._bad_state, int)
 
     def state_dict(self) -> dict:
-        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+        return {"scale": self.get_loss_scaling(),
+                "incr_ratio": self._incr_ratio,
                 "decr_ratio": self._decr_ratio,
-                "incr_count": self._good_steps, "decr_count": self._bad_steps}
+                "incr_count": self._good_steps,
+                "decr_count": self._bad_steps}
 
     def load_state_dict(self, state: dict) -> None:
         self._scale = state.get("scale", self._scale)
-        self._good_steps = state.get("incr_count", 0)
-        self._bad_steps = state.get("decr_count", 0)
+        self._good_state.set_data(
+            jnp.asarray(int(state.get("incr_count", 0)), jnp.int32))
+        self._bad_state.set_data(
+            jnp.asarray(int(state.get("decr_count", 0)), jnp.int32))
+        self._sync_scale_state()
 
 
 AmpScaler = GradScaler

@@ -30,6 +30,7 @@ rematerializing tape, numerically identical to the eager one.
 from __future__ import annotations
 
 import contextlib
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -38,12 +39,24 @@ import numpy as np
 __all__ = ["SegValue", "SegmentRecorder", "segment_mode",
            "current_recorder"]
 
-_current: list = [None]
+
+class _SegTLS(threading.local):
+    """Segment mode is a PER-THREAD property: a compiled-around-break
+    call on the trainer thread must not capture unrelated ops running
+    concurrently on other threads (the DevicePrefetcher/DataLoader
+    collate threads dispatch jnp work mid-step — recording those as
+    lazy placeholders corrupts their shapes)."""
+
+    def __init__(self):
+        self.recorder = None
+
+
+_tls = _SegTLS()
 _cache_checked: list = [False]
 
 
 def current_recorder():
-    return _current[0]
+    return _tls.recorder
 
 
 def _ensure_compile_cache():
@@ -331,16 +344,16 @@ class SegmentRecorder:
 
 @contextlib.contextmanager
 def segment_mode(recorder: SegmentRecorder):
-    prev = _current[0]
-    _current[0] = recorder
+    prev = _tls.recorder
+    _tls.recorder = recorder
     try:
         yield recorder
     except BaseException:
-        _current[0] = prev
+        _tls.recorder = prev
         recorder.abort()   # roll back half-committed state mutations
         raise
     else:
-        _current[0] = prev
+        _tls.recorder = prev
         try:
             recorder.flush()
         except BaseException:

@@ -1,15 +1,51 @@
 """High-level ``paddle.Model`` API (python/paddle/hapi/model.py parity,
-UNVERIFIED): prepare/fit/evaluate/predict/save/load."""
+UNVERIFIED): prepare/fit/evaluate/predict/save/load.
+
+Training hot path: ``fit`` runs a to_static-COMPILED train step by
+default — forward, loss, backward and the optimizer update lower into
+one XLA program with the persistable state (params + optimizer slots)
+donated, fed by a background device-prefetch stage
+(``io.DevicePrefetcher``) and a non-blocking loss window: up to
+``steps_in_flight`` dispatched steps stay un-fetched, loss scalars
+resolve only at ``log_freq``/epoch boundaries, so the host loop stays
+dispatch-ahead of the device (the GSPMD-style host-overlap discipline;
+docs/data_pipeline.md). The eager ``train_batch`` loop remains as
+``fit(compiled=False)`` — the parity oracle and the fallback for
+un-traceable user code (to_static itself also falls back per-signature
+on genuine graph breaks, so ``compiled=True`` is always safe)."""
 
 from __future__ import annotations
+
+import collections
+import time
 
 import numpy as np
 
 from ..framework.core import Tensor, no_grad
 from ..framework.io import save as save_obj, load as load_obj
-from ..io import DataLoader
+from ..io import DataLoader, DevicePrefetcher
+from ..profiler import trace as _trace
+from ..tuner.surface import TunableSurface, register_surface
+from ..utils import monitor
 
 __all__ = ["Model"]
+
+
+#: fit's pipeline knobs registered as a tunable surface (next to the
+#: knob, like the serving chunk ladder): prefetch_depth = batches the
+#: DevicePrefetcher places ahead of the consumer; steps_in_flight =
+#: dispatched-but-unfetched compiled steps before backpressure.
+#: ``bench.py --autotune`` sweeps this grid; fit consults the tuning
+#: cache when both knobs are left None (arg > cache > default).
+register_surface(TunableSurface(
+    name="fit_pipeline",
+    params=("prefetch_depth", "steps_in_flight"),
+    default={"prefetch_depth": 2, "steps_in_flight": 2},
+    candidates=lambda shape: [
+        {"prefetch_depth": p, "steps_in_flight": s}
+        for p in (1, 2, 4) for s in (1, 2, 4)],
+    describe="hapi.Model.fit device-prefetch depth and in-flight "
+             "compiled-step window"))
 
 
 class Model:
@@ -18,11 +54,18 @@ class Model:
         self._optimizer = None
         self._loss = None
         self._metrics = []
+        self._compiled_train_step = None
+        self._compiled_eval_step = None
+        self._fit_pipeline = None
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None):
         self._optimizer = optimizer
         self._loss = loss
+        # the compiled steps close over optimizer/loss/amp — re-prepare
+        # must rebuild them
+        self._compiled_train_step = None
+        self._compiled_eval_step = None
         if metrics is not None:
             self._metrics = metrics if isinstance(metrics, (list, tuple)) \
                 else [metrics]
@@ -88,11 +131,192 @@ class Model:
             out = self.network(*inputs)
         return out
 
+    # ---- compiled steps (the fit hot path) -------------------------------
+
+    def _static_train_step(self, donate: bool = True):
+        """The jitted train step: forward + loss + backward + optimizer
+        update functionalized into ONE compiled program via the
+        to_static machinery, with params and optimizer slots donated
+        (``donate_state``) so XLA updates state in place instead of
+        allocating a fresh copy per step. Returns the loss TENSOR — no
+        host fetch; the fit loop resolves values at log boundaries.
+        ``train_batch`` stays the eager parity oracle."""
+        sf = getattr(self, "_compiled_train_step", None)
+        if sf is not None and \
+                getattr(self, "_compiled_train_donate", None) != donate:
+            sf = None    # donation setting changed: rebuild
+        if sf is None:
+            def train_step(*args):
+                *xs, y = args
+                self.network.train()
+                if getattr(self, "_amp_level", None):
+                    from ..amp import auto_cast
+                    with auto_cast(enable=True, level=self._amp_level):
+                        outputs = self.network(*xs)
+                        loss = self._compute_loss(outputs, y)
+                else:
+                    outputs = self.network(*xs)
+                    loss = self._compute_loss(outputs, y)
+                loss.backward()
+                self._optimizer.step()
+                self._optimizer.clear_grad()
+                return loss
+
+            from ..jit.to_static_api import StaticFunction
+            sf = StaticFunction(train_step, donate_state=donate)
+            self._compiled_train_step = sf
+            self._compiled_train_donate = donate
+        return sf
+
+    def _static_eval_step(self):
+        sf = getattr(self, "_compiled_eval_step", None)
+        if sf is None:
+            def eval_step(*args):
+                *xs, y = args
+                self.network.eval()
+                with no_grad():
+                    outputs = self.network(*xs)
+                    loss = self._compute_loss(outputs, y)
+                return loss
+
+            from ..jit.to_static_api import StaticFunction
+            sf = StaticFunction(eval_step)
+            self._compiled_eval_step = sf
+        return sf
+
+    def _resolve_fit_pipeline(self, batch_size, prefetch_depth,
+                              steps_in_flight) -> dict:
+        """Pipeline-knob resolution, the serving-engine precedence:
+        explicit fit() arg > tuning-cache entry > surface default."""
+        cfg = {"prefetch_depth": prefetch_depth,
+               "steps_in_flight": steps_in_flight}
+        if any(v is None for v in cfg.values()):
+            from ..tuner.surface import get_surface
+            base = dict(get_surface("fit_pipeline").default)
+            try:
+                from .. import tuner
+                hit = tuner.lookup("fit_pipeline",
+                                   {"bs": int(batch_size or 0)},
+                                   dtype="-")
+            except Exception:
+                hit = None
+            if hit:
+                base.update(hit)
+            for k, v in cfg.items():
+                if v is None:
+                    cfg[k] = base.get(k, 2)
+        cfg = {k: int(v) for k, v in cfg.items()}
+        bad = {k: v for k, v in cfg.items() if v < 1}
+        if bad:
+            # 0 must not silently mean 1 — the fully synchronous,
+            # unpipelined path is fit(compiled=False)
+            raise ValueError(
+                f"fit pipeline knobs must be >= 1, got {bad}; use "
+                "compiled=False for the synchronous eager loop")
+        self._fit_pipeline = cfg    # introspection (tests, bench)
+        return cfg
+
+    # ---- epoch loops -----------------------------------------------------
+
+    def _fit_epoch_compiled(self, loader, step_fn, epoch, log_freq,
+                            verbose, pipeline, device_sharding,
+                            explicit_depth=False):
+        """One epoch at compiled-step speed: device-prefetched input,
+        up to ``steps_in_flight`` dispatched steps un-fetched, loss
+        scalars resolved only at log/epoch boundaries. Returns
+        (losses, prefetcher, host_dispatch_seconds)."""
+        tracer = _trace.get_tracer()
+        it = iter(loader)
+        if isinstance(it, DevicePrefetcher):
+            # the loader was built with prefetch_to_device= — use ITS
+            # prefetch stage (a second wrapper would double-place every
+            # batch, double-count h2d_bytes, and undo the loader's own
+            # device_sharding)
+            pf = it
+            ignored = []
+            if device_sharding is not None and \
+                    pf.sharding != device_sharding:
+                ignored.append("device_sharding")
+            if explicit_depth and pf.depth != pipeline["prefetch_depth"]:
+                ignored.append("prefetch_depth")
+            if ignored:
+                import warnings
+                warnings.warn(
+                    f"fit({'/'.join(ignored)}=...) ignored: the "
+                    "DataLoader was built with prefetch_to_device= "
+                    "and its own prefetch config wins — set these on "
+                    "the DataLoader instead")
+        else:
+            pf = DevicePrefetcher(it, depth=pipeline["prefetch_depth"],
+                                  sharding=device_sharding)
+        in_flight = pipeline["steps_in_flight"]
+        pending: collections.deque = collections.deque()
+        losses: list[float] = []
+        host_s = 0.0
+
+        def resolve_pending():
+            # the ONLY host←device value fetches of the epoch
+            while pending:
+                _s, t = pending.popleft()
+                v = float(np.asarray(t._data))
+                losses.append(v)
+                monitor.emit_step_metrics(epoch=epoch, loss=v)
+            tracer.counter("hapi/input_wait_ms",
+                           round(pf.input_wait_s * 1e3, 3), epoch=epoch)
+
+        try:
+            for step, batch in enumerate(pf):
+                batch = batch if isinstance(batch, (list, tuple)) \
+                    else (batch,)
+                t0 = time.perf_counter()
+                with _trace.trace_span("hapi/train_batch", cat="train",
+                                       epoch=epoch, step=step,
+                                       mode="compiled"):
+                    loss_t = step_fn(*batch)
+                host_s += time.perf_counter() - t0
+                pending.append((step, loss_t))
+                in_flight_now = min(len(pending), in_flight)
+                tracer.counter("hapi/steps_in_flight", in_flight_now)
+                if len(pending) > in_flight:
+                    # backpressure: block on the readiness (not the
+                    # value) of the step in_flight behind the newest —
+                    # at most in_flight UNFINISHED steps stay queued,
+                    # however long resolution is deferred. (pending[0]
+                    # would be a no-op once the first step completes.)
+                    _trace.block_on(pending[-in_flight - 1][1]._data)
+                if step % log_freq == 0:
+                    resolve_pending()
+                    if verbose:
+                        print(f"epoch {epoch} step {step}: "
+                              f"loss {losses[-1]:.5f}")
+            resolve_pending()
+        finally:
+            pf.close()
+        tracer.counter("hapi/h2d_bytes", pf.h2d_bytes, epoch=epoch)
+        return losses, pf, host_s
+
+    def _fit_epoch_eager(self, loader, epoch, log_freq, verbose):
+        """The eager parity-oracle loop (per-step host sync)."""
+        losses: list[float] = []
+        for step, batch in enumerate(loader):
+            *xs, y = batch if isinstance(batch, (list, tuple)) \
+                else (batch,)
+            with _trace.trace_span("hapi/train_batch", cat="train",
+                                   epoch=epoch, step=step):
+                loss = self.train_batch(xs, y)
+            losses.append(loss[0])
+            monitor.emit_step_metrics(epoch=epoch, loss=loss[0])
+            if verbose and step % log_freq == 0:
+                print(f"epoch {epoch} step {step}: loss {loss[0]:.5f}")
+        return losses
+
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
             verbose=2, drop_last=False, shuffle=True, num_workers=0,
             callbacks=None, resume=None, keep_last_n=None,
-            legacy_save=True):
+            legacy_save=True, compiled=True, donate=True,
+            prefetch_depth=None, steps_in_flight=None,
+            device_sharding=None):
         """Train. ``save_dir`` writes a committed ``step_N``
         distributed checkpoint per epoch (``keep_last_n`` bounds its
         retention) plus — unless ``legacy_save=False`` — the upstream
@@ -100,7 +324,15 @@ class Model:
         newest *committed* checkpoint — ``PADDLE_RESUME_CHECKPOINT``
         if the elastic launcher exported one, else the newest valid
         ``step_N`` under ``save_dir`` — skipping any save torn by a
-        crash; ``resume=<path>`` loads that checkpoint explicitly."""
+        crash; ``resume=<path>`` loads that checkpoint explicitly.
+
+        Hot-path knobs (module docstring, docs/data_pipeline.md):
+        ``compiled=True`` runs the jitted train step (``donate``
+        controls state-buffer donation); ``prefetch_depth`` /
+        ``steps_in_flight`` override the pipeline depths (default:
+        tuning cache, then 2/2); ``device_sharding`` (a jax Sharding,
+        e.g. NamedSharding over a dp mesh axis) device-places each
+        global batch sharded across the mesh."""
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
@@ -119,31 +351,49 @@ class Model:
                 if verbose:
                     print(f"resuming from {ckpt_path} "
                           f"(epoch {start_epoch})")
-        import time as _time
-        from ..profiler import trace as _trace
+        # cache keying must see the REAL batch size when the caller
+        # handed us a pre-built DataLoader (batch_size stays at its
+        # default of 1 in that case)
+        eff_bs = batch_size
+        if isinstance(train_data, DataLoader):
+            sampler = getattr(loader, "batch_sampler", None)
+            eff_bs = getattr(sampler, "batch_size", None) \
+                or getattr(loader, "batch_size", None) or batch_size
+        pipeline = self._resolve_fit_pipeline(eff_bs, prefetch_depth,
+                                              steps_in_flight)
+        step_fn = self._static_train_step(donate) if compiled else None
         for epoch in range(start_epoch, epochs):
-            losses = []
-            epoch_t0 = _time.perf_counter()
-            for step, batch in enumerate(loader):
-                *xs, y = batch if isinstance(batch, (list, tuple)) \
-                    else (batch,)
-                with _trace.trace_span("hapi/train_batch", cat="train",
-                                       epoch=epoch, step=step):
-                    loss = self.train_batch(xs, y)
-                losses.append(loss[0])
-                from ..utils import monitor
-                monitor.emit_step_metrics(epoch=epoch, loss=loss[0])
-                if verbose and step % log_freq == 0:
-                    print(f"epoch {epoch} step {step}: "
-                          f"loss {loss[0]:.5f}")
+            epoch_t0 = time.perf_counter()
+            extra = {}
+            if compiled:
+                runs0 = (step_fn.n_compiled_runs, step_fn.n_eager_runs)
+                losses, pf, host_s = self._fit_epoch_compiled(
+                    loader, step_fn, epoch, log_freq, verbose,
+                    pipeline, device_sharding,
+                    explicit_depth=prefetch_depth is not None)
+                # host-vs-device attribution: host_dispatch_ms is the
+                # python/dispatch cost of the epoch; the rest of
+                # epoch_s is device compute + input wait. Run counters
+                # are cumulative on the StaticFunction — report the
+                # per-epoch delta.
+                extra = {"input_wait_ms": round(pf.input_wait_s * 1e3, 3),
+                         "h2d_mb": round(pf.h2d_bytes / 1e6, 3),
+                         "host_dispatch_ms": round(host_s * 1e3, 3),
+                         "compiled_steps":
+                             step_fn.n_compiled_runs - runs0[0],
+                         "eager_steps":
+                             step_fn.n_eager_runs - runs0[1]}
+            else:
+                losses = self._fit_epoch_eager(loader, epoch, log_freq,
+                                               verbose)
             # per-epoch perf summary through the trace layer (INFO log +
             # gauges; profiler subsystem) — avg step time is the number
             # every perf regression shows up in first
             summary = _trace.epoch_summary(
                 epoch, steps=len(losses),
-                seconds=_time.perf_counter() - epoch_t0,
+                seconds=time.perf_counter() - epoch_t0,
                 mean_loss=round(float(np.mean(losses)), 6)
-                if losses else None)
+                if losses else None, **extra)
             self._last_epoch_summary = summary
             if verbose:
                 print(f"epoch {epoch} done: {summary['steps']} steps in "
@@ -157,16 +407,34 @@ class Model:
                                      keep_last_n=keep_last_n)
             if eval_data is not None and epoch % eval_freq == 0:
                 self.evaluate(eval_data, batch_size=batch_size,
-                              verbose=verbose)
+                              verbose=verbose, compiled=compiled)
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
-                 num_workers=0, callbacks=None):
+                 num_workers=0, callbacks=None, compiled=True):
         loader = eval_data if isinstance(eval_data, DataLoader) else \
-            DataLoader(eval_data, batch_size=batch_size)
+            DataLoader(eval_data, batch_size=batch_size,
+                       num_workers=num_workers)
         losses = []
-        for batch in loader:
-            *xs, y = batch if isinstance(batch, (list, tuple)) else (batch,)
-            losses.append(self.eval_batch(xs, y)[0])
+        if compiled:
+            step_fn = self._static_eval_step()
+            in_flight = (self._fit_pipeline
+                         or {"steps_in_flight": 2})["steps_in_flight"]
+            pending = []
+            for batch in loader:
+                batch = batch if isinstance(batch, (list, tuple)) \
+                    else (batch,)
+                pending.append(step_fn(*batch))
+                if len(pending) > in_flight:
+                    # same backpressure as fit: bound the device queue
+                    # by the READINESS of the step in_flight back —
+                    # values still resolve only once at the end
+                    _trace.block_on(pending[-in_flight - 1]._data)
+            losses = [float(np.asarray(t._data)) for t in pending]
+        else:
+            for batch in loader:
+                *xs, y = batch if isinstance(batch, (list, tuple)) \
+                    else (batch,)
+                losses.append(self.eval_batch(xs, y)[0])
         result = {"loss": [float(np.mean(losses))]}
         if verbose:
             print(f"Eval loss: {result['loss'][0]:.5f}")

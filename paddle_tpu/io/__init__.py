@@ -35,7 +35,8 @@ __all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
            "ChainDataset", "ConcatDataset", "Subset", "random_split",
            "Sampler", "SequenceSampler", "RandomSampler", "BatchSampler",
            "WeightedRandomSampler", "DistributedBatchSampler", "DataLoader",
-           "get_worker_info", "default_collate_fn", "default_convert_fn"]
+           "get_worker_info", "default_collate_fn", "default_convert_fn",
+           "DevicePrefetcher", "device_prefetch"]
 
 
 class Dataset:
@@ -353,7 +354,31 @@ def get_worker_info():
 # ---- subprocess workers ----------------------------------------------------
 
 class _WorkersDied(RuntimeError):
-    """All subprocess workers exited without reporting a result."""
+    """Subprocess worker(s) exited without reporting a result.
+
+    Carries WHICH worker died first, its exit code, and the last
+    traceback any worker managed to forward before dying — a
+    one-worker OOM (SIGKILL, exit code -9) must surface as exactly
+    that, not stall the epoch or read as an all-workers mystery."""
+
+    def __init__(self, dead=(), last_tb=None, all_dead=False):
+        self.dead = list(dead)            # [(worker_id, exitcode)]
+        self.last_tb = last_tb
+        self.all_dead = bool(all_dead)
+        wid, code = (self.dead[0] if self.dead else (None, None))
+        self.worker_id = wid
+        self.exitcode = code
+        msg = (f"DataLoader worker {wid} exited unexpectedly "
+               f"(exit code {code}"
+               + (", likely killed — e.g. OOM" if isinstance(code, int)
+                  and code < 0 else "") + ")"
+               if self.dead else
+               "DataLoader subprocess workers exited unexpectedly")
+        if len(self.dead) > 1:
+            msg += f"; {len(self.dead)} workers dead: {self.dead}"
+        if last_tb:
+            msg += f"\nlast worker traceback:\n{last_tb}"
+        super().__init__(msg)
 
 
 def _encode_for_ipc(obj):
@@ -391,27 +416,37 @@ def _mp_worker_loop(dataset, index_q, result_q, user_collate, wid,
         pass
     import traceback
 
-    _worker_info.info = _WorkerInfo(wid, num_workers, dataset)
-    if worker_init_fn is not None:
-        worker_init_fn(wid)
-    collate = user_collate if user_collate is not None else _np_collate
-    while True:
-        job = index_q.get()
-        if job is None:
-            return
-        epoch, bidx, indices = job
-        try:
-            out = collate([dataset[i] for i in indices])
-            if user_collate is not None:
-                out = _encode_for_ipc(out)
-            result_q.put((epoch, bidx, True, out))
-        except Exception as e:  # noqa: BLE001 — forwarded to the trainer
+    try:
+        _worker_info.info = _WorkerInfo(wid, num_workers, dataset)
+        if worker_init_fn is not None:
+            worker_init_fn(wid)
+        collate = user_collate if user_collate is not None else _np_collate
+        while True:
+            job = index_q.get()
+            if job is None:
+                return
+            epoch, bidx, indices = job
             try:
-                pickle.dumps(e)
-                payload = (e, traceback.format_exc())
-            except Exception:
-                payload = (None, traceback.format_exc())
-            result_q.put((epoch, bidx, False, payload))
+                out = collate([dataset[i] for i in indices])
+                if user_collate is not None:
+                    out = _encode_for_ipc(out)
+                result_q.put((epoch, bidx, True, out))
+            except Exception as e:  # noqa: BLE001 — forwarded to the trainer
+                try:
+                    pickle.dumps(e)
+                    payload = (e, traceback.format_exc())
+                except Exception:
+                    payload = (None, traceback.format_exc())
+                result_q.put((epoch, bidx, False, payload))
+    except BaseException:  # noqa: BLE001 — loop-level crash (init,
+        # queue plumbing, KeyboardInterrupt): forward the traceback so
+        # the trainer can attribute the death, then let the process die
+        try:
+            result_q.put(("__worker_crash__", wid,
+                          traceback.format_exc()))
+        except Exception:
+            pass
+        raise
 
 
 class _SpawnPool:
@@ -423,6 +458,7 @@ class _SpawnPool:
         self.index_q = ctx.Queue()
         self.result_q = ctx.Queue()
         self.workers = []
+        self.last_crash_tb = None   # most recent forwarded crash tb
         # children inherit the environment at start(): pin them to CPU jax
         # from interpreter startup (before any unpickling can touch jax)
         prev = os.environ.get("JAX_PLATFORMS")
@@ -448,6 +484,11 @@ class _SpawnPool:
     def alive(self):
         return all(p.is_alive() for p in self.workers)
 
+    def dead(self):
+        """[(worker_id, exitcode)] for workers that have exited."""
+        return [(wid, p.exitcode) for wid, p in enumerate(self.workers)
+                if not p.is_alive()]
+
     def shutdown(self):
         for _ in self.workers:
             try:
@@ -466,13 +507,147 @@ class _SpawnPool:
                 pass
 
 
+class _PrefetchFailure:
+    """Producer-thread exception in flight to the consumer."""
+
+    __slots__ = ("exc", "tb")
+
+    def __init__(self, exc, tb):
+        self.exc = exc
+        self.tb = tb
+
+
+class DevicePrefetcher:
+    """Background device-placement stage for the training hot path.
+
+    Wraps any batch iterator: a daemon thread pulls batches AHEAD of
+    the consumer (double-buffered, bounded by ``depth``) and places
+    every array leaf on device with ``jax.device_put`` — so host-side
+    dataset work, collation and the H2D copy of batch k+1..k+depth
+    overlap the consumer's step k. ``sharding`` (any
+    ``jax.sharding.Sharding``, e.g. a ``NamedSharding`` over a ``dp``
+    mesh axis) makes placement sharding-aware: each GLOBAL batch lands
+    split across the mesh directly from host memory, no host-side
+    gather and no per-device python loop; ``None`` places on the
+    default device.
+
+    Overlap accounting (the profiler's ``input_wait_ms`` gauge):
+    ``input_wait_s`` accumulates only the time the CONSUMER blocked in
+    ``__next__`` — 0 means the pipeline was never the bottleneck;
+    ``h2d_bytes`` counts bytes placed; ``batches`` batches delivered.
+    """
+
+    _END = object()
+
+    def __init__(self, it, depth: int = 2, sharding=None):
+        self.depth = max(int(depth), 1)
+        self.sharding = sharding
+        self.input_wait_s = 0.0
+        self.h2d_bytes = 0
+        self.batches = 0
+        self._q: queue.Queue = queue.Queue(maxsize=self.depth)
+        self._stop = threading.Event()
+        self._done = False
+        self._thread = threading.Thread(
+            target=self._produce, args=(iter(it),), daemon=True)
+        self._thread.start()
+
+    # -- producer side -----------------------------------------------------
+
+    def _place_leaf(self, data):
+        import jax
+        if self.sharding is not None:
+            placed = jax.device_put(data, self.sharding)
+        else:
+            placed = jax.device_put(data)
+        self.h2d_bytes += int(getattr(placed, "nbytes", 0) or 0)
+        return placed
+
+    def _place(self, obj):
+        if isinstance(obj, Tensor):
+            return Tensor(self._place_leaf(obj._data))
+        if isinstance(obj, np.ndarray):
+            return Tensor(self._place_leaf(obj))
+        if isinstance(obj, dict):
+            return {k: self._place(v) for k, v in obj.items()}
+        if isinstance(obj, tuple) and hasattr(obj, "_fields"):
+            return type(obj)(*(self._place(v) for v in obj))
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(self._place(v) for v in obj)
+        return obj
+
+    def _offer(self, item) -> bool:
+        """Bounded put that stays responsive to close(); False when the
+        consumer went away."""
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def _produce(self, it):
+        try:
+            for b in it:
+                if self._stop.is_set():
+                    return
+                if not self._offer(self._place(b)):
+                    return
+            self._offer(self._END)
+        except BaseException as e:  # noqa: BLE001 — forwarded to consumer
+            import traceback
+            self._offer(_PrefetchFailure(e, traceback.format_exc()))
+
+    # -- consumer side -----------------------------------------------------
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._done:
+            # exhausted iterators must KEEP raising StopIteration — a
+            # blind q.get() here would block forever (producer gone)
+            raise StopIteration
+        t0 = time.perf_counter()
+        item = self._q.get()
+        self.input_wait_s += time.perf_counter() - t0
+        if item is self._END:
+            self._done = True
+            raise StopIteration
+        if isinstance(item, _PrefetchFailure):
+            self._done = True
+            raise item.exc from RuntimeError(
+                f"DevicePrefetcher producer failed:\n{item.tb}")
+        self.batches += 1
+        return item
+
+    def close(self):
+        self._stop.set()
+        self._done = True   # a closed iterator must raise, not block
+        # unblock a producer stuck on a full queue
+        try:
+            self._q.get_nowait()
+        except queue.Empty:
+            pass
+
+    def __del__(self):
+        self.close()
+
+
+def device_prefetch(it, depth: int = 2, sharding=None) -> DevicePrefetcher:
+    """Wrap ``it`` in a :class:`DevicePrefetcher` (see its docstring)."""
+    return DevicePrefetcher(it, depth=depth, sharding=sharding)
+
+
 class DataLoader:
     def __init__(self, dataset, feed_list=None, places=None,
                  return_list=True, batch_sampler=None, batch_size=1,
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=True, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, prefetch_to_device=None,
+                 device_sharding=None):
         self.dataset = dataset
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
@@ -480,6 +655,11 @@ class DataLoader:
         self.worker_init_fn = worker_init_fn
         self.timeout = timeout
         self.persistent_workers = persistent_workers
+        # device-prefetch stage (DevicePrefetcher): depth of batches
+        # placed on device ahead of the consumer; device_sharding is a
+        # jax Sharding for DP-sharded global-batch placement
+        self.prefetch_to_device = prefetch_to_device
+        self.device_sharding = device_sharding
         self._pool: _SpawnPool | None = None
         self._pool_active = False  # persistent pool owned by a live iter
         self._pool_owner = None    # weakref to the owning iterator
@@ -535,6 +715,13 @@ class DataLoader:
             yield self.collate_fn([self.dataset[i] for i in indices])
 
     def __iter__(self):
+        it = self._make_iter()
+        if self.prefetch_to_device:
+            it = DevicePrefetcher(it, depth=self.prefetch_to_device,
+                                  sharding=self.device_sharding)
+        return it
+
+    def _make_iter(self):
         if self.num_workers <= 0 or self._unbatched:
             # unbatched pass-through is pure conversion — worker
             # processes would only add transport cost
@@ -660,16 +847,35 @@ class DataLoader:
                     continue
                 try:
                     ep, bidx, ok, payload = self._result_get(pool)
-                except _WorkersDied:
+                except _WorkersDied as wd:
                     if next_yield == 0 and not buf:
-                        # children died before producing anything (e.g.
-                        # the dataset failed to unpickle in the fresh
-                        # interpreter) — the thread pool can still serve
-                        fall_back = True
-                        break
-                    raise RuntimeError(
-                        "DataLoader subprocess workers exited "
-                        "unexpectedly mid-epoch") from None
+                        # death before ANY result. All-dead means the
+                        # dataset failed to unpickle in the fresh
+                        # interpreter — the thread pool can still
+                        # serve. Bootstrap crashes land staggered, so
+                        # give the remaining children a moment to
+                        # finish dying before deciding all-dead
+                        # (fallback) vs genuinely partial (a hard
+                        # error carrying the worker's exit code — a
+                        # one-worker OOM must never re-run its killer
+                        # item in the trainer process).
+                        deadline = time.time() + 2.0
+                        while (len(pool.dead()) < len(pool.workers)
+                               and time.time() < deadline):
+                            time.sleep(0.05)
+                        codes = [c for _, c in pool.dead()]
+                        if len(codes) == len(pool.workers) and \
+                                all(c == 1 for c in codes):
+                            # uniform exit-1 = a python exception in
+                            # the spawn bootstrap (unpickle/init), the
+                            # one shape the thread pool can safely
+                            # retry in-process. Signal kills (OOM) or
+                            # explicit exit codes mean an ITEM killed
+                            # the worker — retrying it in the trainer
+                            # would kill the trainer.
+                            fall_back = True
+                            break
+                    raise wd from None
                 if ep != epoch:   # stale result from an abandoned epoch
                     continue
                 if not ok:
@@ -698,14 +904,36 @@ class DataLoader:
         deadline = time.time() + self.timeout if self.timeout else None
         while True:
             try:
-                return pool.result_q.get(timeout=1.0)
+                item = pool.result_q.get(timeout=1.0)
             except queue.Empty:
-                if not pool.alive():
-                    raise _WorkersDied() from None
+                dead = pool.dead()
+                if dead:
+                    # drain in-flight crash notices first so the error
+                    # carries the dying worker's own traceback (the
+                    # ordered reassembly is moot — we are raising)
+                    try:
+                        while True:
+                            it2 = pool.result_q.get_nowait()
+                            if isinstance(it2, tuple) and len(it2) == 3 \
+                                    and it2[0] == "__worker_crash__":
+                                pool.last_crash_tb = it2[2]
+                    except queue.Empty:
+                        pass
+                    raise _WorkersDied(
+                        dead, getattr(pool, "last_crash_tb", None),
+                        all_dead=len(dead) == len(pool.workers)) from None
                 if deadline is not None and time.time() > deadline:
                     raise RuntimeError(
                         f"DataLoader timed out after {self.timeout}s "
                         "waiting for a worker batch") from None
+                continue
+            if isinstance(item, tuple) and len(item) == 3 \
+                    and item[0] == "__worker_crash__":
+                # remember the traceback; the death itself is detected
+                # (with exit code) once the queue runs dry
+                pool.last_crash_tb = item[2]
+                continue
+            return item
 
     def _iter_pool(self):
         """Map-style path: num_workers threads load batches concurrently

@@ -161,6 +161,11 @@ class StaticFunction:
         self._donate = donate_state
         self._enabled = not getattr(function,
                                     "_paddle_tpu_not_to_static", False)
+        # run-mode telemetry (hapi fit attribution + tests): how many
+        # calls executed as the compiled program vs python (discovery
+        # runs and eager fallbacks both count as eager host work)
+        self.n_compiled_runs = 0
+        self.n_eager_runs = 0
 
     # descriptor protocol so @to_static works on Layer methods; the bound
     # copy is cached per instance (each instance has its own parameters ⇒
@@ -195,18 +200,22 @@ class StaticFunction:
 
     def __call__(self, *args, **kwargs):
         if not self._enabled or not StaticFunction._globally_enabled:
+            self.n_eager_runs += 1
             return self._call_fn(*args, **kwargs)
         leaves: list = []
         spec = _tree_flatten((args, kwargs), leaves)
         sig = _signature_key(leaves)
         if sig in self._fallback_sigs:
+            self.n_eager_runs += 1
             return self._call_segmented(sig, args, kwargs)
         entry = self._graphs.get(sig)
         if entry is None or entry.latest_key is None:
+            self.n_eager_runs += 1
             return self._discover(sig, spec, leaves, args, kwargs)
         graph = entry.by_key[entry.latest_key]
         try:
             result = self._run_compiled(graph, leaves)
+            self.n_compiled_runs += 1
             entry.mispredicts = 0   # guard-hit run: healthy specialization
             return result
         except _GuardMismatch:
@@ -219,9 +228,11 @@ class StaticFunction:
                     "eager for this signature")
                 self._fallback_sigs.add(sig)
                 self._graphs.pop(sig, None)
+                self.n_eager_runs += 1
                 return self._call_fn(*args, **kwargs)
             # the discarded run committed nothing; re-run eagerly (correct
             # for the new branch pattern) and re-specialize
+            self.n_eager_runs += 1
             return self._discover(sig, spec, leaves, args, kwargs)
         except _TRACE_ERRORS as e:
             warnings.warn(
@@ -231,6 +242,7 @@ class StaticFunction:
                 "for this signature")
             self._fallback_sigs.add(sig)
             self._graphs.pop(sig, None)
+            self.n_eager_runs += 1
             return self._call_fn(*args, **kwargs)
 
     # ---- broken signatures: compile AROUND the break ---------------------
@@ -464,19 +476,27 @@ class StaticFunction:
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
-              backend=None, full_graph=False, **kwargs):
+              backend=None, full_graph=False, donate_state=False,
+              **kwargs):
     """Decorator/wrapper converting an imperative function or a Layer into a
-    compiled whole-program (paddle.jit.to_static parity)."""
+    compiled whole-program (paddle.jit.to_static parity).
+
+    ``donate_state=True`` donates the captured persistable state buffers
+    (params, optimizer slots) to the compiled program — XLA aliases the
+    updated state into the input buffers instead of allocating a fresh
+    copy per step. Only guard-free graphs donate (a guarded run must be
+    discardable); the flag is a no-op otherwise."""
 
     def decorate(fn):
         from ..nn.layer.layers import Layer
         if isinstance(fn, Layer):
-            static_fwd = StaticFunction(type(fn).forward, input_spec)
+            static_fwd = StaticFunction(type(fn).forward, input_spec,
+                                        donate_state=donate_state)
             static_fwd._instance = fn
             fn.forward = static_fwd
             return fn
         return StaticFunction(fn, input_spec, build_strategy, backend,
-                              full_graph)
+                              full_graph, donate_state=donate_state)
     if function is not None:
         return decorate(function)
     return decorate

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..framework.core import Tensor, Parameter, no_grad, is_floating
 from .lr import LRScheduler
@@ -43,7 +44,15 @@ class Optimizer:
         self._multi_precision = multi_precision
         self._accumulators: dict[str, dict[int, Tensor]] = {}
         self._master_weights: dict[int, Tensor] = {}
-        self._step_count = 0
+        # the step counter lives in a persistable device scalar (like
+        # _lr_state below) so a to_static-compiled train step advances
+        # it INSIDE the compiled program — a python int would only tick
+        # on the discovery run and checkpoints saved after N compiled
+        # steps would record step 1. The _step_count property keeps the
+        # eager-facing int surface (state_dict "@step", tests).
+        self._step_state = Tensor(jnp.asarray(0, jnp.int32))
+        self._step_state.persistable = True
+        self._step_state.name = "@step_state"
         # checkpoint loaded before the first step(): accumulators are lazy,
         # so stash the state and apply it as they get created
         self._pending_state: dict | None = None
@@ -169,6 +178,21 @@ class Optimizer:
             self._lr_state.set_data(jnp.asarray(self.get_lr(), jnp.float32))
         return self._lr_state.jax()
 
+    @property
+    def _step_count(self) -> int:
+        st = self.__dict__.get("_step_state")
+        if st is None:     # wrapper optimizers (LookAhead) that skip
+            return self.__dict__.get("_step_count_py", 0)  # __init__
+        return int(np.asarray(st._data))
+
+    @_step_count.setter
+    def _step_count(self, value) -> None:
+        st = self.__dict__.get("_step_state")
+        if st is None:
+            self.__dict__["_step_count_py"] = int(value)
+        else:
+            st.set_data(jnp.asarray(int(value), jnp.int32))
+
     def step(self) -> None:
         with no_grad():
             pgs = [(p, g) for p, g in self._collect_params_grads()
@@ -178,7 +202,10 @@ class Optimizer:
             lr = self._lr_array()
             for p, g in pgs:
                 self._update_param(p, g, lr)
-        self._step_count += 1
+        # device-side increment, NOT the python property: inside a
+        # compiled trace this must stay a traced op (int(tracer) would
+        # be a per-step guard that mispredicts every call)
+        self._step_state.set_data(self._step_state.jax() + 1)
 
     def _update_param(self, p: Tensor, g: Tensor, lr: float) -> None:
         raise NotImplementedError

@@ -239,3 +239,61 @@ class TestSubprocessEdgeCases:
         with pytest.warns(UserWarning, match="thread pool"):
             out = [b.numpy() for b in loader]
         assert np.concatenate(out).tolist() == list(range(8))
+
+
+class _ExitingDataset(io.Dataset):
+    """One index hard-kills its worker (os._exit — the OOM-kill shape:
+    no exception, no traceback, just a dead process)."""
+
+    def __init__(self, n=16, exit_idx=0, code=7):
+        self.n = n
+        self.exit_idx = exit_idx
+        self.code = code
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if i == self.exit_idx:
+            import os
+            os._exit(self.code)
+        return np.full((2,), i, dtype="float32")
+
+
+class _SystemExitDataset(io.Dataset):
+    """One index raises SystemExit — escapes the per-job handler, so
+    the worker forwards a loop-level crash traceback before dying."""
+
+    def __init__(self, n=16):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        if i == 0:
+            raise SystemExit(5)
+        return np.full((2,), i, dtype="float32")
+
+
+class TestSingleWorkerDeath:
+    """ISSUE-5 satellite: a SINGLE dead worker (others alive) must
+    raise promptly with that worker's exit code — not stall waiting,
+    not misattribute as all-workers-died, and NEVER fall back to the
+    thread pool (which would re-run the killer item in the trainer
+    process)."""
+
+    def test_one_worker_exit_attributed_with_code(self):
+        loader = io.DataLoader(_ExitingDataset(code=7), batch_size=2,
+                               shuffle=False, num_workers=2)
+        with _pytest_mod.raises(RuntimeError, match="exit code 7"):
+            list(loader)
+
+    def test_loop_level_crash_forwards_traceback(self):
+        loader = io.DataLoader(_SystemExitDataset(), batch_size=2,
+                               shuffle=False, num_workers=2)
+        with _pytest_mod.raises(RuntimeError) as ei:
+            list(loader)
+        msg = str(ei.value)
+        assert "exit code 5" in msg
+        assert "SystemExit" in msg        # the forwarded traceback

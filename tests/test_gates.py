@@ -1,0 +1,61 @@
+"""ISSUE-5 satellite: tools/run_gates.py — the single hygiene-gate
+entry point. Fast tier: the gate RUNNER itself is covered, so the gate
+list cannot silently drift out of the builder workflow (each
+individual gate has its own deeper tests —
+test_checkpoint_hygiene.py, test_tuner.py)."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RUN_GATES = os.path.join(REPO, "tools", "run_gates.py")
+
+
+def _run(*args):
+    return subprocess.run([sys.executable, RUN_GATES, *args],
+                          capture_output=True, text=True, cwd=REPO)
+
+
+def test_known_gates_are_registered():
+    """The authoritative gate list must contain every hygiene gate the
+    repo ships — dropping one here is exactly the drift this driver
+    exists to prevent."""
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    try:
+        import run_gates
+        names = [n for n, _ in run_gates.gate_commands("x.log", 300.0,
+                                                       False)]
+    finally:
+        sys.path.pop(0)
+    assert names == ["atomic_writes", "fast_tier_budget"]
+
+
+def test_all_gates_pass_on_healthy_log(tmp_path):
+    log = tmp_path / "t1.log"
+    log.write_text("606 passed, 2 failed in 115.60s (0:01:55)\n")
+    p = _run("--log", str(log))
+    assert p.returncode == 0, p.stdout + p.stderr
+    assert "atomic_writes: PASS" in p.stdout
+    assert "fast_tier_budget: PASS" in p.stdout
+    assert "all gates passed" in p.stdout
+
+
+def test_over_budget_log_fails_the_driver(tmp_path):
+    log = tmp_path / "t1.log"
+    log.write_text("606 passed in 700.00s (0:11:40)\n")
+    p = _run("--log", str(log))
+    assert p.returncode == 1
+    assert "fast_tier_budget: FAIL" in p.stdout
+
+
+def test_missing_log_is_a_failing_gate(tmp_path):
+    p = _run("--log", str(tmp_path / "nope.log"))
+    assert p.returncode == 1     # silence must never read as clean
+
+
+def test_no_budget_skips_only_the_budget_gate(tmp_path):
+    p = _run("--no-budget", "--log", str(tmp_path / "nope.log"))
+    assert p.returncode == 0
+    assert "atomic_writes: PASS" in p.stdout
+    assert "fast_tier_budget" not in p.stdout

@@ -262,3 +262,45 @@ class TestPerfEventLog:
         msgs = [r.message for r in caplog.records]
         assert any("first" in m for m in msgs)
         assert not any("second" in m for m in msgs)
+
+
+class TestFitPipelineGaugeSchema:
+    def test_fit_gauges_in_chrome_export(self, tmp_path):
+        """ISSUE 5: the compiled fit loop's pipeline gauges
+        (input_wait_ms, steps_in_flight, h2d_bytes) must land in the
+        trace export as chrome counter events with numeric values."""
+        from paddle_tpu import nn
+        from paddle_tpu.hapi import Model
+
+        xs = np.random.RandomState(0).rand(8, 4).astype("float32")
+        ys = np.random.RandomState(1).rand(8, 1).astype("float32")
+        ds = [(xs[i], ys[i]) for i in range(8)]
+        paddle.seed(0)
+        net = nn.Linear(4, 1)
+        model = Model(net)
+        import paddle_tpu.optimizer as opt
+        model.prepare(opt.SGD(0.01, parameters=net.parameters()),
+                      lambda out, y: ((out - y) ** 2).mean())
+        tr = profiler.enable(profiler.ProfilerOptions(
+            output_dir=str(tmp_path), export_on_disable=False))
+        tr.clear()
+        try:
+            model.fit(ds, batch_size=4, epochs=1, verbose=0,
+                      compiled=True)
+        finally:
+            profiler.disable(export=False)
+        path = tr.export_chrome_trace(tmp_path / "fit.json")
+        doc = json.load(open(path))
+        counters = {e["name"]: e for e in doc["traceEvents"]
+                    if e["ph"] == "C"}
+        for gauge in ("hapi/input_wait_ms", "hapi/steps_in_flight",
+                      "hapi/h2d_bytes"):
+            assert gauge in counters, sorted(counters)
+            val = counters[gauge]["args"]["value"]
+            assert isinstance(val, (int, float)) and val >= 0
+        # the per-step span keeps its name and marks the mode
+        spans = [e for e in doc["traceEvents"]
+                 if e["ph"] == "X" and e["name"] == "hapi/train_batch"]
+        assert len(spans) == 2
+        assert all(s["args"]["mode"] == "compiled" for s in spans)
+        tr.clear()

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Run EVERY repo hygiene gate in one command.
+
+The gates existed (``check_atomic_writes.py``,
+``check_fast_tier_budget.py``) but nothing tied them together, so a
+builder workflow could invoke one and silently drift past the other —
+exactly the failure mode gates exist to prevent. This driver is the
+single entry point: it runs each gate as a subprocess, prints one
+status line per gate, and exits non-zero if ANY gate fails (an
+unrunnable gate is a failing gate — silence must never read as
+"clean"). It is itself covered by a fast-tier test
+(tests/test_gates.py), so the gate list cannot rot unnoticed.
+
+Usage::
+
+    python tools/run_gates.py                     # after the tier-1 run
+    python tools/run_gates.py --log /tmp/_t1.log --budget 300
+    python tools/run_gates.py --no-budget         # no tier-1 log yet
+
+``--no-budget`` skips the fast-tier budget gate for contexts where no
+tier-1 log exists (e.g. pre-commit on a docs change); the atomic-write
+gate always runs.
+
+Exit codes: 0 = every gate passed, 1 = at least one gate failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+
+TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def gate_commands(log: str, budget: float, no_budget: bool):
+    """The authoritative gate list: (name, argv). New hygiene gates
+    register HERE (tests/test_gates.py pins the known ones so a gate
+    cannot be dropped silently)."""
+    gates = [
+        ("atomic_writes",
+         [sys.executable, os.path.join(TOOLS_DIR,
+                                       "check_atomic_writes.py")]),
+    ]
+    if not no_budget:
+        gates.append(
+            ("fast_tier_budget",
+             [sys.executable,
+              os.path.join(TOOLS_DIR, "check_fast_tier_budget.py"),
+              "--log", log, "--budget", str(budget)]))
+    return gates
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="run all repo hygiene gates; fail if any fails")
+    ap.add_argument("--log", default="/tmp/_t1.log",
+                    help="tier-1 pytest log for the fast-tier budget "
+                         "gate (default /tmp/_t1.log)")
+    ap.add_argument("--budget", type=float, default=300.0,
+                    help="fast-tier wall-time budget in seconds "
+                         "(default 300)")
+    ap.add_argument("--no-budget", action="store_true",
+                    help="skip the fast-tier budget gate (no tier-1 "
+                         "log in this context)")
+    args = ap.parse_args(argv)
+
+    failures = 0
+    for name, cmd in gate_commands(args.log, args.budget,
+                                   args.no_budget):
+        try:
+            proc = subprocess.run(cmd, capture_output=True, text=True)
+            rc = proc.returncode
+            tail = (proc.stdout + proc.stderr).strip().splitlines()
+        except Exception as e:  # noqa: BLE001 — unrunnable == failing
+            rc, tail = 1, [f"{type(e).__name__}: {e}"]
+        status = "PASS" if rc == 0 else f"FAIL (rc={rc})"
+        print(f"[gate] {name}: {status}")
+        if rc != 0:
+            failures += 1
+            for line in tail[-20:]:
+                print(f"    {line}")
+    if failures:
+        print(f"[gate] {failures} gate(s) failed")
+        return 1
+    print("[gate] all gates passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
