@@ -4,11 +4,17 @@ from .save_load import (
     gc_checkpoints, load_values, read_state_dict,
     CheckpointCorruptError, CheckpointNotCommittedError,
     COMMITTED_SENTINEL)
-from .metadata import Metadata, LocalTensorMetadata
+from .validation import shards_intact
+from .metadata import Metadata, LocalTensorMetadata, MeshTopology, \
+    placement_of
+from .reshard import (assemble_slice, reshard_to_sharding,
+                      checkpoint_topology, overlapping_shards)
 
 __all__ = ["save_state_dict", "load_state_dict", "wait_async_save",
            "latest_valid_checkpoint", "validate_checkpoint",
            "is_committed", "gc_checkpoints", "load_values",
            "read_state_dict", "CheckpointCorruptError",
            "CheckpointNotCommittedError", "COMMITTED_SENTINEL",
-           "Metadata", "LocalTensorMetadata"]
+           "Metadata", "LocalTensorMetadata", "MeshTopology",
+           "placement_of", "assemble_slice", "reshard_to_sharding",
+           "checkpoint_topology", "overlapping_shards", "shards_intact"]

@@ -1,0 +1,181 @@
+"""Cross-mesh checkpoint resharding — assemble exactly the slices the
+*loading* mesh needs from whatever shards the *saving* mesh wrote.
+
+The save path records each shard's global offset + local shape (and,
+topology-aware since the elastic PR, the saving mesh + per-tensor
+placements); this module is the load-side inverse. The naive path —
+assemble the full global tensor on host, then ``device_put`` it with
+the target sharding — breaks down twice in production:
+
+- **memory**: a resize-on-preemption resume materializes every global
+  tensor on every host, which for a model sharded precisely because it
+  does not fit is the one thing the loader must not do;
+- **multi-process**: ``device_put`` of a host-global array onto a
+  sharding with non-addressable devices does not work — each process
+  may only construct the shards it can address.
+
+So :func:`reshard_to_sharding` walks the target sharding's addressable
+devices, computes each device's global index box, reads ONLY the saved
+shards overlapping that box (:func:`assemble_slice`), verifies their
+recorded SHA-256, and builds the array with
+``jax.make_array_from_single_device_arrays`` — the global tensor is
+never materialized and non-overlapping shard files are never read.
+dp/mp resize works in both directions (save@dp=4 → resume@dp=2 or
+dp=8): a coarser target reads several saved shards per device, a finer
+one reads a sub-slice of a single shard.
+
+Incomplete coverage (a missing rank's shards — some ranks committed,
+others not) is a :class:`CheckpointCorruptError`, never a silent
+zero-fill."""
+
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as np
+
+from .metadata import NONNATIVE_DTYPES
+from .validation import (CheckpointCorruptError, _read_file, _read_metas,
+                         _sha256, validate_checkpoint)
+
+__all__ = ["assemble_slice", "reshard_to_sharding",
+           "checkpoint_topology", "overlapping_shards"]
+
+
+def _np_dtype(dtype_str):
+    """np dtype for a stored dtype string; ml_dtypes names (bfloat16,
+    fp8) resolve through ml_dtypes."""
+    try:
+        return np.dtype(dtype_str)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, dtype_str))
+
+
+def _load_shard(path, sh, dtype_str, validate, cache):
+    """One shard file as a np array, checksum-verified at most once per
+    reshard call (``cache`` maps file -> verified array: many target
+    devices typically slice the same source shard)."""
+    fname = sh["file"]
+    arr = cache.get(fname) if cache is not None else None
+    if arr is not None:
+        return arr
+    try:
+        blob = _read_file(os.path.join(path, fname))
+    except FileNotFoundError:
+        raise CheckpointCorruptError(
+            f"{path}/{fname}: shard file missing — a rank's shards "
+            f"never landed (partial save) or were deleted; refusing "
+            f"the torn checkpoint")
+    expect = sh.get("sha256")
+    if validate and expect:
+        actual = _sha256(blob)
+        if actual != expect:
+            raise CheckpointCorruptError(
+                f"{path}/{fname}: shard checksum mismatch (expected "
+                f"sha256 {expect}, got {actual}) — refusing to load "
+                f"corrupt data")
+    arr = np.load(io.BytesIO(blob))
+    if dtype_str in NONNATIVE_DTYPES:
+        arr = arr.view(_np_dtype(dtype_str))
+    if cache is not None:
+        cache[fname] = arr
+    return arr
+
+
+def overlapping_shards(entry, starts, stops):
+    """The saved shards intersecting the global box [starts, stops),
+    as (shard_meta, src_slices, dst_slices) triples — src indexes the
+    shard file's array, dst indexes the assembled output box."""
+    out = []
+    for sh in entry["shards"]:
+        off = sh["offset"]
+        loc = sh["local_shape"]
+        src, dst = [], []
+        empty = False
+        for d, (a, b) in enumerate(zip(starts, stops)):
+            lo = max(a, off[d])
+            hi = min(b, off[d] + loc[d])
+            if hi <= lo:
+                empty = True
+                break
+            src.append(slice(lo - off[d], hi - off[d]))
+            dst.append(slice(lo - a, hi - a))
+        if not empty:
+            out.append((sh, tuple(src), tuple(dst)))
+    return out
+
+
+def assemble_slice(entry, path, starts, stops, validate=True, cache=None):
+    """Assemble the global box [starts, stops) of one tensor entry from
+    the shard files that overlap it — non-overlapping files are never
+    opened. Raises :class:`CheckpointCorruptError` if the saved shards
+    do not cover the requested box (the some-ranks-committed torn
+    shape)."""
+    shape = tuple(int(b - a) for a, b in zip(starts, stops))
+    out = np.zeros(shape, dtype=_np_dtype(entry["dtype"]))
+    covered = 0
+    total = int(np.prod(shape)) if shape else 1
+    for sh, src, dst in overlapping_shards(entry, starts, stops):
+        data = _load_shard(path, sh, entry["dtype"], validate, cache)
+        out[dst] = data[src]
+        covered += int(np.prod([s.stop - s.start for s in dst])) \
+            if dst else 1
+    # shards are non-overlapping tiles of the global array (replicated
+    # copies dedupe at metadata-merge time), so clipped volumes sum to
+    # the box volume exactly when coverage is complete
+    if covered < total:
+        raise CheckpointCorruptError(
+            f"{path}: shards cover only {covered}/{total} elements of "
+            f"the requested slice of a {entry['global_shape']} tensor "
+            f"— a rank's shards are missing (torn multi-rank save); "
+            f"refusing the partial state")
+    return out
+
+
+def _norm_box(idx, shape):
+    starts = tuple(0 if s.start is None else int(s.start) for s in idx)
+    stops = tuple(shape[d] if s.stop is None else int(s.stop)
+                  for d, s in enumerate(idx))
+    return starts, stops
+
+
+def reshard_to_sharding(entry, path, sharding, cast_dtype=None,
+                        validate=True):
+    """Lay one saved tensor out for ``sharding`` (the LOADING mesh),
+    reading only the slices this process's devices need. Returns a
+    committed ``jax.Array`` with exactly ``sharding``."""
+    import jax
+    import jax.numpy as jnp
+
+    shape = tuple(entry["global_shape"])
+    cache: dict = {}
+    arrays = []
+    for dev, idx in sharding.addressable_devices_indices_map(
+            shape).items():
+        starts, stops = _norm_box(idx, shape)
+        sl = assemble_slice(entry, path, starts, stops,
+                            validate=validate, cache=cache)
+        piece = jnp.asarray(sl)
+        if cast_dtype is not None:
+            piece = piece.astype(cast_dtype)
+        arrays.append(jax.device_put(piece, dev))
+    return jax.make_array_from_single_device_arrays(
+        shape, sharding, arrays)
+
+
+def checkpoint_topology(path, validate=True):
+    """What topology a checkpoint was saved under: the sentinel's
+    ``topology`` block (process/device counts, meshes) plus each
+    tensor's recorded placement descriptor. Launchers and tools use
+    this to report same-topology vs cross-mesh resumes; the loader
+    itself reshards to the target sharding regardless."""
+    sentinel = validate_checkpoint(path) if validate else {}
+    placements = {}
+    for name, entry in _read_metas(path).items():
+        if entry.get("kind") == "tensor":
+            placements[name] = entry.get("placement")
+    return {"world_size": sentinel.get("world_size"),
+            "topology": sentinel.get("topology"),
+            "placements": placements}

@@ -145,22 +145,15 @@ def _atomic_write(path, data):
 def _np_bytes(arr):
     """npy-serialize to bytes; non-native dtypes (bfloat16, fp8) are
     stored as byte-width integer views — numpy's npy format cannot
-    round-trip ml_dtypes."""
+    round-trip ml_dtypes. The read-side inverse is
+    :func:`.reshard._load_shard` (the one shard reader)."""
+    from .metadata import NONNATIVE_DTYPES
     arr = np.asarray(arr)
-    if arr.dtype.kind == "V" or str(arr.dtype) in (
-            "bfloat16", "float8_e4m3fn", "float8_e5m2"):
+    if arr.dtype.kind == "V" or str(arr.dtype) in NONNATIVE_DTYPES:
         arr = arr.view(np.uint16 if arr.dtype.itemsize == 2 else np.uint8)
     buf = io.BytesIO()
     np.save(buf, arr)
     return buf.getvalue()
-
-
-def _np_from_bytes(data, dtype_str):
-    arr = np.load(io.BytesIO(data))
-    if dtype_str in ("bfloat16", "float8_e4m3fn", "float8_e5m2"):
-        import ml_dtypes
-        arr = arr.view(np.dtype(getattr(ml_dtypes, dtype_str)))
-    return arr
 
 
 # --------------------------------------------------------------------------
@@ -190,21 +183,26 @@ def wait_async_save():
 
 def _snapshot(state_dict):
     """Snapshot device arrays to host numpy (shared by sync and async
-    save, so the writer never touches device state)."""
+    save, so the writer never touches device state). Each tensor also
+    records its placement descriptor (saving mesh + partition spec) —
+    sharding specs are data, and a resized fleet reshards from them at
+    load."""
+    from .metadata import placement_of
     host = {}
     for name, t in _flat(state_dict).items():
         if not isinstance(t, Tensor):
-            host[name] = ("value", None, None, t)
+            host[name] = ("value", None, None, t, None)
             continue
         arr = t._data
+        placement = placement_of(arr)
         if isinstance(arr, jax.Array) and len(arr.sharding.device_set) > 1:
             shards = [(s.index, np.asarray(s.data))
                       for s in arr.addressable_shards]
             host[name] = ("sharded", tuple(arr.shape), str(arr.dtype),
-                          shards)
+                          shards, placement)
         else:
             host[name] = ("full", tuple(arr.shape), str(arr.dtype),
-                          np.asarray(arr))
+                          np.asarray(arr), placement)
     return host
 
 
@@ -285,7 +283,7 @@ def _write_rank_files(host, stage, rank):
     """Write this rank's shards + metadata into the staging dir;
     returns the metadata file's path."""
     meta = {}
-    for name, (kind, shape, dtype, payload) in host.items():
+    for name, (kind, shape, dtype, payload, placement) in host.items():
         safe = name.replace("/", "_")
         if kind == "value":
             meta[name] = {"kind": "value", "value": payload}
@@ -316,17 +314,21 @@ def _write_rank_files(host, stage, rank):
                            "nbytes": len(blob)})
         meta[name] = {"kind": "tensor", "global_shape": list(shape),
                       "dtype": dtype, "shards": shards}
+        if placement is not None:
+            meta[name]["placement"] = placement
     mpath = os.path.join(stage, f"meta.{rank}.json")
     _atomic_write(mpath, json.dumps(meta).encode())
     return mpath
 
 
-def _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n):
+def _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n,
+                      barrier_timeout=None):
     final = os.path.normpath(path)
     stage = f"{final}.tmp-{uid}"
     rank = jax.process_index()
     world = jax.process_count()
-    timeout = _barrier_timeout()
+    timeout = _barrier_timeout() if barrier_timeout is None \
+        else float(barrier_timeout)
     _active_stages.add(stage)
     try:
         if world <= 1:
@@ -375,8 +377,17 @@ def _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n):
             mname = f"meta.{r}.json"
             meta_shas[mname] = _sha256(
                 _read_file(os.path.join(stage, mname)))
+        meshes = []
+        for (_kind, _shape, _dtype, _payload, placement) in host.values():
+            if placement:
+                key = [placement["mesh_shape"], placement["mesh_axes"]]
+                if key not in meshes:
+                    meshes.append(key)
         sentinel = {"format": _FORMAT_VERSION, "world_size": world,
-                    "metas": meta_shas}
+                    "metas": meta_shas,
+                    "topology": {"process_count": world,
+                                 "device_count": jax.device_count(),
+                                 "meshes": meshes}}
         _atomic_write(os.path.join(stage, COMMITTED_SENTINEL),
                       json.dumps(sentinel).encode())
         _fsync_dir(stage)
@@ -400,16 +411,18 @@ def _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n):
     return final
 
 
-def _write_async(host, path, coordinator_rank, uid, keep_last_n):
+def _write_async(host, path, coordinator_rank, uid, keep_last_n,
+                 barrier_timeout=None):
     try:
-        _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n)
+        _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n,
+                          barrier_timeout=barrier_timeout)
     except BaseException as e:  # noqa: BLE001 — re-raised at the join
         _async_errors.append(e)
 
 
 def save_state_dict(state_dict, path, process_group=None,
                     coordinator_rank=0, unique_id=None, async_save=False,
-                    keep_last_n=None):
+                    keep_last_n=None, barrier_timeout=None):
     """Crash-safe sharded save (module docstring has the full
     protocol). Each rank writes the shards it owns + a checksummed
     metadata json into a staging dir; the coordinator rank barriers on
@@ -424,7 +437,10 @@ def save_state_dict(state_dict, path, process_group=None,
     thread (the PaddleNLP unified-checkpoint async pattern) — failures
     re-raise from ``wait_async_save`` or the next save call.
     ``keep_last_n`` garbage-collects older committed ``step_N``
-    siblings (and stale staging dirs) after commit."""
+    siblings (and stale staging dirs) after commit. ``barrier_timeout``
+    overrides the commit-barrier timeout for this save only — the
+    bounded-time emergency-checkpoint path (a preempted worker has a
+    grace window, not 300 s)."""
     _raise_pending_async_error()
     host = _snapshot(state_dict)
     if unique_id is not None:
@@ -436,12 +452,14 @@ def save_state_dict(state_dict, path, process_group=None,
     if async_save:
         th = threading.Thread(
             target=_write_async,
-            args=(host, path, coordinator_rank, uid, keep_last_n),
+            args=(host, path, coordinator_rank, uid, keep_last_n,
+                  barrier_timeout),
             daemon=False)
         th.start()
         _async_threads.append(th)
         return
-    _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n)
+    _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n,
+                      barrier_timeout=barrier_timeout)
 
 
 # --------------------------------------------------------------------------
@@ -449,56 +467,70 @@ def save_state_dict(state_dict, path, process_group=None,
 # --------------------------------------------------------------------------
 
 def _assemble(entry, path, name, validate=True):
+    """Full global tensor as a jnp array — the whole-box case of the
+    slice-exact reshard assembler, so checksum verification, missing-
+    shard detection, and coverage refusal live in ONE place
+    (:func:`.reshard.assemble_slice`)."""
+    from .reshard import assemble_slice
     shape = tuple(entry["global_shape"])
-    dtype = entry["dtype"]
-    out = np.zeros(shape, dtype=np.dtype(dtype))
-    for sh in entry["shards"]:
-        blob = _read_file(os.path.join(path, sh["file"]))
-        expect = sh.get("sha256")
-        if validate and expect:
-            actual = _sha256(blob)
-            if actual != expect:
-                raise CheckpointCorruptError(
-                    f"{path}/{sh['file']} (tensor {name}): shard "
-                    f"checksum mismatch (expected sha256 {expect}, got "
-                    f"{actual}) — refusing to load corrupt data")
-        data = _np_from_bytes(blob, dtype)
-        idx = tuple(slice(o, o + l) for o, l in
-                    zip(sh["offset"], sh["local_shape"]))
-        out[idx] = data
+    try:
+        out = assemble_slice(entry, path, (0,) * len(shape), shape,
+                             validate=validate)
+    except CheckpointCorruptError as e:
+        raise CheckpointCorruptError(f"tensor {name}: {e}")
     return jnp.asarray(out)
 
 
 def load_state_dict(state_dict, path, process_group=None,
                     unique_id=None, offload=False, validate=True):
     """In-place load into ``state_dict``'s tensors, resharding to each
-    target tensor's current sharding. With ``validate=True`` (default)
-    the checkpoint must be committed and every byte read is verified
-    against its recorded SHA-256: the result is bit-exact or an
-    exception — never a silent partial load. ``validate=False`` skips
-    both checks for legacy (pre-sentinel) checkpoint dirs."""
+    target tensor's current sharding. A sharded target goes through
+    the slice-exact reshard path (:mod:`.reshard`): only the shards
+    overlapping this process's addressable devices are read, so a
+    cross-mesh resume (dp/mp resized in either direction) never
+    materializes the global tensor and works when not every device is
+    addressable. With ``validate=True`` (default) the checkpoint must
+    be committed and every byte read is verified against its recorded
+    SHA-256: the result is bit-exact or an exception — never a silent
+    partial load. ``validate=False`` skips both checks for legacy
+    (pre-sentinel) checkpoint dirs."""
     if validate:
         validate_checkpoint(path)
     metas = _read_metas(path)
     flat = _flat(state_dict)
+    n_resharded = 0
+    t0 = time.perf_counter()
     for name, t in flat.items():
         entry = metas.get(name)
         if entry is None:
             continue
         if entry["kind"] == "value":
             continue
-        arr = _assemble(entry, path, name, validate=validate)
         if isinstance(t, Tensor):
             if isinstance(t._data, jax.Array) and \
                     len(t._data.sharding.device_set) > 1:
-                # sharded target: reshard the assembled global array onto
+                # sharded target: assemble exactly the slices the
+                # loading mesh's addressable devices need, directly in
                 # the target's (possibly different-mesh) sharding
-                arr = jax.device_put(arr.astype(t.dtype), t._data.sharding)
+                from .reshard import reshard_to_sharding
+                arr = reshard_to_sharding(
+                    entry, path, t._data.sharding,
+                    cast_dtype=t._data.dtype, validate=validate)
+                n_resharded += 1
             else:
                 # single-device target: keep the array uncommitted so it
                 # composes with mesh-sharded arrays in eager ops
-                arr = arr.astype(t.dtype)
+                arr = _assemble(entry, path, name,
+                                validate=validate).astype(t.dtype)
             t.set_data(arr)
+    if n_resharded:
+        # elastic observability: a cross-mesh resume's reshard cost
+        # shows up as a gauge, not a mystery gap in resume time
+        from ...profiler import trace as _trace
+        tracer = _trace.get_tracer()
+        tracer.counter("elastic/reshard_tensors", n_resharded)
+        tracer.counter("elastic/reshard_ms",
+                       round((time.perf_counter() - t0) * 1e3, 3))
     return state_dict
 
 

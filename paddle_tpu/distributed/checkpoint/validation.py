@@ -19,7 +19,7 @@ import shutil
 from ...utils.retry import retry_call
 
 __all__ = ["is_committed", "validate_checkpoint",
-           "latest_valid_checkpoint", "gc_checkpoints",
+           "latest_valid_checkpoint", "gc_checkpoints", "shards_intact",
            "CheckpointCorruptError", "CheckpointNotCommittedError",
            "COMMITTED_SENTINEL"]
 
@@ -55,11 +55,31 @@ def _read_file(path):
 
 
 def _read_metas(path):
+    """All rank metadata files of a checkpoint, MERGED per tensor.
+
+    A multi-process save writes one ``meta.<rank>.json`` per rank, each
+    listing only the shards that rank owned; loading on a different
+    world size (the elastic-resume case) must see the union of every
+    rank's shards, so tensor entries with the same name merge their
+    shard lists. Replicated copies (same global offset written by
+    several ranks) dedupe to the first occurrence — coordinator rank 0
+    sorts first, so its copy wins."""
     metas = {}
     for fn in sorted(os.listdir(path)):
-        if fn.startswith("meta.") and fn.endswith(".json"):
-            metas.update(json.loads(_read_file(
-                os.path.join(path, fn)).decode()))
+        if not (fn.startswith("meta.") and fn.endswith(".json")):
+            continue
+        for name, entry in json.loads(_read_file(
+                os.path.join(path, fn)).decode()).items():
+            cur = metas.get(name)
+            if cur is None:
+                metas[name] = entry
+            elif cur.get("kind") == "tensor" \
+                    and entry.get("kind") == "tensor":
+                seen = {tuple(s["offset"]) for s in cur["shards"]}
+                for sh in entry.get("shards", []):
+                    if tuple(sh["offset"]) not in seen:
+                        seen.add(tuple(sh["offset"]))
+                        cur["shards"].append(sh)
     return metas
 
 
@@ -76,6 +96,31 @@ def _step_of(name):
 def is_committed(path):
     """True iff ``path`` carries the ``COMMITTED`` sentinel."""
     return os.path.isfile(os.path.join(path, COMMITTED_SENTINEL))
+
+
+def shards_intact(path):
+    """Cheap (stat-level, no hashing) check that every shard file the
+    metadata references exists with its recorded size. Catches the
+    shard-lost-under-a-clean-sentinel rot that shallow validation
+    (metadata checksums only) cannot see, at a fraction of ``deep``
+    validation's re-hash cost — the discovery/retention middle
+    ground."""
+    try:
+        for entry in _read_metas(path).values():
+            if entry.get("kind") != "tensor":
+                continue
+            for sh in entry["shards"]:
+                fpath = os.path.join(path, sh["file"])
+                try:
+                    size = os.stat(fpath).st_size
+                except OSError:
+                    return False
+                expect = sh.get("nbytes")
+                if expect is not None and size != int(expect):
+                    return False
+    except (OSError, ValueError, KeyError):
+        return False
+    return True
 
 
 def validate_checkpoint(path, deep=False):
@@ -127,13 +172,16 @@ def validate_checkpoint(path, deep=False):
 
 
 def latest_valid_checkpoint(root, deep=False):
-    """Newest ``step_N`` subdirectory of ``root`` that is committed and
-    passes validation — torn, in-progress, and corrupt checkpoints are
-    skipped, so elastic restart / ``Model.fit(resume=True)`` always
-    lands on the last *good* step. ``step_N.old`` move-aside backups
-    (an overwrite crashed between its two renames) are considered
-    after their plain sibling, so that crash window cannot lose the
-    newest committed state. Returns None when nothing valid exists."""
+    """Newest ``step_N`` subdirectory of ``root`` that is committed,
+    passes validation, and has every referenced shard file present at
+    its recorded size (:func:`shards_intact` — so a shard lost under a
+    clean sentinel is skipped without ``deep``'s re-hash cost); torn,
+    in-progress, and corrupt checkpoints are skipped, so elastic
+    restart / ``Model.fit(resume=True)`` always lands on the last
+    *good* step. ``step_N.old`` move-aside backups (an overwrite
+    crashed between its two renames) are considered after their plain
+    sibling, so that crash window cannot lose the newest committed
+    state. Returns None when nothing valid exists."""
     if not os.path.isdir(root):
         return None
     cands = []
@@ -152,9 +200,10 @@ def latest_valid_checkpoint(root, deep=False):
     for _, _, full in sorted(cands, reverse=True):
         try:
             validate_checkpoint(full, deep=deep)
-            return full
         except CheckpointCorruptError:
             continue
+        if shards_intact(full):
+            return full
     return None
 
 
@@ -165,6 +214,17 @@ def gc_checkpoints(root, keep_last_n, clean_stale=True):
     ``.old`` move-aside backups that are older than the newest
     committed step (never anything newer — that may be a save in
     progress — and never a staging dir this process is still writing).
+
+    A sentinel alone is NOT proof a checkpoint is resumable (a shard
+    can rot or go missing under a sentinel that still reads clean), so
+    retention additionally pins the newest checkpoint that passes
+    validation AND has all shard files present at their recorded
+    sizes (:func:`shards_intact`): it is never deleted, even when the keep window is
+    filled by newer committed-but-corrupt steps and a later save is
+    still staging. GC racing an in-flight save must never leave zero
+    resumable checkpoints — if that in-flight save dies, the pinned
+    step is what the elastic relaunch resumes from.
+
     Returns the removed paths."""
     if not os.path.isdir(root):
         return []
@@ -175,8 +235,26 @@ def gc_checkpoints(root, keep_last_n, clean_stale=True):
         if s >= 0 and os.path.isdir(full) and is_committed(full):
             committed.append((s, full))
     committed.sort(reverse=True)
+    # each candidate is validated at most once per GC pass (the pin
+    # loop and the .old sweep would otherwise re-read/re-hash the same
+    # metadata — wasted time inside the bounded emergency-save window)
+    resumable_memo = {}
+
+    def _resumable(p):
+        if p not in resumable_memo:
+            try:
+                validate_checkpoint(p)
+                resumable_memo[p] = shards_intact(p)
+            except CheckpointCorruptError:
+                resumable_memo[p] = False
+        return resumable_memo[p]
+
+    newest_valid = next(
+        (full for _, full in committed if _resumable(full)), None)
     removed = []
     for _, full in committed[max(0, int(keep_last_n)):]:
+        if full == newest_valid:
+            continue  # the last resumable state — never GC it
         shutil.rmtree(full, ignore_errors=True)
         removed.append(full)
     if clean_stale:
@@ -195,7 +273,12 @@ def gc_checkpoints(root, keep_last_n, clean_stale=True):
             elif name.endswith(".old"):
                 s = _step_of(name[:-len(".old")])
                 plain = full[:-len(".old")]
-                if 0 <= s <= newest and is_committed(plain):
+                # the backup may be the only VALID copy of its step: a
+                # sentinel on the plain dir is not enough, it must
+                # actually validate (metas AND shard files present)
+                # before its backup is swept
+                plain_ok = is_committed(plain) and _resumable(plain)
+                if 0 <= s <= newest and plain_ok:
                     shutil.rmtree(full, ignore_errors=True)
                     removed.append(full)
             else:

@@ -18,7 +18,9 @@ SIGTERM → relaunch. Recovery stays checkpoint-restart: see
 from .manager import (ElasticManager, ElasticStatus, start_heartbeat,
                       stop_heartbeat, latest_checkpoint, checkpoint_step,
                       latest_valid_checkpoint)
+from .preempt import PreemptionGuard, Preempted, PREEMPTED_EXIT_CODE
 
 __all__ = ["ElasticManager", "ElasticStatus", "start_heartbeat",
            "stop_heartbeat", "latest_checkpoint", "checkpoint_step",
-           "latest_valid_checkpoint"]
+           "latest_valid_checkpoint", "PreemptionGuard", "Preempted",
+           "PREEMPTED_EXIT_CODE"]

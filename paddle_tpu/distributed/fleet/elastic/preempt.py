@@ -1,0 +1,179 @@
+"""Preemption handling — the worker-side half of elastic fault
+tolerance.
+
+A production TPU fleet preempts workers as a matter of course; the
+difference between a preemption and a crash is the *grace window*: the
+scheduler sends SIGTERM and gives the process a bounded number of
+seconds before SIGKILL. The contract here:
+
+- :class:`PreemptionGuard` turns the asynchronous signal into a flag a
+  training loop polls at step boundaries — the signal handler does
+  nothing but record the time (async-signal-safe); the hot loop keeps
+  its compiled-step cadence and drains cleanly at the next boundary.
+- The loop then writes a bounded-time **emergency checkpoint** (the
+  commit barrier gets the *remaining grace*, not the default 300 s —
+  an uncommitted save at SIGKILL is the safe outcome, a blocked one is
+  not) and raises :class:`Preempted`.
+- The trainer exits with :data:`PREEMPTED_EXIT_CODE` (``os.EX_TEMPFAIL``
+  = 75, "temporary failure, retry"), which the elastic launcher
+  classifies as a *clean preemption* — relaunch on its own budget —
+  instead of a crash that burns the restart budget.
+
+A second/third SIGTERM while draining escalates: the third forces
+immediate exit (the operator means it)."""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+__all__ = ["PreemptionGuard", "Preempted", "PREEMPTED_EXIT_CODE"]
+
+#: worker exit code for a clean preemption (emergency checkpoint
+#: committed, state resumable): os.EX_TEMPFAIL = 75 — "temporary
+#: failure, retry", which is exactly the launcher's contract
+PREEMPTED_EXIT_CODE = getattr(os, "EX_TEMPFAIL", 75)
+
+#: grace window the preemptor allows between SIGTERM and SIGKILL
+_GRACE_ENV = "PADDLE_PREEMPT_GRACE_S"
+_DEFAULT_GRACE_S = 30.0
+
+#: signals escalate: 3rd SIGTERM while draining -> immediate exit
+_FORCE_AFTER = 3
+
+
+class Preempted(RuntimeError):
+    """Raised by a preemption-aware training loop AFTER the emergency
+    checkpoint committed — carries what the relaunch needs to know.
+    Trainers normally let it propagate and exit with
+    ``PREEMPTED_EXIT_CODE`` (see ``exit_code``)."""
+
+    def __init__(self, message, checkpoint=None, epoch=None, step=None):
+        super().__init__(message)
+        self.checkpoint = checkpoint
+        self.epoch = epoch
+        self.step = step
+        self.exit_code = PREEMPTED_EXIT_CODE
+
+
+def _install_excepthook():
+    """Make the documented contract true without trainer boilerplate:
+    an UNCAUGHT :class:`Preempted` exits the process with
+    ``PREEMPTED_EXIT_CODE`` (not the generic 1 that the launcher would
+    book as a crash). Chained once, process-wide; trainers that catch
+    Preempted themselves are unaffected."""
+    import sys
+    prev = sys.excepthook
+
+    def hook(exc_type, exc, tb):
+        if isinstance(exc, Preempted):
+            print(f"paddle_tpu: {exc} — exiting "
+                  f"{exc.exit_code} (clean preemption)", file=sys.stderr)
+            sys.exit(exc.exit_code)
+        prev(exc_type, exc, tb)
+
+    hook._paddle_preempt = True  # idempotence marker
+    if not getattr(prev, "_paddle_preempt", False):
+        sys.excepthook = hook
+
+
+class PreemptionGuard:
+    """SIGTERM → pollable flag with a grace-window deadline.
+
+    ``install()`` claims the signal handler (main thread only — from a
+    worker thread the guard stays inert and ``requested()`` can still
+    be driven via :meth:`request`, the test/manual hook) and chains a
+    ``sys.excepthook`` so an uncaught :class:`Preempted` exits with
+    ``PREEMPTED_EXIT_CODE`` instead of reading as a crash.
+    ``uninstall()`` restores the previous signal handler; use as a
+    context manager in loops that must not leak the handler."""
+
+    def __init__(self, signals=(signal.SIGTERM,), grace_s=None):
+        self.signals = tuple(signals)
+        if grace_s is None:
+            grace_s = float(os.environ.get(_GRACE_ENV, _DEFAULT_GRACE_S))
+        self.grace_s = float(grace_s)
+        self._requested_at = None
+        self._count = 0
+        self._prev = {}
+        self._installed = False
+        self._lock = threading.Lock()
+
+    # -- signal side (async-safe: record + count only) ---------------------
+
+    def _on_signal(self, signum, frame):
+        self._count += 1
+        if self._requested_at is None:
+            self._requested_at = time.time()
+        if self._count >= _FORCE_AFTER:
+            # repeated signals mean "now": skip python unwinding
+            os._exit(128 + int(signum))
+
+    def request(self, grace_s=None):
+        """Mark preemption as requested without a real signal — the
+        deterministic hook for tests and cooperative schedulers that
+        deliver preemption notices in-band (a queue message, a
+        metadata-server poll) rather than via SIGTERM."""
+        if grace_s is not None:
+            self.grace_s = float(grace_s)
+        if self._requested_at is None:
+            self._requested_at = time.time()
+        self._count += 1
+        return self
+
+    # -- loop side ---------------------------------------------------------
+
+    def requested(self) -> bool:
+        """Poll at step boundaries: has a preemption been signalled?"""
+        return self._requested_at is not None
+
+    def remaining(self) -> float:
+        """Seconds left in the grace window (``inf`` before any
+        signal, floored at 1 s after — the emergency save always gets
+        a nonzero bound to attempt its commit in)."""
+        if self._requested_at is None:
+            return float("inf")
+        return max(1.0, self._requested_at + self.grace_s - time.time())
+
+    def reset(self):
+        self._requested_at = None
+        self._count = 0
+        return self
+
+    # -- handler lifecycle -------------------------------------------------
+
+    def install(self):
+        with self._lock:
+            if self._installed:
+                return self
+            try:
+                for sig in self.signals:
+                    self._prev[sig] = signal.signal(sig, self._on_signal)
+                self._installed = True
+            except ValueError:
+                # not the main thread: signals cannot be claimed here;
+                # the guard still works through request()
+                self._prev.clear()
+            _install_excepthook()
+        return self
+
+    def uninstall(self):
+        with self._lock:
+            if not self._installed:
+                return
+            for sig, prev in self._prev.items():
+                try:
+                    signal.signal(sig, prev)
+                except (ValueError, TypeError):
+                    pass
+            self._prev.clear()
+            self._installed = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False

@@ -3,7 +3,30 @@
 On TPU pods each host runs ONE process that drives its local chips; the
 launcher therefore spawns `nproc_per_node` processes only for CPU-simulated
 multi-process testing (the Gloo-fallback role, SURVEY.md §4), and for real
-pods simply execs the training script with the coordination env set."""
+pods simply execs the training script with the coordination env set.
+
+Elastic fault tolerance (the launcher-side half; workers implement the
+other half via ``fleet.elastic.PreemptionGuard`` + topology-aware
+checkpoints):
+
+- **failure detection** distinguishes three worker fates: a *clean
+  preemption* (exit ``PREEMPTED_EXIT_CODE`` = 75 after a committed
+  emergency checkpoint) relaunches on its own budget
+  (``--max_preempt_restarts``); a *crash* (any other nonzero exit) or
+  a *stale heartbeat* (hung worker) burns ``--max_restarts``;
+- **auto-restart** respawns with the *surviving* world size when
+  ``--min_nproc_per_node`` allows shrinking (crashed ranks are assumed
+  gone with their capacity), resolves the newest COMMITTED checkpoint
+  and exports it as ``PADDLE_RESUME_CHECKPOINT`` — the workers reshard
+  it onto the reduced mesh — plus ``PADDLE_RESTART_ROUND`` so trainers
+  can derive round-dependent config;
+- restarts use exponential backoff (``--elastic_timeout`` base,
+  ``--max_backoff`` cap) and hard budgets, so a permanently-broken
+  fleet fails loudly instead of hanging or tight-looping;
+- a SIGTERM delivered to the launcher itself (the preemptor reclaiming
+  the whole node) is forwarded to every worker, which gets the grace
+  window to emergency-checkpoint; the launcher then exits 75 without
+  relaunching."""
 
 from __future__ import annotations
 
@@ -15,6 +38,10 @@ import sys
 import time
 
 __all__ = ["launch_main"]
+
+#: clean-preemption worker exit code (fleet.elastic.PREEMPTED_EXIT_CODE;
+#: duplicated literally so the launcher never imports jax-adjacent code)
+PREEMPTED_EXIT_CODE = 75
 
 
 def _parse():
@@ -28,11 +55,34 @@ def _parse():
     p.add_argument("--nproc_per_node", type=int, default=1,
                    help="processes per node (CPU-simulation/testing only; "
                         "TPU uses 1 process per host)")
+    p.add_argument("--min_nproc_per_node", type=int, default=0,
+                   help="if > 0, a crashed rank's slot is treated as "
+                        "lost and the next round respawns with the "
+                        "surviving world size, never below this floor "
+                        "(0 = always respawn at full size). Single-"
+                        "node only: per-launcher shrinking is "
+                        "uncoordinated across nodes, so with "
+                        "--nnodes > 1 it is ignored with a warning")
     p.add_argument("--log_dir", default="log")
     p.add_argument("--devices", default=None)
     p.add_argument("--max_restarts", type=int,
                    default=int(os.environ.get("PADDLE_MAX_RESTARTS", "0")))
-    p.add_argument("--elastic_timeout", type=int, default=30)
+    p.add_argument("--max_preempt_restarts", type=int,
+                   default=int(os.environ.get(
+                       "PADDLE_MAX_PREEMPT_RESTARTS", "16")),
+                   help="separate budget for clean preemptions (exit "
+                        "code 75): routine fleet churn must not burn "
+                        "the crash budget, but still needs a bound")
+    p.add_argument("--elastic_timeout", type=int, default=30,
+                   help="base restart delay; doubles every consecutive "
+                        "restart up to --max_backoff")
+    p.add_argument("--max_backoff", type=float, default=300.0)
+    p.add_argument("--grace", type=float,
+                   default=float(os.environ.get("PADDLE_PREEMPT_GRACE_S",
+                                                "30")),
+                   help="seconds workers get between the launcher's "
+                        "SIGTERM forward and SIGKILL (the emergency-"
+                        "checkpoint window)")
     p.add_argument("--heartbeat_timeout", type=float, default=0.0,
                    help="if > 0, watch worker heartbeats (workers call "
                         "fleet.elastic.start_heartbeat) and treat a "
@@ -104,108 +154,217 @@ def _terminate_all(procs, grace=5.0):
         logf.close()
 
 
-def _run_round(procs, args, manager):
+def _run_round(procs, args, manager, shutdown):
     """Poll all workers concurrently (a failed or hung rank must be
     noticed while others still run — the fault-watch role of the
-    reference's elastic manager). Returns 'ok' | 'failed' | 'stale'."""
+    reference's elastic manager). Returns (outcome, bad_ranks):
+    outcome 'ok' | 'failed' | 'stale' | 'preempted' | 'shutdown';
+    bad_ranks names the crashed/stale local ranks (the slots a
+    shrinking relaunch treats as lost)."""
     start = time.time()
     # a worker hung *before* it ever heartbeats must also be caught:
     # give registration a bounded grace window
     register_deadline = start + max(5 * args.heartbeat_timeout, 30.0)
     while True:
+        if shutdown["flag"]:
+            return "shutdown", []
         alive = False
         done_ok = set()
+        preempted = []
+        failed = []
         for local, (proc, logf) in enumerate(procs):
             ret = proc.poll()
             if ret is None:
                 alive = True
+            elif ret == PREEMPTED_EXIT_CODE:
+                # clean preemption: the worker drained and committed an
+                # emergency checkpoint before exiting — not a crash
+                preempted.append(local)
             elif ret != 0:
+                # keep scanning: simultaneous multi-rank deaths must
+                # ALL be counted, or a shrinking relaunch underestimates
+                # the lost capacity and crashes again
                 _dump_worker_log(args, local, ret, logf)
-                return "failed"
+                failed.append(local)
             else:
                 done_ok.add(local)
+        if failed:
+            return "failed", failed
+        if preempted:
+            # a PARTIAL preemption must end the round too: peers of a
+            # preempted rank would otherwise block forever at their
+            # next collective (still heartbeating, so the watchdog
+            # cannot fire). _terminate_all SIGTERMs the survivors —
+            # each gets the grace window to emergency-checkpoint.
+            print(f"paddle_tpu.launch: ranks {preempted} exited on "
+                  f"clean preemption (rc={PREEMPTED_EXIT_CODE})",
+                  file=sys.stderr)
+            if not alive:
+                for _, logf in procs:
+                    logf.close()
+            return "preempted", []
         if not alive:
             for _, logf in procs:
                 logf.close()
-            return "ok"
+            return "ok", []
         if manager is not None:
             from ..fleet.elastic import ElasticStatus
             # cleanly-exited workers stop heartbeating legitimately
+            # (a preempted rank ended the round above already)
             status, bad = manager.watch(ignore=done_ok)
             if status is ElasticStatus.STALE:
                 print(f"paddle_tpu.launch: stale heartbeats from ranks "
                       f"{bad}", file=sys.stderr)
-                return "stale"
+                return "stale", list(bad)
             if (status is ElasticStatus.INCOMPLETE
                     and time.time() > register_deadline):
                 print(f"paddle_tpu.launch: ranks {bad} never "
                       f"registered a heartbeat", file=sys.stderr)
-                return "stale"
+                return "stale", list(bad)
         time.sleep(0.2)
 
 
 def launch_main():
     args = _parse()
-    world = args.nnodes * args.nproc_per_node
+    if args.min_nproc_per_node > 0 and args.nnodes > 1:
+        # each node's launcher only sees its own workers: independent
+        # shrinking would leave the nodes disagreeing on world size
+        # and rank assignment — refuse rather than misaddress ranks
+        print("paddle_tpu.launch: --min_nproc_per_node is single-node "
+              "only (per-launcher shrinking is uncoordinated across "
+              "nodes); ignoring it", file=sys.stderr)
+        args.min_nproc_per_node = 0
     restarts = 0
+    preempt_restarts = 0
+    nproc = args.nproc_per_node
     manager = None
     hb_dir = None
+    # forward a preemption of the launcher itself: SIGTERM fans out to
+    # the workers (each gets the grace window to emergency-checkpoint),
+    # then the launcher exits 75 instead of relaunching
+    shutdown = {"flag": False}
+
+    def _on_term(signum, frame):
+        shutdown["flag"] = True
+
+    try:
+        prev_term = signal.signal(signal.SIGTERM, _on_term)
+    except ValueError:      # not the main thread (embedded use)
+        prev_term = None
     if args.heartbeat_timeout > 0:
         from ..fleet.elastic import ElasticManager
         hb_dir = os.path.join(args.log_dir, "heartbeat")
         os.makedirs(hb_dir, exist_ok=True)
         # watch only this node's ranks; peer nodes watch their own
-        manager = ElasticManager(args.nproc_per_node, directory=hb_dir,
+        manager = ElasticManager(nproc, directory=hb_dir,
                                  timeout=args.heartbeat_timeout)
-    while True:
-        procs = []
-        base = args.rank * args.nproc_per_node
-        if manager is not None:
-            manager.reset()
-        resume_env = {}
-        if args.checkpoint_dir:
-            # validated auto-resume: point workers at the newest
-            # COMMITTED checkpoint; a save torn by the previous crash
-            # is skipped, so restart recovers the last good step
-            from ..fleet.elastic import (latest_valid_checkpoint,
-                                         checkpoint_step)
-            ck = latest_valid_checkpoint(args.checkpoint_dir)
-            if ck is not None:
-                resume_env = {
-                    "PADDLE_RESUME_CHECKPOINT": ck,
-                    "PADDLE_RESUME_STEP": str(checkpoint_step(ck)),
-                }
-                print(f"paddle_tpu.launch: resuming from {ck}")
-        for local in range(args.nproc_per_node):
-            rank = base + local
-            extra = {"PADDLE_LOCAL_RANK": str(local)}
-            extra.update(resume_env)
-            if hb_dir is not None:
-                extra["PADDLE_ELASTIC_HEARTBEAT_DIR"] = hb_dir
-                extra["PADDLE_ELASTIC_HEARTBEAT_RANK"] = str(local)
-            if args.nproc_per_node > 1:
-                # CPU-simulated cluster: isolate each proc onto CPU devices
-                extra["JAX_PLATFORMS"] = "cpu"
-            procs.append(_spawn(rank, world, args, extra))
-        try:
-            outcome = _run_round(procs, args, manager)
-        except KeyboardInterrupt:
-            _terminate_all(procs)
-            raise
-        if outcome == "ok":
-            print("paddle_tpu.launch: all workers exited cleanly")
-            return 0
-        _terminate_all(procs)
-        # failure detection → checkpoint-restart (elastic mode)
-        if restarts >= args.max_restarts:
-            print(f"paddle_tpu.launch: worker {outcome}; restarts "
-                  f"exhausted", file=sys.stderr)
-            return 1
-        restarts += 1
-        print(f"paddle_tpu.launch: worker {outcome}; relaunching "
-              f"({restarts}/{args.max_restarts}) after "
-              f"{args.elastic_timeout}s", file=sys.stderr)
-        time.sleep(args.elastic_timeout)
+    try:
+        while True:
+            procs = []
+            world = args.nnodes * nproc
+            base = args.rank * nproc
+            if manager is not None:
+                manager.world_size = nproc
+                manager.reset()
+            resume_env = {
+                "PADDLE_RESTART_ROUND":
+                    str(restarts + preempt_restarts),
+                "PADDLE_PREEMPT_GRACE_S": str(args.grace),
+            }
+            if args.checkpoint_dir:
+                # validated auto-resume: point workers at the newest
+                # COMMITTED checkpoint; a save torn by the previous
+                # crash is skipped, so restart recovers the last good
+                # step — and the workers reshard it onto whatever mesh
+                # the surviving world builds
+                from ..fleet.elastic import (latest_valid_checkpoint,
+                                             checkpoint_step)
+                ck = latest_valid_checkpoint(args.checkpoint_dir)
+                if ck is not None:
+                    resume_env.update({
+                        "PADDLE_RESUME_CHECKPOINT": ck,
+                        "PADDLE_RESUME_STEP": str(checkpoint_step(ck)),
+                    })
+                    print(f"paddle_tpu.launch: resuming from {ck}")
+            for local in range(nproc):
+                rank = base + local
+                extra = {"PADDLE_LOCAL_RANK": str(local)}
+                extra.update(resume_env)
+                if hb_dir is not None:
+                    extra["PADDLE_ELASTIC_HEARTBEAT_DIR"] = hb_dir
+                    extra["PADDLE_ELASTIC_HEARTBEAT_RANK"] = str(local)
+                if nproc > 1:
+                    # CPU-simulated cluster: isolate each proc onto CPU
+                    extra["JAX_PLATFORMS"] = "cpu"
+                procs.append(_spawn(rank, world, args, extra))
+            try:
+                outcome, bad = _run_round(procs, args, manager, shutdown)
+            except KeyboardInterrupt:
+                _terminate_all(procs)
+                raise
+            if outcome == "ok":
+                print("paddle_tpu.launch: all workers exited cleanly")
+                return 0
+            if outcome == "shutdown":
+                print("paddle_tpu.launch: SIGTERM received; forwarding "
+                      f"to workers with a {args.grace}s grace window",
+                      file=sys.stderr)
+                _terminate_all(procs, grace=args.grace)
+                return PREEMPTED_EXIT_CODE
+            _terminate_all(procs, grace=args.grace)
+            # failure detection → checkpoint-restart (elastic mode):
+            # clean preemptions relaunch on their own budget; crashes
+            # and hangs burn the crash budget and may shrink the world
+            if outcome == "preempted":
+                if preempt_restarts >= args.max_preempt_restarts:
+                    print("paddle_tpu.launch: preempt restarts "
+                          "exhausted", file=sys.stderr)
+                    return 1
+                preempt_restarts += 1
+                budget = f"preempt {preempt_restarts}/" \
+                         f"{args.max_preempt_restarts}"
+            else:
+                if restarts >= args.max_restarts:
+                    print(f"paddle_tpu.launch: worker {outcome}; "
+                          f"restarts exhausted", file=sys.stderr)
+                    return 1
+                restarts += 1
+                budget = f"{restarts}/{args.max_restarts}"
+                if args.min_nproc_per_node > 0 and bad:
+                    survivors = max(args.min_nproc_per_node,
+                                    nproc - len(bad))
+                    if survivors != nproc:
+                        print(f"paddle_tpu.launch: shrinking "
+                              f"nproc_per_node {nproc} -> {survivors} "
+                              f"(lost ranks {bad})", file=sys.stderr)
+                        nproc = survivors
+            delay = _backoff(args, restarts + preempt_restarts)
+            print(f"paddle_tpu.launch: worker {outcome}; relaunching "
+                  f"({budget}) after {delay:.0f}s", file=sys.stderr)
+            _interruptible_sleep(delay, shutdown)
+            if shutdown["flag"]:
+                return PREEMPTED_EXIT_CODE
+    finally:
+        if prev_term is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_term)
+            except (ValueError, TypeError):
+                pass
+
+
+def _backoff(args, attempt):
+    """Exponential backoff: base * 2^(attempt-1) capped at
+    --max_backoff (a zero base stays zero — the test fast path)."""
+    base = float(args.elastic_timeout)
+    if base <= 0:
+        return 0.0
+    return min(base * (2.0 ** max(0, attempt - 1)), args.max_backoff)
+
+
+def _interruptible_sleep(seconds, shutdown):
+    end = time.time() + seconds
+    while time.time() < end and not shutdown["flag"]:
+        time.sleep(min(0.2, max(0.0, end - time.time())))
 
 
 if __name__ == "__main__":

@@ -53,15 +53,22 @@ class Model:
         self.network = network
         self._optimizer = None
         self._loss = None
+        self._scaler = None
         self._metrics = []
         self._compiled_train_step = None
         self._compiled_eval_step = None
         self._fit_pipeline = None
+        self._resume_mid_step = None
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, scaler=None):
         self._optimizer = optimizer
         self._loss = loss
+        # optional GradScaler: train steps route the update through
+        # scale/unscale/update, and its device scalars (scale +
+        # good/bad counters) ride every checkpoint — an elastic resume
+        # restores dynamic-loss-scaling state exactly
+        self._scaler = scaler
         # the compiled steps close over optimizer/loss/amp — re-prepare
         # must rebuild them
         self._compiled_train_step = None
@@ -98,6 +105,20 @@ class Model:
             return self._loss(outputs, labels)
         raise RuntimeError("prepare(loss=...) first")
 
+    def _backward_and_step(self, loss):
+        """Backward + optimizer update, through the GradScaler when one
+        was prepared (scale → backward → unscale/step/update, the
+        dynamic-loss-scaling flow; its counters are traced device math,
+        so the compiled fit loop keeps them live)."""
+        scaler = self._scaler
+        if scaler is not None and scaler.is_enable():
+            scaler.scale(loss).backward()
+            scaler.step(self._optimizer)
+        else:
+            loss.backward()
+            self._optimizer.step()
+        self._optimizer.clear_grad()
+
     def train_batch(self, inputs, labels=None, update=True):
         self.network.train()
         inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
@@ -110,10 +131,10 @@ class Model:
         else:
             outputs = self.network(*inputs)
             loss = self._compute_loss(outputs, labels)
-        loss.backward()
         if update:
-            self._optimizer.step()
-            self._optimizer.clear_grad()
+            self._backward_and_step(loss)
+        else:
+            loss.backward()
         return [float(loss.item())]
 
     def eval_batch(self, inputs, labels=None):
@@ -157,9 +178,7 @@ class Model:
                 else:
                     outputs = self.network(*xs)
                     loss = self._compute_loss(outputs, y)
-                loss.backward()
-                self._optimizer.step()
-                self._optimizer.clear_grad()
+                self._backward_and_step(loss)
                 return loss
 
             from ..jit.to_static_api import StaticFunction
@@ -220,13 +239,21 @@ class Model:
 
     def _fit_epoch_compiled(self, loader, step_fn, epoch, log_freq,
                             verbose, pipeline, device_sharding,
-                            explicit_depth=False):
+                            explicit_depth=False, guard=None,
+                            skip_to=0):
         """One epoch at compiled-step speed: device-prefetched input,
         up to ``steps_in_flight`` dispatched steps un-fetched, loss
-        scalars resolved only at log/epoch boundaries. Returns
-        (losses, prefetcher, host_dispatch_seconds)."""
+        scalars resolved only at log/epoch boundaries. ``guard`` is
+        polled at each step boundary — on a preemption signal the loop
+        stops dispatching, drains the in-flight loss window, and
+        reports back so fit can emergency-checkpoint within the grace
+        bound. ``skip_to`` fast-forwards a mid-epoch resume past the
+        steps the preempted run already consumed (they are iterated but
+        never dispatched). Returns (losses, prefetcher,
+        host_dispatch_seconds, last_step, preempted)."""
         tracer = _trace.get_tracer()
         it = iter(loader)
+        host_skipped = 0
         if isinstance(it, DevicePrefetcher):
             # the loader was built with prefetch_to_device= — use ITS
             # prefetch stage (a second wrapper would double-place every
@@ -247,8 +274,18 @@ class Model:
                     "and its own prefetch config wins — set these on "
                     "the DataLoader instead")
         else:
+            # mid-epoch resume: skip consumed batches on the HOST
+            # iterator, before the prefetch stage ever device-places
+            # them (a restart should not pay H2D for batches it will
+            # discard, nor inflate the h2d_bytes/input_wait gauges)
+            for _ in range(skip_to):
+                try:
+                    next(it)
+                except StopIteration:
+                    break
             pf = DevicePrefetcher(it, depth=pipeline["prefetch_depth"],
                                   sharding=device_sharding)
+            host_skipped = skip_to
         in_flight = pipeline["steps_in_flight"]
         pending: collections.deque = collections.deque()
         losses: list[float] = []
@@ -264,8 +301,20 @@ class Model:
             tracer.counter("hapi/input_wait_ms",
                            round(pf.input_wait_s * 1e3, 3), epoch=epoch)
 
+        last_step = skip_to - 1
+        preempted = False
         try:
-            for step, batch in enumerate(pf):
+            for step, batch in enumerate(pf, start=host_skipped):
+                if guard is not None and guard.requested():
+                    # step boundary: stop dispatching; the drain below
+                    # resolves every in-flight step before the
+                    # emergency checkpoint snapshots state
+                    preempted = True
+                    break
+                if step < skip_to:
+                    # mid-epoch resume behind a loader-owned prefetch
+                    # stage (already device-placed): discard-iterate
+                    continue
                 batch = batch if isinstance(batch, (list, tuple)) \
                     else (batch,)
                 t0 = time.perf_counter()
@@ -274,6 +323,7 @@ class Model:
                                        mode="compiled"):
                     loss_t = step_fn(*batch)
                 host_s += time.perf_counter() - t0
+                last_step = step
                 pending.append((step, loss_t))
                 in_flight_now = min(len(pending), in_flight)
                 tracer.counter("hapi/steps_in_flight", in_flight_now)
@@ -293,22 +343,32 @@ class Model:
         finally:
             pf.close()
         tracer.counter("hapi/h2d_bytes", pf.h2d_bytes, epoch=epoch)
-        return losses, pf, host_s
+        return losses, pf, host_s, last_step, preempted
 
-    def _fit_epoch_eager(self, loader, epoch, log_freq, verbose):
-        """The eager parity-oracle loop (per-step host sync)."""
+    def _fit_epoch_eager(self, loader, epoch, log_freq, verbose,
+                         guard=None, skip_to=0):
+        """The eager parity-oracle loop (per-step host sync); same
+        preemption/skip contract as the compiled loop."""
         losses: list[float] = []
+        last_step = skip_to - 1
+        preempted = False
         for step, batch in enumerate(loader):
+            if guard is not None and guard.requested():
+                preempted = True
+                break
+            if step < skip_to:
+                continue  # host batches only: no device cost to skip
             *xs, y = batch if isinstance(batch, (list, tuple)) \
                 else (batch,)
             with _trace.trace_span("hapi/train_batch", cat="train",
                                    epoch=epoch, step=step):
                 loss = self.train_batch(xs, y)
+            last_step = step
             losses.append(loss[0])
             monitor.emit_step_metrics(epoch=epoch, loss=loss[0])
             if verbose and step % log_freq == 0:
                 print(f"epoch {epoch} step {step}: loss {loss[0]:.5f}")
-        return losses
+        return losses, last_step, preempted
 
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
@@ -316,7 +376,7 @@ class Model:
             callbacks=None, resume=None, keep_last_n=None,
             legacy_save=True, compiled=True, donate=True,
             prefetch_depth=None, steps_in_flight=None,
-            device_sharding=None):
+            device_sharding=None, preemptible=None):
         """Train. ``save_dir`` writes a committed ``step_N``
         distributed checkpoint per epoch (``keep_last_n`` bounds its
         retention) plus — unless ``legacy_save=False`` — the upstream
@@ -325,6 +385,24 @@ class Model:
         if the elastic launcher exported one, else the newest valid
         ``step_N`` under ``save_dir`` — skipping any save torn by a
         crash; ``resume=<path>`` loads that checkpoint explicitly.
+        Checkpoints are topology-aware: a resume may run on a
+        different mesh (dp/mp resized either way) and each tensor is
+        resharded on load, optimizer slots and device step/scale
+        scalars included.
+
+        **Preemption** (``preemptible``, default: on whenever
+        ``save_dir`` is set): a SIGTERM observed at a step boundary
+        drains the in-flight loss window, writes a bounded-time
+        emergency checkpoint (``PADDLE_PREEMPT_GRACE_S`` caps the
+        commit barrier) recording the mid-epoch step, and raises
+        :class:`~paddle_tpu.distributed.fleet.elastic.Preempted`; the
+        elastic launcher classifies the resulting EX_TEMPFAIL exit as
+        a clean preemption and relaunches without burning the crash
+        budget. A mid-epoch resume fast-forwards the loader past the
+        consumed steps — with a deterministic batch order (seeded or
+        ``shuffle=False``) the loss trajectory continues exactly.
+        Pass a ``PreemptionGuard`` instance to share one across loops,
+        or ``False`` to opt out.
 
         Hot-path knobs (module docstring, docs/data_pipeline.md):
         ``compiled=True`` runs the jitted train step (``donate``
@@ -333,24 +411,41 @@ class Model:
         tuning cache, then 2/2); ``device_sharding`` (a jax Sharding,
         e.g. NamedSharding over a dp mesh axis) device-places each
         global batch sharded across the mesh."""
+        import os as _os
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
         start_epoch = 0
+        resume_skip = 0  # steps already consumed in start_epoch
         if resume:
             ckpt_path = resume if isinstance(resume, str) else None
             if ckpt_path is None:
-                import os
-                ckpt_path = os.environ.get("PADDLE_RESUME_CHECKPOINT")
+                ckpt_path = _os.environ.get("PADDLE_RESUME_CHECKPOINT")
             if ckpt_path is None and save_dir is not None:
                 from ..distributed.checkpoint import \
                     latest_valid_checkpoint
                 ckpt_path = latest_valid_checkpoint(save_dir)
             if ckpt_path:
-                start_epoch = self.load_checkpoint(ckpt_path) + 1
+                epoch_done = self.load_checkpoint(ckpt_path)
+                mid = self._resume_mid_step
+                if mid is None:
+                    start_epoch = epoch_done + 1
+                else:
+                    # emergency checkpoint mid-epoch: redo THIS epoch
+                    # from the step after the last one consumed
+                    start_epoch = epoch_done
+                    resume_skip = int(mid) + 1
+                tracer = _trace.get_tracer()
+                tracer.counter(
+                    "restart/round",
+                    int(_os.environ.get("PADDLE_RESTART_ROUND", "0")))
+                tracer.counter("restart/resume_epoch", start_epoch)
+                tracer.counter("restart/resume_step", resume_skip)
                 if verbose:
+                    mid_msg = f" step {resume_skip}" if resume_skip \
+                        else ""
                     print(f"resuming from {ckpt_path} "
-                          f"(epoch {start_epoch})")
+                          f"(epoch {start_epoch}{mid_msg})")
         # cache keying must see the REAL batch size when the caller
         # handed us a pre-built DataLoader (batch_size stays at its
         # default of 1 in that case)
@@ -362,52 +457,86 @@ class Model:
         pipeline = self._resolve_fit_pipeline(eff_bs, prefetch_depth,
                                               steps_in_flight)
         step_fn = self._static_train_step(donate) if compiled else None
-        for epoch in range(start_epoch, epochs):
-            epoch_t0 = time.perf_counter()
-            extra = {}
-            if compiled:
-                runs0 = (step_fn.n_compiled_runs, step_fn.n_eager_runs)
-                losses, pf, host_s = self._fit_epoch_compiled(
-                    loader, step_fn, epoch, log_freq, verbose,
-                    pipeline, device_sharding,
-                    explicit_depth=prefetch_depth is not None)
-                # host-vs-device attribution: host_dispatch_ms is the
-                # python/dispatch cost of the epoch; the rest of
-                # epoch_s is device compute + input wait. Run counters
-                # are cumulative on the StaticFunction — report the
-                # per-epoch delta.
-                extra = {"input_wait_ms": round(pf.input_wait_s * 1e3, 3),
-                         "h2d_mb": round(pf.h2d_bytes / 1e6, 3),
-                         "host_dispatch_ms": round(host_s * 1e3, 3),
-                         "compiled_steps":
-                             step_fn.n_compiled_runs - runs0[0],
-                         "eager_steps":
-                             step_fn.n_eager_runs - runs0[1]}
-            else:
-                losses = self._fit_epoch_eager(loader, epoch, log_freq,
-                                               verbose)
-            # per-epoch perf summary through the trace layer (INFO log +
-            # gauges; profiler subsystem) — avg step time is the number
-            # every perf regression shows up in first
-            summary = _trace.epoch_summary(
-                epoch, steps=len(losses),
-                seconds=time.perf_counter() - epoch_t0,
-                mean_loss=round(float(np.mean(losses)), 6)
-                if losses else None, **extra)
-            self._last_epoch_summary = summary
-            if verbose:
-                print(f"epoch {epoch} done: {summary['steps']} steps in "
-                      f"{summary['epoch_s']:.2f}s "
-                      f"(avg {summary['avg_step_ms']:.1f} ms/step)")
-            if save_dir is not None and epoch % save_freq == 0:
-                if legacy_save:
-                    self.save(f"{save_dir}/epoch_{epoch}")
-                self.save_checkpoint(f"{save_dir}/step_{epoch}",
-                                     epoch=epoch,
-                                     keep_last_n=keep_last_n)
-            if eval_data is not None and epoch % eval_freq == 0:
-                self.evaluate(eval_data, batch_size=batch_size,
-                              verbose=verbose, compiled=compiled)
+        # preemptible: False = off, a PreemptionGuard = use that one,
+        # None (default) = on when save_dir is set, True = on (needs
+        # save_dir for the emergency checkpoint)
+        guard = None
+        own_guard = False
+        if preemptible is True and save_dir is None:
+            raise ValueError(
+                "fit(preemptible=True) needs save_dir=: an emergency "
+                "checkpoint has nowhere to commit")
+        if preemptible is not None and not isinstance(preemptible, bool):
+            guard = preemptible
+            guard.install()
+        elif preemptible is not False and save_dir is not None:
+            from ..distributed.fleet.elastic import PreemptionGuard
+            guard = PreemptionGuard().install()
+            own_guard = True
+        try:
+            for epoch in range(start_epoch, epochs):
+                epoch_t0 = time.perf_counter()
+                skip_to = resume_skip if epoch == start_epoch else 0
+                extra = {}
+                if compiled:
+                    runs0 = (step_fn.n_compiled_runs,
+                             step_fn.n_eager_runs)
+                    losses, pf, host_s, last_step, preempted = \
+                        self._fit_epoch_compiled(
+                            loader, step_fn, epoch, log_freq, verbose,
+                            pipeline, device_sharding,
+                            explicit_depth=prefetch_depth is not None,
+                            guard=guard, skip_to=skip_to)
+                    # host-vs-device attribution: host_dispatch_ms is
+                    # the python/dispatch cost of the epoch; the rest
+                    # of epoch_s is device compute + input wait. Run
+                    # counters are cumulative on the StaticFunction —
+                    # report the per-epoch delta.
+                    extra = {"input_wait_ms":
+                                 round(pf.input_wait_s * 1e3, 3),
+                             "h2d_mb": round(pf.h2d_bytes / 1e6, 3),
+                             "host_dispatch_ms": round(host_s * 1e3, 3),
+                             "compiled_steps":
+                                 step_fn.n_compiled_runs - runs0[0],
+                             "eager_steps":
+                                 step_fn.n_eager_runs - runs0[1]}
+                else:
+                    losses, last_step, preempted = self._fit_epoch_eager(
+                        loader, epoch, log_freq, verbose,
+                        guard=guard, skip_to=skip_to)
+                # per-epoch perf summary through the trace layer (INFO
+                # log + gauges; profiler subsystem) — avg step time is
+                # the number every perf regression shows up in first
+                summary = _trace.epoch_summary(
+                    epoch, steps=len(losses),
+                    seconds=time.perf_counter() - epoch_t0,
+                    mean_loss=round(float(np.mean(losses)), 6)
+                    if losses else None, **extra)
+                self._last_epoch_summary = summary
+                if preempted:
+                    ck = self._emergency_checkpoint(
+                        save_dir, epoch, last_step, keep_last_n, guard)
+                    from ..distributed.fleet.elastic import Preempted
+                    raise Preempted(
+                        f"preempted at epoch {epoch} step {last_step}; "
+                        f"emergency checkpoint committed at {ck}",
+                        checkpoint=ck, epoch=epoch, step=last_step)
+                if verbose:
+                    print(f"epoch {epoch} done: {summary['steps']} "
+                          f"steps in {summary['epoch_s']:.2f}s "
+                          f"(avg {summary['avg_step_ms']:.1f} ms/step)")
+                if save_dir is not None and epoch % save_freq == 0:
+                    if legacy_save:
+                        self.save(f"{save_dir}/epoch_{epoch}")
+                    self.save_checkpoint(f"{save_dir}/step_{epoch}",
+                                         epoch=epoch,
+                                         keep_last_n=keep_last_n)
+                if eval_data is not None and epoch % eval_freq == 0:
+                    self.evaluate(eval_data, batch_size=batch_size,
+                                  verbose=verbose, compiled=compiled)
+        finally:
+            if own_guard:
+                guard.uninstall()
 
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, compiled=True):
@@ -455,22 +584,64 @@ class Model:
         if training and self._optimizer is not None:
             save_obj(self._optimizer.state_dict(), path + ".pdopt")
 
-    def save_checkpoint(self, path, epoch=None, keep_last_n=None):
-        """Atomic (commit-protocol) checkpoint of model + optimizer +
-        epoch: the directory either appears fully committed or not at
-        all, so a crash mid-save can never corrupt the resume point."""
-        from ..distributed import checkpoint as dckpt
+    def _checkpoint_state(self, epoch=None, mid_epoch_step=None):
+        """The full resumable-state dict: model + optimizer (slots AND
+        the device ``@step`` scalar) + GradScaler scale/counters +
+        epoch/step markers."""
         state = {"model": self.network.state_dict()}
         if self._optimizer is not None:
             state["optimizer"] = self._optimizer.state_dict()
+        if self._scaler is not None:
+            state["scaler"] = self._scaler.state_dict()
         if epoch is not None:
             state["epoch"] = int(epoch)
-        dckpt.save_state_dict(state, path, keep_last_n=keep_last_n)
+        if mid_epoch_step is not None:
+            state["mid_epoch_step"] = int(mid_epoch_step)
+        return state
+
+    def save_checkpoint(self, path, epoch=None, keep_last_n=None,
+                        mid_epoch_step=None, barrier_timeout=None):
+        """Atomic (commit-protocol) checkpoint of model + optimizer +
+        scaler + epoch: the directory either appears fully committed or
+        not at all, so a crash mid-save can never corrupt the resume
+        point. ``mid_epoch_step`` marks an emergency (preemption)
+        checkpoint taken inside an epoch; resume redoes the epoch from
+        the following step. ``barrier_timeout`` bounds the commit
+        barrier (the preemption grace window)."""
+        from ..distributed import checkpoint as dckpt
+        dckpt.save_state_dict(
+            self._checkpoint_state(epoch, mid_epoch_step), path,
+            keep_last_n=keep_last_n, barrier_timeout=barrier_timeout)
+
+    def _emergency_checkpoint(self, save_dir, epoch, step, keep_last_n,
+                              guard):
+        """Bounded-time preemption checkpoint at a step boundary: the
+        in-flight window is already drained, so device state is exactly
+        post-step ``step`` of ``epoch``. Returns the committed path
+        (None when fit has no save_dir to commit into)."""
+        tracer = _trace.get_tracer()
+        tracer.counter("elastic/preempt_requested", 1)
+        if save_dir is None:
+            return None
+        t0 = time.perf_counter()
+        path = f"{save_dir}/step_{epoch}"
+        bound = guard.remaining() if guard is not None else None
+        if bound is not None and not np.isfinite(bound):
+            bound = None
+        self.save_checkpoint(path, epoch=epoch, keep_last_n=keep_last_n,
+                             mid_epoch_step=step, barrier_timeout=bound)
+        tracer.counter("elastic/emergency_save_ms",
+                       round((time.perf_counter() - t0) * 1e3, 3))
+        tracer.counter("elastic/emergency_step", int(step), epoch=epoch)
+        return path
 
     def load_checkpoint(self, path):
         """Validated load of a committed checkpoint (checksums verified;
-        torn/corrupt dirs raise). Returns the epoch recorded at save
-        time, or -1."""
+        torn/corrupt dirs raise), resharding every tensor — params,
+        optimizer slots, device step/scale scalars — onto the CURRENT
+        mesh layout. Returns the epoch recorded at save time, or -1;
+        an emergency checkpoint's mid-epoch step lands in
+        ``self._resume_mid_step`` (None otherwise)."""
         from ..distributed import checkpoint as dckpt
         target = {"model": self.network.state_dict()}
         dckpt.load_state_dict(target, path)
@@ -492,6 +663,11 @@ class Model:
             if opt_state:
                 self._optimizer.set_state_dict(opt_state)
         vals = dckpt.load_values(path)
+        if self._scaler is not None and isinstance(
+                vals.get("scaler"), dict):
+            self._scaler.load_state_dict(vals["scaler"])
+        mid = vals.get("mid_epoch_step")
+        self._resume_mid_step = int(mid) if mid is not None else None
         return int(vals.get("epoch", -1))
 
     def load(self, path, skip_mismatch=False, reset_optimizer=False):

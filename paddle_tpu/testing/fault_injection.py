@@ -18,6 +18,21 @@ operation touches a matching path:
 - ``action="pause"`` — touch ``marker`` then sleep forever, so a
   parent test process can deliver a *real* SIGKILL at a known point
   (e.g. between shard write and commit).
+- ``action="sigterm"`` — deliver a real SIGTERM to this process at the
+  matching operation, then let the operation PROCEED: the preemption
+  shape (the signal is asynchronous; work continues until the loop's
+  next step boundary polls its ``PreemptionGuard``). The
+  :meth:`FaultInjector.preempt` helper arms it.
+
+Beyond filesystem ops, **call-site plans** (:meth:`FaultInjector
+.fail_call` / :meth:`crash_call`) patch a dotted callable — e.g. the
+optimizer step or a collective — and fire after ``after_calls``
+invocations. That is how a chaos test kills a worker *mid-step* or
+*mid-collective* at a chosen, randomizable point::
+
+    fi.crash_call("paddle_tpu.distributed.communication.all_reduce")
+    fi.crash_call("paddle_tpu.optimizer.optimizer.Optimizer.step",
+                  after_calls=k)     # SIGKILL-equivalent at step k
 
 Plans match by substring of the path and fire deterministically: each
 plan fires at most ``times`` times, in registration order. Use as a
@@ -33,7 +48,9 @@ from __future__ import annotations
 
 import builtins
 import errno as _errno
+import importlib
 import os
+import signal as _signal
 import threading
 import time
 
@@ -45,19 +62,23 @@ class FaultPlan:
     ``match``, at most ``times`` times."""
 
     def __init__(self, match, op="write", errno_=_errno.EIO, times=1,
-                 after_bytes=0, action="raise", marker=None):
-        if op not in ("open", "write", "read", "rename"):
+                 after_bytes=0, action="raise", marker=None,
+                 after_calls=0):
+        if op not in ("open", "write", "read", "rename", "call"):
             raise ValueError(f"unknown fault op {op!r}")
-        if action not in ("raise", "truncate", "crash", "pause"):
+        if action not in ("raise", "truncate", "crash", "pause",
+                          "sigterm"):
             raise ValueError(f"unknown fault action {action!r}")
         self.match = match
         self.op = op
         self.errno = errno_
         self.times = int(times)
         self.after_bytes = int(after_bytes)
+        self.after_calls = int(after_calls)
         self.action = action
         self.marker = marker
         self.fired = 0
+        self.calls = 0
 
     def __repr__(self):
         return (f"FaultPlan({self.match!r}, op={self.op}, "
@@ -80,14 +101,19 @@ class _FaultFile:
         plan = self._inj._take(self._path, "write",
                                pending=self._written + len(data))
         if plan is not None:
-            keep = max(0, plan.after_bytes - self._written)
-            if keep:
-                self._f.write(data[:keep])
-                self._written += keep
-            if plan.action == "truncate":
-                self._truncated = True
-                return len(data)  # lie: report full success
-            self._inj._act(plan, self._path)  # raise / crash / pause
+            if plan.action == "sigterm":
+                # preemption notice mid-write: signal, then the write
+                # itself PROCEEDS untouched (the signal is async)
+                self._inj._act(plan, self._path)
+            else:
+                keep = max(0, plan.after_bytes - self._written)
+                if keep:
+                    self._f.write(data[:keep])
+                    self._written += keep
+                if plan.action == "truncate":
+                    self._truncated = True
+                    return len(data)  # lie: report full success
+                self._inj._act(plan, self._path)  # raise/crash/pause
         n = self._f.write(data)
         self._written += len(data)
         return n
@@ -122,6 +148,8 @@ class FaultInjector:
         self._real_open = None
         self._real_replace = None
         self._real_rename = None
+        self._call_targets = []   # (dotted_name, plan) awaiting patch
+        self._patched_calls = []  # (owner, attr, original)
 
     # -- arming ------------------------------------------------------------
 
@@ -160,6 +188,40 @@ class FaultInjector:
         exact point."""
         return self.fail(match, op=op, action="pause", marker=marker)
 
+    def preempt(self, match, op="open", times=1):
+        """Deliver a real SIGTERM to this process when ``op`` touches a
+        matching path, then let the operation proceed — the SIGTERM-
+        with-grace-window preemption scenario: an installed
+        ``PreemptionGuard`` records the signal and the training loop
+        drains at its next step boundary."""
+        return self.fail(match, op=op, action="sigterm", times=times)
+
+    def fail_call(self, target, action="raise", errno_=_errno.EIO,
+                  times=1, after_calls=0):
+        """Arm a fault on a dotted CALLABLE instead of a file path:
+        ``target`` names a module-level function or class method (e.g.
+        ``"paddle_tpu.distributed.communication.all_reduce"``); the
+        plan fires once more than ``after_calls`` invocations have
+        happened, then the chosen action runs *before* the original
+        callable — ``"crash"`` is a worker killed mid-collective /
+        mid-step, ``"raise"`` an injected failure unwinding through
+        it, ``"sigterm"`` a preemption notice landing inside it.
+        Patched on :meth:`install`, restored on :meth:`uninstall`."""
+        plan = FaultPlan(target, op="call", errno_=errno_, times=times,
+                         action=action, after_calls=after_calls)
+        self.plans.append(plan)
+        self._call_targets.append((target, plan))
+        if self._installed:
+            self._patch_call(target, plan)
+        return plan
+
+    def crash_call(self, target, after_calls=0, times=1):
+        """``os._exit(41)`` (SIGKILL-equivalent) inside the named
+        callable — kill a worker mid-step / mid-collective at an
+        exact, randomizable point."""
+        return self.fail_call(target, action="crash", times=times,
+                              after_calls=after_calls)
+
     def fires(self):
         """Total number of times any plan fired."""
         return sum(p.fired for p in self.plans)
@@ -185,6 +247,11 @@ class FaultInjector:
     def _act(self, plan, path):
         if plan.action == "crash":
             os._exit(41)
+        if plan.action == "sigterm":
+            # real signal to self; the caller PROCEEDS with the
+            # operation — preemption is asynchronous by nature
+            os.kill(os.getpid(), _signal.SIGTERM)
+            return
         if plan.action == "pause":
             if plan.marker:
                 with self._real_open(plan.marker, "w") as m:
@@ -194,7 +261,59 @@ class FaultInjector:
         raise OSError(plan.errno,
                       f"fault injected ({plan.op} -> {plan.action})", path)
 
+    def _take_call(self, plan):
+        """Claim a call plan: fires once the invocation count passes
+        ``after_calls`` (counted across install lifetime)."""
+        with self._lock:
+            plan.calls += 1
+            if plan.fired >= plan.times:
+                return None
+            if plan.calls <= plan.after_calls:
+                return None
+            plan.fired += 1
+            return plan
+
     # -- patching ----------------------------------------------------------
+
+    @staticmethod
+    def _resolve_owner(dotted):
+        """(owner, attr) for a dotted target: the longest importable
+        module prefix, then a getattr chain (supports Class.method)."""
+        parts = dotted.split(".")
+        mod = None
+        rest = None
+        for i in range(len(parts) - 1, 0, -1):
+            try:
+                mod = importlib.import_module(".".join(parts[:i]))
+                rest = parts[i:]
+                break
+            except ImportError:
+                continue
+        if mod is None or not rest:
+            raise ValueError(f"cannot resolve fault target {dotted!r}")
+        owner = mod
+        for p in rest[:-1]:
+            owner = getattr(owner, p)
+        if not hasattr(owner, rest[-1]):
+            raise ValueError(
+                f"fault target {dotted!r}: {owner!r} has no "
+                f"attribute {rest[-1]!r}")
+        return owner, rest[-1]
+
+    def _patch_call(self, target, plan):
+        owner, attr = self._resolve_owner(target)
+        original = getattr(owner, attr)
+        injector = self
+
+        def patched(*a, **kw):
+            live = injector._take_call(plan)
+            if live is not None:
+                injector._act(live, target)  # crash/raise/sigterm
+            return original(*a, **kw)
+
+        patched.__name__ = getattr(original, "__name__", attr)
+        setattr(owner, attr, patched)
+        self._patched_calls.append((owner, attr, original))
 
     def _open(self, file, mode="r", *args, **kwargs):
         path = None
@@ -231,6 +350,8 @@ class FaultInjector:
         os.replace = self._rename_like(self._real_replace)
         os.rename = self._rename_like(self._real_rename)
         self._installed = True
+        for target, plan in self._call_targets:
+            self._patch_call(target, plan)
         return self
 
     def uninstall(self):
@@ -239,6 +360,9 @@ class FaultInjector:
         builtins.open = self._real_open
         os.replace = self._real_replace
         os.rename = self._real_rename
+        while self._patched_calls:
+            owner, attr, original = self._patched_calls.pop()
+            setattr(owner, attr, original)
         self._installed = False
 
     def __enter__(self):

@@ -162,6 +162,71 @@ def test_gc_spares_active_staging_dirs(tmp_path):
     assert ckpt.gc_checkpoints(str(tmp_path), 2) == [live]
 
 
+def test_gc_never_deletes_newest_valid_during_staged_save(tmp_path):
+    """The zero-resumable-checkpoints race: keep_last_n retention runs
+    while a LATER save is still staging and the keep window is filled
+    by a committed-but-corrupt step. Sentinel presence alone must not
+    decide retention — the newest checkpoint that actually VALIDATES
+    is pinned, or a failed in-flight save would leave nothing to
+    resume from."""
+    ckpt.save_state_dict(_sd(10), str(tmp_path / "step_10"))
+    ckpt.save_state_dict(_sd(20), str(tmp_path / "step_20"))
+    # step_20 rotted under its sentinel (tampered metadata)
+    meta = tmp_path / "step_20" / "meta.0.json"
+    meta.write_bytes(meta.read_bytes() + b" ")
+    # a later save is mid-flight (possibly another process's staging)
+    os.makedirs(tmp_path / "step_30.tmp-inflight")
+    removed = ckpt.gc_checkpoints(str(tmp_path), 1)
+    # step_10 is the newest VALID checkpoint: pinned, not GC'd
+    assert str(tmp_path / "step_10") not in removed
+    assert os.path.isdir(tmp_path / "step_10")
+    assert os.path.isdir(tmp_path / "step_30.tmp-inflight")
+    best = ckpt.latest_valid_checkpoint(str(tmp_path))
+    assert best is not None and os.path.basename(best) == "step_10"
+    # once a newer save commits cleanly, normal retention resumes
+    ckpt.save_state_dict(_sd(30), str(tmp_path / "step_30"),
+                         keep_last_n=1)
+    assert not os.path.isdir(tmp_path / "step_10")
+    assert ckpt.latest_valid_checkpoint(str(tmp_path)) == \
+        str(tmp_path / "step_30")
+
+
+def test_gc_and_discovery_skip_sentineled_dir_missing_a_shard(tmp_path):
+    """A shard lost UNDER a clean sentinel (metas intact, so shallow
+    validation passes): discovery must skip it and retention must pin
+    the older fully-intact step — the stat-level shards_intact check,
+    cheaper than deep re-hashing."""
+    ckpt.save_state_dict(_sd(10), str(tmp_path / "step_10"))
+    ckpt.save_state_dict(_sd(20), str(tmp_path / "step_20"))
+    shard = next(p for p in (tmp_path / "step_20").iterdir()
+                 if p.name.endswith(".npy"))
+    os.remove(shard)  # metadata + sentinel still read clean
+    assert not ckpt.shards_intact(str(tmp_path / "step_20"))
+    assert ckpt.shards_intact(str(tmp_path / "step_10"))
+    best = ckpt.latest_valid_checkpoint(str(tmp_path))
+    assert best is not None and os.path.basename(best) == "step_10"
+    removed = ckpt.gc_checkpoints(str(tmp_path), 1)
+    assert str(tmp_path / "step_10") not in removed
+    assert os.path.isdir(tmp_path / "step_10")
+
+
+def test_gc_spares_old_backup_of_corrupt_plain_dir(tmp_path):
+    """`.old` move-aside backups are only swept when the plain sibling
+    actually VALIDATES — a sentinel over corrupt metadata must not
+    authorize deleting the only good copy of that step."""
+    path = tmp_path / "step_5"
+    ckpt.save_state_dict(_sd(6), str(path))
+    meta = path / "meta.0.json"
+    meta.write_bytes(meta.read_bytes() + b" ")  # plain copy rots
+    # the crash window left the previous (valid) copy as step_5.old
+    ckpt.save_state_dict(_sd(5), str(tmp_path / "prev"))
+    os.rename(tmp_path / "prev", str(path) + ".old")
+    ckpt.save_state_dict(_sd(7), str(tmp_path / "step_7"))
+    removed = ckpt.gc_checkpoints(str(tmp_path), 2)
+    assert str(path) + ".old" not in removed
+    assert os.path.isdir(str(path) + ".old")
+
+
 def test_crashed_overwrite_recovers_from_old_backup(tmp_path):
     """Overwrite moves the existing committed checkpoint aside to
     `<path>.old` before the commit rename; if a crash hits between
@@ -264,6 +329,55 @@ def test_multirank_stale_staging_cannot_mix_attempts(tmp_path,
     # nothing from the stale attempt survived into the commit
     assert "stale.r1.s0.npy" not in os.listdir(final)
     assert "stale" not in ckpt.read_state_dict(str(final))
+
+
+@pytest.mark.fault
+def test_partial_shard_write_never_commits(tmp_path, monkeypatch):
+    """Some ranks committed their shards, another never finished (its
+    ack write keeps failing): the commit barrier must time out and the
+    checkpoint stay a refused staging dir — the torn multi-rank save
+    is detected, discovery resumes from the prior good step."""
+    import threading
+    from paddle_tpu.distributed.checkpoint import save_load
+
+    ckpt.save_state_dict(_sd(1), str(tmp_path / "step_1"))
+
+    final = tmp_path / "step_2"
+    stage = str(final) + ".tmp-shared"
+    monkeypatch.setattr(save_load.jax, "process_count", lambda: 2)
+    monkeypatch.setenv("PADDLE_CKPT_BARRIER_TIMEOUT", "2")
+    errors = []
+
+    def coordinator():
+        try:
+            ckpt.save_state_dict(_sd(2), str(final))
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    th = threading.Thread(target=coordinator)
+    th.start()
+    try:
+        # play rank 1: stage the shard, but the ack NEVER lands (the
+        # worker was killed after its data write, before its ack)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if os.path.exists(os.path.join(stage, "ATTEMPT")):
+                break
+            time.sleep(0.02)
+        blob = save_load._np_bytes(np.full((2, 4), 2.0, np.float32))
+        save_load._atomic_write(
+            os.path.join(stage, "w.r1.s0.npy"), blob)
+        # no meta.1.json, no ack.1 — rank 1 died here
+    finally:
+        th.join(timeout=60)
+    assert errors and "barrier timed out" in str(errors[0]), errors
+    # nothing committed: the final dir never appeared
+    assert not os.path.isdir(final)
+    assert not ckpt.is_committed(stage)
+    with pytest.raises(ckpt.CheckpointNotCommittedError):
+        ckpt.load_state_dict(_target(), stage)
+    best = ckpt.latest_valid_checkpoint(str(tmp_path))
+    assert best is not None and os.path.basename(best) == "step_1"
 
 
 # --------------------------------------------------------------------------

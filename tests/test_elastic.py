@@ -3,6 +3,7 @@
 """
 
 import os
+import signal
 import subprocess
 import sys
 import time
@@ -239,6 +240,253 @@ def test_launcher_resume_env_absent_without_checkpoints(tmp_path):
         env=ENV, capture_output=True, text=True, timeout=120)
     assert r.returncode == 0, r.stderr
     assert out.read_text().splitlines()[0] == "NONE"
+
+
+# --------------------------------------------------------------------------
+# preemption + elastic shrink (the fault-tolerance launcher paths)
+# --------------------------------------------------------------------------
+
+PREEMPT_ONCE = """
+import os, sys
+from paddle_tpu.distributed.fleet.elastic.preempt import \\
+    PREEMPTED_EXIT_CODE
+marker = sys.argv[1]
+if not os.path.exists(marker):
+    open(marker, "w").write("x")
+    sys.exit(PREEMPTED_EXIT_CODE)   # clean preemption, not a crash
+open(marker + ".done", "w").write(
+    os.environ.get("PADDLE_RESTART_ROUND", "?"))
+"""
+
+
+def test_preempted_exit_does_not_burn_crash_budget(tmp_path):
+    """A worker exiting with PREEMPTED_EXIT_CODE (emergency checkpoint
+    committed) relaunches on the preempt budget — --max_restarts 0
+    must NOT stop it, and the round counter reaches the workers."""
+    script = tmp_path / "preempt_once.py"
+    script.write_text(PREEMPT_ONCE)
+    marker = str(tmp_path / "marker")
+    r = subprocess.run(
+        LAUNCH + ["--max_restarts", "0", "--elastic_timeout", "0",
+                  "--log_dir", str(tmp_path / "log"),
+                  str(script), marker],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "clean preemption" in r.stderr
+    assert "preempt 1/16" in r.stderr
+    assert open(marker + ".done").read() == "1"
+
+
+def test_preempt_restart_budget_exhausted(tmp_path):
+    """Preemptions have their own bound: a worker that is preempted
+    every round must eventually fail loudly, not tight-loop."""
+    script = tmp_path / "always_preempt.py"
+    script.write_text(
+        "import sys\n"
+        "from paddle_tpu.distributed.fleet.elastic.preempt import \\\n"
+        "    PREEMPTED_EXIT_CODE\n"
+        "sys.exit(PREEMPTED_EXIT_CODE)\n")
+    r = subprocess.run(
+        LAUNCH + ["--max_restarts", "0", "--max_preempt_restarts", "2",
+                  "--elastic_timeout", "0",
+                  "--log_dir", str(tmp_path / "log"), str(script)],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 1
+    assert "preempt restarts exhausted" in r.stderr
+
+
+UNCAUGHT_PREEMPTED = """
+import sys
+from paddle_tpu.distributed.fleet.elastic import (Preempted,
+                                                  PreemptionGuard)
+import os
+marker = sys.argv[1]
+if not os.path.exists(marker):
+    open(marker, "w").write("x")
+    PreemptionGuard().install()      # chains the Preempted excepthook
+    raise Preempted("preempted mid-run", checkpoint="/ck", epoch=1,
+                    step=2)          # NOT caught by the trainer
+open(marker + ".done", "w").write("ok")
+"""
+
+
+def test_uncaught_preempted_exits_with_preempt_code(tmp_path):
+    """The documented contract without trainer boilerplate: letting
+    Preempted propagate must exit PREEMPTED_EXIT_CODE (launcher books
+    a clean preemption), not a generic 1 (a crash)."""
+    script = tmp_path / "uncaught.py"
+    script.write_text(UNCAUGHT_PREEMPTED)
+    marker = str(tmp_path / "marker")
+    r = subprocess.run(
+        LAUNCH + ["--max_restarts", "0", "--elastic_timeout", "0",
+                  "--log_dir", str(tmp_path / "log"),
+                  str(script), marker],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "clean preemption" in r.stderr
+    assert os.path.exists(marker + ".done")
+
+
+PARTIAL_PREEMPT = """
+import os, sys, time
+from paddle_tpu.distributed.fleet.elastic.preempt import \\
+    PREEMPTED_EXIT_CODE
+marker, out = sys.argv[1], sys.argv[2]
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+if not os.path.exists(marker):
+    if rank == 0:
+        open(marker, "w").write("x")
+        sys.exit(PREEMPTED_EXIT_CODE)   # rank 0 alone is preempted
+    time.sleep(300)   # rank 1 would block at its next collective
+with open(out + f".{rank}", "w") as f:
+    f.write("ok")
+"""
+
+
+def test_partial_preemption_ends_the_round(tmp_path):
+    """One rank preempted while its peer keeps running: the round must
+    end (the peer would block forever at its next collective, still
+    heartbeating) — survivors are terminated with the grace window and
+    the job relaunches as a preemption."""
+    script = tmp_path / "partial.py"
+    script.write_text(PARTIAL_PREEMPT)
+    marker = str(tmp_path / "marker")
+    out = str(tmp_path / "out")
+    r = subprocess.run(
+        LAUNCH + ["--nproc_per_node", "2", "--max_restarts", "0",
+                  "--elastic_timeout", "0", "--grace", "5",
+                  "--log_dir", str(tmp_path / "log"),
+                  str(script), marker, out],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "clean preemption" in r.stderr
+    assert os.path.exists(out + ".0") and os.path.exists(out + ".1")
+
+
+def test_min_nproc_ignored_multinode(tmp_path):
+    """Per-launcher shrinking is uncoordinated across nodes: with
+    --nnodes > 1 it must be refused loudly, not silently misaddress
+    global ranks."""
+    script = tmp_path / "ok.py"
+    script.write_text("pass\n")
+    r = subprocess.run(
+        LAUNCH + ["--nnodes", "2", "--rank", "0",
+                  "--min_nproc_per_node", "1", "--max_restarts", "0",
+                  "--elastic_timeout", "0",
+                  "--log_dir", str(tmp_path / "log"), str(script)],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "single-node only" in r.stderr
+
+
+SHRINK_PROBE = """
+import os, sys, time
+world = int(os.environ["PADDLE_TRAINERS_NUM"])
+rank = int(os.environ["PADDLE_TRAINER_ID"])
+out = sys.argv[1]
+if world == 2:
+    if rank == 1:
+        sys.exit(9)          # rank 1's host dies
+    time.sleep(60)           # survivor keeps running until terminated
+with open(out, "w") as f:    # reduced world completes the job
+    f.write(f"world={world}")
+"""
+
+
+def test_run_round_counts_all_simultaneous_failures(tmp_path):
+    """A shrinking relaunch must see EVERY rank lost in the round, not
+    just the first one scanned — undercounting respawns onto missing
+    capacity and burns the restart budget crashing again."""
+    import argparse
+    from paddle_tpu.distributed.launch.main import _run_round
+
+    class FakeProc:
+        def __init__(self, ret):
+            self.ret = ret
+
+        def poll(self):
+            return self.ret
+
+    class FakeLog:
+        def flush(self):
+            pass
+
+        def close(self):
+            pass
+
+    args = argparse.Namespace(log_dir=str(tmp_path / "log"),
+                              heartbeat_timeout=0.0)
+    procs = [(FakeProc(9), FakeLog()), (FakeProc(None), FakeLog()),
+             (FakeProc(7), FakeLog())]
+    outcome, bad = _run_round(procs, args, None, {"flag": False})
+    assert outcome == "failed"
+    assert bad == [0, 2]
+
+
+def test_relaunch_shrinks_to_surviving_world(tmp_path):
+    """--min_nproc_per_node: a crashed rank's slot is treated as lost
+    capacity; the next round respawns with the surviving world size
+    and the job completes on the reduced fleet."""
+    script = tmp_path / "shrink_probe.py"
+    script.write_text(SHRINK_PROBE)
+    out = str(tmp_path / "out")
+    r = subprocess.run(
+        LAUNCH + ["--nproc_per_node", "2", "--min_nproc_per_node", "1",
+                  "--max_restarts", "1", "--elastic_timeout", "0",
+                  "--log_dir", str(tmp_path / "log"),
+                  str(script), out],
+        env=ENV, capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "shrinking nproc_per_node 2 -> 1" in r.stderr
+    assert open(out).read() == "world=1"
+
+
+TERM_FORWARD = """
+import os, signal, sys, time
+from paddle_tpu.distributed.fleet.elastic.preempt import \\
+    PreemptionGuard, PREEMPTED_EXIT_CODE
+marker = sys.argv[1]
+guard = PreemptionGuard().install()
+open(marker, "w").write("started")
+for _ in range(600):
+    if guard.requested():
+        open(marker + ".term", "w").write("got SIGTERM")
+        sys.exit(PREEMPTED_EXIT_CODE)
+    time.sleep(0.1)
+sys.exit(3)
+"""
+
+
+def test_launcher_forwards_sigterm_with_grace(tmp_path):
+    """Preempting the LAUNCHER must fan out to workers: each gets the
+    grace window to emergency-checkpoint, then the launcher exits with
+    the preempted code instead of relaunching."""
+    script = tmp_path / "term_forward.py"
+    script.write_text(TERM_FORWARD)
+    marker = str(tmp_path / "marker")
+    proc = subprocess.Popen(
+        LAUNCH + ["--max_restarts", "3", "--elastic_timeout", "0",
+                  "--grace", "20",
+                  "--log_dir", str(tmp_path / "log"),
+                  str(script), marker],
+        env=ENV, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        deadline = time.time() + 60
+        while not os.path.exists(marker):
+            assert proc.poll() is None, proc.communicate()
+            assert time.time() < deadline, "worker never started"
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        _, err = proc.communicate(timeout=60)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    from paddle_tpu.distributed.fleet.elastic import PREEMPTED_EXIT_CODE
+    assert proc.returncode == PREEMPTED_EXIT_CODE, err
+    assert "forwarding to workers" in err.replace("\n", " ")
+    assert os.path.exists(marker + ".term"), \
+        "worker never observed the forwarded SIGTERM"
 
 
 def test_launcher_dumps_failed_worker_log(tmp_path):

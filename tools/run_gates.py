@@ -16,10 +16,12 @@ Usage::
     python tools/run_gates.py                     # after the tier-1 run
     python tools/run_gates.py --log /tmp/_t1.log --budget 300
     python tools/run_gates.py --no-budget         # no tier-1 log yet
+    python tools/run_gates.py --no-chaos          # skip the kill smoke
 
 ``--no-budget`` skips the fast-tier budget gate for contexts where no
-tier-1 log exists (e.g. pre-commit on a docs change); the atomic-write
-gate always runs.
+tier-1 log exists (e.g. pre-commit on a docs change); ``--no-chaos``
+skips the elastic kill-and-resume smoke (a multi-process pytest run —
+the one gate that spawns trainers); the atomic-write gate always runs.
 
 Exit codes: 0 = every gate passed, 1 = at least one gate failed.
 """
@@ -32,9 +34,11 @@ import subprocess
 import sys
 
 TOOLS_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_DIR = os.path.dirname(TOOLS_DIR)
 
 
-def gate_commands(log: str, budget: float, no_budget: bool):
+def gate_commands(log: str, budget: float, no_budget: bool,
+                  no_chaos: bool = False):
     """The authoritative gate list: (name, argv). New hygiene gates
     register HERE (tests/test_gates.py pins the known ones so a gate
     cannot be dropped silently)."""
@@ -49,6 +53,18 @@ def gate_commands(log: str, budget: float, no_budget: bool):
              [sys.executable,
               os.path.join(TOOLS_DIR, "check_fast_tier_budget.py"),
               "--log", log, "--budget", str(budget)]))
+    if not no_chaos:
+        # elastic chaos smoke: launcher kills a worker mid-step, the
+        # relaunch resumes on a reduced mesh from a validated
+        # checkpoint — the end-to-end fault-tolerance contract, run as
+        # real processes on CPU (the fault-marked fast subset; the
+        # 20-point randomized breadth stays in the slow tier)
+        gates.append(
+            ("elastic_chaos",
+             [sys.executable, "-m", "pytest",
+              os.path.join(REPO_DIR, "tests", "test_elastic_chaos.py"),
+              "-q", "-m", "fault and not slow",
+              "-p", "no:cacheprovider"]))
     return gates
 
 
@@ -64,11 +80,14 @@ def main(argv=None) -> int:
     ap.add_argument("--no-budget", action="store_true",
                     help="skip the fast-tier budget gate (no tier-1 "
                          "log in this context)")
+    ap.add_argument("--no-chaos", action="store_true",
+                    help="skip the elastic kill-and-resume smoke "
+                         "(the one gate that spawns worker processes)")
     args = ap.parse_args(argv)
 
     failures = 0
     for name, cmd in gate_commands(args.log, args.budget,
-                                   args.no_budget):
+                                   args.no_budget, args.no_chaos):
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True)
             rc = proc.returncode
