@@ -531,10 +531,10 @@ def _cb_bench(on_tpu, autotune=False):
             best = max(best, win_tps)
     # occupancy / admission-overlap / latency gauges (profiler
     # subsystem): the numbers BASELINE.md's CB-ceiling argument was
-    # previously deriving by hand, plus the ISSUE-3 TTFT/ITL
-    # percentiles and the compiled-signature count (1 batched prefill
-    # program + the adaptive decode-chunk ladder — the per-bucket
-    # baseline compiled one prefill per bucket AND per oversized length)
+    # previously deriving by hand, plus the TTFT/ITL percentiles and
+    # the compiled-signature count (ONE unified batching-step program
+    # — the PR-3 engine compiled 1 prefill + a decode-chunk ladder,
+    # the per-bucket baseline one prefill per bucket AND per length)
     gauges = eng.gauges()
     print(f"# continuous batching: {toks} tokens across "
           f"{len(specs)} mixed-length streams, {best:.0f} tokens/s "
@@ -542,8 +542,26 @@ def _cb_bench(on_tpu, autotune=False):
           f"overlap {gauges['prefill_overlap_frac'] * 100:.0f}%, "
           f"ttft p50 {gauges['ttft_ms_p50']:.1f}ms, itl p50 "
           f"{gauges['itl_ms_p50']:.2f}ms, {gauges['compiled_programs']} "
-          f"compiled programs)", file=sys.stderr)
-    return best, gauges, tuned_cb
+          f"compiled programs, {gauges['unified_steps']} unified steps)",
+          file=sys.stderr)
+    # A/B the PR-3 legacy engine on the SAME workload (acceptance
+    # evidence for the unified-kernel rebuild: cb tok/s >= legacy).
+    # Same warmup + best-rep protocol, own compiled programs.
+    legacy_tps = None
+    try:
+        leg = ContinuousBatchingEngine(
+            model, num_slots=slots, page_size=page, max_len=max_len,
+            decode_chunk=chunk, prompt_buckets=buckets, greedy=True,
+            unified=False)
+        legacy_tps, _, _ = timed_engine(leg)
+        print(f"# continuous batching (legacy engine): "
+              f"{legacy_tps:.0f} tokens/s "
+              f"({leg.gauges()['compiled_programs']} compiled "
+              f"programs) -> unified is x{best / legacy_tps:.2f}",
+              file=sys.stderr)
+    except Exception as exc:  # A/B is telemetry, never fails the bench
+        print(f"# legacy-engine A/B failed: {exc!r}", file=sys.stderr)
+    return best, gauges, tuned_cb, legacy_tps
 
 
 def _moe_bench_config(on_tpu):
@@ -776,6 +794,14 @@ def _autotune_bench(on_tpu):
              sweeps.grouped_matmul_builder(rows=16384), 12),
             ("flash_attention", {"sq": 2048, "sk": 2048, "d": 128},
              sweeps.flash_attention_builder(batch=2, heads=20), 8),
+            # the cb section's unified batching-step kernel at its v5e
+            # bench geometry (llama_1b: chunk 32, 12 x 32-token pages,
+            # head_dim 128, 16:8 GQA) — swept BEFORE the cb section so
+            # the committed winner feeds the engine's traced kernel
+            ("ragged_paged_attention",
+             {"c": 32, "pages": 12, "page": 32, "d": 128},
+             sweeps.ragged_attention_builder(slots=8, heads=16,
+                                             kv_heads=8), 10),
         ]
     else:
         jobs = [
@@ -783,6 +809,10 @@ def _autotune_bench(on_tpu):
              sweeps.grouped_matmul_builder(rows=1024), 3),
             ("flash_attention", {"sq": 128, "sk": 128, "d": 64},
              sweeps.flash_attention_builder(batch=1, heads=2), 2),
+            ("ragged_paged_attention",
+             {"c": 8, "pages": 4, "page": 8, "d": 16},
+             sweeps.ragged_attention_builder(slots=2, heads=4,
+                                             kv_heads=2), 2),
         ]
 
     out = {"tuned_cache_path": engine.cache.path,
@@ -949,13 +979,13 @@ def main():
         print(json.dumps(record), flush=True)
 
     try:
-        cb_tok_s, cb_gauges, cb_tuned = _timed_section(
+        cb_tok_s, cb_gauges, cb_tuned, cb_legacy = _timed_section(
             "cb", lambda: _retry_transient(
                 lambda: _cb_bench(on_tpu, autotune=args.autotune),
                 "cb bench"))
     except Exception as e:
         print(f"# continuous-batching bench failed: {e!r}", file=sys.stderr)
-        cb_tok_s = cb_gauges = cb_tuned = None
+        cb_tok_s = cb_gauges = cb_tuned = cb_legacy = None
     if cb_tok_s is not None:
         record["cb_metric"] = ("llama_1B_continuous_batching_mixed_lengths"
                                + suffix)
@@ -971,6 +1001,18 @@ def main():
         record["cb_itl_ms_p50"] = round(cb_gauges["itl_ms_p50"], 3)
         record["cb_itl_ms_p99"] = round(cb_gauges["itl_ms_p99"], 3)
         record["cb_compiles"] = cb_gauges["compiled_programs"]
+        # ISSUE-7 unified-batching-step keys: the engine now runs ONE
+        # compiled program per scheduler turn (cb_compiles expected
+        # ~1 steady-state), with the PR-3 engine A/B'd on the same
+        # workload as the regression reference
+        # (aliases of cb_value / cb_gauges.unified_steps so rounds
+        # grep ONE name — assigned from the record, cannot diverge)
+        record["cb_unified_tok_s"] = record["cb_value"]
+        record["cb_unified_steps"] = cb_gauges["unified_steps"]
+        if cb_legacy:
+            record["cb_legacy_tok_s"] = round(cb_legacy, 2)
+            record["cb_unified_vs_legacy"] = round(
+                cb_tok_s / cb_legacy, 4)
         record["cb_gauges"] = {
             k: (round(v, 4) if isinstance(v, float) else v)
             for k, v in cb_gauges.items()}

@@ -157,8 +157,23 @@ define_flag("FLAGS_flash_attn_pallas_bwd", True,
             "Flash-attn backward via the hand-written Pallas dkv/dq "
             "kernels (False = blockwise lax.scan recompute fallback).")
 define_flag("FLAGS_use_pallas_paged_attention", 1,
-            "Serving decode: use the Pallas paged-attention kernel on "
-            "TPU (0 = jnp gather/softmax reference path).")
+            "ops.paged_attention.paged_attention (the standalone "
+            "decode-step op + incubate API): use the jax Pallas "
+            "decode kernel on TPU (0 = jnp gather/softmax reference). "
+            "The serving engine's decode path no longer rides this op "
+            "— it goes through the unified ragged entry point, gated "
+            "by FLAGS_use_pallas_ragged_attention.")
+define_flag("FLAGS_use_pallas_ragged_attention", 1,
+            "Serving batching step: use the Pallas ragged "
+            "paged-attention kernel (mixed prefill+decode, ONE "
+            "program) on TPU (0 = jnp gather/softmax reference path).")
+# These are a tunable surface ("ragged_paged_attention",
+# paddle_tpu.tuner): an explicit env / set_flags value wins over a
+# tuner-cache entry, which wins over the defaults here.
+define_flag("FLAGS_ragged_attn_q_block", 16,
+            "Ragged paged-attention: stream tokens per q program.")
+define_flag("FLAGS_ragged_attn_kv_pages", 4,
+            "Ragged paged-attention: KV pages per DMA compute block.")
 define_flag("FLAGS_fused_linear_cross_entropy", False,
             "LM training loss: chunked fused lm_head-matmul +"
             " cross-entropy that never materializes [N, V] logits "

@@ -6,7 +6,29 @@ length streams; reference mount empty, no cites — SURVEY.md §2.1
 inference row, PAPERS.md ragged-paged-attention).
 
 TPU-native design — the vLLM recipe restructured for XLA's static-shape
-world:
+world. Two engine modes share the pool/slot machinery:
+
+**Unified mode (default, ``unified=True``)** — ONE compiled
+batching-step program for the whole scheduler turn, built on the ragged
+paged-attention entry point (PAPERS.md "Ragged Paged Attention"): a
+mixed ragged pass advances every slot — prefill slots stream their next
+``prefill_chunk`` prompt tokens, active decode slots ride their pending
+token as a length-1 sequence, idle slots are length 0 — through one
+``[num_slots, prefill_chunk]`` forward, samples where a prompt
+completes or a decode step fires, then chains ``decode_chunk - 1``
+in-program decode micro-steps via ``lax.scan``. Prefill→decode
+transition happens ON DEVICE inside the program (a slot whose prompt
+ends in the mixed pass decodes from micro-step 1), so the PR-3
+prefill-wave/decode-chunk interleave, its first-token echo machinery,
+and the residual compiled-signature zoo all collapse: steady-state
+``compiled_programs`` == 1.
+
+**Legacy mode (``unified=False``)** — the PR-3 two-program-family
+engine (batched prefill waves interleaved with adaptive decode chunks),
+kept as the scheduling-parity oracle for the ``serving_parity`` CI gate
+and for A/B benching.
+
+Shared structure:
 
 - The KV cache is a global PAGE POOL per layer ([KVH, num_pages,
   page_size, D]); each admitted request owns a page list (its block
@@ -86,9 +108,10 @@ class ServedRequest:
 
 
 class ContinuousBatchingEngine:
-    """Schedules mixed-length generation streams through one compiled
-    decode program and one compiled batched-prefill program. Greedy or
-    temperature sampling.
+    """Schedules mixed-length generation streams through ONE compiled
+    unified batching-step program (ragged mixed prefill+decode; default)
+    or, with ``unified=False``, the legacy prefill-wave/decode-chunk
+    pair. Greedy or temperature sampling.
 
     model: any CausalLM Layer implementing ``forward(ids, caches=, pos=,
     tables=)`` + ``init_kv_cache`` — Llama, Qwen2 (incl. MoE), and GPT2
@@ -103,7 +126,7 @@ class ContinuousBatchingEngine:
                  max_len=512, decode_chunk=None, prompt_buckets=(32, 64, 128),
                  eos_token_id=None, greedy=True, temperature=1.0,
                  seed=0, prefill_chunk=None, admit_batch=None,
-                 adaptive_chunk=True):
+                 adaptive_chunk=True, unified=True):
         self.model = model
         cfg = model.config
         self.cfg = cfg
@@ -207,9 +230,17 @@ class ContinuousBatchingEngine:
         self.completed: list[ServedRequest] = []
         self._next_id = 0
         self._key = jax.random.PRNGKey(seed)
-        self._prefill_fn = None        # ONE signature, lazily built
-        self._chunk_fns = {}           # chunk length -> compiled program
+        self._prefill_fn = None        # legacy: ONE prefill signature
+        self._chunk_fns = {}           # legacy: chunk len -> program
         self._compiled = set()         # distinct compiled signatures
+        # unified mode: ONE batching-step program (mixed ragged pass +
+        # decode_chunk-1 in-program decode micro-steps); per-slot count
+        # of dispatched-but-unharvested steps that may emit tokens for
+        # the slot — drain defers while any are in flight
+        self._unified = bool(unified)
+        self._n_decode = max(0, self.decode_chunk - 1)
+        self._unified_fn = None
+        self._emits_inflight = np.zeros((B,), np.int32)
 
         # perf observability (profiler subsystem): raw counters behind
         # the :meth:`gauges` surface — slot occupancy, admission/prefill
@@ -220,6 +251,7 @@ class ContinuousBatchingEngine:
                        "active_slot_steps": 0, "tokens_emitted": 0,
                        "prefills": 0, "prefills_overlapped": 0,
                        "prefill_waves": 0, "chunks_empty": 0,
+                       "unified_steps": 0,
                        "requests_completed": 0, "run_seconds": 0.0}
         self._ttft_ms: list[float] = []
         self._itl_ms: list[float] = []
@@ -254,10 +286,15 @@ class ContinuousBatchingEngine:
             or bool(self._prefilling.any())
 
     def step(self):
-        """Admit what fits, stream all pending prefill chunks, decode one
-        chunk, drain finished slots. Returns the requests completed by
-        this step."""
+        """Admit what fits, advance every slot one scheduler turn (one
+        unified batching-step program, or prefill waves + one decode
+        chunk in legacy mode), drain finished slots. Returns the
+        requests completed by this step."""
         self._admit()
+        if self._unified:
+            if self._worth_step():
+                self._harvest_step(self._dispatch_step())
+            return self._drain()
         self._pump_prefill()
         if self.active.any():
             self._decode_chunk()
@@ -283,7 +320,52 @@ class ContinuousBatchingEngine:
         lengths that proof fires exactly at each drain wave, so the
         round-4 "one wasted chunk program per drain wave" cost is gone
         (``chunks_empty`` measures any residue, e.g. eos stops the host
-        cannot predict)."""
+        cannot predict).
+
+        Unified mode runs the SAME driver with its own hooks: the
+        speculative successor is a whole batching-step program, there
+        is no separate prefill pump (prompt streaming, activation, the
+        first-token sample and the decode tail all live inside the
+        step), and the successor is skipped when no prefilling slot
+        exists and every active slot's predicted budget is exhausted."""
+        if self._unified:
+            return self._run_driver(
+                spec_dispatch=lambda: self._dispatch_step()
+                if self._worth_step() else None,
+                harvest=self._harvest_step,
+                after_admit=lambda: None,
+                idle_turn=self._idle_turn_unified)
+        return self._run_driver(
+            spec_dispatch=lambda: self._dispatch_chunk()
+            if self._worth_dispatching() else None,
+            harvest=self._harvest_chunk,
+            # ONE prefill wave per scheduler turn: prompt streaming
+            # interleaves with decode chunks instead of stalling them
+            after_admit=lambda: self._pump_prefill(max_waves=1),
+            idle_turn=self._idle_turn_legacy)
+
+    def _idle_turn_unified(self):
+        """Nothing in flight: dispatch a step if it would advance
+        anything. Returns (progressed, inflight record or None)."""
+        if self._worth_step():
+            return True, self._dispatch_step()
+        return False, None
+
+    def _idle_turn_legacy(self):
+        """Nothing in flight: stream one prefill wave if prompts are
+        pending, else dispatch a decode chunk if slots are active."""
+        if self._prefilling.any():
+            self._pump_prefill(max_waves=1)
+            return True, None
+        if self.active.any():
+            return True, self._dispatch_chunk()
+        return False, None
+
+    def _run_driver(self, spec_dispatch, harvest, after_admit,
+                    idle_turn):
+        """The one scheduler loop both modes share — hooks differ, the
+        pipelining skeleton, overlap-admission accounting and stall
+        detection must not (a fix here fixes both engines)."""
         done = []
         inflight = None
         t_run0 = time.perf_counter()
@@ -292,19 +374,15 @@ class ContinuousBatchingEngine:
                 if inflight is not None:
                     # speculative successor first: device never idles
                     # while the host harvests, drains, and admits
-                    nxt = self._dispatch_chunk() \
-                        if self._worth_dispatching() else None
-                    self._harvest_chunk(inflight)
+                    nxt = spec_dispatch()
+                    harvest(inflight)
                     done.extend(self._drain())
-                    # prefills overlap nxt's on-device run — the gauge
-                    # distinguishing overlapped from serialized admission
+                    # admissions overlap nxt's on-device run — the
+                    # gauge distinguishing overlapped from serialized
                     self._overlap_admission = nxt is not None
                     try:
                         self._admit()
-                        # ONE prefill wave per scheduler turn: prompt
-                        # streaming interleaves with decode chunks
-                        # instead of stalling them
-                        self._pump_prefill(max_waves=1)
+                        after_admit()
                     finally:
                         self._overlap_admission = False
                     inflight = nxt
@@ -312,11 +390,8 @@ class ContinuousBatchingEngine:
                 n_before = len(done)
                 self._admit()
                 done.extend(self._drain())
-                if self._prefilling.any():
-                    self._pump_prefill(max_waves=1)
-                    continue
-                if self.active.any():
-                    inflight = self._dispatch_chunk()
+                progressed, inflight = idle_turn()
+                if progressed:
                     continue
                 if not self.queue:
                     break
@@ -331,6 +406,258 @@ class ContinuousBatchingEngine:
             self._stats["run_seconds"] += time.perf_counter() - t_run0
             self._emit_gauges()
         return done
+
+    # ---- unified batching step (ONE compiled program) --------------------
+
+    def _worth_step(self):
+        """Would a unified step advance anything? Prefilling slots
+        always do; decode slots only while the host's ctx prediction
+        leaves budget (an eos stop the host cannot see may still yield
+        an empty step — counted in ``chunks_empty``)."""
+        return bool(self._prefilling.any()
+                    or np.any(self.active
+                              & (self.limits > self._pred_ctx)))
+
+    def _unified_static(self):
+        """The ONE compiled batching-step program: a ragged mixed pass
+        (prefill slots stream their next ``prefill_chunk`` prompt
+        tokens, active decode slots ride their pending token as a
+        length-1 sequence, idle slots are length 0 — one
+        [num_slots, prefill_chunk] forward through
+        ``ragged_paged_attention``) followed by ``decode_chunk - 1``
+        in-program decode micro-steps. A slot whose prompt completes in
+        the mixed pass samples its first token and starts decoding at
+        micro-step 1 — prefill→decode transition never leaves the
+        device, so no first-token echo machinery exists in this mode.
+        The packed output carries every emitted token of the step plus
+        the ctx/active mirrors in ONE int32 fetch."""
+        if self._unified_fn is not None:
+            return self._unified_fn
+        from ..jit import to_static
+        model = self.model
+        greedy = self.greedy
+        temperature = self.temperature
+        C = self.prefill_chunk
+        n_dec = self._n_decode
+
+        def ustep(ids_t, nq_t, last_t, tgt_t, tok_t, ctx_t, act_t,
+                  tbl_t, lim_t, eos_t, key_t, *pools):
+            fwd = model.forward
+
+            def fn(ids, nq, last, tgt, tok, ctx, act, tbl, lim,
+                   eos_arr, key, *pool_leaves):
+                b = tok.shape[0]
+                # stale instant-eos guard (legacy chunk-entry contract)
+                act = act & ((eos_arr < 0) | (tok != eos_arr))
+                is_pre = nq > 0
+                lengths = jnp.where(
+                    is_pre, nq,
+                    jnp.where(act, 1, 0)).astype(jnp.int32)
+                # decode slots carry their device-resident pending
+                # token in stream column 0
+                ids_eff = ids.at[:, 0].set(
+                    jnp.where(is_pre, ids[:, 0], tok))
+                with no_grad():
+                    logits, npools = fwd(
+                        Tensor(ids_eff),
+                        caches=[Tensor(a) for a in pool_leaves],
+                        pos=Tensor(ctx[:, None]),
+                        tables=(Tensor(tbl), Tensor(lengths)))
+                lg = logits._data                      # [B, C, V]
+                idx = jnp.clip(lengths - 1, 0, C - 1)
+                last_lg = jnp.take_along_axis(
+                    lg, idx[:, None, None], axis=1)[:, 0]
+                last_lg = last_lg.astype(jnp.float32)
+                if greedy:
+                    sampled = jnp.argmax(last_lg, -1).astype(jnp.int32)
+                else:
+                    key, sub = jax.random.split(key)
+                    sampled = jax.random.categorical(
+                        sub, last_lg / temperature).astype(jnp.int32)
+                # a next-token fires for completing prompts and for
+                # advancing decode slots
+                fire = (is_pre & last) | (act & ~is_pre)
+                nxt = jnp.where(fire, sampled, tok)
+                ctx1 = ctx + lengths
+                hit_eos = (eos_arr >= 0) & (nxt == eos_arr)
+                still_dec = act & ~is_pre & (ctx1 < lim) & ~hit_eos
+                act_pre = is_pre & last & tgt & (ctx1 < lim) & ~hit_eos
+                act1 = jnp.where(is_pre, act_pre, still_dec)
+                out0 = jnp.where(fire, nxt, -1)
+
+                def body(carry, _):
+                    tok_c, ctx_c, act_c, key_c, leaves = carry
+                    with no_grad():
+                        lgs, ncaches = fwd(
+                            Tensor(tok_c.reshape(b, 1)),
+                            caches=[Tensor(a) for a in leaves],
+                            pos=Tensor(ctx_c[:, None]),
+                            tables=(Tensor(tbl), Tensor(act_c)))
+                    lg_c = lgs[:, -1]._data.astype(jnp.float32)
+                    if greedy:
+                        nx = jnp.argmax(lg_c, -1).astype(jnp.int32)
+                    else:
+                        key_c, sub_c = jax.random.split(key_c)
+                        nx = jax.random.categorical(
+                            sub_c, lg_c / temperature).astype(jnp.int32)
+                    ctx_n = ctx_c + act_c.astype(jnp.int32)
+                    nx = jnp.where(act_c, nx, tok_c)
+                    still = act_c & (ctx_n < lim) & \
+                        ((eos_arr < 0) | (nx != eos_arr))
+                    new_leaves = tuple(t._data for t in ncaches)
+                    out_tok = jnp.where(act_c, nx, -1)
+                    return (nx, ctx_n, still, key_c, new_leaves), \
+                        (out_tok, act_c)
+
+                carry0 = (nxt, ctx1, act1, key,
+                          tuple(t._data for t in npools))
+                if n_dec:
+                    carry, (toks, emitted) = jax.lax.scan(
+                        body, carry0, jnp.arange(n_dec))
+                    tok_f, ctx_f, act_f, key_f, leaves_f = carry
+                    toks_all = jnp.concatenate(
+                        [out0[:, None], toks.T], axis=1)
+                    emit_all = jnp.concatenate(
+                        [fire[:, None], emitted.T], axis=1)
+                else:
+                    tok_f, ctx_f, act_f, key_f, leaves_f = carry0
+                    toks_all = out0[:, None]
+                    emit_all = fire[:, None]
+                packed_out = jnp.concatenate(
+                    [toks_all.astype(jnp.int32),
+                     emit_all.astype(jnp.int32),
+                     ctx_f[:, None].astype(jnp.int32),
+                     act_f[:, None].astype(jnp.int32)], axis=1)
+                return (packed_out, tok_f, ctx_f, act_f, key_f) \
+                    + tuple(leaves_f)
+
+            return _apply_multi(
+                fn, [ids_t, nq_t, last_t, tgt_t, tok_t, ctx_t, act_t,
+                     tbl_t, lim_t, eos_t, key_t] + list(pools),
+                n_out=5 + len(pools))
+
+        self._unified_fn = to_static(ustep)
+        self._compiled.add(("unified", C, 1 + n_dec))
+        return self._unified_fn
+
+    def _dispatch_step(self):
+        """Launch one unified step (async) and chain the device state.
+        Returns an in-flight record for :meth:`_harvest_step` — the
+        packed output is NOT fetched here, so a caller may overlap the
+        fetch with the next step's on-device compute."""
+        B, C = self.num_slots, self.prefill_chunk
+        ids = np.zeros((B, C), np.int32)
+        nq = np.zeros((B,), np.int32)
+        last = np.zeros((B,), bool)
+        tgt = np.zeros((B,), bool)
+        n_pre = 0
+        for slot in range(B):
+            if not self._prefilling[slot] or n_pre >= self.admit_batch:
+                continue
+            req = self.slot_req[slot]
+            off = int(self._prefill_off[slot])
+            v = min(C, len(req.prompt) - off)
+            ids[slot, :v] = req.prompt[off:off + v]
+            nq[slot] = v
+            last[slot] = off + v == len(req.prompt)
+            tgt[slot] = self._act_target[slot]
+            n_pre += 1
+        fn = self._unified_static()
+        self._seq += 1
+        n_steps = 1 + self._n_decode
+        self._stats["chunks"] += 1
+        self._stats["unified_steps"] += 1
+        self._stats["chunk_slot_steps"] += B * n_steps
+        if n_pre:
+            self._stats["prefill_waves"] += 1
+        # a slot advances this step if it decodes with budget left OR
+        # streams prompt tokens (a completing prompt decodes the
+        # in-program tail too, so its tokens must be credited here)
+        n_active = int(np.sum((self.active
+                               & (self.limits > self._pred_ctx))
+                              | (nq > 0)))
+        self._stats["active_slot_steps"] += n_active * n_steps
+        from ..profiler.trace import get_tracer
+        _tr = get_tracer()
+        if _tr.enabled:
+            _tr.counter("serving/active_slots", n_active,
+                        queued=len(self.queue), chunk_len=n_steps,
+                        prefilling=n_pre)
+        res = fn(Tensor(jnp.asarray(ids)), Tensor(jnp.asarray(nq)),
+                 Tensor(jnp.asarray(last)), Tensor(jnp.asarray(tgt)),
+                 Tensor(self._dev_tok), Tensor(self._dev_ctx),
+                 Tensor(self._dev_act), Tensor(self._dev_tbl),
+                 Tensor(self._dev_lim), Tensor(self._dev_eos),
+                 Tensor(self._key), *self.pools)
+        packed, tok_f, ctx_f, act_f, key_f = res[:5]
+        self.pools = list(res[5:])
+        self._dev_tok = tok_f._data
+        self._dev_ctx = ctx_f._data
+        self._dev_act = act_f._data
+        self._key = key_f._data
+        # host bookkeeping: prompt-stream progress is exact; decode
+        # activity is a prediction refined by the harvested mirrors
+        emits = np.zeros((B,), bool)
+        for slot in range(B):
+            if nq[slot] > 0:
+                self._prefill_off[slot] += nq[slot]
+                if last[slot]:
+                    req = self.slot_req[slot]
+                    tl = len(req.prompt)
+                    self._prefilling[slot] = False
+                    self.ctx[slot] = tl
+                    # the first token + in-program decode tail land in
+                    # THIS step; mirrors from any EARLIER in-flight
+                    # step must not clobber the activation
+                    self.active[slot] = bool(tgt[slot])
+                    self._act_since[slot] = self._seq
+                    self._pred_ctx[slot] = min(
+                        int(self.limits[slot]), tl + self._n_decode)
+                    emits[slot] = True
+            elif self.active[slot] \
+                    and self.limits[slot] > self._pred_ctx[slot]:
+                self._pred_ctx[slot] = min(
+                    int(self.limits[slot]),
+                    int(self._pred_ctx[slot]) + n_steps)
+                emits[slot] = True
+        self._emits_inflight += emits.astype(np.int32)
+        return (packed, list(self.slot_req), emits, n_steps, self._seq)
+
+    def _harvest_step(self, rec):
+        """Fetch one in-flight unified step's packed output and apply
+        it: append emitted tokens, refresh the ctx/active mirrors
+        (unless the slot was re-admitted, or activated by a LATER
+        dispatch, since this step went out)."""
+        packed, snap_req, emits, n_steps, seq = rec
+        arr = np.asarray(packed._data)            # the ONE fetch
+        toks_np = arr[:, :n_steps]
+        emitted_np = arr[:, n_steps:2 * n_steps].astype(bool)
+        ctx_m = arr[:, 2 * n_steps].astype(np.int32)
+        act_m = arr[:, 2 * n_steps + 1].astype(bool)
+        t_now = time.perf_counter()
+        appended = 0
+        for slot in range(self.num_slots):
+            req = snap_req[slot]
+            if req is not self.slot_req[slot]:
+                continue      # slot re-admitted since this dispatch
+            if emits[slot]:
+                self._emits_inflight[slot] -= 1
+            if self._act_since[slot] <= seq:
+                self.ctx[slot] = ctx_m[slot]
+                self.active[slot] = act_m[slot]
+                self._pred_ctx[slot] = max(int(self._pred_ctx[slot]),
+                                           int(ctx_m[slot]))
+            if req is None or req.finished:
+                continue
+            for j in range(n_steps):
+                if emitted_np[slot, j]:
+                    if not req.tokens:
+                        req.t_first = t_now
+                    req.tokens.append(int(toks_np[slot, j]))
+                    self._stats["tokens_emitted"] += 1
+                    appended += 1
+        if appended == 0:
+            self._stats["chunks_empty"] += 1
 
     def gauges(self) -> dict:
         """Serving observability surface (profiler subsystem):
@@ -347,12 +674,17 @@ class ContinuousBatchingEngine:
         - ``itl_ms_p50/p99``: smoothed inter-token latency percentiles —
           (t_done - t_first) / (tokens - 1) per request with ≥2 tokens.
         - ``compiled_programs``: distinct compiled signatures this
-          engine built (1 prefill + the decode-chunk-length ladder) —
-          the compile-budget CI gate asserts on this.
-        - ``chunks_empty``: harvested decode chunks that delivered no
+          engine built — steady-state 1 in unified mode (the single
+          batching-step program); 1 prefill + the decode-chunk-length
+          ladder in legacy mode. The compile-budget CI gate asserts on
+          this.
+        - ``chunks_empty``: harvested programs that delivered no
           tokens (unpredictable eos stops; structurally-wasted drain
           wave dispatches are eliminated).
-        - ``prefill_waves``: batched prefill-chunk programs dispatched.
+        - ``prefill_waves``: programs that carried prompt tokens (in
+          unified mode, unified steps with ≥1 prefilling slot).
+        - ``unified_steps``: unified batching-step programs dispatched
+          (0 in legacy mode).
         """
         s = self._stats
         steps = s["chunk_slot_steps"]
@@ -378,6 +710,7 @@ class ContinuousBatchingEngine:
             "chunks_dispatched": s["chunks"],
             "chunks_empty": s["chunks_empty"],
             "prefill_waves": s["prefill_waves"],
+            "unified_steps": s["unified_steps"],
             "tokens_emitted": s["tokens_emitted"],
             "prefills": s["prefills"],
             "requests_completed": s["requests_completed"],
@@ -443,6 +776,7 @@ class ContinuousBatchingEngine:
             self.slot_req[slot] = req
             self._prefilling[slot] = True
             self._prefill_off[slot] = 0
+            self._emits_inflight[slot] = 0
             self._act_target[slot] = req.max_new_tokens > 1
             self.ctx[slot] = 0
             self._pred_ctx[slot] = 0
@@ -783,9 +1117,10 @@ class ContinuousBatchingEngine:
                 # prompt still streaming through prefill waves — the
                 # slot is inactive but very much occupied
                 continue
-            if self._echo_inflight[slot]:
-                # first-token echo rides a dispatched-but-unharvested
-                # chunk: finishing now would lose it (defer one loop)
+            if self._echo_inflight[slot] or self._emits_inflight[slot]:
+                # tokens for this slot ride a dispatched-but-
+                # unharvested program: finishing now would lose them
+                # (defer one loop)
                 continue
             if not self.active[slot]:
                 if self._pending_first[slot]:

@@ -153,13 +153,17 @@ def _paged_attention_step(attn, q, k, v, cache, pos, tables, rope=True,
     position models; ``proj`` overrides the output projection
     (defaults to attn.o_proj).
 
-    ``tables`` is ``(block_tables, gate)``. With a single query token
-    (decode) the gate is the boolean active mask and the step runs the
-    decode write + decode kernel. With a multi-token chunk (chunked
-    prefill, q [B, C, H, D]) the gate is an int32 per-slot VALID count
-    (tokens of the chunk that are real): the chunk's k/v are written
-    into the pages incrementally and the queries run causally over the
-    paged history (``paged_prefill_attention``)."""
+    ``tables`` is ``(block_tables, gate)``. The gate is per-slot
+    validity: a boolean active mask (decode convention) or an int32
+    VALID count (tokens of the chunk that are real) — both normalize to
+    counts, and a single UNIFIED ragged path serves every shape: each
+    slot's k/v tokens are written into its pages at ``ctx .. ctx +
+    valid - 1`` (padding and inactive slots routed to the reserved
+    trash page) and its queries attend causally over the paged history
+    through ``ops.paged_attention.ragged_paged_attention`` — one
+    attention entry point whether the slot carries a prefill chunk
+    (valid > 1), a decode step (valid == 1) or is idle (valid == 0),
+    so mixed batches compile ONE program."""
     b, s = q.shape[0], q.shape[1]
     tbl, gate = tables
     if rope:
@@ -168,24 +172,14 @@ def _paged_attention_step(attn, q, k, v, cache, pos, tables, rope=True,
         k = rope_with_offset(k, pos, attn.cfg.max_position_embeddings,
                              attn.cfg.rope_theta)
 
-    if s == 1:
-        def fn(qa, ka, va, kpa, vpa, tba, gatea, cta):
-            from ..ops import paged_attention as PA
-            ct = cta[:, 0]
-            act = gatea if gatea.dtype == jnp.bool_ else gatea > 0
-            kpa, vpa = PA.paged_decode_write(kpa, vpa, ka, va, tba, ct,
-                                             act)
-            out = PA.paged_attention(qa[:, 0], kpa, vpa, tba, ct + 1)
-            return out[:, None], kpa, vpa
-    else:
-        def fn(qa, ka, va, kpa, vpa, tba, gatea, cta):
-            from ..ops import paged_attention as PA
-            ct = cta[:, 0]
-            valid = gatea.astype(jnp.int32)
-            kpa, vpa = PA.paged_prefill_write(kpa, vpa, ka, va, tba, ct,
-                                              valid)
-            out = PA.paged_prefill_attention(qa, kpa, vpa, tba, ct)
-            return out, kpa, vpa
+    def fn(qa, ka, va, kpa, vpa, tba, gatea, cta):
+        from ..ops import paged_attention as PA
+        ct = cta[:, 0]
+        valid = gatea.astype(jnp.int32)
+        kpa, vpa = PA.paged_prefill_write(kpa, vpa, ka, va, tba, ct,
+                                          valid)
+        out = PA.ragged_paged_attention(qa, kpa, vpa, tba, ct, valid)
+        return out, kpa, vpa
 
     ctx_out, kp2, vp2 = apply(
         fn, q, k, v, cache[0], cache[1], tbl, gate, pos,

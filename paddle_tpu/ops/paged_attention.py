@@ -32,6 +32,7 @@ import jax.numpy as jnp
 
 __all__ = ["paged_attention", "paged_attention_reference",
            "paged_prefill_attention", "paged_prefill_attention_reference",
+           "ragged_paged_attention", "ragged_paged_attention_reference",
            "paged_decode_write", "paged_prefill_write"]
 
 _NEG_INF = -1e30
@@ -146,18 +147,69 @@ def paged_prefill_attention_reference(q, key_pages, value_pages,
 
 def paged_prefill_attention(q, key_pages, value_pages, block_tables,
                             context_lens, scale=None):
-    """Multi-token-query paged attention (chunked prefill).
+    """Multi-token-query paged attention (chunked prefill) — every
+    chunk token treated as valid. Kept as the whole-chunk entry point;
+    the serving hot path goes through :func:`ragged_paged_attention`,
+    which adds per-sequence valid counts (mixed prefill+decode+idle
+    slots in one call) and the Pallas kernel dispatch."""
+    b, c = q.shape[0], q.shape[1]
+    lengths = jnp.full((b,), c, jnp.int32)
+    return ragged_paged_attention(q, key_pages, value_pages,
+                                  block_tables, context_lens, lengths,
+                                  scale)
 
-    Layout is Pallas-ready — q [B, C, H, D] with the page pool and
-    block-table/context-length operands in the exact shapes the jax
-    ragged-paged-attention TPU kernels take (PAPERS.md
-    ragged-paged-attention); when that kernel is wired in it slots into
-    this dispatcher the way the decode kernel does in
-    :func:`paged_attention`. Until then every platform runs the jnp
-    reference — on TPU the chunk is C·max_len work per slot, still far
-    cheaper than the per-bucket dense recompute it replaces."""
-    return paged_prefill_attention_reference(
-        q, key_pages, value_pages, block_tables, context_lens, scale)
+
+def ragged_paged_attention_reference(q, key_pages, value_pages,
+                                     block_tables, ctx_lens, lengths,
+                                     scale=None):
+    """Pure-jnp oracle for the RAGGED mixed prefill+decode batching
+    step: q [B, C, H, D] is the uniform-stride view of the flattened
+    token stream (slot b's tokens are the ``[start=b*C, length=
+    lengths[b]]`` window), ``ctx_lens`` the cache length BEFORE the
+    chunk, ``lengths`` the per-slot valid token count — 0 (idle slot),
+    1 (decode step) or >1 (prefill chunk) all flow through the same
+    reduction. Rows past the valid count are zeroed.
+
+    Reduces over the SAME gathered [max_len] axis as the prefill and
+    decode oracles (it *is* the prefill oracle plus the validity mask),
+    so with lengths == C it equals
+    :func:`paged_prefill_attention_reference` exactly and with
+    lengths == 1 it reduces exactly to the decode oracle at ctx+1 —
+    the basis of the kernel parity tests."""
+    c = q.shape[1]
+    out = paged_prefill_attention_reference(
+        q, key_pages, value_pages, block_tables, ctx_lens, scale)
+    valid = jnp.arange(c)[None, :] < lengths[:, None]      # [B, C]
+    return jnp.where(valid[:, :, None, None], out, 0).astype(out.dtype)
+
+
+def ragged_paged_attention(q, key_pages, value_pages, block_tables,
+                           ctx_lens, lengths, scale=None):
+    """Mixed prefill+decode paged attention — the serving engine's ONE
+    attention entry point (PAPERS.md ragged-paged-attention). Pallas
+    kernel on TPU (``FLAGS_use_pallas_ragged_attention``), jnp oracle
+    elsewhere; the kernel module itself always runs (interpret mode)
+    in the parity tests, the flash_attention discipline."""
+    from ..framework import flags
+    platform = jax.devices()[0].platform
+    use_kernel = (platform == "tpu"
+                  and bool(int(flags.flag(
+                      "FLAGS_use_pallas_ragged_attention"))))
+    if use_kernel:
+        import warnings
+        try:
+            from .pallas.ragged_paged_attention import (
+                ragged_paged_attention as _kernel)
+            return _kernel(q, key_pages, value_pages, block_tables,
+                           ctx_lens, lengths, scale)
+        except Exception as e:
+            warnings.warn(
+                f"Pallas ragged paged-attention kernel unavailable "
+                f"({type(e).__name__}: {e}); using the jnp reference "
+                f"path", RuntimeWarning)
+    return ragged_paged_attention_reference(
+        q, key_pages, value_pages, block_tables, ctx_lens, lengths,
+        scale)
 
 
 def paged_decode_write(kp, vp, k, v, block_tables, ctx, active=None):

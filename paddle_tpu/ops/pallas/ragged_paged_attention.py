@@ -1,0 +1,336 @@
+"""Ragged paged-attention Pallas kernel for TPU serving.
+
+ONE kernel for the whole mixed prefill+decode batching step (PAPERS.md
+"Ragged Paged Attention"): the query operand is a flattened token
+stream — slot ``b``'s tokens are the ``[start, length]`` window
+``[b * C, b * C + lengths[b])`` of the stream, exposed here in its
+uniform-stride ``[B, C, H, D]`` view — and every sequence, whether a
+multi-token prefill chunk (s > 1), a single decode step (s == 1), or an
+idle slot (s == 0), flows through the same grid. No separate prefill
+and decode program families, so the serving engine compiles exactly one
+batching-step signature.
+
+Semantics (identical to the jnp oracle
+``ops.paged_attention.ragged_paged_attention_reference``): the chunk's
+k/v were already written into the paged pool at cache positions
+``ctx[b] .. ctx[b] + lengths[b] - 1`` (``paged_prefill_write``; chunk
+padding rides the reserved trash page 0), and query token ``j`` of
+sequence ``b`` attends every cache position ``<= ctx[b] + j`` — full
+paged history behind it, causal within the chunk. Rows ``j >=
+lengths[b]`` output zeros.
+
+Kernel structure (the jax paged-attention decode kernel's scalar-
+prefetch idiom, generalized to ragged multi-token queries):
+
+- grid ``(kv_head, sequence, q_block)`` — one program per kv head per
+  sequence-block of the token stream;
+- block tables / context lens / lengths ride scalar prefetch, so only
+  the pages a sequence actually owns are streamed;
+- K/V pools stay in HBM (``ANY`` memory space); each grid step DMAs
+  ``kv_pages_per_block`` pages named in the block table into a
+  double-buffered VMEM scratch (next block's copy overlaps the current
+  block's compute) and accumulates with an online softmax in fp32.
+
+Block sizes (``q_block``, ``kv_pages_per_block``) are a registered
+tunable surface ("ragged_paged_attention") swept by ``bench.py
+--autotune`` / the tuner CLI; explicit flags win over cached winners
+(the flash_attention precedence contract).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._utils import interpret_mode as _interpret, no_x64 as _no_x64
+
+__all__ = ["ragged_paged_attention", "force_ragged_blocks",
+           "ragged_attention_cost"]
+
+_NEG_INF = -1e30
+
+# sweep hook: the trial engine pins candidate blocks here while it
+# compiles fresh variants (thread-local, same contract as
+# flash_attention.force_blocks — candidates must not ride set_flags).
+import threading as _threading
+
+_forced_tls = _threading.local()
+
+
+class force_ragged_blocks:
+    """Context manager pinning (q_block, kv_pages_per_block) for tuner
+    trials (this thread only)."""
+
+    def __init__(self, q_block, kv_pages_per_block):
+        self._val = (int(q_block), int(kv_pages_per_block))
+
+    def __enter__(self):
+        self._prev = getattr(_forced_tls, "blocks", None)
+        _forced_tls.blocks = self._val
+        return self
+
+    def __exit__(self, *exc):
+        _forced_tls.blocks = self._prev
+        return False
+
+
+def _resolve_blocks(c, pages_per_seq, page, d, dtype):
+    """(q_block, kv_pages_per_block) for this shape, precedence: forced
+    trial candidate > explicit user flag > tuner cache > default.
+    Host-side at trace time — static ints selecting the compiled
+    grid."""
+    from ...framework import flags
+    forced = getattr(_forced_tls, "blocks", None)
+    if forced is not None:
+        qb, g = forced
+    else:
+        qb = int(flags.flag("FLAGS_ragged_attn_q_block"))
+        g = int(flags.flag("FLAGS_ragged_attn_kv_pages"))
+        qb_explicit = flags.flag_source(
+            "FLAGS_ragged_attn_q_block") != "default"
+        g_explicit = flags.flag_source(
+            "FLAGS_ragged_attn_kv_pages") != "default"
+        if not (qb_explicit and g_explicit):
+            from ...tuner import lookup
+            cfg = lookup("ragged_paged_attention",
+                         {"c": int(c), "pages": int(pages_per_seq),
+                          "page": int(page), "d": int(d)}, str(dtype))
+            if cfg:
+                if not qb_explicit:
+                    qb = int(cfg.get("q_block", qb))
+                if not g_explicit:
+                    g = int(cfg.get("kv_pages_per_block", g))
+    # clamp to the shape: q blocks never exceed the chunk, page blocks
+    # never exceed the table row
+    qb = max(1, min(qb, c))
+    g = max(1, min(g, pages_per_seq))
+    return qb, g
+
+
+def _ragged_kernel(ctx_ref, len_ref, tbl_ref, q_ref, k_hbm_ref,
+                   v_hbm_ref, o_ref, k_buf, v_buf, sem, *, scale,
+                   page, q_block, g_pages, pages_per_seq):
+    """One program: (kv head h, sequence b, q block qi). Streams the
+    sequence's pages through the double-buffered VMEM scratch and
+    accumulates an online softmax over them."""
+    h = pl.program_id(0)
+    b = pl.program_id(1)
+    qi = pl.program_id(2)
+    rep = q_ref.shape[1]           # q heads per kv head
+    d = q_ref.shape[2]
+    bk = g_pages * page            # keys per kv block
+    ctx = ctx_ref[b]
+    length = len_ref[b]
+    q_start = qi * q_block         # first chunk token of this q block
+
+    # rows past the valid count output zeros (also covers idle slots,
+    # length == 0, whose programs skip the whole loop)
+    o_ref[...] = jnp.zeros_like(o_ref)
+
+    def dma_block(i, slot):
+        """Async copies for kv block i into buffer `slot` — one copy
+        per page named in the block table (clamped into the row; the
+        overhang past ceil(n_kv/page) pages is masked out below).
+        Each buffer slot owns its OWN semaphore: every page copy has
+        the same byte count, so a shared counter would let block
+        i+1's prefetch completions satisfy a wait for block i and
+        hand compute a partially-copied buffer."""
+        copies = []
+        for gidx in range(g_pages):
+            pidx = jnp.minimum(i * g_pages + gidx, pages_per_seq - 1)
+            pid = tbl_ref[b * pages_per_seq + pidx]
+            copies.append(pltpu.make_async_copy(
+                k_hbm_ref.at[h, pid], k_buf.at[slot, gidx],
+                sem.at[slot]))
+            copies.append(pltpu.make_async_copy(
+                v_hbm_ref.at[h, pid], v_buf.at[slot, gidx],
+                sem.at[slot]))
+        return copies
+
+    @pl.when(q_start < length)
+    def compute():  # noqa: ANN001 — pl.when body
+        # last key any row of this block may see (+1): the block's last
+        # valid token at chunk offset min(q_start + q_block, length) - 1
+        n_kv = ctx + jnp.minimum(q_start + q_block, length)
+        n_blocks = (n_kv + bk - 1) // bk
+
+        for c in dma_block(0, 0):
+            c.start()
+
+        q = q_ref[...].astype(jnp.float32) * scale  # [q_block, rep, d]
+        q2 = q.reshape(q_block * rep, d)
+
+        def body(i, carry):
+            acc, m_prev, l_prev = carry
+            slot = jax.lax.rem(i, 2)
+            nslot = jax.lax.rem(i + 1, 2)
+
+            @pl.when(i + 1 < n_blocks)
+            def _():
+                for c in dma_block(i + 1, nslot):
+                    c.start()
+
+            for c in dma_block(i, slot):
+                c.wait()
+            k = k_buf[slot].reshape(bk, d).astype(jnp.float32)
+            v = v_buf[slot].reshape(bk, d).astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q2, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [qb*rep, bk]
+            k_pos = i * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block * rep, bk), 1)
+            q_tok = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block * rep, bk), 0) // rep
+            # causal over the paged history + the row-validity mask
+            # (rows past `length` stay fully masked -> zero output)
+            valid = (k_pos <= ctx + q_tok) & (q_tok < length)
+            s = jnp.where(valid, s, _NEG_INF)
+            m_cur = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.where(valid, jnp.exp(s - m_new[:, None]), 0.0)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return acc, m_new, l_new
+
+        acc0 = jnp.zeros((q_block * rep, d), jnp.float32)
+        m0 = jnp.full((q_block * rep,), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((q_block * rep,), jnp.float32)
+        acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
+        l = jnp.maximum(l, 1e-30)
+        o_ref[...] = (acc / l[:, None]).reshape(
+            q_block, rep, d).astype(o_ref.dtype)
+
+
+def ragged_paged_attention(q, key_pages, value_pages, block_tables,
+                           ctx_lens, lengths, scale=None, q_block=None,
+                           kv_pages_per_block=None):
+    """Mixed prefill+decode paged attention over the flattened token
+    stream (uniform-stride view).
+
+    q            [B, C, H, D] — slot b's tokens are the stream window
+                 [b*C, b*C + lengths[b]); rows past lengths[b] are
+                 padding (zeroed in the output)
+    key_pages /  [KVH, num_pages, page_size, D] page pools; the chunk's
+    value_pages  k/v already written at ctx .. ctx+len-1
+    block_tables [B, pages_per_seq] int32
+    ctx_lens     [B] int32 — cache length BEFORE the chunk
+    lengths      [B] int32 — valid stream tokens per slot (0 = idle,
+                 1 = decode step, >1 = prefill chunk)
+    Returns [B, C, H, D].
+    """
+    b, c, h, d = q.shape
+    kvh, _, page, _ = key_pages.shape
+    rep = h // kvh
+    pages_per_seq = block_tables.shape[1]
+    s = scale if scale is not None else 1.0 / math.sqrt(d)
+    qb, g = _resolve_blocks(c, pages_per_seq, page, d, q.dtype)
+    if q_block is not None:
+        qb = max(1, min(int(q_block), c))
+    if kv_pages_per_block is not None:
+        g = max(1, min(int(kv_pages_per_block), pages_per_seq))
+    c_p = -(-c // qb) * qb
+    if c_p != c:
+        q = jnp.pad(q, ((0, 0), (0, c_p - c), (0, 0), (0, 0)))
+    grid = (kvh, b, c_p // qb)
+    with _no_x64():
+        out = pl.pallas_call(
+            functools.partial(
+                _ragged_kernel, scale=s, page=page, q_block=qb,
+                g_pages=g, pages_per_seq=pages_per_seq),
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=3,   # ctx, lengths, block tables
+                grid=grid,
+                in_specs=[
+                    # q: (slot, q block, kv-head group, head_dim)
+                    pl.BlockSpec((None, qb, rep, d),
+                                 lambda hh, bb, qq, *_: (bb, qq, hh, 0)),
+                    pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+                    pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
+                ],
+                out_specs=pl.BlockSpec(
+                    (None, qb, rep, d),
+                    lambda hh, bb, qq, *_: (bb, qq, hh, 0)),
+                scratch_shapes=[
+                    pltpu.VMEM((2, g, page, d), key_pages.dtype),
+                    pltpu.VMEM((2, g, page, d), value_pages.dtype),
+                    pltpu.SemaphoreType.DMA((2,)),   # one per slot
+                ],
+            ),
+            compiler_params=pltpu.TPUCompilerParams(
+                dimension_semantics=("parallel", "arbitrary",
+                                     "arbitrary")),
+            out_shape=jax.ShapeDtypeStruct((b, c_p, h, d), q.dtype),
+            interpret=_interpret(),
+        )(ctx_lens.astype(jnp.int32), lengths.astype(jnp.int32),
+          block_tables.astype(jnp.int32).reshape(-1), q, key_pages,
+          value_pages)
+    return out[:, :c]
+
+
+# -- tunable surface ---------------------------------------------------------
+# q_block / kv_pages_per_block candidate grid, registered next to the
+# knob (the flash_attention pattern). No cost_fn: q blocks revisit the
+# whole page list, so byte traffic scales with the q-block COUNT — the
+# trial engine times every valid candidate rather than trusting a
+# first-order roofline that would mispredict the DMA-overlap win of
+# larger page blocks. Shape key: (c, pages, page, d).
+
+def _register_ragged_surface():
+    from ...tuner.surface import TunableSurface, register_surface
+
+    def _candidates(shape):
+        c = int(shape.get("c", 16))
+        pages = int(shape.get("pages", 8))
+        qbs = sorted({min(qb, c) for qb in (1, 8, 16, 32, 64, 128)
+                      if qb <= max(c, 1)})
+        gs = sorted({min(g, pages) for g in (1, 2, 4, 8, 16)
+                     if g <= max(pages, 1)})
+        return [{"q_block": qb, "kv_pages_per_block": g}
+                for qb in qbs for g in gs]
+
+    def _is_valid(config, shape):
+        c = int(shape.get("c", 16))
+        pages = int(shape.get("pages", 8))
+        return (1 <= config["q_block"] <= max(c, 1)
+                and 1 <= config["kv_pages_per_block"] <= max(pages, 1))
+
+    register_surface(TunableSurface(
+        name="ragged_paged_attention",
+        params=("q_block", "kv_pages_per_block"),
+        default={"q_block": 16, "kv_pages_per_block": 4},
+        candidates=_candidates,
+        is_valid=_is_valid,
+        describe="Ragged paged-attention kernel blocks: stream tokens "
+                 "per q program, KV pages per DMA block. Shape key: "
+                 "c (chunk) / pages (per seq) / page (size) / d. "
+                 "FLAGS_ragged_attn_q_block / _kv_pages set explicitly "
+                 "override any cached value."))
+
+
+_register_ragged_surface()
+
+
+def ragged_attention_cost(q_shape, pool_shape, avg_ctx, lengths_sum=None):
+    """Static FLOPs/bytes for one :func:`ragged_paged_attention` call
+    (profiler cost-accounting surface): q [B, C, H, D], pool
+    [KVH, pages, page, D]. Attention over an average history of
+    ``avg_ctx`` keys per stream token; bytes count q/pages-touched/out
+    only (the kernel never materializes scores)."""
+    from ...profiler.cost import SectionCost
+    b, c, h, d = (int(x) for x in q_shape)
+    _, _, page, _ = (int(x) for x in pool_shape)
+    toks = int(lengths_sum) if lengths_sum is not None else b * c
+    flops = 4.0 * toks * h * d * float(avg_ctx)
+    pages_touched = toks * -(-float(avg_ctx) // page)
+    itemsize = 2  # serving pools are bf16 on TPU
+    bytes_ = (toks * h * d + 2 * pages_touched * page * d
+              + toks * h * d) * itemsize
+    return SectionCost(flops=flops, bytes=bytes_)

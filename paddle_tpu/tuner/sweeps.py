@@ -26,7 +26,8 @@ from __future__ import annotations
 
 __all__ = ["ensure_builtin_surfaces", "auto_builder",
            "grouped_matmul_builder", "flash_attention_builder",
-           "rms_norm_builder", "BENCH_PRESETS"]
+           "rms_norm_builder", "ragged_attention_builder",
+           "BENCH_PRESETS"]
 
 
 def ensure_builtin_surfaces():
@@ -35,6 +36,7 @@ def ensure_builtin_surfaces():
     knobs)."""
     from ..ops.pallas import flash_attention  # noqa: F401
     from ..ops.pallas import grouped_matmul  # noqa: F401
+    from ..ops.pallas import ragged_paged_attention  # noqa: F401
     from ..ops.pallas import rms_norm  # noqa: F401
     from ..nn import scan  # noqa: F401
     from ..inference import serving  # noqa: F401
@@ -163,12 +165,69 @@ def rms_norm_builder(rows=4096, dtype="bfloat16", train=True):
     return builder
 
 
+def ragged_attention_builder(slots=8, heads=8, kv_heads=2,
+                             dtype="bfloat16"):
+    """Builder for the ``ragged_paged_attention`` surface (shape
+    supplies c/pages/page/d): a mixed prefill+decode batch — half the
+    slots stream a full chunk, half ride one decode token over a deep
+    history — through the unified serving kernel. Candidates pin
+    through ``force_ragged_blocks`` (NOT set_flags, which would defeat
+    the override>cache>default precedence), fresh jit per candidate."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    def builder(config, shape):
+        from ..ops.pallas.ragged_paged_attention import (
+            force_ragged_blocks, ragged_paged_attention)
+        c = int(shape["c"])
+        pages = int(shape["pages"])
+        page = int(shape["page"])
+        d = int(shape["d"])
+        dt = jnp.dtype(dtype)
+        total = slots * pages + 1      # + the trash page 0
+        key = jax.random.PRNGKey(0)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(
+            kq, (slots, c, heads, d), jnp.float32).astype(dt)
+        kp = jax.random.normal(
+            kk, (kv_heads, total, page, d), jnp.float32).astype(dt)
+        vp = jax.random.normal(
+            kv, (kv_heads, total, page, d), jnp.float32).astype(dt)
+        rng = np.random.RandomState(0)
+        tables = jnp.asarray(
+            (rng.permutation(total - 1)[:slots * pages] + 1)
+            .reshape(slots, pages).astype(np.int32))
+        # mixed workload: even slots prefill the whole chunk from a
+        # shallow ctx, odd slots decode one token over a deep history
+        ctx = jnp.asarray([(3 if s % 2 == 0 else pages * page - c - 1)
+                           for s in range(slots)], jnp.int32)
+        lens = jnp.asarray([(c if s % 2 == 0 else 1)
+                            for s in range(slots)], jnp.int32)
+        qb = int(config["q_block"])
+        g = int(config["kv_pages_per_block"])
+        step = jax.jit(ragged_paged_attention)
+
+        def fn():
+            # the force context must cover the first (tracing) call —
+            # it short-circuits _resolve_blocks, so the candidate is
+            # pinned through the SAME resolution path production uses
+            with force_ragged_blocks(qb, g):
+                return _trial(step, q, kp, vp, tables, ctx, lens)
+        return fn
+
+    return builder
+
+
 #: surface -> builder factory taking (dtype) — the tune-on-first-call
 #: path and the CLI's default trial hyper-parameters
 _AUTO_BUILDERS = {
     "grouped_matmul": lambda dtype: grouped_matmul_builder(dtype=dtype),
     "flash_attention": lambda dtype: flash_attention_builder(dtype=dtype),
     "rms_norm": lambda dtype: rms_norm_builder(dtype=dtype),
+    "ragged_paged_attention":
+        lambda dtype: ragged_attention_builder(dtype=dtype),
 }
 
 
@@ -192,9 +251,17 @@ BENCH_PRESETS = {
         ("flash_attention", {"sq": 2048, "sk": 2048, "d": 128}),
         ("rms_norm", {"d": 2560}),
     ],
+    "serving": [
+        # the v5e llama_1b cb-bench geometry: chunk 32, 12-page rows of
+        # 32-token pages, head_dim 128
+        ("ragged_paged_attention",
+         {"c": 32, "pages": 12, "page": 32, "d": 128}),
+    ],
     "cpu_smoke": [
         ("grouped_matmul", {"d": 64, "h": 128, "E": 4}),
         ("flash_attention", {"sq": 128, "sk": 128, "d": 64}),
         ("rms_norm", {"d": 128}),
+        ("ragged_paged_attention",
+         {"c": 8, "pages": 4, "page": 8, "d": 16}),
     ],
 }

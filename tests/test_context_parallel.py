@@ -9,7 +9,13 @@ import pytest
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+# jax.shard_map moved namespaces across releases: the root-level alias
+# does not exist on the pinned jax (0.4.37), where the supported spelling
+# is the experimental module (collection error since PR 5 otherwise)
+try:
+    from jax import shard_map
+except ImportError:                                  # jax <= 0.4.x
+    from jax.experimental.shard_map import shard_map
 
 from paddle_tpu.ops import ring_attention as ra
 from paddle_tpu.ops.pallas.flash_attention import flash_attention_reference

@@ -1,0 +1,193 @@
+"""Ragged paged-attention kernel parity (ISSUE 7): the Pallas kernel
+(always exercised — interpret mode off-TPU) against the jnp oracle
+``ragged_paged_attention_reference`` on mixed batches, and the oracle's
+own reduction contracts (C == 1 == the decode oracle; lengths == C ==
+the prefill oracle)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from paddle_tpu.ops.paged_attention import (
+    paged_attention_reference, paged_prefill_attention_reference,
+    ragged_paged_attention_reference)
+from paddle_tpu.ops.pallas.ragged_paged_attention import (
+    force_ragged_blocks, ragged_paged_attention as kernel)
+
+
+def _pool_case(rng, B, KVH, D, page, pages_per_seq, total_pages):
+    """Shuffled page pool + block tables (page 0 reserved as trash,
+    the engine convention)."""
+    kp = rng.randn(KVH, total_pages, page, D).astype("float32")
+    vp = rng.randn(KVH, total_pages, page, D).astype("float32")
+    perm = rng.permutation(total_pages - 1) + 1     # never page 0
+    tables = perm[:B * pages_per_seq].reshape(
+        B, pages_per_seq).astype("int32")
+    return kp, vp, tables
+
+
+def _run_both(q, kp, vp, tables, ctx, lens, **kw):
+    out = kernel(jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                 jnp.asarray(tables), jnp.asarray(ctx),
+                 jnp.asarray(lens), **kw)
+    ref = ragged_paged_attention_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(ctx), jnp.asarray(lens))
+    return np.asarray(out), np.asarray(ref)
+
+
+@pytest.mark.parametrize("H,KVH", [(4, 4), (8, 2)])
+def test_mixed_batch_kernel_matches_oracle(H, KVH):
+    """One invocation covering every slot kind at once: a prefill chunk
+    (s > 1), a decode step (s == 1), an idle slot (s == 0), and a
+    partial chunk — the unified batching step's operand shape."""
+    rng = np.random.RandomState(0)
+    B, D, page, P = 4, 16, 4, 8
+    kp, vp, tables = _pool_case(rng, B, KVH, D, page, P, B * P + 3)
+    C = 6
+    q = rng.randn(B, C, H, D).astype("float32")
+    ctx = np.array([0, 7, 13, 3], "int32")
+    lens = np.array([6, 1, 0, 3], "int32")
+    out, ref = _run_both(q, kp, vp, tables, ctx, lens)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+    # padding rows (and the idle slot) are zero in BOTH
+    assert np.all(out[2] == 0)
+    assert np.all(out[3, 3:] == 0)
+
+
+def test_pure_prefill_and_pure_decode_batches():
+    rng = np.random.RandomState(1)
+    B, H, KVH, D, page, P = 3, 4, 2, 8, 4, 6
+    kp, vp, tables = _pool_case(rng, B, KVH, D, page, P, B * P + 2)
+    # pure prefill from empty caches (ctx = 0)
+    C = 8
+    q = rng.randn(B, C, H, D).astype("float32")
+    ctx = np.zeros((B,), "int32")
+    lens = np.array([8, 5, 2], "int32")
+    out, ref = _run_both(q, kp, vp, tables, ctx, lens)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+    # pure decode (every slot one token over real history)
+    q1 = rng.randn(B, 1, H, D).astype("float32")
+    ctx = np.array([4, 11, 17], "int32")
+    out, ref = _run_both(q1, kp, vp, tables, ctx,
+                         np.ones((B,), "int32"))
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_page_boundary_straddling_and_one_token_sequences():
+    """Ragged lengths that start mid-page, end mid-page, straddle a
+    page boundary, or cover exactly one token — the alignments the
+    online-softmax block loop must get right."""
+    rng = np.random.RandomState(2)
+    B, H, KVH, D, page, P = 4, 4, 2, 8, 4, 8
+    kp, vp, tables = _pool_case(rng, B, KVH, D, page, P, B * P + 2)
+    C = 7
+    q = rng.randn(B, C, H, D).astype("float32")
+    # ctx=3,len=2 straddles the first page boundary (3..4 over page=4);
+    # ctx=4 starts exactly ON a boundary; ctx=15,len=7 crosses two
+    ctx = np.array([3, 4, 15, 0], "int32")
+    lens = np.array([2, 7, 7, 1], "int32")
+    out, ref = _run_both(q, kp, vp, tables, ctx, lens)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("qb,g", [(1, 1), (2, 2), (4, 8), (5, 3)])
+def test_block_size_grid_is_numerics_invariant(qb, g):
+    """q_block / kv_pages_per_block select the schedule, never the
+    numbers — including a q_block that does not divide C (padded) and
+    a page block that does not divide the table row."""
+    rng = np.random.RandomState(3)
+    B, H, KVH, D, page, P = 3, 4, 2, 8, 4, 8
+    kp, vp, tables = _pool_case(rng, B, KVH, D, page, P, B * P + 2)
+    C = 6
+    q = rng.randn(B, C, H, D).astype("float32")
+    ctx = np.array([2, 9, 0], "int32")
+    lens = np.array([6, 1, 4], "int32")
+    out, ref = _run_both(q, kp, vp, tables, ctx, lens,
+                         q_block=qb, kv_pages_per_block=g)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_force_ragged_blocks_hook():
+    """The tuner trial hook pins blocks for the calling thread only —
+    the sweep contract (candidates must not ride set_flags)."""
+    rng = np.random.RandomState(4)
+    B, H, KVH, D, page, P = 2, 4, 2, 8, 4, 4
+    kp, vp, tables = _pool_case(rng, B, KVH, D, page, P, B * P + 2)
+    q = rng.randn(B, 4, H, D).astype("float32")
+    ctx = np.array([1, 5], "int32")
+    lens = np.array([4, 2], "int32")
+    with force_ragged_blocks(2, 1):
+        out, ref = _run_both(q, kp, vp, tables, ctx, lens)
+    np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5)
+
+
+def test_c1_reduces_to_decode_oracle():
+    """The satellite contract: with C == 1 the ragged oracle reduces
+    EXACTLY (reduction order included) to the decode oracle at ctx+1,
+    and the kernel agrees to float tolerance."""
+    rng = np.random.RandomState(5)
+    B, H, KVH, D, page, P = 3, 8, 2, 16, 4, 6
+    kp, vp, tables = _pool_case(rng, B, KVH, D, page, P, B * P + 2)
+    q = rng.randn(B, 1, H, D).astype("float32")
+    ctx = np.array([0, 6, 19], "int32")
+    ones = np.ones((B,), "int32")
+    ragged = ragged_paged_attention_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(ctx), jnp.asarray(ones))
+    dec = paged_attention_reference(
+        jnp.asarray(q[:, 0]), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(ctx + 1))
+    np.testing.assert_allclose(np.asarray(ragged[:, 0]),
+                               np.asarray(dec), rtol=1e-6, atol=1e-6)
+    out = kernel(jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                 jnp.asarray(tables), jnp.asarray(ctx),
+                 jnp.asarray(ones))
+    np.testing.assert_allclose(np.asarray(out[:, 0]), np.asarray(dec),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_full_lengths_reduce_to_prefill_oracle():
+    """lengths == C makes the ragged oracle exactly the chunked-prefill
+    oracle — the legacy engine's whole-chunk path is a special case of
+    the unified entry point."""
+    rng = np.random.RandomState(6)
+    B, H, KVH, D, page, P = 2, 4, 2, 8, 4, 6
+    kp, vp, tables = _pool_case(rng, B, KVH, D, page, P, B * P + 2)
+    C = 5
+    q = rng.randn(B, C, H, D).astype("float32")
+    ctx = np.array([2, 9], "int32")
+    full = np.full((B,), C, "int32")
+    ragged = ragged_paged_attention_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(ctx), jnp.asarray(full))
+    pre = paged_prefill_attention_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(ctx))
+    np.testing.assert_allclose(np.asarray(ragged), np.asarray(pre),
+                               rtol=0, atol=0)
+
+
+@pytest.mark.slow
+def test_bf16_pool_gqa_wide_case():
+    """Breadth: bf16 pools (the TPU serving dtype), 8:2 GQA, longer
+    histories — kernel vs oracle at bf16 tolerance."""
+    rng = np.random.RandomState(7)
+    B, H, KVH, D, page, P = 4, 8, 2, 32, 8, 8
+    kp, vp, tables = _pool_case(rng, B, KVH, D, page, P, B * P + 2)
+    kp = kp.astype(jnp.bfloat16)
+    vp = vp.astype(jnp.bfloat16)
+    C = 8
+    q = rng.randn(B, C, H, D).astype(jnp.bfloat16)
+    ctx = np.array([0, 13, 27, 51], "int32")
+    lens = np.array([8, 3, 1, 8], "int32")
+    out = kernel(jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+                 jnp.asarray(tables), jnp.asarray(ctx),
+                 jnp.asarray(lens), q_block=4, kv_pages_per_block=2)
+    ref = ragged_paged_attention_reference(
+        jnp.asarray(q), jnp.asarray(kp), jnp.asarray(vp),
+        jnp.asarray(tables), jnp.asarray(ctx), jnp.asarray(lens))
+    np.testing.assert_allclose(
+        np.asarray(out, np.float32), np.asarray(ref, np.float32),
+        rtol=2e-2, atol=2e-2)

@@ -102,8 +102,8 @@ def test_eos_stops_stream_early():
 @pytest.mark.slow
 def test_oversized_prompt_uses_exact_bucket():
     """A prompt longer than every configured bucket must still serve —
-    through the SAME chunked prefill signature (it streams in
-    prefill_chunk waves), never an exact-length recompile."""
+    through the SAME unified batching-step signature (it streams in
+    prefill_chunk-sized slices), never an exact-length recompile."""
     model, cfg = _model()
     rng = np.random.RandomState(4)
     prompt = rng.randint(0, cfg.vocab_size, (20,)).astype(np.int32)
@@ -114,8 +114,8 @@ def test_oversized_prompt_uses_exact_bucket():
     eng.add_request(prompt, 5)
     (req,) = eng.run()
     assert req.tokens == ref, (req.tokens, ref)
-    # one prefill signature total, even though 20 > every bucket
-    assert sum(1 for kind, _ in eng._compiled if kind == "prefill") == 1
+    # one signature total, even though 20 > every bucket
+    assert eng.gauges()["compiled_programs"] == 1, eng._compiled
     assert eng.gauges()["prefill_waves"] == 2     # ceil(20 / 16)
 
 
@@ -283,11 +283,12 @@ def test_latency_gauges_schema():
         assert k in g, k
     assert 0 < g["ttft_ms_p50"] <= g["ttft_ms_p99"]
     assert 0 < g["itl_ms_p50"] <= g["itl_ms_p99"]
-    assert g["compiled_programs"] >= 2          # 1 prefill + >=1 chunk
+    assert g["compiled_programs"] == 1          # ONE unified signature
     # 3 prompts through 2 slots: the first TWO admissions share one
-    # batched wave, the third rides its own after a drain — strictly
-    # fewer waves than admitted prompts is the batching at work
-    assert 2 <= g["prefill_waves"] < g["prefills"]
+    # batched step (the third rides a later one after a drain) — at
+    # most one prompt-carrying step per admission is the batching
+    assert 1 <= g["prefill_waves"] <= g["prefills"]
+    assert g["unified_steps"] == g["chunks_dispatched"] > 0
     # per-request stamps are consistent
     for r in done:
         assert r.t_arrive <= r.t_first <= r.t_done
@@ -308,7 +309,7 @@ def test_adaptive_chunk_no_wasted_drain_dispatch():
     eng = ContinuousBatchingEngine(model, num_slots=2, page_size=8,
                                    max_len=64, decode_chunk=4,
                                    prompt_buckets=(8, 16), greedy=True,
-                                   adaptive_chunk=True)
+                                   adaptive_chunk=True, unified=False)
     rng = np.random.RandomState(10)
     specs = [(5, 7), (9, 3), (12, 6), (4, 5)]
     for plen, n in specs:
@@ -340,12 +341,13 @@ def test_stall_detection_still_fires():
 
 
 def test_compile_budget_mixed_length_workload():
-    """Fast-tier CI gate (ISSUE 3 satellite): a mixed-length workload
-    must compile at most a FIXED number of distinct programs — one
-    batched prefill signature plus the power-of-two decode-chunk ladder
-    — strictly below the per-bucket baseline (one prefill program per
-    bucket + exact-length signatures + one chunk program). A bucket or
-    signature explosion fails this gate."""
+    """Fast-tier CI gate (ISSUE 7 satellite): a mixed-length workload
+    through the unified engine must compile EXACTLY ONE program — the
+    unified batching-step signature — strictly below the PR-3
+    per-family baseline (1 batched prefill + the power-of-two
+    decode-chunk ladder: 1 + log2(4) + 1 = 4 programs for this
+    workload) and the older per-bucket baseline (5). Any second
+    signature fails this gate."""
     cfg = LlamaConfig.tiny()
     cfg.tensor_parallel = False
     cfg.scan_layers = False
@@ -357,9 +359,8 @@ def test_compile_budget_mixed_length_workload():
                                    max_len=64, decode_chunk=4,
                                    prompt_buckets=(8, 16), greedy=True)
     rng = np.random.RandomState(11)
-    # five DISTINCT prompt lengths, two past every bucket: the per-
-    # bucket baseline would compile 4 prefill signatures (8, 16, exact
-    # 17, exact 21) + 1 chunk = 5 distinct programs
+    # five DISTINCT prompt lengths, two past every bucket — the shapes
+    # that exploded the per-bucket signature zoo
     specs = [(5, 8), (9, 8), (13, 8), (17, 8), (21, 8)]
     for plen, n in specs:
         eng.add_request(rng.randint(0, cfg.vocab_size,
@@ -367,11 +368,20 @@ def test_compile_budget_mixed_length_workload():
     done = eng.run()
     assert len(done) == len(specs)
     g = eng.gauges()
+    pr3_per_family_baseline = 4   # 1 prefill + pow2 ladder under dc=4
     per_bucket_baseline = 5
-    assert g["compiled_programs"] < per_bucket_baseline, eng._compiled
-    # the hard gate: 1 prefill + the pow2 ladder under decode_chunk=4
-    assert g["compiled_programs"] <= 4, eng._compiled
-    assert sum(1 for kind, _ in eng._compiled if kind == "prefill") == 1
+    # the hard gate: ONE steady-state compiled batching-step program
+    assert g["compiled_programs"] == 1, eng._compiled
+    assert g["compiled_programs"] < pr3_per_family_baseline
+    assert g["compiled_programs"] < per_bucket_baseline
+    (sig,) = eng._compiled
+    assert sig[0] == "unified"
+    # a second mixed workload on the same engine reuses the signature
+    for plen, n in [(7, 3), (19, 2)]:
+        eng.add_request(rng.randint(0, cfg.vocab_size,
+                                    (plen,)).astype(np.int32), n)
+    eng.run()
+    assert eng.gauges()["compiled_programs"] == 1, eng._compiled
 
 
 @pytest.mark.slow

@@ -17,6 +17,7 @@ Usage::
     python tools/run_gates.py --log /tmp/_t1.log --budget 300
     python tools/run_gates.py --no-budget         # no tier-1 log yet
     python tools/run_gates.py --no-chaos          # skip the kill smoke
+    python tools/run_gates.py --no-serving        # skip engine parity
 
 ``--no-budget`` skips the fast-tier budget gate for contexts where no
 tier-1 log exists (e.g. pre-commit on a docs change); ``--no-chaos``
@@ -38,7 +39,7 @@ REPO_DIR = os.path.dirname(TOOLS_DIR)
 
 
 def gate_commands(log: str, budget: float, no_budget: bool,
-                  no_chaos: bool = False):
+                  no_chaos: bool = False, no_serving: bool = False):
     """The authoritative gate list: (name, argv). New hygiene gates
     register HERE (tests/test_gates.py pins the known ones so a gate
     cannot be dropped silently)."""
@@ -65,6 +66,19 @@ def gate_commands(log: str, budget: float, no_budget: bool,
               os.path.join(REPO_DIR, "tests", "test_elastic_chaos.py"),
               "-q", "-m", "fault and not slow",
               "-p", "no:cacheprovider"]))
+    if not no_serving:
+        # serving parity: the unified ragged batching-step engine must
+        # reproduce the legacy prefill-wave/decode-chunk engine's token
+        # streams exactly AND hold the 1-compiled-program budget
+        # (1-layer tiny model on CPU — fast, inside the tier-1 budget
+        # tripwire)
+        gates.append(
+            ("serving_parity",
+             [sys.executable, "-m", "pytest",
+              os.path.join(REPO_DIR, "tests",
+                           "test_serving_parity.py"),
+              "-q", "-m", "serving_parity",
+              "-p", "no:cacheprovider"]))
     return gates
 
 
@@ -83,11 +97,15 @@ def main(argv=None) -> int:
     ap.add_argument("--no-chaos", action="store_true",
                     help="skip the elastic kill-and-resume smoke "
                          "(the one gate that spawns worker processes)")
+    ap.add_argument("--no-serving", action="store_true",
+                    help="skip the unified-vs-legacy serving parity "
+                         "gate (compiles two tiny engines)")
     args = ap.parse_args(argv)
 
     failures = 0
     for name, cmd in gate_commands(args.log, args.budget,
-                                   args.no_budget, args.no_chaos):
+                                   args.no_budget, args.no_chaos,
+                                   args.no_serving):
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True)
             rc = proc.returncode
