@@ -115,8 +115,9 @@ def sep_attention(q, k, v, causal=True, scale=None, impl="ring",
             f"impl='ring' (got impl={impl!r})")
 
     def fn(qq, kk, vv):
-        f = _jax.shard_map(core, mesh=mesh, in_specs=(spec, spec, spec),
-                           out_specs=spec, axis_names={axis})
+        from ....utils.jax_compat import shard_map as _shard_map
+        f = _shard_map(core, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, axis_names={axis})
         return f(qq, kk, vv)
 
     return apply(fn, q, k, v, name=f"sep_attention_{impl}")

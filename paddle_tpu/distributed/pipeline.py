@@ -41,7 +41,7 @@ TPU-native design (SURVEY.md §7 "hard parts" #1) — NOT a port:
   ppermute ring).
 
 Everything is shape-static; ``pipeline_spmd`` must run inside a
-partial-manual ``jax.shard_map(axis_names={'pipe'})`` region (see
+partial-manual ``shard_map(axis_names={'pipe'})`` region (see
 ``run_pipeline`` for the global-view entry point that sets this up).
 """
 
@@ -51,6 +51,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.jax_compat import shard_map as _shard_map
 from jax import lax
 
 __all__ = ["pipeline_spmd", "run_pipeline"]
@@ -240,7 +242,7 @@ def run_pipeline(stage_fn, stacked_params, x_micro, mesh, axis_name="pipe",
     if x_spec is None:
         x_spec = P()
 
-    f = jax.shard_map(
+    f = _shard_map(
         functools.partial(pipeline_spmd, stage_fn, axis_name=axis_name,
                           n_virtual=n_virtual, remat=remat),
         mesh=mesh,

@@ -43,6 +43,8 @@ import functools
 
 import jax
 import jax.numpy as jnp
+
+from ..utils.jax_compat import shard_map as _shard_map
 import numpy as np
 from jax import lax
 
@@ -515,7 +517,7 @@ def run_pipeline_train(stage_fn, loss_fn, stacked_params, x_micro,
             raise ValueError(
                 "extra_axes/expert_axes/x_spec (the pp x sep/ep "
                 "compositions) require full-model mode: pass epi_fn")
-        f = jax.shard_map(
+        f = _shard_map(
             functools.partial(pipeline_train_spmd, stage_fn, loss_fn,
                               axis_name=axis_name, n_stages=S,
                               schedule=schedule),
@@ -536,7 +538,7 @@ def run_pipeline_train(stage_fn, loss_fn, stacked_params, x_micro,
                                    epi_params=ep, extra_axes=extra_axes,
                                    expert_axes=expert_axes)
 
-    f = jax.shard_map(
+    f = _shard_map(
         wrapped,
         mesh=mesh,
         # targets stay replicated (epi_fn gathers hidden states before

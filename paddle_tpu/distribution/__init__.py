@@ -473,7 +473,15 @@ class Binomial(Distribution):
         n = self.total_count._data
         p = self.probs._data
         shape = tuple(shape) + tuple(jnp.broadcast_shapes(n.shape, p.shape))
-        return Tensor(jax.random.binomial(_key(), n, p, shape=shape))
+        # jax 0.4.x random.binomial mixes weak-f64 literals with the
+        # f32 count under the framework's global x64 mode (lax.clamp
+        # dtype mismatch inside _btrs) — sample with x64 promotion
+        # off; operands carry explicit f32 dtypes so nothing changes
+        # semantically
+        from ..ops.pallas._utils import no_x64
+        with no_x64():
+            draw = jax.random.binomial(_key(), n, p, shape=shape)
+        return Tensor(draw)
 
     def log_prob(self, value):
         v = as_tensor(value)._data

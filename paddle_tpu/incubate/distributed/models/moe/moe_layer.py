@@ -16,6 +16,8 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from .....utils.jax_compat import shard_map as _shard_map
+
 from .....framework.core import Tensor, apply
 from .....nn.layer.layers import Layer
 from .....nn import initializer as I
@@ -206,7 +208,7 @@ class MoELayer(Layer):
                         axis, k=k, capacity_factor=cf, norm_topk_prob=ntp)
                     return y, aux, z
 
-                f = jax.shard_map(
+                f = _shard_map(
                     core, mesh=mesh,
                     in_specs=(P(axis, None), P(None, None),
                               P(axis, None, None), P(axis, None, None),

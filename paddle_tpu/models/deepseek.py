@@ -325,7 +325,16 @@ class DeepseekV2DecoderLayer(nn.Layer):
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, new_cache
-        x = x + self.self_attn(self.input_layernorm(x))
+        attn = self.self_attn(self.input_layernorm(x))
+        from ..framework import flags
+        if flags.flag("FLAGS_fused_rmsnorm_residual"):
+            # attention-residual add + post_attention_layernorm as ONE
+            # fused kernel (models/llama.py fused-carry comment)
+            y, r = F.fused_rms_norm_residual(
+                attn, x, self.post_attention_layernorm.weight,
+                self.post_attention_layernorm.epsilon)
+            return r + self.mlp(y)
+        x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 

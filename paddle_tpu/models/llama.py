@@ -332,9 +332,11 @@ class LlamaDecoderLayer(nn.Layer):
         return self._post_stage(x, ctx)
 
     # ---- core_attn selective remat (see LlamaConfig.recompute_granularity)
-    def _qkv_stage(self, x):
+    def _qkv_from(self, h):
+        """q/k/v projections + rope from an already-normed input —
+        the single copy of the projection wiring, shared by the plain,
+        core_attn-remat and fused-residual paths."""
         a = self.self_attn
-        h = self.input_layernorm(x)
         b, s, _ = h.shape
         q = M.reshape(a.q_proj(h), [b, s, a.num_heads, a.head_dim])
         k = M.reshape(a.k_proj(h), [b, s, a.num_kv_heads, a.head_dim])
@@ -344,6 +346,9 @@ class LlamaDecoderLayer(nn.Layer):
         q, k, _ = fused_rotary_position_embedding(
             q, k, None, rotary_emb_base=a.cfg.rope_theta)
         return q, k, v
+
+    def _qkv_stage(self, x):
+        return self._qkv_from(self.input_layernorm(x))
 
     def _post_stage(self, x, ctx):
         a = self.self_attn
@@ -367,6 +372,70 @@ class LlamaDecoderLayer(nn.Layer):
         ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
         return recompute(
             self._post_stage, x, ctx,
+            params_from=[a.o_proj, self.post_attention_layernorm,
+                         self.mlp])
+
+    # ---- fused residual+norm carry (FLAGS_fused_rmsnorm_residual) --------
+    # The unfused stack computes ``x1 = x + attn(norm1(x)); x2 = x1 +
+    # mlp(norm2(x1))`` — each residual add is immediately followed by
+    # an RMSNorm (the next layer's norm1 for the mlp add). The fused
+    # path therefore carries the UN-ADDED pair (hidden, residual)
+    # between layers so every add+norm pair lowers into ONE fused
+    # kernel (ops/pallas/rms_norm.rms_norm_residual on TPU): layer i's
+    # mlp output + residual stream fuse into layer i+1's input_layernorm
+    # and the attention output + residual fuse into
+    # post_attention_layernorm; LlamaModel fuses the final add into the
+    # last norm. Addition commutes, so the carry is numerics-identical
+    # to the sequential adds.
+
+    def _norm_pair(self, norm, hidden, residual):
+        """(normed, summed) for the add+norm pair; a None residual
+        (stack entry) degrades to the plain norm with the hidden
+        itself as the stream."""
+        if residual is None:
+            return norm(hidden), hidden
+        return F.fused_rms_norm_residual(hidden, residual, norm.weight,
+                                         norm.epsilon)
+
+    def forward_fused(self, hidden, residual=None):
+        """One decoder layer over the (hidden, residual) carry; returns
+        the next un-added pair ``(mlp_out, attn_residual_stream)``."""
+        y1, r = self._norm_pair(self.input_layernorm, hidden, residual)
+        q, k, v = self._qkv_from(y1)
+        ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return self._post_stage_fused(ctx, r)
+
+    def _qkv_stage_fused(self, hidden, residual=None):
+        y1, r = self._norm_pair(self.input_layernorm, hidden, residual)
+        q, k, v = self._qkv_from(y1)
+        return q, k, v, r
+
+    def _post_stage_fused(self, ctx, r):
+        a = self.self_attn
+        b, s, _ = r.shape
+        ctx = M.reshape(ctx, [b, s, a.num_heads * a.head_dim])
+        y2, r2 = self._norm_pair(self.post_attention_layernorm,
+                                 a.o_proj(ctx), r)
+        return self.mlp(y2), r2
+
+    def forward_fused_core_attn_remat(self, hidden, residual):
+        """core_attn selective remat over the fused carry: same
+        checkpoint regions as :meth:`forward_core_attn_remat`, with the
+        fused residual+norm kernels INSIDE them — backward recompute
+        re-runs the fused kernels, not an unfused expansion."""
+        from ..incubate.recompute import recompute
+        a = self.self_attn
+        qkv_params = [self.input_layernorm, a.q_proj, a.k_proj, a.v_proj]
+        if residual is None:
+            q, k, v, r = recompute(self._qkv_stage_fused, hidden,
+                                   n_outputs=4, params_from=qkv_params)
+        else:
+            q, k, v, r = recompute(self._qkv_stage_fused, hidden,
+                                   residual, n_outputs=4,
+                                   params_from=qkv_params)
+        ctx = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        return recompute(
+            self._post_stage_fused, ctx, r, n_outputs=2,
             params_from=[a.o_proj, self.post_attention_layernorm,
                          self.mlp])
 
@@ -440,6 +509,43 @@ class LlamaModel(nn.Layer):
             # backward's re-forward time. 0 = off.
             fs = max(int(getattr(self.config, "full_save_interval", 0)),
                      0)
+            # fused residual+norm carry (LlamaDecoderLayer.forward_fused
+            # block comment): every add+norm pair — including the final
+            # norm — lowers into one fused kernel. Only on the unrolled
+            # stack (the on-chip bench path); the scan body keeps the
+            # single-tensor carry.
+            fused = (flags.flag("FLAGS_fused_rmsnorm_residual")
+                     and self.config.sep_parallel is None
+                     and not self.config.sequence_parallel)
+            if fused:
+                hidden, residual = x, None
+                from ..incubate.recompute import recompute
+                for i, layer in enumerate(self.layers):
+                    if self.config.use_recompute and self.training:
+                        if fs and i % fs == fs - 1:
+                            hidden, residual = layer.forward_fused(
+                                hidden, residual)
+                        elif selective and i % interval == 0:
+                            hidden, residual = \
+                                layer.forward_fused_core_attn_remat(
+                                    hidden, residual)
+                        elif residual is None:
+                            hidden, residual = recompute(
+                                layer.forward_fused, hidden,
+                                n_outputs=2, params_from=layer)
+                        else:
+                            hidden, residual = recompute(
+                                layer.forward_fused, hidden, residual,
+                                n_outputs=2, params_from=layer)
+                    else:
+                        hidden, residual = layer.forward_fused(
+                            hidden, residual)
+                if residual is None:
+                    return self.norm(hidden)
+                y, _ = F.fused_rms_norm_residual(
+                    hidden, residual, self.norm.weight,
+                    self.norm.epsilon)
+                return y
             for i, layer in enumerate(self.layers):
                 if self.config.use_recompute and self.training:
                     if fs and i % fs == fs - 1:
@@ -555,7 +661,15 @@ class LlamaHeadPipe(nn.Layer):
 class LlamaPretrainingCriterion(nn.Layer):
     """Shifted next-token cross entropy — identical numerics to
     ``LlamaForCausalLM``'s labeled forward, so pipelined training is
-    loss-parity-comparable against the monolithic model."""
+    loss-parity-comparable against the monolithic model.
+
+    ``fuses_with_network_loss`` certifies exactly that contract to
+    ``hapi.Model``: ``network(x, labels=y)[1]`` equals
+    ``criterion(network(x), y)``, so the compiled fit step may route
+    labels into the network and let the fused linear+cross-entropy
+    path (FLAGS_fused_linear_cross_entropy) skip the [N, V] logits."""
+
+    fuses_with_network_loss = True
 
     def __init__(self, cfg: LlamaConfig):
         super().__init__()

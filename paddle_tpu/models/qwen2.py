@@ -222,7 +222,18 @@ class Qwen2DecoderLayer(nn.Layer):
             x = x + attn
             x = x + self.mlp(self.post_attention_layernorm(x))
             return x, new_cache
-        x = x + self.self_attn(self.input_layernorm(x))
+        attn = self.self_attn(self.input_layernorm(x))
+        from ..framework import flags
+        if flags.flag("FLAGS_fused_rmsnorm_residual"):
+            # the attention-residual add + post_attention_layernorm
+            # pair lowers into ONE fused kernel (identical math; the
+            # Pallas kernel on TPU — see models/llama.py's fused carry
+            # for the full both-pairs treatment on the flagship stack)
+            y, r = F.fused_rms_norm_residual(
+                attn, x, self.post_attention_layernorm.weight,
+                self.post_attention_layernorm.epsilon)
+            return r + self.mlp(y)
+        x = x + attn
         x = x + self.mlp(self.post_attention_layernorm(x))
         return x
 

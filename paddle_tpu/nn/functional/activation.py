@@ -170,8 +170,22 @@ def glu(x, axis=-1, name=None):
     return apply(fn, as_tensor(x), name="glu")
 
 
+def _use_fused_swiglu() -> bool:
+    from ...framework import flags
+    if not (flags.flag("FLAGS_fused_swiglu")
+            and flags.flag("FLAGS_enable_pallas_kernels")):
+        return False
+    return jax.default_backend() == "tpu"
+
+
 def swiglu(x, y=None, name=None):
     if y is not None:
+        if _use_fused_swiglu():
+            # one VMEM pass + fused dgate/dup backward, no silu
+            # intermediate saved (ops/pallas/swiglu.py)
+            from ...ops.pallas import swiglu as pallas_sw
+            return apply(pallas_sw.swiglu_fused, as_tensor(x),
+                         as_tensor(y), name="fused_swiglu")
         return apply(lambda a, b: jax.nn.silu(a) * b, as_tensor(x),
                      as_tensor(y), name="swiglu")
 

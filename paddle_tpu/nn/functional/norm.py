@@ -13,7 +13,7 @@ from ...ops.common import as_tensor
 
 __all__ = ["layer_norm", "batch_norm", "instance_norm", "group_norm",
            "spectral_norm",
-           "local_response_norm", "rms_norm"]
+           "local_response_norm", "rms_norm", "fused_rms_norm_residual"]
 
 
 def _use_pallas() -> bool:
@@ -74,6 +74,29 @@ def rms_norm(x, weight=None, epsilon=1e-6, name=None):
         ms = jnp.mean(jnp.square(af), axis=-1, keepdims=True)
         return (af * jax.lax.rsqrt(ms + epsilon)).astype(dt)
     return apply(fn, x, name="rms_norm")
+
+
+def fused_rms_norm_residual(x, residual, weight, epsilon=1e-6, name=None):
+    """``(rms_norm(x + residual) * weight, x + residual)`` — the
+    decoder-layer residual-add + norm pair as ONE op: the fused Pallas
+    kernel on TPU (ops/pallas/rms_norm.rms_norm_residual, one VMEM
+    pass for both outputs, fused dx/dresidual backward), and the
+    identical-math jnp pairing elsewhere (the add happens in the input
+    dtype, then the f32 norm — bit-parity with the unfused
+    ``x + residual`` followed by :func:`rms_norm`)."""
+    x, r, w = as_tensor(x), as_tensor(residual), as_tensor(weight)
+    from ...ops.pallas import rms_norm as pallas_rms
+    if _use_pallas():
+        return apply(
+            lambda a, b, ww: pallas_rms.rms_norm_residual(a, b, ww,
+                                                          epsilon),
+            x, r, w, n_outputs=2, name="fused_rms_norm_residual")
+    # the SAME oracle the interpret-mode parity tests pin the kernel to
+    # — one source of truth for the fallback math
+    return apply(
+        lambda a, b, ww: pallas_rms.rms_norm_residual_reference(
+            a, b, ww, epsilon),
+        x, r, w, n_outputs=2, name="fused_rms_norm_residual")
 
 
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,

@@ -3,7 +3,15 @@
 Forward is a row-wise reduction + scale — one VMEM pass per block of rows.
 Backward uses a custom VJP with a fused Pallas kernel for dx and an XLA
 reduction for dw (dw is a full-rows reduction; XLA's tree reduction over
-HBM is already optimal for it)."""
+HBM is already optimal for it).
+
+:func:`rms_norm_residual` is the Liger-style residual-add variant for the
+decoder hot path: one VMEM pass reads ``x`` and ``res`` and writes BOTH
+``y = rmsnorm(x + res) * w`` and ``r = x + res`` — the residual stream
+never makes a separate HBM round trip through an add op. The backward
+kernel fuses dx/dres (they are the same tensor: d(x+res) distributes)
+with the rmsnorm dx math, so the pair costs one extra output, not an
+extra pass."""
 
 from __future__ import annotations
 
@@ -17,7 +25,8 @@ from ._utils import interpret_mode as _interpret, no_x64 as _no_x64
 
 
 
-__all__ = ["rms_norm", "rms_norm_reference"]
+__all__ = ["rms_norm", "rms_norm_reference", "rms_norm_residual",
+           "rms_norm_residual_reference", "rms_norm_cost"]
 
 
 def rms_norm_reference(x, w, eps=1e-6):
@@ -90,7 +99,10 @@ def _rows_block(n_rows: int, d: int | None = None, dtype=None) -> int:
 def _pad_rows(a, n_pad):
     if n_pad == a.shape[0]:
         return a
-    return jnp.pad(a, ((0, n_pad - a.shape[0]), (0, 0)))
+    # explicit-dtype fill: jnp.pad's weak-int 0 re-concretizes as i64
+    # under an outer x64-enabled trace and fails interpret lowering
+    return jnp.pad(a, ((0, n_pad - a.shape[0]), (0, 0)),
+                   constant_values=a.dtype.type(0))
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
@@ -172,3 +184,188 @@ def _register_rms_surface():
 
 
 _register_rms_surface()
+
+
+# ===========================================================================
+# Fused RMSNorm + residual (the decoder-layer pair: ``r = x + res;
+# y = rmsnorm(r) * w`` in one VMEM pass, both outputs written)
+# ===========================================================================
+
+
+def rms_norm_residual_reference(x, res, w, eps=1e-6):
+    """Oracle: residual add in the INPUT dtype (exactly what the
+    unfused ``x + res`` followed by ``rms_norm`` computes), then the
+    f32 norm — interpret-mode parity tests pin the kernel to this."""
+    r = x + res
+    rf = r.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(rf), axis=-1, keepdims=True)
+    y = (rf * jax.lax.rsqrt(ms + eps)).astype(r.dtype) * w
+    return y, r
+
+
+def _fwd_res_kernel(x_ref, res_ref, w_ref, y_ref, r_ref, *, eps):
+    # the add happens in the INPUT dtype (bit-parity with the unfused
+    # ``x + res``), the norm in f32 — same accumulation discipline as
+    # the plain kernel above
+    r = x_ref[:] + res_ref[:]
+    r_ref[:] = r
+    rf = r.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(rf), axis=-1, keepdims=True)
+    normed = rf * jax.lax.rsqrt(ms + eps)
+    y_ref[:] = (normed * w_ref[:].astype(jnp.float32)).astype(y_ref.dtype)
+
+
+def _dres_kernel(x_ref, res_ref, w_ref, gy_ref, gr_ref, o_ref, *, eps):
+    # d(x+res) through the norm + the residual-stream grad in one pass:
+    # dh = rms_dx(gy) + gr, and dx == dres == dh
+    r = (x_ref[:] + res_ref[:]).astype(jnp.float32)
+    w = w_ref[:].astype(jnp.float32)
+    gy = gy_ref[:].astype(jnp.float32)
+    ms = jnp.mean(jnp.square(r), axis=-1, keepdims=True)
+    inv = jax.lax.rsqrt(ms + eps)
+    gw = gy * w
+    dot = jnp.mean(gw * r, axis=-1, keepdims=True)
+    dh = inv * gw - r * (inv ** 3) * dot + gr_ref[:].astype(jnp.float32)
+    o_ref[:] = dh.astype(o_ref.dtype)
+
+
+class force_residual_rows_block:
+    """Context manager pinning the rows-per-program block of the
+    residual variant for trials (this thread only)."""
+
+    def __init__(self, block_rows):
+        self._val = int(block_rows)
+
+    def __enter__(self):
+        self._prev = getattr(_forced_tls, "res_rows_block", None)
+        _forced_tls.res_rows_block = self._val
+        return self
+
+    def __exit__(self, *exc):
+        _forced_tls.res_rows_block = self._prev
+        return False
+
+
+def _res_rows_block(n_rows: int, d: int | None = None, dtype=None) -> int:
+    """Rows per program for the residual variant ("rms_norm_residual"
+    surface — tuned separately from the plain kernel: the extra
+    input/output streams shift the VMEM sweet spot)."""
+    want = 256
+    forced = getattr(_forced_tls, "res_rows_block", None)
+    if forced is not None:
+        want = forced
+    elif d is not None:
+        from ...tuner import lookup
+        cfg = lookup("rms_norm_residual", {"d": int(d)}, str(dtype))
+        if cfg:
+            want = int(cfg.get("block_rows", want))
+    return min(want, -(-n_rows // 8) * 8)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def rms_norm_residual(x, res, w, eps=1e-6):
+    """``(rmsnorm(x + res) * w, x + res)`` in one fused pass. Both
+    outputs are differentiable (the second feeds the residual stream);
+    backward fuses the norm's dx with the residual grad — dx and dres
+    are one tensor."""
+    return _rms_res_fwd_impl(x, res, w, eps)
+
+
+def _rms_res_fwd_impl(x, res, w, eps):
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    x2 = x.reshape(-1, d)
+    r2 = res.reshape(-1, d)
+    n = x2.shape[0]
+    blk = _res_rows_block(n, d, x.dtype)
+    n_p = -(-n // blk) * blk
+    with _no_x64():
+        y, r = pl.pallas_call(
+            functools.partial(_fwd_res_kernel, eps=eps),
+            grid=(n_p // blk,),
+            in_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                      pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                      pl.BlockSpec((d,), lambda i: (0,))],
+            out_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                       pl.BlockSpec((blk, d), lambda i: (i, 0))],
+            out_shape=[jax.ShapeDtypeStruct((n_p, d), x.dtype),
+                       jax.ShapeDtypeStruct((n_p, d), x.dtype)],
+            interpret=_interpret(),
+        )(_pad_rows(x2, n_p), _pad_rows(r2, n_p), w)
+    return (y[:n].reshape(orig_shape), r[:n].reshape(orig_shape))
+
+
+def _rms_res_fwd(x, res, w, eps):
+    return _rms_res_fwd_impl(x, res, w, eps), (x, res, w)
+
+
+def _rms_res_bwd(eps, resids, gs):
+    x, res, w = resids
+    gy, gr = gs
+    orig_shape = x.shape
+    d = orig_shape[-1]
+    x2 = x.reshape(-1, d)
+    r2 = res.reshape(-1, d)
+    gy2 = gy.reshape(-1, d)
+    gr2 = gr.reshape(-1, d)
+    n = x2.shape[0]
+    blk = _res_rows_block(n, d, x.dtype)
+    n_p = -(-n // blk) * blk
+    with _no_x64():
+        dh = pl.pallas_call(
+            functools.partial(_dres_kernel, eps=eps),
+            grid=(n_p // blk,),
+            in_specs=[pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                      pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                      pl.BlockSpec((d,), lambda i: (0,)),
+                      pl.BlockSpec((blk, d), lambda i: (i, 0)),
+                      pl.BlockSpec((blk, d), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((blk, d), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct((n_p, d), x.dtype),
+            interpret=_interpret(),
+        )(_pad_rows(x2, n_p), _pad_rows(r2, n_p), w,
+          _pad_rows(gy2, n_p), _pad_rows(gr2, n_p))
+    dh = dh[:n].reshape(orig_shape)
+    # dw: full-rows reduction — XLA's job (same split as the plain bwd)
+    hf = (x2 + r2).astype(jnp.float32)
+    ms = jnp.mean(jnp.square(hf), axis=-1, keepdims=True)
+    normed = hf * jax.lax.rsqrt(ms + eps)
+    dw = jnp.sum(gy2.astype(jnp.float32) * normed, axis=0).astype(w.dtype)
+    return dh, dh, dw
+
+
+rms_norm_residual.defvjp(_rms_res_fwd, _rms_res_bwd)
+
+
+def _register_rms_residual_surface():
+    from ...tuner.surface import TunableSurface, register_surface
+
+    register_surface(TunableSurface(
+        name="rms_norm_residual",
+        params=("block_rows",),
+        default={"block_rows": 256},
+        candidates=lambda shape: [{"block_rows": b}
+                                  for b in (64, 128, 256, 512, 1024)],
+        is_valid=lambda config, shape: (config["block_rows"] % 8 == 0
+                                        and config["block_rows"] > 0),
+        describe="Rows per program of the fused RMSNorm+residual "
+                 "fwd/dh kernels (two streams in, two out — tuned "
+                 "separately from plain rms_norm). Shape key: feature "
+                 "dim."))
+
+
+_register_rms_residual_surface()
+
+
+def rms_norm_cost(x_shape, residual=False, train=False):
+    """Static FLOPs/bytes for one (residual-)rmsnorm call (profiler
+    cost-accounting surface): x ``[..., d]``. Bandwidth-bound by
+    construction — the fused pass reads each stream once and writes
+    each output once; the residual variant adds one input and one
+    output stream but zero extra passes."""
+    import math
+
+    from ...profiler.cost import rms_norm_cost as _cost
+    d = int(x_shape[-1])
+    n = int(math.prod(int(s) for s in x_shape[:-1]))
+    return _cost(n, d, residual=residual, train=train)

@@ -28,7 +28,8 @@ from dataclasses import dataclass
 __all__ = ["SectionCost", "Peaks", "device_peaks", "peak_flops",
            "matmul_cost", "attention_cost", "grouped_matmul_cost",
            "transformer_step_flops", "moe_section_costs", "mfu",
-           "roofline"]
+           "roofline", "rms_norm_cost", "swiglu_cost",
+           "fused_linear_ce_cost"]
 
 
 @dataclass
@@ -176,6 +177,51 @@ def moe_section_costs(tokens, d_model, d_hidden, num_experts, top_k, *,
     L = num_moe_layers
     return {"gating": gating * L, "sort": sort * L,
             "expert_matmul": expert * L, "a2a": a2a * L}
+
+
+def rms_norm_cost(n, d, *, residual=False, train=False,
+                  dtype_bytes=2) -> SectionCost:
+    """(Residual-)RMSNorm over ``n`` rows of ``d``: ~4 VPU ops per
+    element fwd (square, reduce, rsqrt-scale, weight mul; +1 for the
+    fused residual add). Bytes are the fused kernel's streams — each
+    input read once, each output written once (the residual variant
+    reads x+res and writes y+r: four streams, not six — exactly the
+    traffic the fusion saves vs an unfused add + norm). ``train``
+    multiplies both by 3 (dh kernel + dw reduction ~ 2 fwd-equiv)."""
+    ops = 5.0 if residual else 4.0
+    streams = 4.0 if residual else 2.0
+    c = SectionCost(
+        flops=ops * n * d,
+        bytes=float(dtype_bytes) * (streams * n * d + d))
+    return c * 3 if train else c
+
+
+def swiglu_cost(n, h, *, train=False, dtype_bytes=2) -> SectionCost:
+    """Fused SwiGLU over ``n`` rows of ``h``: ~6 VPU ops per element
+    fwd (sigmoid ~4 + 2 muls), 3 streams (gate, up in; out). The bwd
+    kernel recomputes sigmoid and writes dgate/dup: ~2x fwd work over
+    5 streams — folded into the x3 train multiplier like every
+    estimator here."""
+    c = SectionCost(flops=6.0 * n * h,
+                    bytes=float(dtype_bytes) * 3.0 * n * h)
+    return c * 3 if train else c
+
+
+def fused_linear_ce_cost(n, d, v, *, train=False,
+                         dtype_bytes=2) -> SectionCost:
+    """Chunked fused linear+cross-entropy: the lm_head matmul
+    ``[n, d] @ [d, v]`` dominates (2ndv FLOPs; softmax/gather work is
+    O(nv) VPU ops on top). Bytes NEVER include an [n, v] logits tensor
+    — that is the point of the op: h and w stream once, the residents
+    are [n]-vectors plus one f32 [n, d] dh accumulator in backward.
+    ``train`` multiplies by 3 (model-FLOPs convention; the backward's
+    logits re-matmul is remat-class recompute and deliberately NOT
+    counted — module docstring)."""
+    c = SectionCost(
+        flops=2.0 * n * d * v + 4.0 * n * v,
+        bytes=float(dtype_bytes) * (n * d + d * v)
+        + 4.0 * 4.0 * n)           # f32 lse/max/sum/target vectors
+    return c * 3 if train else c
 
 
 def mfu(flops, seconds, peak=None, device=None) -> float:

@@ -27,17 +27,20 @@ from __future__ import annotations
 __all__ = ["ensure_builtin_surfaces", "auto_builder",
            "grouped_matmul_builder", "flash_attention_builder",
            "rms_norm_builder", "ragged_attention_builder",
-           "BENCH_PRESETS"]
+           "rms_norm_residual_builder", "swiglu_builder",
+           "fused_ce_builder", "BENCH_PRESETS"]
 
 
 def ensure_builtin_surfaces():
     """Import every module that registers a built-in surface (imports
     are the registration mechanism — registrations live next to their
     knobs)."""
+    from ..ops import fused_ce  # noqa: F401
     from ..ops.pallas import flash_attention  # noqa: F401
     from ..ops.pallas import grouped_matmul  # noqa: F401
     from ..ops.pallas import ragged_paged_attention  # noqa: F401
     from ..ops.pallas import rms_norm  # noqa: F401
+    from ..ops.pallas import swiglu  # noqa: F401
     from ..nn import scan  # noqa: F401
     from ..inference import serving  # noqa: F401
 
@@ -165,6 +168,119 @@ def rms_norm_builder(rows=4096, dtype="bfloat16", train=True):
     return builder
 
 
+def rms_norm_residual_builder(rows=4096, dtype="bfloat16", train=True):
+    """Builder for the ``rms_norm_residual`` surface (shape supplies
+    d): the fused residual-add + norm pair, fwd + the fused dh bwd
+    when ``train`` — the configuration the decoder hot path runs."""
+    import jax
+    import jax.numpy as jnp
+
+    def builder(config, shape):
+        from ..ops.pallas.rms_norm import (force_residual_rows_block,
+                                           rms_norm_residual)
+        d = int(shape["d"])
+        dt = jnp.dtype(dtype)
+        key = jax.random.PRNGKey(0)
+        kx, kr, kw = jax.random.split(key, 3)
+        x = jax.random.normal(kx, (int(rows), d),
+                              jnp.float32).astype(dt)
+        r = jax.random.normal(kr, (int(rows), d),
+                              jnp.float32).astype(dt)
+        w = jax.random.normal(kw, (d,), jnp.float32).astype(dt)
+        blk = int(config["block_rows"])
+
+        if train:
+            def loss(x, r, w):
+                y, rr = rms_norm_residual(x, r, w)
+                return (y.astype(jnp.float32).sum()
+                        + rr.astype(jnp.float32).sum())
+            step = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        else:
+            step = jax.jit(lambda x, r, w: rms_norm_residual(x, r, w))
+
+        def fn():
+            with force_residual_rows_block(blk):
+                return _trial(step, x, r, w)
+        return fn
+
+    return builder
+
+
+def swiglu_builder(rows=4096, dtype="bfloat16", train=True):
+    """Builder for the ``swiglu`` surface (shape supplies the
+    intermediate dim h)."""
+    import jax
+    import jax.numpy as jnp
+
+    def builder(config, shape):
+        from ..ops.pallas.swiglu import force_swiglu_blocks, swiglu_fused
+        h = int(shape["h"])
+        dt = jnp.dtype(dtype)
+        key = jax.random.PRNGKey(0)
+        kg, ku = jax.random.split(key)
+        g = jax.random.normal(kg, (int(rows), h),
+                              jnp.float32).astype(dt)
+        u = jax.random.normal(ku, (int(rows), h),
+                              jnp.float32).astype(dt)
+        br = int(config["block_rows"])
+        bc = int(config["block_cols"])
+
+        if train:
+            def loss(g, u):
+                return swiglu_fused(g, u).astype(jnp.float32).sum()
+            step = jax.jit(jax.grad(loss, argnums=(0, 1)))
+        else:
+            step = jax.jit(swiglu_fused)
+
+        def fn():
+            with force_swiglu_blocks(br, bc):
+                return _trial(step, g, u)
+        return fn
+
+    return builder
+
+
+def fused_ce_builder(rows=4096, dtype="bfloat16", train=True):
+    """Builder for the ``fused_ce`` surface (shape supplies d/v): the
+    chunked lm_head+CE tail at the train geometry. Candidates pin the
+    chunk width through ``force_chunk_v`` (NOT set_flags — that would
+    mark FLAGS_fused_ce_chunk_v user-explicit and defeat the
+    override > cache > default precedence), fresh jit per candidate."""
+    import jax
+    import jax.numpy as jnp
+
+    def builder(config, shape):
+        from ..ops.fused_ce import (force_chunk_v,
+                                    fused_linear_cross_entropy)
+        d, v = int(shape["d"]), int(shape["v"])
+        n = int(rows)
+        dt = jnp.dtype(dtype)
+        key = jax.random.PRNGKey(0)
+        kh, kw, kl = jax.random.split(key, 3)
+        h = jax.random.normal(kh, (n, d), jnp.float32).astype(dt)
+        w = (jax.random.normal(kw, (d, v), jnp.float32) * 0.02).astype(dt)
+        labels = jax.random.randint(kl, (n,), 0, v, jnp.int32)
+        cv = int(config["chunk_v"])
+
+        if train:
+            step = jax.jit(jax.grad(
+                lambda hh, ww: fused_linear_cross_entropy(hh, ww,
+                                                          labels),
+                argnums=(0, 1)))
+        else:
+            step = jax.jit(lambda hh, ww: fused_linear_cross_entropy(
+                hh, ww, labels))
+
+        def fn():
+            # the force context must cover the first (tracing) call;
+            # later calls hit this candidate's own jit cache
+            with force_chunk_v(cv):
+                return _trial(step, h, w)
+        return fn
+
+    return builder
+
+
 def ragged_attention_builder(slots=8, heads=8, kv_heads=2,
                              dtype="bfloat16"):
     """Builder for the ``ragged_paged_attention`` surface (shape
@@ -226,6 +342,10 @@ _AUTO_BUILDERS = {
     "grouped_matmul": lambda dtype: grouped_matmul_builder(dtype=dtype),
     "flash_attention": lambda dtype: flash_attention_builder(dtype=dtype),
     "rms_norm": lambda dtype: rms_norm_builder(dtype=dtype),
+    "rms_norm_residual":
+        lambda dtype: rms_norm_residual_builder(dtype=dtype),
+    "swiglu": lambda dtype: swiglu_builder(dtype=dtype),
+    "fused_ce": lambda dtype: fused_ce_builder(dtype=dtype),
     "ragged_paged_attention":
         lambda dtype: ragged_attention_builder(dtype=dtype),
 }
@@ -250,6 +370,11 @@ BENCH_PRESETS = {
     "llama_train": [
         ("flash_attention", {"sq": 2048, "sk": 2048, "d": 128}),
         ("rms_norm", {"d": 2560}),
+        # the training-kernel suite at the v5e 2.4B train bench
+        # geometry (hidden 2560, intermediate 6912, vocab 32000)
+        ("rms_norm_residual", {"d": 2560}),
+        ("swiglu", {"h": 6912}),
+        ("fused_ce", {"d": 2560, "v": 32000}),
     ],
     "serving": [
         # the v5e llama_1b cb-bench geometry: chunk 32, 12-page rows of
@@ -261,6 +386,9 @@ BENCH_PRESETS = {
         ("grouped_matmul", {"d": 64, "h": 128, "E": 4}),
         ("flash_attention", {"sq": 128, "sk": 128, "d": 64}),
         ("rms_norm", {"d": 128}),
+        ("rms_norm_residual", {"d": 128}),
+        ("swiglu", {"h": 256}),
+        ("fused_ce", {"d": 64, "v": 1024}),
         ("ragged_paged_attention",
          {"c": 8, "pages": 4, "page": 8, "d": 16}),
     ],

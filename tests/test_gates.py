@@ -31,22 +31,24 @@ def test_known_gates_are_registered():
     finally:
         sys.path.pop(0)
     assert names == ["atomic_writes", "fast_tier_budget",
-                     "elastic_chaos", "serving_parity"]
+                     "elastic_chaos", "serving_parity", "fused_parity"]
 
 
 def test_all_gates_pass_on_healthy_log(tmp_path):
-    # --no-chaos/--no-serving: both heavyweight gates run ONCE in the
-    # fast tier through their own test modules (+ the slow full-driver
-    # test below); re-spawning them here would double their cost for
-    # no coverage
+    # --no-chaos/--no-serving/--no-fused: the heavyweight gates run
+    # ONCE in the fast tier through their own test modules (+ the slow
+    # full-driver test below); re-spawning them here would double
+    # their cost for no coverage
     log = tmp_path / "t1.log"
     log.write_text("606 passed, 2 failed in 115.60s (0:01:55)\n")
-    p = _run("--log", str(log), "--no-chaos", "--no-serving")
+    p = _run("--log", str(log), "--no-chaos", "--no-serving",
+             "--no-fused")
     assert p.returncode == 0, p.stdout + p.stderr
     assert "atomic_writes: PASS" in p.stdout
     assert "fast_tier_budget: PASS" in p.stdout
     assert "elastic_chaos" not in p.stdout
     assert "serving_parity" not in p.stdout
+    assert "fused_parity" not in p.stdout
     assert "all gates passed" in p.stdout
 
 
@@ -61,26 +63,28 @@ def test_full_driver_including_chaos_gate(tmp_path):
     assert p.returncode == 0, p.stdout + p.stderr
     assert "elastic_chaos: PASS" in p.stdout
     assert "serving_parity: PASS" in p.stdout
+    assert "fused_parity: PASS" in p.stdout
     assert "all gates passed" in p.stdout
 
 
 def test_over_budget_log_fails_the_driver(tmp_path):
     log = tmp_path / "t1.log"
     log.write_text("606 passed in 700.00s (0:11:40)\n")
-    p = _run("--log", str(log), "--no-chaos", "--no-serving")
+    p = _run("--log", str(log), "--no-chaos", "--no-serving",
+             "--no-fused")
     assert p.returncode == 1
     assert "fast_tier_budget: FAIL" in p.stdout
 
 
 def test_missing_log_is_a_failing_gate(tmp_path):
     p = _run("--log", str(tmp_path / "nope.log"), "--no-chaos",
-             "--no-serving")
+             "--no-serving", "--no-fused")
     assert p.returncode == 1     # silence must never read as clean
 
 
 def test_no_budget_skips_only_the_budget_gate(tmp_path):
     p = _run("--no-budget", "--no-chaos", "--no-serving",
-             "--log", str(tmp_path / "nope.log"))
+             "--no-fused", "--log", str(tmp_path / "nope.log"))
     assert p.returncode == 0
     assert "atomic_writes: PASS" in p.stdout
     assert "fast_tier_budget" not in p.stdout

@@ -18,6 +18,7 @@ Usage::
     python tools/run_gates.py --no-budget         # no tier-1 log yet
     python tools/run_gates.py --no-chaos          # skip the kill smoke
     python tools/run_gates.py --no-serving        # skip engine parity
+    python tools/run_gates.py --no-fused          # skip kernel parity
 
 ``--no-budget`` skips the fast-tier budget gate for contexts where no
 tier-1 log exists (e.g. pre-commit on a docs change); ``--no-chaos``
@@ -39,7 +40,8 @@ REPO_DIR = os.path.dirname(TOOLS_DIR)
 
 
 def gate_commands(log: str, budget: float, no_budget: bool,
-                  no_chaos: bool = False, no_serving: bool = False):
+                  no_chaos: bool = False, no_serving: bool = False,
+                  no_fused: bool = False):
     """The authoritative gate list: (name, argv). New hygiene gates
     register HERE (tests/test_gates.py pins the known ones so a gate
     cannot be dropped silently)."""
@@ -79,6 +81,25 @@ def gate_commands(log: str, budget: float, no_budget: bool,
                            "test_serving_parity.py"),
               "-q", "-m", "serving_parity",
               "-p", "no:cacheprovider"]))
+    if not no_fused:
+        # fused training-kernel parity: the interpret-mode kernel-vs-
+        # oracle suite with every fused flag forced ON via the
+        # environment (env beats any cached/tuned value by the flag-
+        # precedence contract), so the gate exercises exactly the
+        # configuration the compiled fit hot path runs — CPU-cheap,
+        # inside the tier-1 budget tripwire
+        fused_env = {"FLAGS_fused_linear_cross_entropy": "1",
+                     "FLAGS_fused_rmsnorm_residual": "1",
+                     "FLAGS_fused_swiglu": "1",
+                     "FLAGS_fused_ce_pallas_inner": "1"}
+        gates.append(
+            ("fused_parity",
+             ["env", *[f"{k}={v}" for k, v in fused_env.items()],
+              sys.executable, "-m", "pytest",
+              os.path.join(REPO_DIR, "tests",
+                           "test_fused_training_kernels.py"),
+              "-q", "-m", "fused_parity",
+              "-p", "no:cacheprovider"]))
     return gates
 
 
@@ -100,12 +121,16 @@ def main(argv=None) -> int:
     ap.add_argument("--no-serving", action="store_true",
                     help="skip the unified-vs-legacy serving parity "
                          "gate (compiles two tiny engines)")
+    ap.add_argument("--no-fused", action="store_true",
+                    help="skip the fused training-kernel parity gate "
+                         "(interpret-mode kernel suite, fused flags "
+                         "forced on)")
     args = ap.parse_args(argv)
 
     failures = 0
     for name, cmd in gate_commands(args.log, args.budget,
                                    args.no_budget, args.no_chaos,
-                                   args.no_serving):
+                                   args.no_serving, args.no_fused):
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True)
             rc = proc.returncode
