@@ -20,8 +20,40 @@ measured so far:
 from __future__ import annotations
 
 import json
+import os
 import sys
 import time
+
+
+def _provenance(dev) -> dict:
+    """Attribution metadata stamped into EVERY record line: when a
+    round goes sideways (BENCH_r05's tunnel outage), the artifact alone
+    must say which jax, which chip/backend, which restart round and
+    which commit produced it — no cross-referencing driver logs."""
+    import platform
+    import subprocess
+
+    import jax
+    git_rev = None
+    try:
+        p = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        git_rev = p.stdout.strip() or None
+    except Exception:
+        pass
+    return {
+        "jax_version": jax.__version__,
+        "backend": dev.platform,
+        "chip": getattr(dev, "device_kind", None) or "?",
+        "device_count": jax.device_count(),
+        "restart_round": int(os.environ.get("PADDLE_RESTART_ROUND",
+                                            "0")),
+        "git_rev": git_rev,
+        "python": platform.python_version(),
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+    }
 
 
 def _retry_transient(fn, what, tries=3, wait=20.0):
@@ -313,6 +345,10 @@ def _fit_e2e_bench(on_tpu, dev, autotune=False):
     s = m._last_epoch_summary
     fit_ms = s["avg_step_ms"]
     tokens = batch * seq
+    # goodput ledger projection (obs_* keys, docs/observability.md):
+    # the compiled fit's wall-time partition — captured HERE, before
+    # the eager oracle fit below replaces the model's ledger
+    gp_keys = m._goodput.bench_keys() if m._goodput is not None else {}
 
     # (c) eager oracle loop (CPU smoke only — see docstring)
     eager_ms = None
@@ -331,6 +367,7 @@ def _fit_e2e_bench(on_tpu, dev, autotune=False):
         "input_prefetch_depth": m._fit_pipeline["prefetch_depth"],
         "input_steps_in_flight": m._fit_pipeline["steps_in_flight"],
     }
+    out.update(gp_keys)
     if eager_ms is not None:
         out["train_e2e_eager_step_ms"] = round(eager_ms, 3)
         out["train_e2e_vs_eager"] = round(eager_ms / fit_ms, 4)
@@ -1062,6 +1099,9 @@ def main():
         "value": round(train_tok_s, 2),
         "unit": "tokens/s/chip",
         "vs_baseline": round(mfu / 0.40, 4),
+        # provenance rides every printed line (the record is re-printed
+        # incrementally; each line stays attributable on its own)
+        "provenance": _provenance(dev),
     }
     record.update(tuned)
     print(json.dumps(record), flush=True)
@@ -1153,6 +1193,10 @@ def main():
         # grep ONE name — assigned from the record, cannot diverge)
         record["cb_unified_tok_s"] = record["cb_value"]
         record["cb_unified_steps"] = cb_gauges["unified_steps"]
+        # observability self-measurement: instrumentation's share of
+        # the serving hot loop (<2% pinned by test_metrics)
+        record["obs_overhead_frac"] = round(
+            cb_gauges.get("obs_overhead_frac", 0.0), 6)
         if cb_legacy:
             record["cb_legacy_tok_s"] = round(cb_legacy, 2)
             record["cb_unified_vs_legacy"] = round(

@@ -58,6 +58,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...framework.core import Tensor
+from ...profiler import flight_recorder as _frec
+from ...profiler import metrics as _pmetrics
 from ...utils.retry import retry_call
 from .validation import (
     COMMITTED_SENTINEL, CheckpointCorruptError,
@@ -77,6 +79,12 @@ _FORMAT_VERSION = 1
 
 #: multi-rank attempt token (see module docstring, step 3)
 ATTEMPT_FILE = "ATTEMPT"
+
+_pmetrics.declare("elastic/reshard_tensors", "gauge",
+                  "tensors laid out for a different mesh during a "
+                  "checkpoint load")
+_pmetrics.declare("elastic/reshard_ms", "gauge",
+                  "wall time of the reshard-on-load pass")
 
 
 def _flat(state_dict, prefix=""):
@@ -330,6 +338,10 @@ def _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n,
     timeout = _barrier_timeout() if barrier_timeout is None \
         else float(barrier_timeout)
     _active_stages.add(stage)
+    # flight-recorder breadcrumbs: a save killed mid-protocol leaves
+    # the phase it died in inside the crash bundle
+    _frec.record_event("checkpoint_phase", phase="stage", path=final,
+                       rank=rank)
     try:
         if world <= 1:
             # single process: uid is fresh/random, no stale-staging or
@@ -371,6 +383,8 @@ def _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n,
                         raise
             return final
         if world > 1:
+            _frec.record_event("checkpoint_phase", phase="barrier",
+                               path=final, rank=rank)
             _barrier_on_acks(stage, world, attempt, timeout)
         meta_shas = {}
         for r in range(world):
@@ -392,6 +406,8 @@ def _write_checkpoint(host, path, coordinator_rank, uid, keep_last_n,
                       json.dumps(sentinel).encode())
         _fsync_dir(stage)
         _commit_rename(stage, final)
+        _frec.record_event("checkpoint_phase", phase="committed",
+                           path=final, rank=rank)
     finally:
         _active_stages.discard(stage)
     parent = os.path.dirname(final) or "."
@@ -526,11 +542,10 @@ def load_state_dict(state_dict, path, process_group=None,
     if n_resharded:
         # elastic observability: a cross-mesh resume's reshard cost
         # shows up as a gauge, not a mystery gap in resume time
-        from ...profiler import trace as _trace
-        tracer = _trace.get_tracer()
-        tracer.counter("elastic/reshard_tensors", n_resharded)
-        tracer.counter("elastic/reshard_ms",
-                       round((time.perf_counter() - t0) * 1e3, 3))
+        reg = _pmetrics.get_registry()
+        reg.gauge("elastic/reshard_tensors").set(n_resharded)
+        reg.gauge("elastic/reshard_ms").set(
+            round((time.perf_counter() - t0) * 1e3, 3))
     return state_dict
 
 

@@ -167,6 +167,13 @@ def _process_gather_np(data):
     """All-gather a process-local array to every process: [P, ...]."""
     import numpy as np
     from jax.experimental import multihost_utils
+    # the choke point every eager host-mediated collective funnels
+    # through — and the op that HANGS when a peer died. Entry lands in
+    # the flight-recorder ring so a stall bundle shows which collective
+    # the process never returned from (no-op while uninstalled).
+    from ..profiler import flight_recorder as _frec
+    _frec.record_event("collective", op="process_allgather",
+                       rank=jax.process_index())
     return np.asarray(multihost_utils.process_allgather(
         jnp.asarray(data), tiled=False))
 

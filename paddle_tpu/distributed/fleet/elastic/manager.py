@@ -141,6 +141,21 @@ class ElasticManager:
         stale = [r for r in watched
                  if now - beats[r] > self.timeout]
         if stale:
+            # a stale heartbeat is the elastic no-progress signal: drop
+            # a flight-recorder bundle from the watcher process (ring +
+            # stacks + metrics) before the launcher tears the round down
+            from ....profiler import flight_recorder as _frec
+            _frec.record_event("heartbeat_stale", ranks=list(stale),
+                               gap_s=round(now - min(
+                                   beats[r] for r in stale), 3))
+            rec = _frec.get_recorder()
+            if rec is not None:
+                try:
+                    rec.dump(f"elastic heartbeat gap: ranks {stale} "
+                             f"stale past {self.timeout}s")
+                except OSError:
+                    pass    # the launcher must still receive STALE and
+                            # tear the round down; the bundle is a bonus
             return ElasticStatus.STALE, stale
         return ElasticStatus.HEALTHY, []
 

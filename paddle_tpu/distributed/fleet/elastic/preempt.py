@@ -69,6 +69,16 @@ def _install_excepthook():
 
     def hook(exc_type, exc, tb):
         if isinstance(exc, Preempted):
+            # drop a flight-recorder bundle first (ring + thread
+            # stacks + metrics snapshot): the preemption becomes a
+            # diagnosable artifact, not just an exit code
+            try:
+                from ....profiler import flight_recorder as _frec
+                rec = _frec.get_recorder()
+                if rec is not None:
+                    rec.dump(f"preempted: {exc}")
+            except Exception:  # noqa: BLE001 — the exit must proceed
+                pass
             print(f"paddle_tpu: {exc} — exiting "
                   f"{exc.exit_code} (clean preemption)", file=sys.stderr)
             sys.exit(exc.exit_code)

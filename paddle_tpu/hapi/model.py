@@ -24,11 +24,46 @@ import numpy as np
 from ..framework.core import Tensor, no_grad
 from ..framework.io import save as save_obj, load as load_obj
 from ..io import DataLoader, DevicePrefetcher
+from ..profiler import flight_recorder as _frec
+from ..profiler import metrics as _pmetrics
 from ..profiler import trace as _trace
+from ..profiler.goodput import GoodputLedger
 from ..tuner.surface import TunableSurface, register_surface
 from ..utils import monitor
 
 __all__ = ["Model"]
+
+#: process-wide registry: fit-pipeline gauges + elastic/restart
+#: accounting flow through it (updates mirror into the structured
+#: tracer while tracing is enabled, so chrome exports keep carrying
+#: them — docs/observability.md)
+_REG = _pmetrics.get_registry()
+
+_pmetrics.declare("hapi/input_wait_ms", "gauge",
+                  "prefetcher starvation: ms the fit loop waited on "
+                  "input this epoch")
+_pmetrics.declare("hapi/steps_in_flight", "gauge",
+                  "dispatched-but-unfetched compiled steps at last "
+                  "dispatch")
+_pmetrics.declare("hapi/h2d_bytes", "gauge",
+                  "bytes device-placed by the input pipeline this "
+                  "epoch")
+_pmetrics.declare("hapi/avg_step_ms", "gauge",
+                  "per-epoch mean train-step wall time (epoch summary)")
+_pmetrics.declare("elastic/preempt_requested", "counter",
+                  "preemption signals that reached the fit loop")
+_pmetrics.declare("elastic/emergency_save_ms", "gauge",
+                  "wall time of the bounded-time emergency checkpoint")
+_pmetrics.declare("elastic/emergency_step", "gauge",
+                  "epoch-relative step the emergency checkpoint "
+                  "captured")
+_pmetrics.declare("restart/round", "gauge",
+                  "the launcher's PADDLE_RESTART_ROUND at resume")
+_pmetrics.declare("restart/resume_epoch", "gauge",
+                  "epoch training resumed at")
+_pmetrics.declare("restart/resume_step", "gauge",
+                  "first step consumed after a mid-epoch resume (0 = "
+                  "epoch start)")
 
 
 #: fit's pipeline knobs registered as a tunable surface (next to the
@@ -48,6 +83,19 @@ register_surface(TunableSurface(
              "compiled-step window"))
 
 
+def _persist_ledger(ledger):
+    """Best-effort goodput-ledger persist: an ENOSPC on the bookkeeping
+    file must never mask an in-flight Preempted (the exit-75 launcher
+    contract), skip fit's finally-block cleanup, or fail a training run
+    that otherwise succeeded."""
+    try:
+        ledger.persist()
+    except OSError as e:
+        import warnings
+        warnings.warn(f"goodput ledger persist failed ({e!r}); "
+                      "continuing without on-disk goodput continuity")
+
+
 class Model:
     def __init__(self, network, inputs=None, labels=None):
         self.network = network
@@ -59,6 +107,7 @@ class Model:
         self._compiled_eval_step = None
         self._fit_pipeline = None
         self._resume_mid_step = None
+        self._goodput = None
 
     def prepare(self, optimizer=None, loss=None, metrics=None,
                 amp_configs=None, scaler=None):
@@ -291,7 +340,6 @@ class Model:
         steps the preempted run already consumed (they are iterated but
         never dispatched). Returns (losses, prefetcher,
         host_dispatch_seconds, last_step, preempted)."""
-        tracer = _trace.get_tracer()
         it = iter(loader)
         host_skipped = 0
         if isinstance(it, DevicePrefetcher):
@@ -338,13 +386,17 @@ class Model:
                 v = float(np.asarray(t._data))
                 losses.append(v)
                 monitor.emit_step_metrics(epoch=epoch, loss=v)
-            tracer.counter("hapi/input_wait_ms",
-                           round(pf.input_wait_s * 1e3, 3), epoch=epoch)
+            _REG.gauge("hapi/input_wait_ms").set(
+                round(pf.input_wait_s * 1e3, 3), epoch=epoch)
 
         last_step = skip_to - 1
         preempted = False
+        _wd_token = _frec.arm("fit compiled epoch")
         try:
             for step, batch in enumerate(pf, start=host_skipped):
+                # step-boundary progress for the watchdog (owner-token
+                # scoped so these beats never mask another component)
+                _frec.beat(_wd_token)
                 if guard is not None and guard.requested():
                     # step boundary: stop dispatching; the drain below
                     # resolves every in-flight step before the
@@ -366,7 +418,7 @@ class Model:
                 last_step = step
                 pending.append((step, loss_t))
                 in_flight_now = min(len(pending), in_flight)
-                tracer.counter("hapi/steps_in_flight", in_flight_now)
+                _REG.gauge("hapi/steps_in_flight").set(in_flight_now)
                 if len(pending) > in_flight:
                     # backpressure: block on the readiness (not the
                     # value) of the step in_flight behind the newest —
@@ -381,8 +433,9 @@ class Model:
                               f"loss {losses[-1]:.5f}")
             resolve_pending()
         finally:
+            _frec.disarm(_wd_token)
             pf.close()
-        tracer.counter("hapi/h2d_bytes", pf.h2d_bytes, epoch=epoch)
+        _REG.gauge("hapi/h2d_bytes").set(pf.h2d_bytes, epoch=epoch)
         return losses, pf, host_s, last_step, preempted
 
     def _fit_epoch_eager(self, loader, epoch, log_freq, verbose,
@@ -455,6 +508,18 @@ class Model:
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
+        # goodput ledger: end-to-end wall-time partition (productive
+        # compiled steps vs input-wait / saves / restarts / recompiles
+        # — docs/observability.md). In-memory always; persisted next to
+        # the checkpoints so restart rounds accumulate into ONE ledger
+        # and a preempted run still reports honest end-to-end goodput.
+        # load=resume: a deliberately fresh fit into a reused save_dir
+        # must not inherit (and book days of "restart" loss against) a
+        # previous run's ledger; elastic relaunches pass resume=True
+        ledger = GoodputLedger(
+            path=f"{save_dir}/goodput.json" if save_dir else None,
+            load=bool(resume))
+        self._goodput = ledger
         start_epoch = 0
         resume_skip = 0  # steps already consumed in start_epoch
         if resume:
@@ -466,7 +531,10 @@ class Model:
                     latest_valid_checkpoint
                 ckpt_path = latest_valid_checkpoint(save_dir)
             if ckpt_path:
-                epoch_done = self.load_checkpoint(ckpt_path)
+                # resume restore (validated load + cross-mesh reshard)
+                # is lost time the ledger books against "reshard"
+                with ledger.measure("reshard"):
+                    epoch_done = self.load_checkpoint(ckpt_path)
                 mid = self._resume_mid_step
                 if mid is None:
                     start_epoch = epoch_done + 1
@@ -475,12 +543,13 @@ class Model:
                     # from the step after the last one consumed
                     start_epoch = epoch_done
                     resume_skip = int(mid) + 1
-                tracer = _trace.get_tracer()
-                tracer.counter(
-                    "restart/round",
+                _REG.gauge("restart/round").set(
                     int(_os.environ.get("PADDLE_RESTART_ROUND", "0")))
-                tracer.counter("restart/resume_epoch", start_epoch)
-                tracer.counter("restart/resume_step", resume_skip)
+                _REG.gauge("restart/resume_epoch").set(start_epoch)
+                _REG.gauge("restart/resume_step").set(resume_skip)
+                _frec.record_event("resume", epoch=start_epoch,
+                                   step=resume_skip,
+                                   checkpoint=str(ckpt_path))
                 if verbose:
                     mid_msg = f" step {resume_skip}" if resume_skip \
                         else ""
@@ -537,6 +606,7 @@ class Model:
                 if compiled:
                     runs0 = (step_fn.n_compiled_runs,
                              step_fn.n_eager_runs)
+                    comp_s0 = step_fn.compile_seconds
                     losses, pf, host_s, last_step, preempted = \
                         self._fit_epoch_compiled(
                             loader, step_fn, epoch, log_freq, verbose,
@@ -556,6 +626,9 @@ class Model:
                                  step_fn.n_compiled_runs - runs0[0],
                              "eager_steps":
                                  step_fn.n_eager_runs - runs0[1]}
+                    ledger.add("input_wait", pf.input_wait_s)
+                    ledger.add("recompile",
+                               step_fn.compile_seconds - comp_s0)
                 else:
                     losses, last_step, preempted = self._fit_epoch_eager(
                         loader, epoch, log_freq, verbose,
@@ -567,11 +640,14 @@ class Model:
                     epoch, steps=len(losses),
                     seconds=time.perf_counter() - epoch_t0,
                     mean_loss=round(float(np.mean(losses)), 6)
-                    if losses else None, **extra)
+                    if losses else None,
+                    goodput_frac=ledger.summary()["goodput_frac"],
+                    **extra)
                 self._last_epoch_summary = summary
                 if preempted:
                     ck = self._emergency_checkpoint(
                         save_dir, epoch, last_step, keep_last_n, guard)
+                    _persist_ledger(ledger)
                     from ..distributed.fleet.elastic import Preempted
                     raise Preempted(
                         f"preempted at epoch {epoch} step {last_step}; "
@@ -582,15 +658,22 @@ class Model:
                           f"steps in {summary['epoch_s']:.2f}s "
                           f"(avg {summary['avg_step_ms']:.1f} ms/step)")
                 if save_dir is not None and epoch % save_freq == 0:
-                    if legacy_save:
-                        self.save(f"{save_dir}/epoch_{epoch}")
-                    self.save_checkpoint(f"{save_dir}/step_{epoch}",
-                                         epoch=epoch,
-                                         keep_last_n=keep_last_n)
+                    with ledger.measure("checkpoint_save"):
+                        if legacy_save:
+                            self.save(f"{save_dir}/epoch_{epoch}")
+                        self.save_checkpoint(f"{save_dir}/step_{epoch}",
+                                             epoch=epoch,
+                                             keep_last_n=keep_last_n)
+                    _persist_ledger(ledger)
                 if eval_data is not None and epoch % eval_freq == 0:
                     self.evaluate(eval_data, batch_size=batch_size,
                                   verbose=verbose, compiled=compiled)
         finally:
+            # freeze the wall clock at end-of-run: the ledger stays on
+            # self._goodput, and a summary()/bench_keys() read hours
+            # later must not book the idle gap as productive time
+            ledger.close()
+            _persist_ledger(ledger)
             _scope.close()
             if own_guard:
                 guard.uninstall()
@@ -676,8 +759,8 @@ class Model:
         in-flight window is already drained, so device state is exactly
         post-step ``step`` of ``epoch``. Returns the committed path
         (None when fit has no save_dir to commit into)."""
-        tracer = _trace.get_tracer()
-        tracer.counter("elastic/preempt_requested", 1)
+        _REG.counter("elastic/preempt_requested").inc()
+        _frec.record_event("preempt_requested", epoch=epoch, step=step)
         if save_dir is None:
             return None
         t0 = time.perf_counter()
@@ -687,9 +770,13 @@ class Model:
             bound = None
         self.save_checkpoint(path, epoch=epoch, keep_last_n=keep_last_n,
                              mid_epoch_step=step, barrier_timeout=bound)
-        tracer.counter("elastic/emergency_save_ms",
-                       round((time.perf_counter() - t0) * 1e3, 3))
-        tracer.counter("elastic/emergency_step", int(step), epoch=epoch)
+        elapsed = time.perf_counter() - t0
+        ledger = getattr(self, "_goodput", None)
+        if ledger is not None:
+            ledger.add("emergency_save", elapsed)
+        _REG.gauge("elastic/emergency_save_ms").set(
+            round(elapsed * 1e3, 3))
+        _REG.gauge("elastic/emergency_step").set(int(step), epoch=epoch)
         return path
 
     def load_checkpoint(self, path):

@@ -88,8 +88,91 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.core import Tensor, no_grad
+from ..profiler import flight_recorder as _frec
+from ..profiler import metrics as _pmetrics
 
 __all__ = ["ContinuousBatchingEngine", "ServedRequest"]
+
+# the serving metric vocabulary (docs/observability.md table;
+# tools/check_metric_names.py lints these literals). Each engine owns
+# a PRIVATE MetricsRegistry instance of these — two engines in one
+# process never cross-pollute.
+_pmetrics.declare("serving/chunks", "counter",
+                  "compiled programs dispatched (unified steps + legacy "
+                  "decode chunks)")
+_pmetrics.declare("serving/chunk_slot_steps", "counter",
+                  "slot-steps dispatched (num_slots x chunk length, "
+                  "active or not)")
+_pmetrics.declare("serving/active_slot_steps", "counter",
+                  "slot-steps belonging to slots that could advance at "
+                  "dispatch")
+_pmetrics.declare("serving/tokens_emitted", "counter",
+                  "generated tokens delivered to requests")
+_pmetrics.declare("serving/prefills", "counter",
+                  "requests admitted into a slot")
+_pmetrics.declare("serving/prefills_overlapped", "counter",
+                  "admissions made while a compiled program was in "
+                  "flight (overlap pipeline)")
+_pmetrics.declare("serving/prefill_waves", "counter",
+                  "programs that carried prompt tokens")
+_pmetrics.declare("serving/chunks_empty", "counter",
+                  "harvested programs that delivered no tokens "
+                  "(unpredictable eos stops)")
+_pmetrics.declare("serving/unified_steps", "counter",
+                  "unified batching-step programs dispatched (0 in "
+                  "legacy mode)")
+_pmetrics.declare("serving/requests_completed", "counter",
+                  "requests finished (eos or length)")
+_pmetrics.declare("serving/run_seconds", "counter",
+                  "wall seconds spent inside run()")
+_pmetrics.declare("serving/ttft_ms", "histogram",
+                  "request arrival -> first token on host, ms (bounded "
+                  "reservoir; p50/p99 exposed via gauges())")
+_pmetrics.declare("serving/itl_ms", "histogram",
+                  "smoothed inter-token latency per request with >=2 "
+                  "tokens, ms (bounded reservoir)")
+_pmetrics.declare("obs/overhead_frac", "gauge",
+                  "fraction of serving run() wall time spent inside "
+                  "observability instrumentation (self-measured; the "
+                  "<2% pinned contract)")
+
+#: the historical ``_stats`` key set, preserved verbatim — now backed
+#: by ``serving/*`` registry counters
+_STAT_KEYS = ("chunks", "chunk_slot_steps", "active_slot_steps",
+              "tokens_emitted", "prefills", "prefills_overlapped",
+              "prefill_waves", "chunks_empty", "unified_steps",
+              "requests_completed", "run_seconds")
+
+
+class _StatsView:
+    """Dict-shaped view over the engine's registry counters: the
+    ``_stats`` surface predates the metrics registry and tests index
+    it (``eng._stats["active_slot_steps"]``), so the migration keeps
+    the mapping protocol while the registry holds the truth."""
+
+    __slots__ = ("_c",)
+
+    def __init__(self, registry):
+        self._c = {k: registry.counter("serving/" + k)
+                   for k in _STAT_KEYS}
+
+    def __getitem__(self, k):
+        return self._c[k].value
+
+    def __setitem__(self, k, v):
+        self._c[k].set(v)
+
+    def inc(self, k, n=1):
+        self._c[k].inc(n)
+
+    def __iter__(self):
+        return iter(self._c)
+
+    def keys(self):
+        return self._c.keys()
+
+    def as_dict(self):
+        return {k: c.value for k, c in self._c.items()}
 
 
 @dataclass
@@ -103,8 +186,12 @@ class ServedRequest:
     finish_reason: str | None = None   # "eos" | "length"
     # latency accounting (seconds, perf_counter clock)
     t_arrive: float = 0.0              # add_request
+    t_admit: float = 0.0               # admitted into a slot
+    t_prefill_done: float = 0.0        # prompt fully streamed
     t_first: float = 0.0               # first token visible host-side
     t_done: float = 0.0                # finished
+    #: lifecycle-trace sampling decision (engine trace_sample_rate)
+    traced: bool = False
 
 
 class ContinuousBatchingEngine:
@@ -126,7 +213,8 @@ class ContinuousBatchingEngine:
                  max_len=512, decode_chunk=None, prompt_buckets=(32, 64, 128),
                  eos_token_id=None, greedy=True, temperature=1.0,
                  seed=0, prefill_chunk=None, admit_batch=None,
-                 adaptive_chunk=True, unified=True):
+                 adaptive_chunk=True, unified=True,
+                 trace_sample_rate=0.01, latency_reservoir=2048):
         self.model = model
         cfg = model.config
         self.cfg = cfg
@@ -242,19 +330,30 @@ class ContinuousBatchingEngine:
         self._unified_fn = None
         self._emits_inflight = np.zeros((B,), np.int32)
 
-        # perf observability (profiler subsystem): raw counters behind
-        # the :meth:`gauges` surface — slot occupancy, admission/prefill
-        # overlap, tok/s, latency percentiles. Maintained
-        # unconditionally (integer adds); mirrored into the trace layer
-        # only when tracing is enabled.
-        self._stats = {"chunks": 0, "chunk_slot_steps": 0,
-                       "active_slot_steps": 0, "tokens_emitted": 0,
-                       "prefills": 0, "prefills_overlapped": 0,
-                       "prefill_waves": 0, "chunks_empty": 0,
-                       "unified_steps": 0,
-                       "requests_completed": 0, "run_seconds": 0.0}
-        self._ttft_ms: list[float] = []
-        self._itl_ms: list[float] = []
+        # perf observability (profiler subsystem): a PRIVATE typed
+        # metrics registry behind the :meth:`gauges` surface — slot
+        # occupancy, admission/prefill overlap, tok/s, latency
+        # percentiles. Counters maintained unconditionally; latency
+        # samples live in BOUNDED reservoirs (a long-lived engine's
+        # memory stays flat over millions of completions — the lists
+        # this replaces grew without limit); mirrored into the trace
+        # layer only when tracing is enabled.
+        self.metrics = _pmetrics.MetricsRegistry()
+        self._stats = _StatsView(self.metrics)
+        self._h_ttft = self.metrics.histogram(
+            "serving/ttft_ms", capacity=int(latency_reservoir))
+        self._h_itl = self.metrics.histogram(
+            "serving/itl_ms", capacity=int(latency_reservoir))
+        self._g_overhead = self.metrics.gauge("obs/overhead_frac")
+        # observability self-measurement: seconds spent inside
+        # instrumentation on the hot path (gauges()["obs_overhead_frac"]
+        # = _obs_s / run_seconds; pinned < 2% by test)
+        self._obs_s = 0.0
+        # per-request lifecycle tracing: every Nth request (by id) gets
+        # its spans reconstructed into the chrome trace at completion —
+        # hot-path cost for a traced request is a few float stamps
+        self._trace_every = int(round(1.0 / trace_sample_rate)) \
+            if trace_sample_rate and trace_sample_rate > 0 else 0
         self._overlap_admission = False
 
     # ---- public API ------------------------------------------------------
@@ -369,8 +468,14 @@ class ContinuousBatchingEngine:
         done = []
         inflight = None
         t_run0 = time.perf_counter()
+        _wd_token = _frec.arm("serving run loop")
         try:
             while True:
+                # watchdog progress mark: a hung device fetch or a
+                # scheduler livelock stops the beats and the flight
+                # recorder dumps a diagnosable bundle (owner-token
+                # scoped: another component's beats cannot mask us)
+                _frec.beat(_wd_token)
                 if inflight is not None:
                     # speculative successor first: device never idles
                     # while the host harvests, drains, and admits
@@ -398,11 +503,27 @@ class ContinuousBatchingEngine:
                 if (len(done) == n_before
                         and all(r is None for r in self.slot_req)):
                     # nothing running, nothing finished, head request
-                    # still unadmittable — spinning never terminates
+                    # still unadmittable — spinning never terminates.
+                    # Dump a flight-recorder bundle first: the ring's
+                    # recent scheduler turns + pool state are the
+                    # post-mortem
+                    rec = _frec.get_recorder()
+                    if rec is not None:
+                        _frec.record_event(
+                            "serving_stall", queued=len(self.queue),
+                            free_pages=len(self._free_pages))
+                        try:
+                            rec.dump("serving engine stalled: queued "
+                                     "request cannot be admitted")
+                        except OSError:
+                            pass    # the diagnostic RuntimeError below
+                                    # must not be replaced by a failed
+                                    # bundle write
                     raise RuntimeError(
                         "serving engine stalled: queued request cannot "
                         "be admitted (page pool exhausted?)")
         finally:
+            _frec.disarm(_wd_token)
             self._stats["run_seconds"] += time.perf_counter() - t_run0
             self._emit_gauges()
         return done
@@ -565,24 +686,29 @@ class ContinuousBatchingEngine:
         fn = self._unified_static()
         self._seq += 1
         n_steps = 1 + self._n_decode
-        self._stats["chunks"] += 1
-        self._stats["unified_steps"] += 1
-        self._stats["chunk_slot_steps"] += B * n_steps
-        if n_pre:
-            self._stats["prefill_waves"] += 1
         # a slot advances this step if it decodes with budget left OR
         # streams prompt tokens (a completing prompt decodes the
         # in-program tail too, so its tokens must be credited here)
         n_active = int(np.sum((self.active
                                & (self.limits > self._pred_ctx))
                               | (nq > 0)))
-        self._stats["active_slot_steps"] += n_active * n_steps
+        _t_obs = time.perf_counter()
+        self._stats.inc("chunks")
+        self._stats.inc("unified_steps")
+        self._stats.inc("chunk_slot_steps", B * n_steps)
+        if n_pre:
+            self._stats.inc("prefill_waves")
+        self._stats.inc("active_slot_steps", n_active * n_steps)
         from ..profiler.trace import get_tracer
         _tr = get_tracer()
         if _tr.enabled:
             _tr.counter("serving/active_slots", n_active,
                         queued=len(self.queue), chunk_len=n_steps,
                         prefilling=n_pre)
+        _frec.record_event("sched_turn", seq=self._seq, mode="unified",
+                           active=n_active, queued=len(self.queue),
+                           prefilling=n_pre, chunk_len=n_steps)
+        self._obs_s += time.perf_counter() - _t_obs
         res = fn(Tensor(jnp.asarray(ids)), Tensor(jnp.asarray(nq)),
                  Tensor(jnp.asarray(last)), Tensor(jnp.asarray(tgt)),
                  Tensor(self._dev_tok), Tensor(self._dev_ctx),
@@ -604,6 +730,7 @@ class ContinuousBatchingEngine:
                 if last[slot]:
                     req = self.slot_req[slot]
                     tl = len(req.prompt)
+                    req.t_prefill_done = time.perf_counter()
                     self._prefilling[slot] = False
                     self.ctx[slot] = tl
                     # the first token + in-program decode tail land in
@@ -654,10 +781,12 @@ class ContinuousBatchingEngine:
                     if not req.tokens:
                         req.t_first = t_now
                     req.tokens.append(int(toks_np[slot, j]))
-                    self._stats["tokens_emitted"] += 1
                     appended += 1
+        _t_obs = time.perf_counter()
+        self._stats.inc("tokens_emitted", appended)
         if appended == 0:
-            self._stats["chunks_empty"] += 1
+            self._stats.inc("chunks_empty")
+        self._obs_s += time.perf_counter() - _t_obs
 
     def gauges(self) -> dict:
         """Serving observability surface (profiler subsystem):
@@ -686,12 +815,8 @@ class ContinuousBatchingEngine:
         - ``unified_steps``: unified batching-step programs dispatched
           (0 in legacy mode).
         """
-        s = self._stats
+        s = self._stats.as_dict()
         steps = s["chunk_slot_steps"]
-
-        def pct(xs, q):
-            return float(np.percentile(np.asarray(xs), q)) if xs else 0.0
-
         return {
             "slot_occupancy": s["tokens_emitted"] / steps if steps
             else 0.0,
@@ -702,10 +827,10 @@ class ContinuousBatchingEngine:
             else 0.0,
             "tokens_per_s": (s["tokens_emitted"] / s["run_seconds"])
             if s["run_seconds"] else 0.0,
-            "ttft_ms_p50": pct(self._ttft_ms, 50),
-            "ttft_ms_p99": pct(self._ttft_ms, 99),
-            "itl_ms_p50": pct(self._itl_ms, 50),
-            "itl_ms_p99": pct(self._itl_ms, 99),
+            "ttft_ms_p50": self._h_ttft.percentile(50),
+            "ttft_ms_p99": self._h_ttft.percentile(99),
+            "itl_ms_p50": self._h_itl.percentile(50),
+            "itl_ms_p99": self._h_itl.percentile(99),
             "compiled_programs": len(self._compiled),
             "chunks_dispatched": s["chunks"],
             "chunks_empty": s["chunks_empty"],
@@ -714,6 +839,8 @@ class ContinuousBatchingEngine:
             "tokens_emitted": s["tokens_emitted"],
             "prefills": s["prefills"],
             "requests_completed": s["requests_completed"],
+            "obs_overhead_frac": (self._obs_s / s["run_seconds"])
+            if s["run_seconds"] else 0.0,
         }
 
     def reset_gauges(self):
@@ -723,17 +850,24 @@ class ContinuousBatchingEngine:
         engine, so the compile-budget counter stays truthful."""
         for k in self._stats:
             self._stats[k] = 0.0 if k == "run_seconds" else 0
-        self._ttft_ms = []
-        self._itl_ms = []
+        self._h_ttft.reset()
+        self._h_itl.reset()
+        self._obs_s = 0.0
 
     def _emit_gauges(self):
+        _t_obs = time.perf_counter()
+        s = self._stats.as_dict()
+        self._g_overhead.set(
+            (self._obs_s / s["run_seconds"]) if s["run_seconds"]
+            else 0.0)
         from ..profiler.trace import get_tracer
         tr = get_tracer()
-        if not tr.enabled:
-            return
-        for name, val in self.gauges().items():
-            tr.counter(f"serving/{name}",
-                       round(val, 6) if isinstance(val, float) else val)
+        if tr.enabled:
+            for name, val in self.gauges().items():
+                tr.counter(f"serving/{name}",
+                           round(val, 6) if isinstance(val, float)
+                           else val)
+        self._obs_s += time.perf_counter() - _t_obs
 
     # ---- admission / chunked batched prefill -----------------------------
 
@@ -764,15 +898,23 @@ class ContinuousBatchingEngine:
             row[:len(pages)] = pages
             self.tables[slot] = row
             self._dev_tbl = self._dev_tbl.at[slot].set(jnp.asarray(row))
-            self._stats["prefills"] += 1
+            req.t_admit = time.perf_counter()
+            _t_obs = req.t_admit
+            if self._trace_every:
+                req.traced = req.request_id % self._trace_every == 0
+            self._stats.inc("prefills")
             if self._overlap_admission:
-                self._stats["prefills_overlapped"] += 1
+                self._stats.inc("prefills_overlapped")
             from ..profiler.trace import get_tracer
             _tr = get_tracer()
             if _tr.enabled:
                 _tr.instant("serving/prefill", slot=slot, prompt_len=tl,
                             chunk=self.prefill_chunk,
                             overlapped=self._overlap_admission)
+            _frec.record_event("admit", slot=slot,
+                               req=req.request_id, prompt_len=tl,
+                               queued=len(self.queue))
+            self._obs_s += time.perf_counter() - _t_obs
             self.slot_req[slot] = req
             self._prefilling[slot] = True
             self._prefill_off[slot] = 0
@@ -902,6 +1044,7 @@ class ContinuousBatchingEngine:
                 # drain-time fetch for one-shot tail requests)
                 req = self.slot_req[slot]
                 tl = len(req.prompt)
+                req.t_prefill_done = time.perf_counter()
                 self._prefilling[slot] = False
                 self.ctx[slot] = tl
                 self._pred_ctx[slot] = tl
@@ -1021,19 +1164,24 @@ class ContinuousBatchingEngine:
         n = self._next_chunk_len()
         fn = self._chunk_static(n)
         self._seq += 1
-        self._stats["chunks"] += 1
-        self._stats["chunk_slot_steps"] += self.num_slots * n
         # "active" for occupancy accounting = slots this chunk can
         # actually advance (host-active AND budget remaining); a slot
         # that exhausted its budget but has not drained yet is idle
         n_active = int(np.sum(self.active
                               & (self.limits > self._pred_ctx)))
-        self._stats["active_slot_steps"] += n_active * n
+        _t_obs = time.perf_counter()
+        self._stats.inc("chunks")
+        self._stats.inc("chunk_slot_steps", self.num_slots * n)
+        self._stats.inc("active_slot_steps", n_active * n)
         from ..profiler.trace import get_tracer
         _tr = get_tracer()
         if _tr.enabled:
             _tr.counter("serving/active_slots", n_active,
                         queued=len(self.queue), chunk_len=n)
+        _frec.record_event("sched_turn", seq=self._seq, mode="legacy",
+                           active=n_active, queued=len(self.queue),
+                           chunk_len=n)
+        self._obs_s += time.perf_counter() - _t_obs
         res = fn(Tensor(self._dev_tok), Tensor(self._dev_ctx),
                  Tensor(self._dev_act), Tensor(self._dev_tbl),
                  Tensor(self._dev_lim), Tensor(self._dev_eos),
@@ -1088,7 +1236,6 @@ class ContinuousBatchingEngine:
                 if not req.tokens:
                     req.t_first = t_now
                 req.tokens.append(int(init_tok[slot]))
-                self._stats["tokens_emitted"] += 1
                 appended += 1
             if req.finished:
                 continue
@@ -1097,15 +1244,60 @@ class ContinuousBatchingEngine:
                     if not req.tokens:
                         req.t_first = t_now
                     req.tokens.append(int(toks_np[slot, j]))
-                    self._stats["tokens_emitted"] += 1
                     appended += 1
+        _t_obs = time.perf_counter()
+        self._stats.inc("tokens_emitted", appended)
         if appended == 0:
-            self._stats["chunks_empty"] += 1
+            self._stats.inc("chunks_empty")
+        self._obs_s += time.perf_counter() - _t_obs
 
     def _decode_chunk(self):
         self._harvest_chunk(self._dispatch_chunk())
 
     # ---- completion ------------------------------------------------------
+
+    def _record_latency(self, req):
+        """Book a finished request's latency into the bounded
+        reservoirs and, for sampled requests, reconstruct its
+        lifecycle spans into the chrome trace (queued → admitted →
+        prefill → first-token → decode → finished) from the stamps
+        taken on the hot path. Counted in the ``obs_overhead_frac``
+        self-measurement window (the observes and the trace
+        reconstruction ARE instrumentation cost)."""
+        _t_obs = time.perf_counter()
+        if req.t_first:
+            self._h_ttft.observe((req.t_first - req.t_arrive) * 1e3)
+            if len(req.tokens) > 1:
+                self._h_itl.observe(
+                    (req.t_done - req.t_first) * 1e3
+                    / (len(req.tokens) - 1))
+        if req.traced:
+            self._emit_request_trace(req)
+        self._obs_s += time.perf_counter() - _t_obs
+
+    def _emit_request_trace(self, req):
+        from ..profiler.trace import get_tracer
+        tr = get_tracer()
+        if not tr.enabled:
+            return
+        rid = int(req.request_id)
+        # each traced request gets its own track (tid) so Perfetto
+        # shows the lifecycle as one stacked lane per request
+        admit = req.t_admit or req.t_arrive
+        tr.complete("req/queued", req.t_arrive, admit,
+                    cat="serving_req", tid=rid, request_id=rid)
+        pre_end = req.t_prefill_done or req.t_first or admit
+        tr.complete("req/prefill", admit, pre_end, cat="serving_req",
+                    tid=rid, prompt_len=int(len(req.prompt)))
+        if req.t_first:
+            tr.complete("req/first_token_wait", pre_end, req.t_first,
+                        cat="serving_req", tid=rid)
+            tr.complete("req/decode", req.t_first, req.t_done,
+                        cat="serving_req", tid=rid,
+                        tokens=len(req.tokens))
+        tr.instant("req/finished", cat="serving_req",
+                   request_id=rid, reason=req.finish_reason,
+                   tokens=len(req.tokens))
 
     def _drain(self):
         done = []
@@ -1131,7 +1323,7 @@ class ContinuousBatchingEngine:
                     req.t_first = time.perf_counter()
                     req.tokens.append(int(np.asarray(
                         self._dev_tok[slot])))
-                    self._stats["tokens_emitted"] += 1
+                    self._stats.inc("tokens_emitted")
                     self._pending_first[slot] = False
                 if not req.finished:
                     req.finished = True
@@ -1140,13 +1332,7 @@ class ContinuousBatchingEngine:
                     req.finish_reason = "eos" if (
                         eos is not None and req.tokens
                         and req.tokens[-1] == eos) else "length"
-                    if req.t_first:
-                        self._ttft_ms.append(
-                            (req.t_first - req.t_arrive) * 1e3)
-                        if len(req.tokens) > 1:
-                            self._itl_ms.append(
-                                (req.t_done - req.t_first) * 1e3
-                                / (len(req.tokens) - 1))
+                    self._record_latency(req)
                 self._free_pages.extend(self.slot_pages[slot])
                 self.slot_pages[slot] = []
                 self.slot_req[slot] = None
@@ -1158,7 +1344,12 @@ class ContinuousBatchingEngine:
                 self._prefill_off[slot] = 0
                 self._act_target[slot] = False
                 self.completed.append(req)
-                self._stats["requests_completed"] += 1
+                _t_obs = time.perf_counter()
+                self._stats.inc("requests_completed")
+                _frec.record_event("finish", req=req.request_id,
+                                   reason=req.finish_reason,
+                                   tokens=len(req.tokens))
+                self._obs_s += time.perf_counter() - _t_obs
                 done.append(req)
         return done
 

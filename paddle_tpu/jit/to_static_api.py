@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import functools
 import logging
+import time as _time
 import warnings
 from typing import Callable
 
@@ -166,6 +167,10 @@ class StaticFunction:
         # runs and eager fallbacks both count as eager host work)
         self.n_compiled_runs = 0
         self.n_eager_runs = 0
+        # cumulative wall seconds inside _discover (eager discovery run
+        # + trace/graph construction) — the host-visible recompile cost
+        # the goodput ledger books against the "recompile" category
+        self.compile_seconds = 0.0
 
     # descriptor protocol so @to_static works on Layer methods; the bound
     # copy is cached per instance (each instance has its own parameters ⇒
@@ -298,6 +303,13 @@ class StaticFunction:
     # ---- pass 1: eager run with state tracking --------------------------
 
     def _discover(self, sig, spec, leaves, args, kwargs):
+        _t0 = _time.perf_counter()
+        try:
+            return self._discover_inner(sig, spec, leaves, args, kwargs)
+        finally:
+            self.compile_seconds += _time.perf_counter() - _t0
+
+    def _discover_inner(self, sig, spec, leaves, args, kwargs):
         tracking = StateTracking()
         log: list = []
         with track_state(tracking), record_concretizations(log):

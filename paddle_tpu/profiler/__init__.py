@@ -33,8 +33,13 @@ import jax
 
 from ..framework.core import Tensor
 from . import cost, trace  # noqa: F401 (public submodules)
+from . import flight_recorder, goodput, metrics  # noqa: F401
 from .breakdown import (StepBreakdown, ablation_breakdown,  # noqa: F401
                         moe_step_breakdown)
+from .flight_recorder import FlightRecorder, Watchdog  # noqa: F401
+from .goodput import GoodputLedger  # noqa: F401
+from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
+                      MetricsRegistry, get_registry)
 from .trace import (Tracer, block_on, get_tracer,  # noqa: F401
                     log_perf_event, trace_span)
 
@@ -43,7 +48,10 @@ __all__ = ["Profiler", "RecordEvent", "ProfilerTarget", "ProfilerState",
            "SortedKeys", "SummaryView", "ProfilerOptions", "enable",
            "disable", "trace_span", "get_tracer", "Tracer", "block_on",
            "log_perf_event", "StepBreakdown", "ablation_breakdown",
-           "moe_step_breakdown", "cost", "trace"]
+           "moe_step_breakdown", "cost", "trace",
+           "metrics", "flight_recorder", "goodput",
+           "Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "get_registry", "FlightRecorder", "Watchdog", "GoodputLedger"]
 
 
 def _env_bool(name, default=False):

@@ -1,0 +1,446 @@
+"""Typed metrics registry — the production observability substrate.
+
+Every quantitative claim the runtime makes about itself (serving
+latency percentiles, fit pipeline gauges, elastic restart accounting,
+flight-recorder health) flows through ONE registry of typed metrics:
+
+- :class:`Counter` — monotonically increasing totals (``inc``), float
+  or int, exact under concurrent increment (per-metric lock; the
+  prefetcher and scheduler threads bump counters concurrently).
+- :class:`Gauge` — last-written point-in-time value (``set``).
+- :class:`Histogram` — streaming distribution with a BOUNDED
+  reservoir (Vitter's algorithm R): ``observe()`` is O(1), memory is
+  fixed at ``capacity`` samples forever, and ``percentile(q)`` stays
+  statistically faithful over millions of observations. This replaces
+  the unbounded per-request latency sample lists the serving engine
+  used to grow (``_ttft_ms``/``_itl_ms``, serving.py) — a long-lived
+  engine's memory now stays flat.
+
+Naming is enforced: every metric is ``subsystem/name``
+(``serving/tokens_emitted``, ``hapi/input_wait_ms``, ``obs/overhead_frac``)
+— ``tools/check_metric_names.py`` lints the convention and that every
+registered name is documented in docs/observability.md.
+
+Export surfaces (both atomic — tmp + fsync + rename, the checkpoint
+invariant, so a scraper or post-mortem never reads a torn file):
+
+- ``registry.snapshot()`` → plain dict (JSON-ready; the flight
+  recorder embeds it in crash bundles);
+- ``registry.export(path)`` → Prometheus text exposition v0.0.4
+  (counters/gauges as-is, histograms as summaries with quantile
+  labels);
+- ``registry.export_json(path)`` → the snapshot, atomically.
+
+Two registry scopes exist: the process-wide default
+(:func:`get_registry` — hapi fit, elastic/restart counters, jit
+compile accounting) whose updates MIRROR into the structured tracer
+when tracing is enabled (so chrome-trace exports keep carrying the
+gauges, exactly as before this registry existed), and per-component
+instances (each ``ContinuousBatchingEngine`` owns one, so two engines
+in one process never cross-pollute and ``gauges()`` stays
+per-engine).
+
+Deliberately stdlib-only (no jax): imported from hot paths.
+"""
+
+from __future__ import annotations
+
+import random
+import re
+import threading
+import zlib
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
+           "get_registry", "declare", "catalog", "catalog_markdown",
+           "METRIC_NAME_RE"]
+
+#: the ``subsystem/name`` convention, linted by
+#: tools/check_metric_names.py
+METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*/[a-z][a-z0-9_]*$")
+
+#: process-wide name -> (kind, help) vocabulary. Every registration in
+#: ANY registry lands here (metric NAMES are a global vocabulary even
+#: when their values are per-component); :func:`declare` populates it
+#: at import time so the docs table and the lint gate can see names
+#: before any component is constructed.
+_CATALOG: dict[str, tuple[str, str]] = {}
+_CATALOG_LOCK = threading.Lock()
+
+
+def _check_name(name: str) -> str:
+    if not METRIC_NAME_RE.match(name):
+        raise ValueError(
+            f"metric name {name!r} violates the subsystem/name "
+            "convention (lowercase [a-z0-9_], exactly one '/'); see "
+            "docs/observability.md")
+    return name
+
+
+def declare(name: str, kind: str, help: str) -> str:  # noqa: A002
+    """Register ``name`` in the process-wide metric catalog without
+    creating a metric. Modules declare their vocabulary at import time
+    (literal arguments — ``tools/check_metric_names.py`` parses these
+    statically); the registry pulls help text from here when a metric
+    is later instantiated."""
+    _check_name(name)
+    if kind not in ("counter", "gauge", "histogram"):
+        raise ValueError(f"unknown metric kind {kind!r}")
+    with _CATALOG_LOCK:
+        prev = _CATALOG.get(name)
+        if prev is not None and prev[0] != kind:
+            raise ValueError(
+                f"metric {name!r} re-declared as {kind} (was {prev[0]})")
+        _CATALOG[name] = (kind, help)
+    return name
+
+
+def catalog() -> dict[str, tuple[str, str]]:
+    """A copy of the process-wide name -> (kind, help) catalog."""
+    with _CATALOG_LOCK:
+        return dict(_CATALOG)
+
+
+def catalog_markdown() -> str:
+    """The docs/observability.md metric table, generated from the
+    catalog (one row per declared metric, sorted)."""
+    lines = ["| metric | kind | meaning |", "|---|---|---|"]
+    for name in sorted(catalog()):
+        kind, help_ = _CATALOG[name]
+        lines.append(f"| `{name}` | {kind} | {help_} |")
+    return "\n".join(lines)
+
+
+def _mirror_to_trace(name, value, **args):
+    """Mirror a counter/gauge update into the structured tracer (one
+    enabled-check; zero cost while tracing is off). Keeps chrome-trace
+    exports carrying the same gauge streams they did before the
+    registry existed."""
+    from .trace import get_tracer
+    tr = get_tracer()
+    if tr.enabled:
+        tr.counter(name, value, **args)
+
+
+class _Metric:
+    """Shared base: name, help, per-metric lock, label children."""
+
+    kind = "?"
+
+    def __init__(self, name, help="", mirror=False):  # noqa: A002
+        self.name = _check_name(name)
+        self.help = help
+        self._mirror = bool(mirror)
+        self._lock = threading.Lock()
+        self._children: dict[tuple, _Metric] = {}
+        with _CATALOG_LOCK:
+            prev = _CATALOG.get(name)
+            if prev is not None and prev[0] != self.kind:
+                raise ValueError(
+                    f"metric {name!r} registered as {self.kind} but "
+                    f"declared as {prev[0]}")
+            if prev is None or (help and not prev[1]):
+                _CATALOG[name] = (self.kind, help or
+                                  (prev[1] if prev else ""))
+            elif not help:
+                self.help = prev[1]
+
+    def labels(self, **kv):
+        """The child metric for a label set (Prometheus idiom):
+        ``reg.counter("serving/requests").labels(outcome="eos").inc()``.
+        Children share the parent's config and appear in snapshots as
+        ``name{k="v"}``."""
+        key = tuple(sorted(kv.items()))
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = type(self)(self.name, self.help,
+                                   mirror=self._mirror,
+                                   **self._child_kwargs())
+                child._label_kv = key
+                self._children[key] = child
+            return child
+
+    def _child_kwargs(self):
+        return {}
+
+    def _label_suffix(self):
+        kv = getattr(self, "_label_kv", ())
+        if not kv:
+            return ""
+        inner = ",".join(f'{k}="{v}"' for k, v in kv)
+        return "{" + inner + "}"
+
+    def _iter_series(self):
+        """(label_suffix, metric) for self + every labeled child."""
+        yield self._label_suffix(), self
+        with self._lock:
+            children = list(self._children.values())
+        for c in children:
+            yield c._label_suffix(), c
+
+
+class Counter(_Metric):
+    """Monotonic total. ``inc`` is exact under concurrent callers."""
+
+    kind = "counter"
+
+    def __init__(self, name, help="", mirror=False):  # noqa: A002
+        super().__init__(name, help, mirror=mirror)
+        self._value = 0
+
+    def inc(self, n=1, **args):
+        with self._lock:
+            self._value += n
+            v = self._value
+        if self._mirror:
+            _mirror_to_trace(self.name, v, **args)
+        return v
+
+    def set(self, v, **args):
+        """Direct assignment — reset (``reset_gauges``) and restored
+        state (ledger reload) only; normal accounting uses ``inc``."""
+        with self._lock:
+            self._value = v
+        if self._mirror:
+            _mirror_to_trace(self.name, v, **args)
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Gauge(_Metric):
+    """Point-in-time value; last write wins."""
+
+    kind = "gauge"
+
+    def __init__(self, name, help="", mirror=False):  # noqa: A002
+        super().__init__(name, help, mirror=mirror)
+        self._value = 0.0
+
+    def set(self, v, **args):
+        with self._lock:
+            self._value = v
+        if self._mirror:
+            _mirror_to_trace(self.name, v, **args)
+
+    def inc(self, n=1, **args):
+        with self._lock:
+            self._value += n
+            v = self._value
+        if self._mirror:
+            _mirror_to_trace(self.name, v, **args)
+        return v
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._value
+
+
+class Histogram(_Metric):
+    """Streaming distribution over a BOUNDED reservoir (Vitter's
+    algorithm R): after ``capacity`` samples, each new observation
+    replaces a uniformly-random slot with probability capacity/count —
+    the reservoir stays a uniform sample of the whole stream, memory
+    stays fixed, and percentiles stay faithful. count/sum/min/max are
+    exact (not sampled). Deterministically seeded per instance so
+    tests are reproducible."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help="", mirror=False,  # noqa: A002
+                 capacity=1024):
+        super().__init__(name, help, mirror=mirror)
+        self.capacity = int(capacity)
+        if self.capacity < 1:
+            raise ValueError("histogram capacity must be >= 1")
+        # crc32, not hash(): PYTHONHASHSEED must not change which
+        # reservoir slots a replayed stream evicts
+        self._rng = random.Random(0xA5F00D ^ zlib.crc32(name.encode()))
+        self._samples: list[float] = []
+        self.count = 0
+        self.sum = 0.0
+        self.min = None
+        self.max = None
+
+    def _child_kwargs(self):
+        return {"capacity": self.capacity}
+
+    def observe(self, v, **_args):
+        v = float(v)
+        with self._lock:
+            self.count += 1
+            self.sum += v
+            if self.min is None or v < self.min:
+                self.min = v
+            if self.max is None or v > self.max:
+                self.max = v
+            if len(self._samples) < self.capacity:
+                self._samples.append(v)
+            else:
+                j = self._rng.randrange(self.count)
+                if j < self.capacity:
+                    self._samples[j] = v
+
+    def percentile(self, q):
+        """q in [0, 100]; 0.0 when empty (the legacy gauge contract)."""
+        with self._lock:
+            if not self._samples:
+                return 0.0
+            xs = sorted(self._samples)
+        if len(xs) == 1:
+            return xs[0]
+        # linear interpolation (numpy default) without importing numpy
+        pos = (len(xs) - 1) * (q / 100.0)
+        lo = int(pos)
+        hi = min(lo + 1, len(xs) - 1)
+        frac = pos - lo
+        return xs[lo] * (1.0 - frac) + xs[hi] * frac
+
+    def reset(self):
+        with self._lock:
+            self._samples = []
+            self.count = 0
+            self.sum = 0.0
+            self.min = None
+            self.max = None
+
+    @property
+    def sample_count(self):
+        """Resident reservoir size — bounded by ``capacity`` forever
+        (the memory-flat regression tests pin this)."""
+        with self._lock:
+            return len(self._samples)
+
+    def to_dict(self):
+        with self._lock:
+            n = self.count
+            s = self.sum
+            mn, mx = self.min, self.max
+        return {"count": n, "sum": round(s, 6),
+                "min": mn, "max": mx,
+                "p50": round(self.percentile(50), 6),
+                "p90": round(self.percentile(90), 6),
+                "p99": round(self.percentile(99), 6)}
+
+
+class MetricsRegistry:
+    """Get-or-create home for typed metrics (see module docstring).
+    ``mirror=True`` (the process-wide default registry) echoes every
+    counter/gauge update into the structured tracer while tracing is
+    enabled."""
+
+    def __init__(self, mirror=False):
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+        self._mirror = bool(mirror)
+
+    def _get_or_create(self, cls, name, help, **kw):  # noqa: A002
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{m.kind}, not {cls.kind}")
+                return m
+            m = cls(name, help, mirror=self._mirror, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help="") -> Counter:  # noqa: A002
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name, help="") -> Gauge:  # noqa: A002
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name, help="",  # noqa: A002
+                  capacity=1024) -> Histogram:
+        return self._get_or_create(Histogram, name, help,
+                                   capacity=capacity)
+
+    def get(self, name):
+        with self._lock:
+            return self._metrics.get(name)
+
+    def names(self):
+        with self._lock:
+            return sorted(self._metrics)
+
+    def __contains__(self, name):
+        with self._lock:
+            return name in self._metrics
+
+    # -- export ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-ready {name: value | histogram-dict}; labeled children
+        appear as ``name{k="v"}`` keys."""
+        out = {}
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in metrics:
+            for suffix, series in m._iter_series():
+                key = m.name + suffix
+                if isinstance(series, Histogram):
+                    out[key] = series.to_dict()
+                else:
+                    out[key] = series.value
+        return out
+
+    def export_prometheus(self) -> str:
+        """Prometheus text exposition v0.0.4. ``subsystem/name`` maps
+        to ``paddle_subsystem_name``; histograms export as summaries
+        (quantile labels + _sum/_count)."""
+        lines = []
+        with self._lock:
+            metrics = list(self._metrics.values())
+        for m in sorted(metrics, key=lambda x: x.name):
+            prom = "paddle_" + m.name.replace("/", "_")
+            if m.help:
+                lines.append(f"# HELP {prom} {m.help}")
+            ptype = {"counter": "counter", "gauge": "gauge",
+                     "histogram": "summary"}[m.kind]
+            lines.append(f"# TYPE {prom} {ptype}")
+            for suffix, series in m._iter_series():
+                if isinstance(series, Histogram):
+                    if series.count == 0 and suffix == "" \
+                            and m._children:
+                        continue   # parent unused, only children carry data
+                    for q in (0.5, 0.9, 0.99):
+                        lbl = suffix[1:-1] + "," if suffix else ""
+                        lines.append(
+                            f'{prom}{{{lbl}quantile="{q}"}} '
+                            f"{series.percentile(q * 100)}")
+                    lines.append(f"{prom}_sum{suffix} {series.sum}")
+                    lines.append(f"{prom}_count{suffix} {series.count}")
+                else:
+                    lines.append(f"{prom}{suffix} {series.value}")
+        return "\n".join(lines) + "\n"
+
+    def export(self, path=None) -> str:
+        """Prometheus text; written ATOMICALLY when ``path`` given
+        (a scrape mid-crash reads the previous complete exposition,
+        never a torn one). Returns the text."""
+        text = self.export_prometheus()
+        if path is not None:
+            from .trace import _atomic_write
+            _atomic_write(path, lambda f: f.write(text))
+        return text
+
+    def export_json(self, path) -> str:
+        """Atomic JSON snapshot; returns the path."""
+        from .trace import _atomic_json_dump
+        return _atomic_json_dump(self.snapshot(), path)
+
+
+_registry = MetricsRegistry(mirror=True)
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry (tracer-mirroring). Component
+    instances (e.g. a serving engine) own private
+    ``MetricsRegistry()``\\ s instead so their gauges stay scoped."""
+    return _registry

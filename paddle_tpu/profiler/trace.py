@@ -207,6 +207,23 @@ class Tracer:
             ts=(time.perf_counter() - self._epoch) * _US,
             tid=threading.get_ident() & 0xFFFF, args=dict(args)))
 
+    def complete(self, name, t0, t1, cat="user", tid=None, **args):
+        """Record a span RETROACTIVELY from ``perf_counter``
+        timestamps: the serving request-lifecycle tracer reconstructs
+        a request's queued/prefill/decode spans at completion time
+        from stamps taken on the hot path (one float store each), so
+        tracing a request costs nothing until it finishes. ``tid``
+        gives the span its own track (e.g. the request id)."""
+        if not self.enabled:
+            return
+        self._record(TraceEvent(
+            name=name, ph="X", cat=cat,
+            ts=(t0 - self._epoch) * _US,
+            dur=max(0.0, t1 - t0) * _US,
+            tid=(threading.get_ident() & 0xFFFF) if tid is None
+            else int(tid),
+            args=dict(args)))
+
     def device_sync(self, value, name="device_sync"):
         """Explicit sync point: blocks on ``value`` and records how long
         the host waited (the device-queue depth at this moment)."""
@@ -277,10 +294,12 @@ class Tracer:
             {"events": events, "sections": self.section_summary()}, path)
 
 
-def _atomic_json_dump(obj, path) -> str:
+def _atomic_write(path, write_fn) -> str:
     """tmp + fsync + os.replace: the export either fully exists or not
-    at all (fault-injection-tested; a torn half-JSON trace is worse
-    than none)."""
+    at all (fault-injection-tested; a torn half-written export is worse
+    than none). ``write_fn(f)`` serializes onto the open tmp file —
+    the one atomic-write skeleton every profiler export (chrome trace,
+    metrics JSON, Prometheus text, flight bundles) shares."""
     path = os.fspath(path)
     d = os.path.dirname(path)
     if d:
@@ -288,7 +307,7 @@ def _atomic_json_dump(obj, path) -> str:
     tmp = path + ".tmp"
     try:
         with open(tmp, "w", encoding="utf-8") as f:
-            json.dump(obj, f)
+            write_fn(f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)
@@ -299,6 +318,10 @@ def _atomic_json_dump(obj, path) -> str:
             except OSError:
                 pass
     return path
+
+
+def _atomic_json_dump(obj, path) -> str:
+    return _atomic_write(path, lambda f: json.dump(obj, f))
 
 
 _tracer = Tracer(enabled=False)
@@ -350,6 +373,10 @@ def epoch_summary(epoch, steps, seconds, **metrics) -> dict:
                "steps_per_s": round(steps / seconds, 3) if seconds else 0.0}
     summary.update(metrics)
     perf_logger.info("[hapi/epoch] %s", json.dumps(summary, sort_keys=True))
-    _tracer.counter("hapi/avg_step_ms", summary["avg_step_ms"],
-                    epoch=int(epoch))
+    # registry gauge (docs/observability.md); the default registry
+    # mirrors into the tracer while tracing is on, preserving the old
+    # chrome-trace counter stream
+    from .metrics import get_registry
+    get_registry().gauge("hapi/avg_step_ms").set(
+        summary["avg_step_ms"], epoch=int(epoch))
     return summary

@@ -30,8 +30,9 @@ def test_known_gates_are_registered():
                                                        False)]
     finally:
         sys.path.pop(0)
-    assert names == ["atomic_writes", "fast_tier_budget",
-                     "elastic_chaos", "serving_parity", "fused_parity"]
+    assert names == ["atomic_writes", "metric_names",
+                     "fast_tier_budget", "elastic_chaos",
+                     "serving_parity", "fused_parity"]
 
 
 def test_all_gates_pass_on_healthy_log(tmp_path):
@@ -45,6 +46,7 @@ def test_all_gates_pass_on_healthy_log(tmp_path):
              "--no-fused")
     assert p.returncode == 0, p.stdout + p.stderr
     assert "atomic_writes: PASS" in p.stdout
+    assert "metric_names: PASS" in p.stdout
     assert "fast_tier_budget: PASS" in p.stdout
     assert "elastic_chaos" not in p.stdout
     assert "serving_parity" not in p.stdout

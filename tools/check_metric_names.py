@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Hygiene checker: metric names follow ``subsystem/name`` and every
+one is documented.
+
+The metrics registry (paddle_tpu/profiler/metrics.py) is only an
+observability plane if its vocabulary stays coherent: one naming
+convention, one documented table. This lint walks ``paddle_tpu/`` and
+``bench.py`` ASTs for every LITERAL metric name reaching the
+instrumentation APIs —
+
+- ``metrics.declare(name, kind, help)`` registrations (the catalog);
+- registry/tracer calls: ``.counter("…")``, ``.gauge("…")``,
+  ``.histogram("…")``, ``.instant("…")``, ``.complete("…")`` —
+
+and fails the build when a name violates the convention
+(``^[a-z][a-z0-9_]*/[a-z][a-z0-9_]*$``), when a name is used but never
+appears in ``docs/observability.md``, or when the same name is
+declared with two different kinds. Dynamic names (f-strings over a
+gauges() dict etc.) are out of scope by construction — the convention
+is enforced where names are minted, and every minted family has a
+literal ``declare()``.
+
+``--table`` prints the docs metric table GENERATED from the
+``declare()`` catalog (name | kind | meaning) — paste into
+docs/observability.md; the default mode then keeps the two in sync
+forever.
+
+Usage: python tools/check_metric_names.py [--table] [root_dir]
+Exit code 0 = clean, 1 = violations (printed one per line).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+
+NAME_RE = re.compile(r"^[a-z][a-z0-9_]*/[a-z][a-z0-9_]*$")
+METRIC_CALLS = ("counter", "gauge", "histogram", "instant", "complete")
+DOCS = os.path.join("docs", "observability.md")
+
+
+def _const_str(node):
+    return node.value if isinstance(node, ast.Constant) \
+        and isinstance(node.value, str) else None
+
+
+def scan_file(path):
+    """(declares, uses) — declares: [(name, kind, help, line)];
+    uses: [(name, line)] for literal metric-API first args."""
+    try:
+        tree = ast.parse(open(path, encoding="utf-8").read(),
+                         filename=path)
+    except SyntaxError as e:
+        return [], [(f"<unparseable: {e}>", 0)]
+    declares, uses = [], []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        fname = func.attr if isinstance(func, ast.Attribute) else \
+            func.id if isinstance(func, ast.Name) else None
+        if fname == "declare" and len(node.args) >= 2:
+            name = _const_str(node.args[0])
+            kind = _const_str(node.args[1])
+            help_ = _const_str(node.args[2]) \
+                if len(node.args) >= 3 else ""
+            if name is not None:
+                declares.append((name, kind or "?", help_ or "",
+                                 node.lineno))
+        elif fname in METRIC_CALLS and node.args:
+            name = _const_str(node.args[0])
+            if name is not None and "/" in name:
+                uses.append((name, node.lineno))
+    return declares, uses
+
+
+def collect(root):
+    declares, uses = {}, []   # name -> (kind, help, file, line)
+    files = []
+    pkg = os.path.join(root, "paddle_tpu")
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        files.extend(os.path.join(dirpath, f) for f in filenames
+                     if f.endswith(".py"))
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        files.append(bench)
+    errors = []
+    for path in sorted(files):
+        decl, use = scan_file(path)
+        rel = os.path.relpath(path, root)
+        for name, kind, help_, line in decl:
+            prev = declares.get(name)
+            if prev is not None and prev[0] != kind:
+                errors.append(
+                    f"{rel}:{line}: {name!r} declared as {kind} but "
+                    f"also as {prev[0]} ({prev[2]}:{prev[3]})")
+            if prev is None or (help_ and not prev[1]):
+                declares[name] = (kind, help_, rel, line)
+        uses.extend((name, rel, line) for name, line in use)
+    return declares, uses, errors
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    table = "--table" in argv
+    if table:
+        argv.remove("--table")
+    root = argv[0] if argv else \
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+    declares, uses, errors = collect(root)
+
+    if table:
+        print("| metric | kind | meaning |")
+        print("|---|---|---|")
+        for name in sorted(declares):
+            kind, help_, _, _ = declares[name]
+            print(f"| `{name}` | {kind} | {' '.join(help_.split())} |")
+        return 0
+
+    all_names = {n: (f, ln) for n, (_, _, f, ln) in declares.items()}
+    for name, rel, line in uses:
+        all_names.setdefault(name, (rel, line))
+
+    for name, (rel, line) in sorted(all_names.items()):
+        if not NAME_RE.match(name):
+            errors.append(
+                f"{rel}:{line}: metric name {name!r} violates the "
+                "subsystem/name convention (^[a-z][a-z0-9_]*/"
+                "[a-z][a-z0-9_]*$)")
+
+    docs_path = os.path.join(root, DOCS)
+    try:
+        docs = open(docs_path, encoding="utf-8").read()
+    except OSError:
+        errors.append(f"{DOCS} missing — the metric table must exist")
+        docs = ""
+    for name, (rel, line) in sorted(all_names.items()):
+        if docs and f"`{name}`" not in docs:
+            errors.append(
+                f"{rel}:{line}: metric {name!r} is not documented in "
+                f"{DOCS} (add a `{name}` row; regenerate with "
+                "tools/check_metric_names.py --table)")
+
+    for e in errors:
+        print(e)
+    if errors:
+        print(f"{len(errors)} metric-name violation(s)")
+        return 1
+    print(f"metric names clean: {len(all_names)} names "
+          f"({len(declares)} declared), all documented")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

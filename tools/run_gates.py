@@ -49,6 +49,12 @@ def gate_commands(log: str, budget: float, no_budget: bool,
         ("atomic_writes",
          [sys.executable, os.path.join(TOOLS_DIR,
                                        "check_atomic_writes.py")]),
+        # metric-name hygiene: subsystem/name convention + every
+        # literal metric documented in docs/observability.md (static
+        # AST scan — cheap, always on)
+        ("metric_names",
+         [sys.executable, os.path.join(TOOLS_DIR,
+                                       "check_metric_names.py")]),
     ]
     if not no_budget:
         gates.append(
