@@ -718,6 +718,101 @@ def _cb_bench(on_tpu, autotune=False):
     return best, gauges, tuned_cb, legacy_tps
 
 
+def _cb_overload_bench(on_tpu):
+    """Serving-reliability economics under synthetic heavy traffic
+    (ISSUE 10): drive the engine ~4x past its page capacity with
+    mixed-priority, deadlined requests through the
+    AdmissionController + EngineSupervisor stack and report the
+    overload survival numbers — throughput, tail TTFT, shed fraction,
+    preemption rate and SLO goodput. BASELINE.md documents the keys."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (AdmissionController,
+                                      ContinuousBatchingEngine,
+                                      EngineSupervisor, Overloaded)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig.llama_1b()
+        slots, page, chunk, max_len = 8, 32, 32, 384
+        n_req, plen_lo, plen_hi, new_lo, new_hi = 96, 48, 192, 32, 96
+        ttft_slo_s, total_slo_s = 30.0, 120.0
+    else:
+        cfg = LlamaConfig.tiny()
+        slots, page, chunk, max_len = 2, 8, 4, 48
+        n_req, plen_lo, plen_hi, new_lo, new_hi = 16, 3, 11, 2, 7
+        ttft_slo_s, total_slo_s = 60.0, 120.0
+    cfg.tensor_parallel = False
+    cfg.scan_layers = False
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+
+    def factory():
+        # default pool (slots * pages_per_slot + 1): the queue depth
+        # below is what oversubscribes it ~4x
+        return ContinuousBatchingEngine(
+            model, num_slots=slots, page_size=page, max_len=max_len,
+            decode_chunk=chunk, greedy=True)
+
+    sup = EngineSupervisor(factory, max_restarts=2)
+    # bound chosen so a slice of the offered load is SHED (the door is
+    # part of what this section measures)
+    adm = AdmissionController(sup, max_queue=max(4, n_req // 2),
+                              default_ttft_slo_s=ttft_slo_s)
+    rng = np.random.RandomState(33)
+    offered = n_req
+    accepted_ids, shed = [], 0
+    slos = {}
+    t0 = time.perf_counter()
+    for i in range(n_req):
+        plen = int(rng.randint(plen_lo, plen_hi + 1))
+        n_new = int(rng.randint(new_lo, new_hi + 1))
+        try:
+            rid = adm.submit(
+                rng.randint(0, cfg.vocab_size,
+                            (plen,)).astype(np.int32),
+                n_new, priority=int(rng.randint(0, 3)),
+                ttft_deadline_s=ttft_slo_s, deadline_s=total_slo_s)
+            accepted_ids.append(rid)
+            slos[rid] = (ttft_slo_s, total_slo_s)
+        except Overloaded:
+            shed += 1
+    done = sup.run()
+    wall = max(time.perf_counter() - t0, 1e-9)
+    by = {r.request_id: r for r in done}
+    ok = [by[i] for i in accepted_ids if by[i].error is None]
+    toks = sum(len(r.tokens) for r in ok)
+    ttfts = sorted((r.t_first - r.t_arrive) * 1e3
+                   for r in ok if r.t_first)
+    p99 = ttfts[max(0, int(round(0.99 * (len(ttfts) - 1))))] \
+        if ttfts else 0.0
+    slo_met = [r for r in ok
+               if (r.t_first - r.t_arrive) <= slos[r.request_id][0]
+               and (r.t_done - r.t_arrive) <= slos[r.request_id][1]]
+    g = sup.gauges()   # counters carried across supervised restarts
+    out = {
+        "cb_overload_tok_s": round(toks / wall, 2),
+        "cb_overload_p99_ttft_ms": round(p99, 2),
+        "cb_shed_frac": round(shed / offered, 4),
+        "cb_preempt_rate": round(
+            g["preempt_evictions"] / max(1, len(accepted_ids)), 4),
+        "cb_goodput_frac": round(
+            len(slo_met) / max(1, len(accepted_ids)), 4),
+    }
+    print(f"# cb overload: {offered} offered / {len(accepted_ids)} "
+          f"accepted / {shed} shed, {toks} tokens in {wall:.1f}s "
+          f"({out['cb_overload_tok_s']} tok/s), p99 ttft "
+          f"{out['cb_overload_p99_ttft_ms']} ms, preempt rate "
+          f"{out['cb_preempt_rate']}, goodput "
+          f"{out['cb_goodput_frac']}, restarts {sup.restarts}",
+          file=sys.stderr)
+    return out
+
+
 def _moe_bench_config(on_tpu):
     """The BASELINE config-5 bench shape, shared by the MoE train
     section and the breakdown section (attribution fractions are only
@@ -1208,6 +1303,22 @@ def main():
             record["tuned_serving_chunks"] = cb_tuned
         print(json.dumps(record), flush=True)
     gc.collect()
+
+    # serving reliability under overload (ISSUE 10): right after the
+    # cb section whose engine it stresses — the survival economics
+    # (shed/preempt/goodput) contextualize the throughput number above
+    try:
+        cb_overload = _timed_section(
+            "cb overload", lambda: _retry_transient(
+                lambda: _cb_overload_bench(on_tpu),
+                "cb overload bench"))
+    except Exception as e:
+        print(f"# cb overload bench failed: {e!r}", file=sys.stderr)
+        cb_overload = None
+    gc.collect()
+    if cb_overload is not None:
+        record.update(cb_overload)
+        print(json.dumps(record), flush=True)
 
     try:
         decode_tok_s = _timed_section(

@@ -34,11 +34,17 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .reliability import (AdmissionController, DeadlineExceeded,
+                          EngineSupervisor, Overloaded,
+                          RequestCancelled, RequestQuarantined,
+                          ServingError)
 from .serving import ContinuousBatchingEngine, ServedRequest
 
 __all__ = ["Config", "Predictor", "Tensor", "PrecisionType", "PlaceType",
            "create_predictor", "get_version", "ContinuousBatchingEngine",
-           "ServedRequest"]
+           "ServedRequest", "AdmissionController", "EngineSupervisor",
+           "ServingError", "RequestCancelled", "DeadlineExceeded",
+           "RequestQuarantined", "Overloaded"]
 
 
 class PrecisionType(enum.Enum):

@@ -1,0 +1,383 @@
+"""Serving reliability layer: typed failures, SLO-aware admission
+control, and supervised engine recovery (ISSUE 10).
+
+The ``ContinuousBatchingEngine`` handles overload INSIDE the pool
+(preemption + recompute, deadlines, cancellation, step-failure
+containment — serving.py); this module is what stands in FRONT of and
+AROUND it:
+
+- **Typed errors** — a request never just disappears: it finishes with
+  tokens, or with :class:`RequestCancelled`, :class:`DeadlineExceeded`
+  or :class:`RequestQuarantined` attached to ``ServedRequest.error``;
+  a submission the system cannot absorb raises :class:`Overloaded`
+  with a computed ``retry_after_s``.
+- :class:`AdmissionController` — a bounded admission queue that sheds
+  load AT THE DOOR when the queue is full or when the engine's
+  ``serving/ttft_ms`` / ``serving/itl_ms`` histograms (the PR-9
+  observability plane) predict the request would miss its TTFT
+  deadline anyway. Accepted requests keep their SLOs; excess load gets
+  a typed rejection and a retry-after instead of a doomed queue slot.
+- :class:`EngineSupervisor` — the containment ESCAPE hatch: when the
+  engine dies anyway (watchdog stall ``RuntimeError``, a containment-
+  budget escape, a crash below the step boundary), the supervisor
+  dumps a flight-recorder bundle, tears the engine down, re-queues
+  every queued + in-flight request into a fresh engine (idempotent
+  replay from prompt + already-emitted tokens — the same recompute
+  path preemption uses) and retries with a bounded restart budget
+  (the PR-6 elastic-launcher pattern, in-process).
+
+Deliberately engine-agnostic: nothing here imports serving.py, so the
+two modules cannot cycle; the controller and supervisor duck-type the
+engine surface (``queue``/``slot_req``/``gauges``/``requeue``/...).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..profiler import flight_recorder as _frec
+from ..profiler import metrics as _metrics
+
+__all__ = ["ServingError", "RequestCancelled", "DeadlineExceeded",
+           "RequestQuarantined", "Overloaded", "AdmissionController",
+           "EngineSupervisor"]
+
+_metrics.declare("restart/engine_restarts", "counter",
+                 "supervised serving-engine teardown+restart cycles "
+                 "(EngineSupervisor)")
+_metrics.declare("restart/engine_requeued", "counter",
+                 "queued + in-flight requests salvaged into a fresh "
+                 "engine at a supervised restart (idempotent replay "
+                 "from prompt + emitted tokens)")
+
+
+# ---- typed failures --------------------------------------------------------
+
+class ServingError(RuntimeError):
+    """Base of every typed serving failure; ``request_id`` is set for
+    per-request errors (None for :class:`Overloaded`)."""
+
+    request_id: int | None = None
+
+
+class RequestCancelled(ServingError):
+    """The request's ``cancel()`` was honored: pages freed, tokens
+    already emitted kept on the request."""
+
+    def __init__(self, request_id):
+        super().__init__(f"request {request_id} cancelled")
+        self.request_id = request_id
+
+
+class DeadlineExceeded(ServingError):
+    """A TTFT or total deadline expired — queued, mid-prefill or
+    mid-decode. ``kind`` is ``"ttft"`` or ``"total"``."""
+
+    def __init__(self, request_id, kind, deadline_s):
+        super().__init__(
+            f"request {request_id} missed its {kind} deadline "
+            f"({deadline_s}s)")
+        self.request_id = request_id
+        self.kind = kind
+        self.deadline_s = deadline_s
+
+
+class RequestQuarantined(ServingError):
+    """The request rode ``max_strikes`` failed compiled steps and was
+    isolated by the containment boundary (the poison-request shape)."""
+
+    def __init__(self, request_id, cause=""):
+        super().__init__(
+            f"request {request_id} quarantined after repeated step "
+            f"failures" + (f": {cause}" if cause else ""))
+        self.request_id = request_id
+        self.cause = cause
+
+
+class Overloaded(ServingError):
+    """Admission-control rejection: the system is shedding load.
+    ``retry_after_s`` is the controller's estimate of when a retry has
+    a fighting chance."""
+
+    def __init__(self, reason, retry_after_s):
+        super().__init__(
+            f"overloaded: {reason} (retry after "
+            f"{retry_after_s:.3f}s)")
+        self.retry_after_s = float(retry_after_s)
+
+
+# ---- SLO-aware admission control -------------------------------------------
+
+class AdmissionController:
+    """Bounded admission queue + SLO predictor in front of an engine
+    (or an :class:`EngineSupervisor` — anything exposing ``.engine`` or
+    being one).
+
+    Shedding policy, checked at :meth:`submit` time:
+
+    1. **Queue bound** — more than ``max_queue`` requests waiting means
+       every further accept just manufactures a deadline miss; reject
+       with a retry-after derived from the queue's estimated drain
+       time.
+    2. **SLO prediction** — with latency history available (the
+       engine's ``serving/ttft_ms`` / ``serving/itl_ms`` bounded
+       reservoirs), predicted TTFT = ttft_p99 + queued-work drain time;
+       a request whose TTFT deadline (or the controller's
+       ``default_ttft_slo_s``) is below the prediction is shed
+       immediately — it would occupy pages only to time out.
+
+    Cold engines (no completed request yet) admit on the queue bound
+    alone: there is nothing to predict from.
+    """
+
+    def __init__(self, target, max_queue=64, default_ttft_slo_s=None,
+                 min_retry_after_s=0.05):
+        self._target = target
+        self.max_queue = int(max_queue)
+        self.default_ttft_slo_s = default_ttft_slo_s
+        self.min_retry_after_s = float(min_retry_after_s)
+        self.accepted = 0
+        self.shed = 0
+
+    @property
+    def engine(self):
+        return getattr(self._target, "engine", self._target)
+
+    # -- prediction --------------------------------------------------------
+
+    def _rates(self, eng):
+        """(ttft_p99_s, itl_p50_s) from the engine's latency
+        reservoirs — read through the PUBLIC per-engine metrics
+        registry (``engine.metrics``), not serving.py internals — or
+        None while there is no history."""
+        h_ttft = eng.metrics.get("serving/ttft_ms")
+        h_itl = eng.metrics.get("serving/itl_ms")
+        if h_ttft is None or h_ttft.count == 0:
+            return None
+        itl = (h_itl.percentile(50) / 1e3) \
+            if h_itl is not None and h_itl.count else 0.0
+        return h_ttft.percentile(99) / 1e3, itl
+
+    def _queued_drain_s(self, eng, itl_s):
+        """Estimated seconds to drain the CURRENT queue: remaining
+        tokens across queued requests, served at the observed
+        per-token latency across num_slots lanes."""
+        queued_tok = sum(r.max_new_tokens - len(r.tokens)
+                         for r in eng.queue)
+        return queued_tok * itl_s / max(1, eng.num_slots)
+
+    def predicted_ttft_s(self):
+        """The controller's TTFT prediction for a request submitted
+        NOW (None while the engine has no latency history)."""
+        eng = self.engine
+        rates = self._rates(eng)
+        if rates is None:
+            return None
+        ttft_p99, itl = rates
+        return ttft_p99 + self._queued_drain_s(eng, itl)
+
+    def _retry_after_s(self, eng):
+        rates = self._rates(eng)
+        if rates is None:
+            return self.min_retry_after_s
+        _, itl = rates
+        # time for the queue to drain below half the bound — the point
+        # where a retry stops being a coin flip
+        excess = max(0, len(eng.queue) - self.max_queue // 2)
+        per_req = itl * (
+            sum(r.max_new_tokens for r in eng.queue)
+            / max(1, len(eng.queue))) / max(1, eng.num_slots)
+        return max(self.min_retry_after_s, excess * per_req)
+
+    # -- the door ----------------------------------------------------------
+
+    def _shed(self, eng, reason, floor_s=0.0):
+        """``floor_s``: a shed-specific lower bound — an SLO-
+        prediction shed must tell the client to wait at least the
+        prediction OVERSHOOT (queue-drain math alone reads ~0 while
+        the queue is below half the bound, inviting an immediate
+        re-shed loop)."""
+        retry = max(self._retry_after_s(eng), floor_s)
+        self.shed += 1
+        eng.metrics.counter("serving/shed_rejections").inc()
+        eng.metrics.gauge("serving/shed_retry_after_s").set(retry)
+        _frec.record_event("shed", reason=reason,
+                           queued=len(eng.queue),
+                           retry_after_s=round(retry, 4))
+        raise Overloaded(reason, retry)
+
+    def submit(self, prompt_ids, max_new_tokens, eos_token_id=None,
+               priority=0, ttft_deadline_s=None,
+               deadline_s=None) -> int:
+        """Admit or shed. Returns the request id; raises
+        :class:`Overloaded` (with ``retry_after_s``) when the queue is
+        full or the SLO predictor says the deadline is already lost."""
+        eng = self.engine
+        if len(eng.queue) >= self.max_queue:
+            self._shed(eng, f"admission queue full "
+                            f"({len(eng.queue)}/{self.max_queue})")
+        slo = ttft_deadline_s if ttft_deadline_s is not None \
+            else self.default_ttft_slo_s
+        if slo is not None:
+            pred = self.predicted_ttft_s()
+            if pred is not None and pred > slo:
+                self._shed(eng, f"predicted TTFT {pred:.3f}s exceeds "
+                                f"deadline {slo:.3f}s",
+                           floor_s=pred - slo)
+        rid = eng.add_request(prompt_ids, max_new_tokens,
+                              eos_token_id=eos_token_id,
+                              priority=priority,
+                              ttft_deadline_s=ttft_deadline_s,
+                              deadline_s=deadline_s)
+        self.accepted += 1   # after validation — a rejected oversize
+        return rid           # submission must not count as accepted
+
+
+# ---- supervised recovery ---------------------------------------------------
+
+class EngineSupervisor:
+    """Bounded-restart supervision around a serving engine.
+
+    ``engine_factory`` builds a fresh engine (same model/geometry);
+    the first one is built eagerly as ``self.engine``. :meth:`run`
+    drives it to completion; when the engine dies — the stall
+    ``RuntimeError``, a containment-budget escape, any crash below the
+    step boundary — or returns with a slot it could never drain (a
+    wedged stream), the supervisor:
+
+    1. dumps a flight-recorder bundle (post-mortem),
+    2. salvages every queued + in-flight request,
+    3. builds a fresh engine and re-queues them (idempotent replay:
+       prompt + tokens already emitted re-prefill through the
+       recompute path, so delivered prefixes are never re-served),
+    4. retries, at most ``max_restarts`` times — then the original
+       failure propagates (the PR-6 restart-budget contract: bounded,
+       never infinite).
+    """
+
+    def __init__(self, engine_factory, max_restarts=2):
+        self._factory = engine_factory
+        self.engine = engine_factory()
+        self.max_restarts = int(max_restarts)
+        self.restarts = 0
+        self.completed: list = []
+        self._returned: set[int] = set()   # id()s already handed back
+        # monotonic counters salvaged from torn-down engines, so
+        # gauges() reports the whole supervised lifetime, not just the
+        # engine that happens to be alive (bench reads these)
+        self._carried: dict = {}
+
+    # engine pass-throughs (the supervisor IS the serving surface)
+    def add_request(self, *a, **kw):
+        return self.engine.add_request(*a, **kw)
+
+    def cancel(self, request_id):
+        return self.engine.cancel(request_id)
+
+    def request(self, request_id):
+        return self.engine.request(request_id)
+
+    #: gauges() keys that are monotonic counters — summable across the
+    #: engines a supervised lifetime burns through
+    _COUNTER_GAUGES = (
+        "preempt_evictions", "preempt_recompute_tokens",
+        "requests_cancelled", "deadline_expired", "shed_rejections",
+        "quarantined", "containments", "tokens_emitted", "prefills",
+        "requests_completed", "chunks_dispatched", "unified_steps")
+
+    def gauges(self):
+        """The live engine's gauges, with monotonic counters summed
+        over every engine this supervisor has torn down — restart must
+        not zero the lifetime economics."""
+        g = dict(self.engine.gauges())
+        for k, v in self._carried.items():
+            g[k] = g.get(k, 0) + v
+        return g
+
+    def has_work(self):
+        return self.engine.has_work() \
+            or any(r is not None for r in self.engine.slot_req)
+
+    def run(self):
+        """Drive to completion across restarts; returns every request
+        completed by this call (tokens or typed error), exactly once.
+        Requests that finished before a budget-exhausting failure stay
+        reachable on ``self.completed`` even when the failure
+        propagates — a finished stream never just disappears."""
+        done: list = []
+
+        def absorb(reqs):
+            for r in reqs:
+                if id(r) not in self._returned:
+                    self._returned.add(id(r))
+                    done.append(r)
+
+        try:
+            while True:
+                try:
+                    absorb(self.engine.run())
+                except (KeyboardInterrupt, SystemExit,
+                        AssertionError):
+                    # AssertionError is the page-accounting audit
+                    # speaking — the engine refuses to contain it and
+                    # the supervisor must not launder it into a
+                    # restart either
+                    raise
+                except Exception as exc:  # noqa: BLE001 — supervised
+                    absorb(self.engine.completed)
+                    self._restart(exc)
+                    continue
+                absorb(self.engine.completed)
+                leftover = [r for r in self.engine.slot_req
+                            if r is not None and not r.finished]
+                if leftover:
+                    # a clean return with occupants left behind is an
+                    # engine fault too (a slot that never drained)
+                    self._restart(RuntimeError(
+                        f"engine run() returned with {len(leftover)} "
+                        f"undrained slot(s)"))
+                    continue
+                return done
+        finally:
+            self.completed.extend(done)
+
+    def _restart(self, exc):
+        """Tear down + rebuild, or re-raise once the budget is spent."""
+        rec = _frec.get_recorder()
+        if rec is not None:
+            try:
+                rec.dump(f"engine supervisor restart: {exc!r}")
+            except OSError:
+                pass           # post-mortem is best-effort
+        # budget check BEFORE the counter: the budget-exceeded
+        # terminal attempt is not a restart cycle that happened
+        if self.restarts >= self.max_restarts:
+            raise exc
+        self.restarts += 1
+        reg = _metrics.get_registry()
+        reg.counter("restart/engine_restarts").inc()
+        old = self.engine
+        try:
+            g = old.gauges()
+            for k in self._COUNTER_GAUGES:
+                self._carried[k] = self._carried.get(k, 0) \
+                    + int(g.get(k, 0))
+        except Exception:  # noqa: BLE001 — a dead engine's gauges are
+            pass           # best-effort salvage, never block restart
+        salvage = [r for r in old.queue if not r.finished]
+        salvage += [r for r in old.slot_req
+                    if r is not None and not r.finished]
+        # replay in arrival order so FIFO fairness survives the restart
+        salvage.sort(key=lambda r: r.request_id)
+        self.engine = self._factory()
+        # carry the dead engine's id counter: requeue() only advances
+        # past SALVAGED ids, and a fresh engine re-minting an id the
+        # old engine already completed would conflate two requests in
+        # any client map keyed by request_id
+        self.engine._next_id = max(self.engine._next_id, old._next_id)
+        for r in salvage:
+            self.engine.requeue(r)
+        reg.counter("restart/engine_requeued").inc(len(salvage))
+        _frec.record_event("engine_restart", attempt=self.restarts,
+                           requeued=len(salvage),
+                           error=repr(exc)[:200])

@@ -90,6 +90,8 @@ import numpy as np
 from ..framework.core import Tensor, no_grad
 from ..profiler import flight_recorder as _frec
 from ..profiler import metrics as _pmetrics
+from .reliability import (DeadlineExceeded, RequestCancelled,
+                          RequestQuarantined)
 
 __all__ = ["ContinuousBatchingEngine", "ServedRequest"]
 
@@ -135,13 +137,50 @@ _pmetrics.declare("obs/overhead_frac", "gauge",
                   "fraction of serving run() wall time spent inside "
                   "observability instrumentation (self-measured; the "
                   "<2% pinned contract)")
+# ISSUE 10 reliability vocabulary: overload is a first-class mode, so
+# its economics are first-class metrics
+_pmetrics.declare("serving/preempt_evictions", "counter",
+                  "active sequences evicted on page exhaustion and "
+                  "requeued for recompute-style re-prefill")
+_pmetrics.declare("serving/preempt_pages_reclaimed", "counter",
+                  "KV pages reclaimed by preemption evictions")
+_pmetrics.declare("serving/preempt_recompute_tokens", "counter",
+                  "previously generated tokens re-prefilled when a "
+                  "preempted request was re-admitted")
+_pmetrics.declare("serving/requests_cancelled", "counter",
+                  "requests completed with RequestCancelled")
+_pmetrics.declare("serving/deadline_ttft_expired", "counter",
+                  "requests that missed their TTFT deadline before "
+                  "producing a first token")
+_pmetrics.declare("serving/deadline_total_expired", "counter",
+                  "requests that exceeded their total deadline "
+                  "(mid-stream or queued)")
+_pmetrics.declare("serving/quarantined", "counter",
+                  "requests completed with RequestQuarantined after "
+                  "repeated step-failure implication")
+_pmetrics.declare("serving/containments", "counter",
+                  "step-level fault containments (a failed compiled "
+                  "step converted to slot/page reset + requeue instead "
+                  "of engine death)")
+_pmetrics.declare("serving/shed_rejections", "counter",
+                  "submissions rejected at the admission door "
+                  "(Overloaded, with a computed retry-after)")
+_pmetrics.declare("serving/shed_retry_after_s", "gauge",
+                  "retry-after seconds attached to the most recent "
+                  "Overloaded rejection")
 
 #: the historical ``_stats`` key set, preserved verbatim — now backed
 #: by ``serving/*`` registry counters
 _STAT_KEYS = ("chunks", "chunk_slot_steps", "active_slot_steps",
               "tokens_emitted", "prefills", "prefills_overlapped",
               "prefill_waves", "chunks_empty", "unified_steps",
-              "requests_completed", "run_seconds")
+              "requests_completed", "run_seconds",
+              # ISSUE-10 reliability counters ride the same view so
+              # reset_gauges()/as_dict() cover them uniformly
+              "preempt_evictions", "preempt_pages_reclaimed",
+              "preempt_recompute_tokens", "requests_cancelled",
+              "deadline_ttft_expired", "deadline_total_expired",
+              "quarantined", "containments", "shed_rejections")
 
 
 class _StatsView:
@@ -175,7 +214,7 @@ class _StatsView:
         return {k: c.value for k, c in self._c.items()}
 
 
-@dataclass
+@dataclass(eq=False)
 class ServedRequest:
     request_id: int
     prompt: np.ndarray                 # [S] int
@@ -183,7 +222,8 @@ class ServedRequest:
     eos_token_id: int | None = None
     tokens: list = field(default_factory=list)   # generated ids
     finished: bool = False
-    finish_reason: str | None = None   # "eos" | "length"
+    finish_reason: str | None = None   # "eos" | "length" | "cancelled"
+    #                                  # | "deadline" | "quarantined"
     # latency accounting (seconds, perf_counter clock)
     t_arrive: float = 0.0              # add_request
     t_admit: float = 0.0               # admitted into a slot
@@ -192,6 +232,32 @@ class ServedRequest:
     t_done: float = 0.0                # finished
     #: lifecycle-trace sampling decision (engine trace_sample_rate)
     traced: bool = False
+    # ---- lifecycle control (ISSUE 10) --------------------------------
+    #: higher wins admission order; a strictly-higher-priority arrival
+    #: may preempt running lower-priority sequences for pages/slots
+    priority: int = 0
+    #: seconds from arrival within which the first token must land
+    #: (None = no TTFT deadline)
+    ttft_deadline_s: float | None = None
+    #: seconds from arrival within which the request must finish
+    deadline_s: float | None = None
+    #: cancellation requested; honored at the next scheduler turn
+    cancelled: bool = False
+    #: typed failure (RequestCancelled / DeadlineExceeded /
+    #: RequestQuarantined); None for a normal completion
+    error: Exception | None = None
+    #: times this request was evicted and requeued for recompute
+    preemptions: int = 0
+    #: containment blame: failed steps this request rode; crossing the
+    #: engine's max_strikes quarantines it
+    strikes: int = 0
+
+    def cancel(self):
+        """Request cancellation. Safe from any thread; the engine
+        honors it at its next scheduler turn — pages are freed and the
+        request completes with ``RequestCancelled`` (tokens already
+        emitted are kept)."""
+        self.cancelled = True
 
 
 class ContinuousBatchingEngine:
@@ -214,7 +280,8 @@ class ContinuousBatchingEngine:
                  eos_token_id=None, greedy=True, temperature=1.0,
                  seed=0, prefill_chunk=None, admit_batch=None,
                  adaptive_chunk=True, unified=True,
-                 trace_sample_rate=0.01, latency_reservoir=2048):
+                 trace_sample_rate=0.01, latency_reservoir=2048,
+                 max_strikes=2, max_containments=8, audit=None):
         self.model = model
         cfg = model.config
         self.cfg = cfg
@@ -262,12 +329,14 @@ class ContinuousBatchingEngine:
                       cfg.num_attention_heads)
         d = getattr(cfg, "head_dim",
                     cfg.hidden_size // cfg.num_attention_heads)
-        # per layer: (key_pages, value_pages) — flat list like dense caches
-        self.pools = []
-        for _ in range(cfg.num_hidden_layers):
-            for _kv in range(2):
-                self.pools.append(Tensor(jnp.zeros(
-                    (kvh, self.num_pages, self.page_size, d), dtype)))
+        # per layer: (key_pages, value_pages) — flat list like dense
+        # caches; geometry kept so step-failure containment can rebuild
+        # the pools from scratch (_reset_device_state)
+        self._pool_shape = (kvh, self.num_pages, self.page_size, d)
+        self._pool_dtype = dtype
+        self._n_pools = cfg.num_hidden_layers * 2
+        self.pools = [Tensor(jnp.zeros(self._pool_shape, dtype))
+                      for _ in range(self._n_pools)]
 
         self._free_pages = deque(range(1, self.num_pages))
         # host-side slot bookkeeping (admission decisions, drain)
@@ -279,6 +348,12 @@ class ContinuousBatchingEngine:
         self.slot_eos = np.full((B,), -1, np.int32)  # per-request eos
         self.slot_req: list[ServedRequest | None] = [None] * B
         self.slot_pages: list[list] = [[] for _ in range(B)]
+        # the ADMISSION prompt per slot: the request's prompt, plus —
+        # for a preempted request re-admitted for recompute — every
+        # token it had already generated (vLLM recompute preemption:
+        # chunked prefill is token-identical to the decode it replays,
+        # so the stream continues exactly where the eviction cut it)
+        self._slot_prompt: list[np.ndarray | None] = [None] * B
         # chunked-prefill progress: a slot whose prompt is still being
         # streamed into its pages is PREFILLING — inactive for decode,
         # ineligible for drain
@@ -317,7 +392,47 @@ class ContinuousBatchingEngine:
         self.queue: deque[ServedRequest] = deque()
         self.completed: list[ServedRequest] = []
         self._next_id = 0
+        self._seed = int(seed)
         self._key = jax.random.PRNGKey(seed)
+        # ---- reliability state (ISSUE 10) ----------------------------
+        # pages reclaimed from an EVICTED (still device-active) slot are
+        # quarantined until every compiled program dispatched before the
+        # eviction has been harvested: an in-flight program still writes
+        # the old owner's kv through its dispatch-time block table, and
+        # handing the pages to a new request in the same turn would
+        # interleave two owners' writes. (gate_seq, pages) entries.
+        self._deferred_free: list[tuple[int, list]] = []
+        self._last_fetch_dispatch_seq = 0   # newest fetched-program seq
+        self._last_harvest_seq = 0          # newest harvested seq
+        # admission order degrades to plain FIFO (the historical
+        # contract) until a non-zero priority is ever seen
+        self._has_priorities = False
+        # the per-turn reap's O(queue) sweep only runs once lifecycle
+        # control (a deadline or an engine-level cancel) is in play —
+        # plus a periodic sweep so a direct ServedRequest.cancel() on
+        # a QUEUED handle (a plain flag the engine cannot observe
+        # eagerly) is still honored within a bounded number of turns
+        self._lifecycle_seen = False
+        self._reap_turn = 0
+        # completions produced OUTSIDE the drain pass (already-complete
+        # replays adopted at admission) — drained into the next turn's
+        # done list so run()/step() callers still see them
+        self._done_pending: list[ServedRequest] = []
+        # step-failure containment: blame threshold + containment
+        # budget (an engine failing every step escapes to the
+        # supervisor instead of looping forever). The budget resets at
+        # every run() entry; a bare step() loop spends it until the
+        # next run().
+        self.max_strikes = int(max_strikes)
+        self.max_containments = int(max_containments)
+        self._containments_run = 0
+        # page-accounting audit (PADDLE_TPU_SERVING_AUDIT=1, on in
+        # tests): free + Σ slot pages + deferred + trash == num_pages
+        # after every drain/preempt/cancel, so reclamation bugs fail
+        # loudly instead of leaking quietly
+        from ..profiler import _env_bool
+        self._audit = _env_bool("PADDLE_TPU_SERVING_AUDIT") \
+            if audit is None else bool(audit)
         self._prefill_fn = None        # legacy: ONE prefill signature
         self._chunk_fns = {}           # legacy: chunk len -> program
         self._compiled = set()         # distinct compiled signatures
@@ -359,26 +474,100 @@ class ContinuousBatchingEngine:
     # ---- public API ------------------------------------------------------
 
     def add_request(self, prompt_ids, max_new_tokens,
-                    eos_token_id=None) -> int:
+                    eos_token_id=None, priority=0,
+                    ttft_deadline_s=None, deadline_s=None) -> int:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
-        if prompt.size + int(max_new_tokens) > self.max_len:
+        self._check_fits(prompt.size, int(max_new_tokens))
+        req = ServedRequest(self._next_id, prompt, int(max_new_tokens),
+                            eos_token_id if eos_token_id is not None
+                            else (self.eos if self.eos >= 0 else None),
+                            priority=int(priority),
+                            ttft_deadline_s=ttft_deadline_s,
+                            deadline_s=deadline_s)
+        req.t_arrive = time.perf_counter()
+        self._next_id += 1
+        if req.priority:
+            self._has_priorities = True
+        if ttft_deadline_s is not None or deadline_s is not None:
+            self._lifecycle_seen = True
+        self.queue.append(req)
+        return req.request_id
+
+    def _check_fits(self, prompt_len, max_new):
+        if prompt_len + max_new > self.max_len:
             raise ValueError(
-                f"prompt ({prompt.size}) + max_new_tokens "
-                f"({max_new_tokens}) exceeds engine max_len {self.max_len}")
+                f"prompt ({prompt_len}) + max_new_tokens "
+                f"({max_new}) exceeds engine max_len {self.max_len}")
         # reject what the pool can NEVER satisfy — otherwise run() would
         # spin forever waiting for pages that cannot exist
-        need = -(-(prompt.size + int(max_new_tokens)) // self.page_size)
+        need = -(-(prompt_len + max_new) // self.page_size)
         if need > self.num_pages - 1:
             raise ValueError(
                 f"request needs {need} pages but the pool only has "
                 f"{self.num_pages - 1} allocatable")
-        req = ServedRequest(self._next_id, prompt, int(max_new_tokens),
-                            eos_token_id if eos_token_id is not None
-                            else (self.eos if self.eos >= 0 else None))
-        req.t_arrive = time.perf_counter()
-        self._next_id += 1
+
+    def _queue_snapshot(self):
+        """Copy the queue for a cross-thread lookup. ``list(deque)``
+        is NOT atomic — a scheduler mutation mid-copy raises
+        mutated-during-iteration — so retry; the queue quiesces within
+        a turn, making livelock practically impossible. The handle's
+        own ``cancel()`` (a bool set) remains the truly lock-free
+        any-thread surface."""
+        while True:
+            try:
+                return list(self.queue)
+            except RuntimeError:
+                continue
+
+    def request(self, request_id) -> ServedRequest | None:
+        """The live ServedRequest handle for an id — queued, running,
+        or completed (the cancel()/error/priority surface)."""
+        for req in self._queue_snapshot():
+            if req is not None and req.request_id == request_id:
+                return req
+        for req in list(self.slot_req):
+            if req is not None and req.request_id == request_id:
+                return req
+        for req in list(self.completed):
+            if req.request_id == request_id:
+                return req
+        return None
+
+    def cancel(self, request_id) -> bool:
+        """Cancel a queued or running request: takes effect at the next
+        scheduler turn (pages freed mid-prefill or mid-decode, typed
+        ``RequestCancelled`` completion, tokens already emitted kept).
+        Returns False for an unknown or already-finished request.
+        Only live containers are scanned — cancelling a finished
+        request is a no-op, so lookup cost never grows with the
+        engine's completed history."""
+        for req in self._queue_snapshot() + list(self.slot_req):
+            if req is not None and req.request_id == request_id:
+                if req.finished:
+                    return False
+                req.cancel()
+                self._lifecycle_seen = True
+                return True
+        return False
+
+    def requeue(self, req: ServedRequest):
+        """Adopt a ServedRequest salvaged from a torn-down engine
+        (EngineSupervisor restart): idempotent replay — the prompt plus
+        every token already delivered re-prefills through the recompute
+        path, so the stream continues exactly where the dead engine
+        left it. A request that already has its full stream (it crashed
+        between harvest and drain) completes immediately."""
+        if req.finished:
+            self.completed.append(req)
+            return
+        self._check_fits(req.prompt.size, req.max_new_tokens)
+        self._next_id = max(self._next_id, req.request_id + 1)
+        if req.priority:
+            self._has_priorities = True
+        if req.ttft_deadline_s is not None \
+                or req.deadline_s is not None or req.cancelled:
+            self._lifecycle_seen = True
         self.queue.append(req)
-        return req.request_id
 
     def has_work(self) -> bool:
         return bool(self.queue) or bool(self.active.any()) \
@@ -388,15 +577,21 @@ class ContinuousBatchingEngine:
         """Admit what fits, advance every slot one scheduler turn (one
         unified batching-step program, or prefill waves + one decode
         chunk in legacy mode), drain finished slots. Returns the
-        requests completed by this step."""
+        requests completed by this step. Step failures hit the same
+        containment boundary as :meth:`run`."""
         self._admit()
-        if self._unified:
-            if self._worth_step():
-                self._harvest_step(self._dispatch_step())
-            return self._drain()
-        self._pump_prefill()
-        if self.active.any():
-            self._decode_chunk()
+        try:
+            if self._unified:
+                if self._worth_step():
+                    self._harvest_step(self._dispatch_step())
+            else:
+                self._pump_prefill()
+                if self.active.any():
+                    self._decode_chunk()
+        except Exception as exc:  # noqa: BLE001 — containment boundary
+            if not self._containable(exc):
+                raise
+            return self._contain_step_failure(exc) + self._drain()
         return self._drain()
 
     def run(self):
@@ -463,10 +658,38 @@ class ContinuousBatchingEngine:
     def _run_driver(self, spec_dispatch, harvest, after_admit,
                     idle_turn):
         """The one scheduler loop both modes share — hooks differ, the
-        pipelining skeleton, overlap-admission accounting and stall
-        detection must not (a fix here fixes both engines)."""
+        pipelining skeleton, overlap-admission accounting, the fault-
+        containment boundary and stall detection must not (a fix here
+        fixes both engines).
+
+        Reliability structure (ISSUE 10): every compiled-step
+        dispatch/harvest runs inside the containment boundary — a step
+        exception quarantines the implicated request(s) and resets
+        slots/pages instead of killing the engine. Pure overload never
+        stalls: a no-progress turn with occupied slots evicts the
+        youngest, lowest-priority occupant for recompute (a wedged slot
+        cannot hold the pool hostage); the stall ``RuntimeError``
+        survives only as the watchdog-backed deadlock diagnostic for a
+        pool that is exhausted with NO occupant left to evict (a true
+        leak)."""
         done = []
         inflight = None
+        deadlock_evictions = 0
+        max_deadlock = max(8, 2 * self.num_slots)
+        # the containment budget is PER RUN: a healthy later run must
+        # not inherit an earlier run's spent budget
+        self._containments_run = 0
+
+        def contained(exc, cohort=None):
+            """Quarantine/requeue for a containable compiled-step
+            failure; None when the failure must escape (audit
+            assertion, budget spent — the EngineSupervisor's job).
+            ``cohort``: the failed program's dispatch-time request
+            snapshot, for accurate blame."""
+            if not self._containable(exc):
+                return None
+            return self._contain_step_failure(exc, cohort=cohort)
+
         t_run0 = time.perf_counter()
         _wd_token = _frec.arm("serving run loop")
         try:
@@ -477,17 +700,50 @@ class ContinuousBatchingEngine:
                 # scoped: another component's beats cannot mask us)
                 _frec.beat(_wd_token)
                 if inflight is not None:
-                    # speculative successor first: device never idles
-                    # while the host harvests, drains, and admits
-                    nxt = spec_dispatch()
-                    harvest(inflight)
+                    # speculative successor first: device never
+                    # idles while the host harvests/drains/admits.
+                    # Containment wraps ONLY the compiled dispatch/
+                    # harvest — a host-side scheduler bug in
+                    # _admit/_drain/_reap is not a per-request fault
+                    # and must surface, not be laundered into strikes
+                    try:
+                        nxt = spec_dispatch()
+                    except Exception as exc:  # noqa: BLE001
+                        extra = contained(exc)
+                        if extra is None:
+                            raise
+                        inflight = None
+                        done.extend(extra)
+                        continue
+                    try:
+                        harvest(inflight)
+                    except Exception as exc:  # noqa: BLE001
+                        # blame the HARVESTED program's dispatch-time
+                        # cohort (rec[1]), not whoever occupies the
+                        # slots now
+                        extra = contained(exc, cohort=inflight[1])
+                        if extra is None:
+                            raise
+                        inflight = None
+                        done.extend(extra)
+                        continue
                     done.extend(self._drain())
                     # admissions overlap nxt's on-device run — the
-                    # gauge distinguishing overlapped from serialized
+                    # gauge distinguishing overlapped / serialized
                     self._overlap_admission = nxt is not None
                     try:
                         self._admit()
-                        after_admit()
+                        try:
+                            # legacy prefill waves ARE compiled
+                            # dispatches — containable; nxt is
+                            # abandoned with the rest of device state
+                            after_admit()
+                        except Exception as exc:  # noqa: BLE001
+                            extra = contained(exc)
+                            if extra is None:
+                                raise
+                            nxt = None
+                            done.extend(extra)
                     finally:
                         self._overlap_admission = False
                     inflight = nxt
@@ -495,38 +751,169 @@ class ContinuousBatchingEngine:
                 n_before = len(done)
                 self._admit()
                 done.extend(self._drain())
-                progressed, inflight = idle_turn()
-                if progressed:
+                try:
+                    progressed, inflight = idle_turn()
+                except Exception as exc:  # noqa: BLE001
+                    extra = contained(exc)
+                    if extra is None:
+                        raise
+                    inflight = None
+                    done.extend(extra)
+                    continue
+                if progressed or len(done) > n_before:
+                    # a recovered wedge must not eat the deadlock
+                    # budget forever: the cap bounds CONSECUTIVE
+                    # fruitless evictions, not a run's lifetime total
+                    deadlock_evictions = 0
                     continue
                 if not self.queue:
                     break
-                if (len(done) == n_before
-                        and all(r is None for r in self.slot_req)):
-                    # nothing running, nothing finished, head request
-                    # still unadmittable — spinning never terminates.
-                    # Dump a flight-recorder bundle first: the ring's
-                    # recent scheduler turns + pool state are the
-                    # post-mortem
-                    rec = _frec.get_recorder()
-                    if rec is not None:
-                        _frec.record_event(
-                            "serving_stall", queued=len(self.queue),
-                            free_pages=len(self._free_pages))
-                        try:
-                            rec.dump("serving engine stalled: queued "
-                                     "request cannot be admitted")
-                        except OSError:
-                            pass    # the diagnostic RuntimeError below
-                                    # must not be replaced by a failed
-                                    # bundle write
-                    raise RuntimeError(
-                        "serving engine stalled: queued request cannot "
-                        "be admitted (page pool exhausted?)")
+                # nothing dispatched, harvested, drained or admitted
+                # this turn, but requests still queued: overload always
+                # progresses (slots drain -> pages free -> admission),
+                # so something undrainable holds the pool
+                occupied = [s for s in range(self.num_slots)
+                            if self.slot_req[s] is not None]
+                if occupied and deadlock_evictions < max_deadlock:
+                    victim = min(occupied, key=lambda s: (
+                        self.slot_req[s].priority,
+                        -self.slot_req[s].t_admit))
+                    deadlock_evictions += 1
+                    self._evict_slot(victim, requeue=True,
+                                     reason="deadlock")
+                    continue
+                # pool exhausted with no evictable occupant (or the
+                # eviction budget burned without progress): a true
+                # leak/deadlock. Dump a flight-recorder bundle first:
+                # the ring's recent scheduler turns + pool state are
+                # the post-mortem
+                rec = _frec.get_recorder()
+                if rec is not None:
+                    _frec.record_event(
+                        "serving_stall", queued=len(self.queue),
+                        free_pages=len(self._free_pages),
+                        occupied=len(occupied))
+                    try:
+                        rec.dump("serving engine stalled: queued "
+                                 "request cannot be admitted")
+                    except OSError:
+                        pass    # the diagnostic RuntimeError below
+                                # must not be replaced by a failed
+                                # bundle write
+                raise RuntimeError(
+                    "serving engine stalled: queued request cannot "
+                    "be admitted (page pool exhausted?)")
         finally:
             _frec.disarm(_wd_token)
             self._stats["run_seconds"] += time.perf_counter() - t_run0
             self._emit_gauges()
         return done
+
+    # ---- step-level fault containment (ISSUE 10) -------------------------
+
+    def _containable(self, exc):
+        """Is this step failure containable? AssertionError is the
+        audit invariant speaking — never swallow it; past the per-run
+        containment budget the failure escapes to the
+        EngineSupervisor (an engine failing every step must not loop
+        forever)."""
+        if isinstance(exc, AssertionError):
+            return False
+        return self._containments_run < self.max_containments
+
+    def _contain_step_failure(self, exc, cohort=None):
+        """Step-level fault isolation: one failed compiled step (a
+        poisoned sampler, NaN materializing at the fetch, an injected
+        fault) must not kill every in-flight stream. Every occupied
+        slot gets a STRIKE — a poison request rides every step it is
+        scheduled into, so repeat offenders cross ``max_strikes`` and
+        are quarantined with a typed error, while co-scheduled
+        innocents are requeued for recompute-style replay (suspects
+        re-enter SOLO, so the next fault implicates exactly one
+        request). Device state after a failed step is unreliable (the
+        pools/hot-state chain ran through the failed program), so it
+        is rebuilt from scratch and every survivor replays through the
+        recompute path. Returns the requests completed (quarantined)
+        by the containment.
+
+        ``cohort`` is the failed program's DISPATCH-TIME request
+        snapshot when the caller has one (a harvest record): only
+        cohort members are struck — a request admitted during the
+        overlap window must not be blamed for a program it never
+        rode (it still resets and replays, unblamed)."""
+        self._containments_run += 1
+        self._stats.inc("containments")
+        _frec.record_event(
+            "containment", error=repr(exc)[:200],
+            occupied=int(sum(r is not None for r in self.slot_req)))
+        blame = None if cohort is None else \
+            {id(r) for r in cohort if r is not None}
+        requeue, quarantine = [], []
+        for slot in range(self.num_slots):
+            req = self.slot_req[slot]
+            if req is None or req.finished:
+                continue
+            if blame is None or id(req) in blame:
+                req.strikes += 1
+            (quarantine if req.strikes >= self.max_strikes
+             else requeue).append(req)
+        self._reset_device_state()
+        done = []
+        for req in requeue:
+            req.preemptions += 1
+        # survivors replay in ARRIVAL order at the queue front
+        # (appendleft in slot order would reverse it — later arrivals
+        # must not replay first; slot order itself is shuffled by
+        # drain/re-admit churn)
+        requeue.sort(key=lambda r: (r.t_arrive, r.request_id))
+        self.queue.extendleft(reversed(requeue))
+        for req in quarantine:
+            done.append(self._finish_error(
+                req, RequestQuarantined(req.request_id, repr(exc))))
+        self._audit_pages("containment")
+        return done
+
+    def _reset_device_state(self):
+        """Rebuild the pools, the free list and all per-slot state from
+        scratch — FRESH device buffers, so writes still racing out of
+        an abandoned in-flight program land in orphaned arrays, never
+        in state the engine will read again. Compiled programs are pure
+        functions of their inputs and are kept."""
+        B, MP = self.num_slots, self.pages_per_slot
+        self.pools = [Tensor(jnp.zeros(self._pool_shape,
+                                       self._pool_dtype))
+                      for _ in range(self._n_pools)]
+        self._free_pages = deque(range(1, self.num_pages))
+        self._deferred_free = []
+        self.tables[:] = 0
+        self.ctx[:] = 0
+        self.active[:] = False
+        self.limits[:] = 0
+        self.slot_eos[:] = -1
+        self.slot_req = [None] * B
+        self.slot_pages = [[] for _ in range(B)]
+        self._slot_prompt = [None] * B
+        self._prefilling[:] = False
+        self._prefill_off[:] = 0
+        self._act_target[:] = False
+        self._pred_ctx[:] = 0
+        self._act_since[:] = 0
+        self._pending_first[:] = False
+        self._echo_inflight[:] = False
+        self._emits_inflight[:] = 0
+        self._dev_tok = jnp.zeros((B,), jnp.int32)
+        self._dev_ctx = jnp.zeros((B,), jnp.int32)
+        self._dev_act = jnp.zeros((B,), bool)
+        self._dev_tbl = jnp.zeros((B, MP), jnp.int32)
+        self._dev_lim = jnp.zeros((B,), jnp.int32)
+        self._dev_eos = jnp.full((B,), -1, jnp.int32)
+        # the RNG key chained through the failed program; rebuild from
+        # the seed (greedy streams are unaffected; sampled streams
+        # restart their key chain — documented in docs/serving.md)
+        self._key = jax.random.PRNGKey(
+            self._seed + self._containments_run)
+        self._last_fetch_dispatch_seq = self._seq
+        self._last_harvest_seq = self._seq
 
     # ---- unified batching step (ONE compiled program) --------------------
 
@@ -675,16 +1062,17 @@ class ContinuousBatchingEngine:
         for slot in range(B):
             if not self._prefilling[slot] or n_pre >= self.admit_batch:
                 continue
-            req = self.slot_req[slot]
+            prm = self._slot_prompt[slot]
             off = int(self._prefill_off[slot])
-            v = min(C, len(req.prompt) - off)
-            ids[slot, :v] = req.prompt[off:off + v]
+            v = min(C, len(prm) - off)
+            ids[slot, :v] = prm[off:off + v]
             nq[slot] = v
-            last[slot] = off + v == len(req.prompt)
+            last[slot] = off + v == len(prm)
             tgt[slot] = self._act_target[slot]
             n_pre += 1
         fn = self._unified_static()
         self._seq += 1
+        self._last_fetch_dispatch_seq = self._seq
         n_steps = 1 + self._n_decode
         # a slot advances this step if it decodes with budget left OR
         # streams prompt tokens (a completing prompt decodes the
@@ -729,7 +1117,7 @@ class ContinuousBatchingEngine:
                 self._prefill_off[slot] += nq[slot]
                 if last[slot]:
                     req = self.slot_req[slot]
-                    tl = len(req.prompt)
+                    tl = len(self._slot_prompt[slot])
                     req.t_prefill_done = time.perf_counter()
                     self._prefilling[slot] = False
                     self.ctx[slot] = tl
@@ -757,6 +1145,8 @@ class ContinuousBatchingEngine:
         dispatch, since this step went out)."""
         packed, snap_req, emits, n_steps, seq = rec
         arr = np.asarray(packed._data)            # the ONE fetch
+        self._last_harvest_seq = max(self._last_harvest_seq, seq)
+        self._release_deferred()
         toks_np = arr[:, :n_steps]
         emitted_np = arr[:, n_steps:2 * n_steps].astype(bool)
         ctx_m = arr[:, 2 * n_steps].astype(np.int32)
@@ -776,6 +1166,10 @@ class ContinuousBatchingEngine:
                                            int(ctx_m[slot]))
             if req is None or req.finished:
                 continue
+            # a clean harvest exonerates its riders: one solo step
+            # clears a suspect, so a containment cannot serialize the
+            # whole batch into solo-to-completion replays
+            req.strikes = 0
             for j in range(n_steps):
                 if emitted_np[slot, j]:
                     if not req.tokens:
@@ -841,6 +1235,15 @@ class ContinuousBatchingEngine:
             "requests_completed": s["requests_completed"],
             "obs_overhead_frac": (self._obs_s / s["run_seconds"])
             if s["run_seconds"] else 0.0,
+            # reliability surface (ISSUE 10): overload economics
+            "preempt_evictions": s["preempt_evictions"],
+            "preempt_recompute_tokens": s["preempt_recompute_tokens"],
+            "requests_cancelled": s["requests_cancelled"],
+            "deadline_expired": (s["deadline_ttft_expired"]
+                                 + s["deadline_total_expired"]),
+            "shed_rejections": s["shed_rejections"],
+            "quarantined": s["quarantined"],
+            "containments": s["containments"],
         }
 
     def reset_gauges(self):
@@ -876,63 +1279,368 @@ class ContinuousBatchingEngine:
             return None
         return [self._free_pages.popleft() for _ in range(n)]
 
+    def _release_pages(self, pages, safe=False):
+        """Return pages to the free pool. ``safe=True`` (the drain
+        path) frees immediately — a drained slot is already inactive in
+        every dispatched program, so its writes are trash-page-guarded.
+        Pages from an EVICTED (still device-active) slot are deferred
+        until every fetched program dispatched so far has been
+        harvested (see ``_deferred_free``)."""
+        if not pages:
+            return
+        if safe or self._last_harvest_seq >= \
+                self._last_fetch_dispatch_seq:
+            self._free_pages.extend(pages)
+        else:
+            self._deferred_free.append(
+                (self._last_fetch_dispatch_seq, list(pages)))
+
+    def _release_deferred(self):
+        """Move deferred pages whose gating program has been harvested
+        back into the free pool (called from every harvest)."""
+        if not self._deferred_free:
+            return
+        keep = []
+        for gate, pages in self._deferred_free:
+            if gate <= self._last_harvest_seq:
+                self._free_pages.extend(pages)
+            else:
+                keep.append((gate, pages))
+        self._deferred_free = keep
+
+    def _audit_pages(self, where):
+        """PADDLE_TPU_SERVING_AUDIT invariant: every page lives in
+        exactly one place — the free list, an occupied slot's list, the
+        deferred-reclamation set, or the reserved trash page 0."""
+        if not self._audit:
+            return
+        held = [p for pages in self.slot_pages for p in pages]
+        deferred = [p for _, pages in self._deferred_free
+                    for p in pages]
+        allp = list(self._free_pages) + held + deferred
+        if len(allp) + 1 != self.num_pages \
+                or len(set(allp)) != len(allp) or 0 in allp:
+            raise AssertionError(
+                f"serving page accounting broken at {where}: "
+                f"free={len(self._free_pages)} held={len(held)} "
+                f"deferred={len(deferred)} (+1 trash) != "
+                f"{self.num_pages} pages, "
+                f"dupes={len(allp) - len(set(allp))}, "
+                f"trash_leaked={0 in allp}")
+
+    def _admission_key(self, req):
+        # higher priority first; FIFO (arrival time, then id) within a
+        # priority class — preempted requests keep their original
+        # arrival slot, so recompute does not lose their queue position
+        return (-req.priority, req.t_arrive, req.request_id)
+
+    def _next_candidate(self):
+        if not self.queue:
+            return None
+        if not self._has_priorities:
+            return self.queue[0]       # the historical FIFO contract
+        return min(self.queue, key=self._admission_key)
+
+    def _already_complete(self, req):
+        """A replayed request that already holds its full stream (it
+        died between harvest and drain, or a wedged slot never drained
+        it) — complete it instead of re-admitting."""
+        if not req.tokens:
+            return False
+        eos = req.eos_token_id
+        return (eos is not None and req.tokens[-1] == eos) \
+            or len(req.tokens) >= req.max_new_tokens
+
+    def _complete_ok(self, req):
+        """Normal completion bookkeeping shared by the drain pass and
+        the already-complete replay path."""
+        req.finished = True
+        req.t_done = time.perf_counter()
+        eos = req.eos_token_id
+        req.finish_reason = "eos" if (
+            eos is not None and req.tokens
+            and req.tokens[-1] == eos) else "length"
+        req.strikes = 0        # innocence proven by completion
+        self._record_latency(req)
+        self.completed.append(req)
+        _t_obs = time.perf_counter()
+        self._stats.inc("requests_completed")
+        _frec.record_event("finish", req=req.request_id,
+                           reason=req.finish_reason,
+                           tokens=len(req.tokens))
+        self._obs_s += time.perf_counter() - _t_obs
+
+    def _finish_error(self, req, err):
+        """Complete a request EXCEPTIONALLY: typed error attached,
+        tokens already emitted kept, latency booked when a first token
+        existed."""
+        req.finished = True
+        req.error = err
+        req.t_done = time.perf_counter()
+        # completion instrumentation rides the obs_overhead_frac
+        # window, exactly like _complete_ok (_record_latency books its
+        # own slice internally)
+        _t_obs = time.perf_counter()
+        if isinstance(err, RequestCancelled):
+            req.finish_reason = "cancelled"
+            self._stats.inc("requests_cancelled")
+        elif isinstance(err, DeadlineExceeded):
+            req.finish_reason = "deadline"
+            self._stats.inc("deadline_ttft_expired"
+                            if err.kind == "ttft"
+                            else "deadline_total_expired")
+        else:
+            req.finish_reason = "quarantined"
+            self._stats.inc("quarantined")
+        _frec.record_event("finish_error", req=req.request_id,
+                           reason=req.finish_reason,
+                           tokens=len(req.tokens))
+        self._obs_s += time.perf_counter() - _t_obs
+        self._record_latency(req)
+        self.completed.append(req)
+        return req
+
+    def _clear_slot(self, slot, device=False):
+        """The ONE per-slot teardown (drain and eviction share it —
+        a field missed in a second copy is exactly the stale-state bug
+        class the identity checks exist to catch). ``device=True``
+        additionally deactivates the slot's DEVICE mirrors: needed on
+        eviction, where the device still believes the slot is active;
+        a drained slot already went inactive inside its program."""
+        self.slot_pages[slot] = []
+        self.slot_req[slot] = None
+        self._slot_prompt[slot] = None
+        self.tables[slot] = 0
+        self.ctx[slot] = 0
+        self._pred_ctx[slot] = 0
+        self.limits[slot] = 0
+        self.slot_eos[slot] = -1
+        self._prefill_off[slot] = 0
+        self._act_target[slot] = False
+        if device:
+            self.active[slot] = False
+            self._prefilling[slot] = False
+            self._pending_first[slot] = False
+            self._echo_inflight[slot] = False
+            self._emits_inflight[slot] = 0
+            self._dev_tbl = self._dev_tbl.at[slot].set(
+                jnp.zeros((self.pages_per_slot,), jnp.int32))
+            self._dev_act = self._dev_act.at[slot].set(False)
+            self._dev_ctx = self._dev_ctx.at[slot].set(0)
+            self._dev_lim = self._dev_lim.at[slot].set(0)
+            self._dev_eos = self._dev_eos.at[slot].set(-1)
+
+    def _evict_slot(self, slot, requeue, reason="preempt", error=None):
+        """Tear one occupied slot out of the engine mid-flight:
+        deactivate it on host AND device (an in-flight program's stale
+        view of the slot is discarded at harvest via the slot_req
+        identity check), reclaim its pages (deferred past any fetched
+        program that could still write them), and either requeue the
+        request for recompute-style re-prefill or complete it with a
+        typed error."""
+        req = self.slot_req[slot]
+        if requeue:
+            self._stats.inc("preempt_evictions")
+            self._stats.inc("preempt_pages_reclaimed",
+                            len(self.slot_pages[slot]))
+        self._release_pages(self.slot_pages[slot])
+        self._clear_slot(slot, device=True)
+        _frec.record_event("preempt", slot=slot, req=req.request_id,
+                           tokens=len(req.tokens), reason=reason)
+        if requeue:
+            req.preemptions += 1
+            self.queue.appendleft(req)
+        elif error is not None:
+            self._finish_error(req, error)
+        return req
+
+    def _preempt_for(self, req, need, need_slot=False):
+        """vLLM-style recompute preemption: evict strictly-LOWER-
+        priority occupants — lowest priority, youngest (latest admit)
+        first — until ``req`` has a slot (when ``need_slot``) and
+        ``need`` pages are available or provably arriving (deferred
+        behind the in-flight harvest). Equal-priority traffic never
+        preempts: pure overload queues, it does not thrash."""
+        victims = [s for s in range(self.num_slots)
+                   if self.slot_req[s] is not None
+                   and self.slot_req[s].priority < req.priority]
+        victims.sort(key=lambda s: (self.slot_req[s].priority,
+                                    -self.slot_req[s].t_admit))
+        projected = len(self._free_pages) + sum(
+            len(p) for _, p in self._deferred_free)
+        # feasibility first: if evicting EVERY victim still cannot
+        # reach ``need``, evict none — destroying in-flight progress
+        # with no admission to show for it is pure waste
+        if projected + sum(len(self.slot_pages[s])
+                           for s in victims) < need:
+            return False
+        evicted = 0
+        for s in victims:
+            if projected >= need and (evicted or not need_slot):
+                break
+            projected += len(self.slot_pages[s])
+            self._evict_slot(s, requeue=True, reason="preempt")
+            evicted += 1
+        if need_slot and not evicted:
+            return False
+        return projected >= need
+
+    def _lifecycle_error(self, req, now):
+        if req.cancelled:
+            return RequestCancelled(req.request_id)
+        if req.deadline_s is not None \
+                and now - req.t_arrive > req.deadline_s:
+            return DeadlineExceeded(req.request_id, "total",
+                                    req.deadline_s)
+        if req.ttft_deadline_s is not None and not req.t_first \
+                and now - req.t_arrive > req.ttft_deadline_s:
+            return DeadlineExceeded(req.request_id, "ttft",
+                                    req.ttft_deadline_s)
+        return None
+
+    def _reap(self):
+        """The lifecycle control point, once per scheduler turn:
+        cancelled or deadline-expired requests are shed from the queue,
+        running ones are evicted (pages reclaimed mid-prefill or
+        mid-decode) — each completes with its typed error instead of
+        silently occupying a slot."""
+        done = []
+        now = time.perf_counter()
+        # O(queue) sweep gated on lifecycle control being in play; the
+        # periodic sweep bounds how long a direct handle-cancel() of a
+        # queued request can go unobserved. Running slots (few) are
+        # always swept below.
+        self._reap_turn += 1
+        if self.queue and (self._lifecycle_seen
+                           or self._reap_turn % 32 == 0):
+            drop = [(req, err) for req in self.queue
+                    if (err := self._lifecycle_error(req, now))
+                    is not None]
+            if drop:
+                self._lifecycle_seen = True
+            for req, err in drop:
+                self.queue.remove(req)
+                done.append(self._finish_error(req, err))
+        for slot in range(self.num_slots):
+            req = self.slot_req[slot]
+            if req is None or req.finished:
+                continue
+            err = self._lifecycle_error(req, now)
+            if err is not None:
+                self._evict_slot(slot, requeue=False,
+                                 reason=type(err).__name__,
+                                 error=err)
+                done.append(req)
+        return done
+
     def _admit(self):
         """Move queued requests into free slots: allocate pages, stage
         per-slot state, and mark the slot PREFILLING — the prompt itself
         streams through the batched prefill-chunk program in
-        :meth:`_pump_prefill`."""
-        for slot in range(self.num_slots):
-            if not self.queue:
-                return
-            if self.active[slot] or self.slot_req[slot] is not None:
+        :meth:`_pump_prefill`. Admission order is priority-then-FIFO;
+        when no slot or not enough pages are free, a strictly-higher-
+        priority candidate preempts running lower-priority sequences
+        (:meth:`_preempt_for`). Requests implicated by a step failure
+        (``strikes > 0``) re-enter SOLO so the next fault implicates
+        exactly one request."""
+        while self.queue:
+            req = self._next_candidate()
+            if self._already_complete(req):
+                # replayed request whose stream was already complete
+                self.queue.remove(req)
+                self._complete_ok(req)
+                self._done_pending.append(req)
                 continue
-            req = self.queue[0]
-            tl = len(req.prompt)
-            need = -(-(tl + req.max_new_tokens) // self.page_size)
+            if any(r is not None and r.strikes for r in self.slot_req):
+                return         # a suspect runs alone, nothing joins it
+            occupied = any(r is not None for r in self.slot_req)
+            if req.strikes and occupied:
+                return         # suspects wait for an empty engine
+            gen = len(req.tokens)
+            remaining = req.max_new_tokens - gen
+            eff_len = req.prompt.size + gen
+            need = -(-(eff_len + remaining) // self.page_size)
+            slot = next((s for s in range(self.num_slots)
+                         if self.slot_req[s] is None
+                         and not self.active[s]), None)
+            if slot is None:
+                if not (self._has_priorities
+                        and self._preempt_for(req, need,
+                                              need_slot=True)):
+                    return
+                slot = next((s for s in range(self.num_slots)
+                             if self.slot_req[s] is None
+                             and not self.active[s]), None)
+                if slot is None:
+                    return
             pages = self._alloc_pages(need)
+            if pages is None and self._has_priorities \
+                    and self._preempt_for(req, need):
+                pages = self._alloc_pages(need)
             if pages is None:
-                return        # pool exhausted; retry after a drain
-            self.queue.popleft()
-            self.slot_pages[slot] = pages
-            row = np.zeros((self.pages_per_slot,), np.int32)
-            row[:len(pages)] = pages
-            self.tables[slot] = row
-            self._dev_tbl = self._dev_tbl.at[slot].set(jnp.asarray(row))
-            req.t_admit = time.perf_counter()
-            _t_obs = req.t_admit
-            if self._trace_every:
-                req.traced = req.request_id % self._trace_every == 0
-            self._stats.inc("prefills")
-            if self._overlap_admission:
-                self._stats.inc("prefills_overlapped")
-            from ..profiler.trace import get_tracer
-            _tr = get_tracer()
-            if _tr.enabled:
-                _tr.instant("serving/prefill", slot=slot, prompt_len=tl,
-                            chunk=self.prefill_chunk,
-                            overlapped=self._overlap_admission)
-            _frec.record_event("admit", slot=slot,
-                               req=req.request_id, prompt_len=tl,
-                               queued=len(self.queue))
-            self._obs_s += time.perf_counter() - _t_obs
-            self.slot_req[slot] = req
-            self._prefilling[slot] = True
-            self._prefill_off[slot] = 0
-            self._emits_inflight[slot] = 0
-            self._act_target[slot] = req.max_new_tokens > 1
-            self.ctx[slot] = 0
-            self._pred_ctx[slot] = 0
-            self._dev_ctx = self._dev_ctx.at[slot].set(0)
-            self.slot_eos[slot] = -1 if req.eos_token_id is None \
-                else int(req.eos_token_id)
-            # ctx counts CACHE entries; one generated token is always
-            # pending outside the cache, so the n-th token lands when
-            # ctx hits tl + n - 1 (not tl + n)
-            self.limits[slot] = tl + req.max_new_tokens - 1
-            self._dev_lim = self._dev_lim.at[slot].set(
-                int(self.limits[slot]))
-            self._dev_eos = self._dev_eos.at[slot].set(
-                int(self.slot_eos[slot]))
+                return   # reclaimed pages still deferred behind the
+                         # in-flight harvest (or pure overload): the
+                         # candidate stays queued, admit next turn
+            self.queue.remove(req)
+            if gen:
+                # recompute re-admission: prompt + generated tokens
+                # stream back through prefill (token-identical replay)
+                self._stats.inc("preempt_recompute_tokens", gen)
+                eff = np.concatenate(
+                    [req.prompt,
+                     np.asarray(req.tokens, np.int32)])
+            else:
+                eff = req.prompt
+            self._stage_slot(slot, req, pages, eff, remaining)
+        return
+
+    def _stage_slot(self, slot, req, pages, eff, remaining):
+        """Bind an admitted request to a slot: block-table row, device
+        mirrors, prefill progress. ``eff`` is the admission prompt
+        (original prompt + recompute replay tokens), ``remaining`` the
+        generation budget left."""
+        tl = len(eff)
+        self.slot_pages[slot] = pages
+        self._slot_prompt[slot] = eff
+        row = np.zeros((self.pages_per_slot,), np.int32)
+        row[:len(pages)] = pages
+        self.tables[slot] = row
+        self._dev_tbl = self._dev_tbl.at[slot].set(jnp.asarray(row))
+        req.t_admit = time.perf_counter()
+        _t_obs = req.t_admit
+        if self._trace_every:
+            req.traced = req.request_id % self._trace_every == 0
+        self._stats.inc("prefills")
+        if self._overlap_admission:
+            self._stats.inc("prefills_overlapped")
+        from ..profiler.trace import get_tracer
+        _tr = get_tracer()
+        if _tr.enabled:
+            _tr.instant("serving/prefill", slot=slot, prompt_len=tl,
+                        chunk=self.prefill_chunk,
+                        overlapped=self._overlap_admission)
+        _frec.record_event("admit", slot=slot,
+                           req=req.request_id, prompt_len=tl,
+                           queued=len(self.queue))
+        self._obs_s += time.perf_counter() - _t_obs
+        self.slot_req[slot] = req
+        self._prefilling[slot] = True
+        self._prefill_off[slot] = 0
+        self._emits_inflight[slot] = 0
+        self._act_target[slot] = remaining > 1
+        self.ctx[slot] = 0
+        self._pred_ctx[slot] = 0
+        self._dev_ctx = self._dev_ctx.at[slot].set(0)
+        self.slot_eos[slot] = -1 if req.eos_token_id is None \
+            else int(req.eos_token_id)
+        # ctx counts CACHE entries; one generated token is always
+        # pending outside the cache, so the n-th token lands when
+        # ctx hits tl + n - 1 (not tl + n)
+        self.limits[slot] = tl + remaining - 1
+        self._dev_lim = self._dev_lim.at[slot].set(
+            int(self.limits[slot]))
+        self._dev_eos = self._dev_eos.at[slot].set(
+            int(self.slot_eos[slot]))
 
     def _prefill_static(self):
         """The ONE compiled prefill signature: every wave — any mix of
@@ -1010,13 +1718,13 @@ class ContinuousBatchingEngine:
                     continue
                 if len(batched) >= self.admit_batch:
                     continue      # next wave picks it up
-                req = self.slot_req[slot]
+                prm = self._slot_prompt[slot]
                 off = int(self._prefill_off[slot])
-                v = min(C, len(req.prompt) - off)
-                ids[slot, :v] = req.prompt[off:off + v]
+                v = min(C, len(prm) - off)
+                ids[slot, :v] = prm[off:off + v]
                 pstart[slot] = off
                 valid[slot] = v
-                last[slot] = off + v == len(req.prompt)
+                last[slot] = off + v == len(prm)
                 tgt[slot] = self._act_target[slot]
                 batched.append(slot)
             fn = self._prefill_static()
@@ -1043,7 +1751,7 @@ class ContinuousBatchingEngine:
                 # through the next decode chunk's packed fetch (or the
                 # drain-time fetch for one-shot tail requests)
                 req = self.slot_req[slot]
-                tl = len(req.prompt)
+                tl = len(self._slot_prompt[slot])
                 req.t_prefill_done = time.perf_counter()
                 self._prefilling[slot] = False
                 self.ctx[slot] = tl
@@ -1164,6 +1872,7 @@ class ContinuousBatchingEngine:
         n = self._next_chunk_len()
         fn = self._chunk_static(n)
         self._seq += 1
+        self._last_fetch_dispatch_seq = self._seq
         # "active" for occupancy accounting = slots this chunk can
         # actually advance (host-active AND budget remaining); a slot
         # that exhausted its budget but has not drained yet is idle
@@ -1210,6 +1919,8 @@ class ContinuousBatchingEngine:
         """Fetch one in-flight chunk's packed output and apply it."""
         packed, snap_req, pending, n, seq = rec
         arr = np.asarray(packed._data)            # the ONE fetch
+        self._last_harvest_seq = max(self._last_harvest_seq, seq)
+        self._release_deferred()
         toks_np = arr[:, :n]
         emitted_np = arr[:, n:2 * n].astype(bool)
         init_tok = arr[:, 2 * n]
@@ -1218,13 +1929,18 @@ class ContinuousBatchingEngine:
         t_now = time.perf_counter()
         appended = 0
         for slot in range(self.num_slots):
+            req = snap_req[slot]
+            if req is not self.slot_req[slot]:
+                # slot evicted (its echo flag was reset by the
+                # eviction) or re-admitted since this dispatch: the
+                # stale pending snapshot must not clear the NEW
+                # occupant's first-token guard — its token rides a
+                # later, unharvested program
+                continue
             if pending[slot]:
                 # this harvest delivers the slot's first-token echo;
                 # _drain may finish the slot again from here on
                 self._echo_inflight[slot] = False
-            req = snap_req[slot]
-            if req is not self.slot_req[slot]:
-                continue      # slot re-admitted since this dispatch
             if self._act_since[slot] <= seq:
                 # the chunk's view of this slot is current (it was not
                 # re-activated by a prefill wave after this dispatch)
@@ -1239,6 +1955,7 @@ class ContinuousBatchingEngine:
                 appended += 1
             if req.finished:
                 continue
+            req.strikes = 0        # clean harvest exonerates (above)
             for j in range(n):
                 if emitted_np[slot, j]:
                     if not req.tokens:
@@ -1300,7 +2017,12 @@ class ContinuousBatchingEngine:
                    tokens=len(req.tokens))
 
     def _drain(self):
-        done = []
+        # lifecycle first: cancellations and deadline expiries free
+        # their pages and complete with typed errors at this turn
+        done = self._reap()
+        if self._done_pending:
+            done.extend(self._done_pending)
+            self._done_pending = []
         for slot in range(self.num_slots):
             req = self.slot_req[slot]
             if req is None:
@@ -1325,32 +2047,16 @@ class ContinuousBatchingEngine:
                         self._dev_tok[slot])))
                     self._stats.inc("tokens_emitted")
                     self._pending_first[slot] = False
-                if not req.finished:
-                    req.finished = True
-                    req.t_done = time.perf_counter()
-                    eos = req.eos_token_id
-                    req.finish_reason = "eos" if (
-                        eos is not None and req.tokens
-                        and req.tokens[-1] == eos) else "length"
-                    self._record_latency(req)
-                self._free_pages.extend(self.slot_pages[slot])
-                self.slot_pages[slot] = []
-                self.slot_req[slot] = None
-                self.tables[slot] = 0
-                self.ctx[slot] = 0
-                self._pred_ctx[slot] = 0
-                self.limits[slot] = 0
-                self.slot_eos[slot] = -1
-                self._prefill_off[slot] = 0
-                self._act_target[slot] = False
-                self.completed.append(req)
-                _t_obs = time.perf_counter()
-                self._stats.inc("requests_completed")
-                _frec.record_event("finish", req=req.request_id,
-                                   reason=req.finish_reason,
-                                   tokens=len(req.tokens))
-                self._obs_s += time.perf_counter() - _t_obs
+                finished_now = not req.finished
+                # drained slots are inactive in every dispatched
+                # program (writes trash-page-guarded), so their pages
+                # are immediately reusable
+                self._release_pages(self.slot_pages[slot], safe=True)
+                self._clear_slot(slot)
+                if finished_now:
+                    self._complete_ok(req)
                 done.append(req)
+        self._audit_pages("drain")
         return done
 
 

@@ -150,6 +150,10 @@ class FaultInjector:
         self._real_rename = None
         self._call_targets = []   # (dotted_name, plan) awaiting patch
         self._patched_calls = []  # (owner, attr, original)
+        # serving-side plans need ARGUMENT access (which request rides
+        # the harvested program, which slot is draining), so they carry
+        # their own wrapper factory instead of the blind call patch
+        self._custom_targets = []  # (dotted_name, plan, make_patched)
 
     # -- arming ------------------------------------------------------------
 
@@ -226,6 +230,107 @@ class FaultInjector:
         """Total number of times any plan fired."""
         return sum(p.fired for p in self.plans)
 
+    # -- serving-side plans (ISSUE 10) -------------------------------------
+    # Chaos shapes for the continuous-batching engine: a poisoned
+    # request, a slot that stops draining, a page-reclamation leak.
+    # Each is a call plan on an engine method whose wrapper inspects
+    # the call's arguments, so the fault is attributable (fires only
+    # when the chosen request/slot is involved).
+
+    _SERVING = "paddle_tpu.inference.serving.ContinuousBatchingEngine."
+
+    def _custom(self, target, plan, make_patched):
+        self._custom_targets.append((target, plan, make_patched))
+        if self._installed:
+            self._patch_custom(target, plan, make_patched)
+
+    def _claim(self, plan):
+        """Claim one firing of ``plan`` if it is still live."""
+        with self._lock:
+            if plan.fired >= plan.times:
+                return False
+            plan.fired += 1
+            return True
+
+    def poison_request(self, request_id, times=1):
+        """Poison-request plan: harvesting a compiled serving step
+        RAISES ``FloatingPointError`` (the NaN-sampler-output shape
+        materializing at the packed fetch) whenever the chosen request
+        rides the harvested program. The engine's containment boundary
+        must quarantine the poison and recompute its co-scheduled
+        innocents — never die."""
+        plan = FaultPlan(f"poison_request:{request_id}", op="call",
+                         action="raise", times=times)
+        self.plans.append(plan)
+        rid = int(request_id)
+        injector = self
+
+        def make(original, plan_):
+            def patched(eng, rec, *a, **kw):
+                snap = rec[1]   # both harvest records carry the
+                                # slot->request snapshot at index 1
+                if any(r is not None and r.request_id == rid
+                       for r in snap) and injector._claim(plan_):
+                    raise FloatingPointError(
+                        f"fault injected: NaN sampler output "
+                        f"(poison request {rid})")
+                return original(eng, rec, *a, **kw)
+            return patched
+
+        for meth in ("_harvest_step", "_harvest_chunk"):
+            self._custom(self._SERVING + meth, plan, make)
+        return plan
+
+    def wedge_slot(self, slot, times=1):
+        """Wedge-slot plan: the drain pass SKIPS the chosen slot for
+        ``times`` passes — the stream sits finished-but-undrained,
+        holding its pages (the stuck-slot shape the deadlock-break
+        eviction and the EngineSupervisor exist for)."""
+        plan = FaultPlan(f"wedge_slot:{slot}", op="call",
+                         action="raise", times=times)
+        self.plans.append(plan)
+        slot_i = int(slot)
+        injector = self
+
+        def make(original, plan_):
+            def patched(eng, *a, **kw):
+                if not (slot_i < eng.num_slots
+                        and eng.slot_req[slot_i] is not None
+                        and injector._claim(plan_)):
+                    return original(eng, *a, **kw)
+                # emits-inflight makes the drain defer exactly this
+                # slot, without touching any device state
+                eng._emits_inflight[slot_i] += 1
+                try:
+                    return original(eng, *a, **kw)
+                finally:
+                    eng._emits_inflight[slot_i] -= 1
+            return patched
+
+        self._custom(self._SERVING + "_drain", plan, make)
+        return plan
+
+    def leak_pages(self, n=1, times=1):
+        """Page-leak plan: the engine's page-reclamation path silently
+        DROPS the first ``n`` pages it would have returned to the pool
+        — the reclamation-bug shape the PADDLE_TPU_SERVING_AUDIT
+        invariant exists to catch loudly."""
+        plan = FaultPlan("leak_pages", op="call", action="raise",
+                         times=times)
+        self.plans.append(plan)
+        n_drop = int(n)
+        injector = self
+
+        def make(original, plan_):
+            def patched(eng, pages, *a, **kw):
+                if pages and injector._claim(plan_):
+                    pages = list(pages)[n_drop:]
+                return original(eng, pages, *a, **kw)
+            return patched
+
+        self._custom(self._SERVING + "_release_pages", plan, make)
+        return plan
+
     # -- plan matching / actions -------------------------------------------
 
     def _take(self, path, op, pending=None):
@@ -301,16 +406,25 @@ class FaultInjector:
         return owner, rest[-1]
 
     def _patch_call(self, target, plan):
-        owner, attr = self._resolve_owner(target)
-        original = getattr(owner, attr)
         injector = self
 
-        def patched(*a, **kw):
-            live = injector._take_call(plan)
-            if live is not None:
-                injector._act(live, target)  # crash/raise/sigterm
-            return original(*a, **kw)
+        def make(original, plan_):
+            def patched(*a, **kw):
+                live = injector._take_call(plan_)
+                if live is not None:
+                    injector._act(live, target)  # crash/raise/sigterm
+                return original(*a, **kw)
+            return patched
 
+        self._patch_custom(target, plan, make)
+
+    def _patch_custom(self, target, plan, make_patched):
+        """The one patch/restore skeleton every call plan rides —
+        blind plans (_patch_call) and argument-aware serving plans
+        alike, so install/uninstall bookkeeping lives in one place."""
+        owner, attr = self._resolve_owner(target)
+        original = getattr(owner, attr)
+        patched = make_patched(original, plan)
         patched.__name__ = getattr(original, "__name__", attr)
         setattr(owner, attr, patched)
         self._patched_calls.append((owner, attr, original))
@@ -352,6 +466,8 @@ class FaultInjector:
         self._installed = True
         for target, plan in self._call_targets:
             self._patch_call(target, plan)
+        for target, plan, make in self._custom_targets:
+            self._patch_custom(target, plan, make)
         return self
 
     def uninstall(self):

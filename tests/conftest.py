@@ -17,6 +17,12 @@ if "host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["JAX_PLATFORMS"] = "cpu"
 
+# Serving page-accounting audit (ISSUE 10): every engine built by the
+# suite asserts free + held + deferred + trash == num_pages after each
+# drain/preempt/cancel, so a reclamation bug fails the nearest test
+# loudly instead of leaking quietly.
+os.environ.setdefault("PADDLE_TPU_SERVING_AUDIT", "1")
+
 # Hermetic tuner cache: kernels consult the persistent tuning cache at
 # trace time (paddle_tpu/tuner); tests must never read a developer's
 # ~/.cache winners nor write theirs back, so the suite gets a private

@@ -298,9 +298,13 @@ def test_engine_stall_raises_and_dumps(tmp_path):
     paddle.seed(0)
     model = LlamaForCausalLM(cfg)
     model.eval()
+    # audit=False: this test DELIBERATELY corrupts page accounting to
+    # reach the stall diagnostic; the audit would (correctly) fail
+    # first otherwise (test_serving_reliability pins that behavior)
     eng = ContinuousBatchingEngine(model, num_slots=1, page_size=8,
                                    max_len=64, decode_chunk=4,
-                                   prompt_buckets=(8,), greedy=True)
+                                   prompt_buckets=(8,), greedy=True,
+                                   audit=False)
     eng.add_request(np.arange(5, dtype=np.int32), 4)
     eng._free_pages.clear()
     fr.install(capacity=32, bundle_dir=str(tmp_path))

@@ -327,17 +327,31 @@ def test_adaptive_chunk_no_wasted_drain_dispatch():
 
 @pytest.mark.slow
 def test_stall_detection_still_fires():
-    """The page-pool-exhaustion stall guard must survive the chunked-
-    prefill refactor: a request that can never be admitted (pages
-    vanished under the engine) raises instead of spinning."""
+    """The page-pool-exhaustion stall guard survives ISSUE 10 as the
+    true-deadlock diagnostic: a request that can never be admitted
+    (pages vanished under the engine, NOTHING occupied to preempt)
+    raises instead of spinning. With the accounting audit on, the same
+    corruption fails even earlier as the audit AssertionError."""
     model, cfg = _model()
     eng = ContinuousBatchingEngine(model, num_slots=1, page_size=8,
                                    max_len=64, decode_chunk=4,
-                                   prompt_buckets=(8,), greedy=True)
+                                   prompt_buckets=(8,), greedy=True,
+                                   audit=False)
     eng.add_request(np.arange(5, dtype=np.int32), 4)
     eng._free_pages.clear()       # simulate a leaked/fragmented pool
     with pytest.raises(RuntimeError, match="stalled"):
         eng.run()
+    # the audited engine reports the same corruption as an accounting
+    # failure at the first drain — reclamation bugs cannot hide behind
+    # the stall path
+    eng2 = ContinuousBatchingEngine(model, num_slots=1, page_size=8,
+                                    max_len=64, decode_chunk=4,
+                                    prompt_buckets=(8,), greedy=True,
+                                    audit=True)
+    eng2.add_request(np.arange(5, dtype=np.int32), 4)
+    eng2._free_pages.clear()
+    with pytest.raises(AssertionError, match="page accounting"):
+        eng2.run()
 
 
 def test_compile_budget_mixed_length_workload():

@@ -16,7 +16,7 @@ Usage::
     python tools/run_gates.py                     # after the tier-1 run
     python tools/run_gates.py --log /tmp/_t1.log --budget 300
     python tools/run_gates.py --no-budget         # no tier-1 log yet
-    python tools/run_gates.py --no-chaos          # skip the kill smoke
+    python tools/run_gates.py --no-chaos          # skip both chaos smokes
     python tools/run_gates.py --no-serving        # skip engine parity
     python tools/run_gates.py --no-fused          # skip kernel parity
 
@@ -74,6 +74,17 @@ def gate_commands(log: str, budget: float, no_budget: bool,
               os.path.join(REPO_DIR, "tests", "test_elastic_chaos.py"),
               "-q", "-m", "fault and not slow",
               "-p", "no:cacheprovider"]))
+        # serving chaos smoke (ISSUE 10, mirrors elastic_chaos):
+        # overload + poison + wedge through the supervised engine —
+        # every request completes or fails with a typed error, zero
+        # leaked pages (PADDLE_TPU_SERVING_AUDIT on), no engine death.
+        # The randomized sweep stays in the slow tier.
+        gates.append(
+            ("serving_chaos",
+             [sys.executable, "-m", "pytest",
+              os.path.join(REPO_DIR, "tests", "test_serving_chaos.py"),
+              "-q", "-m", "fault and not slow",
+              "-p", "no:cacheprovider"]))
     if not no_serving:
         # serving parity: the unified ragged batching-step engine must
         # reproduce the legacy prefill-wave/decode-chunk engine's token
@@ -122,8 +133,8 @@ def main(argv=None) -> int:
                     help="skip the fast-tier budget gate (no tier-1 "
                          "log in this context)")
     ap.add_argument("--no-chaos", action="store_true",
-                    help="skip the elastic kill-and-resume smoke "
-                         "(the one gate that spawns worker processes)")
+                    help="skip the chaos smokes (elastic kill-and-"
+                         "resume + serving overload/poison recovery)")
     ap.add_argument("--no-serving", action="store_true",
                     help="skip the unified-vs-legacy serving parity "
                          "gate (compiles two tiny engines)")
