@@ -813,6 +813,107 @@ def _cb_overload_bench(on_tpu):
     return out
 
 
+def _cb_fleet_bench(on_tpu):
+    """Multi-replica serving fleet (ISSUE 11): the cb workload fanned
+    across 4 supervised replicas behind the fault-tolerant router,
+    with a MID-RUN replica kill hard enough to trip its circuit
+    breaker — aggregate delivered tok/s (failover cost included), the
+    tail TTFT a routed client sees, the failover latency itself, and
+    the ratio vs the SAME workload on one engine. BASELINE.md
+    documents the keys."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ContinuousBatchingEngine, ServingFleet
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.testing import FaultInjector
+
+    if on_tpu:
+        cfg = LlamaConfig.llama_1b()
+        slots, page, chunk, max_len = 8, 32, 32, 384
+        n_req, plen_lo, plen_hi, new_lo, new_hi = 64, 48, 192, 16, 48
+        kill_after = 8
+    else:
+        cfg = LlamaConfig.tiny()
+        slots, page, chunk, max_len = 2, 8, 4, 48
+        n_req, plen_lo, plen_hi, new_lo, new_hi = 24, 3, 11, 2, 7
+        kill_after = 3
+    cfg.tensor_parallel = False
+    cfg.scan_layers = False
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+
+    def factory():
+        return ContinuousBatchingEngine(
+            model, num_slots=slots, page_size=page, max_len=max_len,
+            decode_chunk=chunk, greedy=True)
+
+    rng = np.random.RandomState(44)
+    specs = [(rng.randint(0, cfg.vocab_size,
+                          (int(rng.randint(plen_lo, plen_hi + 1)),))
+              .astype(np.int32),
+              int(rng.randint(new_lo, new_hi + 1)))
+             for _ in range(n_req)]
+
+    # single-engine A/B: the SAME workload through one engine (its own
+    # warmup) — the denominator of cb_fleet_vs_single
+    single = factory()
+    single.add_request(specs[0][0], specs[0][1])
+    single.run()                       # warmup compiles
+    single.reset_gauges()
+    t0 = time.perf_counter()
+    for p, n in specs:
+        single.add_request(p, n)
+    sdone = single.run()
+    single_wall = max(time.perf_counter() - t0, 1e-9)
+    single_toks = sum(len(r.tokens) for r in sdone)
+    single_tps = single_toks / single_wall
+
+    fleet = ServingFleet(factory, num_replicas=4, max_restarts=1,
+                         retry_backoff_s=0.01)
+    # warm every replica outside the timed region (compiles)
+    for rep in fleet.replicas.values():
+        fleet._warm(rep)
+    t0 = time.perf_counter()
+    with FaultInjector() as fi:
+        # replica 1 dies for good after a few steps: supervisor
+        # restart, budget exhaustion, breaker, failover — all inside
+        # the timed region (the cost IS the metric)
+        fi.kill_replica(1, times=10_000, after_steps=kill_after)
+        fids = [fleet.submit(p, n) for p, n in specs]
+        done = fleet.run()
+    wall = max(time.perf_counter() - t0, 1e-9)
+    by = {r.request_id: r for r in done}
+    ok = [by[f] for f in fids if by[f].error is None]
+    toks = sum(len(r.tokens) for r in ok)
+    ttfts = sorted((r.t_first - r.t_arrive) * 1e3
+                   for r in ok if r.t_first)
+    p99 = ttfts[max(0, int(round(0.99 * (len(ttfts) - 1))))] \
+        if ttfts else 0.0
+    g = fleet.gauges()
+    out = {
+        "cb_fleet_tok_s": round(toks / wall, 2),
+        "cb_fleet_p99_ttft_ms": round(p99, 2),
+        "cb_fleet_failover_ms": round(g["failover_ms_p99"], 2),
+        "cb_fleet_vs_single": round(toks / wall / single_tps, 4)
+        if single_tps else 0.0,
+    }
+    print(f"# cb fleet: {len(fids)} requests over 4 replicas, "
+          f"replica 1 killed mid-run (breaker "
+          f"{'open' if g['breaker_open'] else 'CLOSED?'}), "
+          f"{toks} tokens in {wall:.1f}s "
+          f"({out['cb_fleet_tok_s']} tok/s), p99 ttft "
+          f"{out['cb_fleet_p99_ttft_ms']} ms, failover "
+          f"{out['cb_fleet_failover_ms']} ms, vs single engine "
+          f"x{out['cb_fleet_vs_single']} "
+          f"(requeued {g['requeued']}, retries {g['retries']}, "
+          f"delivered {len(ok)}/{len(fids)})", file=sys.stderr)
+    return out
+
+
 def _moe_bench_config(on_tpu):
     """The BASELINE config-5 bench shape, shared by the MoE train
     section and the breakdown section (attribution fractions are only
@@ -1318,6 +1419,21 @@ def main():
     gc.collect()
     if cb_overload is not None:
         record.update(cb_overload)
+        print(json.dumps(record), flush=True)
+
+    # multi-replica fleet (ISSUE 11): the scale-out + failover
+    # economics next to the single-engine numbers they contextualize
+    try:
+        cb_fleet = _timed_section(
+            "cb fleet", lambda: _retry_transient(
+                lambda: _cb_fleet_bench(on_tpu),
+                "cb fleet bench"))
+    except Exception as e:
+        print(f"# cb fleet bench failed: {e!r}", file=sys.stderr)
+        cb_fleet = None
+    gc.collect()
+    if cb_fleet is not None:
+        record.update(cb_fleet)
         print(json.dumps(record), flush=True)
 
     try:

@@ -36,15 +36,17 @@ import jax.numpy as jnp
 
 from .reliability import (AdmissionController, DeadlineExceeded,
                           EngineSupervisor, Overloaded,
-                          RequestCancelled, RequestQuarantined,
-                          ServingError)
+                          ReplicaFailed, RequestCancelled,
+                          RequestQuarantined, ServingError)
 from .serving import ContinuousBatchingEngine, ServedRequest
+from .fleet import FleetReplica, ServingFleet
 
 __all__ = ["Config", "Predictor", "Tensor", "PrecisionType", "PlaceType",
            "create_predictor", "get_version", "ContinuousBatchingEngine",
            "ServedRequest", "AdmissionController", "EngineSupervisor",
            "ServingError", "RequestCancelled", "DeadlineExceeded",
-           "RequestQuarantined", "Overloaded"]
+           "RequestQuarantined", "Overloaded", "ReplicaFailed",
+           "ServingFleet", "FleetReplica"]
 
 
 class PrecisionType(enum.Enum):

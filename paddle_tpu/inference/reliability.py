@@ -39,8 +39,9 @@ from ..profiler import flight_recorder as _frec
 from ..profiler import metrics as _metrics
 
 __all__ = ["ServingError", "RequestCancelled", "DeadlineExceeded",
-           "RequestQuarantined", "Overloaded", "AdmissionController",
-           "EngineSupervisor"]
+           "RequestQuarantined", "Overloaded", "ReplicaFailed",
+           "AdmissionController", "EngineSupervisor",
+           "salvage_unfinished"]
 
 _metrics.declare("restart/engine_restarts", "counter",
                  "supervised serving-engine teardown+restart cycles "
@@ -97,13 +98,46 @@ class RequestQuarantined(ServingError):
 class Overloaded(ServingError):
     """Admission-control rejection: the system is shedding load.
     ``retry_after_s`` is the controller's estimate of when a retry has
-    a fighting chance."""
+    a fighting chance. The fleet router (ISSUE 11) propagates the MAX
+    of this value across every replica that shed, and its own retries
+    honor it as a backoff floor."""
 
     def __init__(self, reason, retry_after_s):
         super().__init__(
             f"overloaded: {reason} (retry after "
             f"{retry_after_s:.3f}s)")
         self.retry_after_s = float(retry_after_s)
+
+
+class ReplicaFailed(ServingError):
+    """The request's serving replica died (or the whole fleet became
+    unavailable) and the fleet's bounded retry budget was spent.
+    Tokens already emitted are kept on the request — a failed stream
+    delivers its partial prefix plus this typed error, never
+    silence."""
+
+    def __init__(self, request_id, cause=""):
+        super().__init__(
+            f"request {request_id} abandoned after replica failure"
+            + (f": {cause}" if cause else ""))
+        self.request_id = request_id
+        self.cause = cause
+
+
+def salvage_unfinished(engine):
+    """Every queued + in-flight request of an engine being torn down
+    or ejected, in arrival order — the idempotent-replay set (prompt +
+    tokens already emitted) a fresh engine or a sibling replica
+    re-queues through the recompute path. Read-only: safe on a dead
+    engine whose device state is no longer trustworthy (only host-side
+    containers are touched). Shared by :class:`EngineSupervisor`
+    restarts and the :class:`~paddle_tpu.inference.fleet.ServingFleet`
+    breaker/ejection path, so the salvage contract cannot fork."""
+    salvage = [r for r in engine.queue if not r.finished]
+    salvage += [r for r in engine.slot_req
+                if r is not None and not r.finished]
+    salvage.sort(key=lambda r: r.request_id)
+    return salvage
 
 
 # ---- SLO-aware admission control -------------------------------------------
@@ -189,6 +223,13 @@ class AdmissionController:
             / max(1, len(eng.queue))) / max(1, eng.num_slots)
         return max(self.min_retry_after_s, excess * per_req)
 
+    def retry_after_s(self):
+        """The controller's CURRENT retry-after estimate, without
+        shedding anything — the fleet router reads this to compute the
+        fleet-wide ``Overloaded.retry_after_s`` (max across sheddable
+        replicas) instead of inventing a constant."""
+        return self._retry_after_s(self.engine)
+
     # -- the door ----------------------------------------------------------
 
     def _shed(self, eng, reason, floor_s=0.0):
@@ -206,13 +247,9 @@ class AdmissionController:
                            retry_after_s=round(retry, 4))
         raise Overloaded(reason, retry)
 
-    def submit(self, prompt_ids, max_new_tokens, eos_token_id=None,
-               priority=0, ttft_deadline_s=None,
-               deadline_s=None) -> int:
-        """Admit or shed. Returns the request id; raises
-        :class:`Overloaded` (with ``retry_after_s``) when the queue is
-        full or the SLO predictor says the deadline is already lost."""
-        eng = self.engine
+    def _gate(self, eng, ttft_deadline_s):
+        """The shed decision shared by :meth:`submit` and
+        :meth:`admit` — queue bound first, then the SLO prediction."""
         if len(eng.queue) >= self.max_queue:
             self._shed(eng, f"admission queue full "
                             f"({len(eng.queue)}/{self.max_queue})")
@@ -224,6 +261,15 @@ class AdmissionController:
                 self._shed(eng, f"predicted TTFT {pred:.3f}s exceeds "
                                 f"deadline {slo:.3f}s",
                            floor_s=pred - slo)
+
+    def submit(self, prompt_ids, max_new_tokens, eos_token_id=None,
+               priority=0, ttft_deadline_s=None,
+               deadline_s=None) -> int:
+        """Admit or shed. Returns the request id; raises
+        :class:`Overloaded` (with ``retry_after_s``) when the queue is
+        full or the SLO predictor says the deadline is already lost."""
+        eng = self.engine
+        self._gate(eng, ttft_deadline_s)
         rid = eng.add_request(prompt_ids, max_new_tokens,
                               eos_token_id=eos_token_id,
                               priority=priority,
@@ -231,6 +277,19 @@ class AdmissionController:
                               deadline_s=deadline_s)
         self.accepted += 1   # after validation — a rejected oversize
         return rid           # submission must not count as accepted
+
+    def admit(self, req) -> int:
+        """Router-side admission (ISSUE 11): the same shed policy as
+        :meth:`submit`, applied to a PRE-BUILT ``ServedRequest`` — the
+        fleet mints fleet-global ids and failover replays arrive
+        carrying already-emitted tokens, so the engine adopts the
+        object through its ``requeue()`` recompute path instead of
+        minting a fresh one."""
+        eng = self.engine
+        self._gate(eng, req.ttft_deadline_s)
+        eng.requeue(req)     # validates fit; raises before accounting
+        self.accepted += 1
+        return req.request_id
 
 
 # ---- supervised recovery ---------------------------------------------------
@@ -298,6 +357,32 @@ class EngineSupervisor:
         return self.engine.has_work() \
             or any(r is not None for r in self.engine.slot_req)
 
+    def step(self):
+        """One supervised scheduler turn — the ServingFleet's driver
+        unit (ISSUE 11): the cooperative fleet loop round-robins
+        replicas, so each replica advances one ``engine.step()`` at a
+        time under the SAME restart contract as :meth:`run`. A step
+        failure that escapes the engine's containment boundary tears
+        the engine down, salvages queue + in-flight into a fresh one
+        and returns nothing this turn; past ``max_restarts`` the
+        failure propagates (the fleet opens the replica's circuit
+        breaker). Returns the requests completed by this turn, each
+        exactly once across step()/run() calls."""
+        try:
+            done = self.engine.step()
+        except (KeyboardInterrupt, SystemExit, AssertionError):
+            raise               # the audit is never laundered
+        except Exception as exc:  # noqa: BLE001 — supervised
+            self._restart(exc)
+            done = []
+        out = []
+        for r in done:
+            if id(r) not in self._returned:
+                self._returned.add(id(r))
+                out.append(r)
+        self.completed.extend(out)
+        return out
+
     def run(self):
         """Drive to completion across restarts; returns every request
         completed by this call (tokens or typed error), exactly once.
@@ -364,11 +449,8 @@ class EngineSupervisor:
                     + int(g.get(k, 0))
         except Exception:  # noqa: BLE001 — a dead engine's gauges are
             pass           # best-effort salvage, never block restart
-        salvage = [r for r in old.queue if not r.finished]
-        salvage += [r for r in old.slot_req
-                    if r is not None and not r.finished]
         # replay in arrival order so FIFO fairness survives the restart
-        salvage.sort(key=lambda r: r.request_id)
+        salvage = salvage_unfinished(old)
         self.engine = self._factory()
         # carry the dead engine's id counter: requeue() only advances
         # past SALVAGED ids, and a fresh engine re-minting an id the

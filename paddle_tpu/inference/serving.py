@@ -573,6 +573,30 @@ class ContinuousBatchingEngine:
         return bool(self.queue) or bool(self.active.any()) \
             or bool(self._prefilling.any())
 
+    def handoff(self):
+        """Elasticity/drain hook (ISSUE 11): evict every unfinished
+        occupant for recompute-style replay and empty the queue;
+        returns the unfinished requests in arrival order — pages
+        reclaimed audit-clean, tokens already emitted kept — for
+        adoption by a sibling engine (the ServingFleet's deadline-
+        bounded scale-down and failover paths). The engine is left
+        empty and reusable."""
+        out = []
+        for slot in range(self.num_slots):
+            req = self.slot_req[slot]
+            if req is None or req.finished:
+                continue
+            req.preemptions += 1
+            self._evict_slot(slot, requeue=False, reason="handoff")
+            out.append(req)
+        while self.queue:
+            req = self.queue.popleft()
+            if not req.finished:
+                out.append(req)
+        out.sort(key=lambda r: (r.t_arrive, r.request_id))
+        self._audit_pages("handoff")
+        return out
+
     def step(self):
         """Admit what fits, advance every slot one scheduler turn (one
         unified batching-step program, or prefill waves + one decode

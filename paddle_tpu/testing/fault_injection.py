@@ -310,6 +310,97 @@ class FaultInjector:
         self._custom(self._SERVING + "_drain", plan, make)
         return plan
 
+    # -- replica-level plans (ISSUE 11) ------------------------------------
+    # Fleet chaos shapes: a replica that dies, one that wedges, one
+    # that merely straggles. Each matches the engine's
+    # ``_fleet_replica_id`` tag (set by ServingFleet — re-applied on
+    # every supervised rebuild — or settable by hand on a bare engine),
+    # so one plan targets exactly one replica of the shared class.
+
+    def kill_replica(self, replica_id, times=1, after_steps=0):
+        """Replica death, supervisor-visible: the chosen replica's
+        ``step()`` raises ``RuntimeError`` BEFORE any scheduler work
+        runs — the whole turn dies, exactly what a crashed worker
+        looks like from the driver. The replica's EngineSupervisor
+        salvages + restarts; once its budget is spent the fleet opens
+        the circuit breaker and fails the queue over to siblings.
+        ``after_steps`` counts only the chosen replica's steps."""
+        plan = FaultPlan(f"kill_replica:{replica_id}", op="call",
+                         action="raise", times=times,
+                         after_calls=after_steps)
+        self.plans.append(plan)
+        rid = int(replica_id)
+        injector = self
+
+        def make(original, plan_):
+            def patched(eng, *a, **kw):
+                if getattr(eng, "_fleet_replica_id", None) == rid:
+                    live = injector._take_call(plan_)
+                    if live is not None:
+                        raise RuntimeError(
+                            f"fault injected: replica {rid} died "
+                            f"mid-step")
+                return original(eng, *a, **kw)
+            return patched
+
+        self._custom(self._SERVING + "step", plan, make)
+        return plan
+
+    def wedge_replica(self, replica_id, times=10_000):
+        """Wedged replica: ``step()`` returns promptly having done
+        NOTHING — the scheduler turn is skipped wholesale, so the
+        replica still heartbeats (the step returns; liveness is fine)
+        but never makes progress. Must be caught by the fleet's
+        NO-PROGRESS health check, not the liveness check, and without
+        tripping the engine's true-deadlock stall diagnostic (which
+        lives only in ``run()``)."""
+        plan = FaultPlan(f"wedge_replica:{replica_id}", op="call",
+                         action="raise", times=times)
+        self.plans.append(plan)
+        rid = int(replica_id)
+        injector = self
+
+        def make(original, plan_):
+            def patched(eng, *a, **kw):
+                if getattr(eng, "_fleet_replica_id", None) == rid \
+                        and injector._claim(plan_):
+                    return []      # a turn that does nothing
+                return original(eng, *a, **kw)
+            return patched
+
+        self._custom(self._SERVING + "step", plan, make)
+        return plan
+
+    def slow_replica(self, replica_id, delay_s=0.05, stride=4,
+                     times=10_000):
+        """Straggler replica: inflated step latency — every matching
+        ``step()`` burns ``delay_s`` of wall clock, and only every
+        ``stride``-th actually advances the scheduler (in the fleet's
+        cooperative round-robin a slow worker completes fewer turns
+        per unit time; this models that without threads). Progress
+        continues — just slowly — so the no-progress health check must
+        NOT fire; hedged dispatch is what this shape exercises."""
+        plan = FaultPlan(f"slow_replica:{replica_id}", op="call",
+                         action="raise", times=times)
+        self.plans.append(plan)
+        rid = int(replica_id)
+        delay = float(delay_s)
+        stride_n = max(1, int(stride))
+        injector = self
+
+        def make(original, plan_):
+            def patched(eng, *a, **kw):
+                if getattr(eng, "_fleet_replica_id", None) == rid \
+                        and injector._claim(plan_):
+                    time.sleep(delay)
+                    if plan_.fired % stride_n:
+                        return []  # the slice elapsed, no turn ran
+                return original(eng, *a, **kw)
+            return patched
+
+        self._custom(self._SERVING + "step", plan, make)
+        return plan
+
     def leak_pages(self, n=1, times=1):
         """Page-leak plan: the engine's page-reclamation path silently
         DROPS the first ``n`` pages it would have returned to the pool

@@ -32,8 +32,8 @@ def test_known_gates_are_registered():
         sys.path.pop(0)
     assert names == ["atomic_writes", "metric_names",
                      "fast_tier_budget", "elastic_chaos",
-                     "serving_chaos", "serving_parity",
-                     "fused_parity"]
+                     "serving_chaos", "fleet_chaos",
+                     "serving_parity", "fused_parity"]
 
 
 def test_all_gates_pass_on_healthy_log(tmp_path):
@@ -51,6 +51,7 @@ def test_all_gates_pass_on_healthy_log(tmp_path):
     assert "fast_tier_budget: PASS" in p.stdout
     assert "elastic_chaos" not in p.stdout
     assert "serving_chaos" not in p.stdout
+    assert "fleet_chaos" not in p.stdout
     assert "serving_parity" not in p.stdout
     assert "fused_parity" not in p.stdout
     assert "all gates passed" in p.stdout
@@ -67,6 +68,7 @@ def test_full_driver_including_chaos_gate(tmp_path):
     assert p.returncode == 0, p.stdout + p.stderr
     assert "elastic_chaos: PASS" in p.stdout
     assert "serving_chaos: PASS" in p.stdout
+    assert "fleet_chaos: PASS" in p.stdout
     assert "serving_parity: PASS" in p.stdout
     assert "fused_parity: PASS" in p.stdout
     assert "all gates passed" in p.stdout

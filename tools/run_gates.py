@@ -22,8 +22,9 @@ Usage::
 
 ``--no-budget`` skips the fast-tier budget gate for contexts where no
 tier-1 log exists (e.g. pre-commit on a docs change); ``--no-chaos``
-skips the elastic kill-and-resume smoke (a multi-process pytest run —
-the one gate that spawns trainers); the atomic-write gate always runs.
+skips the three chaos smokes (elastic kill-and-resume, serving
+overload/poison recovery, fleet replica kill/failover); the
+atomic-write gate always runs.
 
 Exit codes: 0 = every gate passed, 1 = at least one gate failed.
 """
@@ -83,6 +84,17 @@ def gate_commands(log: str, budget: float, no_budget: bool,
             ("serving_chaos",
              [sys.executable, "-m", "pytest",
               os.path.join(REPO_DIR, "tests", "test_serving_chaos.py"),
+              "-q", "-m", "fault and not slow",
+              "-p", "no:cacheprovider"]))
+        # fleet chaos smoke (ISSUE 11): kill 1 of 4 replicas mid-run
+        # through the ServingFleet router — zero lost or duplicated
+        # completions, failover token-identity, zero leaked pages on
+        # surviving replicas. The randomized kill/wedge/slow sweep
+        # stays in the slow tier.
+        gates.append(
+            ("fleet_chaos",
+             [sys.executable, "-m", "pytest",
+              os.path.join(REPO_DIR, "tests", "test_fleet_chaos.py"),
               "-q", "-m", "fault and not slow",
               "-p", "no:cacheprovider"]))
     if not no_serving:
