@@ -914,6 +914,114 @@ def _cb_fleet_bench(on_tpu):
     return out
 
 
+def _cb_prefix_bench(on_tpu):
+    """Shared-prefix storm (ISSUE 12): the acceptance A/B for
+    radix-tree prefix caching — N requests sharing one long prefix
+    (>= 64 requests x >= 512 prefix tokens on TPU), run COLD (cache
+    empty; it self-populates mid-run, which is exactly the production
+    cold shape) then WARM (prefix resident) on ONE engine, compiled
+    programs kept and the cache dropped in between. Reports hit rate,
+    the fraction of prefill tokens skipped, p99 TTFT cold vs warm, and
+    a token-identity check against a cache-OFF engine on the same
+    workload. BASELINE.md documents the keys."""
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig.llama_1b()
+        slots, page, chunk, max_len = 8, 32, 32, 768
+        n_req, prefix_len, tail_hi, n_new = 64, 512, 64, 32
+        prefill_chunk = 256
+    else:
+        cfg = LlamaConfig.tiny()
+        slots, page, chunk, max_len = 2, 8, 4, 48
+        n_req, prefix_len, tail_hi, n_new = 12, 24, 5, 4
+        prefill_chunk = 32
+    cfg.tensor_parallel = False
+    cfg.scan_layers = False
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+
+    rng = np.random.RandomState(55)
+    prefix = rng.randint(0, cfg.vocab_size,
+                         (prefix_len,)).astype(np.int32)
+    specs = []
+    for _ in range(n_req):
+        tail = rng.randint(0, cfg.vocab_size,
+                           (int(rng.randint(0, tail_hi)),)
+                           ).astype(np.int32)
+        specs.append((np.concatenate([prefix, tail]), n_new))
+    prompt_tokens = sum(len(p) for p, _ in specs)
+
+    def make_engine(**kw):
+        return ContinuousBatchingEngine(
+            model, num_slots=slots, page_size=page, max_len=max_len,
+            decode_chunk=chunk, prefill_chunk=prefill_chunk,
+            greedy=True, **kw)
+
+    def storm(e):
+        """One timed storm pass; returns (tok_s, p99_ttft_ms,
+        gauges, streams-by-spec-index)."""
+        e.reset_gauges()
+        t0 = time.perf_counter()
+        ids = [e.add_request(p, n) for p, n in specs]
+        done = e.run()
+        wall = max(time.perf_counter() - t0, 1e-9)
+        by = {r.request_id: r for r in done}
+        toks = sum(len(r.tokens) for r in done)
+        ttfts = sorted((by[i].t_first - by[i].t_arrive) * 1e3
+                       for i in ids if by[i].t_first)
+        p99 = ttfts[max(0, int(round(0.99 * (len(ttfts) - 1))))] \
+            if ttfts else 0.0
+        return (toks / wall, p99, e.gauges(),
+                [by[i].tokens for i in ids])
+
+    eng = make_engine()
+    eng.add_request(specs[0][0], 2)
+    eng.run()                            # warmup: compiles
+    eng.reset_prefix_cache()             # drop the warmup's pages
+    cold_tps, cold_p99, cold_g, cold_streams = storm(eng)
+    warm_tps, warm_p99, warm_g, warm_streams = storm(eng)
+    # token-identity oracle: the SAME storm, prefix cache OFF
+    off = make_engine(prefix_cache=False)
+    off.add_request(specs[0][0], 2)
+    off.run()
+    _, off_p99, _, off_streams = storm(off)
+    identical = warm_streams == off_streams \
+        and cold_streams == off_streams
+    saved_frac = warm_g["prefix_cache_tokens_saved"] / prompt_tokens
+    out = {
+        "cb_prefix_warm_tok_s": round(warm_tps, 2),
+        "cb_prefix_cold_tok_s": round(cold_tps, 2),
+        "cb_prefix_hit_rate": round(warm_g["prefix_cache_hit_rate"],
+                                    4),
+        "cb_prefix_tokens_saved_frac": round(saved_frac, 4),
+        "cb_prefix_p99_ttft_ms_warm": round(warm_p99, 2),
+        "cb_prefix_p99_ttft_ms_cold": round(cold_p99, 2),
+        "cb_prefix_p99_ttft_ms_off": round(off_p99, 2),
+        "cb_prefix_cow_forks": int(warm_g["prefix_cache_cow_forks"]),
+        "cb_prefix_identical": bool(identical),
+    }
+    print(f"# cb prefix storm: {n_req} requests x {prefix_len}-token "
+          f"shared prefix, warm {out['cb_prefix_warm_tok_s']} tok/s "
+          f"vs cold {out['cb_prefix_cold_tok_s']} (cache off: "
+          f"{off_p99:.1f}ms p99 ttft), hit rate "
+          f"{out['cb_prefix_hit_rate']}, prefill tokens saved "
+          f"{out['cb_prefix_tokens_saved_frac'] * 100:.0f}%, p99 ttft "
+          f"{out['cb_prefix_p99_ttft_ms_warm']}ms warm vs "
+          f"{out['cb_prefix_p99_ttft_ms_cold']}ms cold, "
+          f"{out['cb_prefix_cow_forks']} cow forks, greedy streams "
+          f"{'IDENTICAL' if identical else 'DIVERGED!'} vs cache-off",
+          file=sys.stderr)
+    return out
+
+
 def _moe_bench_config(on_tpu):
     """The BASELINE config-5 bench shape, shared by the MoE train
     section and the breakdown section (attribution fractions are only
@@ -1434,6 +1542,21 @@ def main():
     gc.collect()
     if cb_fleet is not None:
         record.update(cb_fleet)
+        print(json.dumps(record), flush=True)
+
+    # shared-prefix storm (ISSUE 12): the prefix-cache cold/warm A/B
+    # right after the serving sections whose capacity it multiplies
+    try:
+        cb_prefix = _timed_section(
+            "cb prefix", lambda: _retry_transient(
+                lambda: _cb_prefix_bench(on_tpu),
+                "cb prefix bench"))
+    except Exception as e:
+        print(f"# cb prefix bench failed: {e!r}", file=sys.stderr)
+        cb_prefix = None
+    gc.collect()
+    if cb_prefix is not None:
+        record.update(cb_prefix)
         print(json.dumps(record), flush=True)
 
     try:

@@ -13,7 +13,11 @@ Structure, outside-in:
   fault-tolerant ROUTER. Dispatch is least-loaded/latency-aware,
   driven by each replica's PR-9 metrics registry (outstanding
   generation work per slot as the load signal, the ``serving/ttft_ms``
-  reservoir p99 as the latency tiebreak). Admission per replica rides
+  reservoir p99 as the latency tiebreak). A **prefix-affinity hint**
+  (ISSUE 12) breaks load ties toward the replica that last served the
+  same first-page prefix-hash — its prefix cache is warm — strictly
+  below health and least-loaded, never overriding circuit-breaker
+  state. Admission per replica rides
   the PR-10 :class:`~.reliability.AdmissionController`; when EVERY
   ready replica sheds, the fleet raises
   :class:`~.reliability.Overloaded` with ``retry_after_s`` = the MAX
@@ -130,6 +134,11 @@ _pmetrics.declare("fleet/drains", "counter",
 _pmetrics.declare("fleet/scale_ups", "counter",
                   "replicas registered and warmed by scale_up before "
                   "taking router weight")
+_pmetrics.declare("fleet/affinity_hits", "counter",
+                  "requests routed to the replica that last served "
+                  "their prefix-hash (ISSUE-12 prefix-affinity hint: "
+                  "a load/health tie-break, so the hit lands on a "
+                  "warm prefix cache)")
 _pmetrics.declare("fleet/replicas_ready", "gauge",
                   "replicas currently taking router weight")
 _pmetrics.declare("fleet/failover_ms", "histogram",
@@ -272,6 +281,10 @@ class _Tracked:
     t_failed: float = 0.0
     hedged: bool = False
     hedge_rid: int | None = None
+    #: first-page token-block hash (engine page_size granularity) —
+    #: the ISSUE-12 prefix-affinity routing hint; None for prompts
+    #: shorter than one page
+    prefix_hash: int | None = None
     cancelled: bool = False
     last_error: Exception | None = None
     done: ServedRequest | None = None
@@ -322,6 +335,12 @@ class ServingFleet:
         #: the caller-owned history, exactly like engine.completed)
         self._reqs: dict[int, _Tracked] = {}
         self._next_id = 0
+        #: prefix-hash -> replica that last served it (ISSUE 12):
+        #: the router's cache-affinity memory — bounded (LRU by
+        #: insertion order) so a high-cardinality prefix stream cannot
+        #: grow it without limit
+        self._affinity: dict[int, int] = {}
+        self._affinity_cap = 4096
         self.completed: list[ServedRequest] = []
         self.metrics = _pmetrics.MetricsRegistry()
         self._h_failover = self.metrics.histogram("fleet/failover_ms")
@@ -361,6 +380,14 @@ class ServingFleet:
                       ttft_deadline_s=ttft_deadline_s,
                       deadline_s=deadline_s,
                       t_submit=time.perf_counter())
+        # prefix-affinity hint (ISSUE 12): hash the first full page's
+        # token block — requests sharing >= page_size prefix tokens
+        # carry the same hash, and the engines' prefix caches index at
+        # exactly this granularity
+        if ref is not None:
+            ps = int(getattr(ref.engine, "page_size", 0))
+            if ps and prompt.size >= ps:
+                tr.prefix_hash = hash(prompt[:ps].tobytes())
         self._assign(tr, self._make_attempt(tr))  # raises Overloaded
         self._next_id += 1   # only an accepted submission consumes an
         self._reqs[fid] = tr                # id (and is ever tracked)
@@ -375,19 +402,27 @@ class ServingFleet:
         req.t_arrive = tr.t_submit  # deadlines stay client-relative
         return req
 
-    def _candidates(self, exclude=()):
+    def _candidates(self, exclude=(), prefer=None):
         reps = [r for r in self.replicas.values()
                 if r.takes_weight() and r.id not in exclude]
-        # least outstanding work first; observed ttft p99 breaks ties
-        # (the latency-aware half of the policy); id for determinism
-        reps.sort(key=lambda r: (r.load(), r.ttft_p99_s() or 0.0,
-                                 r.id))
+        # least outstanding work first; among equally-loaded healthy
+        # replicas the prefix-affinity hint wins (the preferred
+        # replica's prefix cache is warm for this prompt), then the
+        # observed ttft p99, then id for determinism. Affinity sits
+        # strictly BELOW health (non-ready replicas — breakers open,
+        # draining — were never candidates) and below least-loaded:
+        # a warm cache never outranks an idle sibling.
+        reps.sort(key=lambda r: (r.load(),
+                                 0 if r.id == prefer else 1,
+                                 r.ttft_p99_s() or 0.0, r.id))
         return reps
 
     def _assign(self, tr, req, exclude=()):
         """Admit one attempt on the best replica that will take it;
         raises :class:`Overloaded` with the fleet-wide retry-after."""
-        cands = self._candidates(exclude)
+        h = tr.prefix_hash
+        prefer = self._affinity.get(h) if h is not None else None
+        cands = self._candidates(exclude, prefer=prefer)
         if not cands:
             self.metrics.counter("fleet/shed_rejections").inc()
             raise Overloaded(
@@ -402,6 +437,16 @@ class ServingFleet:
                 continue
             tr.attempts[rep.id] = req
             tr.t_assign = time.perf_counter()
+            if h is not None:
+                if rep.id == prefer:
+                    self.metrics.counter("fleet/affinity_hits").inc()
+                # pop-then-insert moves a re-served prefix to the
+                # dict's end, so the cap evicts the LEAST recently
+                # used hash, not the hottest long-lived one
+                self._affinity.pop(h, None)
+                self._affinity[h] = rep.id
+                if len(self._affinity) > self._affinity_cap:
+                    self._affinity.pop(next(iter(self._affinity)))
             return rep.id
         self.metrics.counter("fleet/shed_rejections").inc()
         raise Overloaded(
@@ -859,6 +904,7 @@ class ServingFleet:
             "retries": c("fleet/retries"),
             "requeued": c("fleet/requeued"),
             "hedges": c("fleet/hedges"),
+            "affinity_hits": c("fleet/affinity_hits"),
             "hedge_wins": c("fleet/hedge_wins"),
             "hedge_cancels": c("fleet/hedge_cancels"),
             "breaker_open": c("fleet/breaker_open"),

@@ -342,7 +342,10 @@ class EngineSupervisor:
         "preempt_evictions", "preempt_recompute_tokens",
         "requests_cancelled", "deadline_expired", "shed_rejections",
         "quarantined", "containments", "tokens_emitted", "prefills",
-        "requests_completed", "chunks_dispatched", "unified_steps")
+        "requests_completed", "chunks_dispatched", "unified_steps",
+        "prefix_cache_hits", "prefix_cache_misses",
+        "prefix_cache_tokens_saved", "prefix_cache_evictions",
+        "prefix_cache_cow_forks")
 
     def gauges(self):
         """The live engine's gauges, with monotonic counters summed
@@ -351,6 +354,14 @@ class EngineSupervisor:
         g = dict(self.engine.gauges())
         for k, v in self._carried.items():
             g[k] = g.get(k, 0) + v
+        # derived ratios must agree with the summed counters they
+        # summarize (the live engine's local ratio contradicts the
+        # carried hits/misses after a restart)
+        if "prefix_cache_hit_rate" in g:
+            tot = g.get("prefix_cache_hits", 0) \
+                + g.get("prefix_cache_misses", 0)
+            g["prefix_cache_hit_rate"] = \
+                g.get("prefix_cache_hits", 0) / tot if tot else 0.0
         return g
 
     def has_work(self):

@@ -33,6 +33,17 @@ Shared structure:
 - The KV cache is a global PAGE POOL per layer ([KVH, num_pages,
   page_size, D]); each admitted request owns a page list (its block
   table row). Page 0 is a reserved trash page for drained slots.
+- PREFIX CACHE (ISSUE 12, default on): completed prefills publish
+  their full prompt pages into a radix index keyed by token blocks at
+  ``page_size`` granularity; an admitted request whose prompt prefix
+  is resident ATTACHES the existing physical pages (refcounted,
+  read-shared) and chunk-prefills only its unseen suffix — a fully-
+  cached prompt COW-forks the last shared page to recompute its final
+  token's logits. Eviction is refcount-aware LRU over unreferenced
+  cache pages, composed with the deferred-free discipline below; the
+  ``PADDLE_TPU_SERVING_AUDIT`` invariant extends to shared pages
+  (free + private + cache + deferred + trash == num_pages, refcounts
+  exact).
 - A fixed number of SLOTS (the batch dimension) keeps every compiled
   shape static. Admission = host-side: allocate pages from the free
   list and mark the slot PREFILLING.
@@ -168,6 +179,25 @@ _pmetrics.declare("serving/shed_rejections", "counter",
 _pmetrics.declare("serving/shed_retry_after_s", "gauge",
                   "retry-after seconds attached to the most recent "
                   "Overloaded rejection")
+# ISSUE 12 prefix-cache vocabulary: shared-prefix reuse is the serving
+# capacity story, so its economics are first-class metrics
+_pmetrics.declare("serving/prefix_cache_hits", "counter",
+                  "admissions that attached >=1 cached prefix page "
+                  "(suffix-only prefill)")
+_pmetrics.declare("serving/prefix_cache_misses", "counter",
+                  "admissions that found no cached prefix page")
+_pmetrics.declare("serving/prefix_cache_tokens_saved", "counter",
+                  "prompt tokens whose prefill was skipped by "
+                  "attaching cached prefix pages")
+_pmetrics.declare("serving/prefix_cache_evictions", "counter",
+                  "unreferenced cache pages reclaimed by the "
+                  "refcount-aware LRU under allocation pressure")
+_pmetrics.declare("serving/prefix_cache_cow_forks", "counter",
+                  "copy-on-write page forks (a sequence had to write "
+                  "into a fully-shared page)")
+_pmetrics.declare("serving/prefix_cache_pages", "gauge",
+                  "physical pages currently owned by the prefix-cache "
+                  "radix index (referenced + evictable)")
 
 #: the historical ``_stats`` key set, preserved verbatim — now backed
 #: by ``serving/*`` registry counters
@@ -180,7 +210,11 @@ _STAT_KEYS = ("chunks", "chunk_slot_steps", "active_slot_steps",
               "preempt_evictions", "preempt_pages_reclaimed",
               "preempt_recompute_tokens", "requests_cancelled",
               "deadline_ttft_expired", "deadline_total_expired",
-              "quarantined", "containments", "shed_rejections")
+              "quarantined", "containments", "shed_rejections",
+              # ISSUE-12 prefix-cache counters
+              "prefix_cache_hits", "prefix_cache_misses",
+              "prefix_cache_tokens_saved", "prefix_cache_evictions",
+              "prefix_cache_cow_forks")
 
 
 class _StatsView:
@@ -212,6 +246,40 @@ class _StatsView:
 
     def as_dict(self):
         return {k: c.value for k, c in self._c.items()}
+
+
+class _PrefixCacheNode:
+    """One cached FULL KV page of a token prefix (ISSUE 12): a node of
+    the radix index over prompt-token blocks at ``page_size``
+    granularity. The tree position encodes the whole prefix — two
+    sequences reach the same node iff their first ``depth *
+    page_size`` tokens are identical, so a node's page content
+    (KV for those positions) is exact by construction, not
+    probabilistic. ``ref`` counts slots currently attached
+    (read-sharing the page); 0 means resident-but-evictable. The
+    refcount chain is monotone root→leaf (every attachment references
+    a contiguous prefix from the root), which is what makes
+    leaf-first LRU eviction safe: a ref-0 node's whole subtree is
+    ref-0."""
+
+    __slots__ = ("key", "page", "parent", "children", "ref", "stamp")
+
+    def __init__(self, key, page, parent):
+        self.key = key          # the page's token block (bytes)
+        self.page = page        # physical page id it owns
+        self.parent = parent
+        self.children = {}      # token-block bytes -> child node
+        self.ref = 0            # attached readers (slots)
+        self.stamp = 0          # LRU clock (engine _pc_clock)
+
+
+#: copy-on-write fork: duplicate one physical page across EVERY
+#: layer's k/v pool in ONE compiled dispatch (dst becomes a private
+#: writable copy of the shared src) — per-pool launches would put
+#: 2 x num_layers sequential dispatches on the TTFT-critical
+#: admission path.
+_pc_copy_page = jax.jit(lambda pools, src, dst:
+                        [p.at[:, dst].set(p[:, src]) for p in pools])
 
 
 @dataclass(eq=False)
@@ -281,7 +349,8 @@ class ContinuousBatchingEngine:
                  seed=0, prefill_chunk=None, admit_batch=None,
                  adaptive_chunk=True, unified=True,
                  trace_sample_rate=0.01, latency_reservoir=2048,
-                 max_strikes=2, max_containments=8, audit=None):
+                 max_strikes=2, max_containments=8, audit=None,
+                 prefix_cache=None):
         self.model = model
         cfg = model.config
         self.cfg = cfg
@@ -433,6 +502,20 @@ class ContinuousBatchingEngine:
         from ..profiler import _env_bool
         self._audit = _env_bool("PADDLE_TPU_SERVING_AUDIT") \
             if audit is None else bool(audit)
+        # ---- prefix cache (ISSUE 12) ---------------------------------
+        # radix index over FULL pages of prompt-token blocks: an
+        # admitted request whose prompt prefix is resident attaches
+        # the existing physical pages (refcounted, read-shared) and
+        # only prefills its unseen suffix. Default ON; the env knob
+        # or prefix_cache=False restores exclusive-page behavior.
+        self._prefix_cache = _env_bool("PADDLE_TPU_PREFIX_CACHE", True) \
+            if prefix_cache is None else bool(prefix_cache)
+        self._pc_root = _PrefixCacheNode(None, 0, None)   # sentinel
+        self._pc_nodes: dict[int, _PrefixCacheNode] = {}  # page -> node
+        self._pc_clock = 0                                # LRU stamps
+        #: per-slot attached cache nodes, in table-row order — the
+        #: slot's block table is [shared pages..., private pages...]
+        self.slot_shared: list[list] = [[] for _ in range(B)]
         self._prefill_fn = None        # legacy: ONE prefill signature
         self._chunk_fns = {}           # legacy: chunk len -> program
         self._compiled = set()         # distinct compiled signatures
@@ -460,6 +543,8 @@ class ContinuousBatchingEngine:
         self._h_itl = self.metrics.histogram(
             "serving/itl_ms", capacity=int(latency_reservoir))
         self._g_overhead = self.metrics.gauge("obs/overhead_frac")
+        self._g_pc_pages = self.metrics.gauge(
+            "serving/prefix_cache_pages")
         # observability self-measurement: seconds spent inside
         # instrumentation on the hot path (gauges()["obs_overhead_frac"]
         # = _obs_s / run_seconds; pinned < 2% by test)
@@ -916,6 +1001,12 @@ class ContinuousBatchingEngine:
         self.slot_eos[:] = -1
         self.slot_req = [None] * B
         self.slot_pages = [[] for _ in range(B)]
+        # the rebuilt pools are zeroed, so every cached page's content
+        # is gone with them: drop the whole radix index (its pages are
+        # already back in the rebuilt free list)
+        self.slot_shared = [[] for _ in range(B)]
+        self._pc_root = _PrefixCacheNode(None, 0, None)
+        self._pc_nodes = {}
         self._slot_prompt = [None] * B
         self._prefilling[:] = False
         self._prefill_off[:] = 0
@@ -1152,6 +1243,9 @@ class ContinuousBatchingEngine:
                     self._act_since[slot] = self._seq
                     self._pred_ctx[slot] = min(
                         int(self.limits[slot]), tl + self._n_decode)
+                    # the prompt's full pages are final now (decode
+                    # writes land past tl): publish them for sharing
+                    self._pc_insert(slot)
                     emits[slot] = True
             elif self.active[slot] \
                     and self.limits[slot] > self._pred_ctx[slot]:
@@ -1268,6 +1362,20 @@ class ContinuousBatchingEngine:
             "shed_rejections": s["shed_rejections"],
             "quarantined": s["quarantined"],
             "containments": s["containments"],
+            # prefix-cache economics (ISSUE 12): the shared-prefix
+            # capacity story — hit rate, prefill tokens skipped, COW
+            # forks and LRU evictions, plus current residency
+            "prefix_cache_hits": s["prefix_cache_hits"],
+            "prefix_cache_misses": s["prefix_cache_misses"],
+            "prefix_cache_hit_rate": (
+                s["prefix_cache_hits"]
+                / (s["prefix_cache_hits"] + s["prefix_cache_misses"]))
+            if s["prefix_cache_hits"] + s["prefix_cache_misses"]
+            else 0.0,
+            "prefix_cache_tokens_saved": s["prefix_cache_tokens_saved"],
+            "prefix_cache_evictions": s["prefix_cache_evictions"],
+            "prefix_cache_cow_forks": s["prefix_cache_cow_forks"],
+            "prefix_cache_pages": len(self._pc_nodes),
         }
 
     def reset_gauges(self):
@@ -1287,6 +1395,7 @@ class ContinuousBatchingEngine:
         self._g_overhead.set(
             (self._obs_s / s["run_seconds"]) if s["run_seconds"]
             else 0.0)
+        self._g_pc_pages.set(len(self._pc_nodes))
         from ..profiler.trace import get_tracer
         tr = get_tracer()
         if tr.enabled:
@@ -1299,6 +1408,19 @@ class ContinuousBatchingEngine:
     # ---- admission / chunked batched prefill -----------------------------
 
     def _alloc_pages(self, n):
+        if len(self._free_pages) < n and self._pc_nodes:
+            # allocation pressure: reclaim unreferenced cache pages
+            # (refcount-aware LRU) before declaring scarcity — a warm
+            # cache must never deny admission the cold pool would
+            # grant. The shortfall counts pages already deferred
+            # behind the in-flight harvest (including this method's
+            # own earlier evictions): they WILL arrive, so evicting
+            # more cache for the same request would just destroy warm
+            # entries a pipeline-depth wait is about to make moot.
+            deferred = sum(len(p) for _, p in self._deferred_free)
+            short = n - len(self._free_pages) - deferred
+            if short > 0:
+                self._pc_evict(short)
         if len(self._free_pages) < n:
             return None
         return [self._free_pages.popleft() for _ in range(n)]
@@ -1333,24 +1455,191 @@ class ContinuousBatchingEngine:
         self._deferred_free = keep
 
     def _audit_pages(self, where):
-        """PADDLE_TPU_SERVING_AUDIT invariant: every page lives in
-        exactly one place — the free list, an occupied slot's list, the
-        deferred-reclamation set, or the reserved trash page 0."""
+        """PADDLE_TPU_SERVING_AUDIT invariant, extended to shared
+        pages (ISSUE 12): every page lives in exactly one place — the
+        free list, an occupied slot's PRIVATE list, the prefix-cache
+        index (refcount-unique: one physical page per node, however
+        many slots read it), the deferred-reclamation set, or the
+        reserved trash page 0 — and every cache node's refcount equals
+        its live slot attachments (>= 1 for every referenced page, 0
+        exactly for evictable residents; free-list pages have no node
+        at all)."""
         if not self._audit:
             return
         held = [p for pages in self.slot_pages for p in pages]
+        cached = list(self._pc_nodes)
         deferred = [p for _, pages in self._deferred_free
                     for p in pages]
-        allp = list(self._free_pages) + held + deferred
+        allp = list(self._free_pages) + held + cached + deferred
         if len(allp) + 1 != self.num_pages \
                 or len(set(allp)) != len(allp) or 0 in allp:
             raise AssertionError(
                 f"serving page accounting broken at {where}: "
                 f"free={len(self._free_pages)} held={len(held)} "
-                f"deferred={len(deferred)} (+1 trash) != "
-                f"{self.num_pages} pages, "
+                f"cached={len(cached)} deferred={len(deferred)} "
+                f"(+1 trash) != {self.num_pages} pages, "
                 f"dupes={len(allp) - len(set(allp))}, "
                 f"trash_leaked={0 in allp}")
+        refs: dict[int, int] = {}
+        for nodes in self.slot_shared:
+            for node in nodes:
+                refs[node.page] = refs.get(node.page, 0) + 1
+        for node in self._pc_nodes.values():
+            expect = refs.get(node.page, 0)
+            if node.ref != expect or node.ref < 0:
+                raise AssertionError(
+                    f"prefix-cache refcount broken at {where}: page "
+                    f"{node.page} ref={node.ref} but {expect} live "
+                    f"attachment(s)")
+            if node.parent is not self._pc_root \
+                    and node.parent.ref < node.ref:
+                raise AssertionError(
+                    f"prefix-cache chain broken at {where}: page "
+                    f"{node.page} ref={node.ref} exceeds parent page "
+                    f"{node.parent.page} ref={node.parent.ref}")
+        for page in refs:
+            if page not in self._pc_nodes:
+                raise AssertionError(
+                    f"prefix-cache attachment to unindexed page "
+                    f"{page} at {where}")
+
+    # ---- prefix cache: radix index + COW sharing (ISSUE 12) --------------
+
+    def _pc_match(self, eff):
+        """Longest cached full-page prefix of the admission prompt:
+        walk the radix index block by block (``page_size`` tokens per
+        level). Returns the matched node chain, root excluded."""
+        if not self._prefix_cache:
+            return []
+        nodes, cur, ps = [], self._pc_root, self.page_size
+        for i in range(len(eff) // ps):
+            child = cur.children.get(eff[i * ps:(i + 1) * ps].tobytes())
+            if child is None:
+                break
+            nodes.append(child)
+            cur = child
+        return nodes
+
+    def _pc_pin(self, nodes):
+        """Incref a matched chain (attach / pin against eviction)."""
+        self._pc_clock += 1
+        for node in nodes:
+            node.ref += 1
+            node.stamp = self._pc_clock
+
+    def _pc_unpin(self, nodes):
+        self._pc_clock += 1
+        for node in nodes:
+            node.ref -= 1
+            node.stamp = self._pc_clock
+
+    def _pc_detach(self, slot):
+        """Drop a slot's shared-page attachments (drain/evict): decref
+        only — the pages stay resident in the index, evictable once
+        unreferenced (that residency IS the cache)."""
+        if self.slot_shared[slot]:
+            self._pc_unpin(self.slot_shared[slot])
+            self.slot_shared[slot] = []
+
+    def _pc_insert(self, slot):
+        """Publish a slot's full prompt pages into the radix index at
+        prefill completion: ownership moves page-by-page from the
+        slot's private list to new cache nodes (the slot stays
+        attached as a reader, so the refcount starts at 1). A level
+        another slot published first keeps this slot's duplicate page
+        private (it dies at drain) — re-pointing a live block table
+        mid-flight is never worth the race. Safe against the async
+        dispatch: a later attacher's program consumes this program's
+        output pools, so the writes are ordered by data dependency."""
+        if not self._prefix_cache:
+            return
+        eff = self._slot_prompt[slot]
+        ps = self.page_size
+        shared = self.slot_shared[slot]
+        cur = shared[-1] if shared else self._pc_root
+        self._pc_clock += 1
+        for lvl in range(len(shared), len(eff) // ps):
+            if not self.slot_pages[slot]:
+                break
+            key = eff[lvl * ps:(lvl + 1) * ps].tobytes()
+            if key in cur.children:
+                break
+            page = self.slot_pages[slot].pop(0)
+            node = _PrefixCacheNode(key, page, cur)
+            node.ref = 1
+            node.stamp = self._pc_clock
+            cur.children[key] = node
+            self._pc_nodes[page] = node
+            shared.append(node)
+            cur = node
+
+    def _pc_evictable(self):
+        """Pages the LRU could reclaim right now (ref-0 nodes; the
+        monotone refcount chain makes every one reachable leaf-first)."""
+        return sum(1 for n in self._pc_nodes.values() if n.ref == 0)
+
+    def _pc_evict(self, n_pages):
+        """Reclaim up to ``n_pages`` from unreferenced cache entries,
+        LRU-first among childless ref-0 nodes (leaves first — an
+        interior node never outlives its children, keeping every
+        root-contiguous chain matchable). Freed pages ride the same
+        deferred-release discipline as any reclaimed page: an
+        in-flight program dispatched while a since-drained reader was
+        attached may still READ them, so they only re-enter the free
+        list once every fetched program has been harvested."""
+        import heapq
+        freed = []
+        # one snapshot + a heap instead of a rescan per victim: no
+        # admission runs inside this call, so nodes only change state
+        # through our own evictions — a parent joins the heap exactly
+        # when its last child is freed
+        heap = [(n.stamp, n.page) for n in self._pc_nodes.values()
+                if n.ref == 0 and not n.children]
+        heapq.heapify(heap)
+        while heap and len(freed) < n_pages:
+            _, page = heapq.heappop(heap)
+            victim = self._pc_nodes.get(page)
+            if victim is None or victim.ref or victim.children:
+                continue
+            del victim.parent.children[victim.key]
+            del self._pc_nodes[page]
+            freed.append(page)
+            parent = victim.parent
+            if parent is not self._pc_root and parent.ref == 0 \
+                    and not parent.children:
+                heapq.heappush(heap, (parent.stamp, parent.page))
+        if freed:
+            self._stats.inc("prefix_cache_evictions", len(freed))
+            self._release_pages(freed)
+        return len(freed)
+
+    def _pc_cow(self, src, dst):
+        """Copy-on-write fork: duplicate one physical page across
+        every layer's k/v pool so ``dst`` becomes a private writable
+        copy of the shared ``src``. Functional pool update — the copy
+        chains after every dispatched program in the device stream,
+        exactly like admission's table/ctx updates, so it reads the
+        prefix owner's completed writes and is visible to every later
+        program."""
+        s, d = jnp.int32(src), jnp.int32(dst)
+        self.pools = [Tensor(a) for a in _pc_copy_page(
+            [p._data for p in self.pools], s, d)]
+        self._stats.inc("prefix_cache_cow_forks")
+
+    @property
+    def prefix_cache_pages(self):
+        """Physical pages currently owned by the prefix-cache index
+        (referenced + evictable) — the tests' page-accounting term."""
+        return len(self._pc_nodes)
+
+    def reset_prefix_cache(self):
+        """Drop every UNREFERENCED cache entry (the bench cold/warm
+        A/B resets without rebuilding the engine and recompiling its
+        programs). Referenced entries stay — their readers are live.
+        Returns the number of pages reclaimed."""
+        n = self._pc_evict(len(self._pc_nodes))
+        self._audit_pages("reset_prefix_cache")
+        return n
 
     def _admission_key(self, req):
         # higher priority first; FIFO (arrival time, then id) within a
@@ -1431,6 +1720,7 @@ class ContinuousBatchingEngine:
         additionally deactivates the slot's DEVICE mirrors: needed on
         eviction, where the device still believes the slot is active;
         a drained slot already went inactive inside its program."""
+        self._pc_detach(slot)        # shared pages: decref, stay cached
         self.slot_pages[slot] = []
         self.slot_req[slot] = None
         self._slot_prompt[slot] = None
@@ -1491,7 +1781,8 @@ class ContinuousBatchingEngine:
         victims.sort(key=lambda s: (self.slot_req[s].priority,
                                     -self.slot_req[s].t_admit))
         projected = len(self._free_pages) + sum(
-            len(p) for _, p in self._deferred_free)
+            len(p) for _, p in self._deferred_free) \
+            + self._pc_evictable()
         # feasibility first: if evicting EVERY victim still cannot
         # reach ``need``, evict none — destroying in-flight progress
         # with no admission to show for it is pure waste
@@ -1583,51 +1874,93 @@ class ContinuousBatchingEngine:
             gen = len(req.tokens)
             remaining = req.max_new_tokens - gen
             eff_len = req.prompt.size + gen
-            need = -(-(eff_len + remaining) // self.page_size)
+            need_total = -(-(eff_len + remaining) // self.page_size)
             slot = next((s for s in range(self.num_slots)
                          if self.slot_req[s] is None
                          and not self.active[s]), None)
+            if slot is None and not self._has_priorities:
+                return   # no slot and nobody to preempt: skip the
+                         # O(prompt) replay-concat + radix-match work
+                         # this turn would throw away
+            if gen:
+                # recompute re-admission: prompt + generated tokens
+                # stream back through prefill (token-identical replay)
+                eff = np.concatenate(
+                    [req.prompt,
+                     np.asarray(req.tokens, np.int32)])
+            else:
+                eff = req.prompt
+            # cached-prefix fast path (ISSUE 12): match BEFORE the
+            # page-need computation — shared pages are attached, not
+            # allocated, so a warm cache admits deeper than the cold
+            # pool would. The match is PINNED (incref) before any
+            # allocation so the LRU cannot reclaim it mid-admission.
+            shared = self._pc_match(eff)
+            # copy-on-write case: the WHOLE admission prompt is
+            # cached, but at least the last token must re-prefill to
+            # produce logits — its write lands inside the last shared
+            # page, so that page is forked to a private copy
+            cow = bool(shared) \
+                and len(shared) * self.page_size >= len(eff)
+            start = len(eff) - 1 if cow \
+                else len(shared) * self.page_size
+            need = need_total - len(shared) + (1 if cow else 0)
+            self._pc_pin(shared)
             if slot is None:
-                if not (self._has_priorities
-                        and self._preempt_for(req, need,
-                                              need_slot=True)):
+                if not self._preempt_for(req, need, need_slot=True):
+                    self._pc_unpin(shared)
                     return
                 slot = next((s for s in range(self.num_slots)
                              if self.slot_req[s] is None
                              and not self.active[s]), None)
                 if slot is None:
+                    self._pc_unpin(shared)
                     return
             pages = self._alloc_pages(need)
             if pages is None and self._has_priorities \
                     and self._preempt_for(req, need):
                 pages = self._alloc_pages(need)
             if pages is None:
+                self._pc_unpin(shared)
                 return   # reclaimed pages still deferred behind the
                          # in-flight harvest (or pure overload): the
                          # candidate stays queued, admit next turn
+            attach = shared
+            if cow:
+                fork = shared[-1]
+                self._pc_cow(fork.page, pages[0])
+                self._pc_unpin([fork])
+                attach = shared[:-1]
+            if self._prefix_cache:
+                self._stats.inc("prefix_cache_hits" if start
+                                else "prefix_cache_misses")
+                if start:
+                    self._stats.inc("prefix_cache_tokens_saved", start)
             self.queue.remove(req)
             if gen:
-                # recompute re-admission: prompt + generated tokens
-                # stream back through prefill (token-identical replay)
                 self._stats.inc("preempt_recompute_tokens", gen)
-                eff = np.concatenate(
-                    [req.prompt,
-                     np.asarray(req.tokens, np.int32)])
-            else:
-                eff = req.prompt
-            self._stage_slot(slot, req, pages, eff, remaining)
+            self._stage_slot(slot, req, pages, eff, remaining,
+                             attach=attach, start=start)
         return
 
-    def _stage_slot(self, slot, req, pages, eff, remaining):
+    def _stage_slot(self, slot, req, pages, eff, remaining,
+                    attach=(), start=0):
         """Bind an admitted request to a slot: block-table row, device
         mirrors, prefill progress. ``eff`` is the admission prompt
         (original prompt + recompute replay tokens), ``remaining`` the
-        generation budget left."""
+        generation budget left. ``attach`` is the cached-prefix node
+        chain (already pinned) whose pages head the block table;
+        ``start`` is the cached prefix length in tokens — prefill
+        resumes there, indistinguishable from a slot that already
+        streamed ``start`` tokens (chunked prefill always supported
+        arbitrary offsets; sharing only redirects the table)."""
         tl = len(eff)
         self.slot_pages[slot] = pages
+        self.slot_shared[slot] = list(attach)
         self._slot_prompt[slot] = eff
         row = np.zeros((self.pages_per_slot,), np.int32)
-        row[:len(pages)] = pages
+        row[:len(attach)] = [n.page for n in attach]
+        row[len(attach):len(attach) + len(pages)] = pages
         self.tables[slot] = row
         self._dev_tbl = self._dev_tbl.at[slot].set(jnp.asarray(row))
         req.t_admit = time.perf_counter()
@@ -1645,16 +1978,16 @@ class ContinuousBatchingEngine:
                         overlapped=self._overlap_admission)
         _frec.record_event("admit", slot=slot,
                            req=req.request_id, prompt_len=tl,
-                           queued=len(self.queue))
+                           cached=int(start), queued=len(self.queue))
         self._obs_s += time.perf_counter() - _t_obs
         self.slot_req[slot] = req
         self._prefilling[slot] = True
-        self._prefill_off[slot] = 0
+        self._prefill_off[slot] = start
         self._emits_inflight[slot] = 0
         self._act_target[slot] = remaining > 1
-        self.ctx[slot] = 0
-        self._pred_ctx[slot] = 0
-        self._dev_ctx = self._dev_ctx.at[slot].set(0)
+        self.ctx[slot] = start
+        self._pred_ctx[slot] = start
+        self._dev_ctx = self._dev_ctx.at[slot].set(int(start))
         self.slot_eos[slot] = -1 if req.eos_token_id is None \
             else int(req.eos_token_id)
         # ctx counts CACHE entries; one generated token is always
@@ -1786,6 +2119,8 @@ class ContinuousBatchingEngine:
                 # DEVICE at the next chunk's entry; only the structural
                 # one-token case is known host-side now
                 self.active[slot] = bool(self._act_target[slot])
+                # prompt pages final: publish for prefix sharing
+                self._pc_insert(slot)
             waves += 1
 
     # ---- chunked decode --------------------------------------------------

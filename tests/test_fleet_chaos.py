@@ -88,9 +88,11 @@ def _assert_fleet_clean(fleet, done, fids, specs,
         if not rep.live():
             continue            # ejected/retired engines are discarded
         eng = rep.engine
-        assert len(eng._free_pages) == eng.num_pages - 1, rep.id
+        assert len(eng._free_pages) + eng.prefix_cache_pages \
+            == eng.num_pages - 1, rep.id
         assert not eng._deferred_free
         assert all(not p for p in eng.slot_pages)
+        assert all(not s for s in eng.slot_shared)
 
 
 @pytest.mark.fault

@@ -159,8 +159,13 @@ def test_wedged_replica_ejected_by_no_progress():
     normally)."""
     prompts = _prompts(3, 4, lo=6, hi=7)
     specs = [(p, 5) for p in prompts]
+    # hedging OFF (hedge_delay_s huge): in a warm process the p99-
+    # derived hedge fires first and RESCUES the wedged replica's
+    # requests before the no-progress clock reaches 5 — fine behavior,
+    # but this test pins the EJECTION path specifically
     fleet = ServingFleet(_factory(), num_replicas=2,
-                         no_progress_turns=5, retry_backoff_s=0.01)
+                         no_progress_turns=5, retry_backoff_s=0.01,
+                         hedge_delay_s=1e9)
     fids = [fleet.submit(p, n) for p, n in specs]
     with FaultInjector() as fi:
         fi.wedge_replica(0, times=10_000)

@@ -32,8 +32,9 @@ def test_known_gates_are_registered():
         sys.path.pop(0)
     assert names == ["atomic_writes", "metric_names",
                      "fast_tier_budget", "elastic_chaos",
-                     "serving_chaos", "fleet_chaos",
+                     "serving_chaos", "fleet_chaos", "prefix_cache",
                      "serving_parity", "fused_parity"]
+    assert len(names) == 9     # ISSUE-12 pin: 9 gates, none dropped
 
 
 def test_all_gates_pass_on_healthy_log(tmp_path):
@@ -52,6 +53,7 @@ def test_all_gates_pass_on_healthy_log(tmp_path):
     assert "elastic_chaos" not in p.stdout
     assert "serving_chaos" not in p.stdout
     assert "fleet_chaos" not in p.stdout
+    assert "prefix_cache" not in p.stdout
     assert "serving_parity" not in p.stdout
     assert "fused_parity" not in p.stdout
     assert "all gates passed" in p.stdout
@@ -69,6 +71,7 @@ def test_full_driver_including_chaos_gate(tmp_path):
     assert "elastic_chaos: PASS" in p.stdout
     assert "serving_chaos: PASS" in p.stdout
     assert "fleet_chaos: PASS" in p.stdout
+    assert "prefix_cache: PASS" in p.stdout
     assert "serving_parity: PASS" in p.stdout
     assert "fused_parity: PASS" in p.stdout
     assert "all gates passed" in p.stdout

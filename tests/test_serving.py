@@ -71,7 +71,11 @@ def test_mixed_length_streams_more_requests_than_slots():
     by_id = {r.request_id: r for r in done}
     for rid, ref in zip(ids, refs):
         assert by_id[rid].tokens == ref, (rid, by_id[rid].tokens, ref)
-    # every page returned to the pool
+    # every page returned to the pool or resident (unreferenced) in
+    # the prefix cache — the ISSUE-12 accounting: free + cached is the
+    # reusable capacity, and dropping the cache restores the free list
+    assert len(eng._free_pages) + eng.prefix_cache_pages == free_before
+    eng.reset_prefix_cache()
     assert len(eng._free_pages) == free_before
     assert not eng.active.any()
 

@@ -67,9 +67,12 @@ def _assert_recovered(sup, offered, done):
         else:
             assert r.finish_reason in ("eos", "length")
     eng = sup.engine
-    assert len(eng._free_pages) == eng.num_pages - 1
+    # free + prefix-cache-resident = every allocatable page (ISSUE 12)
+    assert len(eng._free_pages) + eng.prefix_cache_pages \
+        == eng.num_pages - 1
     assert not eng._deferred_free
     assert all(not p for p in eng.slot_pages)
+    assert all(not s for s in eng.slot_shared)
 
 
 @pytest.mark.fault
@@ -157,7 +160,8 @@ def test_overload_survival_no_stall_4x():
     by = {r.request_id: r for r in done}
     assert sorted(by) == sorted(ids)
     assert all(r.error is None for r in done)
-    assert len(eng._free_pages) == eng.num_pages - 1
+    assert len(eng._free_pages) + eng.prefix_cache_pages \
+        == eng.num_pages - 1
 
 
 @pytest.mark.fault

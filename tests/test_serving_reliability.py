@@ -78,10 +78,13 @@ def _prompts(seed, shapes):
 
 
 def _assert_balanced(eng):
-    assert len(eng._free_pages) == eng.num_pages - 1, (
-        len(eng._free_pages), eng.num_pages)
+    # free + prefix-cache-resident = every allocatable page (ISSUE 12)
+    assert len(eng._free_pages) + eng.prefix_cache_pages \
+        == eng.num_pages - 1, (
+        len(eng._free_pages), eng.prefix_cache_pages, eng.num_pages)
     assert not eng._deferred_free
     assert all(not p for p in eng.slot_pages)
+    assert all(not s for s in eng.slot_shared)
 
 
 # ---------------------------------------------------------------------------

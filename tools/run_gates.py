@@ -22,9 +22,9 @@ Usage::
 
 ``--no-budget`` skips the fast-tier budget gate for contexts where no
 tier-1 log exists (e.g. pre-commit on a docs change); ``--no-chaos``
-skips the three chaos smokes (elastic kill-and-resume, serving
-overload/poison recovery, fleet replica kill/failover); the
-atomic-write gate always runs.
+skips the four chaos smokes (elastic kill-and-resume, serving
+overload/poison recovery, fleet replica kill/failover, prefix-cache
+shared-page storm); the atomic-write gate always runs.
 
 Exit codes: 0 = every gate passed, 1 = at least one gate failed.
 """
@@ -95,6 +95,19 @@ def gate_commands(log: str, budget: float, no_budget: bool,
             ("fleet_chaos",
              [sys.executable, "-m", "pytest",
               os.path.join(REPO_DIR, "tests", "test_fleet_chaos.py"),
+              "-q", "-m", "fault and not slow",
+              "-p", "no:cacheprovider"]))
+        # prefix-cache chaos smoke (ISSUE 12): a shared-prefix storm
+        # with mid-run preemptions + cancellations + injected faults
+        # through the supervised stack, page-accounting audit on —
+        # shared pages never double-free or leak, clean streams stay
+        # token-identical to the cache-off oracle. The randomized
+        # sweep stays in the slow tier.
+        gates.append(
+            ("prefix_cache",
+             [sys.executable, "-m", "pytest",
+              os.path.join(REPO_DIR, "tests",
+                           "test_prefix_cache_chaos.py"),
               "-q", "-m", "fault and not slow",
               "-p", "no:cacheprovider"]))
     if not no_serving:
