@@ -826,6 +826,7 @@ def _cb_fleet_bench(on_tpu):
     import paddle_tpu as paddle
     from paddle_tpu.inference import ContinuousBatchingEngine, ServingFleet
     from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.profiler.slo import SLORule
     from paddle_tpu.testing import FaultInjector
 
     if on_tpu:
@@ -872,8 +873,18 @@ def _cb_fleet_bench(on_tpu):
     single_toks = sum(len(r.tokens) for r in sdone)
     single_tps = single_toks / single_wall
 
-    fleet = ServingFleet(factory, num_replicas=4, max_restarts=1,
-                         retry_backoff_s=0.01)
+    # per-tenant SLO accounting (ISSUE 13): two synthetic tenants, a
+    # generous TTFT objective (the kill + failover must not break it)
+    # and a delivery-success objective — the record stamps worst
+    # attainment + alerts fired so the regression sentinel can gate on
+    # "we kept our SLOs through the chaos", not just raw tok/s
+    fleet = ServingFleet(
+        factory, num_replicas=4, max_restarts=1,
+        retry_backoff_s=0.01,
+        slo_rules=[SLORule("ttft", kind="ttft", threshold_ms=60_000,
+                           target=0.9, min_events=5),
+                   SLORule("success", kind="success", target=0.9,
+                           min_events=5)])
     # warm every replica outside the timed region (compiles)
     for rep in fleet.replicas.values():
         fleet._warm(rep)
@@ -883,7 +894,8 @@ def _cb_fleet_bench(on_tpu):
         # restart, budget exhaustion, breaker, failover — all inside
         # the timed region (the cost IS the metric)
         fi.kill_replica(1, times=10_000, after_steps=kill_after)
-        fids = [fleet.submit(p, n) for p, n in specs]
+        fids = [fleet.submit(p, n, tenant=f"tenant{i % 2}")
+                for i, (p, n) in enumerate(specs)]
         done = fleet.run()
     wall = max(time.perf_counter() - t0, 1e-9)
     by = {r.request_id: r for r in done}
@@ -894,12 +906,19 @@ def _cb_fleet_bench(on_tpu):
     p99 = ttfts[max(0, int(round(0.99 * (len(ttfts) - 1))))] \
         if ttfts else 0.0
     g = fleet.gauges()
+    slo = fleet.slo.summary()
     out = {
         "cb_fleet_tok_s": round(toks / wall, 2),
         "cb_fleet_p99_ttft_ms": round(p99, 2),
         "cb_fleet_failover_ms": round(g["failover_ms_p99"], 2),
         "cb_fleet_vs_single": round(toks / wall / single_tps, 4)
         if single_tps else 0.0,
+        # SLO accounting through the chaos (BASELINE.md): worst
+        # per-tenant attainment across the declared rules + burn-rate
+        # alerts fired — the sentinel gates obs_slo_attainment
+        "obs_slo_attainment": round(slo["worst_attainment"], 4),
+        "slo_alerts": int(slo["alerts_fired"]),
+        "obs_fleet_overhead_frac": round(g["obs_overhead_frac"], 5),
     }
     print(f"# cb fleet: {len(fids)} requests over 4 replicas, "
           f"replica 1 killed mid-run (breaker "
@@ -910,7 +929,9 @@ def _cb_fleet_bench(on_tpu):
           f"{out['cb_fleet_failover_ms']} ms, vs single engine "
           f"x{out['cb_fleet_vs_single']} "
           f"(requeued {g['requeued']}, retries {g['retries']}, "
-          f"delivered {len(ok)}/{len(fids)})", file=sys.stderr)
+          f"delivered {len(ok)}/{len(fids)}, slo attainment "
+          f"{out['obs_slo_attainment']}, alerts {out['slo_alerts']})",
+          file=sys.stderr)
     return out
 
 

@@ -520,6 +520,11 @@ class Model:
             path=f"{save_dir}/goodput.json" if save_dir else None,
             load=bool(resume))
         self._goodput = ledger
+        # register as the process's CURRENT ledger so the /statusz
+        # goodput section (profiler/exposition.py, ISSUE 13) reads the
+        # live run without a handle threaded through the stack
+        from ..profiler import goodput as _goodput_mod
+        _goodput_mod.set_current(ledger)
         start_epoch = 0
         resume_skip = 0  # steps already consumed in start_epoch
         if resume:

@@ -85,10 +85,12 @@ import numpy as np
 
 from ..profiler import flight_recorder as _frec
 from ..profiler import metrics as _pmetrics
+from ..profiler.slo import SLOTracker
+from ..profiler.trace import get_trace_log, get_tracer
 from .reliability import (AdmissionController, DeadlineExceeded,
                           EngineSupervisor, Overloaded, ReplicaFailed,
                           RequestCancelled, salvage_unfinished)
-from .serving import ServedRequest
+from .serving import ServedRequest, record_hop, request_trace_summary
 
 __all__ = ["ServingFleet", "FleetReplica"]
 
@@ -181,6 +183,9 @@ class FleetReplica:
             min_retry_after_s=min_retry_after_s)
         self.state = "ready"
         self.drain_deadline = None
+        #: why this replica left the fleet ("breaker" / "wedge" /
+        #: "operator"); None while live — the /statusz health render
+        self.eject_kind = None
         self.last_beat = time.perf_counter()
         self.last_progress = self.last_beat
         self._idle_marker = None
@@ -289,6 +294,11 @@ class _Tracked:
     last_error: Exception | None = None
     done: ServedRequest | None = None
     t_assign: float = 0.0
+    #: SLO accounting label (ISSUE 13), copied onto every attempt
+    tenant: str | None = None
+    #: the ONE cross-replica hop list every attempt shares (the fleet
+    #: trace: hedge winner + cancelled loser interleave here)
+    hops: list = field(default_factory=list)
 
 
 class ServingFleet:
@@ -307,7 +317,7 @@ class ServingFleet:
                  retry_jitter=0.25, hedge_delay_s=None,
                  hedge_factor=3.0, hedge_min_delay_s=0.05,
                  no_progress_turns=25, drain_deadline_s=30.0,
-                 all_open_retry_after_s=1.0, seed=0):
+                 all_open_retry_after_s=1.0, seed=0, slo_rules=None):
         self._factory = engine_factory
         self._rep_kw = dict(max_restarts=int(max_restarts),
                             max_queue=int(max_queue),
@@ -324,6 +334,21 @@ class ServingFleet:
         self.drain_deadline_s = float(drain_deadline_s)
         self.all_open_retry_after_s = float(all_open_retry_after_s)
         self._rng = random.Random(seed)
+        #: the fleet's FEDERATION POINT (ISSUE 13): local fleet/*
+        #: metrics live here, and every replica's private engine
+        #: registry is a labeled source — /metrics and flight-recorder
+        #: bundles read the whole fleet through this one handle
+        self.metrics = _pmetrics.FederatedRegistry()
+        #: per-tenant SLO accounting (profiler/slo.py); None without
+        #: rules — attainment/burn gauges land in the federated
+        #: registry so the exposition endpoint carries them
+        self.slo = SLOTracker(slo_rules, registry=self.metrics) \
+            if slo_rules else None
+        #: self-measured observability overhead on the FLEET hot loop
+        #: (SLO booking, trace-log feeds, tracer reconstruction) — the
+        #: <2% obs/overhead_frac pin extends to the fleet tier
+        self._obs_s = 0.0
+        self._run_s = 0.0
         self.replicas: dict[int, FleetReplica] = {}
         self._next_replica_id = 0
         for _ in range(int(num_replicas)):
@@ -342,23 +367,31 @@ class ServingFleet:
         self._affinity: dict[int, int] = {}
         self._affinity_cap = 4096
         self.completed: list[ServedRequest] = []
-        self.metrics = _pmetrics.MetricsRegistry()
         self._h_failover = self.metrics.histogram("fleet/failover_ms")
 
     # ---- replica registry ------------------------------------------------
 
-    def _add_replica(self, factory):
+    def _add_replica(self, factory, federate=True):
         rid = self._next_replica_id
         self._next_replica_id += 1
         rep = FleetReplica(rid, factory, **self._rep_kw)
         self.replicas[rid] = rep
+        if federate:
+            self._federate(rep)
         return rep
+
+    def _federate(self, rep):
+        # federate the replica's private engine registry, read LIVE
+        # through the supervisor (a rebuilt engine swaps the instance;
+        # the federation watermark keeps the fleet totals monotonic)
+        self.metrics.add_source(str(rep.id),
+                                lambda rep=rep: rep.engine.metrics)
 
     # ---- the router door -------------------------------------------------
 
     def submit(self, prompt_ids, max_new_tokens, eos_token_id=None,
                priority=0, ttft_deadline_s=None,
-               deadline_s=None) -> int:
+               deadline_s=None, tenant=None) -> int:
         """Route one request to the best ready replica; returns the
         fleet-global request id. Raises :class:`ValueError` for a
         request no replica geometry can ever satisfy, and
@@ -379,7 +412,12 @@ class ServingFleet:
                       priority=int(priority),
                       ttft_deadline_s=ttft_deadline_s,
                       deadline_s=deadline_s,
-                      t_submit=time.perf_counter())
+                      t_submit=time.perf_counter(),
+                      tenant=tenant)
+        # the trace is born HERE: one id, one hop list, shared by
+        # every attempt this request will ever make (ISSUE 13)
+        tr.hops.append({"kind": "submit", "t": tr.t_submit,
+                        "tenant": tenant})
         # prefix-affinity hint (ISSUE 12): hash the first full page's
         # token block — requests sharing >= page_size prefix tokens
         # carry the same hash, and the engines' prefix caches index at
@@ -398,8 +436,16 @@ class ServingFleet:
         req = ServedRequest(tr.fid, tr.prompt, tr.max_new_tokens,
                             tr.eos_token_id, priority=tr.priority,
                             ttft_deadline_s=tr.ttft_deadline_s,
-                            deadline_s=tr.deadline_s)
+                            deadline_s=tr.deadline_s,
+                            tenant=tr.tenant)
         req.t_arrive = tr.t_submit  # deadlines stay client-relative
+        # fleet trace context: every attempt (the primary, a hedge
+        # duplicate, a failover replay) carries the SAME trace id and
+        # appends into the SAME hop list — the engines' admit/preempt/
+        # finish hops from different replicas interleave into one
+        # cross-replica timeline
+        req.trace_id = tr.fid
+        req.hops = tr.hops
         return req
 
     def _candidates(self, exclude=(), prefer=None):
@@ -437,6 +483,8 @@ class ServingFleet:
                 continue
             tr.attempts[rep.id] = req
             tr.t_assign = time.perf_counter()
+            record_hop(req, "assign", replica=rep.id,
+                       retries=tr.retries)
             if h is not None:
                 if rep.id == prefer:
                     self.metrics.counter("fleet/affinity_hits").inc()
@@ -539,6 +587,15 @@ class ServingFleet:
         bundle (the liveness half of the health model)."""
         done = []
         token = _frec.arm("fleet run loop")
+        # while the fleet is live, flight-recorder bundles carry the
+        # FEDERATED snapshot: a replica-death post-mortem shows every
+        # sibling's state at the moment of failure (ISSUE 13)
+        rec = _frec.get_recorder()
+        prev_fleet_reg = None
+        if rec is not None:
+            prev_fleet_reg = rec.fleet_registry
+            rec.fleet_registry = self.metrics
+        t_run = time.perf_counter()
         try:
             while True:
                 _frec.beat(token)
@@ -561,6 +618,9 @@ class ServingFleet:
                         if wait > 0:
                             time.sleep(min(wait, 0.05))
         finally:
+            self._run_s += time.perf_counter() - t_run
+            if rec is not None:
+                rec.fleet_registry = prev_fleet_reg
             _frec.disarm(token)
             self._emit_gauges()
         return done
@@ -573,10 +633,59 @@ class ServingFleet:
         self._reqs.pop(tr.fid, None)   # pending set stays bounded
         self.completed.append(req)
         self.metrics.counter("fleet/completed").inc()
+        # ---- the fleet observability block (self-measured: rides the
+        # obs_overhead_frac pin) — SLO booking, the completed-trace
+        # log, chrome reconstruction. The delivered object may be a
+        # fresh failover attempt, but every attempt shares tr.hops,
+        # so the summary carries the WHOLE cross-replica timeline
+        _t_obs = time.perf_counter()
+        record_hop(req, "deliver", reason=req.finish_reason,
+                   retries=tr.retries, hedged=tr.hedged)
+        if self.slo is not None:
+            try:
+                self.slo.record(req)
+            except Exception:  # noqa: BLE001 — accounting must never
+                pass           # fail a delivery
+        get_trace_log().record(request_trace_summary(req))
+        self._emit_fleet_trace(tr, req)
+        self._obs_s += time.perf_counter() - _t_obs
         _frec.record_event("fleet_finish", fid=tr.fid,
                            reason=req.finish_reason,
                            tokens=len(req.tokens))
         return req
+
+    def _emit_fleet_trace(self, tr, req):
+        """Reconstruct the request's cross-replica timeline into the
+        chrome trace (Tracer.complete, retroactive): one parent span
+        on the trace-id track, one child span per replica ATTEMPT
+        (admit → finish/preempt/salvage — the hedge winner and its
+        cancelled loser appear as sibling spans of the one trace), and
+        zero-length hop markers at their true timestamps."""
+        tracer = get_tracer()
+        if not tracer.enabled:
+            return
+        t_end = req.t_done or time.perf_counter()
+        tid = int(tr.fid)
+        tracer.complete("fleet/request", tr.t_submit, t_end,
+                        cat="fleet_req", tid=tid, trace_id=tid,
+                        reason=req.finish_reason,
+                        tokens=len(req.tokens), tenant=tr.tenant,
+                        retries=tr.retries, hedged=tr.hedged)
+        open_attempts: dict = {}
+        for h in tr.hops:
+            kind = h.get("kind")
+            rep = h.get("replica")
+            if kind == "admit":
+                open_attempts.setdefault(rep, h["t"])
+            elif kind in ("finish", "preempt", "evict",
+                          "engine_restart", "salvage") \
+                    and rep in open_attempts:
+                tracer.complete(
+                    "fleet/attempt", open_attempts.pop(rep), h["t"],
+                    cat="fleet_req", tid=tid, replica=rep,
+                    outcome=h.get("reason", kind))
+            tracer.complete("req/hop", h["t"], h["t"],
+                            cat="fleet_req", tid=tid, **h)
 
     def _absorb(self, rep, req):
         """Fold one replica completion into the fleet view; returns
@@ -615,6 +724,7 @@ class ServingFleet:
         ``"operator"`` (an explicit :meth:`eject` — no failure
         counter, and the reroute does not burn retry budget)."""
         rep.state = "ejected"
+        rep.eject_kind = kind
         if kind == "wedge":
             self.metrics.counter("fleet/wedge_ejections").inc()
         elif kind == "breaker":
@@ -642,6 +752,8 @@ class ServingFleet:
             if tr.attempts:
                 continue   # a live sibling copy still runs
             n += 1
+            record_hop(req, "salvage", replica=rep.id,
+                       tokens=len(req.tokens))
             if count_retry:
                 tr.retries += 1
                 if tr.retries > self.max_retries:
@@ -671,6 +783,8 @@ class ServingFleet:
         req.error = ReplicaFailed(tr.fid, cause=repr(cause)[:200])
         req.finish_reason = "failed"
         req.t_done = time.perf_counter()
+        record_hop(req, "failed", retries=tr.retries,
+                   cause=repr(cause)[:80])
         return self._deliver(tr, req)
 
     def _fire_retries(self, now):
@@ -697,6 +811,8 @@ class ServingFleet:
             except Overloaded as exc:
                 # the computed retry-after is the backoff FLOOR; an
                 # admission shed does not burn the retry budget
+                record_hop(req, "shed",
+                           retry_after_s=round(exc.retry_after_s, 4))
                 tr.not_before = now + self._backoff_s(
                     tr.retries, floor_s=exc.retry_after_s)
                 continue
@@ -749,6 +865,7 @@ class ServingFleet:
                 continue       # no sibling has room: the straggler
             tr.hedged = True   # keeps the request (one hedge max)
             tr.hedge_rid = nrid
+            record_hop(copy, "hedge", replica=nrid, straggler=rid)
             self.metrics.counter("fleet/hedges").inc()
             _frec.record_event(
                 "fleet_hedge", fid=tr.fid, straggler=rid,
@@ -841,10 +958,17 @@ class ServingFleet:
         compiles its programs, then its gauges are reset so warmup
         latencies cannot pollute the routing signal. Returns the new
         replica id."""
-        rep = self._add_replica(engine_factory or self._factory)
+        # federation waits until AFTER warmup: a concurrent scrape
+        # landing between the sacrificial request and reset_gauges()
+        # would otherwise record the warmup counters into the
+        # federation watermark, and the reset would bank them into the
+        # fleet totals forever (scrape-timing-dependent totals)
+        rep = self._add_replica(engine_factory or self._factory,
+                                federate=False)
         if warm:
             rep.state = "warming"
             self._warm(rep)
+        self._federate(rep)
         rep.state = "ready"
         self.metrics.counter("fleet/scale_ups").inc()
         _frec.record_event("fleet_scale_up", replica=rep.id,
@@ -912,9 +1036,106 @@ class ServingFleet:
             "drains": c("fleet/drains"),
             "scale_ups": c("fleet/scale_ups"),
             "failover_ms_p99": self._h_failover.percentile(99),
+            "obs_overhead_frac": (self._obs_s / self._run_s)
+            if self._run_s else 0.0,
         }
 
     def _emit_gauges(self):
         self.metrics.gauge("fleet/replicas_ready").set(
             sum(1 for r in self.replicas.values()
                 if r.takes_weight()))
+        self.metrics.gauge("obs/overhead_frac").set(
+            (self._obs_s / self._run_s) if self._run_s else 0.0)
+
+    # ---- /statusz + exposition (ISSUE 13) --------------------------------
+
+    def _statusz_replicas(self):
+        """Per-replica health: state, breaker/eject cause, supervisor
+        restarts, load + latency signal, prefix-cache hit rate — the
+        fleet-operator view of the PR-11 health model."""
+        out = {}
+        for r in self.replicas.values():
+            entry = {"state": r.state, "eject_kind": r.eject_kind,
+                     "restarts": r.supervisor.restarts,
+                     "breaker_open": r.eject_kind == "breaker",
+                     "stale_turns": r._stale_turns}
+            try:
+                p99 = r.ttft_p99_s()
+                g = r.supervisor.gauges()
+                entry.update(
+                    load=round(r.load(), 4),
+                    queued=len(r.engine.queue),
+                    ttft_p99_ms=round(p99 * 1e3, 3)
+                    if p99 is not None else None,
+                    tokens_emitted=g.get("tokens_emitted", 0),
+                    requests_completed=g.get("requests_completed", 0),
+                    prefix_cache_hit_rate=round(
+                        g.get("prefix_cache_hit_rate", 0.0), 4),
+                    preempt_evictions=g.get("preempt_evictions", 0))
+            except Exception as exc:  # noqa: BLE001 — a replica mid-
+                # teardown must not tear the whole health render
+                entry["error"] = f"{type(exc).__name__}: {exc}"
+            out[str(r.id)] = entry
+        return out
+
+    def _statusz_traces(self, n=10):
+        """The N slowest recent end-to-end request traces."""
+        return get_trace_log().slowest(n)
+
+    def statusz_sections(self) -> dict:
+        """The named /statusz section providers (each a zero-arg
+        callable, evaluated per scrape and individually guarded by the
+        ObservabilityServer): fleet router economics, per-replica
+        health/breaker state, SLO attainment + burn-rate alerts, the
+        slowest recent traces, flight-recorder incidents, and the
+        current goodput summary (the most recent fit run's ledger,
+        when one exists in this process)."""
+        from ..profiler import goodput as _goodput
+
+        def _slo():
+            return self.slo.summary() if self.slo is not None else None
+
+        def _goodput_section():
+            ledger = _goodput.get_current()
+            return ledger.summary() if ledger is not None else None
+
+        def _flight():
+            rec = _frec.get_recorder()
+            if rec is None:
+                return None
+            return {"dumps": rec.dumps,
+                    "last_bundle": rec.last_bundle_path,
+                    "incidents": rec.incidents()}
+
+        return {
+            "fleet": self.gauges,
+            "replicas": self._statusz_replicas,
+            "slo": _slo,
+            "slowest_traces": self._statusz_traces,
+            "flight_recorder": _flight,
+            "goodput": _goodput_section,
+        }
+
+    def statusz(self) -> dict:
+        """The /statusz document as a dict — the SAME guarded
+        evaluation the HTTP render uses (one loop, cannot drift)."""
+        from ..profiler.exposition import evaluate_sections
+        return evaluate_sections(self.statusz_sections())
+
+    def observability_server(self, host="127.0.0.1", port=0,
+                             start=True):
+        """The fleet's operational front door: an
+        :class:`~paddle_tpu.profiler.exposition.ObservabilityServer`
+        wired to the federated registry (``/metrics``) and the statusz
+        sections (``/statusz``). ``port=0`` binds an ephemeral port;
+        the caller owns ``stop()``."""
+        from ..profiler.exposition import ObservabilityServer
+        srv = ObservabilityServer(
+            registry=self.metrics, sections=self.statusz_sections(),
+            host=host, port=port,
+            # /metrics-only scrapers must read CURRENT slo gauges —
+            # a tenant gone silent after a bad minute self-resolves
+            # on the scrape path too, not just /statusz
+            pre_scrape=(self.slo.refresh if self.slo is not None
+                        else None))
+        return srv.start() if start else srv

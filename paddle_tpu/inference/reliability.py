@@ -41,7 +41,7 @@ from ..profiler import metrics as _metrics
 __all__ = ["ServingError", "RequestCancelled", "DeadlineExceeded",
            "RequestQuarantined", "Overloaded", "ReplicaFailed",
            "AdmissionController", "EngineSupervisor",
-           "salvage_unfinished"]
+           "salvage_unfinished", "record_hop", "MAX_HOPS"]
 
 _metrics.declare("restart/engine_restarts", "counter",
                  "supervised serving-engine teardown+restart cycles "
@@ -122,6 +122,46 @@ class ReplicaFailed(ServingError):
             + (f": {cause}" if cause else ""))
         self.request_id = request_id
         self.cause = cause
+
+
+#: per-request hop bound (ISSUE 13): lifecycle events are few, but a
+#: preemption storm replaying one victim hundreds of times must not
+#: grow its trace without limit — past the bound, hops are counted,
+#: not stored. The helper lives HERE (engine-agnostic, stdlib-only)
+#: because serving.py imports this module; serving re-exports both.
+MAX_HOPS = 64
+
+
+def record_hop(req, kind, replica=None, **fields):
+    """Append one hop to a request's cross-replica trace. Duck-typed:
+    requests without a ``hops`` list are silently skipped. A few dict
+    stores — cheap enough for the hot path (the engine call sites ride
+    the ``obs_overhead_frac`` window).
+
+    Past ``MAX_HOPS`` the LIST's last slot becomes a ``truncated``
+    marker counting the overflow — in the list itself, because hedge
+    copies are distinct request objects sharing ONE list: a per-object
+    counter on the attempt that happened to hit the cap would be
+    invisible in the delivered winner's trace summary."""
+    hops = getattr(req, "hops", None)
+    if hops is None:
+        return
+    if len(hops) >= MAX_HOPS:
+        req.hops_dropped += 1
+        last = hops[-1]
+        if last.get("kind") == "truncated":
+            last["dropped"] += 1
+        else:
+            # dropped=2: the displaced final hop AND the current one
+            hops[-1] = {"kind": "truncated",
+                        "t": time.perf_counter(), "dropped": 2}
+        return
+    h = {"kind": kind, "t": time.perf_counter()}
+    if replica is not None:
+        h["replica"] = replica
+    if fields:
+        h.update(fields)
+    hops.append(h)
 
 
 def salvage_unfinished(engine):
@@ -264,7 +304,7 @@ class AdmissionController:
 
     def submit(self, prompt_ids, max_new_tokens, eos_token_id=None,
                priority=0, ttft_deadline_s=None,
-               deadline_s=None) -> int:
+               deadline_s=None, tenant=None) -> int:
         """Admit or shed. Returns the request id; raises
         :class:`Overloaded` (with ``retry_after_s``) when the queue is
         full or the SLO predictor says the deadline is already lost."""
@@ -274,7 +314,7 @@ class AdmissionController:
                               eos_token_id=eos_token_id,
                               priority=priority,
                               ttft_deadline_s=ttft_deadline_s,
-                              deadline_s=deadline_s)
+                              deadline_s=deadline_s, tenant=tenant)
         self.accepted += 1   # after validation — a rejected oversize
         return rid           # submission must not count as accepted
 
@@ -462,6 +502,12 @@ class EngineSupervisor:
             pass           # best-effort salvage, never block restart
         # replay in arrival order so FIFO fairness survives the restart
         salvage = salvage_unfinished(old)
+        for r in salvage:
+            # the trace hop that distinguishes "my engine was rebuilt
+            # under me" from a scheduler preemption (ISSUE 13)
+            record_hop(r, "engine_restart", attempt=self.restarts,
+                       replica=getattr(old, "_fleet_replica_id", None),
+                       tokens=len(r.tokens), error=repr(exc)[:80])
         self.engine = self._factory()
         # carry the dead engine's id counter: requeue() only advances
         # past SALVAGED ids, and a fresh engine re-minting an id the

@@ -101,10 +101,13 @@ import numpy as np
 from ..framework.core import Tensor, no_grad
 from ..profiler import flight_recorder as _frec
 from ..profiler import metrics as _pmetrics
-from .reliability import (DeadlineExceeded, RequestCancelled,
-                          RequestQuarantined)
+from ..profiler.trace import get_trace_log as _get_trace_log
+from .reliability import (MAX_HOPS as _MAX_HOPS, DeadlineExceeded,
+                          RequestCancelled, RequestQuarantined,
+                          record_hop)
 
-__all__ = ["ContinuousBatchingEngine", "ServedRequest"]
+__all__ = ["ContinuousBatchingEngine", "ServedRequest",
+           "record_hop", "request_trace_summary"]
 
 # the serving metric vocabulary (docs/observability.md table;
 # tools/check_metric_names.py lints these literals). Each engine owns
@@ -145,9 +148,10 @@ _pmetrics.declare("serving/itl_ms", "histogram",
                   "smoothed inter-token latency per request with >=2 "
                   "tokens, ms (bounded reservoir)")
 _pmetrics.declare("obs/overhead_frac", "gauge",
-                  "fraction of serving run() wall time spent inside "
-                  "observability instrumentation (self-measured; the "
-                  "<2% pinned contract)")
+                  "fraction of run() wall time spent inside "
+                  "observability instrumentation, self-measured — "
+                  "per-engine on its private registry, fleet-tier on "
+                  "the federated registry (the <2% pinned contract)")
 # ISSUE 10 reliability vocabulary: overload is a first-class mode, so
 # its economics are first-class metrics
 _pmetrics.declare("serving/preempt_evictions", "counter",
@@ -319,6 +323,23 @@ class ServedRequest:
     #: containment blame: failed steps this request rode; crossing the
     #: engine's max_strikes quarantines it
     strikes: int = 0
+    # ---- fleet-level trace context (ISSUE 13) ------------------------
+    #: one trace id per CLIENT request, minted by the fleet router and
+    #: shared by every attempt (hedge duplicates, failover replays);
+    #: None for a standalone engine (its request_id is the trace)
+    trace_id: int | None = None
+    #: the cross-replica hop list — admission, preemption/replay,
+    #: salvage, failover re-admission, hedge launch, completion — each
+    #: hop a small dict {kind, t, replica?, ...}. Hedge copies SHARE
+    #: the primary's list object, so the winner and the cancelled
+    #: loser interleave into one timeline (bounded; see _hop)
+    hops: list = field(default_factory=list)
+    #: hops dropped past the bound (a preemption storm must not grow
+    #: a request's memory without limit)
+    hops_dropped: int = 0
+    #: SLO accounting label (profiler/slo.py): attainment windows and
+    #: burn-rate alerts partition by tenant
+    tenant: str | None = None
 
     def cancel(self):
         """Request cancellation. Safe from any thread; the engine
@@ -326,6 +347,38 @@ class ServedRequest:
         request completes with ``RequestCancelled`` (tokens already
         emitted are kept)."""
         self.cancelled = True
+
+
+def request_trace_summary(req) -> dict:
+    """The condensed end-to-end trace of a finished request — what the
+    :class:`~paddle_tpu.profiler.trace.RequestTraceLog` stores and
+    ``/statusz`` renders for the N slowest recent traces. One trace id
+    covers every attempt (preemption replays, failover re-admissions,
+    the hedge winner AND its cancelled loser all hop into the same
+    list)."""
+    tid = req.trace_id if req.trace_id is not None else req.request_id
+    t0 = req.t_arrive
+    hops = list(req.hops or ())
+    # overflow is counted IN the shared list (a hedge copy may have
+    # been the object that hit the cap — see reliability.record_hop)
+    dropped = hops[-1]["dropped"] if hops \
+        and hops[-1].get("kind") == "truncated" else req.hops_dropped
+    return {
+        "trace_id": int(tid),
+        "latency_ms": round((req.t_done - t0) * 1e3, 3)
+        if req.t_done else 0.0,
+        "ttft_ms": round((req.t_first - t0) * 1e3, 3)
+        if req.t_first else None,
+        "tokens": len(req.tokens),
+        "finish_reason": req.finish_reason,
+        "error": type(req.error).__name__
+        if req.error is not None else None,
+        "tenant": req.tenant,
+        "priority": int(req.priority),
+        "preemptions": int(req.preemptions),
+        "hops": [dict(h) for h in hops],
+        "hops_dropped": int(dropped),
+    }
 
 
 class ContinuousBatchingEngine:
@@ -560,7 +613,8 @@ class ContinuousBatchingEngine:
 
     def add_request(self, prompt_ids, max_new_tokens,
                     eos_token_id=None, priority=0,
-                    ttft_deadline_s=None, deadline_s=None) -> int:
+                    ttft_deadline_s=None, deadline_s=None,
+                    tenant=None) -> int:
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         self._check_fits(prompt.size, int(max_new_tokens))
         req = ServedRequest(self._next_id, prompt, int(max_new_tokens),
@@ -568,7 +622,8 @@ class ContinuousBatchingEngine:
                             else (self.eos if self.eos >= 0 else None),
                             priority=int(priority),
                             ttft_deadline_s=ttft_deadline_s,
-                            deadline_s=deadline_s)
+                            deadline_s=deadline_s,
+                            tenant=tenant)
         req.t_arrive = time.perf_counter()
         self._next_id += 1
         if req.priority:
@@ -1761,6 +1816,9 @@ class ContinuousBatchingEngine:
         self._clear_slot(slot, device=True)
         _frec.record_event("preempt", slot=slot, req=req.request_id,
                            tokens=len(req.tokens), reason=reason)
+        record_hop(req, "preempt" if requeue else "evict",
+                   replica=getattr(self, "_fleet_replica_id", None),
+                   reason=reason, tokens=len(req.tokens))
         if requeue:
             req.preemptions += 1
             self.queue.appendleft(req)
@@ -1967,6 +2025,10 @@ class ContinuousBatchingEngine:
         _t_obs = req.t_admit
         if self._trace_every:
             req.traced = req.request_id % self._trace_every == 0
+        record_hop(req, "admit",
+                   replica=getattr(self, "_fleet_replica_id", None),
+                   slot=slot, cached=int(start),
+                   replayed=len(req.tokens))
         self._stats.inc("prefills")
         if self._overlap_admission:
             self._stats.inc("prefills_overlapped")
@@ -2347,6 +2409,20 @@ class ContinuousBatchingEngine:
                 self._h_itl.observe(
                     (req.t_done - req.t_first) * 1e3
                     / (len(req.tokens) - 1))
+        record_hop(req, "finish",
+                   replica=getattr(self, "_fleet_replica_id", None),
+                   reason=req.finish_reason, tokens=len(req.tokens))
+        if req.trace_id is None and req.request_id >= 0:
+            # standalone engine use: THIS is the end of the request's
+            # timeline, so feed the process trace log here. A
+            # fleet-managed request (trace_id minted by the router) is
+            # fed by the fleet at DELIVERY instead — a replica
+            # completion may only be the losing hedge copy. Negative
+            # ids are sacrificial warmup requests (fleet._warm): they
+            # deliberately absorb the XLA compile, and their
+            # multi-second "latency" would otherwise dominate the
+            # /statusz slowest-traces render
+            _get_trace_log().record(request_trace_summary(req))
         if req.traced:
             self._emit_request_trace(req)
         self._obs_s += time.perf_counter() - _t_obs
@@ -2358,19 +2434,34 @@ class ContinuousBatchingEngine:
             return
         rid = int(req.request_id)
         # each traced request gets its own track (tid) so Perfetto
-        # shows the lifecycle as one stacked lane per request
+        # shows the lifecycle as one stacked lane per request; a
+        # fleet-minted trace id (ISSUE 13) keeps every attempt —
+        # preemption replays, failover re-admissions, hedge copies —
+        # on ONE track, reconstructing the cross-replica timeline
+        tid = int(req.trace_id) if req.trace_id is not None else rid
         admit = req.t_admit or req.t_arrive
         tr.complete("req/queued", req.t_arrive, admit,
-                    cat="serving_req", tid=rid, request_id=rid)
+                    cat="serving_req", tid=tid, request_id=rid)
         pre_end = req.t_prefill_done or req.t_first or admit
         tr.complete("req/prefill", admit, pre_end, cat="serving_req",
-                    tid=rid, prompt_len=int(len(req.prompt)))
+                    tid=tid, prompt_len=int(len(req.prompt)))
         if req.t_first:
             tr.complete("req/first_token_wait", pre_end, req.t_first,
-                        cat="serving_req", tid=rid)
+                        cat="serving_req", tid=tid)
             tr.complete("req/decode", req.t_first, req.t_done,
-                        cat="serving_req", tid=rid,
+                        cat="serving_req", tid=tid,
                         tokens=len(req.tokens))
+        if req.trace_id is None:
+            # hop markers: zero-length retroactive spans AT the hop
+            # timestamps, so the timeline places preemptions where
+            # they happened. Fleet-owned traces (trace_id set) get
+            # their hop markers from the fleet's delivery-time
+            # reconstruction instead — emitting here too would
+            # duplicate every marker on the same track
+            for h in req.hops or ():
+                tr.complete("req/hop", h["t"], h["t"],
+                            cat="serving_req", tid=tid,
+                            **{**h, "request_id": rid})
         tr.instant("req/finished", cat="serving_req",
                    request_id=rid, reason=req.finish_reason,
                    tokens=len(req.tokens))

@@ -33,13 +33,16 @@ import jax
 
 from ..framework.core import Tensor
 from . import cost, trace  # noqa: F401 (public submodules)
-from . import flight_recorder, goodput, metrics  # noqa: F401
+from . import exposition, flight_recorder, goodput  # noqa: F401
+from . import metrics, slo  # noqa: F401
 from .breakdown import (StepBreakdown, ablation_breakdown,  # noqa: F401
                         moe_step_breakdown)
+from .exposition import ObservabilityServer  # noqa: F401
 from .flight_recorder import FlightRecorder, Watchdog  # noqa: F401
 from .goodput import GoodputLedger  # noqa: F401
-from .metrics import (Counter, Gauge, Histogram,  # noqa: F401
-                      MetricsRegistry, get_registry)
+from .metrics import (Counter, FederatedRegistry, Gauge,  # noqa: F401
+                      Histogram, MetricsRegistry, get_registry)
+from .slo import SLORule, SLOTracker  # noqa: F401
 from .trace import (Tracer, block_on, get_tracer,  # noqa: F401
                     log_perf_event, trace_span)
 

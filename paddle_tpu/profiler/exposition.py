@@ -1,0 +1,207 @@
+"""Live observability exposition — the operational front door (ISSUE 13).
+
+PR 9 gave the process a metrics registry with FILE exposition
+(``registry.export(path)``); a fleet serving live traffic needs a
+scrape endpoint. :class:`ObservabilityServer` is a stdlib-only
+``http.server`` running in a daemon thread, serving:
+
+- ``/metrics`` — Prometheus text exposition v0.0.4 rendered from the
+  configured registry (a :class:`~.metrics.FederatedRegistry` when a
+  ServingFleet wires it: per-replica labeled children + summed
+  totals);
+- ``/statusz`` — one JSON document assembled from named SECTION
+  PROVIDERS (replica health/breaker states, prefix-cache hit rates,
+  goodput summary, flight-recorder incidents, SLO attainment/alerts,
+  the N slowest recent request traces). Each provider is guarded: a
+  section that raises mid-churn (a replica being torn down under the
+  scrape) degrades to an ``{"error": ...}`` stanza — the scrape always
+  parses;
+- ``/healthz`` — liveness (200 ``ok``).
+
+Scrape-safety contract (the chaos gate pins it):
+
+- the handler READS; nothing in it writes runtime state, takes engine
+  locks, or touches the device — the serving hot loop is never blocked
+  by a scrape;
+- every response is fully materialized before a byte is sent
+  (Content-Length framing, no streaming) — a scraper never reads a
+  torn document, the same invariant the atomic file exports hold;
+- handler exceptions return a 500 with a JSON body, never a dropped
+  connection mid-document.
+
+``port=0`` binds an ephemeral port (tests); ``server.port`` reports
+the bound port. Scrapes are themselves metered (``obs/scrapes`` /
+``obs/scrape_errors`` on the process-wide registry) so the
+observability plane's own traffic stays observable.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from . import metrics as _metrics
+
+__all__ = ["ObservabilityServer", "evaluate_sections"]
+
+
+def evaluate_sections(sections) -> dict:
+    """Evaluate named section providers into one dict, each GUARDED —
+    a provider raising mid-churn degrades to an ``{"error": ...}``
+    stanza instead of tearing the document. The ONE loop behind both
+    the HTTP ``/statusz`` render and ``ServingFleet.statusz()``."""
+    doc = {}
+    for name, provider in dict(sections).items():
+        try:
+            doc[name] = provider()
+        except Exception as exc:  # noqa: BLE001 — degrade per section
+            doc[name] = {"error": f"{type(exc).__name__}: {exc}"}
+    return doc
+
+_metrics.declare("obs/scrapes", "counter",
+                 "HTTP scrapes served by the ObservabilityServer "
+                 "(/metrics + /statusz + /healthz)")
+_metrics.declare("obs/scrape_errors", "counter",
+                 "ObservabilityServer requests that returned a 500 "
+                 "(a section provider or the registry render raised)")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    """One scrape. The server instance hangs off ``self.server.owner``
+    (the ObservabilityServer)."""
+
+    protocol_version = "HTTP/1.1"
+
+    # silence the default stderr access log (scrapes arrive every few
+    # seconds forever; the serving process's stderr is for the runtime)
+    def log_message(self, fmt, *args):  # noqa: A002
+        pass
+
+    def _send(self, code, body, ctype):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 — http.server API
+        owner = self.server.owner
+        path = self.path.split("?", 1)[0]
+        _metrics.get_registry().counter("obs/scrapes").inc()
+        try:
+            if path == "/healthz":
+                self._send(200, "ok\n", "text/plain; charset=utf-8")
+            elif path == "/metrics":
+                self._send(200, owner.render_metrics(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/statusz":
+                self._send(200, owner.render_statusz(),
+                           "application/json")
+            else:
+                self._send(404, json.dumps(
+                    {"error": f"unknown path {path!r}",
+                     "paths": ["/metrics", "/statusz", "/healthz"]}),
+                    "application/json")
+        except Exception as exc:  # noqa: BLE001 — a scrape must never
+            # kill the handler thread or drop mid-document
+            _metrics.get_registry().counter("obs/scrape_errors").inc()
+            try:
+                self._send(500, json.dumps(
+                    {"error": f"{type(exc).__name__}: {exc}"}),
+                    "application/json")
+            except OSError:
+                pass
+
+
+class ObservabilityServer:
+    """Background-thread HTTP exposition of a metrics registry plus
+    named /statusz sections (module docstring).
+
+    ``registry`` defaults to the process-wide registry; a fleet passes
+    its :class:`~.metrics.FederatedRegistry`. ``sections`` maps section
+    name -> zero-arg callable returning a JSON-serializable value,
+    evaluated per scrape (live state, not a cached copy);
+    :meth:`add_section` registers more after construction.
+    """
+
+    def __init__(self, registry=None, sections=None, host="127.0.0.1",
+                 port=0, pre_scrape=None):
+        self.registry = registry
+        #: zero-arg callable run before every /metrics render (best-
+        #: effort): the fleet wires the SLO tracker's refresh() here
+        #: so a Prometheus-only scraper reads current burn/attainment
+        #: gauges, not values frozen since the last recorded request
+        self.pre_scrape = pre_scrape
+        self._sections = dict(sections or {})
+        self._lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._httpd.owner = self
+        self._thread = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self):
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.05},
+                name="obs-exposition", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self):
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self._httpd.server_close()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # -- sections ----------------------------------------------------------
+
+    def add_section(self, name, provider):
+        """Register/replace a /statusz section provider (zero-arg
+        callable -> JSON-serializable)."""
+        with self._lock:
+            self._sections[str(name)] = provider
+        return self
+
+    # -- renders (also the test surface: no HTTP needed) --------------------
+
+    def render_metrics(self) -> str:
+        if self.pre_scrape is not None:
+            try:
+                self.pre_scrape()
+            except Exception:  # noqa: BLE001 — a refresh hook must
+                pass           # never fail the scrape itself
+        reg = self.registry or _metrics.get_registry()
+        return reg.export_prometheus()
+
+    def render_statusz(self) -> str:
+        """The /statusz JSON document (see :func:`evaluate_sections`
+        for the guarded evaluation contract)."""
+        with self._lock:
+            sections = dict(self._sections)
+        doc = evaluate_sections(sections)
+        # default=str: a section that leaks a non-JSON value (numpy
+        # scalar, Exception) must not make the whole document
+        # unserializable mid-incident — exactly when /statusz matters
+        return json.dumps(doc, default=str, sort_keys=True)

@@ -86,6 +86,12 @@ class FlightRecorder:
         self.keep_incidents = int(keep_incidents)
         self._slots: list = [None] * self.capacity
         self._seq = itertools.count()       # atomic under the GIL
+        #: a live ServingFleet registers its FederatedRegistry here
+        #: for the duration of run() (ISSUE 13): bundles dumped while
+        #: a fleet is live — a replica-death post-mortem — then carry
+        #: the FLEET-WIDE snapshot (every sibling's counters, replica-
+        #: labeled), not just the local process registry
+        self.fleet_registry = None
         self._last_persist = 0.0
         self._in_dump = threading.local()
         # serializes whole dumps across threads (watchdog vs periodic
@@ -135,7 +141,19 @@ class FlightRecorder:
         return stacks
 
     def bundle(self, reason) -> dict:
-        reg = self.registry or _metrics.get_registry()
+        reg = self.fleet_registry or self.registry \
+            or _metrics.get_registry()
+        try:
+            metrics = reg.snapshot()
+        except Exception:  # noqa: BLE001 — a half-torn-down fleet's
+            # federated read must not cost us the rest of the bundle
+            metrics = {}
+            if reg is not self.registry:
+                try:
+                    metrics = (self.registry
+                               or _metrics.get_registry()).snapshot()
+                except Exception:  # noqa: BLE001
+                    pass
         return {
             "schema": BUNDLE_SCHEMA,
             "reason": str(reason),
@@ -145,8 +163,26 @@ class FlightRecorder:
                                                 "0")),
             "events": self.events(),
             "threads": self._thread_stacks(),
-            "metrics": reg.snapshot(),
+            "metrics": metrics,
         }
+
+    def incidents(self):
+        """The preserved incident bundle filenames (newest last) —
+        the /statusz incident list."""
+        if self.bundle_dir is None:
+            return []
+        try:
+            names = [f for f in os.listdir(self.bundle_dir)
+                     if f.startswith("flight_incident_")
+                     and f.endswith(".json")]
+        except OSError:
+            return []
+        try:
+            names.sort(key=lambda f: int(
+                f[len("flight_incident_"):-len(".json")]))
+        except ValueError:
+            names.sort()
+        return names
 
     def dump(self, reason, path=None) -> str | None:
         """Atomically write the debug bundle; returns its path (None

@@ -43,7 +43,8 @@ import time
 from . import metrics as _metrics
 from .trace import _atomic_json_dump
 
-__all__ = ["GoodputLedger", "CATEGORIES", "LEDGER_SCHEMA"]
+__all__ = ["GoodputLedger", "CATEGORIES", "LEDGER_SCHEMA",
+           "set_current", "get_current"]
 
 LEDGER_SCHEMA = "paddle_tpu.goodput/1"
 
@@ -211,3 +212,25 @@ class GoodputLedger:
         rounds[str(self.round)] = self._this_round()
         return _atomic_json_dump({"schema": LEDGER_SCHEMA,
                                   "rounds": rounds}, self.path)
+
+
+# -- process-wide current ledger (ISSUE 13) ---------------------------------
+# /statusz wants "the goodput summary" without threading a ledger handle
+# through the serving stack; Model.fit registers its ledger here and
+# leaves it registered after the run (the ledger is close()d, so its
+# wall clock is frozen) — the exposition layer reads the live or most
+# recent run, and None renders as an absent section. The next fit
+# replaces it.
+
+_current: GoodputLedger | None = None
+
+
+def set_current(ledger: GoodputLedger | None):
+    """Register (or clear, with None) the process's live ledger."""
+    global _current
+    _current = ledger
+    return ledger
+
+
+def get_current() -> GoodputLedger | None:
+    return _current

@@ -51,8 +51,8 @@ import threading
 import zlib
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "get_registry", "declare", "catalog", "catalog_markdown",
-           "METRIC_NAME_RE"]
+           "FederatedRegistry", "get_registry", "declare", "catalog",
+           "catalog_markdown", "METRIC_NAME_RE"]
 
 #: the ``subsystem/name`` convention, linted by
 #: tools/check_metric_names.py
@@ -314,6 +314,12 @@ class Histogram(_Metric):
         with self._lock:
             return len(self._samples)
 
+    def samples(self):
+        """A copy of the resident reservoir (federation merges the
+        fleet's per-replica reservoirs from these)."""
+        with self._lock:
+            return list(self._samples)
+
     def to_dict(self):
         with self._lock:
             n = self.count
@@ -434,6 +440,295 @@ class MetricsRegistry:
         """Atomic JSON snapshot; returns the path."""
         from .trace import _atomic_json_dump
         return _atomic_json_dump(self.snapshot(), path)
+
+
+def _merge_suffix(suffix, label_key, label):
+    """Fold a federation label into an existing Prometheus label
+    suffix: ``"" -> {replica="0"}``, ``{k="v"} -> {replica="0",k="v"}``
+    (the replica label leads, so federated series group by replica)."""
+    mine = f'{label_key}="{label}"'
+    if not suffix:
+        return "{" + mine + "}"
+    return "{" + mine + "," + suffix[1:-1] + "}"
+
+
+def _percentiles(xs):
+    """(p50, p90, p99) of a sample list with the registry's linear
+    interpolation — shared by the merged-histogram render."""
+    if not xs:
+        return 0.0, 0.0, 0.0
+    xs = sorted(xs)
+    if len(xs) == 1:
+        return xs[0], xs[0], xs[0]
+
+    def pct(q):
+        pos = (len(xs) - 1) * (q / 100.0)
+        lo = int(pos)
+        hi = min(lo + 1, len(xs) - 1)
+        frac = pos - lo
+        return xs[lo] * (1.0 - frac) + xs[hi] * frac
+
+    return pct(50), pct(90), pct(99)
+
+
+class FederatedRegistry(MetricsRegistry):
+    """A registry that is also a FEDERATION POINT (ISSUE 13): it holds
+    its own local metrics (``counter``/``gauge``/``histogram`` work
+    exactly as on :class:`MetricsRegistry` — the fleet's ``fleet/*``
+    vocabulary lives here) and aggregates any number of SOURCE
+    registries — the fleet's per-replica private engine registries plus
+    the process-wide default registry — into one labeled snapshot:
+
+    - **counters** appear twice: per-replica as
+      ``name{replica="3"}`` children AND summed into the unlabeled
+      fleet total. Totals are MONOTONIC across supervised engine
+      rebuilds and scale_down/eject: each source is read through a
+      watermark (``base + raw``) that detects a replaced registry
+      instance (a rebuilt engine starts a fresh registry at zero) and
+      folds the old instance's last-seen value into the base — a
+      restart can never make a fleet total go backwards.
+    - **gauges** are inherently per-replica (summing two occupancy
+      gauges means nothing): only the labeled children appear.
+    - **histograms** appear per-replica AND as a deterministic MERGE:
+      count/sum/min/max are summed exactly; the merged percentiles are
+      computed over the concatenation of the sources' bounded
+      reservoirs (sources visited in sorted label order — same fleet
+      state, same answer).
+
+    ``add_source(label, provider)`` takes a zero-arg callable returning
+    the source registry, read live at every snapshot — so a supervised
+    rebuild that swaps ``engine.metrics`` is picked up automatically.
+    ``remove_source`` folds the source's final counter contributions
+    into retained totals (scale_down must not erase history).
+
+    Snapshots are atomic in the scrape sense: one ``snapshot()`` /
+    ``export_prometheus()`` call serializes against concurrent
+    snapshots (watermark state is shared) and reads each source metric
+    under its own per-metric lock — the serving hot loop is never
+    blocked by a scrape, and a scrape never reads a torn multi-field
+    histogram.
+    """
+
+    def __init__(self, mirror=False, label_key="replica",
+                 include_default=True):
+        super().__init__(mirror=mirror)
+        self._label_key = str(label_key)
+        self._include_default = bool(include_default)
+        self._fed_lock = threading.Lock()
+        self._sources: dict[str, object] = {}       # label -> provider
+        self._seen_reg: dict[str, int] = {}         # label -> id(reg)
+        #: (label, series_key) -> [base, last_raw] counter watermarks
+        self._marks: dict[tuple, list] = {}
+        #: unlabeled total key -> counter mass of removed sources
+        self._retired: dict[str, float] = {}
+
+    # -- source registry ---------------------------------------------------
+
+    def add_source(self, label, provider):
+        """Register a source. ``provider`` is a zero-arg callable
+        returning the source :class:`MetricsRegistry`, resolved at
+        every snapshot (live — engine rebuilds swap the instance)."""
+        label = str(label)
+        with self._fed_lock:
+            self._sources[label] = provider
+        return label
+
+    def remove_source(self, label):
+        """Drop a source, folding its final counter contributions into
+        the retained (unlabeled) totals so fleet counters stay
+        monotonic across scale_down."""
+        label = str(label)
+        with self._fed_lock:
+            self._sources.pop(label, None)
+            self._seen_reg.pop(label, None)
+            for (lbl, key), (base, last) in list(self._marks.items()):
+                if lbl == label:
+                    self._retired[key] = self._retired.get(key, 0) \
+                        + base + last
+                    del self._marks[(lbl, key)]
+
+    def source_labels(self):
+        with self._fed_lock:
+            return sorted(self._sources)
+
+    # -- the federated read ------------------------------------------------
+
+    def _counter_contribution(self, label, key, raw):
+        """Watermarked counter read (caller holds ``_fed_lock``)."""
+        mark = self._marks.setdefault((label, key), [0, 0])
+        if raw < mark[1]:
+            # registry survived but the counter went backwards (an
+            # explicit reset): fold what we saw into the base
+            mark[0] += mark[1]
+        mark[1] = raw
+        return mark[0] + raw
+
+    def _iter_source(self, label, provider, out_c, out_g, out_h):
+        try:
+            reg = provider()
+        except Exception:  # noqa: BLE001 — a dead replica's provider
+            reg = None     # must not fail the whole scrape
+        if reg is None:
+            # keep the retired-style contribution of whatever we last
+            # saw, so totals never dip while a replica is mid-rebuild
+            for (lbl, key), (base, last) in self._marks.items():
+                if lbl == label:
+                    out_c.setdefault(key, {"total": 0.0, "series": []})
+                    out_c[key]["total"] += base + last
+            return
+        if id(reg) != self._seen_reg.get(label):
+            # a REPLACED registry instance (engine rebuild): every
+            # counter restarts from zero — bank the old values
+            for (lbl, key), mark in self._marks.items():
+                if lbl == label:
+                    mark[0] += mark[1]
+                    mark[1] = 0
+            self._seen_reg[label] = id(reg)
+        with reg._lock:
+            metrics = list(reg._metrics.values())
+        visited = set()
+        for m in metrics:
+            for suffix, series in m._iter_series():
+                key = m.name + suffix
+                visited.add(key)
+                lsuffix = _merge_suffix(suffix, self._label_key, label)
+                if isinstance(series, Histogram):
+                    slot = out_h.setdefault(key, {
+                        "count": 0, "sum": 0.0, "min": None,
+                        "max": None, "samples": [], "series": []})
+                    d = series.to_dict()
+                    slot["count"] += d["count"]
+                    slot["sum"] += d["sum"]
+                    for agg, fn in (("min", min), ("max", max)):
+                        if d[agg] is not None:
+                            slot[agg] = d[agg] if slot[agg] is None \
+                                else fn(slot[agg], d[agg])
+                    slot["samples"].extend(series.samples())
+                    slot["series"].append((lsuffix, d))
+                elif isinstance(series, Counter):
+                    v = self._counter_contribution(label, key,
+                                                   series.value)
+                    slot = out_c.setdefault(key, {"total": 0.0,
+                                                  "series": []})
+                    slot["total"] += v
+                    slot["series"].append((lsuffix, v))
+                else:
+                    out_g.setdefault(key, []).append(
+                        (lsuffix, series.value))
+        # counter families the CURRENT registry has not (re-)minted —
+        # a rebuilt engine that cancelled requests in a past life but
+        # not this one — still carry banked watermark mass; emitting
+        # only present families would make the fleet total DIP, the
+        # exact violation the watermark exists to prevent
+        for (lbl, key), (base, last) in self._marks.items():
+            if lbl != label or key in visited:
+                continue
+            mass = base + last
+            if not mass:
+                continue
+            slot = out_c.setdefault(key, {"total": 0.0, "series": []})
+            slot["total"] += mass
+            name = key.split("{")[0]
+            suffix = key[len(name):]
+            slot["series"].append(
+                (_merge_suffix(suffix, self._label_key, label), mass))
+
+    def _collect(self):
+        """One atomic federated read: (counters, gauges, histograms)
+        keyed by the UNLABELED series key. Sources are visited in
+        sorted label order — the deterministic-merge contract."""
+        out_c: dict[str, dict] = {}
+        out_g: dict[str, list] = {}
+        out_h: dict[str, dict] = {}
+        with self._fed_lock:
+            for key, mass in self._retired.items():
+                out_c.setdefault(key, {"total": 0.0, "series": []})
+                out_c[key]["total"] += mass
+            for label in sorted(self._sources):
+                self._iter_source(label, self._sources[label],
+                                  out_c, out_g, out_h)
+        return out_c, out_g, out_h
+
+    def snapshot(self) -> dict:
+        """The federated JSON-ready view: local + default-registry
+        series unlabeled, per-source series as ``{replica="N"}``
+        children, counter totals summed, histograms merged (module
+        docstring). The flight recorder embeds THIS in bundles when a
+        fleet is live, so a replica-death post-mortem shows sibling
+        state."""
+        out = {}
+        if self._include_default and get_registry() is not self:
+            out.update(get_registry().snapshot())
+        out.update(super().snapshot())     # local (fleet/*) metrics
+        out_c, out_g, out_h = self._collect()
+        for key, slot in sorted(out_c.items()):
+            out[key] = out.get(key, 0) + slot["total"]
+            for lsuffix, v in slot["series"]:
+                out[key.split("{")[0] + lsuffix] = v
+        for key, series in sorted(out_g.items()):
+            for lsuffix, v in series:
+                out[key.split("{")[0] + lsuffix] = v
+        for key, slot in sorted(out_h.items()):
+            p50, p90, p99 = _percentiles(slot["samples"])
+            out[key] = {"count": slot["count"],
+                        "sum": round(slot["sum"], 6),
+                        "min": slot["min"], "max": slot["max"],
+                        "p50": round(p50, 6), "p90": round(p90, 6),
+                        "p99": round(p99, 6)}
+            for lsuffix, d in slot["series"]:
+                out[key.split("{")[0] + lsuffix] = d
+        return out
+
+    def export_prometheus(self) -> str:
+        """Prometheus text over the federated view: local + default
+        series as-is, then each federated family with its summed total
+        and ``replica``-labeled children."""
+        parts = []
+        if self._include_default and get_registry() is not self:
+            parts.append(get_registry().export_prometheus())
+        parts.append(super().export_prometheus())
+        # a family already rendered by the local/default blocks must
+        # not get a SECOND # TYPE header from the federated block —
+        # Prometheus parsers reject duplicate family headers
+        seen = set()
+        for p in parts:
+            for line in p.splitlines():
+                if line.startswith("# TYPE "):
+                    seen.add(line.split()[2])
+        lines = []
+        out_c, out_g, out_h = self._collect()
+        kinds = catalog()
+
+        def header(key, ptype):
+            name = key.split("{")[0]
+            prom = "paddle_" + name.replace("/", "_")
+            if prom not in seen:
+                seen.add(prom)
+                help_ = kinds.get(name, ("", ""))[1]
+                if help_:
+                    lines.append(f"# HELP {prom} {help_}")
+                lines.append(f"# TYPE {prom} {ptype}")
+            return prom, key[len(name):]
+
+        for key, slot in sorted(out_c.items()):
+            prom, suffix = header(key, "counter")
+            lines.append(f"{prom}{suffix} {slot['total']}")
+            for lsuffix, v in slot["series"]:
+                lines.append(f"{prom}{lsuffix} {v}")
+        for key, series in sorted(out_g.items()):
+            prom, _ = header(key, "gauge")
+            for lsuffix, v in series:
+                lines.append(f"{prom}{lsuffix} {v}")
+        for key, slot in sorted(out_h.items()):
+            prom, suffix = header(key, "summary")
+            p50, p90, p99 = _percentiles(slot["samples"])
+            for q, v in ((0.5, p50), (0.9, p90), (0.99, p99)):
+                lbl = suffix[1:-1] + "," if suffix else ""
+                lines.append(f'{prom}{{{lbl}quantile="{q}"}} {v}')
+            lines.append(f"{prom}_sum{suffix} {slot['sum']}")
+            lines.append(f"{prom}_count{suffix} {slot['count']}")
+        parts.append("\n".join(lines) + ("\n" if lines else ""))
+        return "".join(parts)
 
 
 _registry = MetricsRegistry(mirror=True)

@@ -36,7 +36,8 @@ import time
 from dataclasses import dataclass, field
 
 __all__ = ["TraceEvent", "Tracer", "get_tracer", "trace_span",
-           "block_on", "log_perf_event", "perf_logger", "epoch_summary"]
+           "block_on", "log_perf_event", "perf_logger", "epoch_summary",
+           "RequestTraceLog", "get_trace_log"]
 
 perf_logger = logging.getLogger("paddle_tpu.perf")
 
@@ -322,6 +323,63 @@ def _atomic_write(path, write_fn) -> str:
 
 def _atomic_json_dump(obj, path) -> str:
     return _atomic_write(path, lambda f: json.dump(obj, f))
+
+
+# -- completed request-trace log (ISSUE 13) ---------------------------------
+
+class RequestTraceLog:
+    """Bounded store of COMPLETED end-to-end request traces — the
+    ``/statusz`` "N slowest recent traces" source.
+
+    The chrome tracer captures everything while enabled, but a serving
+    fleet needs "what were the slowest requests lately?" answerable at
+    any moment without chrome tracing on. Feeders (the fleet at
+    delivery; a standalone engine at completion) call :meth:`record`
+    with one small summary dict per finished request — ``trace_id``,
+    latency, the condensed hop list the request accumulated across
+    replicas. Memory is fixed (a deque of ``capacity``), recording is
+    O(1), reads copy under the lock — a scrape never observes a
+    half-appended entry."""
+
+    def __init__(self, capacity=256):
+        from collections import deque
+        self.capacity = int(capacity)
+        self._entries = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self.recorded = 0
+
+    def record(self, trace: dict):
+        with self._lock:
+            self._entries.append(dict(trace))
+            self.recorded += 1
+
+    def recent(self, n=None):
+        """Newest-last; the whole resident window by default."""
+        with self._lock:
+            out = list(self._entries)
+        return out if n is None else out[-int(n):]
+
+    def slowest(self, n=10, key="latency_ms"):
+        """The N slowest resident traces, slowest first (ties broken
+        by trace id for a stable /statusz render)."""
+        with self._lock:
+            out = list(self._entries)
+        out.sort(key=lambda e: (-float(e.get(key, 0.0)),
+                                str(e.get("trace_id"))))
+        return out[:int(n)]
+
+    def clear(self):
+        with self._lock:
+            self._entries.clear()
+
+
+_trace_log = RequestTraceLog()
+
+
+def get_trace_log() -> RequestTraceLog:
+    """The process-wide completed-request trace log (always on; the
+    fleet and standalone engines feed it, /statusz reads it)."""
+    return _trace_log
 
 
 _tracer = Tracer(enabled=False)

@@ -33,8 +33,9 @@ def test_known_gates_are_registered():
     assert names == ["atomic_writes", "metric_names",
                      "fast_tier_budget", "elastic_chaos",
                      "serving_chaos", "fleet_chaos", "prefix_cache",
-                     "serving_parity", "fused_parity"]
-    assert len(names) == 9     # ISSUE-12 pin: 9 gates, none dropped
+                     "serving_parity", "fused_parity",
+                     "observability"]
+    assert len(names) == 10    # ISSUE-13 pin: 10 gates, none dropped
 
 
 def test_all_gates_pass_on_healthy_log(tmp_path):
@@ -45,7 +46,7 @@ def test_all_gates_pass_on_healthy_log(tmp_path):
     log = tmp_path / "t1.log"
     log.write_text("606 passed, 2 failed in 115.60s (0:01:55)\n")
     p = _run("--log", str(log), "--no-chaos", "--no-serving",
-             "--no-fused")
+             "--no-fused", "--no-observability")
     assert p.returncode == 0, p.stdout + p.stderr
     assert "atomic_writes: PASS" in p.stdout
     assert "metric_names: PASS" in p.stdout
@@ -56,6 +57,7 @@ def test_all_gates_pass_on_healthy_log(tmp_path):
     assert "prefix_cache" not in p.stdout
     assert "serving_parity" not in p.stdout
     assert "fused_parity" not in p.stdout
+    assert "observability" not in p.stdout
     assert "all gates passed" in p.stdout
 
 
@@ -74,6 +76,7 @@ def test_full_driver_including_chaos_gate(tmp_path):
     assert "prefix_cache: PASS" in p.stdout
     assert "serving_parity: PASS" in p.stdout
     assert "fused_parity: PASS" in p.stdout
+    assert "observability: PASS" in p.stdout
     assert "all gates passed" in p.stdout
 
 
@@ -81,20 +84,21 @@ def test_over_budget_log_fails_the_driver(tmp_path):
     log = tmp_path / "t1.log"
     log.write_text("606 passed in 700.00s (0:11:40)\n")
     p = _run("--log", str(log), "--no-chaos", "--no-serving",
-             "--no-fused")
+             "--no-fused", "--no-observability")
     assert p.returncode == 1
     assert "fast_tier_budget: FAIL" in p.stdout
 
 
 def test_missing_log_is_a_failing_gate(tmp_path):
     p = _run("--log", str(tmp_path / "nope.log"), "--no-chaos",
-             "--no-serving", "--no-fused")
+             "--no-serving", "--no-fused", "--no-observability")
     assert p.returncode == 1     # silence must never read as clean
 
 
 def test_no_budget_skips_only_the_budget_gate(tmp_path):
     p = _run("--no-budget", "--no-chaos", "--no-serving",
-             "--no-fused", "--log", str(tmp_path / "nope.log"))
+             "--no-fused", "--no-observability",
+             "--log", str(tmp_path / "nope.log"))
     assert p.returncode == 0
     assert "atomic_writes: PASS" in p.stdout
     assert "fast_tier_budget" not in p.stdout

@@ -1,0 +1,268 @@
+#!/usr/bin/env python
+"""Bench regression sentinel (ISSUE 13): compare a fresh bench record
+against the BENCH_r*.json trajectory and fail on a perf drop.
+
+The decode number sat flat at ~2,254 tok/s for several rounds and only
+a human reading JSON noticed — exactly the job of a machine gate. This
+tool:
+
+1. loads the repo's bench trajectory (``BENCH_r*.json``, driver
+   wrappers ``{cmd, parsed, rc, tail}`` and raw record lines both
+   accepted; rounds whose ``parsed`` is null — outage rounds — are
+   skipped);
+2. takes the FRESH record (``--fresh FILE``; default: the newest
+   trajectory round with a parsed record, compared against the rounds
+   before it);
+3. for every key in the PER-KEY TOLERANCE TABLE present in the fresh
+   record, finds the most recent COMPARABLE baseline round carrying
+   that key and fails (exit 1) when
+   ``fresh < baseline * (1 - tolerance)``.
+
+Provenance-aware: records stamped with ``provenance.backend`` (PR 9)
+are only compared against records on the SAME backend — a CPU-smoke
+record can never "regress" against a TPU round. Records predating the
+provenance stamp (r01–r03) have an unknown backend, which is treated
+as compatible: the historical trajectory was captured by one driver
+environment, and skipping unknowns would make the whole gate vacuous.
+Improvements are reported informationally; only drops past tolerance
+fail.
+
+``--self-test`` runs the built-in synthetic scenarios (a 20% decode
+drop must flag; an in-tolerance wobble must pass; a cross-backend drop
+must be skipped) — wired into the ``observability`` CI gate
+(tools/run_gates.py) so the sentinel itself cannot rot.
+
+Usage::
+
+    python tools/check_bench_regression.py                 # trajectory
+    python tools/check_bench_regression.py --fresh new.json
+    python tools/check_bench_regression.py --self-test
+
+Exit codes: 0 = no regression, 1 = regression (or broken self-test),
+2 = usage/IO error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: higher-is-better keys -> max tolerated fractional DROP vs the most
+#: recent comparable baseline. Train/decode are tight (stable
+#: single-program measurements); serving-stack numbers carry more
+#: scheduling noise; ratio keys (vs_*) are diagnostics, not gated.
+TOLERANCES = {
+    "value": 0.10,                  # train tokens/s/chip (headline)
+    "decode_value": 0.10,           # the flat-at-2254 number
+    "cb_value": 0.20,               # continuous batching tok/s
+    "cb_unified_tok_s": 0.20,
+    "moe_value": 0.15,
+    "moe_decode_value": 0.20,
+    "train_e2e_tokens_per_sec": 0.15,
+    "cb_overload_tok_s": 0.25,
+    "cb_fleet_tok_s": 0.25,
+    "cb_prefix_warm_tok_s": 0.25,
+    "obs_slo_attainment": 0.10,     # SLO attainment is a perf claim too
+}
+
+
+def load_record(path):
+    """One bench artifact -> (record dict | None, label). Driver
+    wrappers are unwrapped; a null ``parsed`` (outage round) is
+    None."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    label = os.path.basename(path)
+    if isinstance(doc, dict) and "parsed" in doc and "rc" in doc:
+        return doc["parsed"], label
+    return doc if isinstance(doc, dict) else None, label
+
+
+def backend_of(record):
+    """The record's provenance backend, or None for pre-PR-9 records
+    (unknown; treated as comparable — see module docstring)."""
+    prov = record.get("provenance")
+    if isinstance(prov, dict):
+        return prov.get("backend")
+    return None
+
+
+def comparable(fresh_backend, base_backend):
+    """Skip ONLY when both backends are known and differ."""
+    if fresh_backend is None or base_backend is None:
+        return True
+    return fresh_backend == base_backend
+
+
+def check(fresh, baselines, tolerances=None, out=sys.stdout):
+    """Compare one fresh record against a list of (record, label)
+    baselines, oldest first. Returns the list of regression strings
+    (empty = pass); prints one line per checked key."""
+    tolerances = TOLERANCES if tolerances is None else tolerances
+    fb = backend_of(fresh)
+    regressions = []
+    checked = 0
+    for key, tol in sorted(tolerances.items()):
+        v = fresh.get(key)
+        if not isinstance(v, (int, float)):
+            continue
+        base = None
+        for rec, label in reversed(baselines):
+            bv = rec.get(key)
+            if not isinstance(bv, (int, float)) or bv <= 0:
+                continue
+            if not comparable(fb, backend_of(rec)):
+                print(f"[bench-regr] {key}: skipped {label} "
+                      f"(backend {backend_of(rec)!r} != {fb!r})",
+                      file=out)
+                continue
+            base = (bv, label)
+            break
+        if base is None:
+            continue
+        bv, label = base
+        checked += 1
+        floor = bv * (1.0 - tol)
+        delta = (v - bv) / bv
+        status = "OK"
+        if v < floor:
+            status = "REGRESSION"
+            regressions.append(
+                f"{key}: {v} vs {bv} ({label}) — "
+                f"{delta:+.1%} exceeds -{tol:.0%} tolerance")
+        print(f"[bench-regr] {key}: {v} vs {bv} ({label}) "
+              f"{delta:+.1%} [{status}]", file=out)
+    if checked == 0:
+        print("[bench-regr] no comparable keys found — nothing gated",
+              file=out)
+    return regressions
+
+
+def load_trajectory(pattern):
+    paths = sorted(glob.glob(pattern))
+    out = []
+    for p in paths:
+        try:
+            rec, label = load_record(p)
+        except (OSError, ValueError) as e:
+            print(f"[bench-regr] {p}: unreadable ({e}) — skipped",
+                  file=sys.stderr)
+            continue
+        if rec is None:
+            print(f"[bench-regr] {os.path.basename(p)}: no parsed "
+                  "record (outage round) — skipped", file=sys.stderr)
+            continue
+        out.append((rec, label))
+    return out
+
+
+def self_test() -> int:
+    """The sentinel's own gate: synthetic trajectories with known
+    answers. Exit 0 iff every scenario behaves."""
+    import io
+    base = [({"decode_value": 2254.0, "value": 8184.0,
+              "provenance": {"backend": "tpu"}}, "BENCH_sym1.json")]
+    ok = True
+
+    def expect(name, fresh, want_regr):
+        nonlocal ok
+        regs = check(fresh, base, out=io.StringIO())
+        got = bool(regs)
+        verdict = "ok" if got == want_regr else "FAILED"
+        if got != want_regr:
+            ok = False
+        print(f"[self-test] {name}: expected "
+              f"{'regression' if want_regr else 'pass'}, got "
+              f"{'regression' if got else 'pass'} [{verdict}]")
+
+    # the acceptance scenario: a 20% decode tok/s drop must flag
+    expect("20% decode drop",
+           {"decode_value": 2254.0 * 0.80,
+            "provenance": {"backend": "tpu"}}, True)
+    expect("in-tolerance wobble (-5%)",
+           {"decode_value": 2254.0 * 0.95,
+            "provenance": {"backend": "tpu"}}, False)
+    expect("cross-backend drop skipped",
+           {"decode_value": 30.0,
+            "provenance": {"backend": "cpu"}}, False)
+    expect("unknown-provenance fresh compares",
+           {"decode_value": 2254.0 * 0.5}, True)
+    expect("improvement passes",
+           {"decode_value": 2254.0 * 1.3,
+            "provenance": {"backend": "tpu"}}, False)
+    # ratio keys and unknown keys are never gated
+    expect("untracked keys ignored",
+           {"cb_unified_vs_legacy": 0.01,
+            "provenance": {"backend": "tpu"}}, False)
+    print(f"[self-test] {'all scenarios behave' if ok else 'BROKEN'}")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail when a fresh bench record regresses vs the "
+                    "BENCH_r0*.json trajectory")
+    ap.add_argument("--fresh", default=None,
+                    help="path to the fresh record (driver wrapper or "
+                         "raw record JSON); default: the newest "
+                         "trajectory round, checked against the "
+                         "rounds before it")
+    ap.add_argument("--glob", default=os.path.join(REPO,
+                                                   "BENCH_r*.json"),
+                    help="trajectory glob (default ./BENCH_r*.json — "
+                         "NOT 'r0*', which would silently stop "
+                         "matching at round 10)")
+    ap.add_argument("--self-test", action="store_true",
+                    help="run the built-in synthetic scenarios")
+    args = ap.parse_args(argv)
+
+    if args.self_test:
+        return self_test()
+
+    trajectory = load_trajectory(args.glob)
+    if args.fresh is not None:
+        try:
+            fresh, flabel = load_record(args.fresh)
+        except (OSError, ValueError) as e:
+            print(f"[bench-regr] --fresh {args.fresh}: {e}",
+                  file=sys.stderr)
+            return 2
+        if fresh is None:
+            print(f"[bench-regr] --fresh {args.fresh}: no parsed "
+                  "record", file=sys.stderr)
+            return 2
+        # a fresh record already committed into the trajectory must
+        # not be compared against ITSELF (delta +0.0% would mask the
+        # exact regression the sentinel exists to catch)
+        fresh_real = os.path.realpath(args.fresh)
+        baselines = [(rec, label) for rec, label in trajectory
+                     if os.path.realpath(
+                         os.path.join(os.path.dirname(args.glob) or
+                                      ".", label)) != fresh_real
+                     and label != flabel]
+    else:
+        if len(trajectory) < 2:
+            print("[bench-regr] fewer than 2 parsed trajectory "
+                  "records — nothing to compare", file=sys.stderr)
+            return 0
+        (fresh, flabel) = trajectory[-1]
+        baselines = trajectory[:-1]
+
+    print(f"[bench-regr] fresh={flabel} vs {len(baselines)} "
+          f"baseline round(s)")
+    regressions = check(fresh, baselines)
+    if regressions:
+        for r in regressions:
+            print(f"[bench-regr] REGRESSION: {r}", file=sys.stderr)
+        return 1
+    print("[bench-regr] no regression")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,11 +14,21 @@ instrumentation APIs —
 
 and fails the build when a name violates the convention
 (``^[a-z][a-z0-9_]*/[a-z][a-z0-9_]*$``), when a name is used but never
-appears in ``docs/observability.md``, or when the same name is
-declared with two different kinds. Dynamic names (f-strings over a
-gauges() dict etc.) are out of scope by construction — the convention
-is enforced where names are minted, and every minted family has a
-literal ``declare()``.
+appears in ``docs/observability.md``, when the same name is declared
+with two different kinds, or — the ISSUE-13 DEAD-METRIC check — when a
+``declare()``\\ d metric is never incremented/set/observed anywhere in
+the tree. A metric is live when its literal name reaches a metric API
+call, or when it is minted through the prefix-concat idiom
+(``registry.counter("serving/" + k)`` — the engine's ``_StatsView``):
+a metric call whose first argument is ``"<subsystem>/" + <expr>``
+marks the prefix, and a declared name under that prefix counts as live
+iff its suffix appears as a string constant in the SAME file (the
+``_STAT_KEYS`` tuple). A declared name that matches neither is an
+error: a declared-but-never-written metric is documentation lying
+about instrumentation that does not exist. Dynamic names beyond that
+idiom (f-strings over a gauges() dict etc.) are out of scope by
+construction — the convention is enforced where names are minted, and
+every minted family has a literal ``declare()``.
 
 ``--table`` prints the docs metric table GENERATED from the
 ``declare()`` catalog (name | kind | meaning) — paste into
@@ -47,15 +57,22 @@ def _const_str(node):
 
 
 def scan_file(path):
-    """(declares, uses) — declares: [(name, kind, help, line)];
-    uses: [(name, line)] for literal metric-API first args."""
+    """(declares, uses, prefixes, strings) — declares: [(name, kind,
+    help, line)]; uses: [(name, line)] for literal metric-API first
+    args; prefixes: {"serving/", ...} from prefix-concat metric calls
+    (``counter("serving/" + k)``); strings: every string constant in
+    the file (suffix liveness for the prefix-concat idiom)."""
     try:
         tree = ast.parse(open(path, encoding="utf-8").read(),
                          filename=path)
     except SyntaxError as e:
-        return [], [(f"<unparseable: {e}>", 0)]
+        return [], [(f"<unparseable: {e}>", 0)], set(), set()
     declares, uses = [], []
+    prefixes, strings = set(), set()
     for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) \
+                and isinstance(node.value, str):
+            strings.add(node.value)
         if not isinstance(node, ast.Call):
             continue
         func = node.func
@@ -73,11 +90,17 @@ def scan_file(path):
             name = _const_str(node.args[0])
             if name is not None and "/" in name:
                 uses.append((name, node.lineno))
-    return declares, uses
+            elif isinstance(node.args[0], ast.BinOp) \
+                    and isinstance(node.args[0].op, ast.Add):
+                left = _const_str(node.args[0].left)
+                if left is not None and left.endswith("/"):
+                    prefixes.add(left)
+    return declares, uses, prefixes, strings
 
 
 def collect(root):
     declares, uses = {}, []   # name -> (kind, help, file, line)
+    concat = []               # (prefixes, strings) per file
     files = []
     pkg = os.path.join(root, "paddle_tpu")
     for dirpath, dirnames, filenames in os.walk(pkg):
@@ -89,7 +112,7 @@ def collect(root):
         files.append(bench)
     errors = []
     for path in sorted(files):
-        decl, use = scan_file(path)
+        decl, use, prefixes, strings = scan_file(path)
         rel = os.path.relpath(path, root)
         for name, kind, help_, line in decl:
             prev = declares.get(name)
@@ -100,7 +123,31 @@ def collect(root):
             if prev is None or (help_ and not prev[1]):
                 declares[name] = (kind, help_, rel, line)
         uses.extend((name, rel, line) for name, line in use)
-    return declares, uses, errors
+        if prefixes:
+            concat.append((prefixes, strings))
+    return declares, uses, errors, concat
+
+
+def dead_metrics(declares, uses, concat):
+    """Declared-but-never-written names (module docstring): not used
+    as a literal metric-API arg anywhere, and not mintable through a
+    same-file prefix-concat idiom."""
+    used = {n for n, _, _ in uses}
+    dead = []
+    for name in declares:
+        if name in used:
+            continue
+        alive = False
+        for prefixes, strings in concat:
+            for p in prefixes:
+                if name.startswith(p) and name[len(p):] in strings:
+                    alive = True
+                    break
+            if alive:
+                break
+        if not alive:
+            dead.append(name)
+    return dead
 
 
 def main(argv=None) -> int:
@@ -111,7 +158,7 @@ def main(argv=None) -> int:
     root = argv[0] if argv else \
         os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-    declares, uses, errors = collect(root)
+    declares, uses, errors, concat = collect(root)
 
     if table:
         print("| metric | kind | meaning |")
@@ -144,6 +191,13 @@ def main(argv=None) -> int:
                 f"{rel}:{line}: metric {name!r} is not documented in "
                 f"{DOCS} (add a `{name}` row; regenerate with "
                 "tools/check_metric_names.py --table)")
+
+    for name in sorted(dead_metrics(declares, uses, concat)):
+        _, _, rel, line = declares[name]
+        errors.append(
+            f"{rel}:{line}: metric {name!r} is declared but never "
+            "incremented/set/observed anywhere in the tree (dead "
+            "metric — instrument it or drop the declare())")
 
     for e in errors:
         print(e)

@@ -19,6 +19,7 @@ Usage::
     python tools/run_gates.py --no-chaos          # skip both chaos smokes
     python tools/run_gates.py --no-serving        # skip engine parity
     python tools/run_gates.py --no-fused          # skip kernel parity
+    python tools/run_gates.py --no-observability  # skip the obs smoke
 
 ``--no-budget`` skips the fast-tier budget gate for contexts where no
 tier-1 log exists (e.g. pre-commit on a docs change); ``--no-chaos``
@@ -42,7 +43,8 @@ REPO_DIR = os.path.dirname(TOOLS_DIR)
 
 def gate_commands(log: str, budget: float, no_budget: bool,
                   no_chaos: bool = False, no_serving: bool = False,
-                  no_fused: bool = False):
+                  no_fused: bool = False,
+                  no_observability: bool = False):
     """The authoritative gate list: (name, argv). New hygiene gates
     register HERE (tests/test_gates.py pins the known ones so a gate
     cannot be dropped silently)."""
@@ -142,6 +144,24 @@ def gate_commands(log: str, budget: float, no_budget: bool,
                            "test_fused_training_kernels.py"),
               "-q", "-m", "fused_parity",
               "-p", "no:cacheprovider"]))
+    if not no_observability:
+        # observability smoke (ISSUE 13): exposition endpoints stay
+        # parseable + federated counters monotonic under replica
+        # churn, one trace id survives preemption/failover/hedging,
+        # SLO burn-rate math + alerts behave, and the bench regression
+        # sentinel's --self-test passes (a marked test shells out to
+        # tools/check_bench_regression.py). The FULL marker — slow
+        # included: the breadth tests were moved out of tier-1 for the
+        # fast-tier budget, and this gate is where they still run on
+        # every gate pass
+        gates.append(
+            ("observability",
+             [sys.executable, "-m", "pytest",
+              os.path.join(REPO_DIR, "tests", "test_exposition.py"),
+              os.path.join(REPO_DIR, "tests", "test_fleet_trace.py"),
+              os.path.join(REPO_DIR, "tests", "test_slo.py"),
+              "-q", "-m", "observability",
+              "-p", "no:cacheprovider"]))
     return gates
 
 
@@ -167,12 +187,17 @@ def main(argv=None) -> int:
                     help="skip the fused training-kernel parity gate "
                          "(interpret-mode kernel suite, fused flags "
                          "forced on)")
+    ap.add_argument("--no-observability", action="store_true",
+                    help="skip the observability smoke gate "
+                         "(exposition under churn + trace propagation "
+                         "+ SLO + bench-regression self-test)")
     args = ap.parse_args(argv)
 
     failures = 0
     for name, cmd in gate_commands(args.log, args.budget,
                                    args.no_budget, args.no_chaos,
-                                   args.no_serving, args.no_fused):
+                                   args.no_serving, args.no_fused,
+                                   args.no_observability):
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True)
             rc = proc.returncode
