@@ -1043,6 +1043,130 @@ def _cb_prefix_bench(on_tpu):
     return out
 
 
+def _cb_http_bench(on_tpu):
+    """HTTP front door overhead (ISSUE 15): the load harness drives
+    the OpenAI-compatible API server (tools/load_harness.py as a
+    SEPARATE process — a real client, not an in-process shortcut)
+    against an engine-backed ApiServer, next to the SAME workload
+    pushed straight into an identically configured engine. Interleaved
+    best-of-N on both legs because single-core boxes drift; the ratio
+    is the front door's all-in cost (asyncio sockets, SSE framing,
+    pump bridging, AND the client's own parsing — which shares the
+    engine's core when there is only one). BASELINE.md documents the
+    keys and the single-core caveat."""
+    import json as _json
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ApiServer, ContinuousBatchingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig.llama_1b()
+        slots, page, chunk, max_len = 8, 32, 32, 384
+        n_req, conc, new_lo, new_hi = 64, 24, 128, 192
+        sse_chunk, reps = 32, 2
+    else:
+        cfg = LlamaConfig.tiny()
+        slots, page, chunk, max_len = 4, 8, 4, 128
+        n_req, conc, new_lo, new_hi = 48, 16, 80, 100
+        sse_chunk, reps = 32, 3
+    cfg.tensor_parallel = False
+    cfg.scan_layers = False
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+
+    def factory():
+        return ContinuousBatchingEngine(
+            model, num_slots=slots, page_size=page, max_len=max_len,
+            decode_chunk=chunk, prompt_buckets=(8, 16), greedy=True)
+
+    rng = np.random.RandomState(44)
+    specs = [(rng.randint(0, cfg.vocab_size,
+                          (int(rng.randint(3, 6)),)).astype(np.int32),
+              int(rng.randint(new_lo, new_hi + 1)))
+             for _ in range(n_req)]
+
+    def warm(e):
+        for p, n in specs[:8]:
+            e.add_request(p, n)
+        e.run()
+
+    direct = factory()
+    warm(direct)
+    served = factory()
+    warm(served)
+    srv = ApiServer(served, stream_chunk_tokens=sse_chunk).start()
+
+    def direct_once():
+        t0 = time.perf_counter()
+        for p, n in specs:
+            direct.add_request(p, n)
+        done = direct.run()
+        wall = max(time.perf_counter() - t0, 1e-9)
+        return sum(len(r.tokens) for r in done) / wall
+
+    def http_once():
+        with tempfile.NamedTemporaryFile(
+                suffix=".json", delete=False) as tf:
+            rep_path = tf.name
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "load_harness.py"),
+             "--url", srv.url, "--requests", str(n_req),
+             "--concurrency", str(conc), "--mode", "closed",
+             "--vocab", str(cfg.vocab_size),
+             "--prompt-len", "3", "5",
+             "--max-new", str(new_lo), str(new_hi),
+             "--prefix-frac", "0.25", "--prefix-len", "4",
+             "--tenants", "tenant0,tenant1",
+             "--seed", "44", "--report", rep_path],
+            capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"load harness failed: {proc.stderr[-500:]}")
+        with open(rep_path) as f:
+            report = _json.load(f)
+        os.unlink(rep_path)
+        return report
+
+    try:
+        direct_tps = 0.0
+        best = None
+        for _ in range(reps):
+            direct_tps = max(direct_tps, direct_once())
+            rep = http_once()
+            if best is None or rep["tok_s"] > best["tok_s"]:
+                best = rep
+    finally:
+        srv.stop()
+
+    out = {
+        "cb_http_tok_s": round(best["tok_s"], 2),
+        "cb_http_p99_ttft_ms": round(best["ttft_ms_p99"], 2),
+        "cb_http_goodput_frac": round(best["goodput_frac"], 4),
+        "cb_http_vs_engine": round(best["tok_s"] / direct_tps, 4)
+        if direct_tps else 0.0,
+    }
+    print(f"# cb http: {n_req} SSE streams x{conc} concurrent through "
+          f"the front door, {out['cb_http_tok_s']} tok/s delivered "
+          f"(direct engine {direct_tps:.1f}, "
+          f"x{out['cb_http_vs_engine']}), p99 ttft "
+          f"{out['cb_http_p99_ttft_ms']} ms, goodput "
+          f"{out['cb_http_goodput_frac']}, "
+          f"{best['completed_ok']}/{best['requests']} ok, "
+          f"errors {best['errors'] or '{}'}",
+          file=sys.stderr)
+    return out
+
+
 def _moe_bench_config(on_tpu):
     """The BASELINE config-5 bench shape, shared by the MoE train
     section and the breakdown section (attribution fractions are only
@@ -1578,6 +1702,21 @@ def main():
     gc.collect()
     if cb_prefix is not None:
         record.update(cb_prefix)
+        print(json.dumps(record), flush=True)
+
+    # HTTP front door (ISSUE 15): what serving costs once a real
+    # client on a real socket is in the loop, next to the raw engine
+    try:
+        cb_http = _timed_section(
+            "cb http", lambda: _retry_transient(
+                lambda: _cb_http_bench(on_tpu),
+                "cb http bench"))
+    except Exception as e:
+        print(f"# cb http bench failed: {e!r}", file=sys.stderr)
+        cb_http = None
+    gc.collect()
+    if cb_http is not None:
+        record.update(cb_http)
         print(json.dumps(record), flush=True)
 
     try:

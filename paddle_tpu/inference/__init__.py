@@ -40,13 +40,14 @@ from .reliability import (AdmissionController, DeadlineExceeded,
                           RequestQuarantined, ServingError)
 from .serving import ContinuousBatchingEngine, ServedRequest
 from .fleet import FleetReplica, ServingFleet
+from .api_server import ApiServer
 
 __all__ = ["Config", "Predictor", "Tensor", "PrecisionType", "PlaceType",
            "create_predictor", "get_version", "ContinuousBatchingEngine",
            "ServedRequest", "AdmissionController", "EngineSupervisor",
            "ServingError", "RequestCancelled", "DeadlineExceeded",
            "RequestQuarantined", "Overloaded", "ReplicaFailed",
-           "ServingFleet", "FleetReplica"]
+           "ServingFleet", "FleetReplica", "ApiServer"]
 
 
 class PrecisionType(enum.Enum):

@@ -286,6 +286,13 @@ _pc_copy_page = jax.jit(lambda pools, src, dst:
                         [p.at[:, dst].set(p[:, src]) for p in pools])
 
 
+#: the priority band EXTERNAL requests are clamped into by the HTTP
+#: front door (inference/api_server.py): higher wins admission order
+#: and may preempt. In-process callers may use any int — the band only
+#: bounds what an untrusted client can claim over the wire.
+PRIORITY_RANGE = (0, 15)
+
+
 @dataclass(eq=False)
 class ServedRequest:
     request_id: int

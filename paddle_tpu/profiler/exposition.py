@@ -42,22 +42,13 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from . import metrics as _metrics
+# the response skeleton is SHARED with the API front door
+# (inference/api_server.py) via httpbase so the two servers cannot
+# drift on torn-response / Content-Length behavior; evaluate_sections
+# is re-exported from its historical home here
+from .httpbase import evaluate_sections, materialize_response
 
 __all__ = ["ObservabilityServer", "evaluate_sections"]
-
-
-def evaluate_sections(sections) -> dict:
-    """Evaluate named section providers into one dict, each GUARDED —
-    a provider raising mid-churn degrades to an ``{"error": ...}``
-    stanza instead of tearing the document. The ONE loop behind both
-    the HTTP ``/statusz`` render and ``ServingFleet.statusz()``."""
-    doc = {}
-    for name, provider in dict(sections).items():
-        try:
-            doc[name] = provider()
-        except Exception as exc:  # noqa: BLE001 — degrade per section
-            doc[name] = {"error": f"{type(exc).__name__}: {exc}"}
-    return doc
 
 _metrics.declare("obs/scrapes", "counter",
                  "HTTP scrapes served by the ObservabilityServer "
@@ -79,10 +70,10 @@ class _Handler(BaseHTTPRequestHandler):
         pass
 
     def _send(self, code, body, ctype):
-        data = body.encode("utf-8")
+        code, headers, data = materialize_response(code, body, ctype)
         self.send_response(code)
-        self.send_header("Content-Type", ctype)
-        self.send_header("Content-Length", str(len(data)))
+        for name, value in headers:
+            self.send_header(name, value)
         self.end_headers()
         self.wfile.write(data)
 

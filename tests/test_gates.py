@@ -34,8 +34,8 @@ def test_known_gates_are_registered():
                      "fast_tier_budget", "elastic_chaos",
                      "serving_chaos", "fleet_chaos", "prefix_cache",
                      "serving_parity", "fused_parity",
-                     "observability"]
-    assert len(names) == 10    # ISSUE-13 pin: 10 gates, none dropped
+                     "observability", "http_api"]
+    assert len(names) == 11    # ISSUE-15 pin: 11 gates, none dropped
 
 
 def test_all_gates_pass_on_healthy_log(tmp_path):
@@ -46,7 +46,7 @@ def test_all_gates_pass_on_healthy_log(tmp_path):
     log = tmp_path / "t1.log"
     log.write_text("606 passed, 2 failed in 115.60s (0:01:55)\n")
     p = _run("--log", str(log), "--no-chaos", "--no-serving",
-             "--no-fused", "--no-observability")
+             "--no-fused", "--no-observability", "--no-http")
     assert p.returncode == 0, p.stdout + p.stderr
     assert "atomic_writes: PASS" in p.stdout
     assert "metric_names: PASS" in p.stdout
@@ -58,6 +58,7 @@ def test_all_gates_pass_on_healthy_log(tmp_path):
     assert "serving_parity" not in p.stdout
     assert "fused_parity" not in p.stdout
     assert "observability" not in p.stdout
+    assert "http_api" not in p.stdout
     assert "all gates passed" in p.stdout
 
 
@@ -77,6 +78,7 @@ def test_full_driver_including_chaos_gate(tmp_path):
     assert "serving_parity: PASS" in p.stdout
     assert "fused_parity: PASS" in p.stdout
     assert "observability: PASS" in p.stdout
+    assert "http_api: PASS" in p.stdout
     assert "all gates passed" in p.stdout
 
 
@@ -84,20 +86,21 @@ def test_over_budget_log_fails_the_driver(tmp_path):
     log = tmp_path / "t1.log"
     log.write_text("606 passed in 700.00s (0:11:40)\n")
     p = _run("--log", str(log), "--no-chaos", "--no-serving",
-             "--no-fused", "--no-observability")
+             "--no-fused", "--no-observability", "--no-http")
     assert p.returncode == 1
     assert "fast_tier_budget: FAIL" in p.stdout
 
 
 def test_missing_log_is_a_failing_gate(tmp_path):
     p = _run("--log", str(tmp_path / "nope.log"), "--no-chaos",
-             "--no-serving", "--no-fused", "--no-observability")
+             "--no-serving", "--no-fused", "--no-observability",
+             "--no-http")
     assert p.returncode == 1     # silence must never read as clean
 
 
 def test_no_budget_skips_only_the_budget_gate(tmp_path):
     p = _run("--no-budget", "--no-chaos", "--no-serving",
-             "--no-fused", "--no-observability",
+             "--no-fused", "--no-observability", "--no-http",
              "--log", str(tmp_path / "nope.log"))
     assert p.returncode == 0
     assert "atomic_writes: PASS" in p.stdout

@@ -44,7 +44,8 @@ REPO_DIR = os.path.dirname(TOOLS_DIR)
 def gate_commands(log: str, budget: float, no_budget: bool,
                   no_chaos: bool = False, no_serving: bool = False,
                   no_fused: bool = False,
-                  no_observability: bool = False):
+                  no_observability: bool = False,
+                  no_http: bool = False):
     """The authoritative gate list: (name, argv). New hygiene gates
     register HERE (tests/test_gates.py pins the known ones so a gate
     cannot be dropped silently)."""
@@ -162,6 +163,24 @@ def gate_commands(log: str, budget: float, no_budget: bool,
               os.path.join(REPO_DIR, "tests", "test_slo.py"),
               "-q", "-m", "observability",
               "-p", "no:cacheprovider"]))
+    if not no_http:
+        # HTTP front door smoke (ISSUE 15): OpenAI-compatible SSE
+        # contracts (framing, option mapping, 429 Retry-After,
+        # disconnect -> cancel -> page reclaim) plus the fleet-backed
+        # kill-one-replica sweeps driven by the load harness — every
+        # stream completes or ends typed, clean streams are
+        # oracle-identical. The FULL marker, slow tests included: the
+        # kill smoke and the >=64-connection full-scale sweep are
+        # slow-marked for the fast-tier wall budget and this gate is
+        # where they still run on every pass (the observability-gate
+        # pattern).
+        gates.append(
+            ("http_api",
+             [sys.executable, "-m", "pytest",
+              os.path.join(REPO_DIR, "tests", "test_api_server.py"),
+              os.path.join(REPO_DIR, "tests", "test_api_chaos.py"),
+              "-q", "-m", "http_api",
+              "-p", "no:cacheprovider"]))
     return gates
 
 
@@ -191,13 +210,18 @@ def main(argv=None) -> int:
                     help="skip the observability smoke gate "
                          "(exposition under churn + trace propagation "
                          "+ SLO + bench-regression self-test)")
+    ap.add_argument("--no-http", action="store_true",
+                    help="skip the HTTP front door smoke gate (SSE "
+                         "contracts + fleet-backed kill sweep through "
+                         "the API server)")
     args = ap.parse_args(argv)
 
     failures = 0
     for name, cmd in gate_commands(args.log, args.budget,
                                    args.no_budget, args.no_chaos,
                                    args.no_serving, args.no_fused,
-                                   args.no_observability):
+                                   args.no_observability,
+                                   args.no_http):
         try:
             proc = subprocess.run(cmd, capture_output=True, text=True)
             rc = proc.returncode
