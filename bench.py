@@ -935,6 +935,159 @@ def _cb_fleet_bench(on_tpu):
     return out
 
 
+def _cb_procfleet_bench(on_tpu):
+    """Process-backed serving fleet (ISSUE 16): the fleet workload
+    over 4 REAL worker processes (``ProcReplica`` spawning ``python -m
+    paddle_tpu.inference.worker``), with one worker SIGKILLed mid-run
+    hard enough to spend its respawn budget and trip the breaker —
+    aggregate delivered tok/s with the wire + failover cost included,
+    the routed p99 TTFT, the failover latency, and the ratio vs the
+    SAME workload + kill on the in-process fleet (the process
+    boundary's all-in cost; ``vs_*`` keys are never gated). The
+    survivors then serve a small load-harness trace through an
+    ``ApiServer`` mounted on the proc-backed fleet — the front-door
+    smoke key. Workers always run the tiny CPU model, even on a TPU
+    host: this section measures orchestration (wire RPCs, respawn,
+    salvage, reroute), which the accelerator does not change, and N
+    worker processes cannot share one chip. BASELINE.md documents the
+    keys (and the TPU-host caveat on the in-proc denominator)."""
+    import json as _json
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (ApiServer,
+                                      ContinuousBatchingEngine,
+                                      ProcReplica, ServingFleet)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.testing import FaultInjector
+
+    eng_kw = dict(num_slots=2, page_size=8, max_len=48,
+                  decode_chunk=4, prompt_buckets=(8, 16), greedy=True)
+    spec = {"factory": "paddle_tpu.inference.worker:llama_engine",
+            "kwargs": dict(model="tiny", num_hidden_layers=1, seed=0,
+                           **eng_kw)}
+    # kill at the SECOND step: any request costs >= 2 steps, so the
+    # budget-spending kill always finds in-flight work to salvage —
+    # a later kill can land on a replica whose whole share already
+    # finished (the PR-15 kill-smoke lesson), zeroing the failover
+    # sample the section exists to price
+    n_req, kill_after = 24, 1
+    h_req, h_conc = 12, 4
+
+    cfg = LlamaConfig.tiny()
+    cfg.tensor_parallel = False
+    cfg.scan_layers = False
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    def factory():
+        return ContinuousBatchingEngine(model, **eng_kw)
+
+    rng = np.random.RandomState(44)
+    specs = [(rng.randint(0, cfg.vocab_size,
+                          (int(rng.randint(3, 10)),)).astype(np.int32),
+              int(rng.randint(2, 7))) for _ in range(n_req)]
+
+    def run_leg(fleet, fi_install):
+        for rep in fleet.replicas.values():
+            fleet._warm(rep)
+        t0 = time.perf_counter()
+        with FaultInjector() as fi:
+            fi_install(fi)
+            fids = [fleet.submit(p, n) for p, n in specs]
+            done = fleet.run()
+        wall = max(time.perf_counter() - t0, 1e-9)
+        by = {r.request_id: r for r in done}
+        ok = [by[f] for f in fids if by[f].error is None]
+        toks = sum(len(r.tokens) for r in ok)
+        ttfts = sorted((r.t_first - r.t_arrive) * 1e3
+                       for r in ok if r.t_first)
+        p99 = ttfts[max(0, int(round(0.99 * (len(ttfts) - 1))))] \
+            if ttfts else 0.0
+        return toks / wall, p99, len(ok), len(fids)
+
+    # in-process A/B: the SAME workload + mid-run kill through the
+    # in-process fleet — the denominator of cb_procfleet_vs_inproc
+    inproc = ServingFleet(factory, num_replicas=4, max_restarts=1,
+                          retry_backoff_s=0.01)
+    inproc_tps, _, _, _ = run_leg(
+        inproc, lambda fi: fi.kill_replica(1, times=10_000,
+                                           after_steps=kill_after))
+
+    # worker processes inherit the parent's platform pin; force CPU
+    # for the section's whole lifetime so RESPAWNS stay CPU too
+    prev_plat = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    fleet = ServingFleet(spec, num_replicas=4, max_restarts=1,
+                         retry_backoff_s=0.01,
+                         replica_cls=ProcReplica,
+                         replica_kwargs=dict(hb_timeout_s=5.0,
+                                             respawn_backoff_s=0.01))
+    srv = None
+    try:
+        tps, p99, n_ok, n_all = run_leg(
+            fleet, lambda fi: fi.kill_worker(1, times=10_000,
+                                             after_steps=kill_after))
+        g = fleet.gauges()
+
+        # front-door smoke: the surviving workers behind an ApiServer,
+        # driven by the load harness as a separate client process
+        srv = ApiServer(fleet).start()
+        with tempfile.NamedTemporaryFile(suffix=".json",
+                                         delete=False) as tf:
+            rep_path = tf.name
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "tools", "load_harness.py"),
+             "--url", srv.url, "--requests", str(h_req),
+             "--concurrency", str(h_conc), "--mode", "closed",
+             "--vocab", str(cfg.vocab_size),
+             "--prompt-len", "3", "5", "--max-new", "2", "6",
+             "--seed", "44", "--report", rep_path],
+            capture_output=True, text=True, timeout=600)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"load harness failed: {proc.stderr[-500:]}")
+        with open(rep_path) as f:
+            report = _json.load(f)
+        os.unlink(rep_path)
+    finally:
+        if srv is not None:
+            srv.stop()
+        fleet.close()
+        if prev_plat is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev_plat
+
+    out = {
+        "cb_procfleet_tok_s": round(tps, 2),
+        "cb_procfleet_p99_ttft_ms": round(p99, 2),
+        "cb_procfleet_failover_ms": round(g["failover_ms_p99"], 2),
+        "cb_procfleet_vs_inproc": round(tps / inproc_tps, 4)
+        if inproc_tps else 0.0,
+        "cb_procfleet_http_goodput_frac": round(
+            report["goodput_frac"], 4),
+    }
+    print(f"# cb procfleet: {n_all} requests over 4 process workers, "
+          f"worker 1 SIGKILLed mid-run (breaker "
+          f"{'open' if g['breaker_open'] else 'CLOSED?'}), "
+          f"{out['cb_procfleet_tok_s']} tok/s delivered "
+          f"({n_ok}/{n_all} ok, vs in-proc fleet "
+          f"x{out['cb_procfleet_vs_inproc']}), p99 ttft "
+          f"{out['cb_procfleet_p99_ttft_ms']} ms, failover "
+          f"{out['cb_procfleet_failover_ms']} ms, http goodput "
+          f"{out['cb_procfleet_http_goodput_frac']} "
+          f"({report['completed_ok']}/{report['requests']} ok)",
+          file=sys.stderr)
+    return out
+
+
 def _cb_prefix_bench(on_tpu):
     """Shared-prefix storm (ISSUE 12): the acceptance A/B for
     radix-tree prefix caching — N requests sharing one long prefix
@@ -1687,6 +1840,22 @@ def main():
     gc.collect()
     if cb_fleet is not None:
         record.update(cb_fleet)
+        print(json.dumps(record), flush=True)
+
+    # process-backed fleet (ISSUE 16): the same failover economics
+    # with REAL worker processes on the wire, next to the in-process
+    # fleet numbers they contextualize
+    try:
+        cb_procfleet = _timed_section(
+            "cb procfleet", lambda: _retry_transient(
+                lambda: _cb_procfleet_bench(on_tpu),
+                "cb procfleet bench"))
+    except Exception as e:
+        print(f"# cb procfleet bench failed: {e!r}", file=sys.stderr)
+        cb_procfleet = None
+    gc.collect()
+    if cb_procfleet is not None:
+        record.update(cb_procfleet)
         print(json.dumps(record), flush=True)
 
     # shared-prefix storm (ISSUE 12): the prefix-cache cold/warm A/B

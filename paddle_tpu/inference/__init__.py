@@ -41,13 +41,18 @@ from .reliability import (AdmissionController, DeadlineExceeded,
 from .serving import ContinuousBatchingEngine, ServedRequest
 from .fleet import FleetReplica, ServingFleet
 from .api_server import ApiServer
+from .proc_replica import ProcReplica
+from .wire import (FrameCorrupt, FrameOutOfOrder, FrameTooLarge,
+                   WireClosed, WireError, WireTimeout)
 
 __all__ = ["Config", "Predictor", "Tensor", "PrecisionType", "PlaceType",
            "create_predictor", "get_version", "ContinuousBatchingEngine",
            "ServedRequest", "AdmissionController", "EngineSupervisor",
            "ServingError", "RequestCancelled", "DeadlineExceeded",
            "RequestQuarantined", "Overloaded", "ReplicaFailed",
-           "ServingFleet", "FleetReplica", "ApiServer"]
+           "ServingFleet", "FleetReplica", "ApiServer", "ProcReplica",
+           "WireError", "FrameCorrupt", "FrameTooLarge",
+           "FrameOutOfOrder", "WireTimeout", "WireClosed"]
 
 
 class PrecisionType(enum.Enum):

@@ -260,6 +260,15 @@ class FleetReplica:
         return self.has_work() and self._stale_turns >= int(
             no_progress_turns)
 
+    def on_eject(self, kind):
+        """Ejection hook for replica subclasses holding external
+        resources (a process-backed replica reaps its worker here);
+        no-op for the in-process replica."""
+
+    def close(self):
+        """Teardown hook (scale-down retire / fleet close); no-op for
+        the in-process replica."""
+
 
 @dataclass(eq=False)
 class _Tracked:
@@ -317,12 +326,19 @@ class ServingFleet:
                  retry_jitter=0.25, hedge_delay_s=None,
                  hedge_factor=3.0, hedge_min_delay_s=0.05,
                  no_progress_turns=25, drain_deadline_s=30.0,
-                 all_open_retry_after_s=1.0, seed=0, slo_rules=None):
+                 all_open_retry_after_s=1.0, seed=0, slo_rules=None,
+                 replica_cls=None, replica_kwargs=None):
         self._factory = engine_factory
+        #: the ISSUE-16 seam: a FleetReplica subclass (e.g.
+        #: ProcReplica, whose "factory" is a worker spec dict) slots
+        #: in here — the router below never knows the difference
+        self._replica_cls = replica_cls or FleetReplica
         self._rep_kw = dict(max_restarts=int(max_restarts),
                             max_queue=int(max_queue),
                             default_ttft_slo_s=default_ttft_slo_s,
                             min_retry_after_s=float(min_retry_after_s))
+        if replica_kwargs:
+            self._rep_kw.update(replica_kwargs)
         self.max_retries = int(max_retries)
         self.retry_backoff_s = float(retry_backoff_s)
         self.retry_backoff_cap_s = float(retry_backoff_cap_s)
@@ -374,7 +390,7 @@ class ServingFleet:
     def _add_replica(self, factory, federate=True):
         rid = self._next_replica_id
         self._next_replica_id += 1
-        rep = FleetReplica(rid, factory, **self._rep_kw)
+        rep = self._replica_cls(rid, factory, **self._rep_kw)
         self.replicas[rid] = rep
         if federate:
             self._federate(rep)
@@ -732,6 +748,7 @@ class ServingFleet:
         _frec.record_event("fleet_eject", replica=rep.id, cause=kind,
                            error=repr(exc)[:200])
         salvage = salvage_unfinished(rep.engine)
+        rep.on_eject(kind)   # after salvage: the shadow was the source
         return self._reroute(salvage, rep, exc,
                              count_retry=kind != "operator")
 
@@ -940,6 +957,7 @@ class ServingFleet:
             self.metrics.counter("fleet/drains").inc()
             _frec.record_event("fleet_drain_done", replica=rep.id,
                                evicted=0)
+            rep.close()
         elif rep.drain_deadline is not None \
                 and time.perf_counter() >= rep.drain_deadline:
             stragglers = rep.engine.handoff()
@@ -947,6 +965,7 @@ class ServingFleet:
             self.metrics.counter("fleet/drains").inc()
             _frec.record_event("fleet_drain_done", replica=rep.id,
                                evicted=len(stragglers))
+            rep.close()
             done.extend(self._reroute(
                 stragglers, rep,
                 RuntimeError("drain deadline"), count_retry=False))
@@ -993,6 +1012,13 @@ class ServingFleet:
                 break
             rep.step()
         eng.reset_gauges()
+
+    def close(self):
+        """Release every replica's external resources (a process-
+        backed replica reaps its worker here); results and gauges
+        remain readable — only the replicas' backends are gone."""
+        for rep in self.replicas.values():
+            rep.close()
 
     def eject(self, replica_id, reason="operator"):
         """Operator-initiated immediate ejection (no drain): the

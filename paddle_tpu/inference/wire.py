@@ -1,0 +1,303 @@
+"""Length-prefixed JSON frame protocol for process-backed replicas
+(ISSUE 16).
+
+One parent (:class:`~paddle_tpu.inference.proc_replica.ProcReplica`)
+and one worker (``python -m paddle_tpu.inference.worker``) speak this
+protocol over a local ``socketpair``. The wire is treated as HOSTILE:
+frames carry a magic, an explicit length and a CRC32, every payload is
+a JSON object with a strictly-increasing per-direction sequence
+number, and every way a frame can be wrong — truncated, oversized,
+garbage bytes, bit-flipped, duplicated, reordered — surfaces as a
+TYPED :class:`WireError` subclass, never a hang and never a silently
+half-applied message. After a corrupt stretch the decoder RESYNCS by
+scanning forward to the next magic, so one mangled frame costs one
+typed error, not the connection.
+
+Frame layout (all integers big-endian)::
+
+    MAGIC(2) | length(4) | crc32(4) | payload = JSON utf-8
+
+Fault hooks: :func:`add_fault_hook` registers a process-local callable
+``hook(replica_id, direction, data) -> data | None`` consulted by
+PARENT-side transports on every send (``direction="tx"``) and every
+socket read (``"rx"``); returning ``None`` drops the bytes, returning
+different bytes corrupts them, and sleeping inside the hook delays
+them. This is the injection point for the FaultInjector's
+``drop_frame`` / ``delay_frame`` / ``corrupt_frame`` plans — the
+production code path is exercised unmodified.
+
+Stdlib only by design: the worker boundary must not grow a dependency
+the parent cannot guarantee.
+"""
+
+from __future__ import annotations
+
+import json
+import select
+import socket
+import threading
+import zlib
+
+MAGIC = b"\xa5\x5a"
+_HEADER = len(MAGIC) + 4 + 4
+#: frames above this are a protocol violation (a corrupt length field
+#: reads as a huge allocation request — reject, resync, move on)
+MAX_FRAME = 8 * 1024 * 1024
+
+
+class WireError(RuntimeError):
+    """Base of every typed wire failure (never raised bare)."""
+
+
+class FrameCorrupt(WireError):
+    """Bad magic, bad CRC, or a payload that is not a JSON object."""
+
+
+class FrameTooLarge(WireError):
+    """Length field exceeds ``MAX_FRAME`` — framing is lost."""
+
+
+class FrameOutOfOrder(WireError):
+    """Sequence number not strictly increasing (duplicate or replay)."""
+
+
+class WireTimeout(WireError):
+    """No complete frame within the caller's deadline."""
+
+
+class WireClosed(WireError):
+    """Peer EOF or a dead socket — the worker is gone."""
+
+
+# ---- fault hooks (FaultInjector seam) --------------------------------------
+
+_fault_hooks: list = []
+_hooks_lock = threading.Lock()
+
+
+def add_fault_hook(hook):
+    """Register ``hook(replica_id, direction, data) -> data | None``
+    (see module docstring). Returns the hook for ``remove``."""
+    with _hooks_lock:
+        _fault_hooks.append(hook)
+    return hook
+
+
+def remove_fault_hook(hook):
+    with _hooks_lock:
+        try:
+            _fault_hooks.remove(hook)
+        except ValueError:
+            pass
+
+
+def _apply_hooks(replica_id, direction, data):
+    with _hooks_lock:
+        hooks = list(_fault_hooks)
+    for hook in hooks:
+        if data is None:
+            break
+        data = hook(replica_id, direction, data)
+    return data
+
+
+# ---- framing ---------------------------------------------------------------
+
+def encode_frame(obj) -> bytes:
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(payload) > MAX_FRAME:
+        raise FrameTooLarge(
+            f"payload {len(payload)} bytes exceeds MAX_FRAME "
+            f"{MAX_FRAME}")
+    return (MAGIC + len(payload).to_bytes(4, "big")
+            + zlib.crc32(payload).to_bytes(4, "big") + payload)
+
+
+class FrameDecoder:
+    """Incremental decoder with resync. ``feed`` bytes in any
+    chunking; ``next_frame`` yields one payload (bytes) or ``None``
+    when more input is needed, raising a typed :class:`WireError` for
+    each corrupt stretch AFTER advancing past it — the caller can keep
+    calling and the next intact frame still decodes."""
+
+    def __init__(self, max_frame=MAX_FRAME):
+        self._buf = bytearray()
+        self._max = int(max_frame)
+        self.errors = 0
+
+    def feed(self, data: bytes):
+        self._buf += data
+
+    def pending(self) -> int:
+        return len(self._buf)
+
+    def _resync(self, skip):
+        """Drop ``skip`` bytes, then everything up to the next magic;
+        returns how many bytes were discarded in total."""
+        del self._buf[:skip]
+        idx = self._buf.find(MAGIC)
+        if idx < 0:
+            # keep the last byte: it may be the first half of a magic
+            # split across reads
+            keep = 1 if self._buf[-1:] == MAGIC[:1] else 0
+            dropped = skip + len(self._buf) - keep
+            del self._buf[:len(self._buf) - keep]
+            return dropped
+        del self._buf[:idx]
+        return skip + idx
+
+    def next_frame(self):
+        if len(self._buf) < _HEADER:
+            if self._buf and not MAGIC.startswith(
+                    bytes(self._buf[:2])):
+                self.errors += 1
+                n = self._resync(1)
+                raise FrameCorrupt(f"bad magic ({n} bytes dropped)")
+            return None
+        if bytes(self._buf[:2]) != MAGIC:
+            self.errors += 1
+            n = self._resync(1)
+            raise FrameCorrupt(f"bad magic ({n} bytes dropped)")
+        length = int.from_bytes(self._buf[2:6], "big")
+        if length > self._max:
+            self.errors += 1
+            self._resync(2)
+            raise FrameTooLarge(
+                f"frame length {length} exceeds {self._max}")
+        if len(self._buf) < _HEADER + length:
+            return None
+        crc = int.from_bytes(self._buf[6:10], "big")
+        payload = bytes(self._buf[_HEADER:_HEADER + length])
+        if zlib.crc32(payload) != crc:
+            self.errors += 1
+            # the length field itself is untrusted after a CRC
+            # mismatch: drop only the magic and rescan
+            self._resync(2)
+            raise FrameCorrupt("crc mismatch")
+        del self._buf[:_HEADER + length]
+        return payload
+
+
+# ---- transport -------------------------------------------------------------
+
+class WireTransport:
+    """One socket endpoint: thread-safe framed ``send`` (the worker's
+    heartbeat thread and RPC loop share one transport) and deadline-
+    bounded ``recv``. ``side="parent"`` consults the fault hooks;
+    the worker side never does (hooks are a parent-process test
+    seam)."""
+
+    def __init__(self, sock, replica_id=None, side="parent",
+                 max_frame=MAX_FRAME):
+        self.sock = sock
+        self.replica_id = replica_id
+        self.side = side
+        self._dec = FrameDecoder(max_frame)
+        self._send_lock = threading.Lock()
+        self._send_seq = 0
+        self._recv_seq = -1
+        self._closed = False
+        sock.setblocking(False)
+
+    # -- send ----------------------------------------------------------
+
+    def send(self, obj: dict):
+        """Frame and send one JSON object (a ``seq`` is stamped in).
+        Raises :class:`WireClosed` on a dead socket."""
+        with self._send_lock:
+            if self._closed:
+                raise WireClosed("transport closed")
+            obj = dict(obj)
+            obj["seq"] = self._send_seq
+            self._send_seq += 1
+            data = encode_frame(obj)
+            if self.side == "parent":
+                data = _apply_hooks(self.replica_id, "tx", data)
+                if data is None:
+                    return           # dropped on the (injected) floor
+            try:
+                self._sendall(data)
+            except (BrokenPipeError, ConnectionError, OSError) as e:
+                raise WireClosed(f"send failed: {e}") from e
+
+    def _sendall(self, data):
+        # non-blocking socket: spin sendall by hand with short waits
+        view = memoryview(data)
+        while view:
+            try:
+                n = self.sock.send(view)
+                view = view[n:]
+            except BlockingIOError:
+                select.select([], [self.sock], [], 0.5)
+
+    # -- recv ----------------------------------------------------------
+
+    def recv(self, timeout_s: float) -> dict:
+        """One decoded, sequence-checked JSON object within
+        ``timeout_s`` seconds. Raises :class:`WireTimeout`,
+        :class:`WireClosed`, or a frame-level :class:`WireError`
+        (after which the decoder has already resynced — call again)."""
+        import time
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        while True:
+            payload = self._dec.next_frame()   # may raise (resynced)
+            if payload is not None:
+                return self._validate(payload)
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise WireTimeout(
+                    f"no frame within {timeout_s:.3f}s")
+            try:
+                r, _, _ = select.select([self.sock], [], [],
+                                        min(remaining, 0.5))
+            except (OSError, ValueError) as e:
+                raise WireClosed(f"socket dead: {e}") from e
+            if not r:
+                continue
+            try:
+                data = self.sock.recv(65536)
+            except BlockingIOError:
+                continue
+            except (ConnectionError, OSError) as e:
+                raise WireClosed(f"recv failed: {e}") from e
+            if not data:
+                raise WireClosed("peer EOF")
+            if self.side == "parent":
+                data = _apply_hooks(self.replica_id, "rx", data)
+                if data is None:
+                    continue
+            self._dec.feed(data)
+
+    def _validate(self, payload):
+        try:
+            obj = json.loads(payload.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise FrameCorrupt(f"payload is not JSON: {e}") from e
+        if not isinstance(obj, dict) or not isinstance(
+                obj.get("seq"), int):
+            raise FrameCorrupt("payload is not a sequenced object")
+        seq = obj["seq"]
+        if seq <= self._recv_seq:
+            raise FrameOutOfOrder(
+                f"seq {seq} after {self._recv_seq} (duplicate or "
+                f"replayed frame)")
+        self._recv_seq = seq
+        return obj
+
+    @property
+    def wire_errors(self) -> int:
+        return self._dec.errors
+
+    def close(self):
+        with self._send_lock:
+            self._closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+def socketpair():
+    """A connected AF_UNIX pair (parent end, worker end)."""
+    a, b = socket.socketpair(socket.AF_UNIX, socket.SOCK_STREAM)
+    return a, b

@@ -154,6 +154,10 @@ class FaultInjector:
         # the harvested program, which slot is draining), so they carry
         # their own wrapper factory instead of the blind call patch
         self._custom_targets = []  # (dotted_name, plan, make_patched)
+        # process-level plans (ISSUE 16)
+        self._wire_hooks = []        # hooks awaiting install
+        self._active_wire_hooks = []  # hooks currently registered
+        self._paused_pids = set()    # SIGSTOP'd workers owed a SIGCONT
 
     # -- arming ------------------------------------------------------------
 
@@ -422,6 +426,145 @@ class FaultInjector:
         self._custom(self._SERVING + "_release_pages", plan, make)
         return plan
 
+    # -- process-level plans (ISSUE 16) ------------------------------------
+    # Real-process fault shapes for ProcReplica workers: a worker
+    # killed with an actual SIGKILL, one frozen with SIGSTOP, and a
+    # lossy wire (dropped / delayed / corrupted frames) injected at
+    # the parent transport's fault-hook seam. Matched by replica id
+    # like the replica-level plans above.
+
+    _PROC = "paddle_tpu.inference.proc_replica.ProcReplica."
+
+    def kill_worker(self, replica_id, times=1, after_steps=0):
+        """Real worker death: deliver an actual SIGKILL to the chosen
+        replica's worker process right before a matching step RPC —
+        the parent sees waitpid/EOF, salvages from its parent-side
+        shadow, and respawns under the restart budget (past it, the
+        breaker opens). ``after_steps`` counts only the chosen
+        replica's step RPCs."""
+        plan = FaultPlan(f"kill_worker:{replica_id}", op="call",
+                         action="raise", times=times,
+                         after_calls=after_steps)
+        self.plans.append(plan)
+        rid = int(replica_id)
+        injector = self
+
+        def make(original, plan_):
+            def patched(rep, *a, **kw):
+                if rep.id == rid:
+                    live = injector._take_call(plan_)
+                    if live is not None and rep.worker_pid:
+                        try:
+                            os.kill(rep.worker_pid, _signal.SIGKILL)
+                        except (ProcessLookupError, OSError):
+                            pass
+                return original(rep, *a, **kw)
+            return patched
+
+        self._custom(self._PROC + "_step_rpc", plan, make)
+        return plan
+
+    def pause_worker(self, replica_id, times=1, after_steps=0):
+        """Hung worker: SIGSTOP the chosen replica's worker process.
+        Heartbeats stop but the process is NOT dead, so the parent
+        must classify it as hung via heartbeat timeout (SIGTERM with
+        grace, then SIGKILL; wedge ejection — never the breaker). Any
+        pid still stopped gets a SIGCONT on :meth:`uninstall` so
+        nothing outlives the test."""
+        plan = FaultPlan(f"pause_worker:{replica_id}", op="call",
+                         action="raise", times=times,
+                         after_calls=after_steps)
+        self.plans.append(plan)
+        rid = int(replica_id)
+        injector = self
+
+        def make(original, plan_):
+            def patched(rep, *a, **kw):
+                if rep.id == rid:
+                    live = injector._take_call(plan_)
+                    if live is not None and rep.worker_pid:
+                        try:
+                            os.kill(rep.worker_pid, _signal.SIGSTOP)
+                            injector._paused_pids.add(rep.worker_pid)
+                        except (ProcessLookupError, OSError):
+                            pass
+                return original(rep, *a, **kw)
+            return patched
+
+        self._custom(self._PROC + "_step_rpc", plan, make)
+        return plan
+
+    def _add_wire_hook(self, hook):
+        from paddle_tpu.inference import wire as _wire
+        _wire.add_fault_hook(hook)
+        self._active_wire_hooks.append(hook)
+
+    def _wire_plan(self, kind, replica_id, times, direction,
+                   after_frames, act):
+        if direction not in ("rx", "tx"):
+            raise ValueError(f"unknown wire direction {direction!r}")
+        plan = FaultPlan(f"{kind}:{replica_id}", op="call",
+                         action="raise", times=times,
+                         after_calls=after_frames)
+        self.plans.append(plan)
+        rid = int(replica_id)
+        injector = self
+
+        def hook(hook_rid, hook_dir, data):
+            if hook_rid != rid or hook_dir != direction:
+                return data
+            live = injector._take_call(plan)
+            if live is None:
+                return data
+            return act(data)
+
+        self._wire_hooks.append(hook)
+        if self._installed:
+            self._add_wire_hook(hook)
+        return plan
+
+    def drop_frame(self, replica_id, times=1, direction="rx",
+                   after_frames=0):
+        """Lossy wire: the matching transport chunk vanishes — a sent
+        frame never leaves (``direction="tx"``) or a received chunk
+        never arrives (``"rx"``). The RPC layer's deadline + bounded
+        retransmit must absorb it; the worker's reply cache keeps the
+        retransmit exactly-once."""
+        return self._wire_plan("drop_frame", replica_id, times,
+                               direction, after_frames,
+                               lambda data: None)
+
+    def delay_frame(self, replica_id, delay_s=0.05, times=1,
+                    direction="rx", after_frames=0):
+        """Slow wire: the matching chunk is held for ``delay_s``
+        before delivery — exercises the RPC deadline/backoff path
+        without losing any bytes."""
+        delay = float(delay_s)
+
+        def act(data):
+            time.sleep(delay)
+            return data
+
+        return self._wire_plan("delay_frame", replica_id, times,
+                               direction, after_frames, act)
+
+    def corrupt_frame(self, replica_id, times=1, direction="rx",
+                      after_frames=0):
+        """Corrupt wire: one byte in the middle of the matching chunk
+        is bit-flipped — the decoder must surface a typed
+        ``WireError`` (bad magic / CRC mismatch), resync, and the RPC
+        layer must retransmit; never a hang, never a half-applied
+        message."""
+        def act(data):
+            if not data:
+                return data
+            buf = bytearray(data)
+            buf[len(buf) // 2] ^= 0xFF
+            return bytes(buf)
+
+        return self._wire_plan("corrupt_frame", replica_id, times,
+                               direction, after_frames, act)
+
     # -- plan matching / actions -------------------------------------------
 
     def _take(self, path, op, pending=None):
@@ -559,6 +702,9 @@ class FaultInjector:
             self._patch_call(target, plan)
         for target, plan, make in self._custom_targets:
             self._patch_custom(target, plan, make)
+        for hook in self._wire_hooks:
+            if hook not in self._active_wire_hooks:
+                self._add_wire_hook(hook)
         return self
 
     def uninstall(self):
@@ -570,6 +716,16 @@ class FaultInjector:
         while self._patched_calls:
             owner, attr, original = self._patched_calls.pop()
             setattr(owner, attr, original)
+        if self._active_wire_hooks:
+            from paddle_tpu.inference import wire as _wire
+            while self._active_wire_hooks:
+                _wire.remove_fault_hook(self._active_wire_hooks.pop())
+        while self._paused_pids:
+            pid = self._paused_pids.pop()
+            try:
+                os.kill(pid, _signal.SIGCONT)
+            except (ProcessLookupError, OSError):
+                pass
         self._installed = False
 
     def __enter__(self):

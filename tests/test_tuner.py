@@ -478,7 +478,7 @@ def test_check_fast_tier_budget(tmp_path, capsys):
     ok = tmp_path / "ok.log"
     ok.write_text("606 passed in 120.0s\n")
     over = tmp_path / "over.log"
-    over.write_text("= 700 passed, 2 warnings in 391.55s (0:06:31) =\n")
+    over.write_text("= 700 passed, 2 warnings in 471.55s (0:07:51) =\n")
     assert mod.main(["--log", str(ok)]) == 0
     assert mod.main(["--log", str(over)]) == 1
     assert mod.main(["--log", str(tmp_path / "missing.log")]) == 2
