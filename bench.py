@@ -1088,6 +1088,155 @@ def _cb_procfleet_bench(on_tpu):
     return out
 
 
+def _cb_disagg_bench(on_tpu):
+    """Disaggregated prefill/decode A/B (ISSUE 17): the named
+    ``long_prompt_flood`` trace mix through 2 prefill + 2 decode
+    process workers (``DisaggServingFleet``) vs the SAME mix through 4
+    colocated process workers — aggregate delivered tok/s with the KV
+    migration cost included, the p99 TTFT of the SHORT-chat subset
+    (the number disaggregation exists to protect: colocated replicas
+    stall short prefills behind long ones and behind resident decode
+    turns; prefill-role slots turn over after one prefill), the p99
+    migration leg, and the tok/s ratio vs colocated (``vs_*`` keys are
+    never gated). Workers always run the tiny CPU model, even on a TPU
+    host: the section measures role-aware orchestration (routing,
+    KV transfer, slot turnover), which the accelerator does not
+    change. BASELINE.md documents the keys."""
+    import numpy as np
+
+    from paddle_tpu.inference import (DisaggServingFleet, ProcReplica,
+                                      ServingFleet)
+    from paddle_tpu.models import LlamaConfig
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        from load_harness import build_trace_mix
+    finally:
+        sys.path.pop(0)
+
+    # geometry fits the mix's long tail: prompts up to 40 tokens + 12
+    # new -> max_len 64, a 40-wide prefill bucket for the floods and
+    # an 8-wide one so short chats never pay the flood's padding.
+    # num_pages leaves headroom for exported-page pins, so a parked
+    # migration never blocks the next admission. Each role gets a
+    # role-SHAPED program — the provisioning freedom that is the point
+    # of disaggregation: prefill replicas keep the 40-wide mixed pass
+    # but drop the decode tail they never use (decode_chunk=2), decode
+    # replicas drop the 40-wide pass they never use (imported pages
+    # re-prefill only short suffixes -> prompt_buckets=(8,)); the
+    # colocated baseline must provision one program for BOTH phases
+    eng_kw = dict(num_slots=2, page_size=8, max_len=64,
+                  num_pages=48, decode_chunk=4,
+                  prompt_buckets=(8, 40), greedy=True)
+
+    def _spec(**over):
+        kw = dict(model="tiny", num_hidden_layers=1, seed=0,
+                  **dict(eng_kw, **over))
+        return {"factory": "paddle_tpu.inference.worker:llama_engine",
+                "kwargs": kw}
+
+    spec = _spec()
+    n_req = 128
+    cfg = LlamaConfig.tiny()
+    mix = build_trace_mix("long_prompt_flood", n_req,
+                          vocab=cfg.vocab_size, seed=17)
+
+    def run_leg(fleet):
+        try:
+            for rep in fleet.replicas.values():
+                fleet._warm(rep)
+            # workload-shaped warm wave: the sacrificial warm request
+            # compiles only the 8-wide bucket; one long prompt per
+            # slot compiles the 40-wide pass (and, on the disagg
+            # fleet, the KV import + decode-side programs) OUTSIDE
+            # the timed region — the A/B measures serving structure,
+            # not whose turn 1 pays which XLA compile
+            for i in range(8):
+                fleet.submit(((np.arange(40) + 97 * i)
+                              % cfg.vocab_size).astype(np.int32), 12)
+            fleet.run()
+            h = getattr(fleet, "_h_migration", None)
+            if h is not None:
+                h.reset()
+            g0 = fleet.gauges()
+            t0 = time.perf_counter()
+            fids = [fleet.submit(
+                np.asarray(it["prompt"], dtype=np.int32),
+                int(it["max_new"])) for it in mix]
+            done = fleet.run()
+            wall = max(time.perf_counter() - t0, 1e-9)
+            by = {r.request_id: r for r in done}
+            ok = [by[f] for f in fids if by[f].error is None]
+            toks = sum(len(r.tokens) for r in ok)
+            short = sorted(
+                (by[f].t_first - by[f].t_arrive) * 1e3
+                for f, it in zip(fids, mix)
+                if it["kind"] == "short" and by[f].error is None
+                and by[f].t_first)
+            p99 = short[max(0, int(round(0.99 * (len(short) - 1))))] \
+                if short else 0.0
+            g = fleet.gauges()
+            g["migrations"] = (g.get("migrations", 0)
+                               - g0.get("migrations", 0))
+            return toks / wall, p99, len(ok), g
+        finally:
+            fleet.close()
+
+    # worker processes inherit the parent's platform pin; force CPU
+    # for the section's whole lifetime (same rationale as procfleet)
+    prev_plat = os.environ.get("JAX_PLATFORMS")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    try:
+        repl_kw = dict(replica_cls=ProcReplica,
+                       replica_kwargs=dict(hb_timeout_s=10.0,
+                                           respawn_backoff_s=0.01))
+        colo_tps, colo_p99, colo_ok, _ = run_leg(
+            ServingFleet(spec, num_replicas=4, **repl_kw))
+        # role-shaped SLOT provisioning, the other half of the
+        # disaggregation win: a prefill slot parks after one token, so
+        # a prefill replica can hold 6 slots where a colocated replica
+        # — whose slots carry decode residency for a request's whole
+        # lifetime — holds 2. num_pages grows with the slot count
+        # (6 slots x 64/8 pages + exported pins in flight).
+        disagg = DisaggServingFleet(
+            _spec(role="prefill", decode_chunk=2, num_slots=6,
+                  num_pages=96), num_prefill=2,
+            num_decode=0, **repl_kw)
+        for _ in range(2):
+            disagg.scale_up(
+                engine_factory=_spec(role="decode",
+                                     prompt_buckets=(8,)),
+                warm=False, role="decode")
+        tps, p99, n_ok, g = run_leg(disagg)
+    finally:
+        if prev_plat is None:
+            os.environ.pop("JAX_PLATFORMS", None)
+        else:
+            os.environ["JAX_PLATFORMS"] = prev_plat
+
+    out = {
+        "cb_disagg_tok_s": round(tps, 2),
+        "cb_disagg_p99_ttft_ms": round(p99, 2),
+        "cb_disagg_colocated_p99_ttft_ms": round(colo_p99, 2),
+        "cb_disagg_migration_ms_p99": round(
+            g.get("migration_ms_p99", 0.0), 2),
+        "cb_disagg_vs_colocated": round(tps / colo_tps, 4)
+        if colo_tps else 0.0,
+    }
+    print(f"# cb disagg: {n_req} long_prompt_flood requests, "
+          f"2 prefill + 2 decode workers "
+          f"({g.get('migrations', 0)} migrations, "
+          f"{n_ok}/{n_req} ok) {out['cb_disagg_tok_s']} tok/s "
+          f"(x{out['cb_disagg_vs_colocated']} vs 4 colocated, "
+          f"{colo_ok}/{n_req} ok), short-chat p99 ttft "
+          f"{out['cb_disagg_p99_ttft_ms']} ms vs "
+          f"{out['cb_disagg_colocated_p99_ttft_ms']} ms colocated, "
+          f"migration p99 {out['cb_disagg_migration_ms_p99']} ms",
+          file=sys.stderr)
+    return out
+
+
 def _cb_prefix_bench(on_tpu):
     """Shared-prefix storm (ISSUE 12): the acceptance A/B for
     radix-tree prefix caching — N requests sharing one long prefix
@@ -1856,6 +2005,22 @@ def main():
     gc.collect()
     if cb_procfleet is not None:
         record.update(cb_procfleet)
+        print(json.dumps(record), flush=True)
+
+    # disaggregated prefill/decode (ISSUE 17): the colocated-vs-disagg
+    # A/B on the long_prompt_flood mix, right after the proc fleet
+    # whose wire + worker machinery it rides
+    try:
+        cb_disagg = _timed_section(
+            "cb disagg", lambda: _retry_transient(
+                lambda: _cb_disagg_bench(on_tpu),
+                "cb disagg bench"))
+    except Exception as e:
+        print(f"# cb disagg bench failed: {e!r}", file=sys.stderr)
+        cb_disagg = None
+    gc.collect()
+    if cb_disagg is not None:
+        record.update(cb_disagg)
         print(json.dumps(record), flush=True)
 
     # shared-prefix storm (ISSUE 12): the prefix-cache cold/warm A/B

@@ -40,6 +40,7 @@ from .reliability import (AdmissionController, DeadlineExceeded,
                           RequestQuarantined, ServingError)
 from .serving import ContinuousBatchingEngine, ServedRequest
 from .fleet import FleetReplica, ServingFleet
+from .disagg import DisaggServingFleet
 from .api_server import ApiServer
 from .proc_replica import ProcReplica
 from .wire import (FrameCorrupt, FrameOutOfOrder, FrameTooLarge,
@@ -50,7 +51,8 @@ __all__ = ["Config", "Predictor", "Tensor", "PrecisionType", "PlaceType",
            "ServedRequest", "AdmissionController", "EngineSupervisor",
            "ServingError", "RequestCancelled", "DeadlineExceeded",
            "RequestQuarantined", "Overloaded", "ReplicaFailed",
-           "ServingFleet", "FleetReplica", "ApiServer", "ProcReplica",
+           "ServingFleet", "FleetReplica", "DisaggServingFleet",
+           "ApiServer", "ProcReplica",
            "WireError", "FrameCorrupt", "FrameTooLarge",
            "FrameOutOfOrder", "WireTimeout", "WireClosed"]
 

@@ -260,6 +260,31 @@ class FleetReplica:
         return self.has_work() and self._stale_turns >= int(
             no_progress_turns)
 
+    # -- disaggregation seam (ISSUE 17): the migration verbs a
+    # role-aware fleet drives. In-process they reach the engine
+    # directly; ProcReplica overrides them with kv_transfer RPCs over
+    # the wire — the DisaggServingFleet router never knows which.
+
+    def take_migrations(self):
+        """Drain the replica's outbound (request, kv payload) pairs
+        (empty for engines without the migration surface)."""
+        eng = self.engine
+        if hasattr(eng, "take_migrations"):
+            return eng.take_migrations()
+        return []
+
+    def import_migration(self, req, payload):
+        """Adopt a migrated request + its KV pages on this replica."""
+        return self.engine.import_migration(req, payload)
+
+    def release_exported(self, request_id):
+        """Ack a completed transfer back to this (source) replica so
+        its pinned exported pages become ordinary evictable cache."""
+        eng = self.engine
+        if hasattr(eng, "release_exported"):
+            return eng.release_exported(request_id)
+        return False
+
     def on_eject(self, kind):
         """Ejection hook for replica subclasses holding external
         resources (a process-backed replica reaps its worker here);

@@ -338,6 +338,7 @@ class ProcReplica(FleetReplica):
                                  side="parent")
         self._ready = False
         self._saw_beat = False
+        self._migrating = []     # rids parked worker-side (step reply)
         self._c_spawns.inc()
         self.last_beat = time.perf_counter()
         # fire the init without waiting: replicas spawned together
@@ -611,6 +612,7 @@ class ProcReplica(FleetReplica):
             "tenant": req.tenant,
             "tokens": [int(t) for t in req.tokens],
             "preemptions": int(req.preemptions),
+            "no_migrate": bool(getattr(req, "no_migrate", False)),
             "age_s": age}}
 
     def _admit_rpc(self, req):
@@ -729,9 +731,17 @@ class ProcReplica(FleetReplica):
                 if err:
                     req.error = _rebuild_error(err[0], err[1])
                 finished.append(req)
-        # re-state occupancy from the worker's truth
+        # re-state occupancy from the worker's truth — a request
+        # parked for migration occupies NEITHER queue nor slot worker
+        # side, but must stay in the shadow (a worker death between
+        # parking and pickup salvages it to prompt replay)
         shadow.queue = [by_id[r] for r in reply.get("queue", ())
                         if r in by_id]
+        self._migrating = list(reply.get("migrating", ()))
+        for rid in self._migrating:
+            req = by_id.get(rid)
+            if req is not None and req not in shadow.queue:
+                shadow.queue.append(req)
         slots = reply.get("slots")
         if slots is not None:
             shadow.slot_req = [
@@ -762,6 +772,97 @@ class ProcReplica(FleetReplica):
         if rss:
             self._g_rss.set(int(rss))
         return finished
+
+    # ---- disaggregation seam (RPC-backed; see fleet.FleetReplica) ------
+
+    def take_migrations(self):
+        """Pop the worker's parked migrations: mirror each request's
+        absolute token list into the shadow object, drop it from this
+        replica's shadow occupancy (ownership is moving), and decode
+        the KV payload to numpy form. A dead worker loses the payload
+        but never the request — it stayed in the shadow through
+        ``migrating`` re-statement, so the respawn replays it from its
+        prompt (the payload was an optimization, not the record)."""
+        from .disagg import kv_payload_from_wire
+        # the last step reply said nothing is parked: skip the RPC
+        # (the pump polls every fleet turn; this keeps the idle cost
+        # zero and gives chaos tests a deterministic pickup window)
+        if not self._ready or not getattr(self, "_migrating", None):
+            return []
+        self._migrating = []
+        try:
+            reply = self._rpc_checked("take_migrations", {})
+        except _WorkerHung as e:
+            self._declare_hung(e)
+            return []
+        except _WorkerDied as e:
+            self._respawn_or_raise(e)
+            return []
+        shadow = self._shadow
+        by_id = {r.request_id: r for r in shadow.queue}
+        for r in shadow.slot_req:
+            if r is not None:
+                by_id[r.request_id] = r
+        out = []
+        for m in reply.get("migrations", ()):
+            req = by_id.get(m.get("rid"))
+            if req is None:
+                continue         # already salvaged off this replica
+            toks = [int(t) for t in m.get("tokens", ())]
+            if len(toks) >= len(req.tokens):
+                req.tokens[:] = toks
+            if m.get("t_first") and not req.t_first:
+                req.t_first = float(m["t_first"]) + self._clock_offset
+            rid = req.request_id
+            shadow.queue = [r for r in shadow.queue
+                            if r.request_id != rid]
+            shadow.slot_req = [
+                None if (r is not None and r.request_id == rid) else r
+                for r in shadow.slot_req]
+            out.append((req, kv_payload_from_wire(m.get("payload")
+                                                  or {})))
+        return out
+
+    def import_migration(self, req, payload):
+        """Land a migrated request + its KV pages on this replica's
+        worker. Raises on a dead/hung worker — the caller
+        (:meth:`~.disagg.DisaggServingFleet._migrate_one`) degrades to
+        plain prompt replay; a worker that actually applied the import
+        before dying is harmless because the respawned engine simply
+        never saw it (exactly-once is the fleet's attempt ledger)."""
+        from .disagg import kv_payload_to_wire
+        self._shadow._check_fits(req.prompt.size, req.max_new_tokens)
+        body = self._admit_payload(req)
+        body["payload"] = kv_payload_to_wire(payload)
+        try:
+            self._ensure_ready()
+            reply = self._rpc_checked("kv_import", body)
+        except _WorkerHung as e:
+            self._declare_hung(e)
+            raise ReplicaFailed(
+                self.id, f"hung during kv_import: {e}") from e
+        except _WorkerDied as e:
+            self._respawn_or_raise(e)
+            raise ReplicaFailed(
+                self.id, "worker died during kv_import") from e
+        self._shadow.queue.append(req)
+        return reply.get("import")
+
+    def release_exported(self, request_id):
+        """Ack a completed migration: the source worker unpins the
+        exported chain (its pages become ordinary prefix-cache
+        residents). Best-effort — a dead source has no pins left."""
+        try:
+            self._ensure_ready()
+            reply = self._rpc_checked("kv_release",
+                                      {"rid": int(request_id)})
+        except _WorkerHung as e:
+            self._declare_hung(e)
+            return False
+        except _WorkerDied as e:
+            self._respawn_or_raise(e)
+            return False
+        return bool(reply.get("released"))
 
     @staticmethod
     def _append_hop(req, hop):

@@ -176,6 +176,13 @@ def salvage_unfinished(engine):
     salvage = [r for r in engine.queue if not r.finished]
     salvage += [r for r in engine.slot_req
                 if r is not None and not r.finished]
+    # disaggregation (ISSUE 17): requests migrated OUT of a slot but
+    # not yet picked up by the router live in neither container — a
+    # prefill engine dying mid-transfer must still salvage them (the
+    # KV payload is lost with the engine; prompt replay is the
+    # fallback, exactly like any preemption)
+    salvage += [req for req, _ in getattr(engine, "migrations_out", ())
+                if not req.finished]
     salvage.sort(key=lambda r: r.request_id)
     return salvage
 

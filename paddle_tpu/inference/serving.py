@@ -91,6 +91,7 @@ Shared structure:
 from __future__ import annotations
 
 import time
+import zlib
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -203,6 +204,26 @@ _pmetrics.declare("serving/prefix_cache_pages", "gauge",
                   "physical pages currently owned by the prefix-cache "
                   "radix index (referenced + evictable)")
 
+# -- disaggregated prefill/decode: engine-side migration counters (ISSUE 17)
+_pmetrics.declare("disagg/migrated_out", "counter",
+                  "requests a prefill-role engine exported to a decode "
+                  "replica after sampling their first token")
+_pmetrics.declare("disagg/kv_pages_exported", "counter",
+                  "full prompt-KV pages serialized into migration "
+                  "payloads (per-pool crc32-checksummed)")
+_pmetrics.declare("disagg/kv_imported_pages", "counter",
+                  "migrated KV pages written into the destination "
+                  "engine's pools and seeded into its prefix-cache "
+                  "radix index")
+_pmetrics.declare("disagg/kv_import_dedup_pages", "counter",
+                  "migrated KV pages already resident at the "
+                  "destination (idempotent re-delivery or shared "
+                  "prefix) — skipped, not rewritten")
+_pmetrics.declare("disagg/kv_import_crc_rejects", "counter",
+                  "migrated KV page blocks rejected at import "
+                  "(checksum mismatch or malformed payload); the "
+                  "request still replays correctly from its prompt")
+
 #: the historical ``_stats`` key set, preserved verbatim — now backed
 #: by ``serving/*`` registry counters
 _STAT_KEYS = ("chunks", "chunk_slot_steps", "active_slot_steps",
@@ -284,6 +305,22 @@ class _PrefixCacheNode:
 #: admission path.
 _pc_copy_page = jax.jit(lambda pools, src, dst:
                         [p.at[:, dst].set(p[:, src]) for p in pools])
+
+
+#: KV-page import (ISSUE 17): write ALL of a migrated request's
+#: accepted pages into every layer's k/v pool in ONE compiled
+#: dispatch. ``dst`` is an int32 vector of page indices and each
+#: pool's ``data`` stacks the matching page contents along the page
+#: axis ([kv_heads, n, page_size, head_dim]) — per-page dispatches put
+#: ~2 x num_layers x pages_per_request sequential launches on the
+#: migration pump, the pump's dominant cost. The page count per
+#: request is bounded by max_len/page_size, so the compile set stays
+#: small. Functional update, so the write chains behind every
+#: in-flight program in the device stream exactly like the COW fork
+#: above — an import never races a dispatched step.
+_kv_write_pages = jax.jit(lambda pools, dst, data:
+                          [p.at[:, dst].set(d)
+                           for p, d in zip(pools, data)])
 
 
 #: the priority band EXTERNAL requests are clamped into by the HTTP
@@ -410,7 +447,20 @@ class ContinuousBatchingEngine:
                  adaptive_chunk=True, unified=True,
                  trace_sample_rate=0.01, latency_reservoir=2048,
                  max_strikes=2, max_containments=8, audit=None,
-                 prefix_cache=None):
+                 prefix_cache=None, role="both"):
+        if role not in ("prefill", "decode", "both"):
+            raise ValueError(f"unknown engine role {role!r}")
+        # disaggregation role (ISSUE 17): a "prefill" engine runs
+        # chunked prefill to completion, samples the first token, then
+        # EXPORTS the finished full KV pages + request state into
+        # ``migrations_out`` instead of decoding — the router moves the
+        # record to a decode-capable engine, where import_migration()
+        # seeds the prefix cache and replays through the recompute
+        # path. "decode"/"both" engines behave identically at this
+        # layer (a decode engine can still prefill — that IS the
+        # cross-role failover path); the role only changes routing
+        # preference and the prefill engine's drain behavior.
+        self.role = role
         self.model = model
         cfg = model.config
         self.cfg = cfg
@@ -520,6 +570,13 @@ class ContinuousBatchingEngine:
 
         self.queue: deque[ServedRequest] = deque()
         self.completed: list[ServedRequest] = []
+        # disaggregation (ISSUE 17): exported (request, kv payload)
+        # records awaiting router pickup, and — per exported request —
+        # the prefix-cache node chain pinned against eviction until
+        # the destination acks the import (release_exported); the page
+        # audit counts these pins as live attachments
+        self.migrations_out: deque = deque()
+        self._exported_pins: dict[int, list] = {}
         self._next_id = 0
         self._seed = int(seed)
         self._key = jax.random.PRNGKey(seed)
@@ -605,6 +662,16 @@ class ContinuousBatchingEngine:
         self._g_overhead = self.metrics.gauge("obs/overhead_frac")
         self._g_pc_pages = self.metrics.gauge(
             "serving/prefix_cache_pages")
+        self._c_migrated_out = self.metrics.counter(
+            "disagg/migrated_out")
+        self._c_kv_exported = self.metrics.counter(
+            "disagg/kv_pages_exported")
+        self._c_kv_imported = self.metrics.counter(
+            "disagg/kv_imported_pages")
+        self._c_kv_dedup = self.metrics.counter(
+            "disagg/kv_import_dedup_pages")
+        self._c_kv_rejects = self.metrics.counter(
+            "disagg/kv_import_crc_rejects")
         # observability self-measurement: seconds spent inside
         # instrumentation on the hot path (gauges()["obs_overhead_frac"]
         # = _obs_s / run_seconds; pinned < 2% by test)
@@ -743,6 +810,205 @@ class ContinuousBatchingEngine:
         out.sort(key=lambda r: (r.t_arrive, r.request_id))
         self._audit_pages("handoff")
         return out
+
+    # ---- disaggregated prefill/decode: KV-page migration (ISSUE 17) ------
+    #
+    # A ``role="prefill"`` engine never activates decode (see
+    # _stage_slot): a slot streams its prompt, samples the first token
+    # in-program and goes inactive, and the drain pass exports it —
+    # full prompt-KV pages plus the request (first token kept) — into
+    # ``migrations_out`` for the router. The destination seeds the
+    # pages into ITS prefix-cache radix index and requeues the request,
+    # so admission attaches them exactly like a prefix-cache hit at
+    # full match length and re-prefills only the unseen suffix: greedy
+    # streams are token-identical to the colocated engine by the same
+    # recompute-replay contract every failover path already leans on,
+    # and a lost/damaged transfer degrades to plain prompt replay, not
+    # a wrong stream.
+
+    def _should_migrate(self, slot, req):
+        """True when a drained slot's request should leave this engine
+        for a decode replica instead of completing here: prefill role,
+        decode budget left, stream not already over (instant-eos and
+        single-token requests complete locally like any engine's)."""
+        if self.role != "prefill" or req.finished or req.cancelled:
+            return False
+        if getattr(req, "no_migrate", False):
+            # the fleet found no decode-capable replica for this
+            # request: complete it colocated (cross-role degradation,
+            # never a migrate/replay livelock)
+            return False
+        if len(req.tokens) >= req.max_new_tokens:
+            return False
+        eos = req.eos_token_id
+        if eos is not None and req.tokens and req.tokens[-1] == eos:
+            return False
+        return True
+
+    def _migrate_out(self, slot, req):
+        """Export a prefill-complete slot: serialize its FULL prompt-KV
+        pages (per-pool crc32 per page), pin the published prefix
+        against eviction until the destination acks, free the slot, and
+        park (request, payload) for the router. The request does NOT
+        complete here — it leaves the engine still live."""
+        eff = self._slot_prompt[slot]
+        ps = self.page_size
+        row = self.tables[slot]
+        blocks = []
+        for lvl in range(len(eff) // ps):
+            page = int(row[lvl])
+            # np.asarray forces the device sync; a drained slot is
+            # inactive in every dispatched program (its writes are
+            # trash-page-guarded), so the fetched content is the final
+            # prefill output even under the pipelined driver
+            data = [np.asarray(p._data[:, page]) for p in self.pools]
+            blocks.append({
+                "tokens": np.asarray(
+                    eff[lvl * ps:(lvl + 1) * ps], np.int32),
+                "data": data,
+                "crc": [zlib.crc32(np.ascontiguousarray(d).tobytes())
+                        for d in data],
+            })
+        payload = {"version": 1, "rid": int(req.request_id),
+                   "eff_len": int(len(eff)), "page_size": ps,
+                   "n_pools": self._n_pools,
+                   "dtype": str(self._pool_dtype),
+                   "blocks": blocks}
+        # deferred-free discipline (ISSUE 17): the source's published
+        # prefix stays pinned until release_exported — a transfer that
+        # dies mid-flight replays against warm source pages
+        chain = self._pc_match(eff)
+        if chain:
+            self._pc_pin(chain)
+            self._exported_pins[int(req.request_id)] = chain
+        record_hop(req, "migrate_out",
+                   replica=getattr(self, "_fleet_replica_id", None),
+                   pages=len(blocks), tokens=len(req.tokens))
+        _t_obs = time.perf_counter()
+        self._c_migrated_out.inc()
+        self._c_kv_exported.inc(len(blocks))
+        _frec.record_event("migrate_out", req=req.request_id,
+                           slot=slot, pages=len(blocks))
+        self._obs_s += time.perf_counter() - _t_obs
+        self._release_pages(self.slot_pages[slot], safe=True)
+        self._clear_slot(slot)
+        self.migrations_out.append((req, payload))
+
+    def take_migrations(self):
+        """Drain the outbound migration queue: (request, payload)
+        pairs in export order, for the router (or the worker RPC seam)
+        to deliver to a decode replica."""
+        out = []
+        while self.migrations_out:
+            out.append(self.migrations_out.popleft())
+        return out
+
+    def release_exported(self, request_id):
+        """Destination ack: unpin a migrated request's exported prefix
+        pages on the SOURCE engine (they stay resident as ordinary
+        evictable cache — that residency is the warm-prefix win for
+        repeated prompts). Idempotent; returns whether a pin existed."""
+        chain = self._exported_pins.pop(int(request_id), None)
+        if chain is None:
+            return False
+        self._pc_unpin(chain)
+        self._audit_pages("release_exported")
+        return True
+
+    def import_migration(self, req, payload):
+        """Adopt a migrated request WITH its shipped KV: verify each
+        block's checksums, write accepted pages into the pools (one
+        compiled functional dispatch for the whole request — chains
+        behind any in-flight program, the COW discipline), seed them
+        into the
+        prefix-cache radix index as evictable residents, then requeue
+        the request. Admission then attaches the seeded chain like any
+        prefix-cache hit. Idempotent: blocks already resident dedup;
+        ANY malformed/damaged block stops seeding (the chain must stay
+        root-contiguous) and the request still replays correctly from
+        whatever prefix landed. Returns import counts."""
+        imported = dedup = rejected = 0
+        pending = []          # (page, [per-pool np page content])
+        ok = (self._prefix_cache and isinstance(payload, dict)
+              and payload.get("version") == 1
+              and payload.get("page_size") == self.page_size
+              and payload.get("n_pools") == self._n_pools
+              and payload.get("dtype") == str(self._pool_dtype))
+        if ok:
+            self._pc_clock += 1
+            cur = self._pc_root
+            for blk in payload.get("blocks") or []:
+                toks = np.asarray(blk["tokens"],
+                                  np.int32).reshape(-1)
+                if toks.size != self.page_size:
+                    rejected += 1
+                    break
+                key = toks.tobytes()
+                child = cur.children.get(key)
+                if child is not None:
+                    child.stamp = self._pc_clock
+                    cur = child
+                    dedup += 1
+                    continue
+                data = blk.get("data") or []
+                crcs = blk.get("crc")
+                if len(data) != self._n_pools or (
+                        crcs is not None
+                        and [zlib.crc32(np.ascontiguousarray(
+                                d).tobytes()) for d in data]
+                        != [int(c) for c in crcs]):
+                    rejected += 1
+                    break
+                alloc = self._alloc_pages(1)
+                if alloc is None:
+                    break        # pool pressure: partial seed is fine
+                page = alloc[0]
+                pending.append(
+                    (page, [np.ascontiguousarray(d) for d in data]))
+                node = _PrefixCacheNode(key, page, cur)
+                node.stamp = self._pc_clock
+                cur.children[key] = node
+                self._pc_nodes[page] = node
+                cur = node
+                imported += 1
+        if pending:
+            # defer the device write until every block has been
+            # verified/alloc'd, then land the whole request in one
+            # batched dispatch (nothing dispatches between alloc and
+            # here — the engine is single-threaded, so a node briefly
+            # pointing at an unwritten page is unobservable). Pad to
+            # the per-request page bound with copies of the last page
+            # so every import shares ONE compiled shape — duplicate
+            # scatter indices carrying identical content are
+            # order-independent, and per-count shapes would recompile
+            # mid-pump, putting XLA compiles on the migration path.
+            width = max(len(pending), self.pages_per_slot)
+            padded = pending + [pending[-1]] * (width - len(pending))
+            dst = jnp.asarray([p for p, _ in padded], jnp.int32)
+            stacked = [jnp.asarray(
+                np.stack([d[i] for _, d in padded], axis=1),
+                self._pool_dtype) for i in range(self._n_pools)]
+            self.pools = [Tensor(a) for a in _kv_write_pages(
+                [p._data for p in self.pools], dst, stacked)]
+        _t_obs = time.perf_counter()
+        if imported:
+            self._c_kv_imported.inc(imported)
+        if dedup:
+            self._c_kv_dedup.inc(dedup)
+        if rejected:
+            self._c_kv_rejects.inc(rejected)
+        _frec.record_event("migrate_in", req=req.request_id,
+                           imported=imported, dedup=dedup,
+                           rejected=rejected)
+        self._obs_s += time.perf_counter() - _t_obs
+        record_hop(req, "migrate_in",
+                   replica=getattr(self, "_fleet_replica_id", None),
+                   imported=imported, dedup=dedup,
+                   rejected=rejected)
+        self.requeue(req)
+        self._audit_pages("kv_import")
+        return {"imported": imported, "dedup": dedup,
+                "rejected": rejected}
 
     def step(self):
         """Admit what fits, advance every slot one scheduler turn (one
@@ -1069,6 +1335,10 @@ class ContinuousBatchingEngine:
         self.slot_shared = [[] for _ in range(B)]
         self._pc_root = _PrefixCacheNode(None, 0, None)
         self._pc_nodes = {}
+        # exported-prefix pins die with the index they pointed into;
+        # the parked migration payloads are host-side copies and
+        # survive (the router still delivers them)
+        self._exported_pins = {}
         self._slot_prompt = [None] * B
         self._prefilling[:] = False
         self._prefill_off[:] = 0
@@ -1544,6 +1814,12 @@ class ContinuousBatchingEngine:
                 f"trash_leaked={0 in allp}")
         refs: dict[int, int] = {}
         for nodes in self.slot_shared:
+            for node in nodes:
+                refs[node.page] = refs.get(node.page, 0) + 1
+        # a migrated-out request's exported prefix stays pinned until
+        # the destination acks (ISSUE 17): each pin is a live
+        # attachment exactly like a reading slot
+        for nodes in self._exported_pins.values():
             for node in nodes:
                 refs[node.page] = refs.get(node.page, 0) + 1
         for node in self._pc_nodes.values():
@@ -2053,7 +2329,14 @@ class ContinuousBatchingEngine:
         self._prefilling[slot] = True
         self._prefill_off[slot] = start
         self._emits_inflight[slot] = 0
-        self._act_target[slot] = remaining > 1
+        # a prefill-role engine never activates decode: the slot
+        # finishes its prompt, samples the first token in-program, and
+        # goes inactive — the drain pass exports it for migration. A
+        # no_migrate request (the fleet found no decode capacity)
+        # decodes here like any colocated stream
+        self._act_target[slot] = remaining > 1 \
+            and (self.role != "prefill"
+                 or getattr(req, "no_migrate", False))
         self.ctx[slot] = start
         self._pred_ctx[slot] = start
         self._dev_ctx = self._dev_ctx.at[slot].set(int(start))
@@ -2504,6 +2787,9 @@ class ContinuousBatchingEngine:
                         self._dev_tok[slot])))
                     self._stats.inc("tokens_emitted")
                     self._pending_first[slot] = False
+                if self._should_migrate(slot, req):
+                    self._migrate_out(slot, req)
+                    continue
                 finished_now = not req.finished
                 # drained slots are inactive in every dispatched
                 # program (writes trash-page-guarded), so their pages

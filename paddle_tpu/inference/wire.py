@@ -32,6 +32,7 @@ the parent cannot guarantee.
 
 from __future__ import annotations
 
+import base64
 import json
 import select
 import socket
@@ -41,8 +42,17 @@ import zlib
 MAGIC = b"\xa5\x5a"
 _HEADER = len(MAGIC) + 4 + 4
 #: frames above this are a protocol violation (a corrupt length field
-#: reads as a huge allocation request — reject, resync, move on)
+#: reads as a huge allocation request — reject, resync, move on).
+#: Default only: both the decoder and the transport take ``max_frame``
+#: as a constructor knob (ISSUE 17 — KV-page transfers size the cap to
+#: the page geometry instead of living with one global constant).
 MAX_FRAME = 8 * 1024 * 1024
+
+#: partial chunked-payload groups kept per transport while awaiting
+#: their remaining chunks; beyond this the OLDEST group is discarded
+#: (its sender's retransmit arrives under a fresh transfer id, so a
+#: group orphaned by a corrupt chunk can never pin memory forever)
+MAX_PARTIAL_CHUNK_GROUPS = 4
 
 
 class WireError(RuntimeError):
@@ -103,12 +113,12 @@ def _apply_hooks(replica_id, direction, data):
 
 # ---- framing ---------------------------------------------------------------
 
-def encode_frame(obj) -> bytes:
+def encode_frame(obj, max_frame=MAX_FRAME) -> bytes:
     payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
-    if len(payload) > MAX_FRAME:
+    if len(payload) > max_frame:
         raise FrameTooLarge(
-            f"payload {len(payload)} bytes exceeds MAX_FRAME "
-            f"{MAX_FRAME}")
+            f"payload {len(payload)} bytes exceeds frame cap "
+            f"{max_frame}")
     return (MAGIC + len(payload).to_bytes(4, "big")
             + zlib.crc32(payload).to_bytes(4, "big") + payload)
 
@@ -185,40 +195,83 @@ class WireTransport:
     heartbeat thread and RPC loop share one transport) and deadline-
     bounded ``recv``. ``side="parent"`` consults the fault hooks;
     the worker side never does (hooks are a parent-process test
-    seam)."""
+    seam).
+
+    Chunked payloads (ISSUE 17): a payload whose JSON encoding would
+    overflow ``max_frame`` is transparently split into a multi-frame
+    group — each chunk is an ordinary sequenced, CRC'd frame carrying
+    a base64 slice plus ``{"_chunk": {"xid", "i", "n"}}`` — and
+    :meth:`recv` reassembles the group before returning the decoded
+    object. A corrupt chunk surfaces exactly like any corrupt frame
+    (typed error, decoder resynced); the orphaned partial group is
+    bounded by ``MAX_PARTIAL_CHUNK_GROUPS`` and the sender's
+    retransmit arrives under a fresh transfer id, so chunking never
+    adds a hang or a half-applied message to the fault model."""
 
     def __init__(self, sock, replica_id=None, side="parent",
-                 max_frame=MAX_FRAME):
+                 max_frame=MAX_FRAME, chunk_bytes=None):
         self.sock = sock
         self.replica_id = replica_id
         self.side = side
+        self.max_frame = int(max_frame)
+        # raw-byte slice per chunk; sized so the b64 expansion (4/3)
+        # plus the JSON envelope stays comfortably under the cap
+        self.chunk_bytes = int(chunk_bytes) if chunk_bytes \
+            else max(1, (self.max_frame // 2))
         self._dec = FrameDecoder(max_frame)
         self._send_lock = threading.Lock()
         self._send_seq = 0
         self._recv_seq = -1
+        self._next_xid = 0
+        self._partial = {}   # xid -> {"n": int, "parts": {i: bytes}}
         self._closed = False
         sock.setblocking(False)
 
     # -- send ----------------------------------------------------------
 
     def send(self, obj: dict):
-        """Frame and send one JSON object (a ``seq`` is stamped in).
-        Raises :class:`WireClosed` on a dead socket."""
+        """Frame and send one JSON object (a ``seq`` is stamped in),
+        transparently splitting into a chunked multi-frame group when
+        the encoding would overflow the frame cap. Raises
+        :class:`WireClosed` on a dead socket."""
         with self._send_lock:
             if self._closed:
                 raise WireClosed("transport closed")
+            payload = json.dumps(
+                obj, separators=(",", ":")).encode("utf-8")
+            # headroom for the seq stamp the single-frame path adds
+            if len(payload) + 64 > self.max_frame:
+                self._send_chunked(payload)
+                return
             obj = dict(obj)
             obj["seq"] = self._send_seq
             self._send_seq += 1
-            data = encode_frame(obj)
-            if self.side == "parent":
-                data = _apply_hooks(self.replica_id, "tx", data)
-                if data is None:
-                    return           # dropped on the (injected) floor
-            try:
-                self._sendall(data)
-            except (BrokenPipeError, ConnectionError, OSError) as e:
-                raise WireClosed(f"send failed: {e}") from e
+            self._send_raw(encode_frame(obj, self.max_frame))
+
+    def _send_chunked(self, payload: bytes):
+        """Split ``payload`` (the un-stamped JSON bytes) into a
+        multi-frame chunk group. Caller holds the send lock."""
+        xid = self._next_xid
+        self._next_xid += 1
+        pieces = [payload[i:i + self.chunk_bytes]
+                  for i in range(0, len(payload), self.chunk_bytes)]
+        for i, piece in enumerate(pieces):
+            frame = {"_chunk": {"xid": xid, "i": i,
+                                "n": len(pieces)},
+                     "d": base64.b64encode(piece).decode("ascii"),
+                     "seq": self._send_seq}
+            self._send_seq += 1
+            self._send_raw(encode_frame(frame, self.max_frame))
+
+    def _send_raw(self, data: bytes):
+        if self.side == "parent":
+            data = _apply_hooks(self.replica_id, "tx", data)
+            if data is None:
+                return               # dropped on the (injected) floor
+        try:
+            self._sendall(data)
+        except (BrokenPipeError, ConnectionError, OSError) as e:
+            raise WireClosed(f"send failed: {e}") from e
 
     def _sendall(self, data):
         # non-blocking socket: spin sendall by hand with short waits
@@ -242,7 +295,13 @@ class WireTransport:
         while True:
             payload = self._dec.next_frame()   # may raise (resynced)
             if payload is not None:
-                return self._validate(payload)
+                obj = self._validate(payload)
+                if "_chunk" in obj:
+                    whole = self._absorb_chunk(obj)
+                    if whole is None:
+                        continue     # group incomplete — keep reading
+                    return whole
+                return obj
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise WireTimeout(
@@ -283,6 +342,47 @@ class WireTransport:
                 f"replayed frame)")
         self._recv_seq = seq
         return obj
+
+    def _absorb_chunk(self, obj):
+        """Fold one chunk frame into its partial group; returns the
+        reassembled, decoded payload when the group completes, else
+        ``None``. A malformed chunk envelope is a corrupt frame."""
+        meta = obj.get("_chunk")
+        try:
+            xid, i, n = (int(meta["xid"]), int(meta["i"]),
+                         int(meta["n"]))
+            piece = base64.b64decode(obj["d"], validate=True)
+        except (TypeError, KeyError, ValueError) as e:
+            raise FrameCorrupt(f"bad chunk envelope: {e}") from e
+        if n <= 0 or not (0 <= i < n):
+            raise FrameCorrupt(f"bad chunk index {i}/{n}")
+        group = self._partial.get(xid)
+        if group is None:
+            group = self._partial[xid] = {"n": n, "parts": {}}
+            while len(self._partial) > MAX_PARTIAL_CHUNK_GROUPS:
+                # oldest first — insertion order IS arrival order
+                self._partial.pop(next(iter(self._partial)))
+        if group["n"] != n:
+            # two sizes claimed for one transfer id: framing is lying
+            self._partial.pop(xid, None)
+            raise FrameCorrupt(
+                f"chunk group {xid} changed size {group['n']}->{n}")
+        group["parts"][i] = piece
+        if len(group["parts"]) < n:
+            return None
+        self._partial.pop(xid, None)
+        whole = b"".join(group["parts"][k] for k in range(n))
+        try:
+            inner = json.loads(whole.decode("utf-8"))
+        except (ValueError, UnicodeDecodeError) as e:
+            raise FrameCorrupt(
+                f"reassembled payload is not JSON: {e}") from e
+        if not isinstance(inner, dict):
+            raise FrameCorrupt("reassembled payload is not an object")
+        # the group's last frame seq stands in for the whole payload
+        # (chunk frames were individually sequence-checked already)
+        inner.setdefault("seq", self._recv_seq)
+        return inner
 
     @property
     def wire_errors(self) -> int:

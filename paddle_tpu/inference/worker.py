@@ -169,6 +169,13 @@ class Worker:
             for r in reqs:
                 self._reported.pop(r.request_id, None)
             return {"rids": [r.request_id for r in reqs]}
+        if op == "take_migrations":
+            return self._take_migrations()
+        if op == "kv_import":
+            return self._kv_import(msg["req"], msg.get("payload"))
+        if op == "kv_release":
+            return {"released": bool(
+                self.engine.release_exported(int(msg["rid"])))}
         if op == "reset_gauges":
             self.engine.reset_gauges()
             # counters were reset in place: resend absolute values so
@@ -183,7 +190,8 @@ class Worker:
             return {}
         raise ValueError(f"unknown rpc op {op!r}")
 
-    def _admit(self, d):
+    @staticmethod
+    def _make_req(d):
         import numpy as np
         from .serving import ServedRequest
         req = ServedRequest(
@@ -202,9 +210,42 @@ class Worker:
         # recompute, continuing the stream exactly where it was
         req.tokens = [int(t) for t in d.get("tokens", [])]
         req.preemptions = int(d.get("preemptions", 0))
+        req.no_migrate = bool(d.get("no_migrate", False))
+        return req
+
+    def _admit(self, d):
+        req = self._make_req(d)
         self.engine.requeue(req)
         self._reported[req.request_id] = [len(req.tokens), 0]
         return {}
+
+    def _take_migrations(self):
+        """Pop parked (request, KV payload) pairs in wire form. The
+        reply cache keeps this exactly-once under retransmits; the
+        parent mirrors absolute token lists into its shadow before
+        handing ownership to a decode replica."""
+        from .disagg import kv_payload_to_wire
+        out = []
+        for req, payload in self.engine.take_migrations():
+            self._reported.pop(req.request_id, None)
+            out.append({"rid": req.request_id,
+                        "tokens": [int(t) for t in req.tokens],
+                        "t_first": req.t_first,
+                        "preemptions": req.preemptions,
+                        "payload": kv_payload_to_wire(payload)})
+        return {"migrations": out}
+
+    def _kv_import(self, d, wire_payload):
+        """Admit a migrated request WITH its prefill KV: the engine
+        seeds the pages into its prefix cache and requeues, so the
+        attach is a full-length prefix hit (module docstring of
+        :mod:`.disagg`)."""
+        from .disagg import kv_payload_from_wire
+        req = self._make_req(d)
+        res = self.engine.import_migration(
+            req, kv_payload_from_wire(wire_payload or {}))
+        self._reported[req.request_id] = [len(req.tokens), 0]
+        return {"import": res}
 
     def _step(self):
         eng = self.engine
@@ -212,6 +253,11 @@ class Worker:
         updates = []
         live = [r for r in eng.slot_req if r is not None]
         live += [r for r in eng.queue]
+        # parked migrations still report (first token + migrate_out
+        # hop mirror into the parent shadow BEFORE ownership moves)
+        migrating = [req for req, _ in
+                     getattr(eng, "migrations_out", ())]
+        live += migrating
         for req in live + list(finished):
             rep = self._reported.setdefault(req.request_id, [0, 0])
             toks = req.tokens[rep[0]:]
@@ -239,6 +285,7 @@ class Worker:
                 "queue": [r.request_id for r in eng.queue],
                 "slots": [r.request_id if r is not None else None
                           for r in eng.slot_req],
+                "migrating": [r.request_id for r in migrating],
                 "rss": _rss_bytes()}
         body.update(self._metrics_diff())
         return body

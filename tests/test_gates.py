@@ -33,9 +33,10 @@ def test_known_gates_are_registered():
     assert names == ["atomic_writes", "metric_names",
                      "fast_tier_budget", "elastic_chaos",
                      "serving_chaos", "fleet_chaos", "prefix_cache",
-                     "proc_fleet_chaos", "serving_parity",
-                     "fused_parity", "observability", "http_api"]
-    assert len(names) == 12    # ISSUE-16 pin: 12 gates, none dropped
+                     "proc_fleet_chaos", "disagg_chaos",
+                     "serving_parity", "fused_parity",
+                     "observability", "http_api"]
+    assert len(names) == 13    # ISSUE-17 pin: 13 gates, none dropped
 
 
 def test_all_gates_pass_on_healthy_log(tmp_path):
@@ -56,6 +57,7 @@ def test_all_gates_pass_on_healthy_log(tmp_path):
     assert "fleet_chaos" not in p.stdout
     assert "prefix_cache" not in p.stdout
     assert "proc_fleet_chaos" not in p.stdout
+    assert "disagg_chaos" not in p.stdout
     assert "serving_parity" not in p.stdout
     assert "fused_parity" not in p.stdout
     assert "observability" not in p.stdout
@@ -77,6 +79,7 @@ def test_full_driver_including_chaos_gate(tmp_path):
     assert "fleet_chaos: PASS" in p.stdout
     assert "prefix_cache: PASS" in p.stdout
     assert "proc_fleet_chaos: PASS" in p.stdout
+    assert "disagg_chaos: PASS" in p.stdout
     assert "serving_parity: PASS" in p.stdout
     assert "fused_parity: PASS" in p.stdout
     assert "observability: PASS" in p.stdout

@@ -82,6 +82,12 @@ TOLERANCES = {
     # ratio — never gated.
     "cb_procfleet_tok_s": 0.30,
     "cb_procfleet_http_goodput_frac": 0.10,
+    # disaggregated prefill/decode (ISSUE 17): process workers + KV
+    # migration inside the timed region — procfleet-class noise. The
+    # latency keys (p99_ttft, migration_ms) are lower-is-better and
+    # out of this table's frame; cb_disagg_vs_colocated is a vs_*
+    # ratio — never gated.
+    "cb_disagg_tok_s": 0.30,
 }
 
 

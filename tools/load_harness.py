@@ -172,6 +172,54 @@ async def do_request(host, port, payload, headers=None, stream=False,
 
 # ---- workload synthesis --------------------------------------------------
 
+#: named, seeded trace mixes — ONE workload definition shared by the
+#: disagg A/B bench (bench.py cb-disagg), chaos suites and any future
+#: scenario harness: every consumer of (name, n, vocab, seed) gets the
+#: SAME request sequence. ``long_prompt_flood`` is the ROADMAP-item-1
+#: shape: a minority of long prompts with real decode budgets flooding
+#: in between short chat turns — the mix where colocated replicas
+#: stall short-chat TTFT behind long prefills and disaggregation pays.
+TRACE_MIXES = {
+    "long_prompt_flood": dict(
+        long_frac=0.35,
+        long_prompt_len=(24, 40), long_max_new=(16, 24),
+        short_prompt_len=(3, 8), short_max_new=(2, 6)),
+}
+
+
+def build_trace_mix(name, n_requests, *, vocab, seed=0):
+    """A named mix as engine-level items: ``{"kind": "long"|"short",
+    "prompt": [token ids], "max_new": int}``. Deterministic in
+    (name, n_requests, vocab, seed)."""
+    params = TRACE_MIXES[name]
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n_requests):
+        kind = "long" if rng.random() < params["long_frac"] \
+            else "short"
+        plen = rng.randint(*params[f"{kind}_prompt_len"])
+        out.append({
+            "kind": kind,
+            "prompt": [rng.randrange(vocab) for _ in range(plen)],
+            "max_new": rng.randint(*params[f"{kind}_max_new"])})
+    return out
+
+
+def trace_mix_workload(mix, *, stream=True, tenants=("default",),
+                       priorities=(0,)):
+    """The HTTP form of a named mix — (payload, headers, disconnect)
+    tuples for :func:`run_load`."""
+    out = []
+    for i, item in enumerate(mix):
+        payload = {"prompt": list(item["prompt"]),
+                   "max_tokens": int(item["max_new"]),
+                   "stream": bool(stream)}
+        headers = {"X-Tenant": tenants[i % len(tenants)],
+                   "X-Priority": str(priorities[i % len(priorities)])}
+        out.append((payload, headers, None))
+    return out
+
+
 def build_workload(n_requests, *, vocab, seed=0, prompt_len=(4, 12),
                    max_new=(2, 8), prefix_frac=0.0, prefix_len=8,
                    tenants=("default",), priorities=(0,),

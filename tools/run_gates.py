@@ -133,6 +133,23 @@ def gate_commands(log: str, budget: float, no_budget: bool,
                            "test_proc_fleet_chaos.py"),
               "-q", "-m", "proc_fleet",
               "-p", "no:cacheprovider"]))
+        # disaggregated prefill/decode chaos (ISSUE 17): the fast
+        # migration-primitive suite (export→import round trips, codec,
+        # corrupt-block/geometry degradation, in-proc role fleet),
+        # then REAL role-split workers — a prefill worker SIGKILLed
+        # mid-transfer and a decode worker SIGKILLed mid-decode, both
+        # with exactly-once delivery, token identity vs the colocated
+        # oracle, and page audits green over the wire on every
+        # survivor. The FULL disagg marker, slow included (the
+        # observability-gate pattern).
+        gates.append(
+            ("disagg_chaos",
+             [sys.executable, "-m", "pytest",
+              os.path.join(REPO_DIR, "tests", "test_disagg.py"),
+              os.path.join(REPO_DIR, "tests",
+                           "test_disagg_chaos.py"),
+              "-q", "-m", "disagg",
+              "-p", "no:cacheprovider"]))
     if not no_serving:
         # serving parity: the unified ragged batching-step engine must
         # reproduce the legacy prefill-wave/decode-chunk engine's token
