@@ -718,6 +718,212 @@ def _cb_bench(on_tpu, autotune=False):
     return best, gauges, tuned_cb, legacy_tps
 
 
+def _cb_spec_bench(on_tpu, autotune=False):
+    """Speculative decoding A/B (ISSUE 18): spec-on vs plain on the
+    SAME model and geometry at decode batch 1/4/8 — the small-batch
+    decode-bound regime where one compiled program per emitted token
+    is the cost spec decoding amortizes. Both legs run decode_chunk=1
+    so the A/B isolates per-program amortization (the scan-tail chunk
+    ladder is the OTHER amortization axis, measured by cb_value); the
+    workload is n-gram-friendly (prompts with repeated spans, the
+    templated-text shape) so acceptance is high — cb_spec_accept_rate
+    in the record says how high, and BASELINE.md documents the caveat.
+
+    autotune=True makes this section the ``spec_decode`` surface's
+    sweep vehicle (K ladder x draft source at the batch-1 geometry;
+    the surface needs a model + workload, so it cannot ride the
+    standalone CLI builders): the winner commits to the tuning cache,
+    where every ctor that leaves spec_k/spec_draft None inherits it.
+
+    Plus the goodput leg: the PR-15 HTTP load harness drives the
+    ``short_chat_batch1`` trace mix (low concurrency, long
+    generations) against a spec-backed and a plain-backed ApiServer.
+    """
+    import json as _json
+    import subprocess
+    import tempfile
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import ApiServer, ContinuousBatchingEngine
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+
+    if on_tpu:
+        cfg = LlamaConfig.llama_1b()
+        page, max_len, buckets = 32, 384, (64,)
+        base_len, tile, n_new, reps = 16, 3, 96, 2
+        http_req, http_conc = 12, 2
+    else:
+        cfg = LlamaConfig.tiny()
+        page, max_len, buckets = 8, 64, (16,)
+        base_len, tile, n_new, reps = 4, 3, 24, 2
+        http_req, http_conc = 8, 2
+    spec_k = 4
+    cfg.tensor_parallel = False
+    cfg.scan_layers = False
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+
+    def make_engine(nslots, spec, **kw):
+        skw = dict(spec_k=spec_k, spec_draft="ngram") if spec else {}
+        skw.update(kw)
+        return ContinuousBatchingEngine(
+            model, num_slots=nslots, page_size=page, max_len=max_len,
+            decode_chunk=1, prompt_buckets=buckets, greedy=True, **skw)
+
+    def prompts_for(nreq, seed):
+        # repeated-span prompts: the generated stream re-walks its own
+        # prompt, the n-gram source's best case
+        rng = np.random.RandomState(seed)
+        return [np.tile(rng.randint(0, cfg.vocab_size,
+                                    (base_len,)).astype(np.int32),
+                        tile) for _ in range(nreq)]
+
+    def timed(eng, nreq, seed0):
+        """Warmup (compiles) then best-of-reps tok/s + gauges."""
+        def erun(seed):
+            for p in prompts_for(nreq, seed):
+                eng.add_request(p, n_new)
+            done = eng.run()
+            return sum(len(r.tokens) for r in done)
+
+        erun(900)
+        eng.reset_gauges()
+        best = 0.0
+        for i in range(reps):
+            t0 = time.perf_counter()
+            t = erun(seed0 + i)
+            best = max(best, t / max(time.perf_counter() - t0, 1e-9))
+        return best, eng.gauges()
+
+    batches = {}
+    for b in (1, 4, 8):
+        nreq = b if on_tpu else max(b, 2)
+        plain_tps, _ = timed(make_engine(b, spec=False), nreq, 910 + b)
+        spec_tps, g = timed(make_engine(b, spec=True), nreq, 910 + b)
+        batches[f"b{b}"] = {
+            "tok_s": round(spec_tps, 2),
+            "plain_tok_s": round(plain_tps, 2),
+            "vs_plain": round(spec_tps / plain_tps, 4)
+            if plain_tps else 0.0,
+            "itl_ms_p99": round(g["itl_ms_p99"], 3),
+            "accept_rate": round(g["spec_accept_rate"], 4),
+        }
+        print(f"# cb spec b{b}: {spec_tps:.1f} tok/s vs plain "
+              f"{plain_tps:.1f} (x{batches[f'b{b}']['vs_plain']}), "
+              f"accept {batches[f'b{b}']['accept_rate']}, itl p99 "
+              f"{batches[f'b{b}']['itl_ms_p99']} ms", file=sys.stderr)
+
+    b1 = batches["b1"]
+    out = {
+        # headline keys = the batch-1 interactive regime where one
+        # program per token hurts most (acceptance criterion:
+        # cb_spec_vs_plain >= 1.0 here on the CPU smoke)
+        "cb_spec_tok_s": b1["tok_s"],
+        "cb_spec_vs_plain": b1["vs_plain"],
+        "cb_spec_accept_rate": b1["accept_rate"],
+        "cb_spec_itl_ms_p99": b1["itl_ms_p99"],
+        "cb_spec_batches": batches,
+    }
+
+    if autotune:
+        # spec_decode sweep (K x source) at the batch-1 geometry; the
+        # small slice is not a silent cap — candidates_tried reports it
+        from paddle_tpu import tuner
+        from paddle_tpu.tuner.surface import sig_from_dict
+        shape = {"slots": 1, "max_len": max_len, "page": page}
+        dtype = next(iter(model.parameters()))._data.dtype
+        key = tuner.make_key("spec_decode", sig_from_dict(shape),
+                             str(dtype), tuner.backend_signature())
+        cache = tuner.get_cache()
+        hit = cache.get(key)
+        if hit is not None:
+            out["tuned_spec_decode"] = {
+                "config": hit["config"], "cached_hit": True,
+                "shape_sig": sig_from_dict(shape)}
+        else:
+            surface = tuner.get_surface("spec_decode")
+            incumbent = {"k": spec_k, "source": "ngram"}
+            cands = [c for c in surface.grid(shape)
+                     if c != incumbent][:3]
+            trials = [(incumbent, b1["tok_s"])]
+            for c in cands:
+                try:
+                    e = make_engine(1, spec=False, spec_k=c["k"],
+                                    spec_draft=c["source"])
+                    tps, _ = timed(e, 1 if on_tpu else 2, 950)
+                    trials.append((dict(c), tps))
+                except Exception as exc:
+                    print(f"# spec autotune candidate {c} failed: "
+                          f"{exc!r}", file=sys.stderr)
+            win_cfg, win_tps = max(trials, key=lambda t: t[1])
+            cache.put(key, win_cfg, median_ms=None,
+                      representative=on_tpu, source="search",
+                      extra={"trials": len(trials),
+                             "tok_s": round(win_tps, 2)})
+            out["tuned_spec_decode"] = {
+                "config": win_cfg, "cached_hit": False,
+                "shape_sig": sig_from_dict(shape),
+                "tok_s": round(win_tps, 2),
+                "candidates_tried": len(trials)}
+            print(f"# spec autotune: {win_cfg} {win_tps:.1f} tok/s "
+                  f"({len(trials)} candidates)", file=sys.stderr)
+
+    # goodput leg: short_chat_batch1 through the HTTP front door,
+    # spec-backed vs plain-backed ApiServer on the same trace
+    def http_leg(spec):
+        eng = make_engine(2, spec=spec)
+        for p in prompts_for(2, 990):
+            eng.add_request(p, 4)
+        eng.run()                   # warm the compiles off the clock
+        srv = ApiServer(eng, stream_chunk_tokens=8).start()
+        try:
+            with tempfile.NamedTemporaryFile(
+                    suffix=".json", delete=False) as tf:
+                rep_path = tf.name
+            proc = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(
+                     os.path.abspath(__file__)),
+                     "tools", "load_harness.py"),
+                 "--url", srv.url, "--requests", str(http_req),
+                 "--concurrency", str(http_conc), "--mode", "closed",
+                 "--vocab", str(cfg.vocab_size),
+                 "--trace-mix", "short_chat_batch1",
+                 "--seed", "18", "--report", rep_path],
+                capture_output=True, text=True, timeout=600)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"load harness failed: {proc.stderr[-500:]}")
+            with open(rep_path) as f:
+                report = _json.load(f)
+            os.unlink(rep_path)
+            return report
+        finally:
+            srv.stop()
+
+    try:
+        plain_rep = http_leg(spec=False)
+        spec_rep = http_leg(spec=True)
+        out["cb_spec_http_tok_s"] = round(spec_rep["tok_s"], 2)
+        out["cb_spec_http_goodput_frac"] = round(
+            spec_rep["goodput_frac"], 4)
+        out["cb_spec_http_vs_plain"] = round(
+            spec_rep["tok_s"] / plain_rep["tok_s"], 4) \
+            if plain_rep["tok_s"] else 0.0
+        print(f"# cb spec http: {out['cb_spec_http_tok_s']} tok/s "
+              f"delivered (plain {plain_rep['tok_s']:.1f}, "
+              f"x{out['cb_spec_http_vs_plain']}), goodput "
+              f"{out['cb_spec_http_goodput_frac']}", file=sys.stderr)
+    except Exception as exc:    # the A/B headline survives a flaky leg
+        print(f"# cb spec http leg failed: {exc!r}", file=sys.stderr)
+    return out
+
+
 def _cb_overload_bench(on_tpu):
     """Serving-reliability economics under synthetic heavy traffic
     (ISSUE 10): drive the engine ~4x past its page capacity with
@@ -1775,6 +1981,33 @@ def _autotune_bench(on_tpu):
     return out
 
 
+def _emit_record(record, path=None):
+    """Print the running record line AND (when ``path`` is set) flush
+    it to disk with the atomic stage-then-rename protocol. Called
+    after EVERY completed section: a round that times out or dies on a
+    backend outage mid-run (BENCH_r04/r05 left nothing parseable)
+    still leaves a complete JSON file carrying every section measured
+    so far, which tools/check_bench_regression.py compares key-by-key
+    against the trajectory."""
+    line = json.dumps(record)
+    print(line, flush=True)
+    if path:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write(line + "\n")
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except OSError as e:    # the flush is telemetry durability,
+            print(f"# record flush to {path} failed: {e}",
+                  file=sys.stderr)    # never a bench failure
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+
 def _timed_section(what, fn):
     """Run a bench section, logging wall time to stderr (budget telemetry:
     round-4's record never printed because the sections overran the
@@ -1798,7 +2031,14 @@ def main():
                          "shapes first (paddle_tpu.tuner) and emit "
                          "tuned_* record keys; winners persist to the "
                          "tuning cache and feed the timed sections")
+    ap.add_argument("--record-out", default=os.environ.get(
+                        "PADDLE_BENCH_RECORD"),
+                    help="atomically rewrite the running record to "
+                         "this file after every completed section — a "
+                         "timed-out round leaves a parseable partial "
+                         "record (also via $PADDLE_BENCH_RECORD)")
     args, _unknown = ap.parse_known_args()
+    rec_out = args.record_out
 
     # Backend init is retried with LONG backoff: the rounds-2/5 axon
     # tunnel outages were transient on the scale of hours, and an
@@ -1855,7 +2095,7 @@ def main():
         "provenance": _provenance(dev),
     }
     record.update(tuned)
-    print(json.dumps(record), flush=True)
+    _emit_record(record, rec_out)
     gc.collect()
 
     # fit-loop e2e (ISSUE 5): right after the headline train metric —
@@ -1875,7 +2115,7 @@ def main():
                                       + suffix)
         record["train_e2e_unit"] = "tokens/s/chip"
         record.update(fit_e2e)
-        print(json.dumps(record), flush=True)
+        _emit_record(record, rec_out)
 
     # peak-HBM accounting (ISSUE 8): compile-only probe — cheap, so it
     # sits right after the fit section whose memory story it documents
@@ -1887,7 +2127,7 @@ def main():
         mem_keys = None
     if mem_keys is not None:
         record.update(mem_keys)
-        print(json.dumps(record), flush=True)
+        _emit_record(record, rec_out)
 
     # Section order = evidentiary priority under the driver's time
     # limit (measured round 5: train 593s, decode 353s — mostly
@@ -1911,7 +2151,7 @@ def main():
         record["moe_value"] = round(moe_tok_s, 2)
         record["moe_unit"] = "tokens/s/chip"
         record["moe_mfu"] = round(moe_mfu, 4)
-        print(json.dumps(record), flush=True)
+        _emit_record(record, rec_out)
 
     try:
         cb_tok_s, cb_gauges, cb_tuned, cb_legacy = _timed_section(
@@ -1957,8 +2197,25 @@ def main():
             for k, v in cb_gauges.items()}
         if cb_tuned:
             record["tuned_serving_chunks"] = cb_tuned
-        print(json.dumps(record), flush=True)
+        _emit_record(record, rec_out)
     gc.collect()
+
+    # speculative decoding A/B (ISSUE 18): this round's headline
+    # addition, right after the cb section whose engine it accelerates
+    # — the decode-batch-1/4/8 sweep, the accept-rate economics, and
+    # the short_chat_batch1 goodput leg through the HTTP front door
+    try:
+        cb_spec = _timed_section(
+            "cb spec", lambda: _retry_transient(
+                lambda: _cb_spec_bench(on_tpu, autotune=args.autotune),
+                "cb spec bench"))
+    except Exception as e:
+        print(f"# cb spec bench failed: {e!r}", file=sys.stderr)
+        cb_spec = None
+    gc.collect()
+    if cb_spec is not None:
+        record.update(cb_spec)
+        _emit_record(record, rec_out)
 
     # serving reliability under overload (ISSUE 10): right after the
     # cb section whose engine it stresses — the survival economics
@@ -1974,7 +2231,7 @@ def main():
     gc.collect()
     if cb_overload is not None:
         record.update(cb_overload)
-        print(json.dumps(record), flush=True)
+        _emit_record(record, rec_out)
 
     # multi-replica fleet (ISSUE 11): the scale-out + failover
     # economics next to the single-engine numbers they contextualize
@@ -1989,7 +2246,7 @@ def main():
     gc.collect()
     if cb_fleet is not None:
         record.update(cb_fleet)
-        print(json.dumps(record), flush=True)
+        _emit_record(record, rec_out)
 
     # process-backed fleet (ISSUE 16): the same failover economics
     # with REAL worker processes on the wire, next to the in-process
@@ -2005,7 +2262,7 @@ def main():
     gc.collect()
     if cb_procfleet is not None:
         record.update(cb_procfleet)
-        print(json.dumps(record), flush=True)
+        _emit_record(record, rec_out)
 
     # disaggregated prefill/decode (ISSUE 17): the colocated-vs-disagg
     # A/B on the long_prompt_flood mix, right after the proc fleet
@@ -2021,7 +2278,7 @@ def main():
     gc.collect()
     if cb_disagg is not None:
         record.update(cb_disagg)
-        print(json.dumps(record), flush=True)
+        _emit_record(record, rec_out)
 
     # shared-prefix storm (ISSUE 12): the prefix-cache cold/warm A/B
     # right after the serving sections whose capacity it multiplies
@@ -2036,7 +2293,7 @@ def main():
     gc.collect()
     if cb_prefix is not None:
         record.update(cb_prefix)
-        print(json.dumps(record), flush=True)
+        _emit_record(record, rec_out)
 
     # HTTP front door (ISSUE 15): what serving costs once a real
     # client on a real socket is in the loop, next to the raw engine
@@ -2051,7 +2308,7 @@ def main():
     gc.collect()
     if cb_http is not None:
         record.update(cb_http)
-        print(json.dumps(record), flush=True)
+        _emit_record(record, rec_out)
 
     try:
         decode_tok_s = _timed_section(
@@ -2064,7 +2321,7 @@ def main():
         record["decode_metric"] = "llama_1B_kv_cache_greedy_decode" + suffix
         record["decode_value"] = round(decode_tok_s, 2)
         record["decode_unit"] = "tokens/s/chip"
-        print(json.dumps(record), flush=True)
+        _emit_record(record, rec_out)
     gc.collect()
 
     try:
@@ -2080,7 +2337,7 @@ def main():
             "deepseek_v2_mla_latent_cache_greedy_decode" + suffix)
         record["moe_decode_value"] = round(moe_decode_tok_s, 2)
         record["moe_decode_unit"] = "tokens/s/chip"
-        print(json.dumps(record), flush=True)
+        _emit_record(record, rec_out)
 
     # MoE step-time attribution (the tentpole evidence table): LAST,
     # after every headline metric has printed — its ~5 fresh variant
@@ -2098,7 +2355,7 @@ def main():
     if moe_bd is not None:
         record["moe_breakdown"] = moe_bd
         record["moe_breakdown_trace"] = moe_bd_trace
-        print(json.dumps(record), flush=True)
+        _emit_record(record, rec_out)
 
 
 if __name__ == "__main__":

@@ -224,6 +224,20 @@ _pmetrics.declare("disagg/kv_import_crc_rejects", "counter",
                   "(checksum mismatch or malformed payload); the "
                   "request still replays correctly from its prompt")
 
+# -- speculative decoding: draft/verify economics (ISSUE 18)
+_pmetrics.declare("spec/steps", "counter",
+                  "speculative unified-step programs dispatched "
+                  "(draft + ragged verify in one compiled step)")
+_pmetrics.declare("spec/tokens_drafted", "counter",
+                  "draft tokens fed into verification chunks")
+_pmetrics.declare("spec/tokens_accepted", "counter",
+                  "draft tokens the target distribution accepted "
+                  "(committed in place, ctx advanced over their KV)")
+_pmetrics.declare("spec/tokens_rejected", "counter",
+                  "draft tokens rejected at verification and rolled "
+                  "back (their in-flight KV writes are left "
+                  "unreachable behind ctx and overwritten in place)")
+
 #: the historical ``_stats`` key set, preserved verbatim — now backed
 #: by ``serving/*`` registry counters
 _STAT_KEYS = ("chunks", "chunk_slot_steps", "active_slot_steps",
@@ -447,7 +461,8 @@ class ContinuousBatchingEngine:
                  adaptive_chunk=True, unified=True,
                  trace_sample_rate=0.01, latency_reservoir=2048,
                  max_strikes=2, max_containments=8, audit=None,
-                 prefix_cache=None, role="both"):
+                 prefix_cache=None, role="both", spec_decode=False,
+                 spec_k=None, spec_draft=None):
         if role not in ("prefill", "decode", "both"):
             raise ValueError(f"unknown engine role {role!r}")
         # disaggregation role (ISSUE 17): a "prefill" engine runs
@@ -644,6 +659,46 @@ class ContinuousBatchingEngine:
         self._n_decode = max(0, self.decode_chunk - 1)
         self._unified_fn = None
         self._emits_inflight = np.zeros((B,), np.int32)
+        # ---- speculative decoding (ISSUE 18) -------------------------
+        # a drafting decode slot rides 1 + K tokens (pending + drafts)
+        # through the SAME ragged mixed pass as a short prefill-shaped
+        # chunk; distribution-exact rejection sampling over the target
+        # logits commits the accepted prefix in place. Knobs left None
+        # resolve through the autotuner cache ("spec_decode" surface,
+        # registered at the bottom of this module) then static
+        # defaults; an explicit argument always wins.
+        self._spec = bool(spec_decode) or spec_k is not None \
+            or spec_draft is not None
+        if self._spec and not self._unified:
+            raise ValueError("speculative decoding requires the "
+                             "unified batching-step engine "
+                             "(unified=True)")
+        self._spec_k = 0
+        self._spec_source = None
+        self._spec_fn = None
+        if self._spec:
+            stuned = {}
+            if spec_k is None or spec_draft is None:
+                from ..tuner import lookup
+                stuned = lookup("spec_decode",
+                                {"slots": self.num_slots,
+                                 "max_len": self.max_len,
+                                 "page": self.page_size},
+                                str(dtype)) or {}
+            if spec_k is None:
+                spec_k = int(stuned.get("k", 0)) or 4
+            if spec_draft is None:
+                spec_draft = stuned.get("source") or "ngram"
+            # the verify chunk reuses the tuned [B, prefill_chunk] ids
+            # plane — no new compiled shape, so K+1 must fit in it
+            if self.prefill_chunk < 2:
+                raise ValueError("speculative decoding needs "
+                                 "prefill_chunk >= 2 to carry a "
+                                 "verification chunk")
+            self._spec_k = max(1, min(int(spec_k),
+                                      self.prefill_chunk - 1))
+            from .spec_decode import get_draft_source
+            self._spec_source = get_draft_source(spec_draft)
 
         # perf observability (profiler subsystem): a PRIVATE typed
         # metrics registry behind the :meth:`gauges` surface — slot
@@ -672,6 +727,13 @@ class ContinuousBatchingEngine:
             "disagg/kv_import_dedup_pages")
         self._c_kv_rejects = self.metrics.counter(
             "disagg/kv_import_crc_rejects")
+        self._c_spec_steps = self.metrics.counter("spec/steps")
+        self._c_spec_drafted = self.metrics.counter(
+            "spec/tokens_drafted")
+        self._c_spec_accepted = self.metrics.counter(
+            "spec/tokens_accepted")
+        self._c_spec_rejected = self.metrics.counter(
+            "spec/tokens_rejected")
         # observability self-measurement: seconds spent inside
         # instrumentation on the hot path (gauges()["obs_overhead_frac"]
         # = _obs_s / run_seconds; pinned < 2% by test)
@@ -1020,7 +1082,11 @@ class ContinuousBatchingEngine:
         try:
             if self._unified:
                 if self._worth_step():
-                    self._harvest_step(self._dispatch_step())
+                    # spec engines speculate in step()-pumped drivers
+                    # too (ApiServer, fleet replicas), not just run()
+                    self._harvest_step(self._dispatch_spec_step()
+                                       if self._spec else
+                                       self._dispatch_step())
             else:
                 self._pump_prefill()
                 if self.active.any():
@@ -1060,6 +1126,19 @@ class ContinuousBatchingEngine:
         step), and the successor is skipped when no prefilling slot
         exists and every active slot's predicted budget is exhausted."""
         if self._unified:
+            if self._spec:
+                # speculative decoding runs the SAME driver SERIALLY:
+                # drafts are functions of the harvested token history
+                # (n-gram lookup) or of the post-harvest device state
+                # (self-spec), so a speculative successor dispatched
+                # before harvest would draft from a stale stream. The
+                # round trip it un-hides is amortized by the ~K tokens
+                # each step emits instead of one.
+                return self._run_driver(
+                    spec_dispatch=lambda: None,
+                    harvest=self._harvest_step,
+                    after_admit=lambda: None,
+                    idle_turn=self._idle_turn_spec)
             return self._run_driver(
                 spec_dispatch=lambda: self._dispatch_step()
                 if self._worth_step() else None,
@@ -1080,6 +1159,13 @@ class ContinuousBatchingEngine:
         anything. Returns (progressed, inflight record or None)."""
         if self._worth_step():
             return True, self._dispatch_step()
+        return False, None
+
+    def _idle_turn_spec(self):
+        """Serial speculative turn: draft + dispatch one spec step if
+        it would advance anything."""
+        if self._worth_step():
+            return True, self._dispatch_spec_step()
         return False, None
 
     def _idle_turn_legacy(self):
@@ -1630,7 +1716,298 @@ class ContinuousBatchingEngine:
         self._stats.inc("tokens_emitted", appended)
         if appended == 0:
             self._stats.inc("chunks_empty")
+        # a SPEC step's packed output carries two extra accounting
+        # columns (committed-draft and drafted counts per slot) past
+        # the layout this method parses — fold them into the spec
+        # economics counters
+        if arr.shape[1] > 2 * n_steps + 2:
+            nds = arr[:, 2 * n_steps + 3]
+            accs = arr[:, 2 * n_steps + 2]
+            drafted = int(nds.sum())
+            if drafted:
+                committed = int(accs.sum())
+                self._c_spec_drafted.inc(drafted)
+                self._c_spec_accepted.inc(committed)
+                self._c_spec_rejected.inc(drafted - committed)
         self._obs_s += time.perf_counter() - _t_obs
+
+    # ---- speculative decoding (ISSUE 18) ---------------------------------
+
+    def _unified_spec_static(self):
+        """The speculative batching-step program: the SAME ragged mixed
+        pass as :meth:`_unified_static` — prefill slots stream prompt
+        chunks unchanged — but an active decode slot rides ``1 + n_d``
+        tokens (its pending token in column 0, host-proposed draft
+        tokens in columns ``1..n_d``) as a short prefill-shaped chunk,
+        and the ``decode_chunk - 1`` scan tail is replaced by
+        DISTRIBUTION-EXACT verification of the drafts against the
+        target logits:
+
+        - greedy: accept while the draft matches the argmax (so spec
+          streams are token-identical to the plain engine);
+        - sampling: accept draft ``d_j`` with prob ``min(1, p_j[d_j])``
+          (point-mass draft), resample the first rejection from the
+          renormalized residual, bonus-sample from ``p_K`` when every
+          draft holds — each emitted position marginally exact.
+
+        Accepted tokens COMMIT by advancing ctx over their already-
+        written KV (``ops.paged_attention.paged_verify_write``
+        semantics); rejected positions simply stay behind ctx, unread
+        and overwritten by the next chunk. The packed output keeps the
+        harvest layout with ``n_steps = K + 1`` plus two trailing
+        accounting columns (committed drafts, drafted count)."""
+        if self._spec_fn is not None:
+            return self._spec_fn
+        from ..jit import to_static
+        model = self.model
+        greedy = self.greedy
+        temperature = self.temperature
+        C = self.prefill_chunk
+        K = self._spec_k
+
+        def sstep(ids_t, nq_t, last_t, tgt_t, nd_t, tok_t, ctx_t,
+                  act_t, tbl_t, lim_t, eos_t, key_t, *pools):
+            fwd = model.forward
+
+            def fn(ids, nq, last, tgt, nd, tok, ctx, act, tbl, lim,
+                   eos_arr, key, *pool_leaves):
+                b = tok.shape[0]
+                # stale instant-eos guard (same as the plain step)
+                act = act & ((eos_arr < 0) | (tok != eos_arr))
+                is_pre = nq > 0
+                dec = act & ~is_pre
+                # drafts were clamped host-side against the host ctx;
+                # re-gate on the device view (the eos guard above can
+                # retire a slot the host still believed active)
+                nd_eff = jnp.where(dec, nd, 0).astype(jnp.int32)
+                lengths = jnp.where(
+                    is_pre, nq,
+                    jnp.where(dec, 1 + nd_eff, 0)).astype(jnp.int32)
+                ids_eff = ids.at[:, 0].set(
+                    jnp.where(is_pre, ids[:, 0], tok))
+                with no_grad():
+                    logits, npools = fwd(
+                        Tensor(ids_eff),
+                        caches=[Tensor(a) for a in pool_leaves],
+                        pos=Tensor(ctx[:, None]),
+                        tables=(Tensor(tbl), Tensor(lengths)))
+                lg = logits._data                      # [B, C, V]
+                # ---- decode slots: verify drafts on columns 0..K ----
+                vlg = lg[:, :K + 1].astype(jnp.float32)
+                d = ids[:, 1:K + 1].astype(jnp.int32)  # [B, K]
+                jk = jnp.arange(K)[None, :]
+                if greedy:
+                    tgt_tok = jnp.argmax(vlg, -1).astype(jnp.int32)
+                    acc = d == tgt_tok[:, :K]
+                else:
+                    p = jax.nn.softmax(vlg / temperature, axis=-1)
+                    key, sub_u = jax.random.split(key)
+                    u = jax.random.uniform(sub_u, (b, K))
+                    pd = jnp.take_along_axis(
+                        p[:, :K], d[:, :, None], axis=2)[:, :, 0]
+                    acc = u < pd
+                acc = acc & (jk < nd_eff[:, None])
+                # leading-run length = accepted draft count
+                n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32),
+                                            axis=1), axis=1)
+                # target token at the first unaccepted position:
+                # rejection resample (draft zeroed, renormalized) or
+                # the bonus sample when every draft held
+                if greedy:
+                    fin = jnp.take_along_axis(
+                        tgt_tok, n_acc[:, None], axis=1)[:, 0]
+                else:
+                    row = jnp.take_along_axis(
+                        p, n_acc[:, None, None], axis=1)[:, 0]
+                    d_at = jnp.take_along_axis(
+                        d, jnp.clip(n_acc, 0, K - 1)[:, None],
+                        axis=1)[:, 0]
+                    rej = n_acc < nd_eff
+                    v_ax = jnp.arange(row.shape[-1])[None, :]
+                    row = jnp.where(
+                        rej[:, None] & (v_ax == d_at[:, None]),
+                        0.0, row)
+                    key, sub_f = jax.random.split(key)
+                    fin_lg = jnp.where(row > 0, jnp.log(row), -1e30)
+                    fin = jax.random.categorical(
+                        sub_f, fin_lg).astype(jnp.int32)
+                # emission ladder e_0..e_K: accepted drafts, then the
+                # target sample; trimmed by per-position ctx budget
+                # and a mid-chunk eos (the eos token itself emits,
+                # nothing after it — the plain-engine contract)
+                d_pad = jnp.concatenate(
+                    [d, jnp.zeros((b, 1), jnp.int32)], axis=1)
+                jk1 = jnp.arange(K + 1)[None, :]
+                e = jnp.where(jk1 < n_acc[:, None], d_pad,
+                              fin[:, None])
+                eos_hit = (eos_arr[:, None] >= 0) & \
+                    (e == eos_arr[:, None])
+                eos_before = jnp.cumsum(
+                    eos_hit.astype(jnp.int32), axis=1) - \
+                    eos_hit.astype(jnp.int32)
+                alive = (jk1 <= n_acc[:, None]) \
+                    & ((ctx[:, None] + jk1) < lim[:, None]) \
+                    & (eos_before == 0) & dec[:, None]
+                n_emit = jnp.sum(alive.astype(jnp.int32), axis=1)
+                ctx_dec = ctx + n_emit
+                last_e = jnp.take_along_axis(
+                    e, jnp.clip(n_emit - 1, 0, K)[:, None],
+                    axis=1)[:, 0]
+                tok_dec = jnp.where(n_emit > 0, last_e, tok)
+                still_dec = dec & (n_emit > 0) & (ctx_dec < lim) \
+                    & ((eos_arr < 0) | (last_e != eos_arr))
+                # ---- prefill slots: plain-step single sample --------
+                idx = jnp.clip(lengths - 1, 0, C - 1)
+                last_lg = jnp.take_along_axis(
+                    lg, idx[:, None, None],
+                    axis=1)[:, 0].astype(jnp.float32)
+                if greedy:
+                    sampled = jnp.argmax(last_lg, -1).astype(jnp.int32)
+                else:
+                    key, sub_p = jax.random.split(key)
+                    sampled = jax.random.categorical(
+                        sub_p, last_lg / temperature).astype(jnp.int32)
+                fire_pre = is_pre & last
+                ctx1 = ctx + lengths
+                hit_eos_pre = (eos_arr >= 0) & (sampled == eos_arr)
+                act_pre = fire_pre & tgt & (ctx1 < lim) & ~hit_eos_pre
+                # ---- merge + pack -----------------------------------
+                toks_all = jnp.where(dec[:, None], e, -1)
+                toks_all = toks_all.at[:, 0].set(
+                    jnp.where(fire_pre, sampled, toks_all[:, 0]))
+                emit_all = alive.at[:, 0].set(
+                    fire_pre | alive[:, 0])
+                tok_f = jnp.where(dec, tok_dec,
+                                  jnp.where(fire_pre, sampled, tok))
+                ctx_f = jnp.where(dec, ctx_dec, ctx + lengths)
+                act_f = jnp.where(is_pre, act_pre,
+                                  jnp.where(dec, still_dec, act))
+                committed = jnp.where(
+                    dec, jnp.minimum(n_acc,
+                                     jnp.maximum(n_emit - 1, 0)), 0)
+                packed_out = jnp.concatenate(
+                    [toks_all.astype(jnp.int32),
+                     emit_all.astype(jnp.int32),
+                     ctx_f[:, None].astype(jnp.int32),
+                     act_f[:, None].astype(jnp.int32),
+                     committed[:, None].astype(jnp.int32),
+                     nd_eff[:, None].astype(jnp.int32)], axis=1)
+                return (packed_out, tok_f, ctx_f, act_f, key) \
+                    + tuple(t._data for t in npools)
+
+            return _apply_multi(
+                fn, [ids_t, nq_t, last_t, tgt_t, nd_t, tok_t, ctx_t,
+                     act_t, tbl_t, lim_t, eos_t, key_t] + list(pools),
+                n_out=5 + len(pools))
+
+        self._spec_fn = to_static(sstep)
+        self._compiled.add(("spec", C, 1 + K))
+        return self._spec_fn
+
+    def _dispatch_spec_step(self):
+        """Launch one SPECULATIVE unified step: stream prefill chunks
+        exactly like :meth:`_dispatch_step`, and for every active
+        decode slot with budget propose up to K draft tokens from the
+        configured :class:`~.spec_decode.DraftSource`, clamped to
+        ``limits - ctx - 1`` so every verify write stays inside the
+        slot's allocated table row. Runs serially (dispatch → harvest)
+        — see :meth:`run`."""
+        B, C, K = self.num_slots, self.prefill_chunk, self._spec_k
+        ids = np.zeros((B, C), np.int32)
+        nq = np.zeros((B,), np.int32)
+        last = np.zeros((B,), bool)
+        tgt = np.zeros((B,), bool)
+        nd = np.zeros((B,), np.int32)
+        n_pre = 0
+        for slot in range(B):
+            if not self._prefilling[slot] or n_pre >= self.admit_batch:
+                continue
+            prm = self._slot_prompt[slot]
+            off = int(self._prefill_off[slot])
+            v = min(C, len(prm) - off)
+            ids[slot, :v] = prm[off:off + v]
+            nq[slot] = v
+            last[slot] = off + v == len(prm)
+            tgt[slot] = self._act_target[slot]
+            n_pre += 1
+        drafting = [s for s in range(B)
+                    if self.active[s] and not self._prefilling[s]
+                    and self.slot_req[s] is not None
+                    and int(self.limits[s]) - int(self.ctx[s]) > 1]
+        if drafting:
+            drafts, counts = self._spec_source.propose(
+                self, drafting, K)
+            for s in drafting:
+                c = min(int(counts[s]), K,
+                        int(self.limits[s]) - int(self.ctx[s]) - 1)
+                if c > 0:
+                    ids[s, 1:1 + c] = drafts[s, :c]
+                    nd[s] = c
+        fn = self._unified_spec_static()
+        self._seq += 1
+        self._last_fetch_dispatch_seq = self._seq
+        n_steps = 1 + K
+        n_active = int(np.sum((self.active
+                               & (self.limits > self._pred_ctx))
+                              | (nq > 0)))
+        _t_obs = time.perf_counter()
+        self._stats.inc("chunks")
+        self._stats.inc("unified_steps")
+        self._stats.inc("chunk_slot_steps", B * n_steps)
+        if n_pre:
+            self._stats.inc("prefill_waves")
+        self._stats.inc("active_slot_steps", n_active * n_steps)
+        self._c_spec_steps.inc()
+        from ..profiler.trace import get_tracer
+        _tr = get_tracer()
+        if _tr.enabled:
+            _tr.counter("serving/active_slots", n_active,
+                        queued=len(self.queue), chunk_len=n_steps,
+                        prefilling=n_pre)
+        _frec.record_event("sched_turn", seq=self._seq, mode="spec",
+                           active=n_active, queued=len(self.queue),
+                           prefilling=n_pre, chunk_len=n_steps)
+        self._obs_s += time.perf_counter() - _t_obs
+        res = fn(Tensor(jnp.asarray(ids)), Tensor(jnp.asarray(nq)),
+                 Tensor(jnp.asarray(last)), Tensor(jnp.asarray(tgt)),
+                 Tensor(jnp.asarray(nd)),
+                 Tensor(self._dev_tok), Tensor(self._dev_ctx),
+                 Tensor(self._dev_act), Tensor(self._dev_tbl),
+                 Tensor(self._dev_lim), Tensor(self._dev_eos),
+                 Tensor(self._key), *self.pools)
+        packed, tok_f, ctx_f, act_f, key_f = res[:5]
+        self.pools = list(res[5:])
+        self._dev_tok = tok_f._data
+        self._dev_ctx = ctx_f._data
+        self._dev_act = act_f._data
+        self._key = key_f._data
+        emits = np.zeros((B,), bool)
+        for slot in range(B):
+            if nq[slot] > 0:
+                self._prefill_off[slot] += nq[slot]
+                if last[slot]:
+                    req = self.slot_req[slot]
+                    tl = len(self._slot_prompt[slot])
+                    req.t_prefill_done = time.perf_counter()
+                    self._prefilling[slot] = False
+                    self.ctx[slot] = tl
+                    self.active[slot] = bool(tgt[slot])
+                    self._act_since[slot] = self._seq
+                    # the spec step has NO in-program decode tail:
+                    # exactly the first token lands this turn
+                    self._pred_ctx[slot] = tl
+                    self._pc_insert(slot)
+                    emits[slot] = True
+            elif self.active[slot] \
+                    and self.limits[slot] > self._pred_ctx[slot]:
+                # at least the target sample always lands; the exact
+                # accepted length arrives with the harvest mirrors
+                self._pred_ctx[slot] = min(
+                    int(self.limits[slot]),
+                    int(self._pred_ctx[slot]) + 1)
+                emits[slot] = True
+        self._emits_inflight += emits.astype(np.int32)
+        return (packed, list(self.slot_req), emits, n_steps, self._seq)
 
     def gauges(self) -> dict:
         """Serving observability surface (profiler subsystem):
@@ -1708,6 +2085,15 @@ class ContinuousBatchingEngine:
             "prefix_cache_evictions": s["prefix_cache_evictions"],
             "prefix_cache_cow_forks": s["prefix_cache_cow_forks"],
             "prefix_cache_pages": len(self._pc_nodes),
+            # speculative decoding economics (ISSUE 18)
+            "spec_steps": int(self._c_spec_steps.value),
+            "spec_tokens_drafted": int(self._c_spec_drafted.value),
+            "spec_tokens_accepted": int(self._c_spec_accepted.value),
+            "spec_tokens_rejected": int(self._c_spec_rejected.value),
+            "spec_accept_rate": (
+                self._c_spec_accepted.value
+                / self._c_spec_drafted.value)
+            if self._c_spec_drafted.value else 0.0,
         }
 
     def reset_gauges(self):
@@ -1717,6 +2103,9 @@ class ContinuousBatchingEngine:
         engine, so the compile-budget counter stays truthful."""
         for k in self._stats:
             self._stats[k] = 0.0 if k == "run_seconds" else 0
+        for c in (self._c_spec_steps, self._c_spec_drafted,
+                  self._c_spec_accepted, self._c_spec_rejected):
+            c.set(0)
         self._h_ttft.reset()
         self._h_itl.reset()
         self._obs_s = 0.0
@@ -2857,4 +3246,37 @@ def _register_serving_surface():
                  "wave. Shape key: slots/max_len/page."))
 
 
+def _register_spec_surface():
+    from ..tuner.surface import TunableSurface, register_surface
+
+    def _candidates(shape):
+        max_len = int(shape.get("max_len", 512))
+        out = []
+        for k in (2, 4, 6, 8):
+            if k + 1 > max_len:
+                continue
+            for src in ("ngram", "self"):
+                out.append({"k": k, "source": src})
+        return out
+
+    def _is_valid(config, shape):
+        max_len = int(shape.get("max_len", 512))
+        return (1 <= int(config["k"]) < max_len
+                and config["source"] in ("ngram", "self"))
+
+    register_surface(TunableSurface(
+        name="spec_decode",
+        params=("k", "source"),
+        default={"k": 4, "source": "ngram"},
+        candidates=_candidates,
+        is_valid=_is_valid,
+        describe="Speculative decoding: draft tokens per decode slot "
+                 "(K, verified as a length-K+1 ragged chunk) x draft "
+                 "source ('ngram' prompt-lookup / 'self' skip-layer). "
+                 "Shape key: slots/max_len/page — the cb geometry; "
+                 "bench.py --autotune's cb-spec section is the sweep "
+                 "vehicle."))
+
+
 _register_serving_surface()
+_register_spec_surface()

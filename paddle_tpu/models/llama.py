@@ -457,16 +457,29 @@ class LlamaModel(nn.Layer):
                                     for _ in range(config.num_hidden_layers)])
         self.norm = nn.RMSNorm(config.hidden_size, config.rms_norm_eps)
 
-    def forward(self, input_ids, caches=None, pos=None, tables=None):
+    def forward(self, input_ids, caches=None, pos=None, tables=None,
+                skip_layers=None):
         x = self.embed_tokens(input_ids)
         if caches is not None:
+            # skip_layers (speculative decoding, ISSUE 18): the listed
+            # decoder layers are passed through entirely — hidden state
+            # AND their KV caches flow unchanged — giving a cheap
+            # self-speculative draft model over the same weights
+            # (LayerSkip-style early exit). Serving-path only.
+            skip = frozenset(skip_layers) if skip_layers else frozenset()
             new_caches = []
             for i, layer in enumerate(self.layers):
+                if i in skip:
+                    new_caches.extend((caches[2 * i], caches[2 * i + 1]))
+                    continue
                 x, (kc, vc) = layer(x, cache=(caches[2 * i],
                                               caches[2 * i + 1]), pos=pos,
                                     tables=tables)
                 new_caches.extend((kc, vc))
             return self.norm(x), new_caches
+        if skip_layers:
+            raise ValueError("skip_layers requires the caches "
+                             "(serving) path")
         from ..nn.scan import scan_layers, can_scan
         if getattr(self.config, "scan_layers", True) and \
                 can_scan(self.layers):
@@ -578,10 +591,11 @@ class LlamaForCausalLM(nn.Layer, GenerationMixin):
         return _alloc_kv_caches(self.config, batch_size, max_length, dtype)
 
     def forward(self, input_ids, labels=None, caches=None, pos=None,
-                tables=None):
+                tables=None, skip_layers=None):
         if caches is not None:
             hidden, caches = self.llama(input_ids, caches=caches, pos=pos,
-                                        tables=tables)
+                                        tables=tables,
+                                        skip_layers=skip_layers)
         else:
             hidden = self.llama(input_ids)
         if labels is not None and caches is None and \

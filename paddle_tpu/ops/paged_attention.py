@@ -33,7 +33,8 @@ import jax.numpy as jnp
 __all__ = ["paged_attention", "paged_attention_reference",
            "paged_prefill_attention", "paged_prefill_attention_reference",
            "ragged_paged_attention", "ragged_paged_attention_reference",
-           "paged_decode_write", "paged_prefill_write"]
+           "paged_decode_write", "paged_prefill_write",
+           "paged_verify_write"]
 
 _NEG_INF = -1e30
 
@@ -252,3 +253,34 @@ def paged_prefill_write(kp, vp, k, v, block_tables, ctx, valid):
     kp = kp.at[:, pid, off, :].set(jnp.transpose(k, (2, 0, 1, 3)))
     vp = vp.at[:, pid, off, :].set(jnp.transpose(v, (2, 0, 1, 3)))
     return kp, vp
+
+
+def paged_verify_write(kp, vp, k, v, block_tables, ctx, valid):
+    """Multi-token speculative VERIFY write (ISSUE 18): write a
+    ``1 + K``-token verification chunk's k/v — the pending token plus
+    ``K`` draft tokens — into positions ``ctx .. ctx + K`` of each
+    slot's block-table row, BEFORE knowing how many drafts the target
+    will accept.
+
+    Rollback-safe page commit, by construction rather than by an undo
+    log:
+
+    - **Reads are fenced by ctx.** Every attention entry point masks
+      cache reads to positions ``<= ctx + j`` for query token ``j``,
+      and the engine only ever advances its committed ``ctx`` mirror by
+      the ACCEPTED length. KV written past the accepted position is
+      therefore unreachable — no future query can attend it.
+    - **Writes overwrite in place.** The next chunk for the slot starts
+      at the committed ``ctx`` and re-writes those same page offsets,
+      so rejected-draft garbage has the lifetime of one scheduler turn.
+    - **Sharing is prompt-only.** The prefix cache publishes full pages
+      of PROMPT tokens at prefill completion; decode/verify positions
+      live past ``len(prompt)`` in COW-private pages, so a rejected
+      draft can never leak into a page another sequence attaches.
+
+    Accepting tokens is thus a pure bookkeeping commit (advance ctx);
+    rejecting is a no-op. The write routing itself is identical to a
+    short prefill chunk — token ``j >= valid`` is trash-routed to page
+    0 and out-of-row positions are clamped — because a verification
+    chunk IS a short prefill chunk to the page pool."""
+    return paged_prefill_write(kp, vp, k, v, block_tables, ctx, valid)

@@ -12,10 +12,11 @@ own variant) and are shared by three consumers:
   for a surface with a builder here triggers one synchronous search).
 
 Surfaces whose trial needs a whole model + workload (``scan_remat``,
-``serving_chunks``) have NO standalone builder — :func:`auto_builder`
-returns None and the CLI directs users at ``bench.py``, which owns a
-model. Their registered grids/validity still gate what those vehicles
-may try.
+``serving_chunks``, ``spec_decode``) have NO standalone builder —
+:func:`auto_builder` returns None and the CLI directs users at
+``bench.py``, which owns a model (``--autotune``'s cb section sweeps
+serving_chunks, its cb-spec section sweeps spec_decode). Their
+registered grids/validity still gate what those vehicles may try.
 
 Each trial times forward + backward where the surface has backward
 tiles (grouped matmul's ``bd/bh`` only exist in the dw kernel), since
@@ -381,6 +382,9 @@ BENCH_PRESETS = {
         # 32-token pages, head_dim 128
         ("ragged_paged_attention",
          {"c": 32, "pages": 12, "page": 32, "d": 128}),
+        # model-level: the CLI points at `bench.py --autotune`'s
+        # cb-spec section, which sweeps K x draft source here
+        ("spec_decode", {"slots": 1, "max_len": 384, "page": 32}),
     ],
     "cpu_smoke": [
         ("grouped_matmul", {"d": 64, "h": 128, "E": 4}),
@@ -391,5 +395,6 @@ BENCH_PRESETS = {
         ("fused_ce", {"d": 64, "v": 1024}),
         ("ragged_paged_attention",
          {"c": 8, "pages": 4, "page": 8, "d": 16}),
+        ("spec_decode", {"slots": 1, "max_len": 64, "page": 8}),
     ],
 }

@@ -88,16 +88,46 @@ TOLERANCES = {
     # out of this table's frame; cb_disagg_vs_colocated is a vs_*
     # ratio — never gated.
     "cb_disagg_tok_s": 0.30,
+    # speculative decoding (ISSUE 18): spec-vs-plain A/B at decode
+    # batch 1. Tok/s gets the serving-section tolerance; HTTP goodput
+    # stays a correctness-adjacent claim. cb_spec_vs_plain and
+    # cb_spec_http_vs_plain are vs_* ratios — never gated — and
+    # cb_spec_accept_rate / cb_spec_itl_ms_p99 are workload-dependent
+    # diagnostics (ITL is lower-is-better, out of this table's frame).
+    "cb_spec_tok_s": 0.25,
+    "cb_spec_http_goodput_frac": 0.10,
 }
 
 
 def load_record(path):
     """One bench artifact -> (record dict | None, label). Driver
-    wrappers are unwrapped; a null ``parsed`` (outage round) is
-    None."""
+    wrappers are unwrapped; a null ``parsed`` (outage round) is None.
+
+    PARTIAL records are first-class (ISSUE 18): bench.py re-prints the
+    running record after every section and flushes it atomically, so a
+    timed-out round's artifact may be a multi-line capture whose final
+    line was cut mid-write — the LAST complete JSON object line wins
+    (it carries every section measured before the cut). check() then
+    compares whatever keys it has; absent keys simply aren't gated."""
     with open(path, encoding="utf-8") as f:
-        doc = json.load(f)
+        text = f.read()
     label = os.path.basename(path)
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+        for line in text.splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue        # section telemetry / stderr bleed
+            try:
+                cand = json.loads(line)
+            except ValueError:
+                continue        # the truncated tail of a killed round
+            if isinstance(cand, dict):
+                doc = cand
+        if doc is None:
+            raise
     if isinstance(doc, dict) and "parsed" in doc and "rc" in doc:
         return doc["parsed"], label
     return doc if isinstance(doc, dict) else None, label
@@ -219,6 +249,42 @@ def self_test() -> int:
     expect("untracked keys ignored",
            {"cb_unified_vs_legacy": 0.01,
             "provenance": {"backend": "tpu"}}, False)
+    # partial records (ISSUE 18): a round cut after the train section
+    # gates ONLY the keys it carries — missing decode/cb keys are not
+    # failures — and a real drop in a carried key still flags
+    expect("partial record, carried key ok",
+           {"value": 8184.0,
+            "provenance": {"backend": "tpu"}}, False)
+    expect("partial record, carried key drops",
+           {"value": 8184.0 * 0.7,
+            "provenance": {"backend": "tpu"}}, True)
+    # a timed-out round's artifact: incremental record lines with a
+    # truncated tail must parse to the last COMPLETE line
+    import tempfile
+    good = {"decode_value": 2254.0 * 0.99,
+            "provenance": {"backend": "tpu"}}
+    capture = (json.dumps({"value": 8184.0}) + "\n"
+               + json.dumps(good) + "\n"
+               + json.dumps({"decode_value": 1.0})[:12] + "\n")
+    with tempfile.NamedTemporaryFile(
+            "w", suffix=".json", delete=False) as tf:
+        tf.write(capture)
+        trunc_path = tf.name
+    try:
+        rec, _ = load_record(trunc_path)
+        got = rec == good
+        print(f"[self-test] truncated multi-line capture: expected "
+              f"last complete line, got "
+              f"{'it' if got else rec!r} [{'ok' if got else 'FAILED'}]")
+        if not got:
+            ok = False
+        regs = check(rec, base, out=__import__('io').StringIO())
+        if regs:
+            ok = False
+            print("[self-test] truncated capture wrongly flagged "
+                  "[FAILED]")
+    finally:
+        os.unlink(trunc_path)
     print(f"[self-test] {'all scenarios behave' if ok else 'BROKEN'}")
     return 0 if ok else 1
 

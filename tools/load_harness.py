@@ -184,6 +184,15 @@ TRACE_MIXES = {
         long_frac=0.35,
         long_prompt_len=(24, 40), long_max_new=(16, 24),
         short_prompt_len=(3, 8), short_max_new=(2, 6)),
+    # the ISSUE-18 small-batch interactive shape: short chat prompts
+    # with LONG generations at low concurrency — decode-bound, one
+    # compiled program per token on the plain engine, so this is the
+    # mix where speculative decoding pays (bench.py cb-spec goodput
+    # leg drives it at concurrency 1-2)
+    "short_chat_batch1": dict(
+        long_frac=0.75,
+        long_prompt_len=(4, 10), long_max_new=(24, 40),
+        short_prompt_len=(3, 6), short_max_new=(12, 20)),
 }
 
 
@@ -407,6 +416,12 @@ def main(argv=None) -> int:
                          "token (exercises cancel/reclaim)")
     ap.add_argument("--ttft-deadline-ms", type=float, default=None)
     ap.add_argument("--deadline-ms", type=float, default=None)
+    ap.add_argument("--trace-mix", default=None,
+                    choices=sorted(TRACE_MIXES),
+                    help="use a named trace mix instead of the "
+                         "--prompt-len/--max-new knobs (same "
+                         "deterministic sequence every consumer of "
+                         "(mix, requests, vocab, seed) gets)")
     ap.add_argument("--no-stream", action="store_true",
                     help="non-streaming JSON instead of SSE")
     ap.add_argument("--timeout-s", type=float, default=120.0)
@@ -415,17 +430,26 @@ def main(argv=None) -> int:
                     help="write the JSON report here")
     args = ap.parse_args(argv)
 
-    workload = build_workload(
-        args.requests, vocab=args.vocab, seed=args.seed,
-        prompt_len=tuple(args.prompt_len),
-        max_new=tuple(args.max_new), prefix_frac=args.prefix_frac,
-        prefix_len=args.prefix_len,
-        tenants=tuple(args.tenants.split(",")),
-        priorities=tuple(int(p) for p in args.priorities.split(",")),
-        disconnect_frac=args.disconnect_frac,
-        stream=not args.no_stream,
-        ttft_deadline_ms=args.ttft_deadline_ms,
-        deadline_ms=args.deadline_ms)
+    if args.trace_mix:
+        mix = build_trace_mix(args.trace_mix, args.requests,
+                              vocab=args.vocab, seed=args.seed)
+        workload = trace_mix_workload(
+            mix, stream=not args.no_stream,
+            tenants=tuple(args.tenants.split(",")),
+            priorities=tuple(int(p)
+                             for p in args.priorities.split(",")))
+    else:
+        workload = build_workload(
+            args.requests, vocab=args.vocab, seed=args.seed,
+            prompt_len=tuple(args.prompt_len),
+            max_new=tuple(args.max_new), prefix_frac=args.prefix_frac,
+            prefix_len=args.prefix_len,
+            tenants=tuple(args.tenants.split(",")),
+            priorities=tuple(int(p) for p in args.priorities.split(",")),
+            disconnect_frac=args.disconnect_frac,
+            stream=not args.no_stream,
+            ttft_deadline_ms=args.ttft_deadline_ms,
+            deadline_ms=args.deadline_ms)
     report, _ = run_load(
         args.url, workload, mode=args.mode,
         concurrency=args.concurrency, rate=args.rate,

@@ -163,6 +163,23 @@ def gate_commands(log: str, budget: float, no_budget: bool,
                            "test_serving_parity.py"),
               "-q", "-m", "serving_parity",
               "-p", "no:cacheprovider"]))
+        # speculative decoding (ISSUE 18): greedy spec-on streams
+        # token-identical to the plain engine for BOTH draft sources
+        # (n-gram prompt-lookup and self-speculative skip-layer),
+        # including eos mid-chunk, forced acceptance-0/K extremes, and
+        # the composition pins — spec x prefix-cache warm attach, spec
+        # x priority preemption replay, spec x supervised restart —
+        # plus rejection-sampler distribution exactness. The FULL
+        # spec_decode marker, slow included (the observability-gate
+        # pattern); rides --no-serving since it compiles the same
+        # tiny-engine stack.
+        gates.append(
+            ("spec_decode",
+             [sys.executable, "-m", "pytest",
+              os.path.join(REPO_DIR, "tests",
+                           "test_spec_decode.py"),
+              "-q", "-m", "spec_decode",
+              "-p", "no:cacheprovider"]))
     if not no_fused:
         # fused training-kernel parity: the interpret-mode kernel-vs-
         # oracle suite with every fused flag forced ON via the
