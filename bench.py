@@ -1443,6 +1443,120 @@ def _cb_disagg_bench(on_tpu):
     return out
 
 
+def _cb_autoscale_bench(on_tpu):
+    """SLO-driven autoscaler A/B (ISSUE 19): the seeded ``diurnal``
+    and ``flash_crowd`` scenarios through a fleet with the
+    :class:`FleetAutoscaler` closing the loop (1..3 replicas) vs the
+    SAME schedules through a max-size FIXED fleet (3 replicas pinned).
+    The claim on the goodput-vs-chips frontier: goodput and the
+    scenarios' own SLO attainment bars hold while the chip-seconds
+    bill (the cost model's ready-replica integral on the harness's
+    virtual clock) comes in under the fixed fleet's.
+    ``autoscale_vs_fixed_chips`` is a vs_* ratio — never gated.
+    Always the tiny 1-layer model: the section measures the control
+    loop (signals, rules, hysteresis, warm spares, drains), which the
+    accelerator does not change. BASELINE.md documents the keys."""
+    import paddle_tpu as paddle
+    from paddle_tpu.inference import (ContinuousBatchingEngine,
+                                      FleetAutoscaler, Overloaded,
+                                      ServingFleet)
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.profiler.slo import SLORule
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        from load_harness import (SCENARIOS, TickClock,
+                                  build_scenario, run_fleet_scenario)
+    finally:
+        sys.path.pop(0)
+
+    cfg = LlamaConfig.tiny()
+    cfg.tensor_parallel = False
+    cfg.scan_layers = False
+    cfg.num_hidden_layers = 1
+    paddle.seed(0)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    def factory():
+        return ContinuousBatchingEngine(
+            model, num_slots=2, page_size=8, max_len=48,
+            decode_chunk=4, prompt_buckets=(8, 16), greedy=True)
+
+    max_r = 3
+    ctl_kw = dict(min_replicas=1, max_replicas=max_r,
+                  up_cooldown_s=2.0, down_cooldown_s=3.0,
+                  queue_high=3.0, queue_low=0.5,
+                  down_stable_ticks=3)
+    # few fleet turns per tick so the bursts genuinely outrun a lone
+    # replica and the controller has to act (same lever as the
+    # scenario gate)
+    steps = {"diurnal": 1, "flash_crowd": 2}
+    goodputs, attains, legs = [], [], []
+    chip_auto = chip_fixed = 0.0
+    decisions = 0
+
+    for name in ("diurnal", "flash_crowd"):
+        sc = SCENARIOS[name]
+        schedule = build_scenario(name, vocab=cfg.vocab_size, seed=23)
+        rules = [SLORule(**d) for d in sc["slo_rules"]]
+
+        # the fixed leg: max-size fleet, no controller
+        fleet = ServingFleet(factory, max_r, slo_rules=rules,
+                             hedge_delay_s=None, seed=0)
+        clock = TickClock()
+        try:
+            fixed = run_fleet_scenario(
+                fleet, schedule, clock=clock, shed_exc=Overloaded,
+                steps_per_tick=steps[name])
+        finally:
+            fleet.close()
+        chip_fixed += max_r * clock.t
+
+        # the autoscaled leg: start at the floor, let the loop drive
+        fleet = ServingFleet(factory, 1, slo_rules=rules,
+                             hedge_delay_s=None, seed=0)
+        clock = TickClock()
+        ctl = FleetAutoscaler(fleet, now_fn=clock, **ctl_kw)
+        try:
+            rep = run_fleet_scenario(
+                fleet, schedule, autoscaler=ctl, clock=clock,
+                shed_exc=Overloaded, steps_per_tick=steps[name])
+        finally:
+            fleet.close()
+        goodputs.append(rep["goodput_frac"])
+        attains.append(rep["slo"]["worst_attainment"])
+        chip_auto += rep["chip_seconds"]
+        decisions += int(
+            fleet.metrics.counter("autoscale/decisions").value)
+        legs.append((name, rep, fixed))
+
+    out = {
+        # the gated pair: worst leg carries the claim
+        "autoscale_goodput_frac": round(min(goodputs), 4),
+        "autoscale_slo_attainment": round(min(attains), 4),
+        # lower-is-better / diagnostics: never gated
+        "autoscale_chip_seconds": round(chip_auto, 2),
+        "autoscale_decisions": decisions,
+        "autoscale_vs_fixed_chips": round(chip_auto / chip_fixed, 4)
+        if chip_fixed else 0.0,
+    }
+    for name, rep, fixed in legs:
+        print(f"# cb autoscale {name}: goodput "
+              f"{rep['goodput_frac']} (fixed {fixed['goodput_frac']}),"
+              f" attainment {rep['slo']['worst_attainment']}, peak "
+              f"{rep['peak_ready']} ready, chip-s "
+              f"{rep['chip_seconds']}", file=sys.stderr)
+    print(f"# cb autoscale: attainment "
+          f"{out['autoscale_slo_attainment']}, chip-s "
+          f"{out['autoscale_chip_seconds']} "
+          f"(x{out['autoscale_vs_fixed_chips']} vs fixed "
+          f"{max_r}-replica fleet), {decisions} decisions",
+          file=sys.stderr)
+    return out
+
+
 def _cb_prefix_bench(on_tpu):
     """Shared-prefix storm (ISSUE 12): the acceptance A/B for
     radix-tree prefix caching — N requests sharing one long prefix
@@ -2278,6 +2392,21 @@ def main():
     gc.collect()
     if cb_disagg is not None:
         record.update(cb_disagg)
+        _emit_record(record, rec_out)
+
+    # SLO-driven autoscaler (ISSUE 19): the goodput-vs-chips frontier
+    # A/B right after the fleets whose control loop it closes
+    try:
+        cb_autoscale = _timed_section(
+            "cb autoscale", lambda: _retry_transient(
+                lambda: _cb_autoscale_bench(on_tpu),
+                "cb autoscale bench"))
+    except Exception as e:
+        print(f"# cb autoscale bench failed: {e!r}", file=sys.stderr)
+        cb_autoscale = None
+    gc.collect()
+    if cb_autoscale is not None:
+        record.update(cb_autoscale)
         _emit_record(record, rec_out)
 
     # shared-prefix storm (ISSUE 12): the prefix-cache cold/warm A/B

@@ -41,6 +41,7 @@ from .reliability import (AdmissionController, DeadlineExceeded,
 from .serving import ContinuousBatchingEngine, ServedRequest
 from .fleet import FleetReplica, ServingFleet
 from .disagg import DisaggServingFleet
+from .autoscaler import FleetAutoscaler
 from .api_server import ApiServer
 from .proc_replica import ProcReplica
 from .wire import (FrameCorrupt, FrameOutOfOrder, FrameTooLarge,
@@ -52,7 +53,7 @@ __all__ = ["Config", "Predictor", "Tensor", "PrecisionType", "PlaceType",
            "ServingError", "RequestCancelled", "DeadlineExceeded",
            "RequestQuarantined", "Overloaded", "ReplicaFailed",
            "ServingFleet", "FleetReplica", "DisaggServingFleet",
-           "ApiServer", "ProcReplica",
+           "FleetAutoscaler", "ApiServer", "ProcReplica",
            "WireError", "FrameCorrupt", "FrameTooLarge",
            "FrameOutOfOrder", "WireTimeout", "WireClosed"]
 

@@ -143,6 +143,13 @@ _pmetrics.declare("fleet/affinity_hits", "counter",
                   "warm prefix cache)")
 _pmetrics.declare("fleet/replicas_ready", "gauge",
                   "replicas currently taking router weight")
+_pmetrics.declare("fleet/queue_depth", "gauge",
+                  "requests waiting in admission queues summed across "
+                  "live replicas — the fleet-level pressure signal the "
+                  "autoscaler and /statusz read (ISSUE 19)")
+_pmetrics.declare("fleet/shed_rate", "gauge",
+                  "admission sheds per second summed across live "
+                  "replicas (each controller's trailing-window rate)")
 _pmetrics.declare("fleet/failover_ms", "histogram",
                   "per salvaged request: replica ejection -> "
                   "re-admission on a sibling, ms — retry backoff "
@@ -217,6 +224,17 @@ class FleetReplica:
                    for r in eng.slot_req
                    if r is not None and not r.finished)
         return rem / max(1, eng.num_slots)
+
+    def queue_depth(self):
+        """Requests waiting in this replica's admission queue — the
+        per-replica pressure signal (ISSUE 19); the fleet mirrors it
+        into the ``serving/queue_depth`` gauge each turn."""
+        return len(self.engine.queue)
+
+    def shed_rate(self):
+        """This replica's windowed admission-shed rate (sheds/s) —
+        :meth:`~.reliability.AdmissionController.shed_rate`."""
+        return self.admission.shed_rate()
 
     def ttft_p99_s(self):
         """The replica's observed ttft p99 (PR-9 reservoir), seconds —
@@ -1086,6 +1104,12 @@ class ServingFleet:
             "wedge_ejections": c("fleet/wedge_ejections"),
             "drains": c("fleet/drains"),
             "scale_ups": c("fleet/scale_ups"),
+            "queue_depth": sum(r.queue_depth()
+                               for r in self.replicas.values()
+                               if r.live()),
+            "shed_rate": round(sum(r.shed_rate()
+                                   for r in self.replicas.values()
+                                   if r.live()), 4),
             "failover_ms_p99": self._h_failover.percentile(99),
             "obs_overhead_frac": (self._obs_s / self._run_s)
             if self._run_s else 0.0,
@@ -1097,6 +1121,24 @@ class ServingFleet:
                 if r.takes_weight()))
         self.metrics.gauge("obs/overhead_frac").set(
             (self._obs_s / self._run_s) if self._run_s else 0.0)
+        # ISSUE 19: the pressure signals, fleet-level AND mirrored
+        # onto each live replica's own registry (labeled children on
+        # the federated scrape) — the router, the autoscaler and
+        # /statusz all read the same numbers
+        q_total = s_total = 0.0
+        for r in self.replicas.values():
+            if not r.live():
+                continue
+            try:
+                q, s = r.queue_depth(), r.shed_rate()
+            except Exception:  # noqa: BLE001 — a replica mid-teardown
+                continue       # must not tear the gauge sweep
+            q_total += q
+            s_total += s
+            r.engine.metrics.gauge("serving/queue_depth").set(q)
+            r.engine.metrics.gauge("serving/shed_rate").set(s)
+        self.metrics.gauge("fleet/queue_depth").set(q_total)
+        self.metrics.gauge("fleet/shed_rate").set(round(s_total, 4))
 
     # ---- /statusz + exposition (ISSUE 13) --------------------------------
 
@@ -1115,7 +1157,8 @@ class ServingFleet:
                 g = r.supervisor.gauges()
                 entry.update(
                     load=round(r.load(), 4),
-                    queued=len(r.engine.queue),
+                    queued=r.queue_depth(),
+                    shed_rate=round(r.shed_rate(), 4),
                     ttft_p99_ms=round(p99 * 1e3, 3)
                     if p99 is not None else None,
                     tokens_emitted=g.get("tokens_emitted", 0),
@@ -1158,10 +1201,18 @@ class ServingFleet:
                     "last_bundle": rec.last_bundle_path,
                     "incidents": rec.incidents()}
 
+        def _autoscaler():
+            # attached by FleetAutoscaler's ctor (ISSUE 19): the
+            # structured decision log — signals in, rule fired, action
+            # out; None for an operator-scaled fleet
+            ctl = getattr(self, "autoscaler", None)
+            return ctl.statusz() if ctl is not None else None
+
         return {
             "fleet": self.gauges,
             "replicas": self._statusz_replicas,
             "slo": _slo,
+            "autoscaler": _autoscaler,
             "slowest_traces": self._statusz_traces,
             "flight_recorder": _flight,
             "goodput": _goodput_section,

@@ -34,6 +34,7 @@ engine surface (``queue``/``slot_req``/``gauges``/``requeue``/...).
 from __future__ import annotations
 
 import time
+from collections import deque
 
 from ..profiler import flight_recorder as _frec
 from ..profiler import metrics as _metrics
@@ -212,13 +213,18 @@ class AdmissionController:
     """
 
     def __init__(self, target, max_queue=64, default_ttft_slo_s=None,
-                 min_retry_after_s=0.05):
+                 min_retry_after_s=0.05, shed_window_s=10.0):
         self._target = target
         self.max_queue = int(max_queue)
         self.default_ttft_slo_s = default_ttft_slo_s
         self.min_retry_after_s = float(min_retry_after_s)
         self.accepted = 0
         self.shed = 0
+        #: recent shed instants (bounded): the windowed shed RATE the
+        #: autoscaler and the fleet gauges read — the counter above is
+        #: lifetime-monotonic and says nothing about "now"
+        self.shed_window_s = float(shed_window_s)
+        self._shed_times = deque(maxlen=1024)
 
     @property
     def engine(self):
@@ -277,6 +283,18 @@ class AdmissionController:
         replicas) instead of inventing a constant."""
         return self._retry_after_s(self.engine)
 
+    def shed_rate(self, now=None):
+        """Sheds per second over the trailing ``shed_window_s`` — the
+        live pressure signal (ISSUE 19): the ``shed`` counter is
+        monotonic and cannot distinguish an overload NOW from one an
+        hour ago. Prunes as it reads, so an idle controller decays to
+        0.0 without any writer."""
+        now = time.perf_counter() if now is None else now
+        horizon = now - self.shed_window_s
+        while self._shed_times and self._shed_times[0] < horizon:
+            self._shed_times.popleft()
+        return len(self._shed_times) / self.shed_window_s
+
     # -- the door ----------------------------------------------------------
 
     def _shed(self, eng, reason, floor_s=0.0):
@@ -287,6 +305,7 @@ class AdmissionController:
         re-shed loop)."""
         retry = max(self._retry_after_s(eng), floor_s)
         self.shed += 1
+        self._shed_times.append(time.perf_counter())
         eng.metrics.counter("serving/shed_rejections").inc()
         eng.metrics.gauge("serving/shed_retry_after_s").set(retry)
         _frec.record_event("shed", reason=reason,

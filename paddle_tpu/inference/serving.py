@@ -184,6 +184,15 @@ _pmetrics.declare("serving/shed_rejections", "counter",
 _pmetrics.declare("serving/shed_retry_after_s", "gauge",
                   "retry-after seconds attached to the most recent "
                   "Overloaded rejection")
+# ISSUE 19 pressure gauges: the LIVE signals the autoscaler and
+# /statusz read — the counters above are monotonic history, these are
+# "now" (set per gauge emission, and per fleet turn on fleet replicas)
+_pmetrics.declare("serving/queue_depth", "gauge",
+                  "requests currently waiting in the admission queue "
+                  "(not yet in a slot)")
+_pmetrics.declare("serving/shed_rate", "gauge",
+                  "admission sheds per second over the controller's "
+                  "trailing window (AdmissionController.shed_rate)")
 # ISSUE 12 prefix-cache vocabulary: shared-prefix reuse is the serving
 # capacity story, so its economics are first-class metrics
 _pmetrics.declare("serving/prefix_cache_hits", "counter",
@@ -717,6 +726,7 @@ class ContinuousBatchingEngine:
         self._g_overhead = self.metrics.gauge("obs/overhead_frac")
         self._g_pc_pages = self.metrics.gauge(
             "serving/prefix_cache_pages")
+        self._g_queue_depth = self.metrics.gauge("serving/queue_depth")
         self._c_migrated_out = self.metrics.counter(
             "disagg/migrated_out")
         self._c_kv_exported = self.metrics.counter(
@@ -2069,6 +2079,7 @@ class ContinuousBatchingEngine:
             "deadline_expired": (s["deadline_ttft_expired"]
                                  + s["deadline_total_expired"]),
             "shed_rejections": s["shed_rejections"],
+            "queue_depth": len(self.queue),
             "quarantined": s["quarantined"],
             "containments": s["containments"],
             # prefix-cache economics (ISSUE 12): the shared-prefix
@@ -2117,6 +2128,7 @@ class ContinuousBatchingEngine:
             (self._obs_s / s["run_seconds"]) if s["run_seconds"]
             else 0.0)
         self._g_pc_pages.set(len(self._pc_nodes))
+        self._g_queue_depth.set(len(self.queue))
         from ..profiler.trace import get_tracer
         tr = get_tracer()
         if tr.enabled:

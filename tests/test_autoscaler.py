@@ -1,0 +1,349 @@
+"""FleetAutoscaler control-loop contracts (ISSUE 19), unit tier.
+
+Every rule, every hysteresis guard and the cost model, pinned against
+a FAKE fleet (no engines, no compiles — the controller only ever
+touches the duck-typed replica surface) with an injected clock, so
+each decision is deterministic. The real-fleet end-to-end scenarios
+live in tests/test_autoscale_scenarios.py (the ``autoscale_scenarios``
+gate)."""
+
+import pytest
+
+from paddle_tpu.inference import FleetAutoscaler
+from paddle_tpu.profiler import metrics as _pmetrics
+
+pytestmark = pytest.mark.autoscale
+
+
+# ---- the fake fleet --------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class _FakeEngine:
+    def __init__(self, num_slots=2):
+        self.num_slots = num_slots
+        self.slot_req = [None] * num_slots
+        self.metrics = _pmetrics.MetricsRegistry()
+
+
+class _Busy:
+    finished = False
+
+
+class _FakeSupervisor:
+    def gauges(self):
+        return {"prefix_cache_hit_rate": 0.5}
+
+
+class _FakeReplica:
+    def __init__(self, rid):
+        self.id = rid
+        self.state = "ready"
+        self.engine = _FakeEngine()
+        self.supervisor = _FakeSupervisor()
+        self.queue = 0
+        self.sheds = 0.0
+        self.load_val = 0.0
+
+    def takes_weight(self):
+        return self.state == "ready"
+
+    def live(self):
+        return self.state in ("ready", "draining")
+
+    def queue_depth(self):
+        return self.queue
+
+    def shed_rate(self):
+        return self.sheds
+
+    def load(self):
+        return self.load_val
+
+    def set_busy(self, n):
+        self.engine.slot_req = [_Busy() if i < n else None
+                                for i in range(self.engine.num_slots)]
+
+
+class _FakeFleet:
+    def __init__(self, n=2):
+        self.metrics = _pmetrics.MetricsRegistry()
+        self.replicas = {i: _FakeReplica(i) for i in range(n)}
+        self.slo = None
+        self.scale_up_calls = []
+        self.scale_down_calls = []
+
+    def scale_up(self, warm=True, **kw):
+        rid = max(self.replicas) + 1
+        self.replicas[rid] = _FakeReplica(rid)
+        self.scale_up_calls.append(dict(kw, warm=warm))
+        return rid
+
+    def scale_down(self, replica_id=None):
+        self.replicas[replica_id].state = "draining"
+        self.scale_down_calls.append(replica_id)
+        return replica_id
+
+
+class _FakeSLO:
+    def __init__(self, burn):
+        self.burn = burn
+
+    def summary(self):
+        return {"rules": {"ttft": {"labels": {
+            "tenantA": {"burn_rate": self.burn}}}}}
+
+
+class _FakeDisagg(_FakeFleet):
+    def __init__(self, roles):
+        super().__init__(len(roles))
+        self.roles = dict(enumerate(roles))
+
+    def _prefill_capable(self, rep):
+        return self.roles.get(rep.id, "both") != "decode"
+
+    def _decode_capable(self, rep):
+        return self.roles.get(rep.id, "both") != "prefill"
+
+    def prefill_queue_depth(self):
+        return sum(r.queue for r in self.replicas.values()
+                   if r.live() and self._prefill_capable(r))
+
+    def scale_up(self, warm=True, role="both", **kw):
+        rid = super().scale_up(warm=warm, role=role, **kw)
+        self.roles[rid] = role
+        return rid
+
+
+def _ctl(fleet, clock, **kw):
+    kw.setdefault("min_replicas", 1)
+    kw.setdefault("max_replicas", 4)
+    kw.setdefault("up_cooldown_s", 3.0)
+    kw.setdefault("down_cooldown_s", 5.0)
+    kw.setdefault("down_stable_ticks", 2)
+    return FleetAutoscaler(fleet, now_fn=clock, **kw)
+
+
+def _tick(ctl, clock, dt=1.0):
+    rec = ctl.tick()
+    clock.t += dt
+    return rec
+
+
+# ---- scale-up rules --------------------------------------------------------
+
+def test_queue_pressure_scales_up_with_explainable_record():
+    fleet, clock = _FakeFleet(2), _Clock()
+    ctl = _ctl(fleet, clock)
+    for r in fleet.replicas.values():
+        r.queue = 10
+    rec = _tick(ctl, clock)
+    assert rec["action"] == "scale_up"
+    assert rec["rule"] == "queue_depth_high"
+    assert rec["replica"] == 2
+    # signals in, rule fired, action out — reconstructable alone
+    assert rec["signals"]["queue_per_replica"] == 10.0
+    assert fleet.scale_up_calls == [{"warm": True}]
+    assert ctl.statusz()["scale_ups"] == 1
+    assert ctl.decisions[-1] is rec
+
+
+def test_occupancy_shed_and_burn_each_trigger():
+    for setup, rule in [
+        (lambda f: [r.set_busy(2) for r in f.replicas.values()],
+         "occupancy_high"),
+        (lambda f: setattr(f.replicas[0], "sheds", 2.0),
+         "shed_rate_high"),
+        (lambda f: setattr(f, "slo", _FakeSLO(burn=3.0)),
+         "slo_burn_high"),
+    ]:
+        fleet, clock = _FakeFleet(2), _Clock()
+        ctl = _ctl(fleet, clock)
+        setup(fleet)
+        rec = _tick(ctl, clock)
+        assert rec["action"] == "scale_up", rule
+        assert rec["rule"] == rule
+
+
+def test_capacity_floor_outranks_pressure_signals():
+    """A fleet below min_replicas ready (operator drain, ejection)
+    reads zero pressure — the floor rule must backfill it anyway."""
+    fleet, clock = _FakeFleet(2), _Clock()
+    ctl = _ctl(fleet, clock, min_replicas=2)
+    fleet.replicas[0].state = "draining"
+    rec = _tick(ctl, clock)
+    assert rec["action"] == "scale_up"
+    assert rec["rule"] == "below_min_replicas"
+
+
+def test_deadband_holds():
+    fleet, clock = _FakeFleet(2), _Clock()
+    ctl = _ctl(fleet, clock)
+    fleet.replicas[0].queue = 2       # above queue_low*2, below high
+    rec = _tick(ctl, clock)
+    assert rec["action"] == "hold"
+    assert rec["rule"] == "deadband"
+    assert not fleet.scale_up_calls and not fleet.scale_down_calls
+
+
+# ---- hysteresis ------------------------------------------------------------
+
+def test_up_cooldown_blocks_and_is_recorded():
+    fleet, clock = _FakeFleet(2), _Clock()
+    ctl = _ctl(fleet, clock)
+    for r in fleet.replicas.values():
+        r.queue = 10
+    assert _tick(ctl, clock)["action"] == "scale_up"
+    rec = _tick(ctl, clock)           # still hot, 1s into 3s cooldown
+    assert rec["action"] == "blocked"
+    assert rec["wanted"] == "scale_up"
+    assert "cooldown" in rec["reason"]
+    clock.t = 10.0                    # past the cooldown
+    assert ctl.tick()["action"] == "scale_up"
+    assert ctl.statusz()["blocked"] == 1
+
+
+def test_max_replicas_and_chip_budget_block():
+    fleet, clock = _FakeFleet(2), _Clock()
+    ctl = _ctl(fleet, clock, max_replicas=2)
+    fleet.replicas[0].queue = 99
+    assert _tick(ctl, clock)["reason"] == "at max_replicas=2"
+
+    fleet, clock = _FakeFleet(2), _Clock()
+    ctl = _ctl(fleet, clock, chips_per_replica=2.0, chip_budget=4.0)
+    fleet.replicas[0].queue = 99
+    rec = _tick(ctl, clock)
+    assert rec["action"] == "blocked"
+    assert "chip budget" in rec["reason"]
+
+
+def test_scale_down_needs_stable_idle_then_cooldown():
+    fleet, clock = _FakeFleet(3), _Clock()
+    ctl = _ctl(fleet, clock, down_stable_ticks=3)
+    recs = [_tick(ctl, clock) for _ in range(4)]
+    assert [r["action"] for r in recs[:2]] == ["hold", "hold"]
+    assert recs[0]["rule"] == "idle_warming"
+    assert recs[2]["action"] == "scale_down"
+    assert recs[2]["rule"] == "idle_stable"
+    # the drained replica is the least-loaded ready one
+    assert fleet.scale_down_calls == [recs[2]["replica"]]
+    # idle again, but inside the down cooldown: blocked, not flapped
+    assert recs[3]["action"] in ("hold", "blocked")
+    acts = ctl.actions()
+    assert [a["action"] for a in acts] == ["scale_down"]
+
+
+def test_min_replicas_floor_blocks_scale_down():
+    fleet, clock = _FakeFleet(1), _Clock()
+    ctl = _ctl(fleet, clock, down_stable_ticks=1)
+    rec = _tick(ctl, clock)
+    assert rec["action"] == "blocked"
+    assert rec["wanted"] == "scale_down"
+    assert not fleet.scale_down_calls
+
+
+def test_no_up_down_pair_within_one_cooldown_under_noise():
+    """The flapping invariant: drive an adversarial alternating
+    hot/idle signal and assert no adjacent action pair lands closer
+    than the first action's cooldown."""
+    fleet, clock = _FakeFleet(2), _Clock()
+    ctl = _ctl(fleet, clock, down_stable_ticks=1,
+               up_cooldown_s=3.0, down_cooldown_s=5.0)
+    for i in range(30):
+        q = 10 if i % 2 == 0 else 0
+        for r in fleet.replicas.values():
+            if r.state == "ready":
+                r.queue = q
+        _tick(ctl, clock)
+    acts = ctl.actions()
+    assert acts, "noise never produced a single action?"
+    cool = {"scale_up": 3.0, "scale_down": 5.0}
+    for a, b in zip(acts, acts[1:]):
+        assert b["t"] - a["t"] >= cool[a["action"]], (a, b)
+
+
+# ---- cost model ------------------------------------------------------------
+
+def test_chip_seconds_integrates_ready_replicas():
+    fleet, clock = _FakeFleet(2), _Clock()
+    ctl = _ctl(fleet, clock, chips_per_replica=2.0)
+    fleet.replicas[0].queue = 2       # deadband: hold at 2 ready
+    for _ in range(5):
+        _tick(ctl, clock)             # 2 ready x 2 chips x 1s per gap
+    # 4 inter-tick gaps have elapsed at the 5th tick
+    assert ctl.chip_seconds == pytest.approx(4 * 2 * 2.0)
+    assert ctl.statusz()["chip_seconds"] == pytest.approx(16.0)
+
+
+# ---- role awareness (disagg) ----------------------------------------------
+
+def test_role_pick_prefill_decode_both():
+    # deep prefill queue -> prefill
+    fleet, clock = _FakeDisagg(["prefill", "decode"]), _Clock()
+    ctl = _ctl(fleet, clock)
+    fleet.replicas[0].queue = 20
+    rec = _tick(ctl, clock)
+    assert (rec["action"], rec["role"]) == ("scale_up", "prefill")
+    assert fleet.roles[rec["replica"]] == "prefill"
+
+    # saturated decode slots (and queue pressure there) -> decode
+    fleet, clock = _FakeDisagg(["prefill", "decode"]), _Clock()
+    ctl = _ctl(fleet, clock)
+    fleet.replicas[1].queue = 20
+    fleet.replicas[1].set_busy(2)
+    rec = _tick(ctl, clock)
+    assert (rec["action"], rec["role"]) == ("scale_up", "decode")
+
+    # both hot -> both
+    fleet, clock = _FakeDisagg(["prefill", "decode"]), _Clock()
+    ctl = _ctl(fleet, clock)
+    fleet.replicas[0].queue = 20
+    fleet.replicas[1].set_busy(2)
+    rec = _tick(ctl, clock)
+    assert (rec["action"], rec["role"]) == ("scale_up", "both")
+
+
+def test_scale_down_never_drains_last_replica_of_a_role():
+    fleet, clock = _FakeDisagg(["prefill", "decode", "decode"]), \
+        _Clock()
+    ctl = _ctl(fleet, clock, down_stable_ticks=1, min_replicas=1)
+    # prefill replica 0 is the least loaded, but it is the LAST
+    # prefill-capable one — the drain must take a decode sibling
+    fleet.replicas[1].load_val = 1.0
+    fleet.replicas[2].load_val = 2.0
+    rec = ctl.tick()
+    assert rec["action"] == "scale_down"
+    assert rec["replica"] == 1
+    assert fleet.roles[rec["replica"]] == "decode"
+
+    # one prefill + one decode left: nothing can be spared
+    fleet2, clock2 = _FakeDisagg(["prefill", "decode"]), _Clock()
+    ctl2 = _ctl(fleet2, clock2, down_stable_ticks=1, min_replicas=1)
+    rec2 = ctl2.tick()
+    assert rec2["action"] == "blocked"
+    assert rec2["wanted"] == "scale_down"
+
+
+# ---- metrics + log bounds --------------------------------------------------
+
+def test_autoscale_metrics_and_bounded_log():
+    fleet, clock = _FakeFleet(2), _Clock()
+    ctl = _ctl(fleet, clock, max_decisions=8)
+    for r in fleet.replicas.values():
+        r.queue = 10
+    for _ in range(20):
+        _tick(ctl, clock, dt=0.1)     # mostly blocked by cooldown
+    m = fleet.metrics
+    assert m.counter("autoscale/ticks").value == 20
+    ups = m.counter("autoscale/scale_ups").value
+    blocked = m.counter("autoscale/blocked").value
+    assert ups >= 1 and blocked >= 1
+    assert m.counter("autoscale/decisions").value == ups + blocked
+    assert len(ctl.decisions) == 8    # bounded, newest kept
+    assert m.gauge("autoscale/slo_burn").value == 0.0

@@ -300,6 +300,39 @@ def test_fleet_disagg_token_identity_and_metrics(model, oracle):
         or fleet.predicted_itl_s() > 0
 
 
+def test_prefill_scale_up_warms_the_wide_bucket(model):
+    """ISSUE-19 satellite: a warm ``scale_up(role="prefill")`` must
+    compile the WIDEST prompt bucket before the replica takes router
+    weight — a long prompt served right after the scale-up must not
+    pay a new XLA compile inside the serving path (the base fleet's
+    4-token sacrificial request would only warm the narrowest
+    bucket)."""
+    cfg, m = model
+    kw = dict(_ENG_KW, prompt_buckets=(8, 32))
+
+    def factory(role="both"):
+        return ContinuousBatchingEngine(m, role=role, **kw)
+
+    fleet = DisaggServingFleet(factory, num_prefill=1, num_decode=1,
+                               hedge_delay_s=None)
+    rid = fleet.scale_up(role="prefill", warm=True)
+    eng = fleet.replicas[rid].engine
+    assert any(sig[1] == 32 for sig in eng._compiled
+               if sig[0] in ("unified", "prefill")), eng._compiled
+    before = eng.gauges()["compiled_programs"]
+    # a long prompt straight onto the warmed engine: same bucket,
+    # zero new compiled signatures
+    prompt = np.arange(28, dtype=np.int32) % cfg.vocab_size
+    eng.add_request(prompt, 1)
+    for _ in range(200):
+        if not fleet.replicas[rid].has_work():
+            break
+        fleet.replicas[rid].step()
+    assert not fleet.replicas[rid].has_work()
+    assert eng.gauges()["compiled_programs"] == before
+    fleet.close()
+
+
 def test_fleet_no_decode_capacity_degrades_colocated(model, oracle):
     """Decode-fleet outage: migrations fail (no candidate), requests
     pin ``no_migrate`` and complete COLOCATED on the prefill replica

@@ -593,6 +593,48 @@ def test_fleet_ejects_hung_proc_replica_via_health_not_breaker():
     fleet.close()
 
 
+@pytest.mark.parametrize("kill_mid_drain", [False, True])
+def test_scale_down_drain_handoff_exactly_once(kill_mid_drain):
+    """ISSUE-19 satellite: drain-based ``scale_down`` composes with
+    process-backed replicas. The drain deadline fires ``handoff()``
+    over the crc-framed wire; with ``kill_mid_drain`` the worker is
+    SIGKILLed between drain-begin and the handoff rpc, so the salvage
+    comes from the parent-side shadow (or a respawn replay) instead.
+    Either way: every request completes exactly once, token streams
+    stay deterministic, the replica RETIRES (never ejects), and the
+    survivor's page audit is green."""
+    spawners = {0: _Spawner(), 1: _Spawner()}
+    fleet = ServingFleet(
+        lambda: None, num_replicas=0, retry_backoff_s=0.001,
+        replica_cls=ProcReplica,
+        replica_kwargs=dict(rpc_deadline_s=0.1, hb_timeout_s=0.3,
+                            term_grace_s=0.05,
+                            respawn_backoff_s=0.001, max_queue=64))
+    for i in (0, 1):
+        fleet._add_replica(spawners[i].spec())
+    fids = [fleet.submit(np.arange(3, dtype=np.int32), 6)
+            for _ in range(8)]
+    fleet.step()                      # work spreads, tokens flow
+    assert fleet.replicas[1].has_work()
+    fleet.scale_down(replica_id=1, deadline_s=0.0)
+    if kill_mid_drain:
+        spawners[1].procs[-1].kill()
+    done = fleet.run()
+    # exactly-once: no lost, no duplicated completions
+    assert sorted(r.request_id for r in done) == sorted(fids)
+    by = {r.request_id: r for r in done}
+    for fid in fids:
+        assert by[fid].error is None, by[fid].error
+        assert by[fid].tokens == _expected_tokens(fid, 6), fid
+    assert fleet.replicas[1].state == "retired"
+    assert fleet.gauges()["breaker_open"] == 0
+    assert fleet.metrics.counter("fleet/drains").value == 1
+    assert fleet.replicas[0].audit()["clean"]
+    fleet.close()
+    for sp in spawners.values():
+        assert all(p.poll() is not None for p in sp.procs)
+
+
 # ---- real process (slow tier) ----------------------------------------------
 
 @pytest.mark.slow

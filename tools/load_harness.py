@@ -38,9 +38,11 @@ from __future__ import annotations
 import argparse
 import asyncio
 import json
+import math
 import random
 import sys
 import time
+import zlib
 
 
 # ---- one request over a raw socket ---------------------------------------
@@ -284,6 +286,258 @@ def arrival_times(n, *, mode="closed", rate=50.0, burst_every=0.0,
             continue
         times.append(t)
     return sorted(times[:n])
+
+
+# ---- production scenario suite (ISSUE 19) ---------------------------------
+#
+# Named, seeded, gate-runnable scenarios for the fleet + autoscaler
+# control loop. A scenario is a TICK-INDEXED arrival schedule (who
+# submits what, when) plus the SLO rules it must be judged by and the
+# attainment bar it must clear — the acceptance criteria live WITH the
+# workload, not in the test that happens to run it. Everything here is
+# deterministic in (name, vocab, seed) and stdlib-only; the runner is
+# duck-typed over the fleet/autoscaler surfaces (submit/step/has_work,
+# tick/actions) so this module still imports without the package.
+
+SCENARIOS = {
+    # a day of traffic in ~40 ticks: load swells to a peak and falls
+    # back — the autoscaler should ride the curve (grow into the
+    # swell, drain after it) instead of provisioning for the peak
+    "diurnal": dict(
+        describe="sinusoidal load curve peak->trough; capacity "
+                 "should follow it",
+        ticks=40, shape="diurnal", base=2, amp=2, period=32,
+        prompt_len=(3, 8), max_new=(2, 5),
+        tenants=("web", "api"),
+        slo_rules=[dict(name="ttft", kind="ttft", threshold_ms=2000.0,
+                        target=0.7, window_s=120.0, min_events=5)],
+        attainment_bar=0.70),
+    # one tenant goes hot while the background stays flat — burn-rate
+    # pressure concentrated in a single label
+    "tenant_hotspot": dict(
+        describe="tenant 'hot' ramps 5x over a flat background",
+        ticks=36, shape="hotspot", base=1, hot=4, window=(8, 24),
+        prompt_len=(3, 8), max_new=(2, 5),
+        tenants=("web",), hot_tenant="hot",
+        slo_rules=[dict(name="ttft", kind="ttft", threshold_ms=2000.0,
+                        target=0.7, window_s=120.0, min_events=5)],
+        attainment_bar=0.70),
+    # a flash crowd piles onto ONE shared prefix: queue depth spikes
+    # fast, and prefix-affinity routing concentrates it — the gate
+    # asserts a scale-up fires within a handful of ticks of onset
+    "flash_crowd": dict(
+        describe="6x crowd on one shared prefix for 10 ticks, quiet "
+                 "before and after",
+        ticks=40, shape="flash", base=1, crowd=6, window=(8, 18),
+        prefix_len=8, prompt_len=(3, 6), max_new=(2, 5),
+        tenants=("web",), crowd_tenant="crowd",
+        slo_rules=[dict(name="ttft", kind="ttft", threshold_ms=3000.0,
+                        target=0.7, window_s=120.0, min_events=5)],
+        attainment_bar=0.70),
+    # adversarial long-prompt flood between short chats — the mix that
+    # starves short-chat TTFT and, on a disagg fleet, pressures the
+    # prefill role specifically
+    "long_prompt_flood": dict(
+        describe="long prompts with real decode budgets flooding "
+                 "between short chats",
+        ticks=36, shape="flood", base=2, floods=2, window=(6, 26),
+        long_prompt_len=(24, 40), long_max_new=(8, 12),
+        prompt_len=(3, 6), max_new=(2, 4),
+        tenants=("web",), flood_tenant="bulk",
+        slo_rules=[dict(name="ttft", kind="ttft", threshold_ms=4000.0,
+                        target=0.6, window_s=120.0, min_events=5)],
+        attainment_bar=0.60),
+    # a rolling upgrade drains replicas out from under steady load —
+    # the operator acts, the autoscaler restores capacity
+    "rolling_upgrade": dict(
+        describe="operator drains a replica at ticks 10 and 22 under "
+                 "steady load; the controller backfills",
+        ticks=40, shape="steady", base=2,
+        prompt_len=(3, 8), max_new=(2, 5),
+        tenants=("web", "api"),
+        events={10: "drain_oldest", 22: "drain_oldest"},
+        slo_rules=[dict(name="ttft", kind="ttft", threshold_ms=3000.0,
+                        target=0.6, window_s=120.0, min_events=5)],
+        attainment_bar=0.60),
+}
+
+
+def _scenario_rng(name, seed):
+    # crc32, not hash(): hash() is salt-randomized per process and
+    # would silently unseed every scenario
+    return random.Random(zlib.crc32(name.encode("utf-8")) ^ seed)
+
+
+def build_scenario(name, *, vocab, seed=0):
+    """The tick-indexed schedule for a named scenario: a list (one
+    entry per tick) of arrival lists, each arrival ``{"prompt":
+    [ids], "max_new": n, "tenant": t}``. Deterministic in
+    (name, vocab, seed)."""
+    sc = SCENARIOS[name]
+    rng = _scenario_rng(name, seed)
+
+    def req(plen_key="prompt_len", new_key="max_new", tenant=None,
+            prefix=None):
+        plen = rng.randint(*sc[plen_key])
+        prompt = list(prefix or []) + [rng.randrange(vocab)
+                                       for _ in range(plen)]
+        return {"prompt": prompt, "max_new": rng.randint(*sc[new_key]),
+                "tenant": tenant}
+
+    shared = [rng.randrange(vocab) for _ in range(sc.get("prefix_len",
+                                                         0))]
+    schedule = []
+    for t in range(sc["ticks"]):
+        tick = []
+        shape = sc["shape"]
+        if shape == "diurnal":
+            n = max(0, round(sc["base"] + sc["amp"]
+                             * math.sin(2 * math.pi * t
+                                        / sc["period"])))
+            for i in range(n):
+                tick.append(req(tenant=sc["tenants"][i
+                                                     % len(sc["tenants"])]))
+        elif shape == "hotspot":
+            for _ in range(sc["base"]):
+                tick.append(req(tenant=sc["tenants"][0]))
+            lo, hi = sc["window"]
+            if lo <= t < hi:
+                for _ in range(sc["hot"]):
+                    tick.append(req(tenant=sc["hot_tenant"]))
+        elif shape == "flash":
+            for _ in range(sc["base"]):
+                tick.append(req(tenant=sc["tenants"][0]))
+            lo, hi = sc["window"]
+            if lo <= t < hi:
+                for _ in range(sc["crowd"]):
+                    tick.append(req(tenant=sc["crowd_tenant"],
+                                    prefix=shared))
+        elif shape == "flood":
+            for _ in range(sc["base"]):
+                tick.append(req(tenant=sc["tenants"][0]))
+            lo, hi = sc["window"]
+            if lo <= t < hi:
+                for _ in range(sc["floods"]):
+                    tick.append(req("long_prompt_len", "long_max_new",
+                                    tenant=sc["flood_tenant"]))
+        elif shape == "steady":
+            for i in range(sc["base"]):
+                tick.append(req(tenant=sc["tenants"][i
+                                                     % len(sc["tenants"])]))
+        else:
+            raise ValueError(f"unknown scenario shape {shape!r}")
+        schedule.append(tick)
+    return schedule
+
+
+def run_fleet_scenario(fleet, schedule, *, autoscaler=None,
+                       clock=None, events=None, steps_per_tick=4,
+                       drain_tick_limit=400, shed_exc=None):
+    """Drive one scenario through a fleet: per tick, submit the
+    tick's arrivals (a shed — ``shed_exc``, typically ``Overloaded``
+    — is counted, never retried: goodput pays for it), run
+    ``steps_per_tick`` fleet turns, fire the scenario's operator
+    event if one lands on this tick, then give the autoscaler its
+    control-loop tick (and advance the injected ``clock``, when the
+    caller paces hysteresis on virtual time). After the schedule the
+    loop keeps ticking — load off, controller still on — until all
+    work and drains complete, which is where the scale-down half of
+    the story happens. Returns the scenario report."""
+    events = events or {}
+    all_done = []
+    submitted = shed = 0
+    peak_ready = min_ready = sum(
+        1 for r in fleet.replicas.values() if r.takes_weight())
+    t0 = time.perf_counter()
+
+    def one_tick(arrivals, tick_no):
+        nonlocal submitted, shed, peak_ready, min_ready
+        for item in arrivals:
+            submitted += 1
+            try:
+                fleet.submit(item["prompt"], item["max_new"],
+                             tenant=item.get("tenant"))
+            except Exception as exc:  # noqa: BLE001 — only the typed
+                if shed_exc is not None and isinstance(exc, shed_exc):
+                    shed += 1         # overload is countable, anything
+                else:                 # else is a real failure
+                    raise
+        ev = events.get(tick_no)
+        if ev == "drain_oldest":
+            ready = [r for r in fleet.replicas.values()
+                     if r.state == "ready"]
+            if ready:
+                fleet.scale_down(
+                    replica_id=min(ready, key=lambda r: r.id).id)
+        elif ev is not None:
+            raise ValueError(f"unknown scenario event {ev!r}")
+        for _ in range(steps_per_tick):
+            all_done.extend(fleet.step())
+        if autoscaler is not None:
+            autoscaler.tick()
+        if clock is not None:
+            clock.advance()
+        ready = sum(1 for r in fleet.replicas.values()
+                    if r.takes_weight())
+        peak_ready = max(peak_ready, ready)
+        min_ready = min(min_ready, ready)
+
+    for tick_no, arrivals in enumerate(schedule):
+        one_tick(arrivals, tick_no)
+    # the cool-down tail: drains must complete and the controller must
+    # get enough quiet ticks to give capacity back
+    tick_no = len(schedule)
+    while tick_no < len(schedule) + drain_tick_limit:
+        draining = any(r.state == "draining"
+                       for r in fleet.replicas.values())
+        if not fleet.has_work() and not draining:
+            break
+        one_tick([], tick_no)
+        tick_no += 1
+
+    ok = [r for r in all_done if r.error is None]
+    ttfts = sorted((r.t_first - r.t_arrive) * 1e3 for r in ok
+                   if r.t_first and r.t_arrive)
+    report = {
+        "submitted": submitted,
+        "accepted": submitted - shed,
+        "shed": shed,
+        "completed_ok": len(ok),
+        "failed": len(all_done) - len(ok),
+        "goodput_frac": round(len(ok) / max(1, submitted), 4),
+        "ttft_ms_p50": round(_pct(ttfts, 0.50), 2),
+        "ttft_ms_p99": round(_pct(ttfts, 0.99), 2),
+        "ticks": tick_no,
+        "wall_s": round(time.perf_counter() - t0, 3),
+        "peak_ready": peak_ready,
+        "min_ready": min_ready,
+    }
+    slo = getattr(fleet, "slo", None)
+    if slo is not None:
+        report["slo"] = slo.summary()
+    if autoscaler is not None:
+        report["decisions"] = list(autoscaler.decisions)
+        report["actions"] = autoscaler.actions()
+        report["chip_seconds"] = round(autoscaler.chip_seconds, 4)
+    return report
+
+
+class TickClock:
+    """A virtual clock for deterministic hysteresis: the scenario
+    runner advances it one ``dt`` per tick, and an autoscaler built
+    with ``now_fn=clock`` paces its cooldowns on TICKS instead of
+    host wall time (a loaded CI box cannot flake the quiet-period
+    assertions)."""
+
+    def __init__(self, dt=1.0):
+        self.t = 0.0
+        self.dt = float(dt)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self):
+        self.t += self.dt
 
 
 # ---- the driver ----------------------------------------------------------

@@ -180,6 +180,23 @@ def gate_commands(log: str, budget: float, no_budget: bool,
                            "test_spec_decode.py"),
               "-q", "-m", "spec_decode",
               "-p", "no:cacheprovider"]))
+        # SLO-driven autoscaler (ISSUE 19): the control-loop unit
+        # contracts (rules, hysteresis, role picks, chip cost model,
+        # flapping invariant) plus the seeded production-scenario
+        # suite on real tiny fleets — each scenario asserts its own
+        # SLO attainment bar, the autoscaler's reaction windows, and
+        # that every decision reconstructs from the /statusz log. The
+        # FULL autoscale marker, slow included (the observability-gate
+        # pattern); rides --no-serving with the rest of the serving
+        # stack.
+        gates.append(
+            ("autoscale_scenarios",
+             [sys.executable, "-m", "pytest",
+              os.path.join(REPO_DIR, "tests", "test_autoscaler.py"),
+              os.path.join(REPO_DIR, "tests",
+                           "test_autoscale_scenarios.py"),
+              "-q", "-m", "autoscale",
+              "-p", "no:cacheprovider"]))
     if not no_fused:
         # fused training-kernel parity: the interpret-mode kernel-vs-
         # oracle suite with every fused flag forced ON via the
