@@ -1665,6 +1665,247 @@ def _cb_prefix_bench(on_tpu):
     return out
 
 
+def _cb_quant_bench(on_tpu, autotune=False):
+    """Quantized serving A/B (ISSUE 20): int8 paged-KV + weight-only
+    int8 against the full-precision engine on one custom model
+    (hidden 256 / head_dim 64 — wide enough that the per-token f32
+    scale column amortizes: page-byte ratio 2d/(d+4) ~ 1.88 under
+    bf16 pools, ~3.56 under the CPU smoke's f32 pools).
+
+    Legs:
+    - capacity (the headline): the ``capacity_probe`` trace mix —
+      every request carries a real prompt AND decode budget, so page
+      demand is the binding constraint — through a base-precision
+      engine and an int8-KV engine holding the SAME page-pool byte
+      budget (the int8 page count is derived from the engines' own
+      pool-byte gauges, so the budget can never drift from the real
+      allocation). ``cb_quant_capacity_ratio`` is the peak-concurrent-
+      residency ratio; admission reserves a request's whole-lifetime
+      pages, so peak residency IS page capacity. ``*_ratio`` keys are
+      never regression-gated (they move with the host's pool dtype);
+      tok/s and the accuracy keys are.
+    - accuracy: greedy token-level top-1 agreement vs a same-weights
+      full-precision engine, for int8-KV and for weight-only int8,
+      plus a teacher-forced perplexity delta for the weight path (KV
+      quantization does not touch the cacheless forward).
+    - residency: prefix-cache pages resident after the same storm at
+      equal bytes — more pages per byte keeps more warm prefix.
+    - wire: one exported prefill migration, base vs int8, through the
+      disagg JSON codec — quantized pages ship natively (no
+      dequant->requant), so wire bytes drop by ~the page-byte ratio.
+
+    autotune=True additionally sweeps the QUANTIZED ragged-attention
+    surface at this bench's geometry (the ``kvq`` shape-sig component
+    keeps its winner apart from bf16 entries) and commits the winner
+    to the tuning cache. BASELINE.md documents the keys."""
+    import json as _json
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.framework.core import Tensor
+    from paddle_tpu.inference import ContinuousBatchingEngine
+    from paddle_tpu.inference.disagg import kv_payload_to_wire
+    from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+    from paddle_tpu.nn.quant import quantize_for_serving
+
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    try:
+        from load_harness import build_trace_mix
+    finally:
+        sys.path.pop(0)
+
+    def make_cfg(**over):
+        cfg = LlamaConfig(
+            vocab_size=256, hidden_size=256, num_hidden_layers=2,
+            num_attention_heads=4, num_key_value_heads=2,
+            intermediate_size=512, max_position_embeddings=64, **over)
+        cfg.tensor_parallel = False
+        cfg.scan_layers = False
+        return cfg
+
+    slots, page, max_len = 16, 8, 40
+    base_pages = 17                    # 16 usable + trash page 0
+    n_req = 64 if on_tpu else 36
+    n_acc, acc_new = (8, 10) if on_tpu else (6, 8)
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(make_cfg())
+    if on_tpu:
+        model.to(dtype="bfloat16")
+    model.eval()
+    vocab = model.config.vocab_size
+
+    def make_engine(m=None, pages=None, nslots=slots, **kw):
+        return ContinuousBatchingEngine(
+            m if m is not None else model, num_slots=nslots,
+            page_size=page, max_len=max_len, num_pages=pages,
+            decode_chunk=4, prompt_buckets=(16,), greedy=True, **kw)
+
+    # equal-byte provisioning from the engines' OWN pool-byte gauges
+    base_eng = make_engine(pages=base_pages)
+    base_bytes = base_eng.gauges()["kv_quant_pool_bytes"]
+    probe = make_engine(pages=base_pages, nslots=1, kv_quant="int8")
+    gq = probe.gauges()
+    per_page_q = (gq["kv_quant_pool_bytes"]
+                  + gq["kv_quant_scale_pool_bytes"]) / base_pages
+    q_pages = int(base_bytes // per_page_q)
+    del probe
+    quant_eng = make_engine(pages=q_pages, kv_quant="int8")
+
+    mix = build_trace_mix("capacity_probe", n_req, vocab=vocab,
+                          seed=20)
+
+    def storm(e):
+        e.add_request(np.asarray(mix[0]["prompt"], np.int32), 2)
+        e.run()                      # warmup: compiles off the clock
+        e.reset_prefix_cache()       # drop the warmup's pages
+        e.reset_gauges()
+        t0 = time.perf_counter()
+        ids = [e.add_request(np.asarray(it["prompt"], np.int32),
+                             int(it["max_new"])) for it in mix]
+        done = e.run()
+        wall = max(time.perf_counter() - t0, 1e-9)
+        by = {r.request_id: r for r in done}
+        ok = [by[i] for i in ids if by[i].error is None]
+        toks = sum(len(r.tokens) for r in ok)
+        # peak concurrent residency by interval overlap: a slot holds
+        # its whole-lifetime page reservation from t_admit to t_done
+        evs = sorted([(r.t_admit, 1) for r in ok if r.t_admit]
+                     + [(r.t_done, -1) for r in ok if r.t_admit])
+        cur = peak = 0
+        for _, step in evs:
+            cur += step
+            peak = max(peak, cur)
+        return toks / wall, peak, e.gauges()
+
+    base_tps, base_peak, base_g = storm(base_eng)
+    quant_tps, quant_peak, quant_g = storm(quant_eng)
+    res_ratio = quant_g["prefix_cache_pages"] / \
+        max(base_g["prefix_cache_pages"], 1)
+
+    # accuracy: greedy token streams vs the full-precision engine on
+    # the SAME weights (fresh small engines so pool pressure cannot
+    # preempt and muddy the comparison)
+    rng = np.random.RandomState(77)
+    prompts = [rng.randint(0, vocab,
+                           (int(rng.randint(6, 13)),)).astype(np.int32)
+               for _ in range(n_acc)]
+
+    def greedy_streams(e):
+        ids = [e.add_request(p, acc_new) for p in prompts]
+        done = e.run()
+        by = {r.request_id: r for r in done}
+        return [by[i].tokens for i in ids]
+
+    def agreement(a, b):
+        num = den = 0
+        for x, y in zip(a, b):
+            den += max(len(x), len(y))
+            num += sum(1 for u, w in zip(x, y) if u == w)
+        return num / max(den, 1)
+
+    oracle = greedy_streams(make_engine(nslots=4))
+    kv_top1 = agreement(oracle,
+                        greedy_streams(make_engine(nslots=4,
+                                                   kv_quant="int8")))
+
+    paddle.seed(0)                     # identical init -> same weights
+    wmodel = LlamaForCausalLM(make_cfg(
+        weight_quant="weight_only_int8"))
+    if on_tpu:
+        wmodel.to(dtype="bfloat16")
+    wmodel.eval()
+    wstats = quantize_for_serving(wmodel)   # engine ctor then no-ops
+    w_top1 = agreement(oracle, greedy_streams(make_engine(m=wmodel,
+                                                          nslots=4)))
+    wbytes_ratio = (wstats["bytes"] + wstats["bytes_saved"]) \
+        / max(wstats["bytes"], 1)
+
+    def mean_nll(m):
+        rs = np.random.RandomState(88)
+        tot = cnt = 0
+        for _ in range(3):
+            seq = rs.randint(0, vocab, (1, 24)).astype(np.int32)
+            logits = np.asarray(m(Tensor(seq))._data, np.float32)[0]
+            x = logits[:-1] - logits[:-1].max(-1, keepdims=True)
+            lse = np.log(np.exp(x).sum(-1))
+            tok = seq[0, 1:]
+            tot += float((lse - x[np.arange(len(tok)), tok]).sum())
+            cnt += len(tok)
+        return tot / cnt
+    ppl_delta = float(np.exp(mean_nll(wmodel)) - np.exp(mean_nll(model)))
+
+    # wire: the disagg codec ships quantized pages natively — measure
+    # one exported prefill migration base vs int8
+    def wire_bytes(kvq):
+        e = make_engine(nslots=2, role="prefill", kv_quant=kvq)
+        e.add_request(prompts[0], 4)
+        e.run()
+        _, payload = e.take_migrations()[0]
+        return len(_json.dumps(kv_payload_to_wire(payload)))
+
+    wire_ratio = wire_bytes("none") / max(wire_bytes("int8"), 1)
+
+    out = {
+        "cb_quant_tok_s": round(quant_tps, 2),
+        "cb_quant_base_tok_s": round(base_tps, 2),
+        "cb_quant_capacity_ratio": round(
+            quant_peak / max(base_peak, 1), 4),
+        "cb_quant_peak_seqs": int(quant_peak),
+        "cb_quant_base_peak_seqs": int(base_peak),
+        "cb_quant_pages": int(q_pages - 1),
+        "cb_quant_base_pages": int(base_pages - 1),
+        "cb_quant_kv_bits": int(quant_g["kv_quant_bits"]),
+        "cb_quant_top1_agreement": round(kv_top1, 4),
+        "cb_quant_weight_top1_agreement": round(w_top1, 4),
+        "cb_quant_ppl_delta": round(ppl_delta, 4),
+        "cb_quant_prefix_residency_ratio": round(res_ratio, 4),
+        "cb_quant_weight_bytes_ratio": round(wbytes_ratio, 4),
+        "cb_quant_kv_wire_bytes_ratio": round(wire_ratio, 4),
+    }
+
+    if autotune:
+        # sweep the quantized ragged surface at this bench's kernel
+        # geometry; the "kvq" sig component keeps the winner apart
+        # from bf16 entries (TrialEngine persists it to the cache)
+        from paddle_tpu.tuner.engine import TrialEngine
+        from paddle_tpu.tuner.sweeps import (ensure_builtin_surfaces,
+                                             ragged_attention_builder)
+        ensure_builtin_surfaces()
+        d = model.config.hidden_size // model.config.num_attention_heads
+        shape = {"c": 4, "pages": -(-max_len // page), "page": page,
+                 "d": d, "kvq": 1}
+        dtype = next(iter(model.parameters()))._data.dtype
+        res = TrialEngine(warmup=1, repeats=3).search(
+            "ragged_paged_attention", shape,
+            ragged_attention_builder(dtype=str(dtype)),
+            dtype=str(dtype))
+        out["tuned_ragged_quant"] = {
+            "config": dict(res.best_config),
+            "shape_sig": res.shape_sig,
+            "cached_hit": bool(res.cached_hit),
+            "median_ms": res.best_ms}
+        print(f"# quant autotune: {res.best_config} @ "
+              f"{res.shape_sig} ({'cache hit' if res.cached_hit else f'{len(res.trials)} trials'})",
+              file=sys.stderr)
+
+    print(f"# cb quant: capacity x{out['cb_quant_capacity_ratio']} "
+          f"({out['cb_quant_peak_seqs']} vs "
+          f"{out['cb_quant_base_peak_seqs']} peak seqs at "
+          f"{out['cb_quant_pages']} vs {out['cb_quant_base_pages']} "
+          f"equal-byte pages), {out['cb_quant_tok_s']} tok/s (base "
+          f"{out['cb_quant_base_tok_s']}), top1 agreement kv "
+          f"{out['cb_quant_top1_agreement']} / weights "
+          f"{out['cb_quant_weight_top1_agreement']} (ppl delta "
+          f"{out['cb_quant_ppl_delta']:+.3f}), prefix residency "
+          f"x{out['cb_quant_prefix_residency_ratio']}, weight bytes "
+          f"x{out['cb_quant_weight_bytes_ratio']}, kv wire bytes "
+          f"x{out['cb_quant_kv_wire_bytes_ratio']}", file=sys.stderr)
+    return out
+
+
 def _cb_http_bench(on_tpu):
     """HTTP front door overhead (ISSUE 15): the load harness drives
     the OpenAI-compatible API server (tools/load_harness.py as a
@@ -2422,6 +2663,23 @@ def main():
     gc.collect()
     if cb_prefix is not None:
         record.update(cb_prefix)
+        _emit_record(record, rec_out)
+
+    # quantized serving (ISSUE 20): the equal-byte capacity A/B plus
+    # the accuracy gate's numbers, right after the prefix cache whose
+    # residency the quantized pools multiply
+    try:
+        cb_quant = _timed_section(
+            "cb quant", lambda: _retry_transient(
+                lambda: _cb_quant_bench(on_tpu,
+                                        autotune=args.autotune),
+                "cb quant bench"))
+    except Exception as e:
+        print(f"# cb quant bench failed: {e!r}", file=sys.stderr)
+        cb_quant = None
+    gc.collect()
+    if cb_quant is not None:
+        record.update(cb_quant)
         _emit_record(record, rec_out)
 
     # HTTP front door (ISSUE 15): what serving costs once a real

@@ -107,14 +107,28 @@ def kv_payload_to_wire(payload):
     a pool has identical geometry). The per-page crc32s computed at
     export ride along and are re-verified at import — corruption
     between the two b64 codecs (or a buggy transport) is caught by
-    checksum, not trusted."""
+    checksum, not trusted.
+
+    Quantized KV (ISSUE 20) ships NATIVELY — the int8 page codes and
+    their f32 scale pages are b64-encoded as exported, no
+    dequant→requant round trip — so pool geometry is heterogeneous:
+    per-pool ``shapes``/``dtypes`` lists (from the first block) ride
+    next to the legacy shared ``shape``/``dtype`` fields, and the
+    engine's ``kv_quant`` mode passes through for the destination's
+    geometry handshake."""
     out = {k: payload[k] for k in ("version", "rid", "eff_len",
                                    "page_size", "n_pools", "dtype")}
+    if "kv_quant" in payload:
+        out["kv_quant"] = payload["kv_quant"]
     shape = None
+    shapes = dtypes = None
     blocks = []
     for blk in payload["blocks"]:
         if shape is None and blk["data"]:
             shape = [int(x) for x in np.asarray(blk["data"][0]).shape]
+            shapes = [[int(x) for x in np.asarray(d).shape]
+                      for d in blk["data"]]
+            dtypes = [str(np.asarray(d).dtype) for d in blk["data"]]
         blocks.append({
             "tokens": [int(t) for t in blk["tokens"]],
             "data": [base64.b64encode(
@@ -123,6 +137,9 @@ def kv_payload_to_wire(payload):
             "crc": [int(c) for c in blk["crc"]],
         })
     out["shape"] = shape
+    if shapes is not None:
+        out["shapes"] = shapes
+        out["dtypes"] = dtypes
     out["blocks"] = blocks
     return out
 
@@ -134,16 +151,28 @@ def kv_payload_from_wire(obj):
     a damaged transfer must never raise past the import seam."""
     out = {k: obj.get(k) for k in ("version", "rid", "eff_len",
                                    "page_size", "n_pools", "dtype")}
+    if "kv_quant" in obj:
+        out["kv_quant"] = obj["kv_quant"]
     blocks = []
     try:
-        dt = np.dtype(str(obj.get("dtype")))
-        shape = tuple(int(x) for x in obj.get("shape") or ())
+        # per-pool geometry when present (quantized payloads mix int8
+        # data pools with f32 scales pools); legacy single-shape
+        # payloads fall back to the shared fields
+        if obj.get("shapes"):
+            shapes = [tuple(int(x) for x in s) for s in obj["shapes"]]
+            dts = [np.dtype(str(d)) for d in obj["dtypes"]]
+        else:
+            shapes = dts = None
+            dt = np.dtype(str(obj.get("dtype")))
+            shape = tuple(int(x) for x in obj.get("shape") or ())
         for blk in obj.get("blocks") or []:
             blocks.append({
                 "tokens": np.asarray(blk["tokens"], np.int32),
                 "data": [np.frombuffer(
-                    base64.b64decode(s), dt).reshape(shape)
-                    for s in blk["data"]],
+                    base64.b64decode(s),
+                    dts[i] if dts is not None else dt).reshape(
+                        shapes[i] if shapes is not None else shape)
+                    for i, s in enumerate(blk["data"])],
                 "crc": [int(c) for c in blk["crc"]],
             })
     except Exception:  # noqa: BLE001 — damaged payload: plain replay

@@ -233,6 +233,20 @@ _pmetrics.declare("disagg/kv_import_crc_rejects", "counter",
                   "(checksum mismatch or malformed payload); the "
                   "request still replays correctly from its prompt")
 
+# -- quantized serving: pool geometry gauges (ISSUE 20)
+_pmetrics.declare("serving/kv_quant_bits", "gauge",
+                  "bits per stored KV element in the page pools "
+                  "(16 = bf16/f32 full precision, 8 = int8/fp8 "
+                  "quantized)")
+_pmetrics.declare("serving/kv_quant_pool_bytes", "gauge",
+                  "total bytes of the KV DATA page pools across all "
+                  "layers (the capacity denominator quantization "
+                  "shrinks)")
+_pmetrics.declare("serving/kv_quant_scale_pool_bytes", "gauge",
+                  "total bytes of the page-parallel f32 scales pools "
+                  "(0 when kv_quant='none') — the quantization "
+                  "overhead term in the capacity math")
+
 # -- speculative decoding: draft/verify economics (ISSUE 18)
 _pmetrics.declare("spec/steps", "counter",
                   "speculative unified-step programs dispatched "
@@ -471,9 +485,17 @@ class ContinuousBatchingEngine:
                  trace_sample_rate=0.01, latency_reservoir=2048,
                  max_strikes=2, max_containments=8, audit=None,
                  prefix_cache=None, role="both", spec_decode=False,
-                 spec_k=None, spec_draft=None):
+                 spec_k=None, spec_draft=None, kv_quant="none"):
         if role not in ("prefill", "decode", "both"):
             raise ValueError(f"unknown engine role {role!r}")
+        if kv_quant not in ("none", "int8", "fp8"):
+            raise ValueError(f"unknown kv_quant {kv_quant!r} "
+                             "(expected 'none', 'int8' or 'fp8')")
+        if kv_quant == "fp8" and not hasattr(jnp, "float8_e4m3fn"):
+            raise ValueError(
+                "kv_quant='fp8' needs jax.numpy.float8_e4m3fn, which "
+                "this backend does not provide — use 'int8'")
+        self.kv_quant = kv_quant
         # disaggregation role (ISSUE 17): a "prefill" engine runs
         # chunked prefill to completion, samples the first token, then
         # EXPORTS the finished full KV pages + request state into
@@ -488,6 +510,14 @@ class ContinuousBatchingEngine:
         self.model = model
         cfg = model.config
         self.cfg = cfg
+        # weight-only serving quantization (ISSUE 20): a config with
+        # weight_quant set gets its big projections converted to
+        # dequant-in-matmul form once, at engine construction
+        # (quantize_for_serving is idempotent — a pre-converted model
+        # or a second engine over the same model is a no-op)
+        if getattr(cfg, "weight_quant", None):
+            from ..nn.quant import quantize_for_serving
+            quantize_for_serving(model)
         self.num_slots = int(num_slots)
         self.page_size = int(page_size)
         self.max_len = int(max_len)
@@ -496,8 +526,11 @@ class ContinuousBatchingEngine:
         self.num_pages = int(num_pages) if num_pages is not None else \
             self.num_slots * self.pages_per_slot + 1
         # also the KV-pool dtype below AND the tuner-cache key's dtype
-        # component — one probe so the two can never diverge
-        dtype = next(iter(model.parameters()))._data.dtype
+        # component — one probe so the two can never diverge. First
+        # FLOATING param: a weight-quantized model carries int8 buffers
+        # whose dtype must not leak into the activation/pool dtype.
+        dtype = next(p._data.dtype for p in model.parameters()
+                     if jnp.issubdtype(p._data.dtype, jnp.floating))
         # chunk-ladder knobs left as None resolve through the autotuner
         # cache ("serving_chunks" surface, keyed by slots/max_len/page —
         # registered at the bottom of this module), then fall back to
@@ -534,12 +567,41 @@ class ContinuousBatchingEngine:
                     cfg.hidden_size // cfg.num_attention_heads)
         # per layer: (key_pages, value_pages) — flat list like dense
         # caches; geometry kept so step-failure containment can rebuild
-        # the pools from scratch (_reset_device_state)
+        # the pools from scratch (_reset_device_state). Quantized KV
+        # (ISSUE 20) interleaves two extra pools per layer — the
+        # page-parallel f32 scales pools (key_scales, value_scales),
+        # shape (kvh, num_pages, page_size): one scale per (token,
+        # kv head), page axis at index 1 like the data pools, so every
+        # generic pool operation (COW page copy, migration export/crc,
+        # batched import landing pads, containment rebuild) composes
+        # over the flat list unchanged.
         self._pool_shape = (kvh, self.num_pages, self.page_size, d)
-        self._pool_dtype = dtype
-        self._n_pools = cfg.num_hidden_layers * 2
-        self.pools = [Tensor(jnp.zeros(self._pool_shape, dtype))
-                      for _ in range(self._n_pools)]
+        self._pool_dtype = dtype if kv_quant == "none" else jnp.dtype(
+            jnp.int8 if kv_quant == "int8" else jnp.float8_e4m3fn)
+        self._scale_shape = (kvh, self.num_pages, self.page_size)
+        if kv_quant == "none":
+            self._pool_shapes = [self._pool_shape] * 2
+            self._pool_dtypes = [self._pool_dtype] * 2
+        else:
+            self._pool_shapes = [self._pool_shape, self._pool_shape,
+                                 self._scale_shape, self._scale_shape]
+            self._pool_dtypes = [self._pool_dtype, self._pool_dtype,
+                                 jnp.float32, jnp.float32]
+        self._pool_shapes = self._pool_shapes * cfg.num_hidden_layers
+        self._pool_dtypes = self._pool_dtypes * cfg.num_hidden_layers
+        self._n_pools = len(self._pool_shapes)
+        self.pools = [Tensor(jnp.zeros(s, dt)) for s, dt in
+                      zip(self._pool_shapes, self._pool_dtypes)]
+        # static pool-geometry facts for the kv_quant gauges
+        self._kv_quant_bits = 8 * jnp.dtype(self._pool_dtype).itemsize
+        self._kv_pool_bytes = sum(
+            int(np.prod(s)) * jnp.dtype(dt).itemsize
+            for s, dt in zip(self._pool_shapes, self._pool_dtypes)
+            if len(s) == 4)
+        self._kv_scale_pool_bytes = sum(
+            int(np.prod(s)) * jnp.dtype(dt).itemsize
+            for s, dt in zip(self._pool_shapes, self._pool_dtypes)
+            if len(s) == 3)
 
         self._free_pages = deque(range(1, self.num_pages))
         # host-side slot bookkeeping (admission decisions, drain)
@@ -727,6 +789,11 @@ class ContinuousBatchingEngine:
         self._g_pc_pages = self.metrics.gauge(
             "serving/prefix_cache_pages")
         self._g_queue_depth = self.metrics.gauge("serving/queue_depth")
+        self._g_kvq_bits = self.metrics.gauge("serving/kv_quant_bits")
+        self._g_kvq_pool_bytes = self.metrics.gauge(
+            "serving/kv_quant_pool_bytes")
+        self._g_kvq_scale_bytes = self.metrics.gauge(
+            "serving/kv_quant_scale_pool_bytes")
         self._c_migrated_out = self.metrics.counter(
             "disagg/migrated_out")
         self._c_kv_exported = self.metrics.counter(
@@ -945,6 +1012,7 @@ class ContinuousBatchingEngine:
                    "eff_len": int(len(eff)), "page_size": ps,
                    "n_pools": self._n_pools,
                    "dtype": str(self._pool_dtype),
+                   "kv_quant": self.kv_quant,
                    "blocks": blocks}
         # deferred-free discipline (ISSUE 17): the source's published
         # prefix stays pinned until release_exported — a transfer that
@@ -1005,7 +1073,11 @@ class ContinuousBatchingEngine:
               and payload.get("version") == 1
               and payload.get("page_size") == self.page_size
               and payload.get("n_pools") == self._n_pools
-              and payload.get("dtype") == str(self._pool_dtype))
+              and payload.get("dtype") == str(self._pool_dtype)
+              # geometry handshake: quantized pages only land in a
+              # same-kv_quant pool (a mixed pair falls back to the
+              # tokens-only recompute path — the requeue below)
+              and payload.get("kv_quant", "none") == self.kv_quant)
         if ok:
             self._pc_clock += 1
             cur = self._pc_root
@@ -1059,7 +1131,7 @@ class ContinuousBatchingEngine:
             dst = jnp.asarray([p for p, _ in padded], jnp.int32)
             stacked = [jnp.asarray(
                 np.stack([d[i] for _, d in padded], axis=1),
-                self._pool_dtype) for i in range(self._n_pools)]
+                self._pool_dtypes[i]) for i in range(self._n_pools)]
             self.pools = [Tensor(a) for a in _kv_write_pages(
                 [p._data for p in self.pools], dst, stacked)]
         _t_obs = time.perf_counter()
@@ -1413,9 +1485,8 @@ class ContinuousBatchingEngine:
         in state the engine will read again. Compiled programs are pure
         functions of their inputs and are kept."""
         B, MP = self.num_slots, self.pages_per_slot
-        self.pools = [Tensor(jnp.zeros(self._pool_shape,
-                                       self._pool_dtype))
-                      for _ in range(self._n_pools)]
+        self.pools = [Tensor(jnp.zeros(s, dt)) for s, dt in
+                      zip(self._pool_shapes, self._pool_dtypes)]
         self._free_pages = deque(range(1, self.num_pages))
         self._deferred_free = []
         self.tables[:] = 0
@@ -2105,6 +2176,12 @@ class ContinuousBatchingEngine:
                 self._c_spec_accepted.value
                 / self._c_spec_drafted.value)
             if self._c_spec_drafted.value else 0.0,
+            # quantized-KV pool geometry (ISSUE 20) — static per
+            # engine, surfaced so capacity A/Bs read the byte budget
+            # they actually ran at
+            "kv_quant_bits": int(self._kv_quant_bits),
+            "kv_quant_pool_bytes": int(self._kv_pool_bytes),
+            "kv_quant_scale_pool_bytes": int(self._kv_scale_pool_bytes),
         }
 
     def reset_gauges(self):
@@ -2129,6 +2206,9 @@ class ContinuousBatchingEngine:
             else 0.0)
         self._g_pc_pages.set(len(self._pc_nodes))
         self._g_queue_depth.set(len(self.queue))
+        self._g_kvq_bits.set(int(self._kv_quant_bits))
+        self._g_kvq_pool_bytes.set(int(self._kv_pool_bytes))
+        self._g_kvq_scale_bytes.set(int(self._kv_scale_pool_bytes))
         from ..profiler.trace import get_tracer
         tr = get_tracer()
         if tr.enabled:
@@ -2241,6 +2321,34 @@ class ContinuousBatchingEngine:
                 raise AssertionError(
                     f"prefix-cache attachment to unindexed page "
                     f"{page} at {where}")
+        # quantized-KV structural invariant (ISSUE 20): every layer
+        # carries [k, v, k_scales, v_scales] and the scales pools index
+        # the SAME page axis as their data pools — a page id is valid
+        # in all four or in none, so the single accounting above covers
+        # the scales pools too iff the geometry agrees
+        if self.kv_quant != "none":
+            if len(self.pools) != self._n_pools \
+                    or self._n_pools != 4 * self.cfg.num_hidden_layers:
+                raise AssertionError(
+                    f"quantized pool count broken at {where}: "
+                    f"{len(self.pools)} pools, expected "
+                    f"{4 * self.cfg.num_hidden_layers}")
+            for i, p in enumerate(self.pools):
+                shape = tuple(p._data.shape)
+                want = (self._pool_shape if i % 4 < 2
+                        else self._scale_shape)
+                if shape != want:
+                    raise AssertionError(
+                        f"quantized pool geometry broken at {where}: "
+                        f"pool {i} shape {shape} != {want}")
+                if i % 4 >= 2 and p._data.dtype != jnp.float32:
+                    raise AssertionError(
+                        f"scales pool {i} dtype {p._data.dtype} at "
+                        f"{where}: scales must stay f32")
+                if shape[1] != self.num_pages:
+                    raise AssertionError(
+                        f"pool {i} page-axis length {shape[1]} != "
+                        f"num_pages {self.num_pages} at {where}")
 
     # ---- prefix cache: radix index + COW sharing (ISSUE 12) --------------
 

@@ -31,6 +31,8 @@ class GPT2Config:
     attention_dropout_prob: float = 0.1
     layer_norm_epsilon: float = 1e-5
     initializer_range: float = 0.02
+    # weight-only serving quantization switch — see LlamaConfig
+    weight_quant: str | None = None
 
     @classmethod
     def small(cls):
@@ -140,11 +142,13 @@ class GPT2Model(nn.Layer):
         x = self.wte(input_ids) + self.wpe(positions)
         if caches is not None:
             new_caches = []
+            # 2 pools per layer, or 4 under quantized KV (ISSUE 20)
+            stride = len(caches) // len(self.h)
             for i, block in enumerate(self.h):
-                x, (kc, vc) = block(x, cache=(caches[2 * i],
-                                              caches[2 * i + 1]), pos=pos,
-                                    tables=tables)
-                new_caches.extend((kc, vc))
+                x, kv = block(
+                    x, cache=tuple(caches[stride * i:stride * (i + 1)]),
+                    pos=pos, tables=tables)
+                new_caches.extend(kv)
             return self.ln_f(x), new_caches
         x = self.drop(x)
         from ..nn.scan import scan_layers, can_scan

@@ -67,6 +67,11 @@ class LlamaConfig:
     # roll the decoder stack into one lax.scan (code-size win on TPU;
     # see nn/scan.py) — turn off to unroll (e.g. heterogeneous stacks)
     scan_layers: bool = True
+    # weight-only serving quantization (ISSUE 20): None keeps full
+    # precision; "weight_only_int8" / "weight_only_int4" route the big
+    # projections (qkv/o/gate/up/down + lm_head) through dequant-in-
+    # matmul layers when nn.quant.quantize_for_serving runs at load
+    weight_quant: str | None = None
 
     def __post_init__(self):
         # validate at construction so a typo'd granularity fails where
@@ -77,6 +82,11 @@ class LlamaConfig:
                 f"recompute_granularity="
                 f"{self.recompute_granularity!r} is not one of "
                 "'full' | 'core_attn' | 'full_attn'")
+        if self.weight_quant not in (None, "weight_only_int8",
+                                     "weight_only_int4"):
+            raise ValueError(
+                f"weight_quant={self.weight_quant!r} is not one of "
+                "None | 'weight_only_int8' | 'weight_only_int4'")
 
     @classmethod
     def llama3_8b(cls):
@@ -163,7 +173,13 @@ def _paged_attention_step(attn, q, k, v, cache, pos, tables, rope=True,
     through ``ops.paged_attention.ragged_paged_attention`` — one
     attention entry point whether the slot carries a prefill chunk
     (valid > 1), a decode step (valid == 1) or is idle (valid == 0),
-    so mixed batches compile ONE program."""
+    so mixed batches compile ONE program.
+
+    Quantized KV (ISSUE 20): a 4-tuple ``cache`` — ``(k_pages,
+    v_pages, k_scales, v_scales)`` with int8/fp8 data pools and f32
+    page-parallel scales pools — routes through the quantize-at-write
+    / dequant-in-kernel pair instead; the quant mode rides the pool
+    dtype, so this compiles the same single program shape per mode."""
     b, s = q.shape[0], q.shape[1]
     tbl, gate = tables
     if rope:
@@ -172,21 +188,42 @@ def _paged_attention_step(attn, q, k, v, cache, pos, tables, rope=True,
         k = rope_with_offset(k, pos, attn.cfg.max_position_embeddings,
                              attn.cfg.rope_theta)
 
-    def fn(qa, ka, va, kpa, vpa, tba, gatea, cta):
-        from ..ops import paged_attention as PA
-        ct = cta[:, 0]
-        valid = gatea.astype(jnp.int32)
-        kpa, vpa = PA.paged_prefill_write(kpa, vpa, ka, va, tba, ct,
-                                          valid)
-        out = PA.ragged_paged_attention(qa, kpa, vpa, tba, ct, valid)
-        return out, kpa, vpa
+    if len(cache) == 4:
+        def fnq(qa, ka, va, kpa, vpa, ksa, vsa, tba, gatea, cta):
+            from ..ops import paged_attention as PA
+            ct = cta[:, 0]
+            valid = gatea.astype(jnp.int32)
+            kpa, vpa, ksa, vsa = PA.paged_prefill_write_quant(
+                kpa, vpa, ksa, vsa, ka, va, tba, ct, valid)
+            out = PA.ragged_paged_attention(qa, kpa, vpa, tba, ct,
+                                            valid, k_scales=ksa,
+                                            v_scales=vsa)
+            return out, kpa, vpa, ksa, vsa
 
-    ctx_out, kp2, vp2 = apply(
-        fn, q, k, v, cache[0], cache[1], tbl, gate, pos,
-        n_outputs=3, name="paged_decode_attention", differentiable=False)
+        ctx_out, kp2, vp2, ks2, vs2 = apply(
+            fnq, q, k, v, cache[0], cache[1], cache[2], cache[3], tbl,
+            gate, pos, n_outputs=5, name="paged_decode_attention_quant",
+            differentiable=False)
+        new_cache = (kp2, vp2, ks2, vs2)
+    else:
+        def fn(qa, ka, va, kpa, vpa, tba, gatea, cta):
+            from ..ops import paged_attention as PA
+            ct = cta[:, 0]
+            valid = gatea.astype(jnp.int32)
+            kpa, vpa = PA.paged_prefill_write(kpa, vpa, ka, va, tba,
+                                              ct, valid)
+            out = PA.ragged_paged_attention(qa, kpa, vpa, tba, ct,
+                                            valid)
+            return out, kpa, vpa
+
+        ctx_out, kp2, vp2 = apply(
+            fn, q, k, v, cache[0], cache[1], tbl, gate, pos,
+            n_outputs=3, name="paged_decode_attention",
+            differentiable=False)
+        new_cache = (kp2, vp2)
     ctx_out = M.reshape(ctx_out, [b, s, attn.num_heads * attn.head_dim])
     out_proj = proj if proj is not None else attn.o_proj
-    return out_proj(ctx_out), (kp2, vp2)
+    return out_proj(ctx_out), new_cache
 
 
 def _alloc_kv_caches(cfg, batch_size, max_length, dtype):
@@ -468,14 +505,16 @@ class LlamaModel(nn.Layer):
             # (LayerSkip-style early exit). Serving-path only.
             skip = frozenset(skip_layers) if skip_layers else frozenset()
             new_caches = []
+            # 2 pools per layer (k, v), or 4 under quantized KV
+            # (k, v, k_scales, v_scales) — ISSUE 20
+            stride = len(caches) // len(self.layers)
             for i, layer in enumerate(self.layers):
+                lc = tuple(caches[stride * i:stride * (i + 1)])
                 if i in skip:
-                    new_caches.extend((caches[2 * i], caches[2 * i + 1]))
+                    new_caches.extend(lc)
                     continue
-                x, (kc, vc) = layer(x, cache=(caches[2 * i],
-                                              caches[2 * i + 1]), pos=pos,
-                                    tables=tables)
-                new_caches.extend((kc, vc))
+                x, kv = layer(x, cache=lc, pos=pos, tables=tables)
+                new_caches.extend(kv)
             return self.norm(x), new_caches
         if skip_layers:
             raise ValueError("skip_layers requires the caches "

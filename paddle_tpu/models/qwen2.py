@@ -49,6 +49,8 @@ class Qwen2Config:
     scan_layers: bool = True
     # every k-th layer skips remat entirely (0 = off) — see llama.py
     full_save_interval: int = 0
+    # weight-only serving quantization switch — see LlamaConfig
+    weight_quant: str | None = None
 
     @classmethod
     def qwen2_7b(cls):
@@ -281,11 +283,13 @@ class _Qwen2Base(nn.Layer, GenerationMixin):
         x = self.embed_tokens(input_ids)
         if caches is not None:
             new_caches = []
+            # 2 pools per layer, or 4 under quantized KV (ISSUE 20)
+            stride = len(caches) // len(self.layers)
             for i, layer in enumerate(self.layers):
-                x, (kc, vc) = layer(x, cache=(caches[2 * i],
-                                              caches[2 * i + 1]), pos=pos,
-                                    tables=tables)
-                new_caches.extend((kc, vc))
+                x, kv = layer(
+                    x, cache=tuple(caches[stride * i:stride * (i + 1)]),
+                    pos=pos, tables=tables)
+                new_caches.extend(kv)
             hidden = self.norm(x)
             logits = self.lm_head(hidden) if self.lm_head is not None else \
                 matmul(hidden, self.embed_tokens.weight, transpose_y=True)

@@ -21,9 +21,22 @@ import jax
 import jax.numpy as jnp
 
 from ...framework.core import Tensor, apply
+from ...profiler import metrics as _pmetrics
+from ..layer.layers import Layer as _Layer
 
 __all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
-           "llm_int8_linear"]
+           "llm_int8_linear", "WeightOnlyLinear", "quantize_for_serving"]
+
+# -- weight-only serving quantization: HBM footprint gauges (ISSUE 20)
+_pmetrics.declare("quant/weight_layers", "gauge",
+                  "projection layers converted to weight-only "
+                  "quantized form by quantize_for_serving")
+_pmetrics.declare("quant/weight_bytes", "gauge",
+                  "bytes of quantized projection weights resident in "
+                  "HBM (int8 codes + f32 scales; int4 nibble-packed)")
+_pmetrics.declare("quant/weight_bytes_saved", "gauge",
+                  "HBM bytes saved vs the original full-precision "
+                  "projection weights (the 2-4x weight capacity win)")
 
 _INT_RANGE = {"weight_only_int8": 127.0, "llm.int8": 127.0,
               "weight_only_int4": 7.0}
@@ -127,3 +140,142 @@ def llm_int8_linear(x, weight, bias=None, weight_scale=None,
     return weight_only_linear(x, weight, bias=bias,
                               weight_scale=weight_scale,
                               weight_dtype="int8")
+
+
+# -- weight-only serving layers (ISSUE 20) -----------------------------------
+
+def _pack_int4(q):
+    """int8 codes in [-8, 7], [in, out] -> nibble-packed int8
+    [ceil(in/2), out]: even row in the low nibble, odd row in the high
+    nibble (odd in_features pads a zero row)."""
+    import numpy as np
+    q = np.asarray(q, np.int8)
+    if q.shape[0] % 2:
+        q = np.concatenate([q, np.zeros((1, q.shape[1]), np.int8)])
+    lo, hi = q[0::2], q[1::2]
+    return ((lo & 0xF) | (hi << 4)).astype(np.int8)
+
+
+def _unpack_int4(p, in_features):
+    """Inverse of :func:`_pack_int4` (jnp, trace-safe): sign-extend
+    both nibbles via arithmetic shifts."""
+    lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+    hi = jnp.right_shift(p, 4)
+    w = jnp.stack([lo, hi], axis=1).reshape(-1, p.shape[-1])
+    return w[:in_features]
+
+
+def _wol_forward(x, w_q, scale, bias, algo, in_features):
+    """One fused apply: (unpack if int4) -> matmul -> epilogue scale
+    (+ bias) — the weight_only_linear math with the int4 unpack folded
+    into the same traced fn so the unpacked int8 never round-trips."""
+    args = [x, w_q, scale] + ([bias] if bias is not None else [])
+
+    def fn(xx, w, s, *rest):
+        if algo == "weight_only_int4":
+            w = _unpack_int4(w, in_features)
+        cd = xx.dtype
+        y = jnp.matmul(xx, w.astype(cd))
+        y = (y.astype(jnp.float32) * s).astype(cd)
+        if rest:
+            y = y + rest[0].astype(cd)
+        return y
+
+    return apply(fn, *args, differentiable=False,
+                 name="weight_only_linear")
+
+
+class WeightOnlyLinear(_Layer):
+    """Serving-time replacement for a Linear-family projection: int8
+    (or nibble-packed int4) weight codes + per-out-channel f32 scales
+    live in HBM as BUFFERS (2-4x fewer weight bytes), and the forward
+    runs the ``weight_only_linear`` dequant-in-matmul epilogue. Built
+    once at load by :func:`quantize_for_serving`; inference-only (the
+    quantized weight is not a trainable Parameter)."""
+
+    def __init__(self, weight, bias=None, algo="weight_only_int8"):
+        super().__init__()
+        if algo not in ("weight_only_int8", "weight_only_int4"):
+            raise ValueError(
+                f"unsupported serving weight_quant algo {algo!r}")
+        w = weight._data if isinstance(weight, Tensor) else \
+            jnp.asarray(weight)
+        self._algo = algo
+        self.in_features = int(w.shape[0])
+        self.out_features = int(w.shape[1])
+        q, s = weight_quantize(Tensor(w), algo=algo)
+        if algo == "weight_only_int4":
+            q = Tensor(jnp.asarray(_pack_int4(q._data)))
+        self.register_buffer("weight_q", q)
+        self.register_buffer("weight_scale", s)
+        if bias is not None:
+            b = bias if isinstance(bias, Tensor) else \
+                Tensor(jnp.asarray(bias))
+            self.register_buffer("bias", b)
+        else:
+            self.bias = None
+
+    def forward(self, x):
+        return _wol_forward(x, self.weight_q, self.weight_scale,
+                            self.bias, self._algo, self.in_features)
+
+    def extra_repr(self):
+        return (f"in={self.in_features}, out={self.out_features}, "
+                f"algo={self._algo}")
+
+
+#: projection names the serving path quantizes — the big matmuls of
+#: the Llama/Qwen2 family (qkv/o/gate/up/down + LM head) and GPT2's
+#: fused equivalents. Norms/embeddings stay full precision.
+_QUANT_TARGETS = frozenset({
+    "q_proj", "k_proj", "v_proj", "o_proj",
+    "gate_proj", "up_proj", "down_proj", "lm_head",
+    "c_attn", "c_proj", "c_fc",
+})
+
+
+def quantize_for_serving(model, algo=None, targets=None):
+    """Convert a model's big projections to :class:`WeightOnlyLinear`
+    in place (once, at load): walks every sublayer, replaces children
+    whose name is in ``targets`` (default :data:`_QUANT_TARGETS`) and
+    whose type is Linear-family, and reports the HBM weight-byte
+    delta on the ``quant/*`` gauges. ``algo`` defaults to
+    ``model.config.weight_quant``. Idempotent — already-converted
+    layers are skipped. A tied-embedding model with ``lm_head=None``
+    simply has no lm_head child to convert (the embedding matmul stays
+    full precision, matching the reference weight-only scope)."""
+    if algo is None:
+        algo = getattr(getattr(model, "config", None), "weight_quant",
+                       None)
+    if not algo:
+        return {"layers": 0, "bytes": 0, "bytes_saved": 0}
+    from ..layer.common import Linear
+    try:
+        from ...distributed.parallel_layers import (
+            ColumnParallelLinear, RowParallelLinear)
+        linear_types = (Linear, ColumnParallelLinear, RowParallelLinear)
+    except Exception:      # pragma: no cover — distributed is baked in
+        linear_types = (Linear,)
+    names = frozenset(targets) if targets is not None else _QUANT_TARGETS
+    import numpy as np
+    converted = q_bytes = saved = 0
+    parents = [model] + [lyr for _, lyr in model.named_sublayers()]
+    for parent in parents:
+        for cname, child in list(parent.named_children()):
+            if cname not in names or not isinstance(child, linear_types):
+                continue
+            w = child.weight._data
+            bias = getattr(child, "bias", None)
+            wol = WeightOnlyLinear(Tensor(w), bias=bias, algo=algo)
+            setattr(parent, cname, wol)
+            orig = int(np.prod(w.shape)) * w.dtype.itemsize
+            new = (wol.weight_q._data.nbytes
+                   + wol.weight_scale._data.nbytes)
+            converted += 1
+            q_bytes += new
+            saved += orig - new
+    reg = _pmetrics.get_registry()
+    reg.gauge("quant/weight_layers").set(converted)
+    reg.gauge("quant/weight_bytes").set(q_bytes)
+    reg.gauge("quant/weight_bytes_saved").set(saved)
+    return {"layers": converted, "bytes": q_bytes, "bytes_saved": saved}

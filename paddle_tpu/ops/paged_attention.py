@@ -34,9 +34,48 @@ __all__ = ["paged_attention", "paged_attention_reference",
            "paged_prefill_attention", "paged_prefill_attention_reference",
            "ragged_paged_attention", "ragged_paged_attention_reference",
            "paged_decode_write", "paged_prefill_write",
-           "paged_verify_write"]
+           "paged_verify_write", "kv_quant_range", "quantize_kv",
+           "dequantize_pages", "paged_prefill_write_quant",
+           "paged_verify_write_quant"]
 
 _NEG_INF = -1e30
+
+
+def kv_quant_range(dtype):
+    """Symmetric quantization range for a quantized-KV pool dtype: the
+    largest magnitude a quantized code can carry, so ``scale = absmax /
+    range``. The quant MODE is inferred from the pool dtype everywhere
+    (no extra traced operand through the compiled batching step)."""
+    dtype = jnp.dtype(dtype)
+    if dtype == jnp.int8:
+        return 127.0       # symmetric, the reference skips -128
+    if "float8_e4m3" in dtype.name:
+        return 448.0       # e4m3 finite max
+    raise ValueError(f"not a quantized KV pool dtype: {dtype}")
+
+
+def quantize_kv(x, dtype):
+    """Per-vector absmax quantization of k/v projections: x [..., D]
+    float -> (q [..., D] ``dtype``, scales [...] float32) with
+    ``dequant = q.astype(f32) * scale``. One scale per (token, kv head)
+    — written WITH the token, so decode appends into a partially filled
+    page never requantize earlier tokens (write-once discipline)."""
+    r = kv_quant_range(dtype)
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scales = jnp.where(amax > 0, amax, 1.0) / r
+    y = xf / scales[..., None]
+    if jnp.dtype(dtype) == jnp.int8:
+        q = jnp.clip(jnp.round(y), -127.0, 127.0).astype(jnp.int8)
+    else:
+        q = y.astype(dtype)
+    return q, scales
+
+
+def dequantize_pages(pages, scales):
+    """Quantized pool -> f32: pages [KVH, P, page, D] x scales
+    [KVH, P, page] (the page-parallel scales pool) -> f32 pages."""
+    return pages.astype(jnp.float32) * scales.astype(jnp.float32)[..., None]
 
 
 def paged_attention_reference(q, key_pages, value_pages, block_tables,
@@ -108,7 +147,8 @@ def paged_attention(q, key_pages, value_pages, block_tables, context_lens,
 
 def paged_prefill_attention_reference(q, key_pages, value_pages,
                                       block_tables, context_lens,
-                                      scale=None):
+                                      scale=None, k_scales=None,
+                                      v_scales=None):
     """Pure-jnp oracle for CHUNKED prefill over the page pool.
 
     q: [B, C, H, D] — C query tokens per sequence whose k/v have already
@@ -122,9 +162,19 @@ def paged_prefill_attention_reference(q, key_pages, value_pages,
     Per-query masking is over the SAME gathered [max_len] axis the
     decode oracle uses, so chunked and whole-prompt prefill reduce in
     the same order — the basis of the token-parity guarantee.
+
+    ``k_scales``/``v_scales`` [KVH, num_pages, page_size] f32 mark the
+    pools as quantized (int8/fp8): pages are dequantized to f32 right
+    after the gather — the same block-table indirection, so trash-page
+    routing and page sharing compose unchanged — and the output is cast
+    back to q's dtype.
     """
     b, c, h, d = q.shape
     kvh, _, page_size, _ = key_pages.shape
+    quantized = k_scales is not None
+    if quantized:
+        key_pages = dequantize_pages(key_pages, k_scales)
+        value_pages = dequantize_pages(value_pages, v_scales)
     rep = h // kvh
     s = scale if scale is not None else 1.0 / math.sqrt(d)
     max_len = block_tables.shape[1] * page_size
@@ -143,7 +193,8 @@ def paged_prefill_attention_reference(q, key_pages, value_pages,
         probs = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
         return jnp.einsum("chk,hkd->chd", probs, v)
 
-    return jax.vmap(one_seq)(q, block_tables, context_lens)
+    out = jax.vmap(one_seq)(q, block_tables, context_lens)
+    return out.astype(q.dtype) if quantized else out
 
 
 def paged_prefill_attention(q, key_pages, value_pages, block_tables,
@@ -162,7 +213,8 @@ def paged_prefill_attention(q, key_pages, value_pages, block_tables,
 
 def ragged_paged_attention_reference(q, key_pages, value_pages,
                                      block_tables, ctx_lens, lengths,
-                                     scale=None):
+                                     scale=None, k_scales=None,
+                                     v_scales=None):
     """Pure-jnp oracle for the RAGGED mixed prefill+decode batching
     step: q [B, C, H, D] is the uniform-stride view of the flattened
     token stream (slot b's tokens are the ``[start=b*C, length=
@@ -179,13 +231,15 @@ def ragged_paged_attention_reference(q, key_pages, value_pages,
     the basis of the kernel parity tests."""
     c = q.shape[1]
     out = paged_prefill_attention_reference(
-        q, key_pages, value_pages, block_tables, ctx_lens, scale)
+        q, key_pages, value_pages, block_tables, ctx_lens, scale,
+        k_scales=k_scales, v_scales=v_scales)
     valid = jnp.arange(c)[None, :] < lengths[:, None]      # [B, C]
     return jnp.where(valid[:, :, None, None], out, 0).astype(out.dtype)
 
 
 def ragged_paged_attention(q, key_pages, value_pages, block_tables,
-                           ctx_lens, lengths, scale=None):
+                           ctx_lens, lengths, scale=None,
+                           k_scales=None, v_scales=None):
     """Mixed prefill+decode paged attention — the serving engine's ONE
     attention entry point (PAPERS.md ragged-paged-attention). Pallas
     kernel on TPU (``FLAGS_use_pallas_ragged_attention``), jnp oracle
@@ -202,7 +256,8 @@ def ragged_paged_attention(q, key_pages, value_pages, block_tables,
             from .pallas.ragged_paged_attention import (
                 ragged_paged_attention as _kernel)
             return _kernel(q, key_pages, value_pages, block_tables,
-                           ctx_lens, lengths, scale)
+                           ctx_lens, lengths, scale,
+                           k_scales=k_scales, v_scales=v_scales)
         except Exception as e:
             warnings.warn(
                 f"Pallas ragged paged-attention kernel unavailable "
@@ -210,7 +265,7 @@ def ragged_paged_attention(q, key_pages, value_pages, block_tables,
                 f"path", RuntimeWarning)
     return ragged_paged_attention_reference(
         q, key_pages, value_pages, block_tables, ctx_lens, lengths,
-        scale)
+        scale, k_scales=k_scales, v_scales=v_scales)
 
 
 def paged_decode_write(kp, vp, k, v, block_tables, ctx, active=None):
@@ -284,3 +339,46 @@ def paged_verify_write(kp, vp, k, v, block_tables, ctx, valid):
     0 and out-of-row positions are clamped — because a verification
     chunk IS a short prefill chunk to the page pool."""
     return paged_prefill_write(kp, vp, k, v, block_tables, ctx, valid)
+
+
+def paged_prefill_write_quant(kp, vp, ks, vs, k, v, block_tables, ctx,
+                              valid):
+    """Quantize-at-write prefill chunk write for quantized KV pools.
+
+    kp, vp: [KVH, num_pages, page_size, D] int8 (or fp8) data pools;
+    ks, vs: [KVH, num_pages, page_size] f32 page-parallel scales pools.
+    k, v: [B, C, KVH, D] float projections (already rotated). The quant
+    mode rides the pool dtype (:func:`kv_quant_range`) and each token's
+    per-kv-head scale is written at the SAME (page, offset) its data
+    lands at, so the scales ride the block-table indirection unchanged:
+    trash-routed padding writes its scale to trash page 0, COW forks
+    copy the scale page with the data page, and preemption replay
+    rewrites both."""
+    c = k.shape[1]
+    page = kp.shape[2]
+    qk, sk = quantize_kv(k, kp.dtype)       # [B, C, KVH, D] / [B, C, KVH]
+    qv, sv = quantize_kv(v, vp.dtype)
+    pos = ctx[:, None] + jnp.arange(c, dtype=ctx.dtype)[None, :]  # [B, C]
+    pidx = jnp.minimum(pos // page, block_tables.shape[1] - 1)
+    pid = jnp.take_along_axis(block_tables, pidx, axis=1)         # [B, C]
+    ok = jnp.arange(c)[None, :] < valid[:, None]
+    pid = jnp.where(ok, pid, 0)
+    off = pos % page
+    kp = kp.at[:, pid, off, :].set(jnp.transpose(qk, (2, 0, 1, 3)))
+    vp = vp.at[:, pid, off, :].set(jnp.transpose(qv, (2, 0, 1, 3)))
+    ks = ks.at[:, pid, off].set(jnp.transpose(sk, (2, 0, 1))
+                                .astype(ks.dtype))
+    vs = vs.at[:, pid, off].set(jnp.transpose(sv, (2, 0, 1))
+                                .astype(vs.dtype))
+    return kp, vp, ks, vs
+
+
+def paged_verify_write_quant(kp, vp, ks, vs, k, v, block_tables, ctx,
+                             valid):
+    """Speculative verify write into quantized pools — the same
+    rollback-safety argument as :func:`paged_verify_write` (reads are
+    fenced by ctx, writes overwrite in place, sharing is prompt-only)
+    holds per-token for the scales too, since a scale is only ever read
+    together with the data it was written with."""
+    return paged_prefill_write_quant(kp, vp, ks, vs, k, v, block_tables,
+                                     ctx, valid)

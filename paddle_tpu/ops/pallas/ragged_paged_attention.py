@@ -79,11 +79,13 @@ class force_ragged_blocks:
         return False
 
 
-def _resolve_blocks(c, pages_per_seq, page, d, dtype):
+def _resolve_blocks(c, pages_per_seq, page, d, dtype, quant=False):
     """(q_block, kv_pages_per_block) for this shape, precedence: forced
     trial candidate > explicit user flag > tuner cache > default.
     Host-side at trace time — static ints selecting the compiled
-    grid."""
+    grid. Quantized pools add a ``kvq`` component to the shape sig so
+    bf16 cache entries can't poison quantized configs (and vice versa);
+    bf16 shapes keep the historical sig."""
     from ...framework import flags
     forced = getattr(_forced_tls, "blocks", None)
     if forced is not None:
@@ -97,9 +99,12 @@ def _resolve_blocks(c, pages_per_seq, page, d, dtype):
             "FLAGS_ragged_attn_kv_pages") != "default"
         if not (qb_explicit and g_explicit):
             from ...tuner import lookup
-            cfg = lookup("ragged_paged_attention",
-                         {"c": int(c), "pages": int(pages_per_seq),
-                          "page": int(page), "d": int(d)}, str(dtype))
+            shape_sig = {"c": int(c), "pages": int(pages_per_seq),
+                         "page": int(page), "d": int(d)}
+            if quant:
+                shape_sig["kvq"] = 1
+            cfg = lookup("ragged_paged_attention", shape_sig,
+                         str(dtype))
             if cfg:
                 if not qb_explicit:
                     qb = int(cfg.get("q_block", qb))
@@ -209,9 +214,111 @@ def _ragged_kernel(ctx_ref, len_ref, tbl_ref, q_ref, k_hbm_ref,
             q_block, rep, d).astype(o_ref.dtype)
 
 
+def _ragged_quant_kernel(ctx_ref, len_ref, tbl_ref, q_ref, k_hbm_ref,
+                         v_hbm_ref, ks_hbm_ref, vs_hbm_ref, o_ref,
+                         k_buf, v_buf, ks_buf, vs_buf, sem, sem_s, *,
+                         scale, page, q_block, g_pages, pages_per_seq):
+    """Quantized-pool variant of :func:`_ragged_kernel`: the data pools
+    are int8 (or fp8) and a page-parallel f32 scales pool rides the
+    SAME block-table indirection — each grid step DMAs the scale pages
+    alongside the data pages and dequantizes in VMEM right after the
+    wait (``k = q_codes.astype(f32) * scale``), so the softmax body is
+    numerically identical to the bf16 kernel's fp32 accumulation. Scale
+    copies have a different byte count than data copies, so they ride
+    their OWN per-slot semaphore (the shared-counter hazard in
+    ``_ragged_kernel.dma_block`` applies per byte-count class)."""
+    h = pl.program_id(0)
+    b = pl.program_id(1)
+    qi = pl.program_id(2)
+    rep = q_ref.shape[1]           # q heads per kv head
+    d = q_ref.shape[2]
+    bk = g_pages * page            # keys per kv block
+    ctx = ctx_ref[b]
+    length = len_ref[b]
+    q_start = qi * q_block         # first chunk token of this q block
+
+    o_ref[...] = jnp.zeros_like(o_ref)
+
+    def dma_block(i, slot):
+        copies = []
+        for gidx in range(g_pages):
+            pidx = jnp.minimum(i * g_pages + gidx, pages_per_seq - 1)
+            pid = tbl_ref[b * pages_per_seq + pidx]
+            copies.append(pltpu.make_async_copy(
+                k_hbm_ref.at[h, pid], k_buf.at[slot, gidx],
+                sem.at[slot]))
+            copies.append(pltpu.make_async_copy(
+                v_hbm_ref.at[h, pid], v_buf.at[slot, gidx],
+                sem.at[slot]))
+            copies.append(pltpu.make_async_copy(
+                ks_hbm_ref.at[h, pid], ks_buf.at[slot, gidx],
+                sem_s.at[slot]))
+            copies.append(pltpu.make_async_copy(
+                vs_hbm_ref.at[h, pid], vs_buf.at[slot, gidx],
+                sem_s.at[slot]))
+        return copies
+
+    @pl.when(q_start < length)
+    def compute():  # noqa: ANN001 — pl.when body
+        n_kv = ctx + jnp.minimum(q_start + q_block, length)
+        n_blocks = (n_kv + bk - 1) // bk
+
+        for c in dma_block(0, 0):
+            c.start()
+
+        q = q_ref[...].astype(jnp.float32) * scale  # [q_block, rep, d]
+        q2 = q.reshape(q_block * rep, d)
+
+        def body(i, carry):
+            acc, m_prev, l_prev = carry
+            slot = jax.lax.rem(i, 2)
+            nslot = jax.lax.rem(i + 1, 2)
+
+            @pl.when(i + 1 < n_blocks)
+            def _():
+                for c in dma_block(i + 1, nslot):
+                    c.start()
+
+            for c in dma_block(i, slot):
+                c.wait()
+            # dequant in VMEM, right after the DMA: one f32 scale per
+            # (token, kv head) broadcast over the head dim
+            k = (k_buf[slot].reshape(bk, d).astype(jnp.float32)
+                 * ks_buf[slot].reshape(bk, 1))
+            v = (v_buf[slot].reshape(bk, d).astype(jnp.float32)
+                 * vs_buf[slot].reshape(bk, 1))
+            s = jax.lax.dot_general(
+                q2, k, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)  # [qb*rep, bk]
+            k_pos = i * bk + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block * rep, bk), 1)
+            q_tok = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (q_block * rep, bk), 0) // rep
+            valid = (k_pos <= ctx + q_tok) & (q_tok < length)
+            s = jnp.where(valid, s, _NEG_INF)
+            m_cur = jnp.max(s, axis=-1)
+            m_new = jnp.maximum(m_prev, m_cur)
+            p = jnp.where(valid, jnp.exp(s - m_new[:, None]), 0.0)
+            alpha = jnp.exp(m_prev - m_new)
+            l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[:, None] + jax.lax.dot_general(
+                p, v, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return acc, m_new, l_new
+
+        acc0 = jnp.zeros((q_block * rep, d), jnp.float32)
+        m0 = jnp.full((q_block * rep,), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((q_block * rep,), jnp.float32)
+        acc, m, l = jax.lax.fori_loop(0, n_blocks, body, (acc0, m0, l0))
+        l = jnp.maximum(l, 1e-30)
+        o_ref[...] = (acc / l[:, None]).reshape(
+            q_block, rep, d).astype(o_ref.dtype)
+
+
 def ragged_paged_attention(q, key_pages, value_pages, block_tables,
                            ctx_lens, lengths, scale=None, q_block=None,
-                           kv_pages_per_block=None):
+                           kv_pages_per_block=None, k_scales=None,
+                           v_scales=None):
     """Mixed prefill+decode paged attention over the flattened token
     stream (uniform-stride view).
 
@@ -224,14 +331,19 @@ def ragged_paged_attention(q, key_pages, value_pages, block_tables,
     ctx_lens     [B] int32 — cache length BEFORE the chunk
     lengths      [B] int32 — valid stream tokens per slot (0 = idle,
                  1 = decode step, >1 = prefill chunk)
+    k_scales /   optional [KVH, num_pages, page_size] f32 page-parallel
+    v_scales     scales pools — when given, the data pools are int8/fp8
+                 and the kernel dequantizes pages in VMEM after the DMA
     Returns [B, C, H, D].
     """
     b, c, h, d = q.shape
     kvh, _, page, _ = key_pages.shape
     rep = h // kvh
     pages_per_seq = block_tables.shape[1]
+    quant = k_scales is not None
     s = scale if scale is not None else 1.0 / math.sqrt(d)
-    qb, g = _resolve_blocks(c, pages_per_seq, page, d, q.dtype)
+    qb, g = _resolve_blocks(c, pages_per_seq, page, d, q.dtype,
+                            quant=quant)
     if q_block is not None:
         qb = max(1, min(int(q_block), c))
     if kv_pages_per_block is not None:
@@ -240,29 +352,43 @@ def ragged_paged_attention(q, key_pages, value_pages, block_tables,
     if c_p != c:
         q = jnp.pad(q, ((0, 0), (0, c_p - c), (0, 0), (0, 0)))
     grid = (kvh, b, c_p // qb)
+    kern = _ragged_quant_kernel if quant else _ragged_kernel
+    any_spec = pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY)
+    in_specs = [
+        # q: (slot, q block, kv-head group, head_dim)
+        pl.BlockSpec((None, qb, rep, d),
+                     lambda hh, bb, qq, *_: (bb, qq, hh, 0)),
+        any_spec,       # key pages stay in HBM
+        any_spec,       # value pages
+    ]
+    scratch = [
+        pltpu.VMEM((2, g, page, d), key_pages.dtype),
+        pltpu.VMEM((2, g, page, d), value_pages.dtype),
+    ]
+    operands = [q, key_pages, value_pages]
+    if quant:
+        in_specs += [any_spec, any_spec]            # scales pools
+        scratch += [pltpu.VMEM((2, g, page), k_scales.dtype),
+                    pltpu.VMEM((2, g, page), v_scales.dtype)]
+        operands += [k_scales, v_scales]
+    scratch.append(pltpu.SemaphoreType.DMA((2,)))   # one per slot
+    if quant:
+        # scale copies are a different byte count than page copies —
+        # they need their own per-slot counter (see kernel docstring)
+        scratch.append(pltpu.SemaphoreType.DMA((2,)))
     with _no_x64():
         out = pl.pallas_call(
             functools.partial(
-                _ragged_kernel, scale=s, page=page, q_block=qb,
+                kern, scale=s, page=page, q_block=qb,
                 g_pages=g, pages_per_seq=pages_per_seq),
             grid_spec=pltpu.PrefetchScalarGridSpec(
                 num_scalar_prefetch=3,   # ctx, lengths, block tables
                 grid=grid,
-                in_specs=[
-                    # q: (slot, q block, kv-head group, head_dim)
-                    pl.BlockSpec((None, qb, rep, d),
-                                 lambda hh, bb, qq, *_: (bb, qq, hh, 0)),
-                    pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
-                    pl.BlockSpec(memory_space=pltpu.TPUMemorySpace.ANY),
-                ],
+                in_specs=in_specs,
                 out_specs=pl.BlockSpec(
                     (None, qb, rep, d),
                     lambda hh, bb, qq, *_: (bb, qq, hh, 0)),
-                scratch_shapes=[
-                    pltpu.VMEM((2, g, page, d), key_pages.dtype),
-                    pltpu.VMEM((2, g, page, d), value_pages.dtype),
-                    pltpu.SemaphoreType.DMA((2,)),   # one per slot
-                ],
+                scratch_shapes=scratch,
             ),
             compiler_params=pltpu.TPUCompilerParams(
                 dimension_semantics=("parallel", "arbitrary",
@@ -270,8 +396,7 @@ def ragged_paged_attention(q, key_pages, value_pages, block_tables,
             out_shape=jax.ShapeDtypeStruct((b, c_p, h, d), q.dtype),
             interpret=_interpret(),
         )(ctx_lens.astype(jnp.int32), lengths.astype(jnp.int32),
-          block_tables.astype(jnp.int32).reshape(-1), q, key_pages,
-          value_pages)
+          block_tables.astype(jnp.int32).reshape(-1), *operands)
     return out[:, :c]
 
 
@@ -318,19 +443,28 @@ def _register_ragged_surface():
 _register_ragged_surface()
 
 
-def ragged_attention_cost(q_shape, pool_shape, avg_ctx, lengths_sum=None):
+def ragged_attention_cost(q_shape, pool_shape, avg_ctx, lengths_sum=None,
+                          pool_dtype=None):
     """Static FLOPs/bytes for one :func:`ragged_paged_attention` call
     (profiler cost-accounting surface): q [B, C, H, D], pool
     [KVH, pages, page, D]. Attention over an average history of
     ``avg_ctx`` keys per stream token; bytes count q/pages-touched/out
-    only (the kernel never materializes scores)."""
+    only (the kernel never materializes scores). ``pool_dtype`` makes
+    the page traffic quant-aware: int8 pools stream half the bytes of
+    bf16, plus one f32 scale per (token, kv head) from the scales
+    pool."""
     from ...profiler.cost import SectionCost
     b, c, h, d = (int(x) for x in q_shape)
     _, _, page, _ = (int(x) for x in pool_shape)
     toks = int(lengths_sum) if lengths_sum is not None else b * c
     flops = 4.0 * toks * h * d * float(avg_ctx)
     pages_touched = toks * -(-float(avg_ctx) // page)
-    itemsize = 2  # serving pools are bf16 on TPU
-    bytes_ = (toks * h * d + 2 * pages_touched * page * d
-              + toks * h * d) * itemsize
+    io_itemsize = 2  # q/out are bf16 on TPU
+    pool_itemsize = (jnp.dtype(pool_dtype).itemsize
+                     if pool_dtype is not None else 2)
+    bytes_ = ((toks * h * d + toks * h * d) * io_itemsize
+              + 2 * pages_touched * page * d * pool_itemsize)
+    if pool_dtype is not None and pool_itemsize == 1:
+        # quantized pools also stream the page-parallel f32 scales
+        bytes_ += 2 * pages_touched * page * 4
     return SectionCost(flops=flops, bytes=bytes_)

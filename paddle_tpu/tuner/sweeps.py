@@ -302,6 +302,11 @@ def ragged_attention_builder(slots=8, heads=8, kv_heads=2,
         pages = int(shape["pages"])
         page = int(shape["page"])
         d = int(shape["d"])
+        # a "kvq" shape component selects the QUANTIZED kernel variant
+        # (int8 data pools + f32 page-parallel scales) — the same
+        # component _resolve_blocks keys the cache on, so quantized
+        # winners land under a distinct sig from bf16 winners
+        quant = bool(shape.get("kvq"))
         dt = jnp.dtype(dtype)
         total = slots * pages + 1      # + the trash page 0
         key = jax.random.PRNGKey(0)
@@ -312,6 +317,11 @@ def ragged_attention_builder(slots=8, heads=8, kv_heads=2,
             kk, (kv_heads, total, page, d), jnp.float32).astype(dt)
         vp = jax.random.normal(
             kv, (kv_heads, total, page, d), jnp.float32).astype(dt)
+        ks = vs = None
+        if quant:
+            from ..ops.paged_attention import quantize_kv
+            (kp, ks), (vp, vs) = (quantize_kv(kp, jnp.int8),
+                                  quantize_kv(vp, jnp.int8))
         rng = np.random.RandomState(0)
         tables = jnp.asarray(
             (rng.permutation(total - 1)[:slots * pages] + 1)
@@ -324,14 +334,23 @@ def ragged_attention_builder(slots=8, heads=8, kv_heads=2,
                             for s in range(slots)], jnp.int32)
         qb = int(config["q_block"])
         g = int(config["kv_pages_per_block"])
-        step = jax.jit(ragged_paged_attention)
+        if quant:
+            def step_fn(qq, kpp, vpp, tb, cx, ln, kss, vss):
+                return ragged_paged_attention(
+                    qq, kpp, vpp, tb, cx, ln,
+                    k_scales=kss, v_scales=vss)
+            step = jax.jit(step_fn)
+            operands = (q, kp, vp, tables, ctx, lens, ks, vs)
+        else:
+            step = jax.jit(ragged_paged_attention)
+            operands = (q, kp, vp, tables, ctx, lens)
 
         def fn():
             # the force context must cover the first (tracing) call —
             # it short-circuits _resolve_blocks, so the candidate is
             # pinned through the SAME resolution path production uses
             with force_ragged_blocks(qb, g):
-                return _trial(step, q, kp, vp, tables, ctx, lens)
+                return _trial(step, *operands)
         return fn
 
     return builder
@@ -382,6 +401,11 @@ BENCH_PRESETS = {
         # 32-token pages, head_dim 128
         ("ragged_paged_attention",
          {"c": 32, "pages": 12, "page": 32, "d": 128}),
+        # quantized-KV variant (ISSUE 20): same geometry, int8 pools +
+        # f32 scales — "kvq" keys a separate shape sig so bf16 winners
+        # can't poison quantized configs (and vice versa)
+        ("ragged_paged_attention",
+         {"c": 32, "pages": 12, "page": 32, "d": 128, "kvq": 1}),
         # model-level: the CLI points at `bench.py --autotune`'s
         # cb-spec section, which sweeps K x draft source here
         ("spec_decode", {"slots": 1, "max_len": 384, "page": 32}),
@@ -395,6 +419,8 @@ BENCH_PRESETS = {
         ("fused_ce", {"d": 64, "v": 1024}),
         ("ragged_paged_attention",
          {"c": 8, "pages": 4, "page": 8, "d": 16}),
+        ("ragged_paged_attention",
+         {"c": 8, "pages": 4, "page": 8, "d": 16, "kvq": 1}),
         ("spec_decode", {"slots": 1, "max_len": 64, "page": 8}),
     ],
 }

@@ -35,9 +35,9 @@ def test_known_gates_are_registered():
                      "serving_chaos", "fleet_chaos", "prefix_cache",
                      "proc_fleet_chaos", "disagg_chaos",
                      "serving_parity", "spec_decode",
-                     "autoscale_scenarios", "fused_parity",
-                     "observability", "http_api"]
-    assert len(names) == 15    # ISSUE-19 pin: 15 gates, none dropped
+                     "autoscale_scenarios", "quant_serving",
+                     "fused_parity", "observability", "http_api"]
+    assert len(names) == 16    # ISSUE-20 pin: 16 gates, none dropped
 
 
 def test_all_gates_pass_on_healthy_log(tmp_path):
@@ -62,6 +62,7 @@ def test_all_gates_pass_on_healthy_log(tmp_path):
     assert "serving_parity" not in p.stdout
     assert "spec_decode" not in p.stdout
     assert "autoscale_scenarios" not in p.stdout
+    assert "quant_serving" not in p.stdout
     assert "fused_parity" not in p.stdout
     assert "observability" not in p.stdout
     assert "http_api" not in p.stdout
@@ -86,6 +87,7 @@ def test_full_driver_including_chaos_gate(tmp_path):
     assert "serving_parity: PASS" in p.stdout
     assert "spec_decode: PASS" in p.stdout
     assert "autoscale_scenarios: PASS" in p.stdout
+    assert "quant_serving: PASS" in p.stdout
     assert "fused_parity: PASS" in p.stdout
     assert "observability: PASS" in p.stdout
     assert "http_api: PASS" in p.stdout

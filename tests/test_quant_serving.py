@@ -1,0 +1,461 @@
+"""Quantized serving: int8/fp8 paged-KV + weight-only int8/int4
+(ISSUE 20).
+
+Contracts pinned here:
+
+- the per-vector absmax KV codec round-trips within its half-step
+  error bound (including bf16 GQA pools and page tails the page size
+  does not divide), and the quantized page write routes invalid
+  positions to trash page 0 exactly like the full-precision write —
+  scales pools included;
+- the Pallas ragged kernel's in-VMEM dequant matches the jnp oracle's
+  pool-level dequant on the same quantized pools;
+- fp8 KV is a typed ValueError when the backend lacks
+  ``float8_e4m3fn`` and works end-to-end when it has it;
+- the engine accuracy gate: greedy decode under ``kv_quant="int8"``
+  (and under weight-only int8) stays pinned to the full-precision
+  oracle within explicit top-1 agreement bars on a fixed-seed model;
+- int8-KV composes with everything that moves pages: prefix-cache
+  warm attach, priority preemption + recompute replay, spec decode,
+  the legacy (unified=False) engine, and disagg migration (native
+  quantized wire blocks, crc over codes+scales, mixed-quant pairs
+  reject into the tokens-only replay) — with the page audit (which
+  covers the scales pools) on for every engine;
+- weight-only layers: the int4 nibble pack round-trips exactly,
+  ``WeightOnlyLinear`` matches the plain Linear within quantization
+  error, and ``quantize_for_serving`` converts exactly the projection
+  set, idempotently, skipping tied-embedding heads.
+
+The ``tools/run_gates.py quant_serving`` gate runs this full marker.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu.framework.core import Tensor
+from paddle_tpu.inference import ContinuousBatchingEngine
+from paddle_tpu.inference.disagg import (kv_payload_from_wire,
+                                         kv_payload_to_wire)
+from paddle_tpu.models import LlamaConfig, LlamaForCausalLM
+from paddle_tpu.nn.quant import (WeightOnlyLinear, _pack_int4,
+                                 _unpack_int4, quantize_for_serving)
+from paddle_tpu.ops import paged_attention as PA
+
+pytestmark = pytest.mark.quant_serving
+
+_MODEL = None
+
+
+def _model():
+    """One tiny 2-layer model shared by the whole module (the accuracy
+    bars below are pinned against THIS fixed-seed model)."""
+    global _MODEL
+    if _MODEL is None:
+        cfg = LlamaConfig.tiny()
+        cfg.tensor_parallel = False
+        cfg.scan_layers = False
+        paddle.seed(0)
+        m = LlamaForCausalLM(cfg)
+        m.eval()
+        _MODEL = (m, cfg)
+    return _MODEL
+
+
+def _engine(**kw):
+    m, _ = _model()
+    kw.setdefault("audit", True)
+    return ContinuousBatchingEngine(
+        m, num_slots=kw.pop("num_slots", 2), page_size=8, max_len=48,
+        decode_chunk=4, prompt_buckets=(16,), greedy=True, **kw)
+
+
+def _prompts(n, seed=7, lo=5, hi=14):
+    m, cfg = _model()
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size,
+                        (int(rng.randint(lo, hi)),)).astype(np.int32)
+            for _ in range(n)]
+
+
+def _streams(eng, prompts, n_new=8, priority=None):
+    ids = [eng.add_request(p, n_new,
+                           **({} if priority is None
+                              else {"priority": priority[i]}))
+           for i, p in enumerate(prompts)]
+    by = {r.request_id: r for r in eng.run()}
+    return [by[i].tokens for i in ids]
+
+
+def _agreement(a, b):
+    num = den = 0
+    for x, y in zip(a, b):
+        den += max(len(x), len(y))
+        num += sum(1 for u, w in zip(x, y) if u == w)
+    return num / max(den, 1)
+
+
+# ---- codec / ops layer ---------------------------------------------------
+
+def test_kv_quant_range():
+    assert PA.kv_quant_range(jnp.int8) == 127.0
+    if hasattr(jnp, "float8_e4m3fn"):
+        assert PA.kv_quant_range(jnp.float8_e4m3fn) == 448.0
+    with pytest.raises(ValueError, match="quantized KV pool dtype"):
+        PA.kv_quant_range(jnp.bfloat16)
+
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_kv_roundtrip_half_step_bound(dtype):
+    """absmax int8 round-trip error <= scale/2 per element, on a GQA
+    pool whose page tail (3 tokens of 8) the codec must not touch
+    differently — quantization is per (token, head) vector, so a tail
+    is just fewer vectors."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(2, 5, 8, 16) * 3.0, jnp.dtype(dtype))
+    q, s = PA.quantize_kv(x, jnp.int8)
+    assert q.dtype == jnp.int8 and s.shape == x.shape[:-1]
+    back = PA.dequantize_pages(q, s)
+    err = np.abs(np.asarray(back, np.float32)
+                 - np.asarray(x, np.float32))
+    bound = np.asarray(s, np.float32)[..., None] * 0.5 + 1e-6
+    assert (err <= bound).all()
+    # a page tail (partial page) carries the same bound
+    tail = x[:, :, :3, :]
+    qt, st = PA.quantize_kv(tail, jnp.int8)
+    bt = PA.dequantize_pages(qt, st)
+    errt = np.abs(np.asarray(bt, np.float32)
+                  - np.asarray(tail, np.float32))
+    assert (errt <= np.asarray(st, np.float32)[..., None] * 0.5
+            + 1e-6).all()
+    # all-zero vectors must round-trip to exactly zero (scale floor)
+    z, sz = PA.quantize_kv(jnp.zeros_like(x), jnp.int8)
+    assert not np.asarray(z).any()
+    assert np.asarray(PA.dequantize_pages(z, sz)).max() == 0.0
+
+
+def test_quant_write_trash_routing():
+    """paged_prefill_write_quant routes invalid positions to trash
+    page 0 (data AND scales) and lands valid tokens dequantizable at
+    their block-table page/offset."""
+    kvh, P, page, d = 2, 6, 4, 8
+    B, C = 2, 4
+    rng = np.random.RandomState(1)
+    k = jnp.asarray(rng.randn(B, C, kvh, d), jnp.float32)
+    v = jnp.asarray(rng.randn(B, C, kvh, d), jnp.float32)
+    kp = jnp.zeros((kvh, P, page, d), jnp.int8)
+    vp = jnp.zeros((kvh, P, page, d), jnp.int8)
+    ks = jnp.zeros((kvh, P, page), jnp.float32)
+    vs = jnp.zeros((kvh, P, page), jnp.float32)
+    tables = jnp.asarray([[1, 2], [3, 4]], jnp.int32)
+    ctx = jnp.asarray([0, 0], jnp.int32)
+    valid = jnp.asarray([3, 2], jnp.int32)   # per-seq valid counts
+    kp, vp, ks, vs = PA.paged_prefill_write_quant(
+        kp, vp, ks, vs, k, v, tables, ctx, valid)
+    # seq 0 wrote 3 valid tokens onto page 1 (+ the 4th to trash 0)
+    back = np.asarray(PA.dequantize_pages(kp, ks), np.float32)
+    src = np.asarray(k, np.float32)
+    for b, pid in ((0, 1), (1, 3)):
+        nvalid = int(np.asarray(valid)[b])
+        got = back[:, pid, :nvalid, :]
+        want = np.transpose(src[b, :nvalid], (1, 0, 2))
+        assert np.abs(got - want).max() < 0.05
+    # invalid tokens landed on page 0, nowhere else: pages 2 and 4
+    # (each seq's second table page) stay untouched
+    assert not np.asarray(kp)[:, 2].any()
+    assert not np.asarray(kp)[:, 4].any()
+    assert np.asarray(kp)[:, 0].any()          # trash took the spill
+    assert np.asarray(ks)[:, 0].any()          # scales follow the data
+
+
+def test_oracle_matches_bf16_and_kernel_matches_oracle():
+    """End-to-end attention parity: (a) the quantized jnp oracle stays
+    close to the bf16 oracle (quantization error only), (b) the Pallas
+    kernel's in-VMEM dequant matches the quantized oracle nearly
+    exactly (same math, different placement)."""
+    from paddle_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention as kernel)
+    B, C, H, kvh, d = 2, 4, 4, 2, 16
+    P, page, pages = 9, 4, 4
+    rng = np.random.RandomState(3)
+    q = jnp.asarray(rng.randn(B, C, H, d), jnp.float32)
+    kp = jnp.asarray(rng.randn(kvh, P, page, d), jnp.float32)
+    vp = jnp.asarray(rng.randn(kvh, P, page, d), jnp.float32)
+    tables = jnp.asarray(
+        (np.arange(B * pages).reshape(B, pages) + 1), jnp.int32)
+    ctx = jnp.asarray([5, 9], jnp.int32)
+    lens = jnp.asarray([4, 2], jnp.int32)
+    ref = PA.ragged_paged_attention_reference(
+        q, kp, vp, tables, ctx, lens)
+    (qk, sk), (qv, sv) = (PA.quantize_kv(kp, jnp.int8),
+                          PA.quantize_kv(vp, jnp.int8))
+    ref_q = PA.ragged_paged_attention_reference(
+        q, qk, qv, tables, ctx, lens, k_scales=sk, v_scales=sv)
+    err_quant = np.abs(np.asarray(ref_q) - np.asarray(ref)).max()
+    assert err_quant < 0.1          # quantization error, bounded
+    out_k = kernel(q, qk, qv, tables, ctx, lens,
+                   k_scales=sk, v_scales=sv)
+    err_kernel = np.abs(np.asarray(out_k)
+                        - np.asarray(ref_q)).max()
+    assert err_kernel < 1e-4        # same math, numerically tight
+
+
+def test_fp8_typed_error_or_works():
+    m, _ = _model()
+    if not hasattr(jnp, "float8_e4m3fn"):
+        with pytest.raises(ValueError, match="float8_e4m3fn"):
+            _engine(kv_quant="fp8")
+        return
+    eng = _engine(kv_quant="fp8")
+    toks = _streams(eng, _prompts(2), n_new=4)
+    assert all(len(t) == 4 for t in toks)
+
+
+def test_engine_ctor_rejects_unknown_kv_quant():
+    with pytest.raises(ValueError, match="kv_quant"):
+        _engine(kv_quant="int3")
+
+
+# ---- engine accuracy gate ------------------------------------------------
+
+def test_accuracy_gate_int8_kv():
+    """The ISSUE-20 accuracy gate: greedy streams under int8 KV vs the
+    full-precision oracle on the same weights. Bars pinned with margin
+    below the measured fixed-seed values (4/5 exact sequences, ~0.97
+    token agreement)."""
+    prompts = _prompts(5)
+    oracle = _streams(_engine(), prompts)
+    quant = _streams(_engine(kv_quant="int8"), prompts)
+    exact = sum(1 for a, b in zip(oracle, quant) if a == b)
+    assert _agreement(oracle, quant) >= 0.9
+    assert exact >= 3
+    assert all(len(t) == 8 for t in quant)
+
+
+def test_accuracy_gate_weight_only_int8():
+    prompts = _prompts(5)
+    oracle = _streams(_engine(), prompts)
+    cfg = LlamaConfig.tiny()
+    cfg.tensor_parallel = False
+    cfg.scan_layers = False
+    cfg.weight_quant = "weight_only_int8"
+    paddle.seed(0)                  # same init as the oracle model
+    wm = LlamaForCausalLM(cfg)
+    wm.eval()
+    eng = ContinuousBatchingEngine(  # ctor runs quantize_for_serving
+        wm, num_slots=2, page_size=8, max_len=48, decode_chunk=4,
+        prompt_buckets=(16,), greedy=True, audit=True)
+    assert isinstance(wm.lm_head, WeightOnlyLinear)
+    quant = _streams(eng, prompts)
+    assert _agreement(oracle, quant) >= 0.85
+    assert sum(1 for a, b in zip(oracle, quant) if a == b) >= 3
+
+
+# ---- composition ---------------------------------------------------------
+
+def test_prefix_cache_composes_with_int8_kv():
+    """Warm shared-prefix attach under quantized pools: the warm pass
+    reuses quantized pages (hits > 0, tokens saved > 0) and stays
+    token-identical to a cache-off int8 engine; audit (which covers
+    the scales pools) is on throughout."""
+    m, cfg = _model()
+    rng = np.random.RandomState(11)
+    prefix = rng.randint(0, cfg.vocab_size, (16,)).astype(np.int32)
+    prompts = [np.concatenate(
+        [prefix, rng.randint(0, cfg.vocab_size,
+                             (int(rng.randint(1, 4)),)
+                             ).astype(np.int32)]) for _ in range(4)]
+    eng = _engine(kv_quant="int8", num_slots=2)
+    cold = _streams(eng, prompts, n_new=4)
+    warm = _streams(eng, prompts, n_new=4)
+    g = eng.gauges()
+    assert g["prefix_cache_hits"] > 0
+    assert g["prefix_cache_tokens_saved"] > 0
+    off = _engine(kv_quant="int8", num_slots=2, prefix_cache=False)
+    base = _streams(off, prompts, n_new=4)
+    assert cold == base and warm == base
+
+
+def test_preemption_replay_composes_with_int8_kv():
+    """Priority preemption + recompute replay over quantized pools:
+    the replayed stream re-quantizes identical K/V, so every stream
+    matches an unpressured int8 engine token-for-token."""
+    prompts = _prompts(3, seed=13, lo=8, hi=12)
+    calm = _streams(_engine(kv_quant="int8", num_slots=3), prompts,
+                    n_new=6)
+    # starved pool: only one request's pages fit at a time, and the
+    # high-priority straggler preempts the running low-priority one
+    eng = _engine(kv_quant="int8", num_slots=2, num_pages=4)
+    ids = [eng.add_request(prompts[0], 6, priority=0),
+           eng.add_request(prompts[1], 6, priority=1),
+           eng.add_request(prompts[2], 6, priority=2)]
+    by = {r.request_id: r for r in eng.run()}
+    assert [by[i].tokens for i in ids] == calm
+    assert all(by[i].error is None for i in ids)
+
+
+def test_spec_decode_composes_with_int8_kv():
+    prompts = [np.tile(p, 3) for p in _prompts(3, lo=4, hi=7)]
+    plain = _streams(_engine(kv_quant="int8", num_slots=2), prompts,
+                     n_new=8)
+    spec = _streams(_engine(kv_quant="int8", num_slots=2, spec_k=4,
+                            spec_draft="ngram"), prompts, n_new=8)
+    assert spec == plain
+
+
+def test_legacy_engine_composes_with_int8_kv():
+    prompts = _prompts(4)
+    uni = _streams(_engine(kv_quant="int8", num_slots=2), prompts)
+    leg = _streams(_engine(kv_quant="int8", num_slots=2,
+                           unified=False), prompts)
+    assert leg == uni
+
+
+def test_disagg_migration_ships_quantized_pages():
+    """Prefill-role int8 engine exports; the payload crosses the JSON
+    wire codec (per-pool shapes/dtypes, crc over codes AND scales) and
+    imports into a same-quant decode engine; a mixed-quant destination
+    rejects the pages and still completes via tokens-only replay."""
+    prompts = _prompts(2, seed=17, lo=10, hi=13)
+    pre = _engine(kv_quant="int8", role="prefill")
+    hid = [pre.add_request(p, 6) for p in prompts]
+    pre.run()
+    migs = pre.take_migrations()
+    assert len(migs) == len(hid)
+    req, payload = migs[0]
+    assert payload["kv_quant"] == "int8"
+    wire = json.loads(json.dumps(kv_payload_to_wire(payload)))
+    assert wire["kv_quant"] == "int8"
+    assert len(set(map(tuple, wire["shapes"]))) == 2  # data + scales
+    back = kv_payload_from_wire(wire)
+    dec = _engine(kv_quant="int8", role="decode")
+    res = dec.import_migration(req, back)
+    assert res["imported"] > 0 and res["rejected"] == 0
+    done = {r.request_id: r for r in dec.run()}
+    assert len(done[req.request_id].tokens) == 6
+
+    # mixed-quant destination: geometry handshake rejects, replay runs
+    req2, payload2 = migs[1]
+    mixed = _engine(role="decode")          # kv_quant="none"
+    res2 = mixed.import_migration(
+        req2, kv_payload_from_wire(
+            json.loads(json.dumps(kv_payload_to_wire(payload2)))))
+    assert res2["imported"] == 0
+    done2 = {r.request_id: r for r in mixed.run()}
+    assert len(done2[req2.request_id].tokens) == 6
+
+
+def test_audit_covers_scales_pools():
+    m, cfg = _model()
+    eng = _engine(kv_quant="int8")
+    _streams(eng, _prompts(2), n_new=4)
+    assert len(eng.pools) == 4 * cfg.num_hidden_layers
+    for i, p in enumerate(eng.pools):
+        if i % 4 < 2:
+            assert p._data.dtype == jnp.int8
+        else:
+            assert p._data.dtype == jnp.float32
+            assert p._data.ndim == 3
+    eng._audit_pages("test")                # must not raise
+    # a corrupted scales-pool shape must be CAUGHT by the audit
+    good = eng.pools[2]
+    eng.pools[2] = Tensor(good._data[:, :, :4])
+    with pytest.raises(AssertionError):
+        eng._audit_pages("test_corrupt")
+    eng.pools[2] = good
+
+
+def test_migration_kv_bytes_drop_on_wire():
+    """The satellite economics: the quantized migration payload is
+    materially smaller than the full-precision one on the same
+    request (codes are 1 byte vs 2/4, scales amortized over d)."""
+    p = _prompts(1, seed=19, lo=12, hi=13)[0]
+
+    def wire_len(kvq):
+        e = _engine(kv_quant=kvq, role="prefill")
+        e.add_request(p, 4)
+        e.run()
+        return len(json.dumps(kv_payload_to_wire(
+            e.take_migrations()[0][1])))
+
+    assert wire_len("none") / wire_len("int8") > 1.5
+
+
+# ---- weight-only layers --------------------------------------------------
+
+def test_int4_pack_roundtrip_exact():
+    rng = np.random.RandomState(5)
+    for rows in (6, 7):                     # even AND odd in_features
+        codes = rng.randint(-8, 8, (rows, 5)).astype(np.int8)
+        packed = _pack_int4(codes)
+        assert packed.shape == ((rows + 1) // 2, 5)
+        back = np.asarray(_unpack_int4(jnp.asarray(packed), rows))
+        assert (back == codes).all()
+
+
+@pytest.mark.parametrize("algo", ["weight_only_int8",
+                                  "weight_only_int4"])
+def test_weight_only_linear_matches_plain(algo):
+    rng = np.random.RandomState(9)
+    w = rng.randn(16, 12).astype(np.float32)
+    b = rng.randn(12).astype(np.float32)
+    x = Tensor(jnp.asarray(rng.randn(3, 16), jnp.float32))
+    lin = WeightOnlyLinear(Tensor(jnp.asarray(w)),
+                           bias=Tensor(jnp.asarray(b)), algo=algo)
+    got = np.asarray(lin(x)._data)
+    want = np.asarray(x._data) @ w + b
+    # per-element weight error <= absmax/(2r); the 16-term dot
+    # accumulates it, so the int4 (r=7) bound is loose by design
+    tol = 0.05 if algo == "weight_only_int8" else 2.0
+    assert np.abs(got - want).max() < tol
+    if algo == "weight_only_int4":          # nibble-packed storage
+        assert lin.weight_q._data.shape == (8, 12)
+    with pytest.raises(ValueError, match="weight_quant algo"):
+        WeightOnlyLinear(Tensor(jnp.asarray(w)), algo="weight_only_fp4")
+
+
+def test_quantize_for_serving_targets_and_idempotency():
+    cfg = LlamaConfig.tiny()
+    cfg.tensor_parallel = False
+    cfg.scan_layers = False
+    paddle.seed(1)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    stats = quantize_for_serving(m, algo="weight_only_int8")
+    # 7 projections x 2 layers + lm_head
+    assert stats["layers"] == 7 * cfg.num_hidden_layers + 1
+    assert stats["bytes_saved"] > 0
+    assert isinstance(m.lm_head, WeightOnlyLinear)
+    again = quantize_for_serving(m, algo="weight_only_int8")
+    assert again["layers"] == 0             # idempotent
+    # the quantized model still runs a cacheless forward
+    out = m(Tensor(np.arange(6, dtype=np.int32).reshape(1, 6)))
+    assert out._data.shape == (1, 6, cfg.vocab_size)
+
+
+def test_quantize_for_serving_skips_tied_embeddings():
+    cfg = LlamaConfig.tiny()
+    cfg.tensor_parallel = False
+    cfg.scan_layers = False
+    cfg.tie_word_embeddings = True
+    paddle.seed(2)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    assert m.lm_head is None
+    stats = quantize_for_serving(m, algo="weight_only_int8")
+    assert stats["layers"] == 7 * cfg.num_hidden_layers  # no lm_head
+    # a config WITHOUT weight_quant is a no-op through the default path
+    assert quantize_for_serving(LlamaForCausalLM(
+        LlamaConfig.tiny()))["layers"] == 0
+
+
+def test_config_rejects_unknown_weight_quant():
+    with pytest.raises(ValueError, match="weight_quant"):
+        LlamaConfig.tiny().__class__(
+            vocab_size=8, hidden_size=8, num_hidden_layers=1,
+            num_attention_heads=1, num_key_value_heads=1,
+            intermediate_size=8, weight_quant="int5")

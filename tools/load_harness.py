@@ -195,6 +195,15 @@ TRACE_MIXES = {
         long_frac=0.75,
         long_prompt_len=(4, 10), long_max_new=(24, 40),
         short_prompt_len=(3, 6), short_max_new=(12, 20)),
+    # the ISSUE-20 capacity shape: EVERY request carries a real prompt
+    # and decode budget, so page demand (not arrival cadence) is the
+    # binding constraint — the mix where the int8-KV engine's ~2x page
+    # budget at equal pool bytes shows up as peak concurrent slots
+    # (bench.py cb-quant drives it on both A/B legs)
+    "capacity_probe": dict(
+        long_frac=1.0,
+        long_prompt_len=(10, 14), long_max_new=(12, 20),
+        short_prompt_len=(3, 8), short_max_new=(2, 6)),
 }
 
 

@@ -197,6 +197,21 @@ def gate_commands(log: str, budget: float, no_budget: bool,
                            "test_autoscale_scenarios.py"),
               "-q", "-m", "autoscale",
               "-p", "no:cacheprovider"]))
+        # quantized serving (ISSUE 20): the int8/fp8 KV codec bounds
+        # and kernel parity, the greedy accuracy gate vs the full-
+        # precision oracle on fixed-seed weights, composition with
+        # everything that moves pages (prefix cache, preemption
+        # replay, spec decode, legacy engine, disagg migration +
+        # mixed-quant reject), and the weight-only int8/int4 layers.
+        # The FULL quant_serving marker; rides --no-serving with the
+        # rest of the serving stack.
+        gates.append(
+            ("quant_serving",
+             [sys.executable, "-m", "pytest",
+              os.path.join(REPO_DIR, "tests",
+                           "test_quant_serving.py"),
+              "-q", "-m", "quant_serving",
+              "-p", "no:cacheprovider"]))
     if not no_fused:
         # fused training-kernel parity: the interpret-mode kernel-vs-
         # oracle suite with every fused flag forced ON via the
